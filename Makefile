@@ -1,0 +1,10 @@
+# Native runtime components (C++). `make` builds build/librtpu.so; the
+# Python side also builds it on demand (ray_tpu/core/native.py).
+.PHONY: all native test clean
+all: native
+native:
+	python -m ray_tpu.core.native
+test: native
+	python -m pytest tests/ -q
+clean:
+	rm -rf build

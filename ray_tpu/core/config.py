@@ -1,0 +1,132 @@
+"""Runtime configuration flag table.
+
+Parity with the reference's ``RAY_CONFIG(type, name, default)`` macro table
+(reference ``src/ray/common/ray_config_def.h``): a single flat registry of
+typed flags, each overridable by an ``RAY_TPU_<NAME>`` environment variable
+or via ``ray_tpu.init(_system_config={...})``.  The resolved table is
+serialized from the head node to every other process so the whole cluster
+sees one consistent configuration.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class Config:
+    # ---- object store ----------------------------------------------------
+    #: Bytes of shared memory for the per-node object store (0 = auto: 30%
+    #: of system memory, capped).
+    object_store_memory: int = 0
+    #: Objects at or below this size are kept in the owner's in-process
+    #: memory store and inlined into task specs instead of going to shm.
+    max_direct_call_object_size: int = 100 * 1024
+    #: Chunk size for node-to-node object transfer.
+    object_transfer_chunk_size: int = 5 * 1024 * 1024
+    #: Fraction of store capacity at which LRU eviction starts.
+    object_store_eviction_fraction: float = 1.0
+    #: Directory for spilled objects ("" = <session_dir>/spill).
+    object_spilling_directory: str = ""
+    #: Start spilling primary copies when the store is this full.
+    object_spilling_threshold: float = 0.8
+
+    # ---- scheduling ------------------------------------------------------
+    #: Hybrid policy: pack onto the local/first node until its utilization
+    #: exceeds this threshold, then spread (reference
+    #: ``hybrid_scheduling_policy.h:48``).
+    scheduler_spread_threshold: float = 0.5
+    #: Max tasks in flight to a single leased worker before requesting more
+    #: workers (pipelining depth).
+    max_tasks_in_flight_per_worker: int = 10
+    #: Seconds a leased idle worker is kept before being returned.
+    idle_worker_lease_timeout_s: float = 1.0
+    #: Number of workers each raylet keeps pre-started.
+    num_prestart_workers: int = 0
+    #: Hard cap on workers a raylet will spawn (0 = 4 * num_cpus).
+    max_workers_per_node: int = 0
+
+    # ---- fault tolerance -------------------------------------------------
+    default_max_task_retries: int = 3
+    default_max_actor_restarts: int = 0
+    #: Period of raylet -> GCS health reports.
+    health_report_period_s: float = 1.0
+    #: GCS declares a node dead after this long without a report.
+    health_timeout_s: float = 10.0
+    #: Max attempts to reconstruct a lost object through lineage.
+    max_lineage_reconstruction_depth: int = 100
+
+    # ---- RPC / transport -------------------------------------------------
+    rpc_connect_timeout_s: float = 10.0
+    rpc_retry_delay_s: float = 0.1
+    rpc_max_retries: int = 5
+    #: Long-poll pubsub batch window.
+    pubsub_batch_window_s: float = 0.01
+
+    # ---- workers ---------------------------------------------------------
+    worker_register_timeout_s: float = 30.0
+    #: Seconds between raylet resource-view broadcasts to the GCS (the
+    #: ray_syncer-equivalent cadence).
+    resource_broadcast_period_s: float = 0.1
+
+    # ---- TPU / mesh ------------------------------------------------------
+    #: Default logical mesh axis names, outermost first.
+    mesh_axis_order: str = "dp,fsdp,sp,tp"
+    #: Label under which TPU chips appear as a schedulable resource.
+    tpu_resource_name: str = "TPU"
+
+    # ---- misc ------------------------------------------------------------
+    session_root: str = "/tmp/ray_tpu"
+    log_to_driver: bool = True
+    event_stats: bool = True
+    task_events_buffer_size: int = 10000
+    metrics_report_period_s: float = 5.0
+
+    def apply_env_overrides(self) -> "Config":
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is None:
+                continue
+            if f.type in ("int", int):
+                setattr(self, f.name, int(env))
+            elif f.type in ("float", float):
+                setattr(self, f.name, float(env))
+            elif f.type in ("bool", bool):
+                setattr(self, f.name, env.lower() in ("1", "true", "yes"))
+            else:
+                setattr(self, f.name, env)
+        return self
+
+    def apply_overrides(self, overrides: Dict[str, Any] | None) -> "Config":
+        for key, value in (overrides or {}).items():
+            if not hasattr(self, key):
+                raise ValueError(f"Unknown system config key: {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_json(cls, blob: str) -> "Config":
+        return cls(**json.loads(blob))
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config().apply_env_overrides()
+    return _global_config
+
+
+def set_config(config: Config) -> None:
+    global _global_config
+    _global_config = config

@@ -1,0 +1,92 @@
+"""ctypes loader for the native (C++) runtime components.
+
+The native sources live in ``src/`` at the repo root and are compiled to a
+shared library on first use (cached by source mtime), or ahead of time via
+``make`` / ``python -m ray_tpu.core.native``.  ctypes rather than an
+extension module keeps the build a single ``g++`` invocation with no
+Python-dev dependency (pybind11 is unavailable in this environment).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_SRC_DIR = os.path.join(_REPO_ROOT, "src")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "librtpu.so")
+_SOURCES = ["object_store.cc"]
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_SRC_DIR, s)) > lib_mtime for s in _SOURCES
+    )
+
+
+def build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-g", "-fPIC", "-shared",
+        "-Wall", "-Wextra",
+        *[os.path.join(_SRC_DIR, s) for s in _SOURCES],
+        "-o", _LIB_PATH + ".tmp",
+        "-pthread",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _needs_build():
+            build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        u64 = ctypes.c_uint64
+        p_u64 = ctypes.POINTER(u64)
+        buf = ctypes.c_char_p  # 28-byte id blobs pass as bytes
+
+        lib.rtpu_store_create.restype = ctypes.c_void_p
+        lib.rtpu_store_create.argtypes = [ctypes.c_char_p, u64]
+        lib.rtpu_store_destroy.restype = None
+        lib.rtpu_store_destroy.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_put.restype = ctypes.c_int64
+        lib.rtpu_store_put.argtypes = [ctypes.c_void_p, buf, u64]
+        lib.rtpu_store_seal.restype = ctypes.c_int
+        lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, buf]
+        lib.rtpu_store_get.restype = ctypes.c_int
+        lib.rtpu_store_get.argtypes = [ctypes.c_void_p, buf, p_u64, p_u64]
+        lib.rtpu_store_release.restype = ctypes.c_int
+        lib.rtpu_store_release.argtypes = [ctypes.c_void_p, buf]
+        lib.rtpu_store_contains.restype = ctypes.c_int
+        lib.rtpu_store_contains.argtypes = [ctypes.c_void_p, buf]
+        lib.rtpu_store_delete.restype = ctypes.c_int
+        lib.rtpu_store_delete.argtypes = [ctypes.c_void_p, buf]
+        lib.rtpu_store_evict.restype = u64
+        lib.rtpu_store_evict.argtypes = [ctypes.c_void_p, u64]
+        lib.rtpu_store_lru_candidates.restype = u64
+        lib.rtpu_store_lru_candidates.argtypes = [ctypes.c_void_p,
+                                                  ctypes.c_char_p, u64]
+        lib.rtpu_store_stats.restype = None
+        lib.rtpu_store_stats.argtypes = [ctypes.c_void_p, p_u64, p_u64, p_u64]
+        _lib = lib
+        return _lib
+
+
+if __name__ == "__main__":
+    print(build())

@@ -1,0 +1,108 @@
+"""ObjectRef: a first-class future/handle for a distributed immutable value.
+
+Parity: reference ``python/ray/_raylet.pyx`` ObjectRef + the ownership
+model of ``src/ray/core_worker/reference_count.h`` — every ref knows its
+*owner* (the worker that created it), which is the authority for the
+value's location and lifetime.  Local ref counting is driven by Python
+object lifetime: ``__del__`` notifies the core worker, which releases the
+object once all local refs, submitted-task refs, and borrows are gone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ray_tpu.core.ids import ObjectID
+
+# Owner address: (node hint, host, port, worker_id_hex). Kept as a plain
+# tuple so it pickles compactly inside task specs.
+OwnerAddress = Tuple[str, str, int, str]
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_address", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: Optional[OwnerAddress],
+                 *, _register: bool = True):
+        self._id = object_id
+        self._owner_address = owner_address
+        self._registered = False
+        if _register:
+            self._register()
+
+    def _register(self) -> None:
+        from ray_tpu.core import worker as worker_mod
+
+        core = worker_mod.global_worker_or_none()
+        if core is not None:
+            core.reference_counter.add_local_ref(self._id)
+            self._registered = True
+
+    # -- identity ---------------------------------------------------------
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def owner_address(self) -> Optional[OwnerAddress]:
+        return self._owner_address
+
+    def task_id(self):
+        return self._id.task_id()
+
+    # -- convenience ------------------------------------------------------
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from ray_tpu.core import worker as worker_mod
+
+        return worker_mod.global_worker().get_async(self)
+
+    def __await__(self):
+        from ray_tpu.core import worker as worker_mod
+
+        import asyncio
+
+        fut = worker_mod.global_worker().get_async(self)
+        return asyncio.wrap_future(fut).__await__()
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id.hex()})"
+
+    def __del__(self):
+        if not self._registered:
+            return
+        from ray_tpu.core import worker as worker_mod
+
+        core = worker_mod.global_worker_or_none()
+        if core is not None:
+            try:
+                core.reference_counter.remove_local_ref(self._id)
+            except Exception:
+                pass  # interpreter shutdown
+
+    def __reduce__(self):
+        # Direct pickling travels through serialization.persistent_id in
+        # task specs / values; this path covers ad-hoc pickling and marks
+        # the ref restored (borrowed) on the far side.
+        return (ObjectRef._restore, (self._id.binary(), self._owner_address))
+
+    @staticmethod
+    def _restore(id_bytes: bytes, owner_address: Optional[OwnerAddress]) -> "ObjectRef":
+        ref = ObjectRef(ObjectID(id_bytes), owner_address, _register=False)
+        from ray_tpu.core import worker as worker_mod
+
+        core = worker_mod.global_worker_or_none()
+        if core is not None:
+            core.reference_counter.add_borrowed_ref(ref._id, owner_address)
+            ref._registered = True
+        return ref

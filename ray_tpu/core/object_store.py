@@ -1,0 +1,207 @@
+"""Object store layers: native shared-memory store + in-process memory store.
+
+Parity map (reference):
+- ``SharedMemoryStore``  -> plasma store, owned by the raylet
+  (``src/ray/object_manager/plasma/store.h``); here a thin wrapper over the
+  C++ library in ``src/object_store.cc``.
+- ``StoreClient``        -> plasma client (``plasma/client.cc``); workers
+  mmap the raylet's arena file and turn {offset,size} leases into zero-copy
+  memoryviews.
+- ``MemoryStore``        -> the core worker's in-process store for small /
+  inlined objects (``core_worker/store_provider/memory_store/memory_store.h``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core import native
+from ray_tpu.core.exceptions import ObjectStoreFullError
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.serialization import SerializedObject
+
+
+class SharedMemoryStore:
+    """Raylet-side owner of the shm arena (C++ allocator + LRU)."""
+
+    def __init__(self, path: str, capacity: int):
+        self._lib = native.load()
+        self._handle = self._lib.rtpu_store_create(path.encode(), capacity)
+        if not self._handle:
+            raise OSError(f"failed to create object store at {path}")
+        self.path = path
+        self.capacity = capacity
+        self._mm = _map_file(path, capacity)
+        self._view = memoryview(self._mm)
+
+    # -- producer side ----------------------------------------------------
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        rc = self._lib.rtpu_store_put(self._handle, object_id.binary(), size)
+        if rc == -2:
+            raise ValueError(f"object {object_id.hex()} already exists")
+        if rc < 0:
+            raise ObjectStoreFullError(
+                f"cannot allocate {size} bytes (capacity {self.capacity})"
+            )
+        return self._view[rc : rc + size]
+
+    def seal(self, object_id: ObjectID) -> None:
+        self._lib.rtpu_store_seal(self._handle, object_id.binary())
+
+    def put_serialized(self, object_id: ObjectID, obj: SerializedObject) -> int:
+        size = obj.total_size()
+        buf = self.create(object_id, size)
+        obj.write_to(buf)
+        self.seal(object_id)
+        return size
+
+    def put_raw(self, object_id: ObjectID, data: bytes) -> int:
+        buf = self.create(object_id, len(data))
+        buf[:] = data
+        self.seal(object_id)
+        return len(data)
+
+    # -- consumer side ----------------------------------------------------
+    def lease(self, object_id: ObjectID) -> Optional[Tuple[int, int]]:
+        """Pin the object; returns (offset, size) or None. Caller must
+        eventually call release()."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        ok = self._lib.rtpu_store_get(
+            self._handle, object_id.binary(), ctypes.byref(off), ctypes.byref(size)
+        )
+        return (off.value, size.value) if ok else None
+
+    def view(self, offset: int, size: int) -> memoryview:
+        return self._view[offset : offset + size]
+
+    def get_pinned(self, object_id: ObjectID) -> Optional[memoryview]:
+        lease = self.lease(object_id)
+        if lease is None:
+            return None
+        return self.view(*lease)
+
+    def release(self, object_id: ObjectID) -> None:
+        self._lib.rtpu_store_release(self._handle, object_id.binary())
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return bool(self._lib.rtpu_store_contains(self._handle, object_id.binary()))
+
+    def delete(self, object_id: ObjectID) -> bool:
+        return bool(self._lib.rtpu_store_delete(self._handle, object_id.binary()))
+
+    def evict(self, bytes_needed: int) -> int:
+        return self._lib.rtpu_store_evict(self._handle, bytes_needed)
+
+    def lru_candidates(self, max_ids: int = 64) -> List[ObjectID]:
+        out = ctypes.create_string_buffer(ObjectID.SIZE * max_ids)
+        n = self._lib.rtpu_store_lru_candidates(self._handle, out, max_ids)
+        raw = out.raw
+        return [
+            ObjectID(raw[i * ObjectID.SIZE : (i + 1) * ObjectID.SIZE])
+            for i in range(n)
+        ]
+
+    def stats(self) -> Dict[str, int]:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        num = ctypes.c_uint64()
+        self._lib.rtpu_store_stats(
+            self._handle, ctypes.byref(used), ctypes.byref(cap), ctypes.byref(num)
+        )
+        return {"used": used.value, "capacity": cap.value, "num_objects": num.value}
+
+    def close(self) -> None:
+        if self._handle:
+            self._view.release()
+            self._mm.close()
+            self._lib.rtpu_store_destroy(self._handle)
+            self._handle = None
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+class StoreClient:
+    """Worker-side zero-copy view of the raylet's arena file.
+
+    Metadata operations (create/seal/get/release) go through the raylet
+    socket; this class only turns granted {offset,size} leases into
+    memoryviews over a private mapping of the same file.
+    """
+
+    def __init__(self, path: str, capacity: int):
+        self.path = path
+        self._mm = _map_file(path, capacity)
+        self._view = memoryview(self._mm)
+
+    def view(self, offset: int, size: int) -> memoryview:
+        return self._view[offset : offset + size]
+
+    def close(self) -> None:
+        self._view.release()
+        self._mm.close()
+
+
+def _map_file(path: str, capacity: int) -> mmap.mmap:
+    fd = os.open(path, os.O_RDWR)
+    try:
+        return mmap.mmap(fd, capacity)
+    finally:
+        os.close(fd)
+
+
+class MemoryStore:
+    """In-process store for small objects, with blocking waiters.
+
+    Values are kept serialized (meta+buffer bytes) so a stored exception or
+    cross-process handoff behaves identically to the shm path.
+    """
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._objects: Dict[ObjectID, bytes] = {}
+
+    def put(self, object_id: ObjectID, data: bytes) -> None:
+        with self._lock:
+            self._objects[object_id] = data
+            self._lock.notify_all()
+
+    def get(self, object_id: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float]) -> List[ObjectID]:
+        """Block until num_returns of object_ids are present (or timeout)."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = [o for o in object_ids if o in self._objects]
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return ready
+                self._lock.wait(remaining)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)

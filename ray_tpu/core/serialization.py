@@ -1,0 +1,179 @@
+"""Value serialization with zero-copy buffer support.
+
+Parity: reference ``python/ray/_private/serialization.py`` (cloudpickle +
+pickle-5 out-of-band buffers, zero-copy numpy reads from plasma).
+
+Wire layout of a serialized object:
+
+    [8B magic+version][4B meta_len][meta pickle][4B n_buffers]
+    ([8B len][pad to 64][buffer bytes]) * n_buffers
+
+The metadata pickle is produced with ``cloudpickle`` (protocol 5) using a
+``buffer_callback`` so large contiguous buffers (numpy arrays, jax host
+arrays, bytes) are extracted out-of-band.  On read, buffers are
+reconstructed as memoryviews directly over the shared-memory mapping —
+numpy arrays alias store memory with no copy.  Buffers are 64-byte aligned
+so the views are friendly to XLA host-buffer donation.
+
+ObjectRefs found inside values are serialized specially so the ownership
+layer can track borrowed references (reference ``serialization.py``'s
+object-ref hooks); the contained refs are collected into the header.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable, List, Tuple
+
+import cloudpickle
+
+_MAGIC = b"RTPUOBJ1"
+_ALIGN = 64
+
+# Sentinel metadata for special object kinds (parity: reference object
+# metadata strings like RAW / ACTOR_DIED etc.).
+META_EXCEPTION = b"__rtpu_exc__"
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class SerializedObject:
+    """A serialized value: a metadata blob plus out-of-band buffers."""
+
+    __slots__ = ("meta", "buffers", "contained_refs")
+
+    def __init__(self, meta: bytes, buffers: List, contained_refs: List):
+        self.meta = meta
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def total_size(self) -> int:
+        size = len(_MAGIC) + 4 + len(self.meta) + 4
+        for buf in self.buffers:
+            size = _pad(size + 8) + memoryview(buf).nbytes
+        return size
+
+    def write_to(self, dest: memoryview) -> int:
+        """Write the wire format into ``dest``; returns bytes written."""
+        offset = 0
+
+        def put(data) -> None:
+            nonlocal offset
+            n = len(data)
+            dest[offset : offset + n] = bytes(data) if not isinstance(
+                data, (bytes, bytearray, memoryview)
+            ) else data
+            offset += n
+
+        put(_MAGIC)
+        put(struct.pack("<I", len(self.meta)))
+        put(self.meta)
+        put(struct.pack("<I", len(self.buffers)))
+        for buf in self.buffers:
+            view = memoryview(buf).cast("B")
+            header_end = offset + 8
+            data_start = _pad(header_end)
+            put(struct.pack("<Q", view.nbytes))
+            # zero pad for determinism
+            dest[offset:data_start] = b"\x00" * (data_start - offset)
+            offset = data_start
+            dest[offset : offset + view.nbytes] = view
+            offset += view.nbytes
+        return offset
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size())
+        n = self.write_to(memoryview(out))
+        return bytes(out[:n])
+
+
+def serialize(value: Any) -> SerializedObject:
+    """Serialize ``value``, extracting large buffers out-of-band and
+    collecting any contained ObjectRefs."""
+    from ray_tpu.core.object_ref import ObjectRef  # cycle-free at call time
+
+    buffers: List = []
+    contained: List = []
+
+    def buffer_callback(buf: pickle.PickleBuffer) -> bool:
+        view = buf.raw()
+        if view.nbytes >= 512:  # tiny buffers travel in-band
+            buffers.append(view)
+            return False  # out-of-band
+        return True
+
+    class _Pickler(cloudpickle.CloudPickler):
+        def persistent_id(self, obj):  # noqa: N802 (pickle API name)
+            if isinstance(obj, ObjectRef):
+                contained.append(obj)
+                return ("rtpu_ref", obj.binary(), obj.owner_address())
+            return None
+
+    sink = io.BytesIO()
+    pickler = _Pickler(sink, protocol=5, buffer_callback=buffer_callback)
+    pickler.dump(value)
+    return SerializedObject(sink.getvalue(), buffers, contained)
+
+
+def serialize_exception(exc: BaseException) -> SerializedObject:
+    from ray_tpu.core.exceptions import TaskError
+
+    if not isinstance(exc, TaskError):
+        exc = TaskError.from_exception(exc)
+    try:
+        out = serialize(exc)
+    except Exception:
+        out = serialize(TaskError(None, exc.remote_traceback, exc.task_desc))
+    out.meta += META_EXCEPTION  # flag so get() raises instead of returning
+    return out
+
+
+def deserialize(data, out_of_band_owner: Any = None) -> Tuple[Any, bool]:
+    """Deserialize wire bytes; returns ``(value, is_exception)``.
+
+    ``data`` may be any buffer (bytes or a memoryview over shared memory).
+    Buffers inside the mapping are NOT copied; numpy arrays alias it.
+    ``out_of_band_owner`` is attached to reconstructed ObjectRefs so
+    borrow-tracking knows where the value came from.
+    """
+    view = memoryview(data).cast("B")
+    if bytes(view[: len(_MAGIC)]) != _MAGIC:
+        raise ValueError("corrupt serialized object (bad magic)")
+    offset = len(_MAGIC)
+    (meta_len,) = struct.unpack_from("<I", view, offset)
+    offset += 4
+    meta = view[offset : offset + meta_len]
+    offset += meta_len
+    (n_buffers,) = struct.unpack_from("<I", view, offset)
+    offset += 4
+    buffers: List[memoryview] = []
+    for _ in range(n_buffers):
+        (buf_len,) = struct.unpack_from("<Q", view, offset)
+        offset = _pad(offset + 8)
+        buffers.append(view[offset : offset + buf_len])
+        offset += buf_len
+
+    meta_bytes = bytes(meta)
+    is_exception = meta_bytes.endswith(META_EXCEPTION)
+    if is_exception:
+        meta_bytes = meta_bytes[: -len(META_EXCEPTION)]
+
+    value = _unpickle(meta_bytes, buffers)
+    return value, is_exception
+
+
+def _unpickle(meta: bytes, buffers: List[memoryview]) -> Any:
+    from ray_tpu.core.object_ref import ObjectRef
+
+    class _Unpickler(pickle.Unpickler):
+        def persistent_load(self, pid):
+            tag, ref_bytes, owner_addr = pid
+            if tag != "rtpu_ref":
+                raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+            return ObjectRef._restore(ref_bytes, owner_addr)
+
+    return _Unpickler(io.BytesIO(meta), buffers=buffers).load()

@@ -1,0 +1,323 @@
+// Shared-memory object store: the plasma equivalent for the TPU runtime.
+//
+// Design parity with the reference's plasma store
+// (reference src/ray/object_manager/plasma/{store.h,object_lifecycle_manager.h,
+// eviction_policy.h,plasma_allocator.cc}) re-thought for this runtime's
+// process model: the store library lives inside the raylet process, which
+// owns a large mmap'd file in /dev/shm.  Worker processes mmap the same
+// file read-only (or read-write while producing) and receive {offset,size}
+// leases from the raylet over its socket.  All metadata (object table,
+// free list, LRU queue, pin counts) therefore lives in ordinary process
+// memory here — no in-shm metadata, no lock-free tricks needed, and the
+// data plane stays zero-copy.
+//
+// Allocation: first-fit over an offset-ordered free list with coalescing
+// on free; 64-byte alignment so numpy/XLA host buffers are aligned.
+// Eviction: LRU over sealed, unpinned objects (reference
+// eviction_policy.h:160), triggered on allocation failure and by an
+// explicit spill-candidate query so the raylet can spill before the store
+// is hard-full.
+//
+// C ABI only (loaded via ctypes): every function is `extern "C"`, handles
+// are opaque pointers, ids are fixed 28-byte blobs.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kAlign = 64;
+constexpr size_t kIdSize = 28;
+
+inline uint64_t AlignUp(uint64_t n) { return (n + kAlign - 1) / kAlign * kAlign; }
+
+struct IdKey {
+  unsigned char b[kIdSize];
+  bool operator==(const IdKey& o) const { return std::memcmp(b, o.b, kIdSize) == 0; }
+};
+
+struct IdHash {
+  size_t operator()(const IdKey& k) const {
+    // ids contain fresh entropy in their tail; fold 8 tail bytes.
+    uint64_t h;
+    std::memcpy(&h, k.b + kIdSize - 8, 8);
+    return static_cast<size_t>(h * 0x9E3779B97F4A7C15ull);
+  }
+};
+
+enum class ObjectState : uint8_t { kCreated, kSealed };
+
+struct Entry {
+  uint64_t offset = 0;
+  uint64_t size = 0;          // payload size requested by the client
+  uint64_t alloc_size = 0;    // aligned size actually reserved
+  ObjectState state = ObjectState::kCreated;
+  int64_t pin_count = 0;      // outstanding get leases (evict only at 0)
+  uint64_t seq = 0;           // LRU clock value at last touch
+  std::list<IdKey>::iterator lru_it;
+  bool in_lru = false;
+};
+
+class Store {
+ public:
+  Store(void* base, uint64_t capacity, int fd, std::string path)
+      : base_(static_cast<unsigned char*>(base)),
+        capacity_(capacity),
+        fd_(fd),
+        path_(std::move(path)) {
+    free_.emplace(0, capacity);
+  }
+
+  ~Store() {
+    munmap(base_, capacity_);
+    close(fd_);
+  }
+
+  // Returns payload offset, or -1 if full even after eviction, or -2 if
+  // the id already exists.
+  int64_t Create(const IdKey& id, uint64_t size) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (table_.count(id)) return -2;
+    uint64_t need = AlignUp(std::max<uint64_t>(size, 1));
+    int64_t off = AllocLocked(need);
+    if (off < 0) {
+      EvictLocked(need);
+      off = AllocLocked(need);
+      if (off < 0) return -1;
+    }
+    Entry e;
+    e.offset = static_cast<uint64_t>(off);
+    e.size = size;
+    e.alloc_size = need;
+    e.state = ObjectState::kCreated;
+    used_ += need;
+    table_.emplace(id, std::move(e));
+    return off;
+  }
+
+  bool Seal(const IdKey& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = table_.find(id);
+    if (it == table_.end() || it->second.state == ObjectState::kSealed) return false;
+    it->second.state = ObjectState::kSealed;
+    TouchLocked(id, it->second);
+    return true;
+  }
+
+  // Pins the object (caller must Release). Returns false if absent/unsealed.
+  bool Get(const IdKey& id, uint64_t* offset, uint64_t* size) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = table_.find(id);
+    if (it == table_.end() || it->second.state != ObjectState::kSealed) return false;
+    it->second.pin_count++;
+    if (it->second.in_lru) {  // pinned objects leave the eviction queue
+      lru_.erase(it->second.lru_it);
+      it->second.in_lru = false;
+    }
+    *offset = it->second.offset;
+    *size = it->second.size;
+    return true;
+  }
+
+  bool Release(const IdKey& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = table_.find(id);
+    if (it == table_.end() || it->second.pin_count <= 0) return false;
+    if (--it->second.pin_count == 0) TouchLocked(id, it->second);
+    return true;
+  }
+
+  bool Contains(const IdKey& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = table_.find(id);
+    return it != table_.end() && it->second.state == ObjectState::kSealed;
+  }
+
+  // Abort an unsealed create or delete a sealed, unpinned object.
+  bool Delete(const IdKey& id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = table_.find(id);
+    if (it == table_.end() || it->second.pin_count > 0) return false;
+    FreeEntryLocked(it);
+    return true;
+  }
+
+  uint64_t Evict(uint64_t bytes_needed) {
+    std::lock_guard<std::mutex> g(mu_);
+    return EvictLocked(bytes_needed);
+  }
+
+  // Oldest sealed unpinned objects — the raylet's spill candidates.
+  // Writes up to max ids into out (28 bytes each); returns count.
+  uint64_t LruCandidates(unsigned char* out, uint64_t max_ids) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint64_t n = 0;
+    for (auto it = lru_.begin(); it != lru_.end() && n < max_ids; ++it, ++n) {
+      std::memcpy(out + n * kIdSize, it->b, kIdSize);
+    }
+    return n;
+  }
+
+  void Stats(uint64_t* used, uint64_t* capacity, uint64_t* num_objects) {
+    std::lock_guard<std::mutex> g(mu_);
+    *used = used_;
+    *capacity = capacity_;
+    *num_objects = table_.size();
+  }
+
+  const std::string& path() const { return path_; }
+
+ private:
+  // ---- locked helpers ----
+  int64_t AllocLocked(uint64_t need) {
+    for (auto it = free_.begin(); it != free_.end(); ++it) {
+      if (it->second >= need) {
+        uint64_t off = it->first;
+        uint64_t remaining = it->second - need;
+        free_.erase(it);
+        if (remaining > 0) free_.emplace(off + need, remaining);
+        return static_cast<int64_t>(off);
+      }
+    }
+    return -1;
+  }
+
+  void FreeBlockLocked(uint64_t off, uint64_t len) {
+    auto next = free_.lower_bound(off);
+    // coalesce with predecessor
+    if (next != free_.begin()) {
+      auto prev = std::prev(next);
+      if (prev->first + prev->second == off) {
+        off = prev->first;
+        len += prev->second;
+        free_.erase(prev);
+      }
+    }
+    // coalesce with successor
+    if (next != free_.end() && off + len == next->first) {
+      len += next->second;
+      free_.erase(next);
+    }
+    free_.emplace(off, len);
+  }
+
+  void TouchLocked(const IdKey& id, Entry& e) {
+    if (e.in_lru) lru_.erase(e.lru_it);
+    lru_.push_back(id);
+    e.lru_it = std::prev(lru_.end());
+    e.in_lru = true;
+    e.seq = ++clock_;
+  }
+
+  void FreeEntryLocked(std::unordered_map<IdKey, Entry, IdHash>::iterator it) {
+    Entry& e = it->second;
+    if (e.in_lru) lru_.erase(e.lru_it);
+    FreeBlockLocked(e.offset, e.alloc_size);
+    used_ -= e.alloc_size;
+    table_.erase(it);
+  }
+
+  uint64_t EvictLocked(uint64_t bytes_needed) {
+    uint64_t freed = 0;
+    while (freed < bytes_needed && !lru_.empty()) {
+      IdKey victim = lru_.front();
+      auto it = table_.find(victim);
+      // lru_ only holds sealed & unpinned entries by construction.
+      freed += it->second.alloc_size;
+      FreeEntryLocked(it);
+    }
+    return freed;
+  }
+
+  std::mutex mu_;
+  unsigned char* base_;
+  uint64_t capacity_;
+  uint64_t used_ = 0;
+  uint64_t clock_ = 0;
+  int fd_;
+  std::string path_;
+  std::unordered_map<IdKey, Entry, IdHash> table_;
+  std::map<uint64_t, uint64_t> free_;  // offset -> length, offset-ordered
+  std::list<IdKey> lru_;               // front = oldest evictable
+};
+
+IdKey MakeKey(const unsigned char* id) {
+  IdKey k;
+  std::memcpy(k.b, id, kIdSize);
+  return k;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Creates (truncating) the backing file and maps it. Returns NULL on error.
+void* rtpu_store_create(const char* path, uint64_t capacity) {
+  int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(capacity)) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  return new Store(base, capacity, fd, path);
+}
+
+void rtpu_store_destroy(void* handle) { delete static_cast<Store*>(handle); }
+
+int64_t rtpu_store_put(void* handle, const unsigned char* id, uint64_t size) {
+  return static_cast<Store*>(handle)->Create(MakeKey(id), size);
+}
+
+int rtpu_store_seal(void* handle, const unsigned char* id) {
+  return static_cast<Store*>(handle)->Seal(MakeKey(id)) ? 1 : 0;
+}
+
+int rtpu_store_get(void* handle, const unsigned char* id, uint64_t* offset,
+                   uint64_t* size) {
+  return static_cast<Store*>(handle)->Get(MakeKey(id), offset, size) ? 1 : 0;
+}
+
+int rtpu_store_release(void* handle, const unsigned char* id) {
+  return static_cast<Store*>(handle)->Release(MakeKey(id)) ? 1 : 0;
+}
+
+int rtpu_store_contains(void* handle, const unsigned char* id) {
+  return static_cast<Store*>(handle)->Contains(MakeKey(id)) ? 1 : 0;
+}
+
+int rtpu_store_delete(void* handle, const unsigned char* id) {
+  return static_cast<Store*>(handle)->Delete(MakeKey(id)) ? 1 : 0;
+}
+
+uint64_t rtpu_store_evict(void* handle, uint64_t bytes_needed) {
+  return static_cast<Store*>(handle)->Evict(bytes_needed);
+}
+
+uint64_t rtpu_store_lru_candidates(void* handle, unsigned char* out,
+                                   uint64_t max_ids) {
+  return static_cast<Store*>(handle)->LruCandidates(out, max_ids);
+}
+
+void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
+                      uint64_t* num_objects) {
+  static_cast<Store*>(handle)->Stats(used, capacity, num_objects);
+}
+
+}  // extern "C"

@@ -1,0 +1,36 @@
+"""Test configuration.
+
+JAX-based tests run against a virtual 8-device CPU mesh (multi-chip
+hardware is unavailable in CI); the env vars must be set before jax is
+imported anywhere in the process, hence they live at module import time
+here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def shutdown_only():
+    """Ensure the runtime is torn down after a test that calls init()."""
+    yield None
+    import ray_tpu
+
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular(shutdown_only):
+    """Single-node in-process cluster (parity: reference conftest.py:266)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield None

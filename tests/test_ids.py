@@ -1,0 +1,56 @@
+import pickle
+
+import pytest
+
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+
+
+def test_sizes():
+    assert len(JobID.from_int(7).binary()) == 4
+    job = JobID.from_int(7)
+    actor = ActorID.of(job)
+    assert len(actor.binary()) == 16
+    task = TaskID.for_actor_task(actor)
+    assert len(task.binary()) == 24
+    obj = ObjectID.for_task_return(task, 1)
+    assert len(obj.binary()) == 28
+
+
+def test_embedded_lineage():
+    job = JobID.from_int(42)
+    task = TaskID.for_normal_task(job)
+    obj = ObjectID.for_task_return(task, 3)
+    assert obj.task_id() == task
+    assert obj.job_id() == job
+    assert obj.index() == 3
+    assert not obj.is_put()
+
+    put_obj = ObjectID.for_put(task, 3)
+    assert put_obj.is_put()
+    assert put_obj.index() == 3
+    assert put_obj != obj
+
+    actor = ActorID.of(job)
+    atask = TaskID.for_actor_task(actor)
+    assert atask.actor_id() == actor
+    assert atask.job_id() == job
+
+
+def test_nil_and_equality():
+    assert NodeID.nil().is_nil()
+    assert not NodeID.from_random().is_nil()
+    a = NodeID.from_random()
+    b = NodeID(a.binary())
+    assert a == b and hash(a) == hash(b)
+    assert a != NodeID.from_random()
+
+
+def test_hex_roundtrip_and_pickle():
+    n = NodeID.from_random()
+    assert NodeID.from_hex(n.hex()) == n
+    assert pickle.loads(pickle.dumps(n)) == n
+
+
+def test_wrong_size_rejected():
+    with pytest.raises(ValueError):
+        NodeID(b"short")

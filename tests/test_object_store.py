@@ -1,0 +1,101 @@
+import numpy as np
+import pytest
+
+from ray_tpu.core.exceptions import ObjectStoreFullError
+from ray_tpu.core.ids import JobID, ObjectID, TaskID
+from ray_tpu.core.object_store import MemoryStore, SharedMemoryStore, StoreClient
+from ray_tpu.core.serialization import deserialize, serialize
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = SharedMemoryStore(str(tmp_path / "arena"), 32 * 1024 * 1024)
+    yield s
+    s.close()
+
+
+def oid(i=1):
+    return ObjectID.for_put(TaskID.for_normal_task(JobID.from_int(1)), i)
+
+
+def test_put_get_roundtrip(store):
+    o = oid()
+    arr = np.arange(1000, dtype=np.float64)
+    store.put_serialized(o, serialize(arr))
+    view = store.get_pinned(o)
+    out, is_exc = deserialize(view)
+    assert not is_exc
+    assert np.array_equal(out, arr)
+    assert not out.flags["OWNDATA"]  # zero-copy from shm
+    store.release(o)
+
+
+def test_client_shares_mapping(store, tmp_path):
+    o = oid()
+    store.put_raw(o, b"hello world")
+    lease = store.lease(o)
+    assert lease is not None
+    client = StoreClient(store.path, store.capacity)
+    offset, size = lease
+    assert bytes(client.view(offset, size)) == b"hello world"
+    client.close()
+    store.release(o)
+
+
+def test_pinned_objects_survive_eviction(store):
+    o = oid(1)
+    store.put_raw(o, b"x" * 1024)
+    assert store.lease(o) is not None  # pin
+    # flood the store to force eviction
+    for i in range(2, 200):
+        try:
+            store.put_raw(oid(i), b"y" * (1024 * 1024))
+        except ObjectStoreFullError:
+            break
+    assert store.contains(o)  # pinned object never evicted
+    store.release(o)
+
+
+def test_unpinned_lru_eviction(store):
+    first = oid(1)
+    store.put_raw(first, b"x" * (1024 * 1024))
+    for i in range(2, 64):
+        store.put_raw(oid(i), b"y" * (1024 * 1024))
+    assert not store.contains(first)  # oldest went first
+
+
+def test_delete_and_stats(store):
+    o = oid()
+    store.put_raw(o, b"abc")
+    before = store.stats()
+    assert before["num_objects"] == 1
+    assert store.delete(o)
+    after = store.stats()
+    assert after["num_objects"] == 0
+    assert after["used"] == 0
+
+
+def test_duplicate_create_rejected(store):
+    o = oid()
+    store.put_raw(o, b"abc")
+    with pytest.raises(ValueError):
+        store.create(o, 10)
+
+
+def test_lru_candidates_for_spilling(store):
+    ids = [oid(i) for i in range(1, 6)]
+    for o in ids:
+        store.put_raw(o, b"z" * 100)
+    cands = store.lru_candidates(max_ids=3)
+    assert cands == ids[:3]  # oldest first
+
+
+def test_memory_store_wait():
+    import threading
+
+    ms = MemoryStore()
+    o = oid()
+    assert ms.wait([o], 1, timeout=0.05) == []
+    threading.Timer(0.05, lambda: ms.put(o, b"v")).start()
+    assert ms.wait([o], 1, timeout=2.0) == [o]
+    assert ms.get(o) == b"v"

@@ -1,0 +1,76 @@
+import numpy as np
+import pytest
+
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.serialization import (
+    deserialize,
+    serialize,
+    serialize_exception,
+)
+
+
+def roundtrip(value):
+    data = serialize(value).to_bytes()
+    out, is_exc = deserialize(data)
+    assert not is_exc
+    return out
+
+
+def test_simple_values():
+    assert roundtrip(123) == 123
+    assert roundtrip("hello") == "hello"
+    assert roundtrip({"a": [1, 2, (3, 4)]}) == {"a": [1, 2, (3, 4)]}
+    assert roundtrip(None) is None
+
+
+def test_numpy_zero_copy():
+    arr = np.arange(10_000, dtype=np.int64)
+    data = serialize({"arr": arr}).to_bytes()
+    out, _ = deserialize(data)
+    assert np.array_equal(out["arr"], arr)
+    # reconstructed array aliases the wire buffer, not a copy
+    assert not out["arr"].flags["OWNDATA"]
+
+
+def test_numpy_alignment():
+    # Buffers are 64-byte aligned relative to the mapping base; shm
+    # mappings are page-aligned, so absolute alignment holds there.
+    import mmap
+
+    arr = np.ones((17,), dtype=np.float64)
+    ser = serialize(arr)
+    mm = mmap.mmap(-1, ser.total_size())
+    ser.write_to(memoryview(mm))
+    out, _ = deserialize(memoryview(mm))
+    addr = out.__array_interface__["data"][0]
+    assert addr % 64 == 0
+
+
+def test_closures_and_lambdas():
+    x = 10
+    fn = roundtrip(lambda y: x + y)
+    assert fn(5) == 15
+
+
+def test_exception_roundtrip():
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        data = serialize_exception(e).to_bytes()
+    out, is_exc = deserialize(data)
+    assert is_exc
+    assert isinstance(out, TaskError)
+    assert "boom" in str(out)
+    assert "ValueError" in out.remote_traceback
+
+
+def test_multiple_buffers():
+    arrs = [np.full((1000,), i, dtype=np.float32) for i in range(5)]
+    out = roundtrip(arrs)
+    for i, a in enumerate(out):
+        assert np.array_equal(a, arrs[i])
+
+
+def test_corrupt_magic_rejected():
+    with pytest.raises(ValueError):
+        deserialize(b"XXXXXXXX" + b"\x00" * 100)
