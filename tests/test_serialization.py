@@ -37,7 +37,7 @@ def test_numpy_alignment():
     # mappings are page-aligned, so absolute alignment holds there.
     import mmap
 
-    arr = np.ones((17,), dtype=np.float64)
+    arr = np.ones((1000,), dtype=np.float64)
     ser = serialize(arr)
     mm = mmap.mmap(-1, ser.total_size())
     ser.write_to(memoryview(mm))
