@@ -1,0 +1,241 @@
+"""ray_tpu: a TPU-native distributed runtime and ML library stack.
+
+Public core API (parity: reference ``python/ray/__init__.py`` /
+``_private/worker.py``): ``init``, ``shutdown``, ``remote``, ``get``,
+``put``, ``wait``, ``kill``, ``cancel``, ``get_actor``, plus cluster
+introspection helpers.  The ML stack lives in the submodules
+``ray_tpu.parallel`` / ``ops`` / ``models`` / ``train`` / ``data`` /
+``tune`` / ``serve`` / ``rllib``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu.core.config import Config, get_config, set_config
+from ray_tpu.core.exceptions import (  # noqa: F401 — public API
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    ObjectStoreFullError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID  # noqa: F401
+from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core import worker as _worker_mod
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+
+__version__ = "0.1.0"
+
+logger = logging.getLogger(__name__)
+
+_init_lock = threading.Lock()
+_head_proc: Optional[subprocess.Popen] = None
+_owns_head = False
+
+
+def is_initialized() -> bool:
+    return _worker_mod.global_worker_or_none() is not None
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         _system_config: Optional[Dict[str, Any]] = None,
+         ignore_reinit_error: bool = False) -> Dict[str, Any]:
+    """Start (or connect to) a cluster and attach this process as driver.
+
+    With no ``address``, spawns a head node (GCS + raylet) subprocess and
+    connects to it — reference ``ray.init()`` semantics.  With
+    ``address="host:port"`` (a GCS address), connects to an existing
+    cluster by asking the GCS for a raylet on this host (or the head's).
+    """
+    global _head_proc, _owns_head
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return connection_info()
+            raise RayTpuError("ray_tpu.init() called twice")
+
+        config = Config().apply_env_overrides().apply_overrides(_system_config)
+        if object_store_memory:
+            config.object_store_memory = int(object_store_memory)
+        set_config(config)
+
+        from ray_tpu.core import node as node_mod
+        from ray_tpu.core.ids import NodeID as _NodeID
+        from ray_tpu.core.worker import CoreWorker
+
+        if address is None:
+            session_dir = node_mod.new_session_dir(config)
+            res: Dict[str, float] = dict(resources or {})
+            if num_cpus is not None:
+                res["CPU"] = float(num_cpus)
+            if num_tpus is not None:
+                res["TPU"] = float(num_tpus)
+            _head_proc, handshake = node_mod.spawn_head(
+                config, session_dir, res or None)
+            _owns_head = True
+        else:
+            host, port = address.rsplit(":", 1)
+            handshake = _discover_via_gcs((host, int(port)))
+            _owns_head = False
+
+        CoreWorker(
+            mode="driver",
+            gcs_address=tuple(handshake["gcs_address"]),
+            raylet_address=tuple(handshake["raylet_address"]),
+            node_id=_NodeID.from_hex(handshake["node_id"]),
+            store_path=handshake["store_path"],
+            store_capacity=handshake["store_capacity"],
+            session_dir=handshake["session_dir"],
+            config=config,
+        )
+        atexit.register(shutdown)
+        return connection_info()
+
+
+def _discover_via_gcs(gcs_address: Tuple[str, int]) -> Dict[str, Any]:
+    """Connect to a running cluster: pick a raylet from the GCS node table."""
+    import asyncio
+
+    from ray_tpu.core import rpc
+
+    async def _probe():
+        conn = await rpc.connect(gcs_address)
+        try:
+            nodes = await conn.call("get_nodes", {})
+        finally:
+            conn.close()
+        alive = [n for n in nodes if n["alive"]]
+        if not alive:
+            raise RayTpuError(f"no alive nodes at GCS {gcs_address}")
+        return alive[0]
+
+    node = asyncio.run(_probe())
+    raylet_addr = tuple(node["address"])
+
+    async def _store_info():
+        conn = await rpc.connect(raylet_addr)
+        try:
+            # the raylet tells drivers where its store lives
+            return await conn.call("store_info", {})
+        finally:
+            conn.close()
+
+    info = asyncio.run(_store_info())
+    return {
+        "gcs_address": list(gcs_address),
+        "raylet_address": list(raylet_addr),
+        "node_id": NodeID(node["node_id"]).hex(),
+        "store_path": info["store_path"],
+        "store_capacity": info["store_capacity"],
+        "session_dir": info["session_dir"],
+    }
+
+
+def connection_info() -> Dict[str, Any]:
+    core = _worker_mod.global_worker()
+    return {
+        "gcs_address": core.gcs_address,
+        "raylet_address": core.raylet_address,
+        "node_id": core.node_id.hex(),
+        "job_id": core.job_id.hex() if core.job_id else None,
+        "session_dir": core.session_dir,
+    }
+
+
+def shutdown() -> None:
+    global _head_proc, _owns_head
+    with _init_lock:
+        core = _worker_mod.global_worker_or_none()
+        if core is not None:
+            core.shutdown()
+        if _head_proc is not None and _owns_head:
+            _head_proc.terminate()
+            try:
+                _head_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                _head_proc.kill()
+            _head_proc = None
+
+
+def remote(*args, **options):
+    """``@remote`` decorator for functions and classes (parity:
+    ``ray.remote``)."""
+    def decorate(fn_or_class):
+        if isinstance(fn_or_class, type):
+            return ActorClass(fn_or_class, **options)
+        return RemoteFunction(fn_or_class, **options)
+
+    if len(args) == 1 and not options and callable(args[0]):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return decorate
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    core = _worker_mod.global_worker()
+    single = isinstance(refs, ObjectRef)
+    out = core.get([refs] if single else list(refs), timeout=timeout)
+    return out[0] if single else out
+
+
+def put(value: Any) -> ObjectRef:
+    return _worker_mod.global_worker().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None
+         ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    return _worker_mod.global_worker().wait(
+        refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _worker_mod.global_worker().kill_actor(actor.actor_id,
+                                           no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    # Cooperative cancellation: drop owner-side interest. In-flight
+    # execution is not interrupted (documented limitation this round).
+    core = _worker_mod.global_worker()
+    core.task_manager.fail(ref.task_id())
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    _worker_mod.global_worker().free(list(refs))
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return _worker_mod.global_worker().get_nodes()
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _worker_mod.global_worker().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return _worker_mod.global_worker().available_resources()
+
+
+def method(**options):
+    """Decorator for actor methods (``num_returns`` option)."""
+    def decorate(m):
+        m.__rtpu_method_options__ = options
+        return m
+    return decorate

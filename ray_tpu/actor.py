@@ -1,0 +1,166 @@
+"""Actor API: @remote classes, handles, and method invocation.
+
+Parity: reference ``python/ray/actor.py`` — ``ActorClass`` (decorated user
+class), ``ActorClass.remote(...)`` / ``.options(...)``, ``ActorHandle``
+with dynamic ``.method.remote(...)`` dispatch, named/detached actors,
+``max_restarts`` / ``max_task_retries`` fault-tolerance knobs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Union
+
+import cloudpickle
+
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import ActorCreationSpec
+from ray_tpu.core import worker as worker_mod
+from ray_tpu.remote_function import _resolve_strategy
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name,
+                           num_returns=int(opts.get("num_returns",
+                                                    self._num_returns)))
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        core = worker_mod.global_worker()
+        refs = core.submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called directly; "
+            f"use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = "",
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self) -> str:
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle,
+                (self._actor_id, self._class_name, self._max_task_retries))
+
+    def __ray_ready__(self) -> ObjectRef:
+        """Ref resolving once the actor can serve calls."""
+        return ActorMethod(self, "__rtpu_ping__").remote()
+
+
+class ActorClass:
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = options
+        self._descriptor = f"{cls.__module__}.{cls.__qualname__}"
+        self._class_id: Optional[str] = None
+        self._pickled: Optional[bytes] = None
+        self._export_lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._descriptor} cannot be instantiated "
+            f"directly; use .remote()")
+
+    def options(self, **options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(options)
+        clone = ActorClass(self._cls, **merged)
+        clone._class_id = self._class_id
+        clone._pickled = self._pickled
+        return clone
+
+    def _export(self, core) -> str:
+        with self._export_lock:
+            if self._class_id is None:
+                if self._pickled is None:
+                    self._pickled = cloudpickle.dumps(_wrap_actor_class(self._cls))
+                self._class_id = core.register_function(self._pickled)
+        return self._class_id
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = worker_mod.global_worker()
+        class_id = self._export(core)
+        opts = self._options
+        resources = dict(opts.get("resources", {}))
+        resources.setdefault("CPU", float(opts.get("num_cpus", 1)))
+        if opts.get("num_tpus"):
+            resources["TPU"] = float(opts["num_tpus"])
+        if opts.get("num_gpus"):
+            resources["TPU"] = float(opts["num_gpus"])
+        creation = ActorCreationSpec(
+            max_restarts=int(opts.get("max_restarts", 0)),
+            max_task_retries=int(opts.get("max_task_retries", 0)),
+            name=opts.get("name"),
+            namespace=opts.get("namespace", "default"),
+            lifetime_detached=opts.get("lifetime") == "detached",
+            max_concurrency=int(opts.get("max_concurrency", 1)),
+        )
+        actor_id = core.create_actor(
+            class_id,
+            self._descriptor,
+            args,
+            kwargs,
+            resources=resources,
+            creation_spec=creation,
+            scheduling_strategy=_resolve_strategy(
+                opts.get("scheduling_strategy")),
+            get_if_exists=bool(opts.get("get_if_exists", False)),
+        )
+        return ActorHandle(actor_id, self._descriptor,
+                           max_task_retries=creation.max_task_retries)
+
+
+def _wrap_actor_class(cls):
+    """Add framework-internal methods to the user's class."""
+    if hasattr(cls, "__rtpu_ping__"):
+        return cls
+
+    class Wrapped(cls):  # type: ignore[misc,valid-type]
+        def __rtpu_ping__(self):
+            return True
+
+    Wrapped.__name__ = cls.__name__
+    Wrapped.__qualname__ = cls.__qualname__
+    Wrapped.__module__ = cls.__module__
+    return Wrapped
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    """Look up a named actor (parity: ``ray.get_actor``)."""
+    core = worker_mod.global_worker()
+    info = core.get_actor_info(name=name, namespace=namespace)
+    if info is None:
+        raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
+    return ActorHandle(ActorID(info["actor_id"]),
+                       info.get("class_name", ""))

@@ -1,0 +1,665 @@
+"""Global Control Service: the cluster metadata authority.
+
+Parity: reference ``src/ray/gcs/gcs_server/`` — node membership
+(GcsNodeManager), actor directory + lifecycle (GcsActorManager /
+GcsActorScheduler), placement groups (GcsPlacementGroupManager, two-phase
+prepare/commit), job table, internal KV, function table, health checking
+(GcsHealthCheckManager), and the pubsub hub.  Storage is in-memory (the
+reference's default store client); the storage interface is a plain dict
+per table so a persistent backend can be slotted in later.
+
+TPU twist (SURVEY.md §7.2): node registration carries topology metadata —
+slice name, chip coordinates, ICI neighbor hints — alongside resources, so
+gang scheduling can place co-located bundles on one slice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    raylet_address: rpc.Address
+    resources_total: Dict[str, float]
+    resources_available: Dict[str, float]
+    # TPU topology metadata: {"slice": str, "coords": [x,y,z], "worker_index": int}
+    topology: Dict[str, Any] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    # load: number of queued+running lease requests, for hybrid scheduling
+    load: int = 0
+
+
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    state: str = ACTOR_PENDING
+    name: Optional[str] = None
+    namespace: str = "default"
+    detached: bool = False
+    address: Optional[rpc.Address] = None  # the actor worker's task server
+    node_id: Optional[NodeID] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    creation_spec_blob: Optional[bytes] = None  # pickled TaskSpec, for restarts
+    resources: Dict[str, float] = field(default_factory=dict)
+    owner_job: Optional[JobID] = None
+    death_cause: str = ""
+    class_name: str = ""
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED | INFEASIBLE
+    # bundle index -> node id
+    bundle_nodes: Dict[int, NodeID] = field(default_factory=dict)
+    name: Optional[str] = None
+
+
+class GcsServer:
+    """All GCS tables + managers in one asyncio service."""
+
+    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0):
+        self.config = config
+        self.server = rpc.Server(self, host=host, port=port)
+        self.pool = rpc.ConnectionPool()
+        # tables
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (ns, name)
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
+        self.functions: Dict[str, bytes] = {}  # function_id -> pickled blob
+        self.job_counter = 0
+        self.jobs: Dict[JobID, Dict[str, Any]] = {}
+        # pubsub: channel -> set of connections
+        self.subscribers: Dict[str, set] = {}
+        # node connections (raylet registration conns) for death detection
+        self._node_conns: Dict[NodeID, rpc.Connection] = {}
+        self._health_task: Optional[asyncio.Task] = None
+        self._pg_retry_task: Optional[asyncio.Task] = None
+        self._actor_creation_locks: Dict[ActorID, asyncio.Lock] = {}
+        self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
+
+    async def start(self) -> rpc.Address:
+        address = await self.server.start()
+        self._health_task = asyncio.get_running_loop().create_task(
+            self._health_check_loop()
+        )
+        logger.info("GCS listening on %s", address)
+        return address
+
+    async def stop(self) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.stop()
+        self.pool.close_all()
+
+    # ------------------------------------------------------------------
+    # pubsub hub
+    # ------------------------------------------------------------------
+    def publish(self, channel: str, message: Any) -> None:
+        for conn in list(self.subscribers.get(channel, ())):
+            if conn.closed:
+                self.subscribers[channel].discard(conn)
+            else:
+                conn.push(channel, message)
+
+    async def handle_subscribe(self, conn, data):
+        channel = data["channel"]
+        self.subscribers.setdefault(channel, set()).add(conn)
+        return True
+
+    async def handle_unsubscribe(self, conn, data):
+        self.subscribers.get(data["channel"], set()).discard(conn)
+        return True
+
+    async def handle_publish(self, conn, data):
+        self.publish(data["channel"], data["message"])
+        return True
+
+    def on_disconnection(self, conn) -> None:
+        for subs in self.subscribers.values():
+            subs.discard(conn)
+        node_id = conn.context.get("node_id")
+        if node_id is not None and node_id in self.nodes:
+            self._mark_node_dead(node_id, "raylet connection lost")
+        actor_id = conn.context.get("actor_id")
+        if actor_id is not None:
+            self._on_actor_worker_lost(actor_id, "actor worker connection lost")
+
+    # ------------------------------------------------------------------
+    # node membership + health (GcsNodeManager / GcsHealthCheckManager)
+    # ------------------------------------------------------------------
+    async def handle_register_node(self, conn, data):
+        node_id = NodeID(data["node_id"])
+        info = NodeInfo(
+            node_id=node_id,
+            raylet_address=tuple(data["raylet_address"]),
+            resources_total=dict(data["resources"]),
+            resources_available=dict(data["resources"]),
+            topology=data.get("topology", {}),
+        )
+        self.nodes[node_id] = info
+        self._node_conns[node_id] = conn
+        conn.context["node_id"] = node_id
+        self.publish("nodes", {"event": "alive", "node_id": node_id.binary(),
+                               "address": info.raylet_address})
+        logger.info("node %s registered: %s", node_id.hex()[:12], info.resources_total)
+        return {"config": self.config.to_json()}
+
+    async def handle_health_report(self, conn, data):
+        node_id = NodeID(data["node_id"])
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return {"acked": False}  # tells a zombie raylet to exit
+        info.last_heartbeat = time.monotonic()
+        info.resources_available = dict(data["resources_available"])
+        info.load = data.get("load", 0)
+        return {"acked": True}
+
+    async def handle_get_nodes(self, conn, data):
+        return [
+            {
+                "node_id": n.node_id.binary(),
+                "address": n.raylet_address,
+                "alive": n.alive,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "topology": n.topology,
+                "load": n.load,
+            }
+            for n in self.nodes.values()
+        ]
+
+    async def handle_drain_node(self, conn, data):
+        node_id = NodeID(data["node_id"])
+        self._mark_node_dead(node_id, data.get("reason", "drained"))
+        return True
+
+    def _mark_node_dead(self, node_id: NodeID, reason: str) -> None:
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        info.resources_available = {}
+        self._node_conns.pop(node_id, None)
+        logger.warning("node %s dead: %s", node_id.hex()[:12], reason)
+        self.publish("nodes", {"event": "dead", "node_id": node_id.binary(),
+                               "address": info.raylet_address})
+        # fail actors on the node (restart if budget remains)
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ACTOR_ALIVE,
+                                                            ACTOR_PENDING):
+                self._on_actor_worker_lost(actor.actor_id,
+                                           f"node died: {reason}")
+        # placement groups with bundles there must be rescheduled
+        for pg in self.placement_groups.values():
+            if pg.state == "CREATED" and node_id in pg.bundle_nodes.values():
+                pg.state = "RESCHEDULING"
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+
+    async def _health_check_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.health_report_period_s)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and (now - node.last_heartbeat
+                                   > self.config.health_timeout_s):
+                    self._mark_node_dead(node.node_id, "health check timeout")
+
+    # ------------------------------------------------------------------
+    # KV store (GcsInternalKVManager)
+    # ------------------------------------------------------------------
+    async def handle_kv_put(self, conn, data):
+        ns = self.kv.setdefault(data.get("namespace", ""), {})
+        existed = data["key"] in ns
+        if data.get("overwrite", True) or not existed:
+            ns[data["key"]] = data["value"]
+        return existed
+
+    async def handle_kv_get(self, conn, data):
+        return self.kv.get(data.get("namespace", ""), {}).get(data["key"])
+
+    async def handle_kv_del(self, conn, data):
+        ns = self.kv.get(data.get("namespace", ""), {})
+        return ns.pop(data["key"], None) is not None
+
+    async def handle_kv_keys(self, conn, data):
+        ns = self.kv.get(data.get("namespace", ""), {})
+        prefix = data.get("prefix", "")
+        return [k for k in ns if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # function table (GcsFunctionManager)
+    # ------------------------------------------------------------------
+    async def handle_register_function(self, conn, data):
+        self.functions[data["function_id"]] = data["blob"]
+        return True
+
+    async def handle_get_function(self, conn, data):
+        return self.functions.get(data["function_id"])
+
+    # ------------------------------------------------------------------
+    # jobs (GcsJobManager)
+    # ------------------------------------------------------------------
+    async def handle_register_job(self, conn, data):
+        self.job_counter += 1
+        job_id = JobID.from_int(self.job_counter)
+        self.jobs[job_id] = {"start_time": time.time(),
+                             "driver_address": data.get("driver_address"),
+                             "alive": True}
+        return {"job_id": job_id.binary()}
+
+    async def handle_job_finished(self, conn, data):
+        job = self.jobs.get(JobID(data["job_id"]))
+        if job:
+            job["alive"] = False
+            job["end_time"] = time.time()
+        return True
+
+    # ------------------------------------------------------------------
+    # task events (state API feed; parity: TaskEventBuffer -> GCS)
+    # ------------------------------------------------------------------
+    async def handle_report_task_events(self, conn, data):
+        self._task_events.extend(data["events"])
+        overflow = len(self._task_events) - self.config.task_events_buffer_size
+        if overflow > 0:
+            del self._task_events[:overflow]
+        return True
+
+    async def handle_get_task_events(self, conn, data):
+        limit = data.get("limit", 1000)
+        return self._task_events[-limit:]
+
+    # ------------------------------------------------------------------
+    # actor manager (GcsActorManager + GcsActorScheduler)
+    # ------------------------------------------------------------------
+    async def handle_register_actor(self, conn, data):
+        """Register + schedule an actor creation.
+
+        ``data``: actor_id, creation spec blob (pickled TaskSpec),
+        resources, name/namespace/detached, max_restarts, class_name.
+        """
+        actor_id = ActorID(data["actor_id"])
+        name = data.get("name")
+        namespace = data.get("namespace", "default")
+        if name is not None:
+            key = (namespace, name)
+            existing_id = self.named_actors.get(key)
+            if existing_id is not None:
+                existing = self.actors.get(existing_id)
+                if existing is not None and existing.state != ACTOR_DEAD:
+                    if data.get("get_if_exists"):
+                        return {"existing": True,
+                                "actor_id": existing_id.binary()}
+                    raise ValueError(
+                        f"actor name {name!r} already taken in {namespace!r}")
+            self.named_actors[key] = actor_id
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=name,
+            namespace=namespace,
+            detached=data.get("detached", False),
+            max_restarts=data.get("max_restarts", 0),
+            creation_spec_blob=data["spec_blob"],
+            resources=dict(data.get("resources", {})),
+            owner_job=JobID(data["job_id"]),
+            class_name=data.get("class_name", ""),
+        )
+        self.actors[actor_id] = info
+        asyncio.get_running_loop().create_task(self._schedule_actor(info))
+        return {"existing": False, "actor_id": actor_id.binary()}
+
+    def _publish_actor(self, info: ActorInfo) -> None:
+        self.publish(f"actor:{info.actor_id.hex()}", self._actor_message(info))
+
+    def _actor_message(self, info: ActorInfo) -> Dict[str, Any]:
+        return {
+            "actor_id": info.actor_id.binary(),
+            "state": info.state,
+            "address": info.address,
+            "node_id": info.node_id.binary() if info.node_id else None,
+            "num_restarts": info.num_restarts,
+            "death_cause": info.death_cause,
+        }
+
+    async def _schedule_actor(self, info: ActorInfo) -> None:
+        """Pick a node, lease a worker there, push the creation task.
+
+        Parity: GcsActorScheduler::Schedule (gcs_actor_scheduler.cc:49).
+        """
+        lock = self._actor_creation_locks.setdefault(info.actor_id,
+                                                     asyncio.Lock())
+        async with lock:
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if info.state == ACTOR_DEAD:
+                    return
+                node = self._pick_node(info.resources,
+                                       getattr(info, "_pg_node", None))
+                if node is None:
+                    await asyncio.sleep(0.2)  # wait for resources/nodes
+                    continue
+                try:
+                    conn = await self.pool.get(node.raylet_address)
+                    reply = await conn.call(
+                        "lease_worker_for_actor",
+                        {"actor_id": info.actor_id.binary(),
+                         "resources": info.resources,
+                         "spec_blob": info.creation_spec_blob},
+                        timeout=60.0,
+                    )
+                except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError) as e:
+                    logger.warning("actor lease on %s failed: %s",
+                                   node.node_id.hex()[:12], e)
+                    await asyncio.sleep(0.2)
+                    continue
+                if not reply.get("granted"):
+                    await asyncio.sleep(0.1)
+                    continue
+                info.node_id = node.node_id
+                info.address = tuple(reply["worker_task_address"])
+                info.state = ACTOR_ALIVE
+                self._publish_actor(info)
+                return
+            info.state = ACTOR_DEAD
+            info.death_cause = "creation timed out: no feasible node"
+            self._publish_actor(info)
+
+    def _pick_node(self, resources: Dict[str, float],
+                   required_node: Optional[NodeID] = None) -> Optional[NodeInfo]:
+        """Least-loaded feasible node (actors spread by default)."""
+        candidates = []
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            if required_node is not None and node.node_id != required_node:
+                continue
+            if all(node.resources_available.get(k, 0.0) >= v
+                   for k, v in resources.items()):
+                candidates.append(node)
+        if not candidates:
+            return None
+        return min(candidates, key=lambda n: n.load)
+
+    async def handle_actor_started(self, conn, data):
+        """The actor worker reports in after executing its creation task."""
+        actor_id = ActorID(data["actor_id"])
+        conn.context["actor_id"] = actor_id
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        info.address = tuple(data["task_address"])
+        info.state = ACTOR_ALIVE
+        self._publish_actor(info)
+        return True
+
+    async def handle_actor_creation_failed(self, conn, data):
+        actor_id = ActorID(data["actor_id"])
+        self._on_actor_worker_lost(actor_id, data.get("reason", "creation failed"),
+                                   allow_restart=False)
+        return True
+
+    async def handle_get_actor(self, conn, data):
+        if "name" in data:
+            key = (data.get("namespace", "default"), data["name"])
+            actor_id = self.named_actors.get(key)
+            if actor_id is None:
+                return None
+        else:
+            actor_id = ActorID(data["actor_id"])
+        info = self.actors.get(actor_id)
+        if info is None:
+            return None
+        msg = self._actor_message(info)
+        msg["class_name"] = info.class_name
+        msg["name"] = info.name
+        return msg
+
+    async def handle_list_actors(self, conn, data):
+        return [dict(self._actor_message(a), name=a.name,
+                     class_name=a.class_name)
+                for a in self.actors.values()]
+
+    async def handle_kill_actor(self, conn, data):
+        actor_id = ActorID(data["actor_id"])
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        info.max_restarts = 0  # no_restart semantics
+        if info.address is not None:
+            try:
+                worker_conn = await self.pool.get(info.address)
+                worker_conn.push("kill_actor", {"actor_id": actor_id.binary()})
+            except Exception:
+                pass
+        self._on_actor_worker_lost(actor_id, "killed via kill_actor",
+                                   allow_restart=False)
+        return True
+
+    def _on_actor_worker_lost(self, actor_id: ActorID, reason: str,
+                              allow_restart: bool = True) -> None:
+        info = self.actors.get(actor_id)
+        if info is None or info.state == ACTOR_DEAD:
+            return
+        if allow_restart and info.num_restarts < info.max_restarts:
+            info.num_restarts += 1
+            info.state = ACTOR_RESTARTING
+            info.address = None
+            info.node_id = None
+            self._publish_actor(info)
+            logger.info("restarting actor %s (%d/%d): %s",
+                        actor_id.hex()[:12], info.num_restarts,
+                        info.max_restarts, reason)
+            asyncio.get_running_loop().create_task(self._schedule_actor(info))
+        else:
+            info.state = ACTOR_DEAD
+            info.death_cause = reason
+            info.address = None
+            self._publish_actor(info)
+            if info.name is not None:
+                self.named_actors.pop((info.namespace, info.name), None)
+
+    # ------------------------------------------------------------------
+    # placement groups (GcsPlacementGroupManager/Scheduler, 2-phase)
+    # ------------------------------------------------------------------
+    async def handle_create_placement_group(self, conn, data):
+        pg = PlacementGroupInfo(
+            pg_id=PlacementGroupID(data["pg_id"]),
+            bundles=[dict(b) for b in data["bundles"]],
+            strategy=data.get("strategy", "PACK"),
+            name=data.get("name"),
+        )
+        self.placement_groups[pg.pg_id] = pg
+        await self._schedule_pg(pg)
+        return {"state": pg.state}
+
+    async def handle_placement_group_ready(self, conn, data):
+        pg = self.placement_groups.get(PlacementGroupID(data["pg_id"]))
+        if pg is None:
+            return {"state": "REMOVED"}
+        return {"state": pg.state,
+                "bundle_nodes": {i: n.binary()
+                                 for i, n in pg.bundle_nodes.items()}}
+
+    async def handle_list_placement_groups(self, conn, data):
+        return [
+            {"pg_id": pg.pg_id.binary(), "state": pg.state,
+             "strategy": pg.strategy, "bundles": pg.bundles,
+             "name": pg.name,
+             "bundle_nodes": {i: n.binary()
+                              for i, n in pg.bundle_nodes.items()}}
+            for pg in self.placement_groups.values()
+        ]
+
+    async def handle_remove_placement_group(self, conn, data):
+        pg = self.placement_groups.get(PlacementGroupID(data["pg_id"]))
+        if pg is None:
+            return False
+        await self._release_pg_bundles(pg, set(pg.bundle_nodes))
+        pg.state = "REMOVED"
+        pg.bundle_nodes.clear()
+        self.publish(f"pg:{pg.pg_id.hex()}", {"state": "REMOVED"})
+        return True
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
+        """Pick nodes per strategy, then two-phase prepare/commit bundles.
+
+        Parity: GcsPlacementGroupScheduler (gcs_placement_group_scheduler.h:265).
+        """
+        placement = self._plan_bundles(pg)
+        if placement is None:
+            pg.state = "INFEASIBLE"
+            self.publish(f"pg:{pg.pg_id.hex()}", {"state": pg.state})
+            return
+        # phase 1: prepare on every involved raylet
+        prepared: List[int] = []
+        ok = True
+        for index, node in placement.items():
+            try:
+                conn = await self.pool.get(node.raylet_address)
+                granted = await conn.call(
+                    "prepare_bundle",
+                    {"pg_id": pg.pg_id.binary(), "bundle_index": index,
+                     "resources": pg.bundles[index]}, timeout=30.0)
+                if granted:
+                    prepared.append(index)
+                else:
+                    ok = False
+                    break
+            except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+                ok = False
+                break
+        if not ok:  # roll back phase-1 reservations
+            for index in prepared:
+                node = placement[index]
+                try:
+                    conn = await self.pool.get(node.raylet_address)
+                    await conn.call("return_bundle",
+                                    {"pg_id": pg.pg_id.binary(),
+                                     "bundle_index": index}, timeout=30.0)
+                except Exception:
+                    pass
+            pg.state = "PENDING"
+            self.publish(f"pg:{pg.pg_id.hex()}", {"state": pg.state})
+            return
+        # phase 2: commit
+        for index, node in placement.items():
+            conn = await self.pool.get(node.raylet_address)
+            await conn.call("commit_bundle",
+                            {"pg_id": pg.pg_id.binary(),
+                             "bundle_index": index}, timeout=30.0)
+            pg.bundle_nodes[index] = node.node_id
+        pg.state = "CREATED"
+        self.publish(f"pg:{pg.pg_id.hex()}",
+                     {"state": pg.state,
+                      "bundle_nodes": {i: n.binary()
+                                       for i, n in pg.bundle_nodes.items()}})
+
+    def _plan_bundles(self, pg: PlacementGroupInfo
+                      ) -> Optional[Dict[int, NodeInfo]]:
+        """Bundle→node assignment per strategy, slice/topology aware.
+
+        PACK prefers one node (and one TPU slice); SPREAD round-robins;
+        STRICT_* are the hard variants (parity:
+        policy/bundle_scheduling_policy.cc).  Nodes in the same TPU slice
+        sort adjacently so PACKed gangs land on one ICI domain.
+        """
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        alive.sort(key=lambda n: (n.topology.get("slice", ""),
+                                  n.topology.get("worker_index", 0)))
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def fits(node: NodeInfo, bundle: Dict[str, float]) -> bool:
+            a = avail[node.node_id]
+            return all(a.get(k, 0.0) >= v for k, v in bundle.items())
+
+        def take(node: NodeInfo, bundle: Dict[str, float]) -> None:
+            a = avail[node.node_id]
+            for k, v in bundle.items():
+                a[k] = a.get(k, 0.0) - v
+
+        placement: Dict[int, NodeInfo] = {}
+        if pg.strategy in ("PACK", "STRICT_PACK"):
+            # try to fit everything on a single node first
+            for node in alive:
+                trial = dict(avail[node.node_id])
+                all_fit = True
+                for bundle in pg.bundles:
+                    if all(trial.get(k, 0.0) >= v for k, v in bundle.items()):
+                        for k, v in bundle.items():
+                            trial[k] = trial.get(k, 0.0) - v
+                    else:
+                        all_fit = False
+                        break
+                if all_fit:
+                    for i, bundle in enumerate(pg.bundles):
+                        placement[i] = node
+                        take(node, bundle)
+                    return placement
+            if pg.strategy == "STRICT_PACK":
+                return None
+            # soft pack: greedy fill node by node
+            for i, bundle in enumerate(pg.bundles):
+                node = next((n for n in alive if fits(n, bundle)), None)
+                if node is None:
+                    return None
+                placement[i] = node
+                take(node, bundle)
+            return placement
+        else:  # SPREAD / STRICT_SPREAD
+            used_nodes: set = set()
+            for i, bundle in enumerate(pg.bundles):
+                fresh = [n for n in alive
+                         if n.node_id not in used_nodes and fits(n, bundle)]
+                if fresh:
+                    node = fresh[0]
+                elif pg.strategy == "STRICT_SPREAD":
+                    return None
+                else:
+                    node = next((n for n in alive if fits(n, bundle)), None)
+                    if node is None:
+                        return None
+                placement[i] = node
+                used_nodes.add(node.node_id)
+                take(node, bundle)
+            return placement
+
+    async def _release_pg_bundles(self, pg: PlacementGroupInfo,
+                                  indices: set) -> None:
+        for index in indices:
+            node_id = pg.bundle_nodes.get(index)
+            node = self.nodes.get(node_id) if node_id else None
+            if node is None or not node.alive:
+                continue
+            try:
+                conn = await self.pool.get(node.raylet_address)
+                await conn.call("return_bundle",
+                                {"pg_id": pg.pg_id.binary(),
+                                 "bundle_index": index}, timeout=30.0)
+            except Exception:
+                pass

@@ -1,0 +1,235 @@
+"""Node process orchestration: bring-up of head and worker nodes.
+
+Parity: reference ``python/ray/_private/node.py`` + ``services.py`` —
+spawn/monitor the per-node daemons and the cluster head.  Here a *head
+node* process hosts the GCS and a raylet in one asyncio loop; additional
+*worker node* processes host one raylet each.  ``ray_tpu.init()`` spawns a
+head subprocess and connects the driver to it; test clusters add more
+node subprocesses (see ``ray_tpu.cluster_utils``).
+
+The head writes a small JSON handshake file into the session dir once its
+services are listening so the parent can discover the ports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core.config import Config
+
+logger = logging.getLogger(__name__)
+
+
+def new_session_dir(config: Config) -> str:
+    root = config.session_root
+    os.makedirs(root, exist_ok=True)
+    session = os.path.join(
+        root, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{uuid.uuid4().hex[:8]}")
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    return session
+
+
+def detect_tpu_resources() -> Dict[str, float]:
+    """TPU chips visible on this host, as schedulable resources.
+
+    The chip count comes from env (set by TPU VMs) or an explicit
+    override; importing jax here is deliberately avoided since the raylet
+    must not grab the accelerator.
+    """
+    n = os.environ.get("RAY_TPU_CHIPS")
+    if n is not None:
+        return {"TPU": float(n)} if float(n) > 0 else {}
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v5e-8"
+    if accel and "-" in accel:
+        try:
+            return {"TPU": float(accel.rsplit("-", 1)[1])}
+        except ValueError:
+            pass
+    chips = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")  # e.g. "2,2,1"
+    if chips:
+        try:
+            dims = [int(x) for x in chips.split(",")]
+            total = 1
+            for d in dims:
+                total *= d
+            return {"TPU": float(total)}
+        except ValueError:
+            pass
+    return {}
+
+
+def detect_topology() -> Dict[str, Any]:
+    """Slice/host coordinates for gang scheduling (SURVEY.md §7.2)."""
+    topo: Dict[str, Any] = {}
+    if os.environ.get("TPU_NAME"):
+        topo["slice"] = os.environ["TPU_NAME"]
+    if os.environ.get("TPU_WORKER_ID"):
+        try:
+            topo["worker_index"] = int(os.environ["TPU_WORKER_ID"])
+        except ValueError:
+            pass
+    if os.environ.get("TPU_ACCELERATOR_TYPE"):
+        topo["accelerator_type"] = os.environ["TPU_ACCELERATOR_TYPE"]
+    return topo
+
+
+async def run_head(config: Config, session_dir: str,
+                   resources: Optional[Dict[str, float]],
+                   handshake_path: str, host: str = "127.0.0.1") -> None:
+    from ray_tpu.core.gcs import GcsServer
+    from ray_tpu.core.raylet import Raylet
+
+    gcs = GcsServer(config, host=host)
+    gcs_address = await gcs.start()
+    merged = dict(resources or {})
+    for k, v in detect_tpu_resources().items():
+        merged.setdefault(k, v)
+    raylet = Raylet(config, gcs_address, session_dir, resources=merged,
+                    topology=detect_topology(), host=host)
+    raylet_address = await raylet.start()
+    with open(handshake_path + ".tmp", "w") as f:
+        json.dump({
+            "gcs_address": list(gcs_address),
+            "raylet_address": list(raylet_address),
+            "node_id": raylet.node_id.hex(),
+            "store_path": raylet.store.path,
+            "store_capacity": raylet.store_capacity,
+            "session_dir": session_dir,
+        }, f)
+    os.replace(handshake_path + ".tmp", handshake_path)
+    stop = asyncio.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        asyncio.get_running_loop().add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await raylet.stop()
+    await gcs.stop()
+
+
+async def run_node(config: Config, gcs_address: Tuple[str, int],
+                   session_dir: str, resources: Optional[Dict[str, float]],
+                   handshake_path: str, host: str = "127.0.0.1") -> None:
+    from ray_tpu.core.raylet import Raylet
+
+    merged = dict(resources or {})
+    for k, v in detect_tpu_resources().items():
+        merged.setdefault(k, v)
+    raylet = Raylet(config, gcs_address, session_dir, resources=merged,
+                    topology=detect_topology(), host=host)
+    raylet_address = await raylet.start()
+    with open(handshake_path + ".tmp", "w") as f:
+        json.dump({
+            "gcs_address": list(gcs_address),
+            "raylet_address": list(raylet_address),
+            "node_id": raylet.node_id.hex(),
+            "store_path": raylet.store.path,
+            "store_capacity": raylet.store_capacity,
+            "session_dir": session_dir,
+        }, f)
+    os.replace(handshake_path + ".tmp", handshake_path)
+    stop = asyncio.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        asyncio.get_running_loop().add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await raylet.stop()
+
+
+def spawn_head(config: Config, session_dir: str,
+               resources: Optional[Dict[str, float]] = None,
+               ) -> Tuple[subprocess.Popen, Dict[str, Any]]:
+    """Spawn the head node subprocess; returns (proc, handshake)."""
+    handshake = os.path.join(session_dir, "head_handshake.json")
+    cmd = [sys.executable, "-m", "ray_tpu.core.node",
+           "--mode", "head",
+           "--session-dir", session_dir,
+           "--handshake", handshake,
+           "--config", config.to_json()]
+    if resources is not None:
+        cmd += ["--resources", json.dumps(resources)]
+    proc = _spawn(cmd, session_dir, "head")
+    return proc, _await_handshake(proc, handshake)
+
+
+def spawn_node(config: Config, session_dir: str,
+               gcs_address: Tuple[str, int],
+               resources: Optional[Dict[str, float]] = None,
+               ) -> Tuple[subprocess.Popen, Dict[str, Any]]:
+    handshake = os.path.join(
+        session_dir, f"node_handshake_{uuid.uuid4().hex[:8]}.json")
+    cmd = [sys.executable, "-m", "ray_tpu.core.node",
+           "--mode", "node",
+           "--gcs", f"{gcs_address[0]}:{gcs_address[1]}",
+           "--session-dir", session_dir,
+           "--handshake", handshake,
+           "--config", config.to_json()]
+    if resources is not None:
+        cmd += ["--resources", json.dumps(resources)]
+    proc = _spawn(cmd, session_dir, "node")
+    return proc, _await_handshake(proc, handshake)
+
+
+def _spawn(cmd, session_dir: str, tag: str) -> subprocess.Popen:
+    log_base = os.path.join(session_dir, "logs",
+                            f"{tag}-{uuid.uuid4().hex[:8]}")
+    out = open(log_base + ".out", "ab")
+    err = open(log_base + ".err", "ab")
+    env = dict(os.environ)
+    # node daemons never need an accelerator
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(cmd, stdout=out, stderr=err, env=env,
+                            cwd=os.getcwd())
+
+
+def _await_handshake(proc: subprocess.Popen, path: str,
+                     timeout: float = 30.0) -> Dict[str, Any]:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"node process exited with code {proc.returncode} before "
+                f"handshake; see logs in the session dir")
+        time.sleep(0.02)
+    proc.terminate()
+    raise TimeoutError("timed out waiting for node handshake")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", choices=["head", "node"], required=True)
+    parser.add_argument("--gcs", default=None)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--handshake", required=True)
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--resources", default=None)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    config = Config.from_json(args.config)
+    resources = json.loads(args.resources) if args.resources else None
+    if args.mode == "head":
+        asyncio.run(run_head(config, args.session_dir, resources,
+                             args.handshake))
+    else:
+        host, port = args.gcs.rsplit(":", 1)
+        asyncio.run(run_node(config, (host, int(port)), args.session_dir,
+                             resources, args.handshake))
+
+
+if __name__ == "__main__":
+    main()

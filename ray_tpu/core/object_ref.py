@@ -81,14 +81,14 @@ class ObjectRef:
     def __del__(self):
         if not self._registered:
             return
-        from ray_tpu.core import worker as worker_mod
+        try:
+            from ray_tpu.core import worker as worker_mod
 
-        core = worker_mod.global_worker_or_none()
-        if core is not None:
-            try:
+            core = worker_mod.global_worker_or_none()
+            if core is not None:
                 core.reference_counter.remove_local_ref(self._id)
-            except Exception:
-                pass  # interpreter shutdown
+        except Exception:
+            pass  # interpreter shutdown
 
     def __reduce__(self):
         # Direct pickling travels through serialization.persistent_id in

@@ -39,7 +39,8 @@ class SharedMemoryStore:
         self._view = memoryview(self._mm)
 
     # -- producer side ----------------------------------------------------
-    def create(self, object_id: ObjectID, size: int) -> memoryview:
+    def alloc(self, object_id: ObjectID, size: int) -> Tuple[int, memoryview]:
+        """Allocate space for the object; returns (offset, writable view)."""
         rc = self._lib.rtpu_store_put(self._handle, object_id.binary(), size)
         if rc == -2:
             raise ValueError(f"object {object_id.hex()} already exists")
@@ -47,7 +48,10 @@ class SharedMemoryStore:
             raise ObjectStoreFullError(
                 f"cannot allocate {size} bytes (capacity {self.capacity})"
             )
-        return self._view[rc : rc + size]
+        return rc, self._view[rc : rc + size]
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        return self.alloc(object_id, size)[1]
 
     def seal(self, object_id: ObjectID) -> None:
         self._lib.rtpu_store_seal(self._handle, object_id.binary())

@@ -1,0 +1,773 @@
+"""Raylet: per-node scheduler, worker pool, and object plane.
+
+Parity: reference ``src/ray/raylet/`` (NodeManager, ClusterTaskManager /
+LocalTaskManager, WorkerPool) and ``src/ray/object_manager/`` (ObjectManager
+push/pull transfer, LocalObjectManager spill/restore), with the plasma store
+role played by the C++ library behind
+:class:`ray_tpu.core.object_store.SharedMemoryStore`.
+
+Scheduling model is the reference's lease protocol: submitters ask the
+raylet for a worker lease; the raylet grants a local worker (spawning one
+if the pool is empty), replies with a *spillback* hint when another node
+should run the task, or queues the request.  Granted leases hold their
+resources until returned.  The hybrid policy packs onto the local node
+until utilization crosses ``scheduler_spread_threshold``, then prefers the
+least-loaded feasible remote node (reference
+``hybrid_scheduling_policy.h:48``).
+
+Object plane: workers create/seal objects in the node's shared-memory
+arena through this service and read them zero-copy via their own mapping.
+Missing objects are located through the *owner* (ownership-based object
+directory, reference ``ownership_based_object_directory.h``) and pulled in
+chunks from the remote raylet.  Primary copies are pinned until the owner
+frees them; under memory pressure they are spilled to disk and restored on
+demand (reference ``local_object_manager.h``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
+from ray_tpu.core.object_store import SharedMemoryStore
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    pid: int
+    job_id_bin: Optional[bytes]
+    conn: rpc.Connection
+    task_address: rpc.Address  # the worker's own task server
+    proc: Optional[subprocess.Popen] = None
+    # lease state
+    leased: bool = False
+    lease_resources: Dict[str, float] = field(default_factory=dict)
+    lease_bundle: Optional[Tuple[bytes, int]] = None  # (pg_id, bundle_index)
+    is_actor: bool = False
+
+
+@dataclass
+class PendingLease:
+    request: Dict[str, Any]
+    future: asyncio.Future
+    job_id_bin: Optional[bytes]
+    resources: Dict[str, float]
+    bundle: Optional[Tuple[bytes, int]]
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+class Raylet:
+    def __init__(self, config: Config, gcs_address: rpc.Address,
+                 session_dir: str, resources: Optional[Dict[str, float]] = None,
+                 node_id: Optional[NodeID] = None,
+                 topology: Optional[Dict[str, Any]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.config = config
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.node_id = node_id or NodeID.from_random()
+        self.topology = topology or {}
+        self.server = rpc.Server(self, host=host, port=port)
+        self.pool = rpc.ConnectionPool()  # raylet->raylet, raylet->owner
+        self.gcs_conn: Optional[rpc.Connection] = None
+
+        if resources is None:
+            resources = {"CPU": float(os.cpu_count() or 1)}
+        resources.setdefault("CPU", float(os.cpu_count() or 1))
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+
+        # object store
+        store_capacity = config.object_store_memory
+        if store_capacity <= 0:
+            store_capacity = min(
+                int(os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+                    * 0.3),
+                16 * 1024 ** 3,
+            )
+        store_path = os.path.join(
+            "/dev/shm" if os.path.isdir("/dev/shm") else session_dir,
+            f"rtpu_store_{self.node_id.hex()[:12]}",
+        )
+        self.store = SharedMemoryStore(store_path, store_capacity)
+        self.store_capacity = store_capacity
+        self._primary: Set[ObjectID] = set()  # pinned primaries
+        self._owner_of: Dict[ObjectID, tuple] = {}  # id -> owner address tuple
+        self._spilled: Dict[ObjectID, str] = {}  # id -> file path
+        self._spill_dir = config.object_spilling_directory or os.path.join(
+            session_dir, "spill")
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self._pull_locks: Dict[ObjectID, asyncio.Lock] = {}
+
+        # worker pool
+        self._spawned_procs: List[Tuple[subprocess.Popen, float]] = []
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self._idle: List[WorkerHandle] = []
+        self._starting = 0
+        self._pending_leases: List[PendingLease] = []
+        self._register_waiters: List[asyncio.Future] = []
+        max_workers = config.max_workers_per_node
+        self._max_workers = max_workers if max_workers > 0 else int(
+            4 * self.resources_total.get("CPU", 1))
+
+        # placement-group bundles: (pg_id, idx) -> remaining resources
+        self._bundles: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+        self._bundle_totals: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+
+        # cluster view for spillback (refreshed from GCS health replies)
+        self._cluster_view: List[Dict[str, Any]] = []
+        self._tasks: List[asyncio.Task] = []
+        self._closing = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    async def start(self) -> rpc.Address:
+        address = await self.server.start()
+        self.gcs_conn = await rpc.connect(self.gcs_address)
+        reply = await self.gcs_conn.call("register_node", {
+            "node_id": self.node_id.binary(),
+            "raylet_address": address,
+            "resources": self.resources_total,
+            "topology": self.topology,
+        })
+        # adopt the cluster-wide config decided by the head node
+        self.config = Config.from_json(reply["config"])
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._health_loop()))
+        self._tasks.append(loop.create_task(self._reap_loop()))
+        for _ in range(self.config.num_prestart_workers):
+            self._start_worker(None)
+        logger.info("raylet %s on %s resources=%s",
+                    self.node_id.hex()[:12], address, self.resources_total)
+        return address
+
+    async def stop(self) -> None:
+        self._closing = True
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self.workers.values()):
+            if w.proc is not None:
+                w.proc.terminate()
+        await self.server.stop()
+        if self.gcs_conn:
+            self.gcs_conn.close()
+        self.pool.close_all()
+        self.store.close()
+
+    async def _health_loop(self) -> None:
+        while not self._closing:
+            try:
+                reply = await self.gcs_conn.call("health_report", {
+                    "node_id": self.node_id.binary(),
+                    "resources_available": self.resources_available,
+                    "load": len(self._pending_leases),
+                }, timeout=5.0)
+                if not reply.get("acked"):
+                    logger.error("GCS rejected health report; exiting raylet")
+                    break
+                view = await self.gcs_conn.call("get_nodes", {}, timeout=5.0)
+                self._cluster_view = view
+                self._gcs_misses = 0
+            except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+                if self._closing:
+                    break
+                self._gcs_misses = getattr(self, "_gcs_misses", 0) + 1
+                logger.warning("GCS unreachable from raylet %s (%d)",
+                               self.node_id.hex()[:12], self._gcs_misses)
+                if self._gcs_misses * self.config.health_report_period_s > \
+                        self.config.health_timeout_s * 3:
+                    # head is gone: tear down this node (workers follow via
+                    # their raylet connections dropping)
+                    logger.error("GCS dead; raylet exiting")
+                    os._exit(0)
+            await asyncio.sleep(self.config.health_report_period_s)
+
+    async def _reap_loop(self) -> None:
+        """Detect dead worker processes (parity: WorkerPool SIGCHLD path)."""
+        while not self._closing:
+            for w in list(self.workers.values()):
+                if w.proc is not None and w.proc.poll() is not None:
+                    self._on_worker_dead(w, f"exit code {w.proc.returncode}")
+            await asyncio.sleep(0.2)
+
+    # ------------------------------------------------------------------
+    # worker pool
+    # ------------------------------------------------------------------
+    def _start_worker(self, job_id_bin: Optional[bytes]) -> None:
+        if self._starting + len(self.workers) >= self._max_workers:
+            return
+        self._starting += 1
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER"] = "1"
+        log_base = os.path.join(self.session_dir, "logs",
+                                f"worker-{os.getpid()}-{self._starting}-{time.monotonic_ns()}")
+        os.makedirs(os.path.dirname(log_base), exist_ok=True)
+        cmd = [
+            sys.executable, "-m", "ray_tpu.core.worker_main",
+            "--raylet", f"{self.server.address[0]}:{self.server.address[1]}",
+            "--gcs", f"{self.gcs_address[0]}:{self.gcs_address[1]}",
+            "--node-id", self.node_id.hex(),
+            "--store-path", self.store.path,
+            "--store-capacity", str(self.store_capacity),
+            "--session-dir", self.session_dir,
+        ]
+        if job_id_bin is not None:
+            cmd += ["--job-id", job_id_bin.hex()]
+        out = open(log_base + ".out", "ab")
+        err = open(log_base + ".err", "ab")
+        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err,
+                                cwd=os.getcwd())
+        # handle registered later in handle_register_worker; remember proc
+        self._spawned_procs.append((proc, time.monotonic()))
+
+    async def handle_register_worker(self, conn, data):
+        if data.get("is_driver"):
+            # drivers use the object plane but never join the worker pool
+            conn.context["is_driver"] = True
+            return {"node_id": self.node_id.binary(),
+                    "config": self.config.to_json()}
+        worker = WorkerHandle(
+            worker_id=WorkerID(data["worker_id"]),
+            pid=data["pid"],
+            job_id_bin=data.get("job_id"),
+            conn=conn,
+            task_address=tuple(data["task_address"]),
+        )
+        # adopt the spawned process handle if this pid is one of ours
+        for proc, _ in list(self._spawned_procs):
+            if proc.pid == worker.pid:
+                worker.proc = proc
+                self._spawned_procs.remove((proc, _))
+                self._starting -= 1
+                break
+        conn.context["worker_id"] = worker.worker_id
+        self.workers[worker.worker_id] = worker
+        self._idle.append(worker)
+        self._maybe_schedule()
+        return {"node_id": self.node_id.binary(),
+                "config": self.config.to_json()}
+
+    def on_disconnection(self, conn) -> None:
+        worker_id = conn.context.get("worker_id")
+        if worker_id is not None:
+            w = self.workers.get(worker_id)
+            if w is not None:
+                self._on_worker_dead(w, "connection lost")
+
+    def _on_worker_dead(self, worker: WorkerHandle, reason: str) -> None:
+        self.workers.pop(worker.worker_id, None)
+        if worker in self._idle:
+            self._idle.remove(worker)
+        if worker.leased:
+            self._release_lease_resources(worker)
+        logger.info("worker %s (pid %d) dead: %s",
+                    worker.worker_id.hex()[:12], worker.pid, reason)
+        self._maybe_schedule()
+
+    # ------------------------------------------------------------------
+    # resource accounting
+    # ------------------------------------------------------------------
+    def _resource_pool(self, bundle: Optional[Tuple[bytes, int]]
+                       ) -> Dict[str, float]:
+        if bundle is not None:
+            return self._bundles.get(bundle, {})
+        return self.resources_available
+
+    def _fits(self, resources: Dict[str, float],
+              bundle: Optional[Tuple[bytes, int]]) -> bool:
+        pool = self._resource_pool(bundle)
+        return all(pool.get(k, 0.0) >= v for k, v in resources.items())
+
+    def _feasible_ever(self, resources: Dict[str, float],
+                       bundle: Optional[Tuple[bytes, int]]) -> bool:
+        if bundle is not None:
+            pool = self._bundle_totals.get(bundle)
+            if pool is None:
+                return False
+            return all(pool.get(k, 0.0) >= v for k, v in resources.items())
+        return all(self.resources_total.get(k, 0.0) >= v
+                   for k, v in resources.items())
+
+    def _take(self, resources: Dict[str, float],
+              bundle: Optional[Tuple[bytes, int]]) -> None:
+        pool = self._resource_pool(bundle)
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0.0) - v
+
+    def _give(self, resources: Dict[str, float],
+              bundle: Optional[Tuple[bytes, int]]) -> None:
+        if bundle is not None and bundle not in self._bundles:
+            return  # bundle was removed while leased
+        pool = self._resource_pool(bundle)
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0.0) + v
+
+    def _utilization(self) -> float:
+        fractions = []
+        for k, total in self.resources_total.items():
+            if total > 0:
+                fractions.append(
+                    1.0 - self.resources_available.get(k, 0.0) / total)
+        return max(fractions) if fractions else 0.0
+
+    # ------------------------------------------------------------------
+    # lease scheduling (ClusterTaskManager + LocalTaskManager)
+    # ------------------------------------------------------------------
+    async def handle_request_worker_lease(self, conn, data):
+        """Returns {granted, worker_address, lease_id} | {spillback: addr} —
+        or blocks (queues) until a local grant is possible."""
+        resources = dict(data.get("resources", {}))
+        bundle = None
+        pg_bin = data.get("placement_group_id")
+        if pg_bin is not None:
+            bundle = (pg_bin, data.get("bundle_index", -1))
+            bundle = self._resolve_bundle(bundle, resources)
+            if bundle is None:
+                return {"error": "placement group bundle not on this node"}
+        job_id_bin = data.get("job_id")
+
+        if not self._fits(resources, bundle):
+            spill = self._pick_spillback(resources, data)
+            if spill is not None:
+                return {"spillback": spill}
+            if not self._feasible_ever(resources, bundle):
+                if bundle is None and not self._feasible_anywhere(resources):
+                    return {"error":
+                            f"infeasible resource demand {resources}"}
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending_leases.append(PendingLease(
+            request=data, future=fut, job_id_bin=job_id_bin,
+            resources=resources, bundle=bundle))
+        self._maybe_schedule()
+        return await fut
+
+    def _resolve_bundle(self, bundle: Tuple[bytes, int],
+                        resources: Dict[str, float]
+                        ) -> Optional[Tuple[bytes, int]]:
+        if bundle[1] >= 0:
+            return bundle if bundle in self._bundles else None
+        # bundle_index == -1: any committed bundle of the group that fits
+        for key in self._bundles:
+            if key[0] == bundle[0]:
+                pool = self._bundles[key]
+                if all(pool.get(k, 0.0) >= v for k, v in resources.items()):
+                    return key
+        # fall back to any bundle of the group (will queue)
+        for key in self._bundles:
+            if key[0] == bundle[0]:
+                return key
+        return None
+
+    def _feasible_anywhere(self, resources: Dict[str, float]) -> bool:
+        for node in self._cluster_view:
+            if not node.get("alive"):
+                continue
+            total = node.get("resources_total", {})
+            if all(total.get(k, 0.0) >= v for k, v in resources.items()):
+                return True
+        return all(self.resources_total.get(k, 0.0) >= v
+                   for k, v in resources.items())
+
+    def _pick_spillback(self, resources: Dict[str, float],
+                        data: Dict[str, Any]) -> Optional[rpc.Address]:
+        """Hybrid policy: if local is saturated, hand the lease to the
+        least-loaded remote node that can run it *now*."""
+        strategy = data.get("strategy", "DEFAULT")
+        if strategy == "NODE_AFFINITY" or data.get("placement_group_id"):
+            return None  # pinned to this node
+        best = None
+        best_load = None
+        for node in self._cluster_view:
+            if not node.get("alive"):
+                continue
+            if bytes(node["node_id"]) == self.node_id.binary():
+                continue
+            avail = node.get("resources_available", {})
+            if all(avail.get(k, 0.0) >= v for k, v in resources.items()):
+                load = node.get("load", 0)
+                if best is None or load < best_load:
+                    best, best_load = node, load
+        if best is None:
+            return None
+        if strategy == "SPREAD":
+            return tuple(best["address"])
+        # hybrid: stay local while below the spread threshold and feasible
+        if self._utilization() < self.config.scheduler_spread_threshold and \
+                self._feasible_ever(resources, None):
+            return None
+        return tuple(best["address"])
+
+    def _maybe_schedule(self) -> None:
+        """Grant queued leases FIFO while resources and workers allow."""
+        if self._closing:
+            return
+        remaining: List[PendingLease] = []
+        for lease in self._pending_leases:
+            if lease.future.done():
+                continue
+            if not self._fits(lease.resources, lease.bundle):
+                remaining.append(lease)
+                continue
+            worker = self._pop_idle(lease.job_id_bin)
+            if worker is None:
+                remaining.append(lease)
+                if self._starting == 0 or len(self._idle) == 0:
+                    self._start_worker(lease.job_id_bin)
+                continue
+            self._take(lease.resources, lease.bundle)
+            worker.leased = True
+            worker.lease_resources = lease.resources
+            worker.lease_bundle = lease.bundle
+            lease.future.set_result({
+                "granted": True,
+                "worker_address": worker.task_address,
+                "worker_id": worker.worker_id.binary(),
+            })
+        self._pending_leases = remaining
+
+    def _pop_idle(self, job_id_bin: Optional[bytes]) -> Optional[WorkerHandle]:
+        # job-dedicated workers: a worker that has loaded job code serves
+        # only that job (parity: WorkerPool per-job isolation)
+        for i, w in enumerate(self._idle):
+            if w.job_id_bin is None or job_id_bin is None or \
+                    w.job_id_bin == job_id_bin:
+                return self._idle.pop(i)
+        return None
+
+    async def handle_return_worker(self, conn, data):
+        worker = self.workers.get(WorkerID(data["worker_id"]))
+        if worker is None:
+            return False
+        if data.get("job_id") is not None and worker.job_id_bin is None:
+            worker.job_id_bin = data["job_id"]
+        self._release_lease_resources(worker)
+        if not data.get("disconnect", False):
+            self._idle.append(worker)
+        self._maybe_schedule()
+        return True
+
+    def _release_lease_resources(self, worker: WorkerHandle) -> None:
+        if worker.leased:
+            self._give(worker.lease_resources, worker.lease_bundle)
+            worker.leased = False
+            worker.lease_resources = {}
+            worker.lease_bundle = None
+
+    async def handle_lease_worker_for_actor(self, conn, data):
+        """GCS asks this node to host an actor: lease a worker, push the
+        creation task to it, reply with its task-server address."""
+        resources = dict(data.get("resources", {}))
+        bundle = None
+        pg_bin = data.get("placement_group_id")
+        if pg_bin is not None:
+            bundle = self._resolve_bundle((pg_bin, data.get("bundle_index", -1)),
+                                          resources)
+        reply = await self.handle_request_worker_lease(conn, {
+            "resources": resources,
+            "job_id": data.get("job_id"),
+            "placement_group_id": pg_bin if bundle else None,
+            "bundle_index": bundle[1] if bundle else -1,
+            "strategy": "DEFAULT",
+        })
+        if not reply.get("granted"):
+            return {"granted": False, "reason": str(reply)}
+        worker = self.workers.get(WorkerID(reply["worker_id"]))
+        if worker is None:
+            return {"granted": False, "reason": "worker vanished"}
+        worker.is_actor = True
+        try:
+            result = await worker.conn.call(
+                "create_actor", {"spec_blob": data["spec_blob"]}, timeout=120.0)
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            self._on_worker_dead(worker, f"actor creation failed: {e}")
+            return {"granted": False, "reason": str(e)}
+        if not result.get("ok"):
+            # creation raised in user code: actor is dead on arrival
+            self._release_lease_resources(worker)
+            self._idle.append(worker)
+            worker.is_actor = False
+            return {"granted": False, "reason": result.get("error", "unknown"),
+                    "creation_error": True}
+        return {"granted": True, "worker_task_address": worker.task_address,
+                "worker_id": worker.worker_id.binary()}
+
+    # ------------------------------------------------------------------
+    # placement-group bundles (PlacementGroupResourceManager)
+    # ------------------------------------------------------------------
+    async def handle_prepare_bundle(self, conn, data):
+        resources = dict(data["resources"])
+        if not all(self.resources_available.get(k, 0.0) >= v
+                   for k, v in resources.items()):
+            return False
+        key = (data["pg_id"], data["bundle_index"])
+        for k, v in resources.items():
+            self.resources_available[k] = self.resources_available.get(k, 0.0) - v
+        self._bundles[key] = dict(resources)  # held but uncommitted
+        self._bundle_totals[key] = dict(resources)
+        return True
+
+    async def handle_commit_bundle(self, conn, data):
+        key = (data["pg_id"], data["bundle_index"])
+        return key in self._bundles
+
+    async def handle_return_bundle(self, conn, data):
+        key = (data["pg_id"], data["bundle_index"])
+        total = self._bundle_totals.pop(key, None)
+        self._bundles.pop(key, None)
+        if total is not None:
+            for k, v in total.items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) + v
+        self._maybe_schedule()
+        return True
+
+    # ------------------------------------------------------------------
+    # object plane: local store service
+    # ------------------------------------------------------------------
+    async def handle_object_create(self, conn, data):
+        object_id = ObjectID(data["object_id"])
+        size = data["size"]
+        self._maybe_spill(size)
+        offset, _ = self.store.alloc(object_id, size)  # raises if full
+        return {"offset": offset, "size": size}
+
+    async def handle_object_seal(self, conn, data):
+        object_id = ObjectID(data["object_id"])
+        self.store.seal(object_id)
+        self._mark_primary(object_id, tuple(data["owner_address"])
+                           if data.get("owner_address") else None)
+        return True
+
+    def _mark_primary(self, object_id: ObjectID, owner: Optional[tuple]) -> None:
+        if object_id not in self._primary:
+            if self.store.lease(object_id) is not None:  # pin primary copy
+                self._primary.add(object_id)
+        if owner is not None:
+            self._owner_of[object_id] = owner
+
+    async def handle_object_get(self, conn, data):
+        """Resolve objects to {offset,size} leases, pulling remote /
+        spilled copies as needed.  The client must release_objects."""
+        ids = [ObjectID(b) for b in data["object_ids"]]
+        owners = data.get("owners", {})
+        timeout = data.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = {}
+        for oid in ids:
+            lease = self.store.lease(oid)
+            if lease is None:
+                ok = await self._make_local(oid, owners.get(oid.binary()),
+                                            deadline)
+                lease = self.store.lease(oid) if ok else None
+            if lease is None:
+                out[oid.binary()] = None
+            else:
+                out[oid.binary()] = {"offset": lease[0], "size": lease[1]}
+        return out
+
+    async def _make_local(self, oid: ObjectID, owner: Optional[tuple],
+                          deadline: Optional[float]) -> bool:
+        """Restore from spill or pull from a remote holder."""
+        lock = self._pull_locks.setdefault(oid, asyncio.Lock())
+        async with lock:
+            if self.store.contains(oid):
+                return True
+            if oid in self._spilled:
+                return self._restore_from_spill(oid)
+            if owner is None:
+                owner = self._owner_of.get(oid)
+            if owner is None:
+                return False
+            # ownership-based directory: ask the owner where copies live
+            while True:
+                try:
+                    owner_conn = await self.pool.get((owner[1], owner[2]))
+                    locs = await owner_conn.call(
+                        "get_object_locations",
+                        {"object_id": oid.binary()}, timeout=10.0)
+                except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+                    return False
+                if locs is None:
+                    return False  # owner no longer knows the object
+                for node_addr in locs.get("nodes", []):
+                    if tuple(node_addr) == self.server.address:
+                        continue
+                    if await self._pull_from(tuple(node_addr), oid):
+                        return True
+                if locs.get("spilled_on") :
+                    node_addr = tuple(locs["spilled_on"])
+                    if node_addr == self.server.address:
+                        return self._restore_from_spill(oid)
+                    if await self._pull_from(node_addr, oid):
+                        return True
+                if locs.get("pending"):
+                    # object not produced yet; wait and retry
+                    if deadline is not None and time.monotonic() > deadline:
+                        return False
+                    await asyncio.sleep(0.05)
+                    continue
+                return False
+
+    async def _pull_from(self, node_addr: rpc.Address, oid: ObjectID) -> bool:
+        """Chunked pull (parity: ObjectManager Push/Pull, pull_manager.h)."""
+        try:
+            conn = await self.pool.get(node_addr)
+            meta = await conn.call("object_pull_start",
+                                   {"object_id": oid.binary()}, timeout=10.0)
+            if meta is None:
+                return False
+            size = meta["size"]
+            self._maybe_spill(size)
+            view = self.store.create(oid, size)
+            chunk = self.config.object_transfer_chunk_size
+            try:
+                for off in range(0, size, chunk):
+                    n = min(chunk, size - off)
+                    data = await conn.call(
+                        "object_pull_chunk",
+                        {"object_id": oid.binary(), "offset": off, "n": n},
+                        timeout=60.0)
+                    if data is None:
+                        raise IOError("remote dropped object mid-transfer")
+                    view[off:off + n] = data
+            except Exception:
+                self.store.delete(oid)
+                raise
+            finally:
+                await conn.call("object_pull_end",
+                                {"object_id": oid.binary()}, timeout=10.0)
+            self.store.seal(oid)
+            # secondary copy: not pinned, evictable
+            return True
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
+                IOError):
+            return False
+
+    async def handle_object_pull_start(self, conn, data):
+        oid = ObjectID(data["object_id"])
+        lease = self.store.lease(oid)
+        if lease is None:
+            if oid in self._spilled and self._restore_from_spill(oid):
+                lease = self.store.lease(oid)
+            if lease is None:
+                return None
+        conn.context.setdefault("pull_leases", set()).add(oid)
+        return {"size": lease[1]}
+
+    async def handle_object_pull_chunk(self, conn, data):
+        oid = ObjectID(data["object_id"])
+        lease = self.store.lease(oid)
+        if lease is None:
+            return None
+        try:
+            offset, size = lease
+            start = data["offset"]
+            n = data["n"]
+            return bytes(self.store.view(offset + start, n))
+        finally:
+            self.store.release(oid)
+
+    async def handle_object_pull_end(self, conn, data):
+        oid = ObjectID(data["object_id"])
+        leases = conn.context.get("pull_leases", set())
+        if oid in leases:
+            leases.discard(oid)
+            self.store.release(oid)
+        return True
+
+    async def handle_object_release(self, conn, data):
+        for b in data["object_ids"]:
+            self.store.release(ObjectID(b))
+        return True
+
+    async def handle_object_contains(self, conn, data):
+        oid = ObjectID(data["object_id"])
+        return self.store.contains(oid) or oid in self._spilled
+
+    async def handle_object_free(self, conn, data):
+        """Owner-driven free: drop primaries, spill files, local copies."""
+        for b in data["object_ids"]:
+            oid = ObjectID(b)
+            if oid in self._primary:
+                self._primary.discard(oid)
+                self.store.release(oid)
+            path = self._spilled.pop(oid, None)
+            if path:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self.store.delete(oid)
+            self._owner_of.pop(oid, None)
+        return True
+
+    async def handle_store_info(self, conn, data):
+        """Connection bootstrap info for late-joining drivers."""
+        return {"store_path": self.store.path,
+                "store_capacity": self.store_capacity,
+                "session_dir": self.session_dir,
+                "node_id": self.node_id.binary()}
+
+    async def handle_store_stats(self, conn, data):
+        stats = self.store.stats()
+        stats["num_primary"] = len(self._primary)
+        stats["num_spilled"] = len(self._spilled)
+        return stats
+
+    # ------------------------------------------------------------------
+    # spilling (LocalObjectManager)
+    # ------------------------------------------------------------------
+    def _maybe_spill(self, incoming: int) -> None:
+        stats = self.store.stats()
+        threshold = self.config.object_spilling_threshold * stats["capacity"]
+        if stats["used"] + incoming <= threshold:
+            return
+        need = stats["used"] + incoming - int(threshold)
+        # spill pinned primaries LRU-first; unpinned copies just evict
+        spilled = 0
+        for oid in list(self._primary):
+            if spilled >= need:
+                break
+            lease = self.store.lease(oid)
+            if lease is None:
+                self._primary.discard(oid)
+                continue
+            offset, size = lease
+            path = os.path.join(self._spill_dir, oid.hex())
+            try:
+                with open(path, "wb") as f:
+                    f.write(self.store.view(offset, size))
+            finally:
+                self.store.release(oid)
+            self._spilled[oid] = path
+            self._primary.discard(oid)
+            self.store.release(oid)  # drop the primary pin
+            self.store.delete(oid)
+            spilled += size
+
+    def _restore_from_spill(self, oid: ObjectID) -> bool:
+        path = self._spilled.get(oid)
+        if path is None or not os.path.exists(path):
+            return False
+        size = os.path.getsize(path)
+        try:
+            view = self.store.create(oid, size)
+        except Exception:
+            return False
+        with open(path, "rb") as f:
+            f.readinto(view)
+        self.store.seal(oid)
+        return True

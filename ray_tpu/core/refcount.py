@@ -1,0 +1,282 @@
+"""Ownership-based reference counting and task lifetime management.
+
+Parity: reference ``src/ray/core_worker/reference_count.h`` (distributed
+refcount + borrowing) and ``task_manager.h`` (in-flight task tracking,
+retries, lineage pinning for reconstruction).
+
+Model: the worker that creates an object (by ``put`` or by submitting the
+producing task) is its *owner*.  The owner tracks
+
+- local refs    — live ``ObjectRef`` pythons objects in the owner process,
+- submitted refs — uses of the object as an argument of in-flight tasks,
+- borrowers     — remote workers that deserialized the ref.
+
+When all three hit zero, the object is freed: dropped from the owner's
+memory store and, for shared-memory objects, a free is broadcast to every
+raylet holding a copy.  Borrowing workers keep a local count per borrowed
+ref and tell the owner when they first see the ref and when their last
+local ref dies.
+
+Lineage: the owner keeps the producing TaskSpec of every finished task
+whose returns are still referenced, so a lost shared-memory object can be
+reconstructed by resubmitting the task (reference
+``object_recovery_manager.h``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Reference:
+    local_refs: int = 0
+    submitted_refs: int = 0
+    borrowers: Set[tuple] = field(default_factory=set)  # worker addresses
+    owned: bool = False  # this process is the owner
+    owner_address: Optional[tuple] = None  # for borrowed refs
+    # nodes (raylet addresses) known to hold a shm copy; owner-side only
+    locations: Set[tuple] = field(default_factory=set)
+    spilled_on: Optional[tuple] = None
+    in_plasma: bool = False
+    # lineage: the task that produces this object (owner-side)
+    producing_task: Optional[TaskID] = None
+    freed: bool = False
+
+
+class ReferenceCounter:
+    def __init__(self, on_free: Callable[[ObjectID, Reference], None],
+                 on_borrow_added: Callable[[ObjectID, Optional[tuple]], None],
+                 on_borrow_removed: Callable[[ObjectID, Optional[tuple]], None]):
+        self._lock = threading.RLock()
+        self._refs: Dict[ObjectID, Reference] = {}
+        self._on_free = on_free
+        self._on_borrow_added = on_borrow_added
+        self._on_borrow_removed = on_borrow_removed
+
+    def _get(self, object_id: ObjectID) -> Reference:
+        ref = self._refs.get(object_id)
+        if ref is None:
+            ref = Reference()
+            self._refs[object_id] = ref
+        return ref
+
+    # -- owner-side -------------------------------------------------------
+    def add_owned(self, object_id: ObjectID,
+                  producing_task: Optional[TaskID] = None) -> None:
+        with self._lock:
+            ref = self._get(object_id)
+            ref.owned = True
+            ref.producing_task = producing_task
+
+    def add_local_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._get(object_id).local_refs += 1
+
+    def remove_local_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            ref.local_refs -= 1
+            self._maybe_release(object_id, ref)
+
+    def add_submitted_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._get(object_id).submitted_refs += 1
+
+    def remove_submitted_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            ref.submitted_refs -= 1
+            self._maybe_release(object_id, ref)
+
+    def add_borrower(self, object_id: ObjectID, borrower: tuple) -> None:
+        with self._lock:
+            self._get(object_id).borrowers.add(borrower)
+
+    def remove_borrower(self, object_id: ObjectID, borrower: tuple) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            ref.borrowers.discard(borrower)
+            self._maybe_release(object_id, ref)
+
+    def add_location(self, object_id: ObjectID, node_address: tuple) -> None:
+        with self._lock:
+            ref = self._get(object_id)
+            ref.in_plasma = True
+            ref.locations.add(node_address)
+
+    def set_spilled(self, object_id: ObjectID, node_address: tuple) -> None:
+        with self._lock:
+            self._get(object_id).spilled_on = node_address
+
+    def remove_location(self, object_id: ObjectID, node_address: tuple) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is not None:
+                ref.locations.discard(node_address)
+
+    def get_locations(self, object_id: ObjectID) -> Tuple[List[tuple],
+                                                          Optional[tuple]]:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return [], None
+            return list(ref.locations), ref.spilled_on
+
+    def get(self, object_id: ObjectID) -> Optional[Reference]:
+        with self._lock:
+            return self._refs.get(object_id)
+
+    # -- borrower-side ----------------------------------------------------
+    def add_borrowed_ref(self, object_id: ObjectID,
+                         owner_address: Optional[tuple]) -> None:
+        with self._lock:
+            ref = self._get(object_id)
+            first = ref.local_refs == 0 and not ref.owned
+            ref.local_refs += 1
+            if ref.owner_address is None:
+                ref.owner_address = owner_address
+        if first:
+            self._on_borrow_added(object_id, owner_address)
+
+    # -- release ----------------------------------------------------------
+    def _maybe_release(self, object_id: ObjectID, ref: Reference) -> None:
+        if ref.local_refs > 0 or ref.submitted_refs > 0 or ref.borrowers:
+            return
+        if ref.freed:
+            return
+        if ref.owned:
+            ref.freed = True
+            del self._refs[object_id]
+            self._on_free(object_id, ref)
+        else:
+            # last local borrow released: tell the owner
+            ref.freed = True
+            del self._refs[object_id]
+            self._on_borrow_removed(object_id, ref.owner_address)
+
+    def owned_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._refs.values() if r.owned)
+
+    def summary(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "total": len(self._refs),
+                "owned": sum(1 for r in self._refs.values() if r.owned),
+                "borrowed": sum(1 for r in self._refs.values() if not r.owned),
+                "in_plasma": sum(1 for r in self._refs.values() if r.in_plasma),
+            }
+
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    # callbacks fired with (results | None, error | None)
+    lineage_footprint: List[ObjectID] = field(default_factory=list)
+
+
+class TaskManager:
+    """Owner-side in-flight task table with retry + lineage bookkeeping.
+
+    The owner registers every submitted task here before handing it to a
+    submitter.  On completion the return values are published to the
+    memory store; the spec is retained (lineage) while any return object
+    may still need reconstruction.  On worker/node failure the task is
+    resubmitted if its retry budget allows.
+    """
+
+    def __init__(self, reference_counter: ReferenceCounter):
+        self._lock = threading.RLock()
+        self._pending: Dict[TaskID, PendingTask] = {}
+        self._lineage: Dict[TaskID, TaskSpec] = {}
+        self._rc = reference_counter
+
+    def register(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._pending[spec.task_id] = PendingTask(
+                spec=spec, retries_left=spec.max_retries)
+            for ret in spec.return_ids():
+                self._rc.add_owned(ret, producing_task=spec.task_id)
+            for arg in spec.args:
+                if arg.object_id is not None:
+                    self._rc.add_submitted_ref(arg.object_id)
+
+    def is_pending(self, task_id: TaskID) -> bool:
+        with self._lock:
+            return task_id in self._pending
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def complete(self, task_id: TaskID) -> Optional[TaskSpec]:
+        """Mark done; returns the spec (now lineage) if it was pending."""
+        with self._lock:
+            entry = self._pending.pop(task_id, None)
+            if entry is None:
+                return None
+            self._lineage[task_id] = entry.spec
+            for arg in entry.spec.args:
+                if arg.object_id is not None:
+                    self._rc.remove_submitted_ref(arg.object_id)
+            return entry.spec
+
+    def take_for_retry(self, task_id: TaskID) -> Optional[TaskSpec]:
+        """Consume one retry; returns the bumped spec or None if exhausted."""
+        with self._lock:
+            entry = self._pending.get(task_id)
+            if entry is None or entry.retries_left <= 0:
+                return None
+            entry.retries_left -= 1
+            entry.spec.attempt_number += 1
+            return entry.spec
+
+    def fail(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            entry = self._pending.pop(task_id, None)
+            if entry is None:
+                return None
+            for arg in entry.spec.args:
+                if arg.object_id is not None:
+                    self._rc.remove_submitted_ref(arg.object_id)
+            return entry.spec
+
+    def lineage_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            return self._lineage.get(task_id)
+
+    def resubmit_for_reconstruction(self, task_id: TaskID
+                                    ) -> Optional[TaskSpec]:
+        """Move a finished task back to pending for lineage reconstruction."""
+        with self._lock:
+            spec = self._lineage.get(task_id)
+            if spec is None:
+                return None
+            if task_id in self._pending:
+                return None  # already being re-executed
+            spec.attempt_number += 1
+            self._pending[task_id] = PendingTask(spec=spec, retries_left=0)
+            for arg in spec.args:
+                if arg.object_id is not None:
+                    self._rc.add_submitted_ref(arg.object_id)
+            return spec
+
+    def evict_lineage(self, task_id: TaskID) -> None:
+        with self._lock:
+            self._lineage.pop(task_id, None)

@@ -1,0 +1,271 @@
+"""Asyncio message transport used by every control-plane service.
+
+Parity: the reference's gRPC layer (``src/ray/rpc/grpc_server.h``) plus its
+long-poll pubsub push channel (``src/ray/pubsub/``).  One framed protocol
+covers both: request/reply correlated by message id, and unsolicited PUSH
+frames for subscriptions.  Payloads are pickled Python structures; large
+tensors never travel this path (they go through the shared-memory object
+plane), so pickling cost is bounded by control-message size.
+
+Frame layout: ``[8B little-endian length][payload]`` where payload is
+``pickle((msg_id, kind, method, data))``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<Q")
+
+KIND_REQ = 0
+KIND_REP = 1
+KIND_ERR = 2
+KIND_PUSH = 3
+
+Address = Tuple[str, int]
+
+
+class RpcError(Exception):
+    """Remote handler raised; message carries the remote repr."""
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    payload = await reader.readexactly(length)
+    return pickle.loads(payload)
+
+
+def _write_frame(writer: asyncio.StreamWriter, message: Any) -> None:
+    payload = pickle.dumps(message, protocol=5)
+    writer.write(_LEN.pack(len(payload)) + payload)
+
+
+class Connection:
+    """One bidirectional peer link; usable as client and/or server side."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handler: Optional["Server"] = None,
+                 on_close: Optional[Callable[["Connection"], None]] = None):
+        self._reader = reader
+        self._writer = writer
+        self._handler = handler
+        self._on_close = on_close
+        self._msg_ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._push_handler: Optional[Callable[[str, Any], None]] = None
+        self._closed = False
+        self.peername = writer.get_extra_info("peername")
+        self._loop_task = asyncio.get_running_loop().create_task(self._run())
+        # Application state slot (e.g. the worker/node this conn belongs to).
+        self.context: Dict[str, Any] = {}
+
+    def set_push_handler(self, fn: Callable[[str, Any], None]) -> None:
+        self._push_handler = fn
+
+    async def _run(self) -> None:
+        try:
+            while True:
+                msg_id, kind, method, data = await _read_frame(self._reader)
+                if kind == KIND_REQ:
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch(msg_id, method, data)
+                    )
+                elif kind == KIND_REP:
+                    fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(data)
+                elif kind == KIND_ERR:
+                    fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(RpcError(data))
+                elif kind == KIND_PUSH:
+                    try:
+                        if self._push_handler is not None:
+                            self._push_handler(method, data)
+                        elif self._handler is not None:
+                            # server side: route to service push_<channel>
+                            self._handler.dispatch_push(self, method, data)
+                    except Exception:
+                        logger.exception("push handler failed: %s", method)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception:
+            logger.exception("connection loop failed")
+        finally:
+            self._teardown()
+
+    def _teardown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost())
+        self._pending.clear()
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+        if self._on_close is not None:
+            try:
+                self._on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    async def _dispatch(self, msg_id: int, method: str, data: Any) -> None:
+        try:
+            if self._handler is None:
+                raise RpcError(f"no handler for {method}")
+            result = await self._handler.dispatch(self, method, data)
+            reply = (msg_id, KIND_REP, method, result)
+        except Exception as e:
+            logger.debug("handler %s raised", method, exc_info=True)
+            reply = (msg_id, KIND_ERR, method, f"{type(e).__name__}: {e}")
+        if not self._closed:
+            try:
+                _write_frame(self._writer, reply)
+            except Exception:
+                self._teardown()
+
+    async def call(self, method: str, data: Any = None,
+                   timeout: Optional[float] = None) -> Any:
+        if self._closed:
+            raise ConnectionLost()
+        msg_id = next(self._msg_ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        _write_frame(self._writer, (msg_id, KIND_REQ, method, data))
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    def push(self, channel: str, data: Any) -> None:
+        """Fire-and-forget push (pubsub delivery, notifications)."""
+        if self._closed:
+            return
+        try:
+            _write_frame(self._writer, (0, KIND_PUSH, channel, data))
+        except Exception:
+            self._teardown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def drain(self) -> None:
+        await self._writer.drain()
+
+    def close(self) -> None:
+        self._teardown()
+
+
+class Server:
+    """Listens on a port; dispatches ``handle_<method>`` coroutines defined
+    on a service object."""
+
+    def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0):
+        self._service = service
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: set[Connection] = set()
+
+    async def start(self) -> Address:
+        self._server = await asyncio.start_server(
+            self._on_connect, self._host, self._port
+        )
+        sock = self._server.sockets[0]
+        self._host, self._port = sock.getsockname()[:2]
+        return (self._host, self._port)
+
+    @property
+    def address(self) -> Address:
+        return (self._host, self._port)
+
+    async def _on_connect(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        conn = Connection(reader, writer, handler=self,
+                          on_close=self._on_disconnect)
+        self.connections.add(conn)
+        hook = getattr(self._service, "on_connection", None)
+        if hook is not None:
+            hook(conn)
+
+    def _on_disconnect(self, conn: Connection) -> None:
+        self.connections.discard(conn)
+        hook = getattr(self._service, "on_disconnection", None)
+        if hook is not None:
+            hook(conn)
+
+    async def dispatch(self, conn: Connection, method: str, data: Any) -> Any:
+        handler: Optional[Callable[..., Awaitable[Any]]] = getattr(
+            self._service, f"handle_{method}", None
+        )
+        if handler is None:
+            raise RpcError(f"{type(self._service).__name__} has no method {method}")
+        return await handler(conn, data)
+
+    def dispatch_push(self, conn: Connection, channel: str, data: Any) -> None:
+        handler = getattr(self._service, f"push_{channel}", None)
+        if handler is not None:
+            handler(conn, data)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            conn.close()
+
+
+async def connect(address: Address, handler: Optional[Server] = None,
+                  timeout: float = 10.0) -> Connection:
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(address[0], address[1]), timeout
+    )
+    return Connection(reader, writer, handler=handler)
+
+
+class ConnectionPool:
+    """Caches one connection per remote address (parity:
+    ``core_worker_client_pool.h``)."""
+
+    def __init__(self, handler: Optional[Server] = None):
+        self._handler = handler
+        self._conns: Dict[Address, Connection] = {}
+        self._locks: Dict[Address, asyncio.Lock] = {}
+
+    async def get(self, address: Address) -> Connection:
+        conn = self._conns.get(address)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._locks.setdefault(address, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(address)
+            if conn is not None and not conn.closed:
+                return conn
+            conn = await connect(address, handler=self._handler)
+            self._conns[address] = conn
+            return conn
+
+    def invalidate(self, address: Address) -> None:
+        conn = self._conns.pop(address, None)
+        if conn is not None:
+            conn.close()
+
+    def close_all(self) -> None:
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()

@@ -1,0 +1,116 @@
+"""Task specifications — the unit handed from submitter to executor.
+
+Parity: reference ``src/ray/common/task/task_spec.h`` /
+``src/ray/protobuf/common.proto`` TaskSpec.  A spec fully describes one
+invocation: function identity (by hash into the GCS function table),
+serialized arguments (small values inlined; larger ones as ObjectRef
+references), resource demand, retry policy, and — for actor tasks —
+ordering metadata.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu.core.object_ref import OwnerAddress
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class TaskArg:
+    """Either an inlined serialized value or an object reference."""
+
+    # Exactly one of (value_bytes, object_id) is set.
+    value_bytes: Optional[bytes] = None
+    object_id: Optional[ObjectID] = None
+    owner_address: Optional[OwnerAddress] = None
+
+    def is_inline(self) -> bool:
+        return self.value_bytes is not None
+
+
+@dataclass
+class SchedulingStrategy:
+    """Default / spread / node-affinity / placement-group placement.
+
+    Parity: ``python/ray/util/scheduling_strategies.py``.
+    """
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id_hex: Optional[str] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    # Identity of the function/class in the GCS function table.
+    function_id: str
+    function_descriptor: str  # human-readable "module.fn" for errors/state API
+    args: List[TaskArg] = field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    owner_address: Optional[OwnerAddress] = None
+    # Actor-related fields.
+    actor_id: Optional[ActorID] = None
+    actor_creation_spec: Optional["ActorCreationSpec"] = None
+    # Ordering for actor tasks (per-caller sequence number).
+    sequence_number: int = 0
+    # Name of the concurrency group for async actors ("" = default).
+    concurrency_group: str = ""
+    # Attempt counter (incremented on retries) — return object IDs stay
+    # stable across attempts, matching the reference's semantics.
+    attempt_number: int = 0
+    # Depth in the lineage tree (driver = 0), bounds reconstruction.
+    depth: int = 0
+    runtime_env: Optional[Dict[str, Any]] = None
+
+    def return_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i + 1)
+            for i in range(self.num_returns)
+        ]
+
+    def scheduling_key(self) -> Tuple:
+        """Tasks with the same key can share leased workers (parity:
+        ``SchedulingKey`` in direct_task_transport.h)."""
+        strat = self.scheduling_strategy
+        return (
+            self.function_id,
+            tuple(sorted(self.resources.items())),
+            strat.kind,
+            strat.node_id_hex,
+            strat.placement_group_id,
+            strat.bundle_index,
+        )
+
+    def debug_name(self) -> str:
+        return f"{self.function_descriptor}[{self.task_id.hex()[:12]}]"
+
+
+@dataclass
+class ActorCreationSpec:
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    name: Optional[str] = None  # named (and optionally detached) actors
+    namespace: str = "default"
+    lifetime_detached: bool = False
+    max_concurrency: int = 1
+    is_asyncio: bool = False
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)

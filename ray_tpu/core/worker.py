@@ -1,0 +1,1409 @@
+"""CoreWorker: the per-process runtime embedded in drivers and workers.
+
+Parity: reference ``src/ray/core_worker/core_worker.h`` — task submission
+(lease-then-direct-push, ``direct_task_transport.h``), actor submission
+(ordered per-actor queues, ``direct_actor_task_submitter.h``), object
+``put``/``get``/``wait`` over a two-tier store (in-process memory store for
+small values, node shared-memory store for large ones), ownership-based
+reference counting, task retries, and lineage reconstruction.
+
+Threading model: all network I/O runs on one background asyncio loop
+("io thread").  User threads call the sync API which bridges with
+``run_coroutine_threadsafe``.  Task execution (worker mode) happens on
+dedicated executor thread(s) fed by a queue so user code never blocks the
+I/O loop.
+
+Zero-copy: values fetched from shared memory deserialize with their
+buffers aliasing the store mapping.  Each buffer is wrapped in a
+:class:`_PinnedBuffer` (PEP 688 ``__buffer__`` protocol) holding a lease on
+the store slot; when the last consuming array is garbage collected the pin
+is released and the slot becomes evictable — the same lifetime contract as
+the reference's plasma client buffers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import hashlib
+import logging
+import os
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config, get_config, set_config
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+    _Counter,
+)
+from ray_tpu.core.object_ref import ObjectRef, OwnerAddress
+from ray_tpu.core.object_store import MemoryStore, StoreClient
+from ray_tpu.core.refcount import ReferenceCounter, TaskManager
+from ray_tpu.core.serialization import (
+    SerializedObject,
+    deserialize,
+    serialize,
+    serialize_exception,
+)
+from ray_tpu.core.task_spec import (
+    ActorCreationSpec,
+    SchedulingStrategy,
+    TaskArg,
+    TaskSpec,
+    TaskType,
+)
+
+logger = logging.getLogger(__name__)
+
+PLASMA_MARKER = b"__RTPU_IN_PLASMA__"
+
+_global_worker: Optional["CoreWorker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RayTpuError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def global_worker_or_none() -> Optional["CoreWorker"]:
+    return _global_worker
+
+
+def set_global_worker(worker: Optional["CoreWorker"]) -> None:
+    global _global_worker
+    with _global_lock:
+        _global_worker = worker
+
+
+class _PinnedBuffer:
+    """Buffer-protocol wrapper that releases a store pin on GC (PEP 688)."""
+
+    def __init__(self, view: memoryview, pin: "_Pin"):
+        self._view = view
+        self._pin = pin
+        pin.count += 1
+
+    def __buffer__(self, flags: int) -> memoryview:
+        return self._view
+
+    def __release_buffer__(self, view: memoryview) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return self._view.nbytes
+
+    def __del__(self):
+        pin = self._pin
+        pin.count -= 1
+        if pin.count == 0:
+            pin.release()
+
+
+class _Pin:
+    __slots__ = ("count", "release")
+
+    def __init__(self, release: Callable[[], None]):
+        self.count = 0
+        self.release = release
+
+
+class _TaskContext(threading.local):
+    task_id: Optional[TaskID] = None
+    put_counter: Optional[_Counter] = None
+    actor_id: Optional[ActorID] = None
+    attempt_number: int = 0
+
+
+class CoreWorker:
+    def __init__(self, *, mode: str, gcs_address: rpc.Address,
+                 raylet_address: rpc.Address, node_id: NodeID,
+                 store_path: str, store_capacity: int, session_dir: str,
+                 job_id: Optional[JobID] = None,
+                 config: Optional[Config] = None):
+        assert mode in ("driver", "worker")
+        self.mode = mode
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.worker_id = WorkerID.from_random()
+        self.config = config or get_config()
+
+        self.memory_store = MemoryStore()
+        self.store_client = StoreClient(store_path, store_capacity)
+        self.reference_counter = ReferenceCounter(
+            on_free=self._on_object_freed,
+            on_borrow_added=self._on_borrow_added,
+            on_borrow_removed=self._on_borrow_removed,
+        )
+        self.task_manager = TaskManager(self.reference_counter)
+
+        # io loop thread
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, name="rtpu-io", daemon=True)
+        self._loop_thread.start()
+
+        self._ctx = _TaskContext()
+        self.job_id = job_id
+        self._driver_task_id: Optional[TaskID] = None
+        self._object_events: Dict[ObjectID, asyncio.Event] = {}
+        self._task_done_events: Dict[TaskID, asyncio.Event] = {}
+
+        # execution (worker mode)
+        self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._exec_threads: List[threading.Thread] = []
+        self._function_cache: Dict[str, Any] = {}
+        self._actor_instance: Any = None
+        self._actor_id: Optional[ActorID] = None
+        self._actor_creation_spec: Optional[ActorCreationSpec] = None
+        self._max_concurrency = 1
+        self._actor_reply_cache: Dict[Tuple, Dict[str, Any]] = {}
+
+        # submitters
+        self._lease_states: Dict[Tuple, "_LeaseState"] = {}
+        self._actor_states: Dict[ActorID, "_ActorSubmitState"] = {}
+
+        self._pool = rpc.ConnectionPool()
+        self.gcs_conn: Optional[rpc.Connection] = None
+        self.raylet_conn: Optional[rpc.Connection] = None
+        self.task_server: Optional[rpc.Server] = None
+        self.task_address: Optional[rpc.Address] = None
+        self._shutdown = False
+        self._task_events: List[Dict[str, Any]] = []
+
+        self._run(self._async_init())
+        set_global_worker(self)
+
+    # ------------------------------------------------------------------
+    # bootstrap / teardown
+    # ------------------------------------------------------------------
+    def _run(self, coro, timeout: Optional[float] = None):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    def _post(self, coro) -> None:
+        """Fire-and-forget a coroutine on the io loop."""
+        def _spawn():
+            task = self._loop.create_task(coro)
+            task.add_done_callback(lambda t: t.exception())
+        self._loop.call_soon_threadsafe(_spawn)
+
+    async def _async_init(self) -> None:
+        self.task_server = rpc.Server(self, host="127.0.0.1", port=0)
+        self.task_address = await self.task_server.start()
+        # outbound connections carry our handler too, so the raylet/GCS can
+        # call back into this worker over the registration link (e.g.
+        # create_actor pushes)
+        self.gcs_conn = await rpc.connect(self.gcs_address,
+                                          handler=self.task_server)
+        self.gcs_conn.set_push_handler(self._on_gcs_push)
+        if self.mode == "driver" and self.job_id is None:
+            reply = await self.gcs_conn.call(
+                "register_job", {"driver_address": self.task_address})
+            self.job_id = JobID(reply["job_id"])
+        self.raylet_conn = await rpc.connect(self.raylet_address,
+                                             handler=self.task_server)
+        if self.mode == "worker":
+            # a worker must not outlive its raylet (orphan prevention —
+            # parity: reference workers exit when the raylet socket drops)
+            self.raylet_conn._on_close = lambda _c: os._exit(0)
+        reply = await self.raylet_conn.call("register_worker", {
+            "worker_id": self.worker_id.binary(),
+            "pid": os.getpid(),
+            "job_id": self.job_id.binary() if self.job_id else None,
+            "task_address": self.task_address,
+            "is_driver": self.mode == "driver",
+        })
+        set_config(Config.from_json(reply["config"]))
+        self.config = get_config()
+        if self.job_id is not None:
+            self._bind_driver_context()
+        self._flusher = self._loop.create_task(self._task_event_flush_loop())
+
+    def _bind_driver_context(self) -> None:
+        self._driver_task_id = TaskID.for_driver(self.job_id)
+        self._ctx.task_id = self._driver_task_id
+        self._ctx.put_counter = _Counter()
+        self._driver_put_counter = self._ctx.put_counter
+
+    @property
+    def address(self) -> OwnerAddress:
+        return (self.node_id.hex(), self.task_address[0], self.task_address[1],
+                self.worker_id.hex())
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for _ in self._exec_threads:
+            self._exec_queue.put(None)
+        async def _close():
+            if self.task_server:
+                await self.task_server.stop()
+            for conn in (self.gcs_conn, self.raylet_conn):
+                if conn:
+                    conn.close()
+            self._pool.close_all()
+        try:
+            self._run(_close(), timeout=5)
+        except Exception:
+            pass
+
+        def _drain_and_stop():
+            for task in asyncio.all_tasks(self._loop):
+                task.cancel()
+            self._loop.call_soon(self._loop.stop)
+
+        self._loop.call_soon_threadsafe(_drain_and_stop)
+        self._loop_thread.join(timeout=5)
+        self.store_client.close()
+        if global_worker_or_none() is self:
+            set_global_worker(None)
+
+    # ------------------------------------------------------------------
+    # context helpers
+    # ------------------------------------------------------------------
+    def _current_task_id(self) -> TaskID:
+        if self._ctx.task_id is None:
+            # worker thread outside a task (e.g. actor background thread):
+            # bind to the driver-style root context lazily
+            self._ctx.task_id = TaskID.for_normal_task(self.job_id
+                                                       or JobID.from_int(0))
+            self._ctx.put_counter = _Counter()
+        return self._ctx.task_id
+
+    def _next_put_id(self) -> ObjectID:
+        if self._ctx.put_counter is None:
+            self._current_task_id()
+        return ObjectID.for_put(self._ctx.task_id, self._ctx.put_counter.next())
+
+    def current_task_id(self) -> Optional[TaskID]:
+        return self._ctx.task_id
+
+    def current_actor_id(self) -> Optional[ActorID]:
+        return self._actor_id
+
+    # ------------------------------------------------------------------
+    # object publication (owner side)
+    # ------------------------------------------------------------------
+    def _publish(self, object_id: ObjectID, data: bytes) -> None:
+        self.memory_store.put(object_id, data)
+        self._loop.call_soon_threadsafe(self._wake_object_waiters, object_id)
+
+    def _wake_object_waiters(self, object_id: ObjectID) -> None:
+        event = self._object_events.pop(object_id, None)
+        if event is not None:
+            event.set()
+
+    async def _wait_local_object(self, object_id: ObjectID,
+                                 deadline: Optional[float]) -> Optional[bytes]:
+        while True:
+            data = self.memory_store.get(object_id)
+            if data is not None:
+                return data
+            event = self._object_events.get(object_id)
+            if event is None:
+                event = asyncio.Event()
+                self._object_events[object_id] = event
+            timeout = None if deadline is None else deadline - time.monotonic()
+            if timeout is not None and timeout <= 0:
+                return None
+            try:
+                await asyncio.wait_for(event.wait(), timeout)
+            except asyncio.TimeoutError:
+                return None
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        object_id = self._next_put_id()
+        ser = serialize(value)
+        self.reference_counter.add_owned(object_id)
+        ref = ObjectRef(object_id, self.address)
+        if ser.total_size() <= self.config.max_direct_call_object_size:
+            self._publish(object_id, ser.to_bytes())
+        else:
+            self._run(self._put_plasma(object_id, ser))
+            self._publish(object_id, PLASMA_MARKER)
+        return ref
+
+    async def _put_plasma(self, object_id: ObjectID,
+                          ser: SerializedObject) -> None:
+        size = ser.total_size()
+        reply = await self.raylet_conn.call(
+            "object_create", {"object_id": object_id.binary(), "size": size})
+        view = self.store_client.view(reply["offset"], size)
+        ser.write_to(view)
+        await self.raylet_conn.call("object_seal", {
+            "object_id": object_id.binary(),
+            "owner_address": self.address,
+        })
+        self.reference_counter.add_location(
+            object_id, tuple(self.raylet_address))
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
+            ) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        fut = asyncio.run_coroutine_threadsafe(
+            self._get_async(list(refs), deadline), self._loop)
+        values = fut.result()
+        # raise the first exception encountered, like the reference
+        for v in values:
+            if isinstance(v, _PendingMarker):
+                raise GetTimeoutError(f"get() timed out after {timeout}s")
+        for v in values:
+            if isinstance(v, TaskError):
+                if isinstance(v.cause, BaseException):
+                    raise v.cause from v
+                raise v
+        return values
+
+    def get_async(self, ref: ObjectRef) -> concurrent.futures.Future:
+        async def _one():
+            values = await self._get_async([ref], None)
+            v = values[0]
+            if isinstance(v, TaskError):
+                if isinstance(v.cause, BaseException):
+                    raise v.cause
+                raise v
+            return v
+        return asyncio.run_coroutine_threadsafe(_one(), self._loop)
+
+    async def _get_async(self, refs: List[ObjectRef],
+                         deadline: Optional[float]) -> List[Any]:
+        return list(await asyncio.gather(
+            *[self._get_one(ref, deadline) for ref in refs]))
+
+    async def _get_one(self, ref: ObjectRef, deadline: Optional[float],
+                       _reconstruction_depth: int = 0) -> Any:
+        object_id = ref.id()
+        owner = ref.owner_address()
+        is_owner = owner is None or owner[3] == self.worker_id.hex()
+        if is_owner:
+            data = await self._wait_local_object(object_id, deadline)
+            if data is None:
+                return _PendingMarker()
+        else:
+            data = self.memory_store.get(object_id)  # borrower-side cache
+            if data is None:
+                data = await self._fetch_from_owner(object_id, owner, deadline)
+                if data is None:
+                    return _PendingMarker()
+        if data == PLASMA_MARKER:
+            return await self._get_plasma(ref, deadline, _reconstruction_depth)
+        value, is_exc = deserialize(data)
+        return value if not is_exc else value  # TaskError instance either way
+
+    async def _fetch_from_owner(self, object_id: ObjectID,
+                                owner: OwnerAddress,
+                                deadline: Optional[float]) -> Optional[bytes]:
+        try:
+            conn = await self._pool.get((owner[1], owner[2]))
+            timeout = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            reply = await conn.call(
+                "get_small_object",
+                {"object_id": object_id.binary(), "timeout": timeout},
+                timeout=None if timeout is None else timeout + 5.0)
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError) as e:
+            raise ObjectLostError(object_id.hex(),
+                                  f"owner unreachable: {e}") from None
+        if reply is None:
+            return None
+        if reply.get("plasma"):
+            self.memory_store.put(object_id, PLASMA_MARKER)
+            return PLASMA_MARKER
+        data = reply["data"]
+        self.memory_store.put(object_id, data)  # borrower cache
+        return data
+
+    async def _get_plasma(self, ref: ObjectRef, deadline: Optional[float],
+                          depth: int = 0) -> Any:
+        object_id = ref.id()
+        owner = ref.owner_address() or self.address
+        timeout = None if deadline is None else max(
+            0.0, deadline - time.monotonic())
+        reply = await self.raylet_conn.call("object_get", {
+            "object_ids": [object_id.binary()],
+            "owners": {object_id.binary(): owner},
+            "timeout": timeout,
+        }, timeout=None)
+        lease = reply.get(object_id.binary())
+        if lease is None:
+            # lost object: attempt lineage reconstruction, owner-side only
+            if depth < self.config.max_lineage_reconstruction_depth and \
+                    await self._try_reconstruct(object_id):
+                return await self._get_one(ref, deadline, depth + 1)
+            if timeout is not None:
+                return _PendingMarker()
+            raise ObjectLostError(object_id.hex(),
+                                  "no copies found and reconstruction failed")
+        view = self.store_client.view(lease["offset"], lease["size"])
+        pin = _Pin(release=lambda b=object_id.binary():
+                   self._post(self._release_plasma(b)))
+        value, _ = _deserialize_pinned(view, pin)
+        if pin.count == 0:
+            # no out-of-band buffers alias the mapping; release immediately
+            await self._release_plasma(object_id.binary())
+        return value
+
+    async def _release_plasma(self, object_id_bin: bytes) -> None:
+        try:
+            await self.raylet_conn.call(
+                "object_release", {"object_ids": [object_id_bin]})
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
+
+    async def _try_reconstruct(self, object_id: ObjectID) -> bool:
+        """Lineage reconstruction: resubmit the producing task
+        (parity: ObjectRecoveryManager)."""
+        producing = object_id.task_id()
+        if object_id.is_put():
+            return False  # put objects have no lineage
+        ref_info = self.reference_counter.get(object_id)
+        if ref_info is None or not ref_info.owned:
+            return False
+        if self.task_manager.is_pending(producing):
+            await self._wait_task_done(producing)
+            return True
+        spec = self.task_manager.resubmit_for_reconstruction(producing)
+        if spec is None:
+            return False
+        logger.info("reconstructing %s via %s", object_id.hex()[:16],
+                    spec.debug_name())
+        for ret in spec.return_ids():
+            self.memory_store.delete(ret)
+        self._submit_to_lease_queue(spec)
+        await self._wait_task_done(producing)
+        return True
+
+    async def _wait_task_done(self, task_id: TaskID) -> None:
+        while self.task_manager.is_pending(task_id):
+            event = self._task_done_events.get(task_id)
+            if event is None:
+                event = asyncio.Event()
+                self._task_done_events[task_id] = event
+            await event.wait()
+
+    def _signal_task_done(self, task_id: TaskID) -> None:
+        event = self._task_done_events.pop(task_id, None)
+        if event is not None:
+            event.set()
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def _wait():
+            pending = {self._loop.create_task(
+                self._probe_ready(ref, deadline)): ref for ref in refs}
+            ready: List[ObjectRef] = []
+            not_ready = list(refs)
+            while pending and len(ready) < num_returns:
+                remaining = None if deadline is None else max(
+                    0.0, deadline - time.monotonic())
+                done, _ = await asyncio.wait(
+                    pending, timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break
+                for task in done:
+                    ref = pending.pop(task)
+                    if task.result():
+                        ready.append(ref)
+                        not_ready.remove(ref)
+            for task in pending:
+                task.cancel()
+            # preserve input order for ready as the reference does
+            ready_set = set(ready)
+            return ([r for r in refs if r in ready_set],
+                    [r for r in refs if r not in ready_set])
+
+        return self._run(_wait())
+
+    async def _probe_ready(self, ref: ObjectRef,
+                           deadline: Optional[float]) -> bool:
+        object_id = ref.id()
+        owner = ref.owner_address()
+        is_owner = owner is None or owner[3] == self.worker_id.hex()
+        if is_owner:
+            data = await self._wait_local_object(object_id, deadline)
+            return data is not None
+        data = self.memory_store.get(object_id)
+        if data is not None:
+            return True
+        try:
+            data = await self._fetch_from_owner(object_id, owner, deadline)
+        except ObjectLostError:
+            return False
+        return data is not None
+
+    def free(self, refs: Sequence[ObjectRef]) -> None:
+        for ref in refs:
+            info = self.reference_counter.get(ref.id())
+            if info is not None and info.owned:
+                self.memory_store.delete(ref.id())
+                self._on_object_freed(ref.id(), info)
+
+    # ------------------------------------------------------------------
+    # refcount callbacks (may fire on any thread, incl. GC)
+    # ------------------------------------------------------------------
+    def _on_object_freed(self, object_id: ObjectID, ref_info) -> None:
+        self.memory_store.delete(object_id)
+        if ref_info.in_plasma and not self._shutdown:
+            locations = set(ref_info.locations)
+            async def _free():
+                for node_addr in locations:
+                    try:
+                        conn = await self._pool.get(tuple(node_addr))
+                        await conn.call("object_free",
+                                        {"object_ids": [object_id.binary()]})
+                    except Exception:
+                        pass
+            try:
+                self._post(_free())
+            except Exception:
+                pass
+        task_id = object_id.task_id()
+        if not object_id.is_put():
+            self.task_manager.evict_lineage(task_id)
+
+    def _on_borrow_added(self, object_id: ObjectID,
+                         owner: Optional[tuple]) -> None:
+        if owner is None or self._shutdown or owner[3] == self.worker_id.hex():
+            return
+        async def _notify():
+            try:
+                conn = await self._pool.get((owner[1], owner[2]))
+                await conn.call("add_borrow", {
+                    "object_id": object_id.binary(),
+                    "borrower": self.address})
+            except Exception:
+                pass
+        try:
+            self._post(_notify())
+        except Exception:
+            pass
+
+    def _on_borrow_removed(self, object_id: ObjectID,
+                           owner: Optional[tuple]) -> None:
+        if owner is None or self._shutdown or owner[3] == self.worker_id.hex():
+            return
+        self.memory_store.delete(object_id)
+        async def _notify():
+            try:
+                conn = await self._pool.get((owner[1], owner[2]))
+                await conn.call("remove_borrow", {
+                    "object_id": object_id.binary(),
+                    "borrower": self.address})
+            except Exception:
+                pass
+        try:
+            self._post(_notify())
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # owner-side RPC service (on the task server)
+    # ------------------------------------------------------------------
+    async def handle_get_small_object(self, conn, data):
+        object_id = ObjectID(data["object_id"])
+        timeout = data.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        blob = await self._wait_local_object(object_id, deadline)
+        if blob is None:
+            return None
+        if blob == PLASMA_MARKER:
+            return {"plasma": True}
+        return {"data": blob}
+
+    async def handle_get_object_locations(self, conn, data):
+        object_id = ObjectID(data["object_id"])
+        info = self.reference_counter.get(object_id)
+        if info is None:
+            # unknown object: may be an in-flight return; report pending if
+            # its producing task is still running
+            if self.task_manager.is_pending(object_id.task_id()):
+                return {"nodes": [], "pending": True}
+            return None
+        locations, spilled = self.reference_counter.get_locations(object_id)
+        pending = self.task_manager.is_pending(object_id.task_id())
+        return {"nodes": [list(a) for a in locations],
+                "spilled_on": list(spilled) if spilled else None,
+                "pending": pending}
+
+    async def handle_add_borrow(self, conn, data):
+        self.reference_counter.add_borrower(
+            ObjectID(data["object_id"]), tuple(data["borrower"]))
+        return True
+
+    async def handle_remove_borrow(self, conn, data):
+        self.reference_counter.remove_borrower(
+            ObjectID(data["object_id"]), tuple(data["borrower"]))
+        return True
+
+    async def handle_ping(self, conn, data):
+        return {"worker_id": self.worker_id.hex(), "mode": self.mode,
+                "actor_id": self._actor_id.hex() if self._actor_id else None}
+
+    # ------------------------------------------------------------------
+    # task submission (normal tasks)
+    # ------------------------------------------------------------------
+    def register_function(self, blob: bytes) -> str:
+        function_id = hashlib.sha256(blob).hexdigest()[:32]
+        if function_id not in self._function_cache:
+            self._run(self.gcs_conn.call("register_function", {
+                "function_id": function_id, "blob": blob}))
+        return function_id
+
+    def submit_task(self, function_id: str, descriptor: str, args: tuple,
+                    kwargs: dict, *, num_returns: int = 1,
+                    resources: Optional[Dict[str, float]] = None,
+                    max_retries: Optional[int] = None,
+                    retry_exceptions: bool = False,
+                    scheduling_strategy: Optional[SchedulingStrategy] = None,
+                    ) -> List[ObjectRef]:
+        task_id = TaskID.for_normal_task(self.job_id)
+        task_args, holds = self._build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            function_id=function_id,
+            function_descriptor=descriptor,
+            args=task_args,
+            num_returns=num_returns,
+            resources=dict(resources or {"CPU": 1.0}),
+            max_retries=(self.config.default_max_task_retries
+                         if max_retries is None else max_retries),
+            retry_exceptions=retry_exceptions,
+            scheduling_strategy=scheduling_strategy or SchedulingStrategy(),
+            owner_address=self.address,
+            depth=self._ctx.attempt_number,
+        )
+        self.task_manager.register(spec)
+        del holds  # submitted-refs now pin the promoted args
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        self._submit_to_lease_queue(spec)
+        return refs
+
+    def _build_args(self, args: tuple, kwargs: dict
+                    ) -> Tuple[List[TaskArg], List[ObjectRef]]:
+        """Serialize arguments; small values inline, ObjectRefs by
+        reference, large values promoted to the object store.
+
+        Returns (task_args, holds): ``holds`` keeps refs created here alive
+        until the task is registered (which adds submitted-refs) —
+        otherwise a promoted arg would be freed the instant this function
+        returns.
+        """
+        out: List[TaskArg] = []
+        holds: List[ObjectRef] = []
+        for value in list(args) + [kwargs or {}]:
+            if isinstance(value, ObjectRef):
+                out.append(TaskArg(object_id=value.id(),
+                                   owner_address=value.owner_address()))
+                continue
+            ser = serialize(value)
+            if ser.total_size() > self.config.max_direct_call_object_size:
+                ref = self.put(value)
+                holds.append(ref)
+                out.append(TaskArg(object_id=ref.id(),
+                                   owner_address=ref.owner_address()))
+            else:
+                out.append(TaskArg(value_bytes=ser.to_bytes()))
+        return out, holds
+
+    def _submit_to_lease_queue(self, spec: TaskSpec) -> None:
+        self._record_task_event(spec, "PENDING")
+        self._loop.call_soon_threadsafe(self._enqueue_for_lease, spec)
+
+    def _enqueue_for_lease(self, spec: TaskSpec) -> None:
+        key = spec.scheduling_key()
+        state = self._lease_states.get(key)
+        if state is None:
+            state = _LeaseState(key)
+            self._lease_states[key] = state
+        state.backlog.append(spec)
+        self._pump_lease_queue(state)
+
+    def _pump_lease_queue(self, state: "_LeaseState") -> None:
+        # Phase 1 — breadth first: one task per idle worker, so independent
+        # tasks spread across workers/nodes instead of serializing into one
+        # worker's pipeline.
+        for worker in list(state.workers.values()):
+            if state.backlog and worker.inflight == 0:
+                self._dispatch_to_worker(state, worker)
+        # Phase 2 — grow the fleet while there is queued work (the raylet
+        # answers with local grants or spillback to other nodes).
+        if state.backlog and not state.requesting:
+            state.requesting = True
+            task = self._loop.create_task(self._request_lease(state))
+            task.add_done_callback(lambda t: t.exception())
+        # Phase 3 — pipeline small tasks onto busy workers up to the
+        # in-flight cap (throughput for sub-millisecond tasks).
+        for worker in list(state.workers.values()):
+            while state.backlog and \
+                    worker.inflight < self.config.max_tasks_in_flight_per_worker:
+                self._dispatch_to_worker(state, worker)
+
+    def _dispatch_to_worker(self, state: "_LeaseState",
+                            worker: "_LeasedWorker") -> None:
+        spec = state.backlog.popleft()
+        worker.inflight += 1
+        task = self._loop.create_task(self._push_task(state, worker, spec))
+        task.add_done_callback(lambda t: t.exception())
+        # return idle leases
+        for worker in list(state.workers.values()):
+            if worker.inflight == 0 and not state.backlog and \
+                    worker.return_handle is None:
+                worker.return_handle = self._loop.call_later(
+                    self.config.idle_worker_lease_timeout_s,
+                    lambda w=worker, s=state: self._loop.create_task(
+                        self._return_lease(s, w)))
+
+    async def _request_lease(self, state: "_LeaseState",
+                             raylet_address: Optional[rpc.Address] = None
+                             ) -> None:
+        try:
+            spec = state.backlog[0] if state.backlog else None
+            if spec is None:
+                state.requesting = False
+                return
+            address = raylet_address or self.raylet_address
+            conn = self.raylet_conn if address == self.raylet_address \
+                else await self._pool.get(address)
+            strat = spec.scheduling_strategy
+            reply = await conn.call("request_worker_lease", {
+                "resources": spec.resources,
+                "job_id": self.job_id.binary() if self.job_id else None,
+                "strategy": strat.kind,
+                "placement_group_id":
+                    strat.placement_group_id.binary()
+                    if strat.placement_group_id else None,
+                "bundle_index": strat.bundle_index,
+                "backlog": len(state.backlog),
+            }, timeout=None)
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            state.requesting = False
+            self._fail_backlog(state, WorkerCrashedError(
+                f"lease request failed: {e}"))
+            return
+        if reply.get("spillback"):
+            await self._request_lease(state, tuple(reply["spillback"]))
+            return
+        state.requesting = False
+        if reply.get("error"):
+            self._fail_backlog(state, RayTpuError(reply["error"]))
+            return
+        if reply.get("granted"):
+            worker = _LeasedWorker(
+                worker_id=WorkerID(reply["worker_id"]),
+                address=tuple(reply["worker_address"]),
+                raylet=raylet_address or self.raylet_address,
+            )
+            state.workers[worker.worker_id] = worker
+            self._pump_lease_queue(state)
+
+    def _fail_backlog(self, state: "_LeaseState", error: Exception) -> None:
+        while state.backlog:
+            spec = state.backlog.popleft()
+            self._fail_task(spec, error)
+
+    async def _push_task(self, state: "_LeaseState", worker: "_LeasedWorker",
+                         spec: TaskSpec) -> None:
+        if worker.return_handle is not None:
+            worker.return_handle.cancel()
+            worker.return_handle = None
+        try:
+            conn = await self._pool.get(worker.address)
+            self._record_task_event(spec, "RUNNING")
+            reply = await conn.call(
+                "push_task", {"spec_blob": cloudpickle.dumps(spec)},
+                timeout=None)
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            worker.inflight -= 1
+            state.workers.pop(worker.worker_id, None)
+            self._pool.invalidate(worker.address)
+            self._retry_or_fail(spec, WorkerCrashedError(
+                f"worker died while running {spec.debug_name()}: {e}"))
+            self._pump_lease_queue(state)
+            return
+        worker.inflight -= 1
+        self._handle_task_reply(spec, reply)
+        self._pump_lease_queue(state)
+
+    async def _return_lease(self, state: "_LeaseState",
+                            worker: "_LeasedWorker") -> None:
+        if worker.inflight > 0 or state.backlog:
+            worker.return_handle = None
+            return
+        state.workers.pop(worker.worker_id, None)
+        try:
+            conn = self.raylet_conn if worker.raylet == self.raylet_address \
+                else await self._pool.get(worker.raylet)
+            await conn.call("return_worker", {
+                "worker_id": worker.worker_id.binary(),
+                "job_id": self.job_id.binary() if self.job_id else None,
+            })
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
+
+    def _handle_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
+        if reply.get("system_error"):
+            self._retry_or_fail(spec, WorkerCrashedError(reply["system_error"]))
+            return
+        retryable_app_error = reply.get("app_error") and spec.retry_exceptions
+        if retryable_app_error:
+            retry_spec = self.task_manager.take_for_retry(spec.task_id)
+            if retry_spec is not None:
+                self._loop.call_soon_threadsafe(
+                    self._enqueue_for_lease, retry_spec)
+                return
+        self._complete_task(spec, reply["results"])
+
+    def _retry_or_fail(self, spec: TaskSpec, error: Exception) -> None:
+        retry_spec = self.task_manager.take_for_retry(spec.task_id)
+        if retry_spec is not None:
+            logger.info("retrying %s (attempt %d)", spec.debug_name(),
+                        retry_spec.attempt_number)
+            self._loop.call_soon_threadsafe(self._enqueue_for_lease, retry_spec)
+        else:
+            self._fail_task(spec, error)
+
+    def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
+        self.task_manager.fail(spec.task_id)
+        blob = serialize_exception(
+            error if isinstance(error, TaskError)
+            else TaskError.from_exception(error, spec.debug_name())
+        ).to_bytes()
+        for ret in spec.return_ids():
+            self._publish(ret, blob)
+        self._record_task_event(spec, "FAILED")
+        self._loop.call_soon_threadsafe(self._signal_task_done, spec.task_id)
+
+    def _complete_task(self, spec: TaskSpec, results: List[Tuple]) -> None:
+        """Store task results as owner (parity: TaskManager::CompletePendingTask)."""
+        self.task_manager.complete(spec.task_id)
+        for object_id_bin, kind, payload in results:
+            object_id = ObjectID(object_id_bin)
+            if kind == "inline":
+                self._publish(object_id, payload)
+            else:  # ("plasma", node raylet address)
+                self.reference_counter.add_location(object_id, tuple(payload))
+                self._publish(object_id, PLASMA_MARKER)
+        self._record_task_event(spec, "FINISHED")
+        self._loop.call_soon_threadsafe(self._signal_task_done, spec.task_id)
+
+    # ------------------------------------------------------------------
+    # actors: creation + submission
+    # ------------------------------------------------------------------
+    def create_actor(self, class_id: str, class_descriptor: str, args: tuple,
+                     kwargs: dict, *, resources: Dict[str, float],
+                     creation_spec: ActorCreationSpec,
+                     scheduling_strategy: Optional[SchedulingStrategy] = None,
+                     get_if_exists: bool = False) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.for_actor_task(actor_id)
+        task_args, holds = self._build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function_id=class_id,
+            function_descriptor=class_descriptor,
+            args=task_args,
+            resources=dict(resources),
+            owner_address=self.address,
+            actor_id=actor_id,
+            actor_creation_spec=creation_spec,
+            scheduling_strategy=scheduling_strategy or SchedulingStrategy(),
+        )
+        strat = spec.scheduling_strategy
+        reply = self._run(self.gcs_conn.call("register_actor", {
+            "actor_id": actor_id.binary(),
+            "spec_blob": cloudpickle.dumps(spec),
+            "resources": resources,
+            "name": creation_spec.name,
+            "namespace": creation_spec.namespace,
+            "detached": creation_spec.lifetime_detached,
+            "max_restarts": creation_spec.max_restarts,
+            "job_id": self.job_id.binary(),
+            "class_name": class_descriptor,
+            "get_if_exists": get_if_exists,
+            "placement_group_id":
+                strat.placement_group_id.binary()
+                if strat.placement_group_id else None,
+            "bundle_index": strat.bundle_index,
+        }))
+        # pin creation args for the actor's lifetime (restarts re-run the
+        # creation task and need them)
+        self._actor_creation_holds = getattr(self, "_actor_creation_holds", [])
+        self._actor_creation_holds.extend(holds)
+        return ActorID(reply["actor_id"])
+
+    def _actor_state(self, actor_id: ActorID) -> "_ActorSubmitState":
+        state = self._actor_states.get(actor_id)
+        if state is None:
+            state = _ActorSubmitState(actor_id)
+            self._actor_states[actor_id] = state
+        return state
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args: tuple, kwargs: dict, *, num_returns: int = 1,
+                          max_task_retries: int = 0) -> List[ObjectRef]:
+        task_id = TaskID.for_actor_task(actor_id)
+        task_args, holds = self._build_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id or actor_id.job_id(),
+            task_type=TaskType.ACTOR_TASK,
+            function_id="",
+            function_descriptor=method_name,
+            args=task_args,
+            num_returns=num_returns,
+            max_retries=max_task_retries,
+            owner_address=self.address,
+            actor_id=actor_id,
+        )
+        self.task_manager.register(spec)
+        del holds  # submitted-refs now pin the promoted args
+        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        self._record_task_event(spec, "PENDING")
+        self._loop.call_soon_threadsafe(self._enqueue_actor_task, spec)
+        return refs
+
+    def _enqueue_actor_task(self, spec: TaskSpec) -> None:
+        state = self._actor_state(spec.actor_id)
+        spec.sequence_number = state.next_seq
+        state.next_seq += 1
+        state.pending[spec.sequence_number] = spec
+        task = self._loop.create_task(self._drive_actor_task(state, spec))
+        task.add_done_callback(lambda t: t.exception())
+
+    async def _drive_actor_task(self, state: "_ActorSubmitState",
+                                spec: TaskSpec) -> None:
+        try:
+            address = await self._resolve_actor_address(state)
+        except ActorDiedError as e:
+            state.pending.pop(spec.sequence_number, None)
+            self._fail_task(spec, e)
+            return
+        try:
+            conn = await self._pool.get(address)
+            self._record_task_event(spec, "RUNNING")
+            reply = await conn.call(
+                "push_actor_task", {"spec_blob": cloudpickle.dumps(spec)},
+                timeout=None)
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            self._pool.invalidate(address)
+            state.address = None
+            # the actor may be restarting; re-resolve and retry if allowed
+            if spec.max_retries > 0:
+                retry_spec = self.task_manager.take_for_retry(spec.task_id)
+                if retry_spec is not None:
+                    await asyncio.sleep(0.1)
+                    await self._drive_actor_task(state, retry_spec)
+                    return
+            state.pending.pop(spec.sequence_number, None)
+            self._fail_task(spec, ActorDiedError(
+                spec.actor_id.hex()[:12], f"connection lost: {e}"))
+            return
+        state.pending.pop(spec.sequence_number, None)
+        if reply.get("actor_dead"):
+            self._fail_task(spec, ActorDiedError(
+                spec.actor_id.hex()[:12], reply.get("reason", "")))
+            return
+        self._handle_task_reply(spec, reply)
+
+    async def _resolve_actor_address(self, state: "_ActorSubmitState"
+                                     ) -> rpc.Address:
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if state.address is not None:
+                return state.address
+            reply = await self.gcs_conn.call(
+                "get_actor", {"actor_id": state.actor_id.binary()})
+            if reply is None:
+                raise ActorDiedError(state.actor_id.hex()[:12],
+                                     "actor not found")
+            if reply["state"] == "ALIVE" and reply["address"]:
+                state.address = tuple(reply["address"])
+                return state.address
+            if reply["state"] == "DEAD":
+                raise ActorDiedError(state.actor_id.hex()[:12],
+                                     reply.get("death_cause", "dead"))
+            await asyncio.sleep(0.1)
+        raise ActorDiedError(state.actor_id.hex()[:12],
+                             "timed out resolving actor address")
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._run(self.gcs_conn.call("kill_actor",
+                                     {"actor_id": actor_id.binary()}))
+        state = self._actor_states.get(actor_id)
+        if state is not None:
+            state.address = None
+
+    def get_actor_info(self, *, actor_id: Optional[ActorID] = None,
+                       name: Optional[str] = None,
+                       namespace: str = "default") -> Optional[Dict[str, Any]]:
+        if name is not None:
+            return self._run(self.gcs_conn.call(
+                "get_actor", {"name": name, "namespace": namespace}))
+        return self._run(self.gcs_conn.call(
+            "get_actor", {"actor_id": actor_id.binary()}))
+
+    # ------------------------------------------------------------------
+    # GCS conveniences
+    # ------------------------------------------------------------------
+    def kv_put(self, key: str, value: bytes, namespace: str = "") -> None:
+        self._run(self.gcs_conn.call("kv_put", {
+            "key": key, "value": value, "namespace": namespace}))
+
+    def kv_get(self, key: str, namespace: str = "") -> Optional[bytes]:
+        return self._run(self.gcs_conn.call("kv_get", {
+            "key": key, "namespace": namespace}))
+
+    def kv_del(self, key: str, namespace: str = "") -> bool:
+        return self._run(self.gcs_conn.call("kv_del", {
+            "key": key, "namespace": namespace}))
+
+    def kv_keys(self, prefix: str = "", namespace: str = "") -> List[str]:
+        return self._run(self.gcs_conn.call("kv_keys", {
+            "prefix": prefix, "namespace": namespace}))
+
+    def get_nodes(self) -> List[Dict[str, Any]]:
+        return self._run(self.gcs_conn.call("get_nodes", {}))
+
+    def cluster_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for node in self.get_nodes():
+            if node["alive"]:
+                for k, v in node["resources_total"].items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
+
+    def available_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for node in self.get_nodes():
+            if node["alive"]:
+                for k, v in node["resources_available"].items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
+
+    def _on_gcs_push(self, channel: str, message: Any) -> None:
+        if channel.startswith("actor:"):
+            actor_id = ActorID.from_hex(channel.split(":", 1)[1])
+            state = self._actor_states.get(actor_id)
+            if state is not None:
+                if message["state"] == "ALIVE" and message["address"]:
+                    state.address = tuple(message["address"])
+                else:
+                    state.address = None
+
+    # ------------------------------------------------------------------
+    # task events (state API feed)
+    # ------------------------------------------------------------------
+    def _record_task_event(self, spec: TaskSpec, state: str) -> None:
+        self._task_events.append({
+            "task_id": spec.task_id.hex(),
+            "name": spec.function_descriptor,
+            "state": state,
+            "type": spec.task_type.name,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "time": time.time(),
+            "attempt": spec.attempt_number,
+            "worker_id": self.worker_id.hex(),
+        })
+
+    async def _task_event_flush_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            if self._task_events and self.gcs_conn and not self.gcs_conn.closed:
+                batch, self._task_events = self._task_events, []
+                try:
+                    await self.gcs_conn.call("report_task_events",
+                                             {"events": batch})
+                except (rpc.ConnectionLost, rpc.RpcError):
+                    pass
+
+    # ------------------------------------------------------------------
+    # task execution (worker mode)
+    # ------------------------------------------------------------------
+    def run_exec_loop(self) -> None:
+        """Main loop of a worker process: execute queued tasks until
+        shutdown (parity: worker.main_loop / RunTaskExecutionLoop)."""
+        self._consume_exec_queue()
+
+    def _consume_exec_queue(self) -> None:
+        while not self._shutdown:
+            item = self._exec_queue.get()
+            if item is None:
+                break
+            spec, reply_fut = item
+            reply = self._execute_task(spec)
+            self._loop.call_soon_threadsafe(_set_future, reply_fut, reply)
+
+    def _start_extra_exec_threads(self, n: int) -> None:
+        for _ in range(n):
+            t = threading.Thread(target=self._consume_exec_queue,
+                                 name="rtpu-exec", daemon=True)
+            t.start()
+            self._exec_threads.append(t)
+
+    async def handle_push_task(self, conn, data):
+        spec: TaskSpec = cloudpickle.loads(data["spec_blob"])
+        reply_fut = self._loop.create_future()
+        # enqueue synchronously (before any await) to preserve arrival order
+        self._exec_queue.put((spec, reply_fut))
+        return await reply_fut
+
+    async def handle_push_actor_task(self, conn, data):
+        if self._actor_instance is None:
+            return {"actor_dead": True, "reason": "no actor in this worker"}
+        spec: TaskSpec = cloudpickle.loads(data["spec_blob"])
+        caller = spec.owner_address[3] if spec.owner_address else ""
+        cache_key = (caller, spec.sequence_number, spec.task_id.binary())
+        cached = self._actor_reply_cache.get(cache_key)
+        if cached is not None:  # duplicate delivery after a retry
+            return cached
+        reply_fut = self._loop.create_future()
+        self._exec_queue.put((spec, reply_fut))
+        reply = await reply_fut
+        self._actor_reply_cache[cache_key] = reply
+        if len(self._actor_reply_cache) > 1024:
+            self._actor_reply_cache.pop(next(iter(self._actor_reply_cache)))
+        return reply
+
+    async def handle_create_actor(self, conn, data):
+        spec: TaskSpec = cloudpickle.loads(data["spec_blob"])
+        reply_fut = self._loop.create_future()
+        self._exec_queue.put((spec, reply_fut))
+        reply = await reply_fut
+        if reply.get("app_error") or reply.get("system_error"):
+            return {"ok": False,
+                    "error": reply.get("system_error", "constructor raised")}
+        creation = spec.actor_creation_spec or ActorCreationSpec()
+        self._actor_id = spec.actor_id
+        self._actor_creation_spec = creation
+        self._max_concurrency = max(1, creation.max_concurrency)
+        if self._max_concurrency > 1:
+            self._start_extra_exec_threads(self._max_concurrency - 1)
+        # register on our own GCS connection so the GCS can detect death
+        # of this actor when the connection drops
+        try:
+            await self.gcs_conn.call("actor_started", {
+                "actor_id": spec.actor_id.binary(),
+                "task_address": self.task_address,
+            })
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
+        return {"ok": True}
+
+    def _execute_task(self, spec: TaskSpec) -> Dict[str, Any]:
+        """Run one task on this thread; returns the wire reply."""
+        prev = (self._ctx.task_id, self._ctx.put_counter,
+                self._ctx.attempt_number)
+        self._ctx.task_id = spec.task_id
+        self._ctx.put_counter = _Counter()
+        self._ctx.attempt_number = spec.attempt_number
+        if self.job_id is None:
+            self.job_id = spec.job_id
+        try:
+            args, kwargs = self._resolve_args(spec)
+            fn = self._resolve_callable(spec)
+            value = fn(*args, **kwargs)
+            if asyncio.iscoroutine(value):
+                value = asyncio.run(value)
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                results = [(rid.binary(), "inline", serialize(None).to_bytes())
+                           for rid in spec.return_ids()]
+                return {"results": results}
+            if spec.num_returns == 1:
+                values = [value]
+            else:
+                values = list(value)
+                if len(values) != spec.num_returns:
+                    raise ValueError(
+                        f"task returned {len(values)} values, expected "
+                        f"{spec.num_returns}")
+            results = []
+            for rid, v in zip(spec.return_ids(), values):
+                results.append(self._post_return(rid, v, spec))
+            return {"results": results}
+        except BaseException as e:  # noqa: BLE001 — errors travel to caller
+            logger.debug("task %s raised", spec.debug_name(), exc_info=True)
+            blob = serialize_exception(
+                TaskError.from_exception(e, spec.debug_name())).to_bytes()
+            results = [(rid.binary(), "inline", blob)
+                       for rid in spec.return_ids()]
+            return {"results": results, "app_error": True}
+        finally:
+            (self._ctx.task_id, self._ctx.put_counter,
+             self._ctx.attempt_number) = prev
+
+    def _post_return(self, object_id: ObjectID, value: Any,
+                     spec: TaskSpec) -> Tuple[bytes, str, Any]:
+        ser = serialize(value)
+        if ser.total_size() <= self.config.max_direct_call_object_size:
+            return (object_id.binary(), "inline", ser.to_bytes())
+        # large return: store in this node's shm; owner learns the location
+        async def _store():
+            size = ser.total_size()
+            reply = await self.raylet_conn.call(
+                "object_create",
+                {"object_id": object_id.binary(), "size": size})
+            view = self.store_client.view(reply["offset"], size)
+            ser.write_to(view)
+            await self.raylet_conn.call("object_seal", {
+                "object_id": object_id.binary(),
+                "owner_address": spec.owner_address,
+            })
+        self._run(_store())
+        return (object_id.binary(), "plasma", tuple(self.raylet_address))
+
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        resolved: List[Any] = []
+        for arg in spec.args:
+            if arg.is_inline():
+                value, is_exc = deserialize(arg.value_bytes)
+                if is_exc:
+                    raise value.cause or value
+                resolved.append(value)
+            else:
+                ref = ObjectRef._restore(arg.object_id.binary(),
+                                         arg.owner_address)
+                resolved.append(self.get([ref])[0])
+        kwargs = resolved.pop() if resolved else {}
+        return resolved, kwargs
+
+    def _resolve_callable(self, spec: TaskSpec) -> Callable:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            method = getattr(self._actor_instance, spec.function_descriptor,
+                             None)
+            if method is None:
+                raise AttributeError(
+                    f"actor has no method {spec.function_descriptor!r}")
+            return method
+        fn_or_class = self._get_function(spec.function_id)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            def _construct(*args, **kwargs):
+                self._actor_instance = fn_or_class(*args, **kwargs)
+                return None
+            return _construct
+        return fn_or_class
+
+    def _get_function(self, function_id: str) -> Callable:
+        fn = self._function_cache.get(function_id)
+        if fn is None:
+            blob = self._run(self.gcs_conn.call(
+                "get_function", {"function_id": function_id}))
+            if blob is None:
+                raise RayTpuError(f"function {function_id} not registered")
+            fn = cloudpickle.loads(blob)
+            self._function_cache[function_id] = fn
+        return fn
+
+    def push_kill_actor(self, conn, data) -> None:
+        """Forced actor kill (GCS or owner initiated)."""
+        logger.info("actor %s killed", data.get("actor_id", b"").hex()[:12])
+        os._exit(1)
+
+    def push_exit(self, conn, data) -> None:
+        """Graceful exit request from the raylet (idle worker culling)."""
+        self._shutdown = True
+        self._exec_queue.put(None)
+
+
+def _set_future(fut: asyncio.Future, value: Any) -> None:
+    if not fut.done():
+        fut.set_result(value)
+
+
+class _PendingMarker:
+    pass
+
+
+class _LeasedWorker:
+    __slots__ = ("worker_id", "address", "raylet", "inflight", "return_handle")
+
+    def __init__(self, worker_id: WorkerID, address: rpc.Address,
+                 raylet: rpc.Address):
+        self.worker_id = worker_id
+        self.address = address
+        self.raylet = raylet
+        self.inflight = 0
+        self.return_handle = None
+
+
+class _LeaseState:
+    __slots__ = ("key", "backlog", "workers", "requesting")
+
+    def __init__(self, key):
+        self.key = key
+        self.backlog: deque = deque()
+        self.workers: Dict[WorkerID, _LeasedWorker] = {}
+        self.requesting = False
+
+
+class _ActorSubmitState:
+    __slots__ = ("actor_id", "address", "next_seq", "pending")
+
+    def __init__(self, actor_id: ActorID):
+        self.actor_id = actor_id
+        self.address: Optional[rpc.Address] = None
+        self.next_seq = 0
+        self.pending: Dict[int, TaskSpec] = {}
+
+
+def _deserialize_pinned(view: memoryview, pin: _Pin):
+    """Deserialize with out-of-band buffers wrapped in _PinnedBuffer so the
+    store slot stays pinned while any consumer is alive."""
+    import pickle
+    import struct as struct_mod
+    from ray_tpu.core import serialization as ser_mod
+
+    magic = ser_mod._MAGIC
+    if bytes(view[: len(magic)]) != magic:
+        raise ValueError("corrupt serialized object (bad magic)")
+    offset = len(magic)
+    (meta_len,) = struct_mod.unpack_from("<I", view, offset)
+    offset += 4
+    meta = bytes(view[offset : offset + meta_len])
+    offset += meta_len
+    (n_buffers,) = struct_mod.unpack_from("<I", view, offset)
+    offset += 4
+    buffers = []
+    for _ in range(n_buffers):
+        (buf_len,) = struct_mod.unpack_from("<Q", view, offset)
+        offset = ser_mod._pad(offset + 8)
+        buffers.append(_PinnedBuffer(view[offset : offset + buf_len], pin))
+        offset += buf_len
+    is_exception = meta.endswith(ser_mod.META_EXCEPTION)
+    if is_exception:
+        meta = meta[: -len(ser_mod.META_EXCEPTION)]
+    value = ser_mod._unpickle(meta, buffers)
+    return value, is_exception

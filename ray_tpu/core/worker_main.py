@@ -1,0 +1,69 @@
+"""Entry point for spawned worker processes.
+
+Parity: the reference's python worker `default_worker.py` — connect to the
+local raylet + GCS, then run the task execution loop on the main thread.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet", required=True)
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--store-path", required=True)
+    parser.add_argument("--store-capacity", type=int, required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--job-id", default=None)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    if os.environ.get("RAY_TPU_WORKER_FAULTHANDLER"):
+        import faulthandler
+
+        faulthandler.enable()
+        faulthandler.dump_traceback_later(
+            float(os.environ["RAY_TPU_WORKER_FAULTHANDLER"]), repeat=True)
+
+    # Workers never own TPU chips unless a task leases them; keep jax (if
+    # user code imports it) off the real accelerator by default so that N
+    # workers on one host don't fight over the chip.  Training workers
+    # explicitly clear this (see ray_tpu.train).
+    os.environ.setdefault("JAX_PLATFORMS", os.environ.get(
+        "RAY_TPU_WORKER_JAX_PLATFORMS", "cpu"))
+
+    from ray_tpu.core.ids import JobID, NodeID
+    from ray_tpu.core.worker import CoreWorker
+
+    def parse_addr(s: str):
+        host, port = s.rsplit(":", 1)
+        return (host, int(port))
+
+    worker = CoreWorker(
+        mode="worker",
+        gcs_address=parse_addr(args.gcs),
+        raylet_address=parse_addr(args.raylet),
+        node_id=NodeID.from_hex(args.node_id),
+        store_path=args.store_path,
+        store_capacity=args.store_capacity,
+        session_dir=args.session_dir,
+        job_id=JobID.from_hex(args.job_id) if args.job_id else None,
+    )
+    try:
+        worker.run_exec_loop()
+    finally:
+        worker.shutdown()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

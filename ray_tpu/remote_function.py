@@ -1,0 +1,103 @@
+"""@remote functions.
+
+Parity: reference ``python/ray/remote_function.py`` — a decorated function
+becomes a :class:`RemoteFunction` whose ``.remote(...)`` submits a task and
+returns ObjectRef futures; ``.options(...)`` overrides per-invocation
+options.  The pickled function is exported to the GCS function table on
+first submission.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Union
+
+import cloudpickle
+
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.core import worker as worker_mod
+
+
+def _resolve_strategy(strategy) -> Optional[SchedulingStrategy]:
+    if strategy is None:
+        return None
+    if isinstance(strategy, SchedulingStrategy):
+        return strategy
+    if isinstance(strategy, str):
+        return SchedulingStrategy(kind=strategy)
+    # duck-typed PlacementGroupSchedulingStrategy / NodeAffinitySchedulingStrategy
+    if hasattr(strategy, "placement_group"):
+        pg = strategy.placement_group
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=pg.id,
+            bundle_index=getattr(strategy, "placement_group_bundle_index", -1),
+            capture_child_tasks=getattr(
+                strategy, "placement_group_capture_child_tasks", False),
+        )
+    if hasattr(strategy, "node_id"):
+        return SchedulingStrategy(kind="NODE_AFFINITY",
+                                  node_id_hex=strategy.node_id,
+                                  soft=getattr(strategy, "soft", False))
+    raise TypeError(f"unsupported scheduling strategy: {strategy!r}")
+
+
+class RemoteFunction:
+    def __init__(self, fn, **options):
+        self._fn = fn
+        self._options = options
+        self._descriptor = f"{fn.__module__}.{fn.__qualname__}"
+        self._function_id: Optional[str] = None
+        self._pickled: Optional[bytes] = None
+        self._export_lock = threading.Lock()
+        self.__name__ = getattr(fn, "__name__", "remote_function")
+        self.__doc__ = fn.__doc__
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._descriptor} cannot be called directly; "
+            f"use .remote()")
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(options)
+        clone = RemoteFunction(self._fn, **merged)
+        clone._function_id = self._function_id
+        clone._pickled = self._pickled
+        return clone
+
+    def _export(self, core) -> str:
+        with self._export_lock:
+            if self._function_id is None:
+                if self._pickled is None:
+                    self._pickled = cloudpickle.dumps(self._fn)
+                self._function_id = core.register_function(self._pickled)
+        return self._function_id
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        core = worker_mod.global_worker()
+        function_id = self._export(core)
+        opts = self._options
+        resources = dict(opts.get("resources", {}))
+        resources.setdefault("CPU", float(opts.get("num_cpus", 1)))
+        if opts.get("num_tpus"):
+            resources["TPU"] = float(opts["num_tpus"])
+        if opts.get("num_gpus"):  # accepted for API parity; TPU-first alias
+            resources["TPU"] = float(opts["num_gpus"])
+        if opts.get("memory"):
+            resources["memory"] = float(opts["memory"])
+        num_returns = int(opts.get("num_returns", 1))
+        refs = core.submit_task(
+            function_id,
+            self._descriptor,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=opts.get("max_retries"),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            scheduling_strategy=_resolve_strategy(
+                opts.get("scheduling_strategy")),
+        )
+        return refs[0] if num_returns == 1 else refs

@@ -27,10 +27,12 @@ def shutdown_only():
     ray_tpu.shutdown()
 
 
-@pytest.fixture
-def ray_start_regular(shutdown_only):
-    """Single-node in-process cluster (parity: reference conftest.py:266)."""
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    """Single-node cluster shared by a test module (parity: reference
+    conftest.py:266 ``ray_start_regular_shared``)."""
     import ray_tpu
 
     ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
     yield None
+    ray_tpu.shutdown()

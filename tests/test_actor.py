@@ -1,0 +1,125 @@
+"""Actor API tests (parity model: reference python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic():
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(5), timeout=30) == 6
+    assert ray_tpu.get(c.read.remote(), timeout=30) == 6
+
+
+def test_actor_constructor_args():
+    c = Counter.remote(start=41)
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 42
+
+
+def test_actor_method_ordering():
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(50)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(1, 51))
+
+
+def test_two_actors_isolated():
+    a = Counter.remote()
+    b = Counter.remote()
+    ray_tpu.get([a.incr.remote(), a.incr.remote()], timeout=60)
+    assert ray_tpu.get(b.read.remote(), timeout=60) == 0
+
+
+def test_named_actor():
+    Counter.options(name="counter-x").remote(7)
+    h = ray_tpu.get_actor("counter-x")
+    assert ray_tpu.get(h.read.remote(), timeout=60) == 7
+
+
+def test_named_actor_conflict():
+    a = Counter.options(name="dup").remote()
+    ray_tpu.get(a.__ray_ready__(), timeout=60)
+    with pytest.raises(Exception):
+        b = Counter.options(name="dup").remote()
+        ray_tpu.get(b.__ray_ready__(), timeout=30)
+
+
+def test_get_if_exists():
+    a = Counter.options(name="shared", get_if_exists=True).remote(5)
+    ray_tpu.get(a.__ray_ready__(), timeout=60)
+    b = Counter.options(name="shared", get_if_exists=True).remote(99)
+    assert a.actor_id == b.actor_id
+    assert ray_tpu.get(b.read.remote(), timeout=30) == 5
+
+
+def test_missing_named_actor():
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does-not-exist")
+
+
+def test_actor_handle_passed_to_task():
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.incr.remote(), timeout=30)
+
+    assert ray_tpu.get(bump.remote(c), timeout=60) == 1
+    assert ray_tpu.get(c.read.remote(), timeout=30) == 1
+
+
+def test_actor_error():
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("nope")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="nope"):
+        ray_tpu.get(b.fail.remote(), timeout=60)
+    # actor survives a method error
+    assert ray_tpu.get(b.ok.remote(), timeout=30) == "fine"
+
+
+def test_kill_actor():
+    c = Counter.remote()
+    ray_tpu.get(c.__ray_ready__(), timeout=60)
+    ray_tpu.kill(c)
+    with pytest.raises(ray_tpu.ActorError):
+        for _ in range(20):  # the kill races with the next call
+            ray_tpu.get(c.read.remote(), timeout=15)
+            time.sleep(0.2)
+
+
+def test_actor_resource_exhaustion_queues():
+    # 4 CPUs total; 2-CPU actors: the 3rd creation must wait, not fail
+    @ray_tpu.remote(num_cpus=2)
+    class Chunky:
+        def ping(self):
+            return True
+
+    a = Chunky.remote()
+    b = Chunky.remote()
+    assert ray_tpu.get([a.ping.remote(), b.ping.remote()], timeout=90) == \
+        [True, True]

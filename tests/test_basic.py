@@ -1,0 +1,177 @@
+"""Core task/object API tests (parity model: reference
+python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+def test_simple_task():
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+
+
+def test_task_with_kwargs_and_defaults():
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 111
+    assert ray_tpu.get(f.remote(1, 2, c=3), timeout=60) == 6
+
+
+def test_put_get_roundtrip():
+    for value in [1, "x", None, {"a": [1, 2]}, (1, 2)]:
+        assert ray_tpu.get(ray_tpu.put(value), timeout=30) == value
+
+
+def test_large_object_via_plasma():
+    arr = np.random.rand(500_000)  # ~4MB, above inline threshold
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=30)
+    assert np.array_equal(out, arr)
+
+
+def test_object_ref_as_argument():
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    ref = ray_tpu.put(21)
+    assert ray_tpu.get(double.remote(ref), timeout=60) == 42
+
+
+def test_chained_tasks():
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(4):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref, timeout=60) == 5
+
+
+def test_large_task_arg_promoted():
+    arr = np.arange(1_000_000, dtype=np.float64)
+
+    @ray_tpu.remote
+    def head(a):
+        return float(a[0]) + float(a.sum() > 0)
+
+    assert ray_tpu.get(head.remote(arr), timeout=60) == 1.0
+
+
+def test_multiple_returns():
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3], timeout=60) == [1, 2, 3]
+
+
+def test_error_propagation():
+    @ray_tpu.remote(max_retries=0)
+    def fail():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        ray_tpu.get(fail.remote(), timeout=60)
+
+
+def test_error_through_dependency():
+    @ray_tpu.remote(max_retries=0)
+    def fail():
+        raise ValueError("upstream")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray_tpu.get(consume.remote(fail.remote()), timeout=60)
+
+
+def test_wait():
+    @ray_tpu.remote
+    def sleeper(t):
+        time.sleep(t)
+        return t
+
+    fast = sleeper.remote(0.05)
+    slow = sleeper.remote(10)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=30)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_wait_timeout():
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(30)
+
+    ref = sleeper.remote()
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=0.2)
+    assert ready == []
+    assert not_ready == [ref]
+
+
+def test_get_timeout():
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(sleeper.remote(), timeout=0.2)
+
+
+def test_nested_object_refs():
+    inner = ray_tpu.put("inner-value")
+
+    @ray_tpu.remote
+    def unwrap(box):
+        return ray_tpu.get(box["ref"], timeout=30)
+
+    assert ray_tpu.get(unwrap.remote({"ref": inner}), timeout=60) == \
+        "inner-value"
+
+
+def test_task_launches_task():
+    @ray_tpu.remote
+    def leaf(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(leaf.remote(x), timeout=30) + 1
+
+    assert ray_tpu.get(parent.remote(4), timeout=60) == 41
+
+
+def test_cluster_resources():
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4.0
+
+
+def test_runtime_context():
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_job_id() is not None
+
+    @ray_tpu.remote
+    def get_ctx():
+        c = ray_tpu.get_runtime_context()
+        return c.get_task_id(), c.get_node_id()
+
+    task_id, node_id = ray_tpu.get(get_ctx.remote(), timeout=60)
+    assert task_id is not None
+    assert node_id == ctx.get_node_id()  # single-node cluster
