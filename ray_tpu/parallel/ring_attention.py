@@ -1,0 +1,116 @@
+"""Ring attention: exact attention over sequence shards with a ring of
+``ppermute`` K/V rotations.
+
+Sequence parallelism is absent from the reference (SURVEY.md §2.5); this
+is the TPU-native construction: each device along the ``sp`` mesh axis
+holds a contiguous sequence chunk of Q, K, V.  Over ``sp``-many steps,
+every device computes blockwise attention of its Q chunk against the K/V
+chunk currently resident, maintaining an online-softmax accumulator
+(running max ``m``, normalizer ``l``, weighted values ``o``), then rotates
+K/V one hop around the ring.  Communication overlaps compute on ICI and
+peak memory stays O(T/n) per device.
+
+Causal masking is exact: global block offsets are derived from the ring
+step so a Q chunk skips K/V blocks entirely in its future (their
+contribution is masked; XLA still schedules them — block skipping is a
+future optimization).
+
+Usable two ways:
+- inside an existing ``shard_map``: call with ``axis_name="sp"``;
+- standalone: pass ``mesh=``; inputs are globally-shaped arrays and the
+  function applies ``shard_map`` itself.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, m, l, o, q_offset, k_offset, causal, scale):
+    """One blockwise online-softmax update.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]
+    m, l: [B, H, Tq] running max / normalizer; o: [B, Tq, H, D]
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows (m_new == NEG_INF) against NaNs
+    safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    correction = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - safe_m)
+    correction = jnp.where(m <= NEG_INF / 2, 0.0, correction)
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
+                            scale: float):
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    batch, tq, heads, dim = q.shape
+    qf = q.astype(jnp.float32)
+
+    m0 = jnp.full((batch, heads, tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, heads, tq), jnp.float32)
+    o0 = jnp.zeros((batch, tq, heads, dim), jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, s):
+        m, l, o, k_cur, v_cur = carry
+        # K/V chunk at ring step s originated on device (my_idx - s) mod n
+        k_idx = (my_idx - s) % axis_size
+        q_offset = my_idx * tq
+        k_offset = k_idx * k_cur.shape[1]
+        m, l, o = _block_attn(qf, k_cur, v_cur, m, l, o,
+                              q_offset, k_offset, causal, scale)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, o, k_nxt, v_nxt), None
+
+    (m, l, o, _, _), _ = lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(axis_size))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None,
+                   mesh: Optional[Mesh] = None) -> jax.Array:
+    """Exact (flash-equivalent) attention over a sequence-sharded mesh
+    axis.
+
+    Args shapes: ``[batch, seq, heads, head_dim]`` — the seq dim sharded
+    over ``axis_name`` (shard-local when called inside shard_map, global
+    when ``mesh`` is given).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if mesh is None:
+        return _ring_attention_sharded(q, k, v, axis_name, causal, scale)
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(_ring_attention_sharded, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,109 @@
+"""Logical-axis sharding rules and GSPMD presets.
+
+The pattern (from public JAX scaling practice): model code annotates
+arrays with *logical* axis names ("batch", "seq", "embed", "mlp",
+"heads", "kv", "vocab", "layers", "expert"); a :class:`ShardingRules`
+table maps logical names to mesh axes per parallelism style.  XLA then
+inserts the collectives.  This replaces the reference's per-backend
+process-group wiring with declarative sharding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxis = Union[None, str, Tuple[str, ...]]
+
+
+@dataclass
+class ShardingRules:
+    """logical axis -> mesh axis (or tuple of axes, or None=replicated)."""
+
+    rules: Dict[str, MeshAxis] = field(default_factory=dict)
+
+    def spec(self, *logical_axes: Optional[str]) -> P:
+        return P(*[self.rules.get(a) if a is not None else None
+                   for a in logical_axes])
+
+    def merged(self, **updates: MeshAxis) -> "ShardingRules":
+        out = dict(self.rules)
+        out.update(updates)
+        return ShardingRules(out)
+
+
+#: Fully-replicated parameters, batch split over data axes (DP).
+DP_RULES = ShardingRules({
+    "batch": ("dp", "fsdp"),
+    "seq": None, "embed": None, "mlp": None, "heads": None,
+    "kv": None, "vocab": None, "layers": None, "expert": None,
+})
+
+#: FSDP: parameters sharded over the fsdp axis on their largest dim.
+FSDP_RULES = ShardingRules({
+    "batch": ("dp", "fsdp"),
+    "embed": "fsdp",
+    "seq": None, "mlp": None, "heads": None, "kv": None,
+    "vocab": None, "layers": None, "expert": None,
+})
+
+#: Megatron-style TP on top of (F)SDP: hidden/heads over tp.
+TP_RULES = ShardingRules({
+    "batch": ("dp", "fsdp"),
+    "embed": "fsdp",
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": "tp",
+    "vocab": "tp",
+    "seq": None, "layers": None, "expert": None,
+})
+
+#: Sequence/context parallelism: activations split on seq over sp.
+SP_RULES = TP_RULES.merged(seq="sp")
+
+#: Expert parallelism: experts over ep (usually aliased with fsdp).
+EP_RULES = TP_RULES.merged(expert="ep")
+
+PRESETS: Dict[str, ShardingRules] = {
+    "dp": DP_RULES,
+    "fsdp": FSDP_RULES,
+    "tp": TP_RULES,
+    "sp": SP_RULES,
+    "ep": EP_RULES,
+}
+
+
+def logical_to_mesh(rules: ShardingRules, logical_specs: Any) -> Any:
+    """Map a pytree of logical-axis tuples to PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: rules.spec(*axes)
+        if isinstance(axes, (tuple, list)) else P(),
+        logical_specs,
+        is_leaf=lambda x: isinstance(x, (tuple, list)),
+    )
+
+
+def shard_params(params: Any, logical_specs: Any, rules: ShardingRules,
+                 mesh: Mesh) -> Any:
+    """Device-put a parameter pytree according to logical specs."""
+    specs = logical_to_mesh(rules, logical_specs)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+
+
+def with_sharding_constraint(x: Any, rules: ShardingRules,
+                             *logical_axes: Optional[str]) -> Any:
+    """In-jit activation sharding hint."""
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, rules.spec(*logical_axes))
+    except (ValueError, RuntimeError):
+        return x  # outside jit/mesh context: no-op
+
+
+def named_sharding(mesh: Mesh, *axes: MeshAxis) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))

@@ -1,19 +1,25 @@
 """Test configuration.
 
 JAX-based tests run against a virtual 8-device CPU mesh (multi-chip
-hardware is unavailable in CI); the env vars must be set before jax is
-imported anywhere in the process, hence they live at module import time
-here.
+hardware is unavailable in CI).  The environment may pre-import jax and
+pin it to a real TPU backend (e.g. an axon sitecustomize), so plain env
+vars are not enough — we force the platform through jax.config before any
+backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
