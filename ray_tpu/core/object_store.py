@@ -148,8 +148,13 @@ class StoreClient:
         return self._view[offset : offset + size]
 
     def close(self) -> None:
-        self._view.release()
-        self._mm.close()
+        try:
+            self._view.release()
+            self._mm.close()
+        except BufferError:
+            # user code still holds zero-copy arrays over the mapping; the
+            # mapping lives until those buffers are garbage collected
+            pass
 
 
 def _map_file(path: str, capacity: int) -> mmap.mmap:

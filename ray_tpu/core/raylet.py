@@ -181,6 +181,7 @@ class Raylet:
                 view = await self.gcs_conn.call("get_nodes", {}, timeout=5.0)
                 self._cluster_view = view
                 self._gcs_misses = 0
+                self._maybe_schedule()  # fresh view may unblock queued work
             except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
                 if self._closing:
                     break
@@ -343,10 +344,11 @@ class Raylet:
             spill = self._pick_spillback(resources, data)
             if spill is not None:
                 return {"spillback": spill}
-            if not self._feasible_ever(resources, bundle):
-                if bundle is None and not self._feasible_anywhere(resources):
-                    return {"error":
-                            f"infeasible resource demand {resources}"}
+            if bundle is None and not self._feasible_ever(resources, None) \
+                    and not self._feasible_anywhere(resources):
+                logger.warning(
+                    "lease demand %s infeasible cluster-wide; queueing "
+                    "(waiting for new nodes)", resources)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending_leases.append(PendingLease(
             request=data, future=fut, job_id_bin=job_id_bin,
@@ -411,7 +413,8 @@ class Raylet:
         return tuple(best["address"])
 
     def _maybe_schedule(self) -> None:
-        """Grant queued leases FIFO while resources and workers allow."""
+        """Grant queued leases FIFO while resources and workers allow;
+        spill queued leases to other nodes as the cluster view evolves."""
         if self._closing:
             return
         remaining: List[PendingLease] = []
@@ -419,6 +422,14 @@ class Raylet:
             if lease.future.done():
                 continue
             if not self._fits(lease.resources, lease.bundle):
+                # re-evaluate spillback against the latest cluster view
+                # (e.g. demand for a resource this node will never have)
+                if lease.bundle is None:
+                    spill = self._pick_spillback(lease.resources,
+                                                 lease.request)
+                    if spill is not None:
+                        lease.future.set_result({"spillback": spill})
+                        continue
                 remaining.append(lease)
                 continue
             worker = self._pop_idle(lease.job_id_bin)

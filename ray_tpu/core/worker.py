@@ -207,7 +207,10 @@ class CoreWorker:
         def _spawn():
             task = self._loop.create_task(coro)
             task.add_done_callback(lambda t: t.exception())
-        self._loop.call_soon_threadsafe(_spawn)
+        try:
+            self._loop.call_soon_threadsafe(_spawn)
+        except (RuntimeError, AttributeError):
+            coro.close()  # loop shut down (interpreter teardown)
 
     async def _async_init(self) -> None:
         self.task_server = rpc.Server(self, host="127.0.0.1", port=0)

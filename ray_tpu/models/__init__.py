@@ -1,0 +1,6 @@
+"""Model zoo: TPU-first flax implementations with logical-axis sharding
+annotations consumed by ``ray_tpu.parallel.sharding``."""
+
+from ray_tpu.models.gpt2 import GPT2, GPT2Config  # noqa: F401
+from ray_tpu.models.llama import Llama, LlamaConfig  # noqa: F401
+from ray_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401

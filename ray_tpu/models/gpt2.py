@@ -1,0 +1,186 @@
+"""GPT-2 in flax linen, TPU-first.
+
+The benchmark flagship (BASELINE.json: GPT-2 124M data-parallel on TPU).
+Design notes:
+- bfloat16 activations/params by default, float32 softmax/layernorm
+  accumulation — MXU-friendly.
+- attention goes through ``ray_tpu.ops.flash_attention`` (pallas kernel on
+  TPU); sequence-parallel training swaps in ring attention via ``attn_impl``.
+- every parameter is annotated with logical axes via
+  ``nn.with_partitioning``, so ``ray_tpu.parallel.sharding`` presets map
+  them onto the mesh without model changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    #: "flash" | "ring" | "reference"
+    attn_impl: str = "flash"
+    #: mesh axis name for ring attention (when attn_impl == "ring")
+    sp_axis: str = "sp"
+
+    @classmethod
+    def gpt2_small(cls, **kw) -> "GPT2Config":  # 124M
+        return cls(num_layers=12, num_heads=12, embed_dim=768, **kw)
+
+    @classmethod
+    def gpt2_medium(cls, **kw) -> "GPT2Config":  # 350M
+        return cls(num_layers=24, num_heads=16, embed_dim=1024, **kw)
+
+    @classmethod
+    def gpt2_large(cls, **kw) -> "GPT2Config":  # 774M
+        return cls(num_layers=36, num_heads=20, embed_dim=1280, **kw)
+
+    @classmethod
+    def gpt2_xl(cls, **kw) -> "GPT2Config":  # 1.5B
+        return cls(num_layers=48, num_heads=25, embed_dim=1600, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":  # for tests
+        return cls(vocab_size=256, max_seq_len=128, num_layers=2,
+                   num_heads=2, embed_dim=64, **kw)
+
+    def num_params(self) -> int:
+        e, v, l = self.embed_dim, self.vocab_size, self.num_layers
+        per_layer = 12 * e * e + 13 * e  # qkv/proj/mlp + biases + lns
+        return v * e + self.max_seq_len * e + l * per_layer + 2 * e
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs per token (fwd+bwd ≈ 6·N + attn)."""
+        n = self.num_params() - self.vocab_size * self.embed_dim
+        attn = 12 * self.num_layers * self.embed_dim * self.max_seq_len
+        return 6.0 * n + attn
+
+
+def _dense(features: int, config: GPT2Config, name: str,
+           kernel_axes: tuple) -> nn.Dense:
+    return nn.Dense(
+        features,
+        dtype=config.dtype,
+        param_dtype=config.param_dtype,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.normal(0.02), kernel_axes),
+        bias_init=nn.with_partitioning(
+            nn.initializers.zeros, (kernel_axes[-1],)),
+        name=name,
+    )
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        head_dim = cfg.embed_dim // cfg.num_heads
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1",
+                         scale_init=nn.with_partitioning(
+                             nn.initializers.ones, ("embed",)),
+                         bias_init=nn.with_partitioning(
+                             nn.initializers.zeros, ("embed",)))(x)
+        qkv = _dense(3 * cfg.embed_dim, cfg, "attn_qkv",
+                     ("embed", "heads"))(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        batch, seq = x.shape[:2]
+
+        def heads(t):
+            return t.reshape(batch, seq, cfg.num_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if cfg.attn_impl == "ring":
+            from ray_tpu.parallel.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, axis_name=cfg.sp_axis,
+                                  causal=True)
+        elif cfg.attn_impl == "reference":
+            from ray_tpu.ops.flash_attention import _attention_reference
+
+            attn = _attention_reference(q, k, v, True, head_dim ** -0.5)
+        else:
+            attn = flash_attention(q, k, v, causal=True)
+        attn = attn.reshape(batch, seq, cfg.embed_dim)
+        attn = _dense(cfg.embed_dim, cfg, "attn_proj",
+                      ("heads", "embed"))(attn)
+        x = x + attn
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2",
+                         scale_init=nn.with_partitioning(
+                             nn.initializers.ones, ("embed",)),
+                         bias_init=nn.with_partitioning(
+                             nn.initializers.zeros, ("embed",)))(x)
+        h = _dense(cfg.mlp_ratio * cfg.embed_dim, cfg, "mlp_up",
+                   ("embed", "mlp"))(h)
+        h = nn.gelu(h)
+        h = _dense(cfg.embed_dim, cfg, "mlp_down", ("mlp", "embed"))(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return x + h
+
+
+class GPT2(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        wte = self.param(
+            "wte",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 ("vocab", "embed")),
+            (cfg.vocab_size, cfg.embed_dim), cfg.param_dtype)
+        wpe = self.param(
+            "wpe",
+            nn.with_partitioning(nn.initializers.normal(0.01),
+                                 (None, "embed")),
+            (cfg.max_seq_len, cfg.embed_dim), cfg.param_dtype)
+        seq = tokens.shape[1]
+        x = wte.astype(cfg.dtype)[tokens] + \
+            wpe.astype(cfg.dtype)[None, :seq]
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"h{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f",
+                         scale_init=nn.with_partitioning(
+                             nn.initializers.ones, ("embed",)),
+                         bias_init=nn.with_partitioning(
+                             nn.initializers.zeros, ("embed",)))(x)
+        # tied embedding head
+        logits = jnp.einsum("bte,ve->btv", x.astype(jnp.float32),
+                            wte.astype(jnp.float32))
+        return logits
+
+    def init_params(self, rng: jax.Array, batch: int = 1,
+                    seq: Optional[int] = None):
+        seq = seq or self.config.max_seq_len
+        tokens = jnp.zeros((batch, seq), jnp.int32)
+        return self.init(rng, tokens)["params"]
+
+
+def loss_fn(model: GPT2, params, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy (labels = tokens shifted left)."""
+    from ray_tpu.ops.fused import fused_softmax_cross_entropy
+
+    logits = model.apply({"params": params}, tokens)
+    losses = fused_softmax_cross_entropy(logits[:, :-1], tokens[:, 1:])
+    return losses.mean()

@@ -1,0 +1,80 @@
+"""ResNet for image classification (BASELINE config: ResNet-18 CIFAR-10).
+
+Convs map directly to the MXU; NHWC layout (TPU-native).  Batch norm uses
+synchronized cross-replica statistics when run under a mesh (axis_name
+passed at apply time), matching multi-chip data-parallel training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)  # resnet-18
+    num_classes: int = 10
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def resnet18(cls, num_classes: int = 10, **kw) -> "ResNetConfig":
+        return cls(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, **kw)
+
+    @classmethod
+    def resnet50(cls, num_classes: int = 1000, **kw) -> "ResNetConfig":
+        return cls(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, **kw)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=jnp.float32,
+                       axis_name=self.axis_name)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            (self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.num_filters, (3, 3), use_bias=False,
+                    dtype=cfg.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=jnp.float32, axis_name=self.axis_name)(x)
+        x = nn.relu(x)
+        for stage, size in enumerate(cfg.stage_sizes):
+            for block in range(size):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(cfg.num_filters * (2 ** stage), strides,
+                               cfg.dtype, self.axis_name)(x, train)
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,4 @@
+"""TPU compute kernels (pallas) with portable fallbacks."""
+
+from ray_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from ray_tpu.ops.fused import fused_rmsnorm, fused_softmax_cross_entropy  # noqa: F401

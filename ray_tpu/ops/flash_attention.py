@@ -1,0 +1,169 @@
+"""Flash attention: fused blockwise attention for the MXU.
+
+Forward pass is a pallas kernel (online softmax over K/V tiles resident
+in VMEM — HBM traffic is O(T·D) instead of the O(T²) score matrix).
+Backward currently recomputes through a jnp implementation under
+``jax.custom_vjp`` (exact, O(T²) peak inside XLA fusion); a pallas
+backward kernel is the planned follow-up.  For sequence lengths beyond
+one chip's VMEM budget, use ``ray_tpu.parallel.ring_attention`` which
+composes with this kernel per shard.
+
+Grid: one program per (batch, head, Q tile); each program streams K/V
+tiles with ``lax.fori_loop``.  Tiles are MXU-shaped (128 rows) and
+accumulation is float32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _attention_reference(q, k, v, causal: bool, scale: float) -> jax.Array:
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+               block_k: int, seq_k: int):
+    from jax.experimental import pallas as pl
+
+    block_q, head_dim = q_ref.shape
+    q = q_ref[:].astype(jnp.float32) * scale
+    q_offset = pl.program_id(2) * block_q
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    num_k_blocks = seq_k // block_k
+
+    def body(i, carry):
+        m, l, acc = carry
+        k_start = i * block_k
+        k = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        if causal:
+            q_pos = q_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - safe_m[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - safe_m))
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Q tile [q_offset, q_offset+block_q) never attends past its end;
+        # stop the K loop at the last contributing tile.
+        last = lax.div(q_offset + block_q - 1, block_k) + 1
+        num_iters = jnp.minimum(num_k_blocks, last)
+    else:
+        num_iters = num_k_blocks
+    m, l, acc = lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float,
+                   block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    batch, seq_q, heads, dim = q.shape
+    seq_k = k.shape[1]
+    # pallas layout: [B, H, T, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    assert seq_q % block_q == 0 and seq_k % block_k == 0, (
+        f"sequence lengths ({seq_q}, {seq_k}) must divide into blocks "
+        f"({block_q}, {block_k})")
+
+    grid = (batch, heads, seq_q // block_q)
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_k=seq_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, dim),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, seq_k, dim),
+                         lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, seq_k, dim),
+                         lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, dim),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused attention. Shapes ``[batch, seq, heads, head_dim]``.
+
+    On TPU runs the pallas kernel; on other backends (tests) falls back
+    to the jnp reference unless ``interpret=True`` forces the kernel
+    through the pallas interpreter.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    backend = jax.default_backend()
+    if interpret is None:
+        if backend not in ("tpu", "axon"):
+            return _attention_reference(q, k, v, causal, scale)
+        interpret = False
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -1,0 +1,87 @@
+"""Small fused ops: RMSNorm and softmax cross-entropy.
+
+Pallas kernels for the memory-bound pieces XLA sometimes leaves on the
+table; each has a jnp fallback used off-TPU (and as the autodiff rule —
+the kernels are forward-only with ``custom_vjp`` recompute backward).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _rmsnorm_ref(x, weight, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    y = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (y * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rmsnorm(x, weight, eps, interpret):
+    from jax.experimental import pallas as pl
+
+    rows = x.shape[0] * (x.shape[1] if x.ndim == 3 else 1)
+    flat = x.reshape(rows, x.shape[-1])
+    block = min(512, rows)
+    while rows % block:
+        block //= 2
+    block = max(block, 1)
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        grid=(rows // block,),
+        in_specs=[
+            pl.BlockSpec((block, x.shape[-1]), lambda i: (i, 0)),
+            pl.BlockSpec((x.shape[-1],), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, x.shape[-1]), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(flat.shape, x.dtype),
+        interpret=interpret,
+    )(flat, weight)
+    return out.reshape(x.shape)
+
+
+def _rmsnorm_fwd(x, weight, eps, interpret):
+    return _rmsnorm(x, weight, eps, interpret), (x, weight)
+
+
+def _rmsnorm_bwd(eps, interpret, res, g):
+    x, weight = res
+    _, vjp = jax.vjp(lambda x_, w_: _rmsnorm_ref(x_, w_, eps), x, weight)
+    return vjp(g)
+
+
+_rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)
+
+
+def fused_rmsnorm(x: jax.Array, weight: jax.Array, *, eps: float = 1e-6,
+                  interpret: Optional[bool] = None) -> jax.Array:
+    backend = jax.default_backend()
+    if interpret is None:
+        if backend not in ("tpu", "axon"):
+            return _rmsnorm_ref(x, weight, eps)
+        interpret = False
+    return _rmsnorm(x, weight, eps, interpret)
+
+
+def fused_softmax_cross_entropy(logits: jax.Array,
+                                labels: jax.Array) -> jax.Array:
+    """Numerically-stable token cross entropy; relies on XLA fusion (the
+    log-softmax + gather fuse into the producing matmul's epilogue)."""
+    logits = logits.astype(jnp.float32)
+    m = logits.max(axis=-1, keepdims=True)
+    shifted = logits - jax.lax.stop_gradient(m)
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+    label_logit = jnp.take_along_axis(
+        shifted, labels[..., None], axis=-1)[..., 0]
+    return lse - label_logit

@@ -1,0 +1,171 @@
+"""Model forward/backward smoke + sharded-training integration tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import (
+    GPT2,
+    GPT2Config,
+    Llama,
+    LlamaConfig,
+    ResNet,
+    ResNetConfig,
+)
+from ray_tpu.parallel import MeshConfig, build_mesh
+
+
+def test_gpt2_forward_and_loss_decreases():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init_params(rng, batch=2, seq=32)
+    tokens = jax.random.randint(rng, (2, 32), 0, cfg.vocab_size)
+
+    from ray_tpu.models.gpt2 import loss_fn
+
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, tokens))(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_param_count():
+    cfg = GPT2Config.gpt2_small()
+    assert 110e6 < cfg.num_params() < 140e6  # ~124M
+
+
+def test_gpt2_sharded_training_step():
+    """Full dp x tp sharded train step on the 8-device mesh."""
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    from flax.linen import get_partition_spec
+
+    from ray_tpu.models.gpt2 import loss_fn
+    from ray_tpu.parallel.sharding import TP_RULES, logical_to_mesh
+
+    rng = jax.random.PRNGKey(0)
+    abstract = jax.eval_shape(
+        lambda: model.init(rng, jnp.zeros((1, 16), jnp.int32))["params"])
+    logical = nn_logical_specs(abstract)
+    specs = logical_to_mesh(TP_RULES, logical)
+
+    params = model.init(rng, jnp.zeros((1, 16), jnp.int32))["params"]
+    params = jax.tree.map(lambda x: jax.device_put(x), params)
+    import flax
+
+    flat_params = flax.traverse_util.flatten_dict(
+        jax.tree.map(lambda x: x,
+                     flax.core.unfreeze(params),
+                     is_leaf=lambda x: hasattr(x, "unbox")))
+    # place params according to specs
+    flat_specs = flax.traverse_util.flatten_dict(specs)
+    placed = {}
+    for key, val in flat_params.items():
+        leaf = val.unbox() if hasattr(val, "unbox") else val
+        spec = flat_specs.get(key, P())
+        placed[key] = jax.device_put(leaf, NamedSharding(mesh, spec))
+    params = flax.traverse_util.unflatten_dict(placed)
+
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    tokens = jax.device_put(
+        tokens, NamedSharding(mesh, P(("dp", "fsdp"), None)))
+
+    @jax.jit
+    def step(p, t):
+        return jax.grad(lambda p_: loss_fn(model, p_, t))(p)
+
+    grads = step(params, tokens)
+    chex_assert_finite(grads)
+
+
+def nn_logical_specs(abstract_params):
+    """Extract logical axis tuples from flax Partitioned metadata."""
+    import flax
+
+    def leaf_spec(x):
+        if hasattr(x, "names"):
+            return tuple(x.names)
+        return ()
+
+    return jax.tree.map(leaf_spec, abstract_params,
+                        is_leaf=lambda x: hasattr(x, "names"))
+
+
+def chex_assert_finite(tree):
+    import chex
+
+    leaves = jax.tree.leaves(
+        jax.tree.map(lambda x: x.unbox() if hasattr(x, "unbox") else x,
+                     tree, is_leaf=lambda x: hasattr(x, "unbox")))
+    for leaf in leaves:
+        assert bool(jnp.all(jnp.isfinite(leaf)))
+
+
+def test_llama_forward():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    params = model.init(rng, tokens)["params"]
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_llama_kv_cache_decode_matches_full():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    rng = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(rng, (1, 8), 0, cfg.vocab_size)
+    params = model.init(rng, tokens)["params"]
+
+    full_logits = model.apply({"params": params}, tokens)
+
+    # prefill 4, then decode 4 one token at a time
+    caches = model.init_kv_caches(batch=1, max_len=16)
+    positions = jnp.arange(4)[None]
+    logits, caches = model.apply({"params": params}, tokens[:, :4],
+                                 positions, caches)
+    outs = [logits]
+    for t in range(4, 8):
+        positions = jnp.asarray([[t]])
+        logits, caches = model.apply({"params": params},
+                                     tokens[:, t:t + 1], positions, caches)
+        outs.append(logits)
+    step_logits = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(step_logits),
+                               np.asarray(full_logits),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_resnet_forward_backward():
+    cfg = ResNetConfig.resnet18(num_classes=10, dtype=jnp.float32)
+    model = ResNet(cfg)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((2, 32, 32, 3))
+    variables = model.init(rng, x)
+
+    def loss(params):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        return logits.sum()
+
+    grads = jax.grad(loss)(variables["params"])
+    assert jax.tree.leaves(grads)

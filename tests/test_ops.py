@@ -1,0 +1,78 @@
+"""Kernel correctness tests (pallas interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import fused_rmsnorm, fused_softmax_cross_entropy
+from ray_tpu.ops.flash_attention import (
+    _attention_reference,
+    flash_attention,
+)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_kernel_matches_reference(causal):
+    rng = np.random.default_rng(0)
+    b, t, h, d = 2, 256, 2, 64
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = _attention_reference(q, k, v, causal, d ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_gradients():
+    rng = np.random.default_rng(1)
+    b, t, h, d = 1, 128, 2, 32
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=True).sum()
+
+    def loss_ref(q, k, v):
+        return _attention_reference(q, k, v, True, d ** -0.5).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_attention_bf16():
+    rng = np.random.default_rng(2)
+    b, t, h, d = 1, 128, 2, 64
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
+    out = flash_attention(q, q, q, causal=True, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _attention_reference(q, q, q, True, d ** -0.5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_rmsnorm_matches_reference():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 64, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+    out = fused_rmsnorm(x, w, interpret=True)
+    var = np.mean(np.square(np.asarray(x)), axis=-1, keepdims=True)
+    ref = np.asarray(x) / np.sqrt(var + 1e-6) * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_cross_entropy():
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.standard_normal((8, 100)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 100, (8,)), jnp.int32)
+    loss = fused_softmax_cross_entropy(logits, labels)
+    ref = -jax.nn.log_softmax(logits)[jnp.arange(8), labels]
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
