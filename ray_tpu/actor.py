@@ -112,8 +112,8 @@ class ActorClass:
         core = worker_mod.global_worker()
         class_id = self._export(core)
         opts = self._options
-        resources = dict(opts.get("resources", {}))
-        resources.setdefault("CPU", float(opts.get("num_cpus", 1)))
+        resources = dict(opts.get("resources") or {})
+        resources.setdefault("CPU", float(opts.get("num_cpus") if opts.get("num_cpus") is not None else 1))
         if opts.get("num_tpus"):
             resources["TPU"] = float(opts["num_tpus"])
         if opts.get("num_gpus"):

@@ -761,15 +761,20 @@ class CoreWorker:
             if state.backlog and worker.inflight == 0:
                 self._dispatch_to_worker(state, worker)
         # Phase 2 — grow the fleet while there is queued work (the raylet
-        # answers with local grants or spillback to other nodes).
-        if state.backlog and not state.requesting:
-            state.requesting = True
+        # answers with local grants or spillback to other nodes).  Several
+        # lease requests may be outstanding so fan-out ramps quickly.
+        want = min(len(state.backlog), 8)
+        while state.requesting < want:
+            state.requesting += 1
             task = self._loop.create_task(self._request_lease(state))
             task.add_done_callback(lambda t: t.exception())
-        # Phase 3 — pipeline small tasks onto busy workers up to the
-        # in-flight cap (throughput for sub-millisecond tasks).
+        # Phase 3 — pipeline further tasks onto busy workers up to the
+        # in-flight cap (throughput for sub-millisecond tasks), but always
+        # leave at least one queued task per pending lease grant so new
+        # workers (possibly on other nodes) get work on arrival.
+        reserve = max(1, state.requesting)
         for worker in list(state.workers.values()):
-            while state.backlog and \
+            while len(state.backlog) > reserve and \
                     worker.inflight < self.config.max_tasks_in_flight_per_worker:
                 self._dispatch_to_worker(state, worker)
 
@@ -788,17 +793,23 @@ class CoreWorker:
                     lambda w=worker, s=state: self._loop.create_task(
                         self._return_lease(s, w)))
 
-    async def _request_lease(self, state: "_LeaseState",
-                             raylet_address: Optional[rpc.Address] = None
-                             ) -> None:
+    async def _request_lease(self, state: "_LeaseState") -> None:
+        """One lease acquisition (follows spillback redirects); holds one
+        ``state.requesting`` slot for its whole lifetime."""
         try:
-            spec = state.backlog[0] if state.backlog else None
-            if spec is None:
-                state.requesting = False
-                return
-            address = raylet_address or self.raylet_address
-            conn = self.raylet_conn if address == self.raylet_address \
-                else await self._pool.get(address)
+            await self._request_lease_chain(state, self.raylet_address)
+        finally:
+            state.requesting -= 1
+            self._pump_lease_queue(state)
+
+    async def _request_lease_chain(self, state: "_LeaseState",
+                                   raylet_address: rpc.Address) -> None:
+        spec = state.backlog[0] if state.backlog else None
+        if spec is None:
+            return
+        try:
+            conn = self.raylet_conn if raylet_address == self.raylet_address \
+                else await self._pool.get(raylet_address)
             strat = spec.scheduling_strategy
             reply = await conn.call("request_worker_lease", {
                 "resources": spec.resources,
@@ -811,14 +822,12 @@ class CoreWorker:
                 "backlog": len(state.backlog),
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
-            state.requesting = False
             self._fail_backlog(state, WorkerCrashedError(
                 f"lease request failed: {e}"))
             return
         if reply.get("spillback"):
-            await self._request_lease(state, tuple(reply["spillback"]))
+            await self._request_lease_chain(state, tuple(reply["spillback"]))
             return
-        state.requesting = False
         if reply.get("error"):
             self._fail_backlog(state, RayTpuError(reply["error"]))
             return
@@ -826,10 +835,9 @@ class CoreWorker:
             worker = _LeasedWorker(
                 worker_id=WorkerID(reply["worker_id"]),
                 address=tuple(reply["worker_address"]),
-                raylet=raylet_address or self.raylet_address,
+                raylet=raylet_address,
             )
             state.workers[worker.worker_id] = worker
-            self._pump_lease_queue(state)
 
     def _fail_backlog(self, state: "_LeaseState", error: Exception) -> None:
         while state.backlog:
@@ -1369,7 +1377,7 @@ class _LeaseState:
         self.key = key
         self.backlog: deque = deque()
         self.workers: Dict[WorkerID, _LeasedWorker] = {}
-        self.requesting = False
+        self.requesting = 0  # outstanding lease-request chains
 
 
 class _ActorSubmitState:

@@ -57,8 +57,10 @@ class GPT2Config:
 
     @classmethod
     def tiny(cls, **kw) -> "GPT2Config":  # for tests
-        return cls(vocab_size=256, max_seq_len=128, num_layers=2,
-                   num_heads=2, embed_dim=64, **kw)
+        defaults = dict(vocab_size=256, max_seq_len=128, num_layers=2,
+                        num_heads=2, embed_dim=64)
+        defaults.update(kw)
+        return cls(**defaults)
 
     def num_params(self) -> int:
         e, v, l = self.embed_dim, self.vocab_size, self.num_layers
@@ -109,10 +111,14 @@ class Block(nn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         if cfg.attn_impl == "ring":
+            from ray_tpu.parallel.mesh import get_global_mesh
             from ray_tpu.parallel.ring_attention import ring_attention
 
+            # under plain jit/GSPMD the sp axis is bound via the global
+            # mesh (shard_map applied inside ring_attention); inside a
+            # user shard_map the axis is already bound and mesh is None
             attn = ring_attention(q, k, v, axis_name=cfg.sp_axis,
-                                  causal=True)
+                                  causal=True, mesh=get_global_mesh())
         elif cfg.attn_impl == "reference":
             from ray_tpu.ops.flash_attention import _attention_reference
 

@@ -44,9 +44,11 @@ class LlamaConfig:
 
     @classmethod
     def tiny(cls, **kw) -> "LlamaConfig":
-        return cls(vocab_size=256, max_seq_len=128, num_layers=2,
-                   num_heads=4, num_kv_heads=2, embed_dim=64, mlp_dim=128,
-                   **kw)
+        defaults = dict(vocab_size=256, max_seq_len=128, num_layers=2,
+                        num_heads=4, num_kv_heads=2, embed_dim=64,
+                        mlp_dim=128)
+        defaults.update(kw)
+        return cls(**defaults)
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:

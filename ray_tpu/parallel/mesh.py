@@ -105,5 +105,35 @@ def mesh_axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
 
+_global_mesh: Optional[Mesh] = None
+
+
+def set_global_mesh(mesh: Optional[Mesh]) -> None:
+    """Install the process-wide mesh used by model-internal shard_map
+    blocks (e.g. ring attention inside GPT2 under plain jit/GSPMD)."""
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+class use_mesh:
+    """Context manager form of :func:`set_global_mesh`."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._prev: Optional[Mesh] = None
+
+    def __enter__(self) -> Mesh:
+        self._prev = get_global_mesh()
+        set_global_mesh(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc) -> None:
+        set_global_mesh(self._prev)
+
+
 def local_mesh_summary(mesh: Mesh) -> Dict[str, int]:
     return dict(mesh.shape)

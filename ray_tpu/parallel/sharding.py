@@ -107,3 +107,31 @@ def with_sharding_constraint(x: Any, rules: ShardingRules,
 
 def named_sharding(mesh: Mesh, *axes: MeshAxis) -> NamedSharding:
     return NamedSharding(mesh, P(*axes))
+
+
+def flax_sharding(boxed_params: Any, rules: ShardingRules
+                  ) -> Tuple[Any, Any]:
+    """Split a flax ``nn.with_partitioning``-boxed param tree into
+    (plain arrays, PartitionSpec tree) using the logical->mesh rules."""
+
+    def is_boxed(x):
+        return hasattr(x, "unbox") and hasattr(x, "names")
+
+    specs = jax.tree.map(
+        lambda x: rules.spec(*x.names) if is_boxed(x) else P(),
+        boxed_params, is_leaf=is_boxed)
+    plain = jax.tree.map(
+        lambda x: x.unbox() if is_boxed(x) else x,
+        boxed_params, is_leaf=is_boxed)
+    return plain, specs
+
+
+def place_flax_params(boxed_params: Any, rules: ShardingRules,
+                      mesh: Mesh) -> Tuple[Any, Any]:
+    """Unbox + device_put a flax param tree onto the mesh; returns
+    (sharded plain params, spec tree)."""
+    plain, specs = flax_sharding(boxed_params, rules)
+    placed = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        plain, specs)
+    return placed, specs

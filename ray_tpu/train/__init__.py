@@ -1,0 +1,13 @@
+"""Training library (parity: ``ray.train`` + the AIR session/config
+surface, jax-first)."""
+
+from ray_tpu.train import session  # noqa: F401
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401
+from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401

@@ -1,0 +1,148 @@
+"""Checkpoints: interconvertible dict / directory / object-store forms.
+
+Parity: reference ``python/ray/air/checkpoint.py`` — a ``Checkpoint`` can
+be created from an in-memory dict (small states), a directory (orbax /
+msgpack artifacts), or an ObjectRef, and converted between forms.  The
+manager implements keep-K + score-attribute retention
+(``CheckpointConfig``, reference ``air/config.py:513``).
+
+JAX pytrees serialize with flax's msgpack (no pickle for tensors);
+``save_pytree`` / ``load_pytree`` are the convenience entry points used by
+``JaxTrainer`` workers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.train.config import CheckpointConfig
+
+
+class Checkpoint:
+    def __init__(self, *, data: Optional[Dict[str, Any]] = None,
+                 directory: Optional[str] = None):
+        if (data is None) == (directory is None):
+            raise ValueError("exactly one of data/directory required")
+        self._data = data
+        self._dir = directory
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, directory: str) -> "Checkpoint":
+        return cls(directory=directory)
+
+    @classmethod
+    def from_pytree(cls, pytree: Any,
+                    metrics: Optional[Dict[str, Any]] = None) -> "Checkpoint":
+        from flax import serialization
+
+        return cls(data={
+            "pytree_msgpack": serialization.to_bytes(pytree),
+            "metrics": metrics or {},
+        })
+
+    # -- accessors --------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return self._data
+        out: Dict[str, Any] = {}
+        for name in os.listdir(self._dir):
+            with open(os.path.join(self._dir, name), "rb") as f:
+                out[name] = f.read()
+        return out
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if self._dir is not None:
+            return self._dir
+        path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        for key, value in self._data.items():
+            blob = value if isinstance(value, bytes) else pickle.dumps(value)
+            with open(os.path.join(path, key), "wb") as f:
+                f.write(blob)
+        return path
+
+    def to_pytree(self, target: Any) -> Any:
+        """Restore a pytree saved by ``from_pytree`` (``target`` supplies
+        the structure)."""
+        from flax import serialization
+
+        data = self.to_dict()
+        blob = data["pytree_msgpack"]
+        if not isinstance(blob, bytes):
+            blob = pickle.loads(blob)
+        return serialization.from_bytes(target, blob)
+
+    @property
+    def metrics(self) -> Dict[str, Any]:
+        data = self._data or {}
+        m = data.get("metrics", {})
+        return m if isinstance(m, dict) else pickle.loads(m)
+
+    def __repr__(self) -> str:
+        kind = "dict" if self._data is not None else f"dir:{self._dir}"
+        return f"Checkpoint({kind})"
+
+
+class CheckpointManager:
+    """Keep-K checkpoint retention with optional score ordering."""
+
+    def __init__(self, directory: str,
+                 config: Optional[CheckpointConfig] = None):
+        self.directory = directory
+        self.config = config or CheckpointConfig()
+        os.makedirs(directory, exist_ok=True)
+        self._entries: List[Tuple[float, str, Dict[str, Any]]] = []
+        self._counter = 0
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict[str, Any]] = None) -> str:
+        self._counter += 1
+        path = os.path.join(self.directory, f"checkpoint_{self._counter:06d}")
+        checkpoint.to_directory(path)
+        metrics = dict(metrics or checkpoint.metrics)
+        with open(os.path.join(path, ".metrics.json"), "w") as f:
+            json.dump({k: v for k, v in metrics.items()
+                       if isinstance(v, (int, float, str, bool))}, f)
+        score = self._score(metrics)
+        self._entries.append((score, path, metrics))
+        self._enforce_retention()
+        return path
+
+    def _score(self, metrics: Dict[str, Any]) -> float:
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            return float(self._counter)  # recency
+        value = float(metrics.get(attr, float("-inf")))
+        return value if self.config.checkpoint_score_order == "max" else -value
+
+    def _enforce_retention(self) -> None:
+        keep = self.config.num_to_keep
+        if keep is None or len(self._entries) <= keep:
+            return
+        self._entries.sort(key=lambda e: e[0], reverse=True)
+        for _, path, _ in self._entries[keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+        self._entries = self._entries[:keep]
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        best = max(self._entries, key=lambda e: e[0])
+        return Checkpoint.from_directory(best[1])
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        latest = max(self._entries, key=lambda e: e[1])
+        return Checkpoint.from_directory(latest[1])

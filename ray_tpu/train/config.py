@@ -1,0 +1,61 @@
+"""Trainer configuration dataclasses.
+
+Parity: reference ``python/ray/air/config.py`` — ``ScalingConfig``
+(:79), ``FailureConfig`` (:454), ``CheckpointConfig`` (:513),
+``RunConfig`` (:641) — with TPU-first fields: workers are *hosts* (one
+jax process per host, SURVEY.md §7 hard parts), each holding
+``tpus_per_worker`` chips, and the intra-program parallelism is a
+:class:`ray_tpu.parallel.MeshConfig` rather than a DDP flag.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshConfig
+
+
+@dataclass
+class ScalingConfig:
+    #: number of training worker processes (one per TPU host)
+    num_workers: int = 1
+    #: TPU chips claimed by each worker (0 = CPU-only training/testing)
+    tpus_per_worker: float = 0
+    cpus_per_worker: float = 1
+    #: extra custom resources per worker
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    #: gang placement strategy over nodes
+    placement_strategy: str = "PACK"
+    #: intra-program parallelism over the global device mesh
+    mesh: Optional[MeshConfig] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        out = dict(self.resources_per_worker)
+        out["CPU"] = float(self.cpus_per_worker)
+        if self.tpus_per_worker:
+            out["TPU"] = float(self.tpus_per_worker)
+        return out
+
+
+@dataclass
+class FailureConfig:
+    #: gang restarts allowed before giving up (-1 = unlimited)
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1

@@ -1,0 +1,158 @@
+"""JaxTrainer: the Train-equivalent entry point.
+
+Parity: reference ``train/data_parallel_trainer.py`` (``DataParallelTrainer``)
++ ``base_trainer.py`` (``fit`` contract) re-designed for jax: the trainer
+gangs one actor per TPU host, bootstraps the jax multi-host runtime
+(instead of a torch process group), and the user's
+``train_loop_per_worker`` runs identical SPMD code on every host —
+``pjit``/``shard_map`` over the global mesh does the intra-step
+parallelism, so there is no DDP wrapper to install.
+
+Fault tolerance (reference ``FailureConfig`` semantics): a worker/actor
+failure tears down the gang and restarts it from the latest streamed
+checkpoint, up to ``max_failures`` times — the checkpoint+respawn policy
+that replaces NCCL-style per-op recovery on TPU (SURVEY.md §7 hard
+parts).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[str] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable[[Dict[str, Any]], None],
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self._fn = train_loop_per_worker
+        self._config = dict(train_loop_config or {})
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self._resume_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------
+    def fit(self) -> Result:
+        ckpt_dir = self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_train",
+            self.run_config.name or f"run_{int(time.time())}")
+        manager = CheckpointManager(ckpt_dir,
+                                    self.run_config.checkpoint_config)
+        failures_allowed = self.run_config.failure_config.max_failures
+        attempt = 0
+        resume = self._resume_checkpoint
+        history: List[Dict[str, Any]] = []
+        while True:
+            try:
+                result = self._run_attempt(manager, resume, history)
+                result.metrics_history = history
+                return result
+            except _GangFailure as failure:
+                attempt += 1
+                if failures_allowed != -1 and attempt > failures_allowed:
+                    return Result(
+                        metrics=history[-1] if history else {},
+                        checkpoint=manager.latest_checkpoint(),
+                        error=str(failure),
+                        metrics_history=history)
+                logger.warning(
+                    "training gang failed (attempt %d/%s): %s — restarting "
+                    "from latest checkpoint", attempt,
+                    failures_allowed if failures_allowed != -1 else "inf",
+                    failure)
+                resume = manager.latest_checkpoint() or \
+                    self._resume_checkpoint
+
+    def _run_attempt(self, manager: CheckpointManager,
+                     resume: Optional[Checkpoint],
+                     history: List[Dict[str, Any]]) -> Result:
+        group = WorkerGroup(self.scaling_config)
+        try:
+            group.start()
+            group.setup_backend()
+            shards = self._shard_datasets()
+            group.run(self._fn, self._config, shards, resume)
+            last_metrics: Dict[str, Any] = {}
+            while True:
+                try:
+                    polls = group.poll(timeout=1.0)
+                except RayTpuError as e:
+                    raise _GangFailure(f"worker poll failed: {e}") from e
+                round_metrics: List[Dict[str, Any]] = []
+                for poll in polls:
+                    if poll["error"]:
+                        raise _TrainLoopError(poll["error"])
+                    for item in poll["results"]:
+                        round_metrics.append(item)
+                        if item["checkpoint"] is not None and \
+                                item["rank"] == 0:
+                            manager.register(item["checkpoint"],
+                                             item["metrics"])
+                for item in round_metrics:
+                    if item["rank"] == 0:
+                        last_metrics = item["metrics"]
+                        history.append(last_metrics)
+                if all(p["finished"] for p in polls):
+                    break
+            return Result(metrics=last_metrics,
+                          checkpoint=manager.latest_checkpoint())
+        except _TrainLoopError as e:
+            # deterministic user-code error: do not retry
+            return Result(metrics={}, checkpoint=manager.latest_checkpoint(),
+                          error=str(e))
+        finally:
+            group.shutdown()
+
+    def _shard_datasets(self) -> Optional[List[Any]]:
+        if not self.datasets:
+            return None
+        n = self.scaling_config.num_workers
+        shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, dataset in self.datasets.items():
+            if hasattr(dataset, "split"):
+                parts = dataset.split(n)
+            else:
+                parts = [dataset] * n
+            for i in range(n):
+                shards[i][name] = parts[i]
+        return shards
+
+
+class _GangFailure(RuntimeError):
+    pass
+
+
+class _TrainLoopError(RuntimeError):
+    pass

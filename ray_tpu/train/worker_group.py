@@ -1,0 +1,202 @@
+"""Training worker gangs.
+
+Parity: reference ``python/ray/train/_internal/worker_group.py`` (actor
+gang) + ``backend_executor.py`` (backend lifecycle).  A
+:class:`WorkerGroup` places N ``TrainWorker`` actors inside a placement
+group (PACK over a TPU slice by default) and runs the same function on
+every worker in lockstep — the property multi-host jax requires
+(SURVEY.md §7 hard parts: all hosts must execute the same program).
+
+The jax backend replaces the reference's torch-process-group bootstrap
+(``train/torch/config.py:69-113``): worker 0 picks a coordinator port and
+every worker calls ``jax.distributed.initialize(coordinator, n, rank)``
+before user code runs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+logger = logging.getLogger(__name__)
+
+
+class TrainWorker:
+    """Actor hosting one training process (one per TPU host)."""
+
+    def __init__(self, world_rank: int, world_size: int):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self._thread: Optional[threading.Thread] = None
+        self._session: Optional[session_mod._TrainSession] = None
+
+    def hostname_and_port(self) -> tuple:
+        """Reserve a coordinator port (called on rank 0 only)."""
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return (socket.gethostbyname(socket.gethostname()), port)
+
+    def setup_jax(self, coordinator: Optional[str], use_tpu: bool) -> bool:
+        """Initialize the jax runtime for this worker.
+
+        On TPU hosts, clears the CPU pin set by the worker bootstrap so
+        jax grabs the chips; multi-host gangs rendezvous at the rank-0
+        coordinator (the torch TCP-store analog).
+        """
+        if use_tpu:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        if coordinator is not None and self.world_size > 1 and use_tpu:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=self.world_size,
+                process_id=self.world_rank)
+        return True
+
+    def run(self, fn: Callable, config: Dict[str, Any],
+            dataset_shard: Any = None, resume_checkpoint=None) -> bool:
+        """Start the user loop on a background thread; returns
+        immediately.  Results stream via ``next_results``."""
+        self._session = session_mod._TrainSession(
+            self.world_rank, self.world_size, local_rank=0,
+            dataset_shard=dataset_shard)
+        self._session.resume_checkpoint = resume_checkpoint
+        session_mod._set_session(self._session)
+
+        def _target():
+            try:
+                fn(config)
+            except BaseException as e:  # noqa: BLE001 — forwarded to driver
+                logger.exception("train loop failed on rank %d",
+                                 self.world_rank)
+                self._session.error = e
+            finally:
+                self._session.finished.set()
+
+        self._thread = threading.Thread(target=_target, daemon=True,
+                                        name="train-loop")
+        self._thread.start()
+        return True
+
+    def next_results(self, timeout: float = 1.0) -> Dict[str, Any]:
+        """Drain queued results; reports liveness and errors."""
+        assert self._session is not None
+        results: List[Dict[str, Any]] = []
+        try:
+            results.append(self._session.result_queue.get(timeout=timeout))
+            while True:
+                results.append(self._session.result_queue.get_nowait())
+        except queue.Empty:
+            pass
+        error = None
+        if self._session.error is not None:
+            import traceback
+
+            error = "".join(traceback.format_exception(self._session.error))
+        return {
+            "results": results,
+            "finished": self._session.finished.is_set()
+                        and self._session.result_queue.empty(),
+            "error": error,
+        }
+
+    def shutdown_jax(self) -> bool:
+        try:
+            import jax
+
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, scaling: ScalingConfig):
+        self.scaling = scaling
+        self.pg: Optional[PlacementGroup] = None
+        self.workers: List[Any] = []
+
+    def start(self) -> None:
+        bundles = [self.scaling.worker_resources()
+                   for _ in range(self.scaling.num_workers)]
+        self.pg = placement_group(bundles,
+                                  strategy=self.scaling.placement_strategy)
+        if not self.pg.wait(120):
+            remove_placement_group(self.pg)
+            raise RuntimeError(
+                f"could not place training gang: {bundles} "
+                f"({self.scaling.placement_strategy})")
+        actor_cls = ray_tpu.remote(TrainWorker)
+        self.workers = []
+        for rank in range(self.scaling.num_workers):
+            strategy = PlacementGroupSchedulingStrategy(
+                placement_group=self.pg,
+                placement_group_bundle_index=rank)
+            worker = actor_cls.options(
+                num_cpus=self.scaling.cpus_per_worker,
+                num_tpus=self.scaling.tpus_per_worker or None,
+                resources=self.scaling.resources_per_worker or None,
+                scheduling_strategy=strategy,
+                max_concurrency=4,  # run + poll concurrently
+            ).remote(rank, self.scaling.num_workers)
+            self.workers.append(worker)
+        # barrier: all actors alive
+        ray_tpu.get([w.__ray_ready__() for w in self.workers], timeout=300)
+
+    def setup_backend(self) -> None:
+        use_tpu = (self.scaling.tpus_per_worker or 0) > 0
+        coordinator = None
+        if self.scaling.num_workers > 1 and use_tpu:
+            host, port = ray_tpu.get(
+                self.workers[0].hostname_and_port.remote(), timeout=60)
+            coordinator = f"{host}:{port}"
+        ray_tpu.get([w.setup_jax.remote(coordinator, use_tpu)
+                     for w in self.workers], timeout=600)
+
+    def run(self, fn: Callable, config: Dict[str, Any],
+            dataset_shards: Optional[List[Any]] = None,
+            resume_checkpoint=None) -> None:
+        ray_tpu.get([
+            w.run.remote(fn, config,
+                         dataset_shards[i] if dataset_shards else None,
+                         resume_checkpoint)
+            for i, w in enumerate(self.workers)
+        ], timeout=300)
+
+    def poll(self, timeout: float = 1.0) -> List[Dict[str, Any]]:
+        return ray_tpu.get(
+            [w.next_results.remote(timeout) for w in self.workers],
+            timeout=max(60.0, timeout * 10))
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None

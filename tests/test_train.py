@@ -1,0 +1,123 @@
+"""JaxTrainer integration tests (parity model: reference
+python/ray/train/tests/test_data_parallel_trainer.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    session,
+)
+
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+def test_single_worker_reports_metrics():
+    def loop(config):
+        for step in range(3):
+            session.report({"step": step, "loss": 1.0 / (step + 1)})
+
+    trainer = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_multi_worker_ranks():
+    def loop(config):
+        session.report({
+            "rank": session.get_world_rank(),
+            "world": session.get_world_size(),
+        })
+
+    trainer = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["world"] == 2
+
+
+def test_train_loop_config_passed():
+    def loop(config):
+        session.report({"doubled": config["x"] * 2})
+
+    trainer = JaxTrainer(loop, train_loop_config={"x": 21},
+                         scaling_config=ScalingConfig(num_workers=1))
+    assert trainer.fit().metrics["doubled"] == 42
+
+
+def test_checkpoints_persisted(tmp_path):
+    def loop(config):
+        for step in range(3):
+            ckpt = Checkpoint.from_dict({"weights": [step] * 3,
+                                         "metrics": {"step": step}})
+            session.report({"step": step}, checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=2)),
+    )
+    result = trainer.fit()
+    assert result.checkpoint is not None
+    import pickle
+
+    data = result.checkpoint.to_dict()
+    weights = pickle.loads(data["weights"])
+    assert weights == [2, 2, 2]
+
+
+def test_user_error_not_retried(tmp_path):
+    def loop(config):
+        raise ValueError("bad hyperparameters")
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+    assert "bad hyperparameters" in result.error
+
+
+def test_jax_training_loop_on_workers():
+    """An actual jax training loop (CPU) inside the gang."""
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        w = jnp.zeros((4,))
+        tx = optax.sgd(0.1)
+        opt = tx.init(w)
+        data_x = jnp.ones((8, 4))
+        data_y = jnp.full((8,), 2.0)
+
+        @jax.jit
+        def step(w, opt):
+            def loss(w):
+                return jnp.mean((data_x @ w - data_y) ** 2)
+
+            l, g = jax.value_and_grad(loss)(w)
+            updates, opt = tx.update(g, opt)
+            return optax.apply_updates(w, updates), opt, l
+
+        for i in range(20):
+            w, opt, l = step(w, opt)
+        session.report({"final_loss": float(l)})
+
+    trainer = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["final_loss"] < 0.1
