@@ -1,0 +1,114 @@
+"""User-style end-to-end drive of ray_tpu through its public API."""
+import os, sys, time, json, urllib.request
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import ray_tpu
+from ray_tpu import data as rdata, tune, serve
+from ray_tpu.serve.http_proxy import start_proxy
+
+t_all = time.time()
+info = ray_tpu.init(num_cpus=4, object_store_memory=256*1024*1024)
+print(f"[1 init] cluster up in {time.time()-t_all:.1f}s session={info['session_dir']}")
+
+# -- tasks: cross-function dependency chain (lease-return fix) --
+@ray_tpu.remote
+def square(x): return x * x
+@ray_tpu.remote
+def add(a, b): return a + b
+t0 = time.time()
+refs = [add.remote(square.remote(i), square.remote(i+1)) for i in range(20)]
+out = ray_tpu.get(refs, timeout=60)
+print(f"[2 tasks] 60 chained tasks -> {out[:3]}... in {time.time()-t0:.2f}s")
+assert out == [i*i + (i+1)*(i+1) for i in range(20)]
+
+# -- actors: ordering + more actors than CPUs (CPU:0 default fix) --
+@ray_tpu.remote
+class Counter:
+    def __init__(self): self.n = 0
+    def incr(self): self.n += 1; return self.n
+t0 = time.time()
+actors = [Counter.remote() for _ in range(8)]  # 8 actors > 4 CPUs
+vals = ray_tpu.get([a.incr.remote() for a in actors], timeout=120)
+assert vals == [1]*8, vals
+c = actors[0]
+seq = ray_tpu.get([c.incr.remote() for _ in range(30)], timeout=60)
+assert seq == list(range(2, 32)), "ordering broken"
+print(f"[3 actors] 8 actors on 4 CPUs + 30 ordered calls in {time.time()-t0:.2f}s")
+
+# -- data: pipeline over the object plane --
+t0 = time.time()
+ds = rdata.range(1000, parallelism=8).map_batches(lambda b: {"id": b["id"]*2})
+ds = ds.random_shuffle(seed=1)
+total = ds.sum("id")
+assert total == sum(i*2 for i in range(1000))
+batch = next(iter(ds.iter_batches(batch_size=128)))
+print(f"[4 data] shuffle+sum ok, batch shape {batch['id'].shape} in {time.time()-t0:.2f}s")
+
+# -- tune: small sweep with early stopping --
+def trainable(config):
+    for i in range(8):
+        tune.report({"loss": config["lr"] * (8 - i)})
+t0 = time.time()
+res = tune.run(trainable, config={"lr": tune.grid_search([0.1, 1.0, 4.0])},
+               scheduler=tune.AsyncHyperBandScheduler(metric="loss", mode="min", max_t=8, grace_period=2, reduction_factor=2),
+               metric="loss", mode="min")
+best = res.get_best_result()
+print(f"[5 tune] 3 trials, best lr={best.config['lr']} loss={best.metrics['loss']} in {time.time()-t0:.2f}s")
+assert best.config["lr"] == 0.1
+
+# -- serve: deployment + real HTTP request --
+@serve.deployment(num_replicas=2)
+class Model:
+    def __init__(self):
+        self.w = np.arange(4.0)
+    def __call__(self, payload):
+        x = np.asarray(payload["x"], dtype=float)
+        return {"y": float(x @ self.w)}
+t0 = time.time()
+handle = serve.run(Model.bind())
+r = ray_tpu.get(handle.remote({"x": [1, 1, 1, 1]}), timeout=60)
+assert r["y"] == 6.0
+host, port = start_proxy()
+req = urllib.request.Request(f"http://{host}:{port}/Model",
+                             data=json.dumps({"x": [0, 1, 2, 3]}).encode())
+body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+assert body["result"]["y"] == 14.0
+print(f"[6 serve] 2 replicas, handle+HTTP ok (y={body['result']['y']}) in {time.time()-t0:.2f}s")
+
+# -- probes --
+# P1: HTTP request to nonexistent deployment
+try:
+    urllib.request.urlopen(urllib.request.Request(
+        f"http://{host}:{port}/NoSuchThing", data=b'{}'), timeout=30)
+    print("[P1] UNEXPECTED: no error for missing deployment")
+except urllib.error.HTTPError as e:
+    print(f"[P1 probe] missing deployment -> HTTP {e.code}: {json.loads(e.read())['error'][:60]}")
+
+# P2: task raising an exception propagates
+@ray_tpu.remote
+def boom(): raise ValueError("kapow")
+try:
+    ray_tpu.get(boom.remote(), timeout=30)
+    print("[P2] UNEXPECTED: no exception")
+except Exception as e:
+    print(f"[P2 probe] task error -> {type(e).__name__}: {str(e)[:80]}")
+
+# P3: named actor dies when owning handle dropped (new GC semantics)
+h = Counter.options(name="ephemeral").remote()
+ray_tpu.get(h.incr.remote(), timeout=30)
+del h
+time.sleep(1.0)
+try:
+    h2 = ray_tpu.get_actor("ephemeral")
+    v = ray_tpu.get(h2.incr.remote(), timeout=10)
+    print(f"[P3] handle-drop: actor still alive (v={v}) — GC kill did not land")
+except Exception as e:
+    print(f"[P3 probe] dropped handle -> actor gone ({type(e).__name__})")
+
+serve.shutdown()
+t0 = time.time()
+ray_tpu.shutdown()
+print(f"[7 shutdown] clean in {time.time()-t0:.2f}s; total {time.time()-t_all:.1f}s")
+# P4: double shutdown is a no-op
+ray_tpu.shutdown()
+print("[P4 probe] double shutdown -> no error")

@@ -50,12 +50,25 @@ class ActorMethod:
             f"use .remote()")
 
 
+# per-process count of owned handles per actor; when the creator process
+# drops its last handle the actor is killed (parity: reference actor handle
+# reference counting — non-detached actors die with their owner scope)
+_owned_handle_counts: Dict[bytes, int] = {}
+_handle_lock = threading.Lock()
+
+
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str = "",
-                 max_task_retries: int = 0):
+                 max_task_retries: int = 0, owned: bool = False):
         self._actor_id = actor_id
         self._class_name = class_name
         self._max_task_retries = max_task_retries
+        self._owned = owned
+        if owned:
+            with _handle_lock:
+                key = actor_id.binary()
+                _owned_handle_counts[key] = \
+                    _owned_handle_counts.get(key, 0) + 1
 
     @property
     def actor_id(self) -> ActorID:
@@ -70,12 +83,39 @@ class ActorHandle:
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
+        # copies in other processes are borrows, not owners
         return (ActorHandle,
                 (self._actor_id, self._class_name, self._max_task_retries))
+
+    def __del__(self):
+        if not getattr(self, "_owned", False):
+            return
+        key = self._actor_id.binary()
+        with _handle_lock:
+            n = _owned_handle_counts.get(key, 1) - 1
+            if n > 0:
+                _owned_handle_counts[key] = n
+                return
+            _owned_handle_counts.pop(key, None)
+        try:
+            core = worker_mod.global_worker_or_none()
+            if core is not None:
+                core.kill_actor_async(self._actor_id)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def _detach(self) -> "ActorHandle":
+        """Return a non-owning copy (the actor outlives this handle)."""
+        return ActorHandle(self._actor_id, self._class_name,
+                           self._max_task_retries)
 
     def __ray_ready__(self) -> ObjectRef:
         """Ref resolving once the actor can serve calls."""
         return ActorMethod(self, "__rtpu_ping__").remote()
+
+
+def _rebuild_actor_class(cls, options):
+    return ActorClass(cls, **options)
 
 
 class ActorClass:
@@ -91,6 +131,11 @@ class ActorClass:
         raise TypeError(
             f"Actor class {self._descriptor} cannot be instantiated "
             f"directly; use .remote()")
+
+    def __reduce__(self):
+        # actor classes travel inside closures/args of tasks (parity:
+        # ActorClass.__getstate__); rebuild from the plain class
+        return (_rebuild_actor_class, (self._cls, self._options))
 
     def options(self, **options) -> "ActorClass":
         merged = dict(self._options)
@@ -113,7 +158,10 @@ class ActorClass:
         class_id = self._export(core)
         opts = self._options
         resources = dict(opts.get("resources") or {})
-        resources.setdefault("CPU", float(opts.get("num_cpus") if opts.get("num_cpus") is not None else 1))
+        # actors default to zero CPUs for their lifetime (parity: reference
+        # actor.py — creation is cheap, a per-actor CPU would deadlock
+        # workloads with more actors than cores)
+        resources.setdefault("CPU", float(opts.get("num_cpus") or 0))
         if opts.get("num_tpus"):
             resources["TPU"] = float(opts["num_tpus"])
         if opts.get("num_gpus"):
@@ -138,7 +186,8 @@ class ActorClass:
             get_if_exists=bool(opts.get("get_if_exists", False)),
         )
         return ActorHandle(actor_id, self._descriptor,
-                           max_task_retries=creation.max_task_retries)
+                           max_task_retries=creation.max_task_retries,
+                           owned=not creation.lifetime_detached)
 
 
 def _wrap_actor_class(cls):

@@ -45,9 +45,11 @@ class Config:
     #: workers (pipelining depth).
     max_tasks_in_flight_per_worker: int = 10
     #: Seconds a leased idle worker is kept before being returned.
-    idle_worker_lease_timeout_s: float = 1.0
+    idle_worker_lease_timeout_s: float = 0.25
     #: Number of workers each raylet keeps pre-started.
-    num_prestart_workers: int = 0
+    #: workers to warm up at raylet start; -1 = auto (min(4, num CPUs)),
+    #: parity: reference ``prestart_worker_first_driver``
+    num_prestart_workers: int = -1
     #: Hard cap on workers a raylet will spawn (0 = 4 * num_cpus).
     max_workers_per_node: int = 0
 

@@ -51,6 +51,9 @@ class WorkerHandle:
     conn: rpc.Connection
     task_address: rpc.Address  # the worker's own task server
     proc: Optional[subprocess.Popen] = None
+    # whether this process kept the host's accelerator plugin env (slow to
+    # import); plain pool workers strip it for fast startup
+    tpu_capable: bool = True
     # lease state
     leased: bool = False
     lease_resources: Dict[str, float] = field(default_factory=dict)
@@ -111,8 +114,8 @@ class Raylet:
         os.makedirs(self._spill_dir, exist_ok=True)
         self._pull_locks: Dict[ObjectID, asyncio.Lock] = {}
 
-        # worker pool
-        self._spawned_procs: List[Tuple[subprocess.Popen, float]] = []
+        # worker pool: spawned-but-unregistered procs as (proc, tpu_capable)
+        self._spawned_procs: List[Tuple[subprocess.Popen, bool]] = []
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: List[WorkerHandle] = []
         self._starting = 0
@@ -148,7 +151,10 @@ class Raylet:
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._health_loop()))
         self._tasks.append(loop.create_task(self._reap_loop()))
-        for _ in range(self.config.num_prestart_workers):
+        n_prestart = self.config.num_prestart_workers
+        if n_prestart < 0:
+            n_prestart = min(4, int(self.resources_total.get("CPU", 1)))
+        for _ in range(n_prestart):
             self._start_worker(None)
         logger.info("raylet %s on %s resources=%s",
                     self.node_id.hex()[:12], address, self.resources_total)
@@ -202,17 +208,34 @@ class Raylet:
             for w in list(self.workers.values()):
                 if w.proc is not None and w.proc.poll() is not None:
                     self._on_worker_dead(w, f"exit code {w.proc.returncode}")
+            # workers that died before registering (startup crash)
+            for entry in list(self._spawned_procs):
+                proc, _ = entry
+                if proc.poll() is not None:
+                    self._spawned_procs.remove(entry)
+                    self._starting -= 1
+                    logger.warning("worker pid %d died before registering "
+                                   "(exit %d)", proc.pid, proc.returncode)
+                    self._maybe_schedule()
             await asyncio.sleep(0.2)
 
     # ------------------------------------------------------------------
     # worker pool
     # ------------------------------------------------------------------
-    def _start_worker(self, job_id_bin: Optional[bytes]) -> None:
+    def _start_worker(self, job_id_bin: Optional[bytes],
+                      needs_tpu: bool = False) -> None:
         if self._starting + len(self.workers) >= self._max_workers:
             return
         self._starting += 1
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
+        tpu_capable = True
+        if not needs_tpu and env.get("PALLAS_AXON_POOL_IPS"):
+            # plain pool workers skip the accelerator-plugin sitecustomize
+            # (it imports jax at interpreter start, ~2s); only workers that
+            # may lease TPU chips pay that cost
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            tpu_capable = False
         log_base = os.path.join(self.session_dir, "logs",
                                 f"worker-{os.getpid()}-{self._starting}-{time.monotonic_ns()}")
         os.makedirs(os.path.dirname(log_base), exist_ok=True)
@@ -232,7 +255,7 @@ class Raylet:
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err,
                                 cwd=os.getcwd())
         # handle registered later in handle_register_worker; remember proc
-        self._spawned_procs.append((proc, time.monotonic()))
+        self._spawned_procs.append((proc, tpu_capable))
 
     async def handle_register_worker(self, conn, data):
         if data.get("is_driver"):
@@ -248,10 +271,12 @@ class Raylet:
             task_address=tuple(data["task_address"]),
         )
         # adopt the spawned process handle if this pid is one of ours
-        for proc, _ in list(self._spawned_procs):
+        for entry in list(self._spawned_procs):
+            proc, tpu_capable = entry
             if proc.pid == worker.pid:
                 worker.proc = proc
-                self._spawned_procs.remove((proc, _))
+                worker.tpu_capable = tpu_capable
+                self._spawned_procs.remove(entry)
                 self._starting -= 1
                 break
         conn.context["worker_id"] = worker.worker_id
@@ -418,6 +443,7 @@ class Raylet:
         if self._closing:
             return
         remaining: List[PendingLease] = []
+        want_workers: List[Optional[bytes]] = []
         for lease in self._pending_leases:
             if lease.future.done():
                 continue
@@ -432,11 +458,11 @@ class Raylet:
                         continue
                 remaining.append(lease)
                 continue
-            worker = self._pop_idle(lease.job_id_bin)
+            needs_tpu = lease.resources.get("TPU", 0) > 0
+            worker = self._pop_idle(lease.job_id_bin, needs_tpu)
             if worker is None:
                 remaining.append(lease)
-                if self._starting == 0 or len(self._idle) == 0:
-                    self._start_worker(lease.job_id_bin)
+                want_workers.append((lease.job_id_bin, needs_tpu))
                 continue
             self._take(lease.resources, lease.bundle)
             worker.leased = True
@@ -448,11 +474,19 @@ class Raylet:
                 "worker_id": worker.worker_id.binary(),
             })
         self._pending_leases = remaining
+        # spawn exactly enough workers to cover unmet (schedulable) demand —
+        # one per waiting lease, minus those already starting (parity:
+        # WorkerPool::PrestartWorkers demand accounting)
+        for job_id_bin, needs_tpu in want_workers[self._starting:]:
+            self._start_worker(job_id_bin, needs_tpu)
 
-    def _pop_idle(self, job_id_bin: Optional[bytes]) -> Optional[WorkerHandle]:
+    def _pop_idle(self, job_id_bin: Optional[bytes],
+                  needs_tpu: bool = False) -> Optional[WorkerHandle]:
         # job-dedicated workers: a worker that has loaded job code serves
         # only that job (parity: WorkerPool per-job isolation)
         for i, w in enumerate(self._idle):
+            if needs_tpu and not w.tpu_capable:
+                continue
             if w.job_id_bin is None or job_id_bin is None or \
                     w.job_id_bin == job_id_bin:
                 return self._idle.pop(i)

@@ -139,14 +139,24 @@ class Connection:
             except Exception:
                 self._teardown()
 
-    async def call(self, method: str, data: Any = None,
-                   timeout: Optional[float] = None) -> Any:
+    def start_call(self, method: str, data: Any = None) -> asyncio.Future:
+        """Write the request frame now and return the reply future.
+
+        The frame hits the stream before this returns, so callers that need
+        ordered delivery (e.g. per-actor sequential submission) can sequence
+        their ``start_call``s without waiting for replies.
+        """
         if self._closed:
             raise ConnectionLost()
         msg_id = next(self._msg_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
         _write_frame(self._writer, (msg_id, KIND_REQ, method, data))
+        return fut
+
+    async def call(self, method: str, data: Any = None,
+                   timeout: Optional[float] = None) -> Any:
+        fut = self.start_call(method, data)
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)
@@ -223,11 +233,16 @@ class Server:
             handler(conn, data)
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        # close live connections BEFORE wait_closed(): since 3.12
+        # wait_closed blocks until every connection handler finishes
         for conn in list(self.connections):
             conn.close()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
 
 
 async def connect(address: Address, handler: Optional[Server] = None,

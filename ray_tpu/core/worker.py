@@ -175,6 +175,7 @@ class CoreWorker:
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
         self._exec_threads: List[threading.Thread] = []
         self._function_cache: Dict[str, Any] = {}
+        self._syspath_applied: set = set()
         self._actor_instance: Any = None
         self._actor_id: Optional[ActorID] = None
         self._actor_creation_spec: Optional[ActorCreationSpec] = None
@@ -225,6 +226,17 @@ class CoreWorker:
             reply = await self.gcs_conn.call(
                 "register_job", {"driver_address": self.task_address})
             self.job_id = JobID(reply["job_id"])
+            # publish the driver's import paths so workers can deserialize
+            # by-reference functions from driver-side modules (parity:
+            # the reference's working_dir runtime env / function manager)
+            import sys as _sys
+
+            paths = [p for p in _sys.path
+                     if p and os.path.isdir(p)][:64]
+            await self.gcs_conn.call("kv_put", {
+                "key": f"syspath:{self.job_id.hex()}",
+                "value": cloudpickle.dumps(paths),
+                "namespace": "_internal"})
         self.raylet_conn = await rpc.connect(self.raylet_address,
                                              handler=self.task_server)
         if self.mode == "worker":
@@ -777,6 +789,16 @@ class CoreWorker:
             while len(state.backlog) > reserve and \
                     worker.inflight < self.config.max_tasks_in_flight_per_worker:
                 self._dispatch_to_worker(state, worker)
+        # Phase 4 — arm a return timer on every lease left idle, so leased
+        # resources flow back to the raylet for other scheduling keys
+        # (leaked leases deadlock the node once CPUs are exhausted)
+        if not state.backlog:
+            for worker in list(state.workers.values()):
+                if worker.inflight == 0 and worker.return_handle is None:
+                    worker.return_handle = self._loop.call_later(
+                        self.config.idle_worker_lease_timeout_s,
+                        lambda w=worker, s=state: self._loop.create_task(
+                            self._return_lease(s, w)))
 
     def _dispatch_to_worker(self, state: "_LeaseState",
                             worker: "_LeasedWorker") -> None:
@@ -784,14 +806,6 @@ class CoreWorker:
         worker.inflight += 1
         task = self._loop.create_task(self._push_task(state, worker, spec))
         task.add_done_callback(lambda t: t.exception())
-        # return idle leases
-        for worker in list(state.workers.values()):
-            if worker.inflight == 0 and not state.backlog and \
-                    worker.return_handle is None:
-                worker.return_handle = self._loop.call_later(
-                    self.config.idle_worker_lease_timeout_s,
-                    lambda w=worker, s=state: self._loop.create_task(
-                        self._return_lease(s, w)))
 
     async def _request_lease(self, state: "_LeaseState") -> None:
         """One lease acquisition (follows spillback redirects); holds one
@@ -1012,36 +1026,58 @@ class CoreWorker:
         spec.sequence_number = state.next_seq
         state.next_seq += 1
         state.pending[spec.sequence_number] = spec
-        task = self._loop.create_task(self._drive_actor_task(state, spec))
-        task.add_done_callback(lambda t: t.exception())
+        state.queue.append(spec)
+        self._kick_actor_sender(state)
 
-    async def _drive_actor_task(self, state: "_ActorSubmitState",
-                                spec: TaskSpec) -> None:
-        try:
-            address = await self._resolve_actor_address(state)
-        except ActorDiedError as e:
-            state.pending.pop(spec.sequence_number, None)
-            self._fail_task(spec, e)
-            return
-        try:
-            conn = await self._pool.get(address)
+    def _kick_actor_sender(self, state: "_ActorSubmitState") -> None:
+        if state.sender_task is None or state.sender_task.done():
+            state.sender_task = self._loop.create_task(
+                self._actor_sender_loop(state))
+            state.sender_task.add_done_callback(lambda t: t.exception())
+
+    async def _actor_sender_loop(self, state: "_ActorSubmitState") -> None:
+        """Drain the per-actor submit queue, initiating the RPC writes in
+        sequence-number order (parity: ``SequentialActorSubmitQueue``).  The
+        write happens synchronously via ``start_call`` so frames hit the TCP
+        stream in order; replies resolve concurrently (pipelined)."""
+        while state.queue:
+            spec = state.queue.popleft()
+            try:
+                address = await self._resolve_actor_address(state)
+                conn = await self._pool.get(address)
+            except ActorDiedError as e:
+                state.pending.pop(spec.sequence_number, None)
+                self._fail_task(spec, e)
+                continue
+            except (rpc.ConnectionLost, rpc.RpcError, OSError):
+                state.address = None
+                await self._retry_or_fail_actor_task(state, spec,
+                                                     "connect failed")
+                continue
             self._record_task_event(spec, "RUNNING")
-            reply = await conn.call(
-                "push_actor_task", {"spec_blob": cloudpickle.dumps(spec)},
-                timeout=None)
+            try:
+                reply_fut = conn.start_call(
+                    "push_actor_task", {"spec_blob": cloudpickle.dumps(spec)})
+            except rpc.ConnectionLost:
+                self._pool.invalidate(address)
+                state.address = None
+                await self._retry_or_fail_actor_task(state, spec,
+                                                     "connection lost")
+                continue
+            waiter = self._loop.create_task(
+                self._await_actor_reply(state, spec, address, reply_fut))
+            waiter.add_done_callback(lambda t: t.exception())
+
+    async def _await_actor_reply(self, state: "_ActorSubmitState",
+                                 spec: TaskSpec, address: rpc.Address,
+                                 reply_fut) -> None:
+        try:
+            reply = await reply_fut
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             self._pool.invalidate(address)
             state.address = None
-            # the actor may be restarting; re-resolve and retry if allowed
-            if spec.max_retries > 0:
-                retry_spec = self.task_manager.take_for_retry(spec.task_id)
-                if retry_spec is not None:
-                    await asyncio.sleep(0.1)
-                    await self._drive_actor_task(state, retry_spec)
-                    return
-            state.pending.pop(spec.sequence_number, None)
-            self._fail_task(spec, ActorDiedError(
-                spec.actor_id.hex()[:12], f"connection lost: {e}"))
+            await self._retry_or_fail_actor_task(
+                state, spec, f"connection lost: {e}")
             return
         state.pending.pop(spec.sequence_number, None)
         if reply.get("actor_dead"):
@@ -1049,6 +1085,32 @@ class CoreWorker:
                 spec.actor_id.hex()[:12], reply.get("reason", "")))
             return
         self._handle_task_reply(spec, reply)
+
+    async def _retry_or_fail_actor_task(self, state: "_ActorSubmitState",
+                                        spec: TaskSpec, reason: str) -> None:
+        # the actor may be restarting; re-resolve and retry if allowed
+        if spec.max_retries > 0:
+            retry_spec = self.task_manager.take_for_retry(spec.task_id)
+            if retry_spec is not None:
+                retry_spec.sequence_number = spec.sequence_number
+                state.pending[spec.sequence_number] = retry_spec
+
+                def _requeue():
+                    # keep the queue sorted by sequence number so a retried
+                    # task runs before later submissions (in-order contract)
+                    state.queue.append(retry_spec)
+                    ordered = sorted(state.queue,
+                                     key=lambda s: s.sequence_number)
+                    state.queue.clear()
+                    state.queue.extend(ordered)
+                    self._kick_actor_sender(state)
+
+                # backoff without stalling the sender loop for other tasks
+                self._loop.call_later(0.1, _requeue)
+                return
+        state.pending.pop(spec.sequence_number, None)
+        self._fail_task(spec, ActorDiedError(
+            spec.actor_id.hex()[:12], reason))
 
     async def _resolve_actor_address(self, state: "_ActorSubmitState"
                                      ) -> rpc.Address:
@@ -1077,6 +1139,32 @@ class CoreWorker:
         state = self._actor_states.get(actor_id)
         if state is not None:
             state.address = None
+
+    def kill_actor_async(self, actor_id: ActorID) -> None:
+        """Fire-and-forget kill, safe from GC/__del__ contexts (cannot
+        block on the event loop).  Defers the kill until this owner's
+        in-flight tasks to the actor have drained, so patterns like
+        ``get(Cls.remote().method.remote())`` (handle GC'd right after
+        submit) don't race the kill against the call."""
+        if self._shutdown or self.gcs_conn is None or self.gcs_conn.closed:
+            return
+
+        async def _kill():
+            deadline = time.monotonic() + 60.0
+            state = self._actor_states.get(actor_id)
+            while state is not None and time.monotonic() < deadline and \
+                    (state.pending or state.queue):
+                await asyncio.sleep(0.05)
+            try:
+                await self.gcs_conn.call("kill_actor",
+                                         {"actor_id": actor_id.binary()})
+            except Exception:  # noqa: BLE001
+                pass
+
+        try:
+            self._post(_kill())
+        except Exception:  # noqa: BLE001
+            pass
 
     def get_actor_info(self, *, actor_id: Optional[ActorID] = None,
                        name: Optional[str] = None,
@@ -1244,6 +1332,7 @@ class CoreWorker:
         if self.job_id is None:
             self.job_id = spec.job_id
         try:
+            self._apply_job_syspath(spec.job_id)
             args, kwargs = self._resolve_args(spec)
             fn = self._resolve_callable(spec)
             value = fn(*args, **kwargs)
@@ -1327,6 +1416,27 @@ class CoreWorker:
             return _construct
         return fn_or_class
 
+    def _apply_job_syspath(self, job_id: Optional[JobID]) -> None:
+        """Merge the driver's import paths into this worker (parity: the
+        reference's working_dir runtime env) so by-reference pickles of
+        driver-side modules can be deserialized."""
+        if job_id is None or job_id in self._syspath_applied:
+            return
+        try:
+            blob = self._run(self.gcs_conn.call("kv_get", {
+                "key": f"syspath:{job_id.hex()}", "namespace": "_internal"}))
+        except (rpc.ConnectionLost, rpc.RpcError):
+            return  # transient — retry on the next task
+        # mark applied only after a successful fetch
+        self._syspath_applied.add(job_id)
+        if not blob:
+            return
+        import sys as _sys
+
+        for p in cloudpickle.loads(blob):
+            if p not in _sys.path and os.path.isdir(p):
+                _sys.path.append(p)
+
     def _get_function(self, function_id: str) -> Callable:
         fn = self._function_cache.get(function_id)
         if fn is None:
@@ -1381,13 +1491,16 @@ class _LeaseState:
 
 
 class _ActorSubmitState:
-    __slots__ = ("actor_id", "address", "next_seq", "pending")
+    __slots__ = ("actor_id", "address", "next_seq", "pending", "queue",
+                 "sender_task")
 
     def __init__(self, actor_id: ActorID):
         self.actor_id = actor_id
         self.address: Optional[rpc.Address] = None
         self.next_seq = 0
         self.pending: Dict[int, TaskSpec] = {}
+        self.queue: deque = deque()
+        self.sender_task: Optional[asyncio.Task] = None
 
 
 def _deserialize_pinned(view: memoryview, pin: _Pin):

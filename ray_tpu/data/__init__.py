@@ -1,0 +1,23 @@
+"""ray_tpu.data — distributed datasets on the object plane.
+
+Parity: reference ``python/ray/data``.  See ``dataset.py`` for the block
+and execution model.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset, GroupedDataset  # noqa: F401
+from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from ray_tpu.data import preprocessors  # noqa: F401

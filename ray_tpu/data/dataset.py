@@ -1,0 +1,680 @@
+"""ray_tpu.data Dataset: distributed blocks on the object plane.
+
+Parity: reference ``python/ray/data/dataset.py`` (map_batches / shuffle /
+sort / split / zip / iter_batches / …) with the lazy ``ExecutionPlan`` of
+``data/_internal/plan.py:74``.  Blocks are ObjectRefs of numpy-column
+tables (see ``block.py``); per-block transforms are fused into a single
+task per block at execution time (the reference's stage fusion), and
+all-to-all ops (repartition/shuffle/sort) are barriers.
+
+TPU-first: ``iter_batches``/``to_jax`` produce contiguous numpy batches
+sized for the device, and ``split(n, locality_hints=…)`` places shards on
+the training gang's hosts the way Ray Train consumes
+``_internal/dataset_spec.py``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple, Union)
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (Block, BlockAccessor, BlockMetadata,
+                                batch_to_block, build_block, concat_blocks)
+
+# A stage is a named per-block transform: Block -> Block (or -> List[Block]).
+Stage = Tuple[str, Callable[[Block], Block]]
+
+
+def _apply_stages(block: Block, stages: List[Callable[[Block], Block]]) -> Block:
+    for fn in stages:
+        block = fn(block)
+    return block
+
+
+@ray_tpu.remote
+def _fused_map(block: Block, stages: List[Callable[[Block], Block]]) -> Block:
+    return _apply_stages(block, stages)
+
+
+@ray_tpu.remote
+def _fused_map_meta(block: Block, stages) -> Tuple[Block, BlockMetadata]:
+    out = _apply_stages(block, stages)
+    return out, BlockAccessor(out).metadata()
+
+
+@ray_tpu.remote
+def _concat_task(*blocks: Block) -> Block:
+    return concat_blocks(list(blocks))
+
+
+@ray_tpu.remote
+def _split_task(block: Block, bounds: List[int]) -> List[Block]:
+    acc = BlockAccessor(block)
+    return [acc.slice(s, e) for s, e in zip([0] + bounds, bounds + [acc.num_rows()])]
+
+
+@ray_tpu.remote
+def _shuffle_map(block: Block, n_reducers: int, seed: Optional[int],
+                 stages) -> List[Block]:
+    """Map side of the pull-based shuffle (parity: data/_internal/shuffle.py)."""
+    block = _apply_stages(block, stages)
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    assignment = rng.integers(0, n_reducers, size=n)
+    return [acc.take_indices(np.nonzero(assignment == r)[0])
+            for r in range(n_reducers)]
+
+
+@ray_tpu.remote
+def _shuffle_reduce(seed: Optional[int], *parts: Block) -> Block:
+    merged = concat_blocks(list(parts))
+    acc = BlockAccessor(merged)
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(acc.num_rows())
+    return acc.take_indices(idx)
+
+
+@ray_tpu.remote
+def _sort_sample(block: Block, key) -> np.ndarray:
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return np.asarray([])
+    if acc.is_table:
+        col = block[key] if isinstance(key, str) else key(block)
+    else:
+        col = np.asarray([key(r) if key else r for r in block])
+    k = min(16, len(col))
+    return np.sort(np.random.default_rng(0).choice(col, size=k, replace=False))
+
+
+@ray_tpu.remote
+def _sort_map(block: Block, key, boundaries: np.ndarray,
+              descending: bool) -> List[Block]:
+    acc = BlockAccessor(block)
+    idx = acc.sort_indices(key, descending) if acc.num_rows() else np.asarray([], int)
+    block = acc.take_indices(idx)
+    acc = BlockAccessor(block)
+    if acc.is_table:
+        col = block[key] if isinstance(key, str) else key(block)
+    else:
+        col = np.asarray([key(r) if key else r for r in block])
+    if descending:
+        cuts = len(col) - np.searchsorted(col[::-1], boundaries[::-1])
+        cuts = cuts[::-1]
+    else:
+        cuts = np.searchsorted(col, boundaries)
+    parts = []
+    prev = 0
+    for c in list(cuts) + [acc.num_rows()]:
+        parts.append(acc.slice(int(prev), int(c)))
+        prev = c
+    return parts
+
+
+@ray_tpu.remote
+def _sort_merge(key, descending: bool, *parts: Block) -> Block:
+    merged = concat_blocks(list(parts))
+    acc = BlockAccessor(merged)
+    if acc.num_rows() == 0:
+        return merged
+    return acc.take_indices(acc.sort_indices(key, descending))
+
+
+@ray_tpu.remote
+def _zip_task(a: Block, b: Block) -> Block:
+    aa, bb = BlockAccessor(a), BlockAccessor(b)
+    if aa.is_table and bb.is_table:
+        out = dict(a)
+        for k, v in b.items():
+            out[k if k not in out else k + "_1"] = v
+        return out
+    return [(x, y) for x, y in zip(aa.iter_rows(), bb.iter_rows())]
+
+
+@ray_tpu.remote
+def _groupby_map(block: Block, key, n_reducers: int, stages) -> List[Block]:
+    block = _apply_stages(block, stages)
+    acc = BlockAccessor(block)
+    if acc.num_rows() == 0:
+        return [[] for _ in range(n_reducers)]
+    if acc.is_table:
+        col = np.asarray(block[key])
+    else:
+        col = np.asarray([r[key] for r in block])
+    h = np.asarray([hash(x) % n_reducers for x in col])
+    return [acc.take_indices(np.nonzero(h == r)[0]) for r in range(n_reducers)]
+
+
+class Dataset:
+    """Distributed data pipeline (parity: reference ``data/dataset.py``)."""
+
+    def __init__(self, blocks: List[ray_tpu.ObjectRef],
+                 stages: Optional[List[Stage]] = None,
+                 metadata: Optional[List[Optional[BlockMetadata]]] = None):
+        self._blocks = list(blocks)
+        self._stages: List[Stage] = list(stages or [])
+        self._metadata = metadata if metadata and not self._stages else None
+
+    # ------------------------------------------------------------------
+    # plan & execution
+    # ------------------------------------------------------------------
+    def _with_stage(self, name: str, fn: Callable[[Block], Block]) -> "Dataset":
+        return Dataset(self._blocks, self._stages + [(name, fn)])
+
+    def materialize(self) -> "Dataset":
+        """Execute pending fused stages, one task per block (parity:
+        ``ExecutionPlan.execute`` plan.py:295)."""
+        if not self._stages:
+            return self
+        fns = [fn for _, fn in self._stages]
+        out = [_fused_map.remote(b, fns) for b in self._blocks]
+        return Dataset(out)
+
+    def fully_executed(self) -> "Dataset":
+        return self.materialize()
+
+    def _executed_blocks(self) -> List[ray_tpu.ObjectRef]:
+        return self.materialize()._blocks
+
+    def stats(self) -> str:
+        stages = " -> ".join(name for name, _ in self._stages) or "(materialized)"
+        return f"Dataset({self.num_blocks()} blocks): {stages}"
+
+    # ------------------------------------------------------------------
+    # transforms (lazy, fused per block)
+    # ------------------------------------------------------------------
+    def map_batches(self, fn: Callable[..., Any], *,
+                    batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    compute: Optional[Any] = None,
+                    fn_args: tuple = (), fn_kwargs: Optional[dict] = None,
+                    **_ignored) -> "Dataset":
+        fn_kwargs = fn_kwargs or {}
+
+        if compute is not None and getattr(compute, "is_actor_pool", False):
+            return self._map_batches_actors(fn, compute, batch_size,
+                                            batch_format, fn_args, fn_kwargs)
+
+        def stage(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            bs = batch_size or max(n, 1)
+            outs = []
+            for start in range(0, max(n, 1), bs):
+                sub = BlockAccessor(acc.slice(start, min(start + bs, n)))
+                if n == 0 and start > 0:
+                    break
+                res = fn(sub.to_batch(batch_format), *fn_args, **fn_kwargs)
+                outs.append(batch_to_block(res))
+            return concat_blocks(outs) if outs else block
+
+        return self._with_stage(f"map_batches({getattr(fn, '__name__', 'fn')})",
+                                stage)
+
+    def _map_batches_actors(self, fn, compute, batch_size, batch_format,
+                            fn_args, fn_kwargs) -> "Dataset":
+        """ActorPoolStrategy compute: callable-class transforms on a pool of
+        actors (parity: data/_internal/compute.py ActorPoolStrategy)."""
+        from ray_tpu.util.actor_pool import ActorPool
+
+        cls = fn if isinstance(fn, type) else None
+        if cls is None:
+            raise ValueError("ActorPoolStrategy requires a callable class")
+
+        @ray_tpu.remote
+        class _MapWorker:
+            def __init__(self):
+                self._fn = cls()
+
+            def apply(self, block, batch_size, batch_format, fn_args, fn_kwargs):
+                acc = BlockAccessor(block)
+                n = acc.num_rows()
+                bs = batch_size or max(n, 1)
+                outs = []
+                for start in range(0, max(n, 1), bs):
+                    sub = BlockAccessor(acc.slice(start, min(start + bs, n)))
+                    res = self._fn(sub.to_batch(batch_format),
+                                   *fn_args, **fn_kwargs)
+                    outs.append(batch_to_block(res))
+                return concat_blocks(outs) if outs else block
+
+        pool = ActorPool([_MapWorker.remote() for _ in range(compute.size)])
+        blocks = self._executed_blocks()
+        out = list(pool.map(
+            lambda a, b: a.apply.remote(b, batch_size, batch_format,
+                                        fn_args, fn_kwargs),
+            blocks))
+        # map() returns values; re-put to keep everything as refs
+        return Dataset([ray_tpu.put(b) for b in out])
+
+    def map(self, fn: Callable[[Any], Any], **kwargs) -> "Dataset":
+        def stage(block: Block) -> Block:
+            return build_block([fn(r) for r in BlockAccessor(block).iter_rows()])
+        return self._with_stage(f"map({getattr(fn, '__name__', 'fn')})", stage)
+
+    def flat_map(self, fn: Callable[[Any], Iterable[Any]], **kwargs) -> "Dataset":
+        def stage(block: Block) -> Block:
+            out: List[Any] = []
+            for r in BlockAccessor(block).iter_rows():
+                out.extend(fn(r))
+            return build_block(out)
+        return self._with_stage("flat_map", stage)
+
+    def filter(self, fn: Callable[[Any], bool], **kwargs) -> "Dataset":
+        def stage(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            if acc.is_table:
+                mask = np.asarray([bool(fn(r)) for r in acc.iter_rows()])
+                return acc.take_indices(np.nonzero(mask)[0])
+            return [r for r in acc.iter_rows() if fn(r)]
+        return self._with_stage("filter", stage)
+
+    def add_column(self, name: str, fn: Callable[[Block], np.ndarray]
+                   ) -> "Dataset":
+        def stage(block: Block) -> Block:
+            out = dict(block)
+            out[name] = np.asarray(fn(block))
+            return out
+        return self._with_stage(f"add_column({name})", stage)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def stage(block: Block) -> Block:
+            return {k: v for k, v in block.items() if k not in cols}
+        return self._with_stage("drop_columns", stage)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def stage(block: Block) -> Block:
+            return {k: block[k] for k in cols}
+        return self._with_stage("select_columns", stage)
+
+    # ------------------------------------------------------------------
+    # all-to-all ops (barriers)
+    # ------------------------------------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        blocks = self._executed_blocks()
+        merged = _concat_task.remote(*blocks)
+        total = self.count()
+        per = total // num_blocks
+        bounds = [per * i + min(i, total % num_blocks)
+                  for i in range(1, num_blocks)]
+        parts = _split_task.options(num_returns=num_blocks).remote(
+            merged, bounds)
+        if num_blocks == 1:
+            parts = [parts]
+        return Dataset(list(parts))
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        n_red = num_blocks or max(self.num_blocks(), 1)
+        fns = [fn for _, fn in self._stages]
+        maps = [_shuffle_map.options(num_returns=n_red).remote(
+            b, n_red, None if seed is None else seed + i, fns)
+            for i, b in enumerate(self._blocks)]
+        maps = [[m] if n_red == 1 else list(m) for m in maps]
+        reduces = [
+            _shuffle_reduce.remote(
+                None if seed is None else seed + 1000 + r,
+                *[m[r] for m in maps])
+            for r in range(n_red)
+        ]
+        return Dataset(reduces)
+
+    def sort(self, key: Optional[Union[str, Callable]] = None,
+             descending: bool = False) -> "Dataset":
+        blocks = self._executed_blocks()
+        if not blocks:
+            return self
+        n = len(blocks)
+        samples = ray_tpu.get([_sort_sample.remote(b, key) for b in blocks])
+        allsamp = np.sort(np.concatenate([s for s in samples if len(s)]))
+        if len(allsamp) == 0:
+            return Dataset(blocks)
+        qs = [allsamp[int(i * len(allsamp) / n)] for i in range(1, n)]
+        boundaries = np.asarray(qs)
+        if descending:
+            boundaries = boundaries[::-1]
+        maps = [_sort_map.options(num_returns=n).remote(
+            b, key, boundaries, descending) for b in blocks]
+        maps = [[m] if n == 1 else list(m) for m in maps]
+        merges = [_sort_merge.remote(key, descending, *[m[r] for m in maps])
+                  for r in range(n)]
+        return Dataset(merges)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        a = self.repartition(max(self.num_blocks(), 1))._blocks
+        b = other.repartition(len(a))._blocks
+        return Dataset([_zip_task.remote(x, y) for x, y in zip(a, b)])
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = list(self._executed_blocks())
+        for o in others:
+            blocks.extend(o._executed_blocks())
+        return Dataset(blocks)
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    def split(self, n: int, *, equal: bool = False,
+              locality_hints: Optional[List[Any]] = None) -> List["Dataset"]:
+        """Split into n sub-datasets by block (parity: data/_internal/split.py).
+        With ``equal=True`` rows are balanced exactly (needed by Train)."""
+        blocks = self._executed_blocks()
+        if equal:
+            total = self.count()
+            per = total // n
+            merged = _concat_task.remote(*blocks)
+            bounds = [per * (i + 1) for i in range(n - 1)]
+            parts = _split_task.options(num_returns=n).remote(merged, bounds)
+            if n == 1:
+                parts = [parts]
+            return [Dataset([p]) for p in parts]
+        out: List[List[ray_tpu.ObjectRef]] = [[] for _ in range(n)]
+        for i, b in enumerate(blocks):
+            out[i % n].append(b)
+        return [Dataset(bs) for bs in out]
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        blocks = self._executed_blocks()
+        merged = _concat_task.remote(*blocks)
+        n = len(indices) + 1
+        parts = _split_task.options(num_returns=n).remote(merged, list(indices))
+        if n == 1:
+            parts = [parts]
+        return [Dataset([p]) for p in parts]
+
+    def limit(self, n: int) -> "Dataset":
+        taken: List[ray_tpu.ObjectRef] = []
+        count = 0
+        for b in self._executed_blocks():
+            if count >= n:
+                break
+            blk = ray_tpu.get(b)
+            rows = BlockAccessor(blk).num_rows()
+            if count + rows > n:
+                blk = BlockAccessor(blk).slice(0, n - count)
+                taken.append(ray_tpu.put(blk))
+                count = n
+            else:
+                taken.append(b)
+                count += rows
+        return Dataset(taken)
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None
+                      ) -> "Dataset":
+        rng_seed = seed
+
+        def stage(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            rng = np.random.default_rng(rng_seed)
+            mask = rng.random(acc.num_rows()) < fraction
+            return acc.take_indices(np.nonzero(mask)[0])
+        return self._with_stage("random_sample", stage)
+
+    # ------------------------------------------------------------------
+    # consumption
+    # ------------------------------------------------------------------
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    def count(self) -> int:
+        return int(sum(BlockAccessor(b).num_rows()
+                       for b in ray_tpu.get(self._executed_blocks())))
+
+    def schema(self) -> Optional[Any]:
+        for b in self._executed_blocks():
+            blk = ray_tpu.get(b)
+            s = BlockAccessor(blk).schema()
+            if s is not None:
+                return s
+        return None
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for b in self._executed_blocks():
+            for row in BlockAccessor(ray_tpu.get(b)).iter_rows():
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for b in self._executed_blocks():
+            out.extend(BlockAccessor(ray_tpu.get(b)).iter_rows())
+        return out
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for b in self._executed_blocks():
+            yield from BlockAccessor(ray_tpu.get(b)).iter_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     prefetch_blocks: int = 1) -> Iterator[Any]:
+        """Stream batches; prefetches the next block's get while the
+        current one is consumed (parity: dataset.py iter_batches)."""
+        blocks = self._executed_blocks()
+        carry: Optional[Block] = None
+        it = iter(blocks)
+        pending: List[ray_tpu.ObjectRef] = list(itertools.islice(
+            it, prefetch_blocks + 1))
+        while pending:
+            ref = pending.pop(0)
+            nxt = next(it, None)
+            if nxt is not None:
+                pending.append(nxt)
+            blk = ray_tpu.get(ref)
+            if carry is not None:
+                blk = concat_blocks([carry, blk])
+                carry = None
+            acc = BlockAccessor(blk)
+            n = acc.num_rows()
+            bs = batch_size or n
+            start = 0
+            while n - start >= bs:
+                yield BlockAccessor(acc.slice(start, start + bs)).to_batch(
+                    batch_format)
+                start += bs
+            if start < n:
+                carry = acc.slice(start, n)
+        if carry is not None and not drop_last:
+            yield BlockAccessor(carry).to_batch(batch_format)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        import torch
+
+        for batch in self.iter_batches(**{**kwargs, "batch_format": "numpy"}):
+            if isinstance(batch, dict):
+                yield {k: torch.as_tensor(np.ascontiguousarray(v))
+                       for k, v in batch.items()}
+            else:
+                yield torch.as_tensor(np.ascontiguousarray(batch))
+
+    def to_jax(self, *, batch_size: Optional[int] = 256,
+               drop_last: bool = True) -> Iterator[Any]:
+        """Batches as jax arrays (device-put by the consumer's jit)."""
+        import jax.numpy as jnp
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            if isinstance(batch, dict):
+                yield {k: jnp.asarray(v) for k, v in batch.items()}
+            else:
+                yield jnp.asarray(batch)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        dfs = [BlockAccessor(ray_tpu.get(b)).to_pandas()
+               for b in self._executed_blocks()]
+        return pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
+
+    def to_numpy_refs(self) -> List[ray_tpu.ObjectRef]:
+        return self._executed_blocks()
+
+    def get_internal_block_refs(self) -> List[ray_tpu.ObjectRef]:
+        return self._executed_blocks()
+
+    # aggregations ----------------------------------------------------
+    def _agg(self, np_fn, column: Optional[str]):
+        vals = []
+        for b in self._executed_blocks():
+            blk = ray_tpu.get(b)
+            acc = BlockAccessor(blk)
+            if acc.num_rows() == 0:
+                continue
+            if acc.is_table:
+                col = blk[column] if column else next(iter(blk.values()))
+            else:
+                col = np.asarray(blk)
+            vals.append(col)
+        if not vals:
+            return None
+        return np_fn(np.concatenate(vals))
+
+    def sum(self, on: Optional[str] = None):
+        r = self._agg(np.sum, on)
+        return None if r is None else r.item()
+
+    def min(self, on: Optional[str] = None):
+        r = self._agg(np.min, on)
+        return None if r is None else r.item()
+
+    def max(self, on: Optional[str] = None):
+        r = self._agg(np.max, on)
+        return None if r is None else r.item()
+
+    def mean(self, on: Optional[str] = None):
+        r = self._agg(np.mean, on)
+        return None if r is None else r.item()
+
+    def std(self, on: Optional[str] = None):
+        r = self._agg(lambda a: np.std(a, ddof=1), on)
+        return None if r is None else r.item()
+
+    # pipeline --------------------------------------------------------
+    def window(self, *, blocks_per_window: int = 10) -> "DatasetPipeline":
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        blocks = self._executed_blocks()
+        windows = [Dataset(blocks[i:i + blocks_per_window])
+                   for i in range(0, len(blocks), blocks_per_window)]
+        return DatasetPipeline(windows)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        ds = self.materialize()
+        return DatasetPipeline([ds] * times if times else None,
+                               infinite_source=None if times else ds)
+
+    def __repr__(self) -> str:
+        return (f"Dataset(num_blocks={self.num_blocks()}, "
+                f"pending_stages={[n for n, _ in self._stages]})")
+
+
+class GroupedDataset:
+    """Hash-partitioned groupby with map_groups / aggregations (parity:
+    reference ``data/grouped_dataset.py``)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _partitions(self) -> List[ray_tpu.ObjectRef]:
+        ds = self._ds
+        n_red = max(ds.num_blocks(), 1)
+        fns = [fn for _, fn in ds._stages]
+        maps = [_groupby_map.options(num_returns=n_red).remote(
+            b, self._key, n_red, fns) for b in ds._blocks]
+        maps = [[m] if n_red == 1 else list(m) for m in maps]
+        return [_concat_task.remote(*[m[r] for m in maps])
+                for r in range(n_red)]
+
+    def map_groups(self, fn: Callable[[Any], Any]) -> Dataset:
+        key = self._key
+
+        def apply(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            if acc.num_rows() == 0:
+                return block
+            outs = []
+            if acc.is_table:
+                col = np.asarray(block[key])
+                for val in list(dict.fromkeys(col.tolist())):
+                    idx = np.nonzero(col == val)[0]
+                    outs.append(batch_to_block(fn(acc.take_indices(idx))))
+            else:
+                groups: Dict[Any, List[Any]] = {}
+                for r in acc.iter_rows():
+                    groups.setdefault(r[key], []).append(r)
+                for rows in groups.values():
+                    outs.append(batch_to_block(fn(rows)))
+            return concat_blocks(outs)
+
+        return Dataset(self._partitions(), [("map_groups", apply)])
+
+    def _agg(self, np_fn, on: str, name: str) -> Dataset:
+        key = self._key
+
+        def apply(block):
+            acc = BlockAccessor(block)
+            if acc.num_rows() == 0 or not acc.is_table:
+                return block if isinstance(block, dict) else []
+            col = np.asarray(block[key])
+            keys, vals = [], []
+            for val in list(dict.fromkeys(col.tolist())):
+                idx = np.nonzero(col == val)[0]
+                keys.append(val)
+                vals.append(np_fn(np.asarray(block[on])[idx]))
+            return {key: np.asarray(keys), name: np.asarray(vals)}
+
+        return Dataset(self._partitions(), [(name, apply)])
+
+    def count(self) -> Dataset:
+        key = self._key
+
+        def apply(block):
+            acc = BlockAccessor(block)
+            if acc.num_rows() == 0:
+                return block if isinstance(block, dict) else []
+            if acc.is_table:
+                col = np.asarray(block[key])
+            else:
+                col = np.asarray([r[key] for r in acc.iter_rows()])
+            keys, counts = np.unique(col, return_counts=True)
+            return {key: keys, "count()": counts}
+
+        return Dataset(self._partitions(), [("count", apply)])
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg(np.sum, on, f"sum({on})")
+
+    def min(self, on: str) -> Dataset:
+        return self._agg(np.min, on, f"min({on})")
+
+    def max(self, on: str) -> Dataset:
+        return self._agg(np.max, on, f"max({on})")
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg(np.mean, on, f"mean({on})")
+
+
+class ActorPoolStrategy:
+    """Compute strategy for map_batches on a fixed actor pool (parity:
+    reference ``data/_internal/compute.py`` ``ActorPoolStrategy``)."""
+
+    is_actor_pool = True
+
+    def __init__(self, size: int = 2, min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        self.size = max_size or size or min_size or 2

@@ -1,0 +1,97 @@
+"""Windowed streaming over datasets (parity: reference
+``python/ray/data/dataset_pipeline.py``).  A pipeline is a sequence of
+Dataset windows executed lazily one after another, so only one window's
+blocks need be materialized at a time — the input-pipeline form consumed
+by per-epoch training loops."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterator, List, Optional
+
+from ray_tpu.data.dataset import Dataset
+
+
+class DatasetPipeline:
+    def __init__(self, windows: Optional[List[Dataset]],
+                 infinite_source: Optional[Dataset] = None,
+                 transforms: Optional[List[Callable[[Dataset], Dataset]]] = None):
+        self._windows = windows
+        self._infinite = infinite_source
+        self._transforms = list(transforms or [])
+
+    def _window_iter(self) -> Iterator[Dataset]:
+        if self._infinite is not None:
+            source: Iterator[Dataset] = itertools.repeat(self._infinite)
+        else:
+            source = iter(self._windows or [])
+        for w in source:
+            for t in self._transforms:
+                w = t(w)
+            yield w
+
+    def _with_transform(self, t: Callable[[Dataset], Dataset]
+                        ) -> "DatasetPipeline":
+        return DatasetPipeline(self._windows, self._infinite,
+                               self._transforms + [t])
+
+    # per-window transforms -------------------------------------------
+    def map(self, fn, **kw) -> "DatasetPipeline":
+        return self._with_transform(lambda ds: ds.map(fn, **kw))
+
+    def map_batches(self, fn, **kw) -> "DatasetPipeline":
+        return self._with_transform(lambda ds: ds.map_batches(fn, **kw))
+
+    def filter(self, fn, **kw) -> "DatasetPipeline":
+        return self._with_transform(lambda ds: ds.filter(fn, **kw))
+
+    def random_shuffle_each_window(self, *, seed=None) -> "DatasetPipeline":
+        return self._with_transform(lambda ds: ds.random_shuffle(seed=seed))
+
+    def repartition_each_window(self, n: int) -> "DatasetPipeline":
+        return self._with_transform(lambda ds: ds.repartition(n))
+
+    def foreach_window(self, fn: Callable[[Dataset], Dataset]
+                       ) -> "DatasetPipeline":
+        return self._with_transform(fn)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        if self._infinite is not None:
+            return self
+        windows = self._windows or []
+        return DatasetPipeline(windows * times if times else None,
+                               None if times else (windows[0] if len(windows) == 1
+                                                   else None),
+                               self._transforms)
+
+    # consumption ------------------------------------------------------
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        for window in self._window_iter():
+            yield from window.iter_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for window in self._window_iter():
+            yield from window.iter_rows()
+
+    def iter_datasets(self) -> Iterator[Dataset]:
+        return self._window_iter()
+
+    def split(self, n: int, *, equal: bool = False) -> List["DatasetPipeline"]:
+        """Split every window n-ways; consumer i sees shard i of each
+        window (parity: pipeline split for Train ingest)."""
+        shards: List[List[Dataset]] = [[] for _ in range(n)]
+        for window in self._window_iter():
+            for i, sub in enumerate(window.split(n, equal=equal)):
+                shards[i].append(sub)
+        return [DatasetPipeline(s) for s in shards]
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def count(self) -> int:
+        return sum(w.count() for w in self._window_iter())

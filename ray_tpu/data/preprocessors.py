@@ -1,0 +1,179 @@
+"""Preprocessors (parity: reference ``python/ray/data/preprocessors/`` —
+scalers, encoders, batch mapper, concatenator, chain).  fit computes
+statistics with dataset aggregations; transform is a lazy map_batches."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset
+
+
+class Preprocessor:
+    """Base class (parity: ``data/preprocessor.py``): fit() computes state,
+    transform() applies it lazily; fit_transform chains both."""
+
+    _is_fitted = False
+
+    def fit(self, ds: Dataset) -> "Preprocessor":
+        self._fit(ds)
+        self._is_fitted = True
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        if not self._is_fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit first")
+        return ds.map_batches(self._transform_numpy, batch_format="numpy")
+
+    def fit_transform(self, ds: Dataset) -> Dataset:
+        return self.fit(ds).transform(ds)
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return self._transform_numpy(dict(batch))
+
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, ds: Dataset) -> None:
+        pass
+
+    def _transform_numpy(self, batch):
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        for c in self.columns:
+            self.stats_[c] = (ds.mean(c), ds.std(c) or 1.0)
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            batch[c] = (batch[c] - mean) / (std if std else 1.0)
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        for c in self.columns:
+            self.stats_[c] = (ds.min(c), ds.max(c))
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            rng = (hi - lo) or 1.0
+            batch[c] = (batch[c] - lo) / rng
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.stats_: Dict[Any, int] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        import ray_tpu
+
+        blocks = ray_tpu.get(ds.get_internal_block_refs())
+        vals = sorted(set(
+            v.item() if hasattr(v, "item") else v
+            for b in blocks
+            for v in np.unique(np.asarray(b[self.label_column]))))
+        self.stats_ = {v: i for i, v in enumerate(vals)}
+
+    def _transform_numpy(self, batch):
+        col = batch[self.label_column]
+        batch[self.label_column] = np.asarray(
+            [self.stats_[v.item() if hasattr(v, "item") else v] for v in col])
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, List[Any]] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        import ray_tpu
+
+        blocks = ray_tpu.get(ds.get_internal_block_refs())
+        for c in self.columns:
+            vals = sorted(set(
+                v.item() if hasattr(v, "item") else v
+                for b in blocks for v in np.unique(np.asarray(b[c]))))
+            self.stats_[c] = vals
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            vals = self.stats_[c]
+            col = batch.pop(c)
+            for v in vals:
+                batch[f"{c}_{v}"] = (col == v).astype(np.int64)
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Concatenate feature columns into one matrix column — the form a jax
+    training loop consumes directly."""
+
+    def __init__(self, output_column_name: str = "concat_out",
+                 include: Optional[List[str]] = None,
+                 exclude: Optional[List[str]] = None, dtype=np.float32):
+        self.output_column_name = output_column_name
+        self.include = include
+        self.exclude = set(exclude or [])
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_numpy(self, batch):
+        cols = self.include or [k for k in batch if k not in self.exclude]
+        mats = []
+        for c in cols:
+            v = np.asarray(batch.pop(c))
+            mats.append(v.reshape(len(v), -1).astype(self.dtype))
+        batch[self.output_column_name] = np.concatenate(mats, axis=1)
+        return batch
+
+
+class BatchMapper(Preprocessor):
+    def __init__(self, fn: Callable[[Any], Any], batch_format: str = "numpy"):
+        self.fn = fn
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_numpy(self, batch):
+        return self.fn(batch)
+
+
+class Chain(Preprocessor):
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = preprocessors
+
+    def fit(self, ds: Dataset) -> "Preprocessor":
+        for p in self.preprocessors:
+            ds = p.fit_transform(ds).materialize()
+        self._is_fitted = True
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def transform_batch(self, batch):
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch

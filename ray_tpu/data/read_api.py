@@ -1,0 +1,152 @@
+"""Dataset creation APIs (parity: reference ``python/ray/data/read_api.py``
++ ``data/datasource/``).  Reads are parallel tasks, one per file/partition;
+arrow is unavailable here so tabular formats go through pandas/numpy."""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, build_block
+from ray_tpu.data.dataset import Dataset
+
+
+def _expand_paths(paths: Union[str, List[str]], suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob_mod.glob(os.path.join(p, f"*{suffix}"))))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths}")
+    return out
+
+
+@ray_tpu.remote
+def _read_csv_file(path: str, kwargs: Dict[str, Any]) -> Block:
+    import pandas as pd
+
+    df = pd.read_csv(path, **kwargs)
+    return {str(c): df[c].to_numpy() for c in df.columns}
+
+
+@ray_tpu.remote
+def _read_json_file(path: str) -> Block:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return build_block(rows)
+
+
+@ray_tpu.remote
+def _read_numpy_file(path: str) -> Block:
+    return {"data": np.load(path)}
+
+
+@ray_tpu.remote
+def _read_parquet_file(path: str, kwargs: Dict[str, Any]) -> Block:
+    import pandas as pd
+
+    df = pd.read_parquet(path, **kwargs)  # needs a parquet engine
+    return {str(c): df[c].to_numpy() for c in df.columns}
+
+
+@ray_tpu.remote
+def _range_block(start: int, stop: int, tensor_shape: Optional[tuple]) -> Block:
+    arr = np.arange(start, stop)
+    if tensor_shape:
+        arr = np.stack([np.full(tensor_shape, i) for i in arr])
+    return {"id": arr}
+
+
+_py_range = __import__("builtins").range
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    blocks = [_range_block.remote(s, min(s + per, n), None)
+              for s in _py_range(0, n, per)]
+    return Dataset(blocks)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = 8
+                 ) -> Dataset:
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    blocks = [_range_block.remote(s, min(s + per, n), shape)
+              for s in _py_range(0, n, per)]
+    return Dataset(blocks)
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    items = list(items)
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    per = (len(items) + parallelism - 1) // parallelism
+    blocks = [ray_tpu.put(build_block(items[i:i + per]))
+              for i in _py_range(0, len(items), per)]
+    return Dataset(blocks)
+
+
+def from_numpy(arrays: Union[np.ndarray, List[np.ndarray]]) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    return Dataset([ray_tpu.put({"data": a}) for a in arrays])
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    blocks = []
+    for df in dfs:
+        blocks.append(ray_tpu.put(
+            {str(c): df[c].to_numpy() for c in df.columns}))
+    return Dataset(blocks)
+
+
+def read_csv(paths: Union[str, List[str]], **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+    return Dataset([_read_csv_file.remote(p, kwargs) for p in files])
+
+
+def read_json(paths: Union[str, List[str]], **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".json")
+    return Dataset([_read_json_file.remote(p) for p in files])
+
+
+def read_numpy(paths: Union[str, List[str]], **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".npy")
+    return Dataset([_read_numpy_file.remote(p) for p in files])
+
+
+def read_parquet(paths: Union[str, List[str]], **kwargs) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+    return Dataset([_read_parquet_file.remote(p, kwargs) for p in files])
+
+
+def read_binary_files(paths: Union[str, List[str]], **kwargs) -> Dataset:
+    @ray_tpu.remote
+    def _read(path: str) -> Block:
+        with open(path, "rb") as f:
+            return [f.read()]
+
+    files = _expand_paths(paths, "")
+    return Dataset([_read.remote(p) for p in files])
+
+
+def from_huggingface(dataset) -> Dataset:
+    """Convert a datasets.Dataset (hf) via pandas."""
+    return from_pandas(dataset.to_pandas())

@@ -43,6 +43,10 @@ def _resolve_strategy(strategy) -> Optional[SchedulingStrategy]:
     raise TypeError(f"unsupported scheduling strategy: {strategy!r}")
 
 
+def _rebuild_remote_function(fn, options):
+    return RemoteFunction(fn, **options)
+
+
 class RemoteFunction:
     def __init__(self, fn, **options):
         self._fn = fn
@@ -58,6 +62,12 @@ class RemoteFunction:
         raise TypeError(
             f"Remote function {self._descriptor} cannot be called directly; "
             f"use .remote()")
+
+    def __reduce__(self):
+        # remote functions travel inside closures of other tasks (parity:
+        # RemoteFunction.__getstate__); rebuild from the plain function —
+        # the export cache re-fills on first .remote() in the new process
+        return (_rebuild_remote_function, (self._fn, self._options))
 
     def options(self, **options) -> "RemoteFunction":
         merged = dict(self._options)

@@ -1,0 +1,311 @@
+"""ray_tpu.serve — model serving on the actor substrate.
+
+Parity: reference ``python/ray/serve`` — ``@serve.deployment``,
+``serve.run``, handles, batching, autoscaling, HTTP ingress.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve._internal import (CONTROLLER_NAME, DeploymentConfig,
+                                     Router, ServeController)
+
+_router: Optional[Router] = None
+_router_lock = threading.Lock()
+
+
+def start(detached: bool = True) -> Any:
+    """Start (or connect to) the Serve controller (parity: serve.start)."""
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    controller = ServeController.options(
+        name=CONTROLLER_NAME, lifetime="detached",
+        max_concurrency=16).remote()
+    ray_tpu.get(controller.list_deployments.remote(), timeout=60)
+    return controller
+
+
+def _get_router() -> Router:
+    global _router
+    with _router_lock:
+        if _router is None:
+            _router = Router(start())
+        return _router
+
+
+def shutdown() -> None:
+    global _router
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except ValueError:
+        pass
+    with _router_lock:
+        _router = None
+
+
+class DeploymentHandle:
+    """Parity: reference ``serve/handle.py`` RayServeHandle."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._name = deployment_name
+        self._method = method_name
+
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._name, method_name)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._name, name)
+
+    def remote(self, *args, **kwargs) -> ray_tpu.ObjectRef:
+        router = _get_router()
+        replica, key = router.assign(self._name)
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        # release the slot when the result lands (best effort: piggyback on
+        # a waiter thread so the caller needn't call back)
+        threading.Thread(target=_release_on_done,
+                         args=(router, key, ref), daemon=True).start()
+        return ref
+
+
+def _release_on_done(router, key, ref):
+    try:
+        ray_tpu.wait([ref], num_returns=1, timeout=3600)
+    finally:
+        router.release(key)
+
+
+class Application:
+    """A bound deployment graph node (parity: ``serve.deployment.bind``)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    """Parity: reference ``serve/deployment.py`` Deployment."""
+
+    def __init__(self, func_or_class: Any, name: str,
+                 config: DeploymentConfig):
+        self._target = func_or_class
+        self.name = name
+        self.config = config
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_concurrent_queries: Optional[int] = None,
+                user_config: Any = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None,
+                autoscaling_config: Optional[Dict[str, Any]] = None,
+                **_ignored) -> "Deployment":
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas if num_replicas is not None
+            else self.config.num_replicas,
+            max_concurrent_queries=max_concurrent_queries
+            if max_concurrent_queries is not None
+            else self.config.max_concurrent_queries,
+            user_config=user_config if user_config is not None
+            else self.config.user_config,
+            ray_actor_options=ray_actor_options
+            if ray_actor_options is not None
+            else self.config.ray_actor_options,
+            autoscaling_config=autoscaling_config
+            if autoscaling_config is not None
+            else self.config.autoscaling_config,
+        )
+        return Deployment(self._target, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def deploy(self, *init_args, **init_kwargs) -> DeploymentHandle:
+        controller = start()
+        blob = cloudpickle.dumps(self._target)
+        version = ray_tpu.get(controller.deploy.remote(
+            self.name, blob, init_args, init_kwargs, self.config), timeout=60)
+        _wait_for_replicas(controller, self.name, self.config, version)
+        return DeploymentHandle(self.name)
+
+    def get_handle(self) -> DeploymentHandle:
+        return DeploymentHandle(self.name)
+
+
+def _wait_for_replicas(controller, name: str, config: DeploymentConfig,
+                       version: int, timeout: float = 120.0) -> None:
+    target = config.num_replicas
+    if config.autoscaling_config:
+        target = config.autoscaling_config.get("min_replicas", 1)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        deps = ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+        info = deps.get(name)
+        if info and info["num_replicas"] >= target and \
+                info["version"] == version and \
+                info.get("stale_replicas", 0) == 0:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"deployment {name} did not reach {target} replicas")
+
+
+def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 100,
+               user_config: Any = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               **_ignored):
+    """``@serve.deployment`` decorator (parity: serve/api.py)."""
+
+    def wrap(target):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
+            ray_actor_options=ray_actor_options or {},
+            autoscaling_config=autoscaling_config,
+        )
+        return Deployment(target, name or target.__name__, cfg)
+
+    if func_or_class is not None:
+        return wrap(func_or_class)
+    return wrap
+
+
+def run(target: Union[Application, Deployment], *, _blocking: bool = True,
+        **_ignored) -> DeploymentHandle:
+    """Deploy an application (parity: ``serve.run``)."""
+    if isinstance(target, Application):
+        return target.deployment.deploy(*target.args, **target.kwargs)
+    return target.deploy()
+
+
+def delete(name: str) -> None:
+    controller = start()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=30)
+
+
+def status() -> Dict[str, Any]:
+    controller = start()
+    return ray_tpu.get(controller.list_deployments.remote(), timeout=30)
+
+
+def get_deployment_handle(name: str, *_a, **_k) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+# ----------------------------------------------------------------------
+# batching (parity: reference serve/batching.py @serve.batch)
+# ----------------------------------------------------------------------
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.lock = threading.Lock()
+        self.items: List[Any] = []
+        self.results: Dict[int, Any] = {}
+        self.errors: Dict[int, BaseException] = {}
+        self.cv = threading.Condition(self.lock)
+        self.batch_start: Optional[float] = None
+        self.next_id = 0
+
+    def submit(self, item: Any) -> Any:
+        with self.cv:
+            my_id = self.next_id
+            self.next_id += 1
+            self.items.append((my_id, item))
+            if self.batch_start is None:
+                self.batch_start = time.monotonic()
+            # leader: first waiter whose batch fills or times out runs fn
+            while True:
+                if my_id in self.results:
+                    return self.results.pop(my_id)
+                if my_id in self.errors:
+                    raise self.errors.pop(my_id)
+                full = len(self.items) >= self.max_batch_size
+                expired = (self.batch_start is not None and
+                           time.monotonic() - self.batch_start >= self.timeout)
+                if self.items and (full or expired):
+                    batch = self.items[:self.max_batch_size]
+                    self.items = self.items[self.max_batch_size:]
+                    self.batch_start = (time.monotonic()
+                                        if self.items else None)
+                    ids = [i for i, _ in batch]
+                    values = [v for _, v in batch]
+                    self.lock.release()
+                    try:
+                        try:
+                            outs = self.fn(values)
+                        except BaseException as e:  # noqa: BLE001
+                            outs = None
+                            err = e
+                        else:
+                            err = None
+                    finally:
+                        self.lock.acquire()
+                    if err is not None:
+                        for i in ids:
+                            self.errors[i] = err
+                    else:
+                        for i, out in zip(ids, outs):
+                            self.results[i] = out
+                    self.cv.notify_all()
+                    continue
+                self.cv.wait(timeout=max(self.timeout / 4, 0.001))
+
+
+# per-process registry of lazily created batch queues; keyed by the wrapped
+# function so nothing unpicklable (locks) is attached to user classes
+_batch_queues: Dict[int, _BatchQueue] = {}
+_batch_queues_lock = threading.Lock()
+
+
+def batch(fn: Callable = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch``: transparently batch concurrent calls — on TPU the
+    natural fit for jitted inference with a batch dimension."""
+
+    def wrap(f):
+        @functools.wraps(f)
+        def wrapper(self_or_item, *rest):
+            # late import by name: this closure is cloudpickled by value
+            # inside user deployment classes, and a direct reference to the
+            # module-level lock would make them unpicklable
+            from ray_tpu import serve as serve_mod
+
+            # support both methods (self, item) and free functions (item)
+            if rest:
+                bound_self, item = self_or_item, rest[0]
+                key = id(bound_self)
+                target = lambda vals, s=bound_self: f(s, vals)  # noqa: E731
+            else:
+                bound_self, item = None, self_or_item
+                key = id(wrapper)
+                target = f
+            with serve_mod._batch_queues_lock:
+                q = serve_mod._batch_queues.get(key)
+                if q is None:
+                    q = serve_mod._BatchQueue(target, max_batch_size,
+                                              batch_wait_timeout_s)
+                    serve_mod._batch_queues[key] = q
+            return q.submit(item)
+
+        return wrapper
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap

@@ -1,0 +1,341 @@
+"""Serve control/data plane actors.
+
+Parity: reference ``python/ray/serve/`` —
+- ``ServeController`` (controller.py:61): single-writer reconciliation of
+  deployment state onto replica actors, rolling updates, autoscaling,
+  long-poll config push (``_private/long_poll.py``).
+- ``RayServeReplica`` (``_private/replica.py:250``): wraps the user
+  callable, tracks queue depth for backpressure/autoscaling.
+- ``Router``/``ReplicaSet`` (``_private/router.py:261,:134``): power-of-two
+  choices over replicas, skipping those at ``max_concurrent_queries``.
+
+TPU twist: a deployment whose callable jits a model keeps the compiled
+executable warm in the replica process; replicas requesting TPU resources
+gang onto chips via the core scheduler.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    user_config: Any = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    version: int = 0
+
+
+@ray_tpu.remote
+class ServeReplica:
+    """One replica actor (parity: RayServeReplica replica.py:250)."""
+
+    def __init__(self, pickled_callable: bytes, init_args: tuple,
+                 init_kwargs: dict, user_config: Any = None):
+        target = cloudpickle.loads(pickled_callable)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+        self._inflight = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config: Any) -> bool:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._inflight += 1
+            self._total += 1
+        try:
+            target = self._callable
+            if method_name and method_name != "__call__":
+                target = getattr(self._callable, method_name)
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"inflight": self._inflight, "total": self._total}
+
+    def ready(self) -> bool:
+        return True
+
+
+@ray_tpu.remote
+class ServeController:
+    """Single-writer control loop (parity: controller.py:61)."""
+
+    def __init__(self):
+        # name -> {"config", "blob", "init", "replicas": [handles], "version"}
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._routing_version = 0
+        self._routing: Dict[str, List[Any]] = {}  # name -> replica handles
+        self._configs: Dict[str, DeploymentConfig] = {}
+        self._lock = threading.Lock()
+        self._stop = False
+        self._thread = threading.Thread(target=self._control_loop, daemon=True)
+        self._thread.start()
+
+    # -- API ----------------------------------------------------------
+    def deploy(self, name: str, pickled_callable: bytes, init_args: tuple,
+               init_kwargs: dict, config: DeploymentConfig) -> int:
+        """Returns the assigned version (monotonic per deployment)."""
+        with self._lock:
+            prev = self._deployments.get(name)
+            config.version = (prev["config"].version + 1) if prev else 0
+            self._deployments[name] = {
+                "config": config,
+                "blob": pickled_callable,
+                "init": (init_args, init_kwargs),
+                "replicas": prev["replicas"] if prev else [],
+                "replica_versions": prev.get("replica_versions", [])
+                if prev else [],
+            }
+            return config.version
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            dep = self._deployments.pop(name, None)
+        if dep:
+            for r in dep["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._bump_routing()
+        return True
+
+    def get_routing_table(self, known_version: int = -1,
+                          timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Long-poll: blocks until the table moves past known_version
+        (parity: LongPollHost long_poll.py:185)."""
+        deadline = time.monotonic() + timeout_s
+        while self._routing_version <= known_version and not self._stop:
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
+        with self._lock:
+            table = {
+                name: {"replicas": list(replicas),
+                       "max_concurrent_queries":
+                           self._configs[name].max_concurrent_queries
+                           if name in self._configs else 100}
+                for name, replicas in self._routing.items()
+            }
+        return {"version": self._routing_version, "table": table}
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                name: {"num_replicas": len(dep["replicas"]),
+                       "target_replicas": dep["config"].num_replicas,
+                       "version": dep["config"].version,
+                       "stale_replicas": sum(
+                           1 for v in dep["replica_versions"]
+                           if v != dep["config"].version)}
+                for name, dep in self._deployments.items()
+            }
+
+    def graceful_shutdown(self) -> bool:
+        self._stop = True
+        with self._lock:
+            deps = list(self._deployments.values())
+            self._deployments.clear()
+        for dep in deps:
+            for r in dep["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        return True
+
+    # -- reconciliation ------------------------------------------------
+    def _bump_routing(self) -> None:
+        with self._lock:
+            self._routing = {name: list(dep["replicas"])
+                             for name, dep in self._deployments.items()}
+            self._configs = {name: dep["config"]
+                             for name, dep in self._deployments.items()}
+            self._routing_version += 1
+
+    def _control_loop(self) -> None:
+        """Reconcile actual replicas toward target state
+        (parity: DeploymentStateManager.update deployment_state.py)."""
+        while not self._stop:
+            try:
+                changed = self._reconcile_once()
+                if changed:
+                    self._bump_routing()
+            except Exception:  # noqa: BLE001
+                logger.exception("serve control loop iteration failed")
+            time.sleep(0.1)
+
+    def _reconcile_once(self) -> bool:
+        changed = False
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, dep in items:
+            config: DeploymentConfig = dep["config"]
+            target = self._autoscaled_target(dep, config)
+            replicas: List[Any] = dep["replicas"]
+            versions: List[int] = dep["replica_versions"]
+            # rolling update: replace one stale replica at a time
+            stale = [i for i, v in enumerate(versions)
+                     if v != config.version]
+            if stale and len(replicas) >= target:
+                i = stale[0]
+                new = self._start_replica(dep, config)
+                if new is not None:
+                    old = replicas[i]
+                    replicas[i] = new
+                    versions[i] = config.version
+                    try:
+                        ray_tpu.kill(old)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    changed = True
+                    continue
+            while len(replicas) < target:
+                new = self._start_replica(dep, config)
+                if new is None:
+                    break
+                replicas.append(new)
+                versions.append(config.version)
+                changed = True
+            while len(replicas) > target:
+                old = replicas.pop()
+                versions.pop()
+                try:
+                    ray_tpu.kill(old)
+                except Exception:  # noqa: BLE001
+                    pass
+                changed = True
+        return changed
+
+    def _autoscaled_target(self, dep: Dict[str, Any],
+                           config: DeploymentConfig) -> int:
+        ac = config.autoscaling_config
+        if not ac:
+            return config.num_replicas
+        metrics = []
+        for r in dep["replicas"]:
+            try:
+                metrics.append(ray_tpu.get(r.metrics.remote(), timeout=5))
+            except Exception:  # noqa: BLE001
+                pass
+        if not metrics:
+            return max(1, ac.get("min_replicas", 1))
+        # parity: BasicAutoscalingPolicy (autoscaling_policy.py:93) —
+        # scale toward (total queued) / target_per_replica
+        total_inflight = sum(m["inflight"] for m in metrics)
+        target_per = ac.get("target_num_ongoing_requests_per_replica", 1)
+        desired = int(total_inflight / max(target_per, 1e-9) + 0.999)
+        lo = ac.get("min_replicas", 1)
+        hi = ac.get("max_replicas", config.num_replicas)
+        return min(max(desired, lo), hi)
+
+    def _start_replica(self, dep: Dict[str, Any],
+                       config: DeploymentConfig) -> Optional[Any]:
+        try:
+            opts = dict(config.ray_actor_options or {})
+            init_args, init_kwargs = dep["init"]
+            replica = ServeReplica.options(
+                max_concurrency=max(4, config.max_concurrent_queries),
+                **opts).remote(dep["blob"], init_args, init_kwargs,
+                               config.user_config)
+            ray_tpu.get(replica.ready.remote(), timeout=120)
+            return replica
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to start replica")
+            return None
+
+
+class Router:
+    """Client-side replica picker with long-poll refresh (parity:
+    router.py Router/ReplicaSet)."""
+
+    def __init__(self, controller):
+        self._controller = controller
+        self._table: Dict[str, Any] = {}
+        self._version = -1
+        self._rr: Dict[str, int] = {}
+        self._inflight: Dict[Tuple[str, bytes], int] = {}
+        self._lock = threading.Lock()
+        self._refresh(block=True)
+        self._thread = threading.Thread(target=self._poll_loop, daemon=True)
+        self._thread.start()
+
+    def _refresh(self, block: bool = False) -> None:
+        reply = ray_tpu.get(self._controller.get_routing_table.remote(
+            self._version if not block else -1, 10.0), timeout=30)
+        with self._lock:
+            self._version = reply["version"]
+            self._table = reply["table"]
+
+    def _poll_loop(self) -> None:
+        while True:
+            try:
+                self._refresh()
+            except Exception:  # noqa: BLE001
+                time.sleep(1.0)
+
+    def assign(self, deployment: str):
+        """Pick a replica (round-robin, skipping saturated ones).  Unknown
+        deployments fail fast (one short grace for table propagation);
+        known deployments with no live replica yet wait for them."""
+        deadline = time.monotonic() + 30.0
+        grace = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                entry = self._table.get(deployment)
+            if entry is None:
+                if time.monotonic() > grace:
+                    raise KeyError(f"no deployment named {deployment!r}")
+                time.sleep(0.05)
+                continue
+            with self._lock:
+                entry = self._table.get(deployment)
+                if entry and entry["replicas"]:
+                    replicas = entry["replicas"]
+                    cap = entry["max_concurrent_queries"]
+                    start = self._rr.get(deployment, 0)
+                    for i in range(len(replicas)):
+                        idx = (start + i) % len(replicas)
+                        r = replicas[idx]
+                        key = (deployment, r.actor_id.binary())
+                        if self._inflight.get(key, 0) < cap:
+                            self._rr[deployment] = idx + 1
+                            self._inflight[key] = \
+                                self._inflight.get(key, 0) + 1
+                            return r, key
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"no available replica for deployment {deployment!r}")
+
+    def release(self, key) -> None:
+        with self._lock:
+            self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)

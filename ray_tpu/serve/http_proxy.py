@@ -1,0 +1,115 @@
+"""HTTP ingress for serve (parity: reference ``serve/_private/http_proxy.py``
+``HTTPProxy:218`` — uvicorn is unavailable here, so a small asyncio
+HTTP/1.1 server provides the same routing contract: ``/<deployment>``
+paths dispatch to deployment handles, JSON in/out)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class HTTPProxy:
+    """Per-cluster HTTP proxy actor."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=30)
+
+    def address(self) -> tuple:
+        return (self._host, self._port)
+
+    def ready(self) -> bool:
+        return self._started.is_set()
+
+    def _serve_forever(self) -> None:
+        asyncio.run(self._main())
+
+    async def _main(self) -> None:
+        server = await asyncio.start_server(self._handle_conn, self._host,
+                                            self._port)
+        sock = server.sockets[0]
+        self._port = sock.getsockname()[1]
+        self._started.set()
+        async with server:
+            await server.serve_forever()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = await reader.readline()
+            if not request_line:
+                return
+            method, path, _ = request_line.decode().split(" ", 2)
+            headers: Dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            length = int(headers.get("content-length", "0"))
+            if length:
+                body = await reader.readexactly(length)
+            status, payload = await asyncio.get_running_loop().run_in_executor(
+                None, self._route, method, path, body)
+            blob = json.dumps(payload).encode()
+            writer.write(
+                f"HTTP/1.1 {status}\r\ncontent-type: application/json\r\n"
+                f"content-length: {len(blob)}\r\nconnection: close"
+                f"\r\n\r\n".encode() + blob)
+            await writer.drain()
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _route(self, method: str, path: str, body: bytes):
+        from ray_tpu import serve
+
+        name = path.strip("/").split("/")[0]
+        if not name:
+            return "200 OK", {"deployments": list(serve.status().keys())}
+        if name == "-" or name == "healthz":
+            return "200 OK", {"status": "ok"}
+        try:
+            args: tuple = ()
+            if body:
+                args = (json.loads(body),)
+            handle = serve.get_deployment_handle(name)
+            result = ray_tpu.get(handle.remote(*args), timeout=60)
+            return "200 OK", {"result": result}
+        except KeyError as e:
+            return "404 Not Found", {"error": str(e)}
+        except Exception as e:  # noqa: BLE001
+            return "500 Internal Server Error", {"error": str(e)}
+
+
+_proxy_handle: Optional[Any] = None
+
+
+def start_proxy(port: int = 0) -> tuple:
+    """Start (or fetch) the cluster HTTP proxy; returns (host, port)."""
+    global _proxy_handle
+    try:
+        _proxy_handle = ray_tpu.get_actor("SERVE_HTTP_PROXY")
+    except ValueError:
+        _proxy_handle = HTTPProxy.options(
+            name="SERVE_HTTP_PROXY", lifetime="detached",
+            max_concurrency=32).remote(port=port)
+    ray_tpu.get(_proxy_handle.ready.remote(), timeout=60)
+    return tuple(ray_tpu.get(_proxy_handle.address.remote(), timeout=30))

@@ -1,0 +1,156 @@
+"""ray_tpu.tune — distributed hyperparameter search.
+
+Parity: reference ``python/ray/tune`` — ``Tuner``/``tune.run`` (tune.py:131),
+trial actors over the core runtime, ASHA/PBT/median-stopping schedulers,
+grid/random search spaces, checkpointed fault-tolerant trials.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig
+from ray_tpu.tune import schedulers  # noqa: F401
+from ray_tpu.tune.execution import TrialRunner
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler,  # noqa: F401
+                                     FIFOScheduler, MedianStoppingRule,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search import (BasicVariantGenerator, Searcher,  # noqa: F401
+                                 choice, grid_search, loguniform, quniform,
+                                 randint, sample_from, uniform)
+from ray_tpu.tune.trial import (ERROR, TERMINATED, Trial,  # noqa: F401
+                                get_checkpoint, report)
+
+
+@dataclass
+class TuneConfig:
+    """Parity: reference ``tune/tune_config.py``."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    scheduler: Optional[TrialScheduler] = None
+    search_seed: Optional[int] = None
+
+
+class Result:
+    """Parity: reference ``air/result.py``."""
+
+    def __init__(self, trial: Trial):
+        self.config = trial.config
+        self.metrics = trial.last_result
+        self.checkpoint = trial.checkpoint
+        self.error = trial.error
+        self.metrics_history = trial.results
+        self.trial_id = trial.trial_id
+
+    def __repr__(self) -> str:
+        return f"Result(trial={self.trial_id}, metrics={self.metrics})"
+
+
+class ResultGrid:
+    """Parity: reference ``tune/result_grid.py``."""
+
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._trials)
+
+    def __getitem__(self, i: int) -> Result:
+        return Result(self._trials[i])
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required")
+        sign = 1 if mode == "max" else -1
+        best = None
+        best_v = None
+        for t in self._trials:
+            v = t.last_result.get(metric)
+            # fall back to the best intermediate result (early-stopped trials)
+            for r in t.results:
+                rv = r.get(metric)
+                if rv is not None and (v is None or sign * rv > sign * v):
+                    v = rv
+            if v is None:
+                continue
+            if best_v is None or sign * v > sign * best_v:
+                best, best_v = t, v
+        if best is None:
+            raise RuntimeError("no trial reported the metric " + str(metric))
+        return Result(best)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([{**t.last_result,
+                              **{f"config/{k}": v for k, v in t.config.items()},
+                              "trial_id": t.trial_id, "status": t.status}
+                             for t in self._trials])
+
+
+class Tuner:
+    """Parity: reference ``tune/tuner.py`` Tuner / ``tune.run``."""
+
+    def __init__(self, trainable: Callable[[Dict[str, Any]], Any], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        # trainers (JaxTrainer et al.) expose as_trainable()
+        trainable = self.trainable
+        if hasattr(trainable, "as_trainable"):
+            trainable = trainable.as_trainable()
+        gen = BasicVariantGenerator(seed=self.tune_config.search_seed)
+        configs = gen.generate(self.param_space,
+                               self.tune_config.num_samples)
+        trials = [Trial(config=c) for c in configs]
+        scheduler = self.tune_config.scheduler
+        if scheduler is not None:
+            # propagate metric/mode if the scheduler was built without them
+            if getattr(scheduler, "metric", None) is None:
+                scheduler.metric = self.tune_config.metric
+                scheduler.mode = self.tune_config.mode
+        runner = TrialRunner(
+            trainable, trials, scheduler=scheduler,
+            max_concurrent=self.tune_config.max_concurrent_trials,
+            resources_per_trial=self.resources_per_trial,
+            run_config=self.run_config)
+        runner.run()
+        return ResultGrid(trials, self.tune_config.metric,
+                          self.tune_config.mode)
+
+
+def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: Optional[str] = None,
+        mode: str = "max", scheduler: Optional[TrialScheduler] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        max_concurrent_trials: int = 0, **_ignored) -> ResultGrid:
+    """Functional entry point (parity: ``tune.run`` tune.py:131)."""
+    tuner = Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler,
+                               max_concurrent_trials=max_concurrent_trials),
+        resources_per_trial=resources_per_trial)
+    return tuner.fit()

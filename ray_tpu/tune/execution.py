@@ -1,0 +1,149 @@
+"""Trial runner event loop (parity: reference
+``tune/execution/trial_runner.py`` ``TrialRunner:327`` +
+``ray_trial_executor.py`` ``RayTrialExecutor:213``): trials are actors
+with per-trial resources, polled for buffered results; schedulers may
+stop trials early; failed trials restore from their last checkpoint up to
+``FailureConfig.max_failures``; PBT exploits restart a trial from a
+donor's checkpoint with a mutated config."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.config import FailureConfig, RunConfig
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.trial import (ERROR, PAUSED, PENDING, RUNNING, TERMINATED,
+                                Trial, TrialActor)
+
+logger = logging.getLogger(__name__)
+
+
+class TrialRunner:
+    def __init__(self, trainable: Callable, trials: List[Trial], *,
+                 scheduler: Optional[sched_mod.TrialScheduler] = None,
+                 max_concurrent: int = 0,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.trainable = trainable
+        self.trials = trials
+        self.scheduler = scheduler or sched_mod.FIFOScheduler()
+        self.resources = resources_per_trial or {"CPU": 1}
+        self.run_config = run_config or RunConfig()
+        self.max_concurrent = max_concurrent or len(trials)
+        self._exploit_requests: Dict[str, tuple] = {}
+
+    def get_trial(self, trial_id: str) -> Optional[Trial]:
+        for t in self.trials:
+            if t.trial_id == trial_id:
+                return t
+        return None
+
+    def exploit_trial(self, trial: Trial, donor: Trial,
+                      new_config: Dict[str, Any]) -> None:
+        """PBT hook: restart ``trial`` from ``donor``'s checkpoint with a
+        mutated config."""
+        donor_ckpt = donor.checkpoint
+        if donor_ckpt is None and donor.actor is not None:
+            try:
+                donor_ckpt = ray_tpu.get(donor.actor.get_checkpoint.remote(),
+                                         timeout=30)
+            except Exception:  # noqa: BLE001
+                donor_ckpt = None
+        self._exploit_requests[trial.trial_id] = (new_config, donor_ckpt)
+
+    # ------------------------------------------------------------------
+    def _start_trial(self, trial: Trial) -> None:
+        opts = {"resources": dict(self.resources)}
+        trial.actor = TrialActor.options(**opts).remote()
+        ray_tpu.get(trial.actor.run.remote(
+            self.trainable, trial.config, trial.checkpoint), timeout=300)
+        trial.status = RUNNING
+
+    def _stop_trial(self, trial: Trial, status: str) -> None:
+        if trial.actor is not None:
+            try:
+                ray_tpu.get(trial.actor.request_stop.remote(), timeout=10)
+                ckpt = ray_tpu.get(trial.actor.get_checkpoint.remote(),
+                                   timeout=10)
+                if ckpt is not None:
+                    trial.checkpoint = ckpt
+            except Exception:  # noqa: BLE001
+                pass
+            ray_tpu.kill(trial.actor)
+            trial.actor = None
+        trial.status = status
+
+    def run(self, poll_period: float = 0.05) -> List[Trial]:
+        pending = [t for t in self.trials if t.status == PENDING]
+        live: List[Trial] = []
+        while pending or live:
+            while pending and len(live) < self.max_concurrent:
+                trial = pending.pop(0)
+                try:
+                    self._start_trial(trial)
+                    live.append(trial)
+                except Exception as e:  # noqa: BLE001
+                    trial.status = ERROR
+                    trial.error = str(e)
+            progressed = False
+            for trial in list(live):
+                polls = ray_tpu.get(trial.actor.poll.remote(), timeout=60)
+                decision = sched_mod.CONTINUE
+                for result in polls["results"]:
+                    progressed = True
+                    if result.pop("_has_checkpoint", False):
+                        trial.checkpoint = ray_tpu.get(
+                            trial.actor.get_checkpoint.remote(), timeout=30)
+                    trial.last_result = result
+                    trial.results.append(result)
+                    d = self.scheduler.on_trial_result(self, trial, result)
+                    if d != sched_mod.CONTINUE:
+                        decision = d
+                if decision == sched_mod.STOP:
+                    self._stop_trial(trial, TERMINATED)
+                    live.remove(trial)
+                    self.scheduler.on_trial_complete(self, trial,
+                                                     trial.last_result)
+                    continue
+                if trial.trial_id in self._exploit_requests:
+                    new_config, ckpt = self._exploit_requests.pop(
+                        trial.trial_id)
+                    self._stop_trial(trial, PAUSED)
+                    live.remove(trial)
+                    trial.config = new_config
+                    if ckpt is not None:
+                        trial.checkpoint = ckpt
+                    trial.status = PENDING
+                    pending.append(trial)
+                    continue
+                if polls["done"]:
+                    live.remove(trial)
+                    if polls["error"]:
+                        trial.num_failures += 1
+                        trial.error = polls["error"]
+                        maxf = self.run_config.failure_config.max_failures
+                        if maxf < 0 or trial.num_failures <= maxf:
+                            logger.warning(
+                                "trial %s failed (%d); restoring from "
+                                "checkpoint", trial.trial_id,
+                                trial.num_failures)
+                            self._stop_trial(trial, PENDING)
+                            pending.append(trial)
+                        else:
+                            self._stop_trial(trial, ERROR)
+                            self.scheduler.on_trial_complete(self, trial, None)
+                    else:
+                        trial.error = None  # a successful retry clears it
+                        ckpt = ray_tpu.get(
+                            trial.actor.get_checkpoint.remote(), timeout=30)
+                        if ckpt is not None:
+                            trial.checkpoint = ckpt
+                        self._stop_trial(trial, TERMINATED)
+                        self.scheduler.on_trial_complete(
+                            self, trial, trial.last_result)
+            if not progressed:
+                time.sleep(poll_period)
+        return self.trials

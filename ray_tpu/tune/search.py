@@ -1,0 +1,170 @@
+"""Search spaces and suggestion algorithms.
+
+Parity: reference ``python/ray/tune/search/`` — sample-space primitives
+(``tune.uniform`` … ``tune.grid_search``, sample.py), the
+``BasicVariantGenerator`` grid/random resolver (basic_variant.py), and a
+native TPE-free BayesOpt-style searcher is out of scope (pluggable via
+``Searcher``)."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    categories: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+@dataclass
+class Quantized(Domain):
+    base: Domain
+    q: float
+
+    def sample(self, rng):
+        v = self.base.sample(rng)
+        return round(v / self.q) * self.q
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(list(categories))
+
+
+def quniform(low: float, high: float, q: float) -> Quantized:
+    return Quantized(Uniform(low, high), q)
+
+
+def grid_search(values: List[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+def sample_from(fn: Callable[[Dict[str, Any]], Any]) -> "Function":
+    return Function(fn)
+
+
+@dataclass
+class Function(Domain):
+    fn: Callable
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+class BasicVariantGenerator:
+    """Resolves a param_space into trial configs: cartesian product over
+    grid_search values × num_samples random draws of Domain params.
+    Parity: reference ``tune/search/basic_variant.py``."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+
+    def generate(self, param_space: Dict[str, Any], num_samples: int
+                 ) -> List[Dict[str, Any]]:
+        grid_keys = [k for k, v in param_space.items() if _is_grid(v)]
+        grid_values = [param_space[k]["grid_search"] for k in grid_keys]
+        configs: List[Dict[str, Any]] = []
+        grids = list(itertools.product(*grid_values)) if grid_keys else [()]
+        for _ in range(num_samples):
+            for combo in grids:
+                cfg = {}
+                for k, v in param_space.items():
+                    if k in grid_keys:
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    elif isinstance(v, dict) and not _is_grid(v):
+                        cfg[k] = self._resolve_nested(v)
+                    else:
+                        cfg[k] = v
+                configs.append(cfg)
+        return configs
+
+    def _resolve_nested(self, space: Dict[str, Any]) -> Dict[str, Any]:
+        out = {}
+        for k, v in space.items():
+            if isinstance(v, Domain):
+                out[k] = v.sample(self._rng)
+            elif isinstance(v, dict) and _is_grid(v):
+                out[k] = self._rng.choice(v["grid_search"])
+            elif isinstance(v, dict):
+                out[k] = self._resolve_nested(v)
+            else:
+                out[k] = v
+        return out
+
+
+class Searcher:
+    """Pluggable suggestion interface (parity: tune/search/searcher.py).
+    Subclasses implement ``suggest``/``on_trial_complete``."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        pass

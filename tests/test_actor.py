@@ -49,7 +49,7 @@ def test_two_actors_isolated():
 
 
 def test_named_actor():
-    Counter.options(name="counter-x").remote(7)
+    a = Counter.options(name="counter-x").remote(7)  # noqa: F841 — keep alive
     h = ray_tpu.get_actor("counter-x")
     assert ray_tpu.get(h.read.remote(), timeout=60) == 7
 

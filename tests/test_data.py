@@ -1,0 +1,199 @@
+"""ray_tpu.data tests (parity model: reference python/ray/data/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data.preprocessors import (Chain, Concatenator, LabelEncoder,
+                                        MinMaxScaler, OneHotEncoder,
+                                        StandardScaler)
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+def test_range_count_take():
+    ds = rdata.range(100, parallelism=4)
+    assert ds.num_blocks() == 4
+    assert ds.count() == 100
+    assert [r["id"] for r in ds.take(5)] == [0, 1, 2, 3, 4]
+
+
+def test_from_items_and_map():
+    ds = rdata.from_items([{"x": i} for i in range(20)], parallelism=2)
+    out = ds.map(lambda r: {"x": r["x"] * 2}).take_all()
+    assert sorted(r["x"] for r in out) == [i * 2 for i in range(20)]
+
+
+def test_map_batches_fusion():
+    ds = rdata.range(64, parallelism=4)
+    ds = ds.map_batches(lambda b: {"id": b["id"] + 1})
+    ds = ds.map_batches(lambda b: {"id": b["id"] * 10})
+    # two lazy stages, still 4 blocks, fused on execute
+    assert len(ds._stages) == 2
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == sorted((i + 1) * 10 for i in range(64))
+
+
+def test_filter_flat_map():
+    ds = rdata.range(30, parallelism=3).filter(lambda r: r["id"] % 2 == 0)
+    assert ds.count() == 15
+    ds2 = rdata.from_items([1, 2, 3]).flat_map(lambda x: [x, x])
+    assert sorted(ds2.take_all()) == [1, 1, 2, 2, 3, 3]
+
+
+def test_repartition_split():
+    ds = rdata.range(100, parallelism=5).repartition(2)
+    assert ds.num_blocks() == 2
+    assert ds.count() == 100
+    shards = rdata.range(100, parallelism=4).split(3, equal=True)
+    counts = [s.count() for s in shards]
+    assert sum(counts) >= 99 and max(counts) - min(counts) <= 1
+
+
+def test_split_at_indices():
+    parts = rdata.range(10, parallelism=2).split_at_indices([3, 7])
+    assert [p.count() for p in parts] == [3, 4, 3]
+
+
+def test_random_shuffle():
+    ds = rdata.range(200, parallelism=4).random_shuffle(seed=7)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(200))
+    assert vals != list(range(200))
+
+
+def test_sort():
+    rng = np.random.default_rng(0)
+    items = [{"k": int(v)} for v in rng.permutation(50)]
+    ds = rdata.from_items(items, parallelism=4).sort("k")
+    assert [r["k"] for r in ds.take_all()] == list(range(50))
+    ds_desc = rdata.from_items(items, parallelism=3).sort("k", descending=True)
+    assert [r["k"] for r in ds_desc.take_all()] == list(range(49, -1, -1))
+
+
+def test_zip_union():
+    a = rdata.range(10, parallelism=2)
+    b = rdata.range(10, parallelism=2).map_batches(
+        lambda bb: {"y": bb["id"] * 2})
+    z = a.zip(b)
+    rows = z.take_all()
+    assert all(r["y"] == r["id"] * 2 for r in rows)
+    u = a.union(a)
+    assert u.count() == 20
+
+
+def test_groupby():
+    items = [{"g": i % 3, "v": i} for i in range(30)]
+    ds = rdata.from_items(items, parallelism=4)
+    counts = {r["g"]: r["count()"] for r in ds.groupby("g").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+    sums = {r["g"]: r["sum(v)"] for r in ds.groupby("g").sum("v").take_all()}
+    assert sums[0] == sum(i for i in range(30) if i % 3 == 0)
+
+
+def test_aggregations():
+    ds = rdata.from_items([{"x": float(i)} for i in range(10)], parallelism=2)
+    assert ds.sum("x") == 45.0
+    assert ds.min("x") == 0.0
+    assert ds.max("x") == 9.0
+    assert ds.mean("x") == 4.5
+
+
+def test_iter_batches_exact_sizes():
+    ds = rdata.range(100, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=32))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [32, 32, 32, 4]
+    all_ids = np.concatenate([b["id"] for b in batches])
+    assert sorted(all_ids.tolist()) == list(range(100))
+
+
+def test_limit_and_sample():
+    ds = rdata.range(100, parallelism=4)
+    assert ds.limit(17).count() == 17
+    frac = rdata.range(1000, parallelism=2).random_sample(0.5, seed=3).count()
+    assert 400 < frac < 600
+
+
+def test_csv_roundtrip(tmp_path):
+    import pandas as pd
+
+    p = os.path.join(tmp_path, "t.csv")
+    pd.DataFrame({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0]}).to_csv(p, index=False)
+    ds = rdata.read_csv(p)
+    assert ds.count() == 3
+    assert ds.sum("a") == 6
+
+
+def test_json_numpy_roundtrip(tmp_path):
+    import json
+
+    p = os.path.join(tmp_path, "t.jsonl")
+    with open(p, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"v": i}) + "\n")
+    assert rdata.read_json(p).count() == 5
+
+    npy = os.path.join(tmp_path, "a.npy")
+    np.save(npy, np.arange(12).reshape(3, 4))
+    ds = rdata.read_numpy(npy)
+    assert ds.count() == 3
+
+
+def test_from_pandas_to_pandas():
+    import pandas as pd
+
+    df = pd.DataFrame({"x": np.arange(10), "y": np.arange(10) * 2})
+    ds = rdata.from_pandas(df)
+    out = ds.to_pandas()
+    assert list(out["y"]) == [i * 2 for i in range(10)]
+
+
+def test_actor_pool_strategy():
+    class AddOne:
+        def __call__(self, batch):
+            return {"id": batch["id"] + 1}
+
+    ds = rdata.range(40, parallelism=4).map_batches(
+        AddOne, compute=rdata.ActorPoolStrategy(size=2))
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(1, 41))
+
+
+def test_preprocessors():
+    ds = rdata.from_items(
+        [{"a": float(i), "c": i % 2} for i in range(8)], parallelism=2)
+    ss = StandardScaler(["a"]).fit(ds)
+    out = ss.transform(ds).to_pandas()
+    assert abs(out["a"].mean()) < 1e-6
+
+    mm = MinMaxScaler(["a"]).fit(ds)
+    out2 = mm.transform(ds).to_pandas()
+    assert out2["a"].min() == 0.0 and out2["a"].max() == 1.0
+
+    ohe = OneHotEncoder(["c"]).fit(ds)
+    out3 = ohe.transform(ds).to_pandas()
+    assert "c_0" in out3 and "c_1" in out3
+
+    chain = Chain(MinMaxScaler(["a"]), Concatenator(include=["a"]))
+    chain.fit(ds)
+    out4 = chain.transform(ds).take(1)[0]
+    assert "concat_out" in out4
+
+
+def test_pipeline_window_repeat():
+    ds = rdata.range(40, parallelism=4)
+    pipe = ds.window(blocks_per_window=2)
+    total = sum(len(b["id"]) for b in pipe.iter_batches(batch_size=10))
+    assert total == 40
+    pipe2 = ds.repeat(3)
+    assert pipe2.count() == 120
+
+
+def test_to_jax():
+    ds = rdata.range(32, parallelism=2)
+    batches = list(ds.to_jax(batch_size=16))
+    assert len(batches) == 2
+    assert batches[0]["id"].shape == (16,)

@@ -1,0 +1,184 @@
+"""ray_tpu.serve tests (parity model: reference python/ray/serve/tests/)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+@pytest.fixture(autouse=True)
+def _serve_cleanup():
+    yield
+    serve.shutdown()
+
+
+def test_deploy_and_call():
+    @serve.deployment
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    handle = serve.run(Adder.bind(10))
+    assert ray_tpu.get(handle.remote(5), timeout=60) == 15
+
+
+def test_function_deployment():
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind())
+    assert ray_tpu.get(handle.remote(21), timeout=60) == 42
+
+
+def test_multiple_replicas_round_robin():
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, _):
+            return self.pid
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {ray_tpu.get(handle.remote(None), timeout=60) for _ in range(10)}
+    assert len(pids) == 2
+
+
+def test_method_call_via_handle():
+    @serve.deployment
+    class Calc:
+        def add(self, a, b):
+            return a + b
+
+        def mul(self, a, b):
+            return a * b
+
+    serve.run(Calc.bind())
+    h = serve.get_deployment_handle("Calc")
+    assert ray_tpu.get(h.add.remote(2, 3), timeout=60) == 5
+    assert ray_tpu.get(h.mul.remote(2, 3), timeout=60) == 6
+
+
+def test_user_config_reconfigure():
+    @serve.deployment(user_config={"threshold": 5})
+    class Thresh:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, cfg):
+            self.threshold = cfg["threshold"]
+
+        def __call__(self, x):
+            return x > self.threshold
+
+    handle = serve.run(Thresh.bind())
+    assert ray_tpu.get(handle.remote(7), timeout=60) is True
+    assert ray_tpu.get(handle.remote(3), timeout=60) is False
+
+
+def test_redeploy_rolling_update():
+    @serve.deployment
+    class V:
+        def __call__(self, _):
+            return "v1"
+
+    serve.run(V.bind())
+    h = serve.get_deployment_handle("V")
+    assert ray_tpu.get(h.remote(None), timeout=60) == "v1"
+
+    @serve.deployment(name="V")
+    class V2:
+        def __call__(self, _):
+            return "v2"
+
+    serve.run(V2.bind())
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ray_tpu.get(h.remote(None), timeout=60) == "v2":
+            break
+        time.sleep(0.2)
+    assert ray_tpu.get(h.remote(None), timeout=60) == "v2"
+
+
+def test_delete_deployment():
+    @serve.deployment
+    def f(_):
+        return 1
+
+    serve.run(f.bind())
+    assert "f" in serve.status()
+    serve.delete("f")
+    assert "f" not in serve.status()
+
+
+def test_batching():
+    calls = []
+
+    @serve.deployment
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def handler(self, items):
+            calls.append(len(items))
+            return [i * 2 for i in items]
+
+        def __call__(self, x):
+            return self.handler(x)
+
+    handle = serve.run(Batched.bind())
+    refs = [handle.remote(i) for i in range(8)]
+    out = sorted(ray_tpu.get(refs, timeout=60))
+    assert out == [i * 2 for i in range(8)]
+
+
+def test_autoscaling_scales_up():
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_num_ongoing_requests_per_replica": 1,
+    })
+    class Slow:
+        def __call__(self, _):
+            time.sleep(1.0)
+            return 1
+
+    handle = serve.run(Slow.bind())
+    refs = [handle.remote(None) for _ in range(12)]
+    deadline = time.monotonic() + 45
+    scaled = False
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["num_replicas"] >= 2:
+            scaled = True
+            break
+        time.sleep(0.2)
+    ray_tpu.get(refs, timeout=120)
+    assert scaled
+
+
+def test_http_proxy():
+    from ray_tpu.serve.http_proxy import start_proxy
+
+    @serve.deployment
+    def echo(payload):
+        return {"echoed": payload}
+
+    serve.run(echo.bind())
+    host, port = start_proxy()
+    data = json.dumps({"hello": "world"}).encode()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/echo", data=data,
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert body["result"]["echoed"]["hello"] == "world"

@@ -1,0 +1,129 @@
+"""ray_tpu.tune tests (parity model: reference python/ray/tune/tests/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import FailureConfig, RunConfig
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+def _trainable(config):
+    score = config["a"] * 10 + config.get("b", 0)
+    for i in range(3):
+        tune.report({"score": score + i})
+
+
+def test_grid_search_runs_all():
+    results = tune.run(
+        _trainable,
+        config={"a": tune.grid_search([1, 2, 3]), "b": 5},
+        metric="score", mode="max")
+    assert len(results) == 3
+    best = results.get_best_result()
+    assert best.config["a"] == 3
+    assert best.metrics["score"] == 37  # 3*10+5+2
+
+
+def test_random_search_num_samples():
+    results = tune.run(
+        _trainable,
+        config={"a": tune.uniform(0, 1), "b": tune.randint(0, 10)},
+        num_samples=4, metric="score", mode="max")
+    assert len(results) == 4
+    assert not results.errors
+    # sampled configs differ
+    configs = {(r.config["a"], r.config["b"]) for r in
+               (results[i] for i in range(4))}
+    assert len(configs) > 1
+
+
+def test_asha_stops_bad_trials():
+    def trainable(config):
+        for i in range(20):
+            tune.report({"loss": config["lr"] * (20 - i)})
+
+    sched = tune.AsyncHyperBandScheduler(
+        metric="loss", mode="min", max_t=20, grace_period=2,
+        reduction_factor=2)
+    results = tune.run(
+        trainable, config={"lr": tune.grid_search([1.0, 2.0, 4.0, 8.0])},
+        scheduler=sched, metric="loss", mode="min")
+    iters = [results[i].metrics.get("training_iteration", 0)
+             for i in range(len(results))]
+    # at least one trial ran to completion, at least one stopped early
+    assert max(iters) == 20
+    assert min(iters) < 20
+
+
+def test_checkpoint_and_failure_recovery():
+    def flaky(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        for step in range(start, 6):
+            tune.report({"step_metric": step},
+                        checkpoint=Checkpoint.from_dict({"step": step + 1}))
+            if step == 2 and ckpt is None:
+                raise RuntimeError("injected failure")
+
+    tuner = tune.Tuner(
+        flaky, param_space={},
+        tune_config=tune.TuneConfig(metric="step_metric", mode="max"),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=2)))
+    results = tuner.fit()
+    assert not results.errors
+    assert results[0].metrics["step_metric"] == 5
+    assert results[0].checkpoint.to_dict()["step"] == 6
+
+
+def test_pbt_exploits():
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        state = ckpt.to_dict() if ckpt else {"acc": 0.0}
+        acc = state["acc"]
+        for _ in range(30):
+            acc += config["lr"]
+            tune.report({"acc": acc},
+                        checkpoint=Checkpoint.from_dict({"acc": acc}))
+
+    sched = tune.PopulationBasedTraining(
+        metric="acc", mode="max", perturbation_interval=5,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=0)
+    results = tune.run(
+        trainable, config={"lr": tune.grid_search([0.01, 0.5])},
+        scheduler=sched, metric="acc", mode="max")
+    best = results.get_best_result()
+    assert best.metrics["acc"] > 1.0
+
+
+def test_search_space_primitives():
+    gen = tune.BasicVariantGenerator(seed=1)
+    cfgs = gen.generate({
+        "g": tune.grid_search(["x", "y"]),
+        "u": tune.uniform(0, 1),
+        "l": tune.loguniform(1e-4, 1e-1),
+        "c": tune.choice([1, 2, 3]),
+        "q": tune.quniform(0, 10, 2),
+        "nested": {"r": tune.randint(5, 9)},
+        "fixed": 42,
+    }, num_samples=2)
+    assert len(cfgs) == 4
+    for c in cfgs:
+        assert c["g"] in ("x", "y")
+        assert 0 <= c["u"] <= 1
+        assert 1e-4 <= c["l"] <= 1e-1
+        assert c["c"] in (1, 2, 3)
+        assert c["q"] % 2 == 0
+        assert 5 <= c["nested"]["r"] < 9
+        assert c["fixed"] == 42
+
+
+def test_result_grid_dataframe():
+    results = tune.run(_trainable,
+                       config={"a": tune.grid_search([1, 2])},
+                       metric="score", mode="max")
+    df = results.get_dataframe()
+    assert len(df) == 2
+    assert "config/a" in df.columns
