@@ -75,6 +75,9 @@ class PlacementGroupInfo:
     # bundle index -> node id
     bundle_nodes: Dict[int, NodeID] = field(default_factory=dict)
     name: Optional[str] = None
+    scheduling: bool = False  # reentrancy guard for _schedule_pg
+    retry_at: float = 0.0  # monotonic time of next placement attempt
+    retry_backoff: float = 0.5  # grows while unplaceable, capped
 
 
 class GcsServer:
@@ -107,12 +110,17 @@ class GcsServer:
         self._health_task = asyncio.get_running_loop().create_task(
             self._health_check_loop()
         )
+        self._pg_retry_task = asyncio.get_running_loop().create_task(
+            self._pg_retry_loop()
+        )
         logger.info("GCS listening on %s", address)
         return address
 
     async def stop(self) -> None:
         if self._health_task:
             self._health_task.cancel()
+        if self._pg_retry_task:
+            self._pg_retry_task.cancel()
         await self.server.stop()
         self.pool.close_all()
 
@@ -524,15 +532,78 @@ class GcsServer:
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": "REMOVED"})
         return True
 
+    async def _pg_retry_loop(self) -> None:
+        """Reschedule unplaced groups as resources free up.
+
+        Parity: GcsPlacementGroupManager's pending queue + retry on
+        resource change (gcs_placement_group_manager.h:221) — raylet
+        resource views are refreshed by health reports, so a group that
+        failed placement (e.g. a previous gang's resources not yet
+        returned) becomes placeable moments later.
+        """
+        while True:
+            await asyncio.sleep(0.25)
+            now = time.monotonic()
+            for pg in list(self.placement_groups.values()):
+                if pg.state not in ("PENDING", "INFEASIBLE", "RESCHEDULING"):
+                    continue
+                if now < pg.retry_at:
+                    continue
+                try:
+                    await self._schedule_pg(pg)
+                except Exception:
+                    logger.exception("pg retry failed %s",
+                                     pg.pg_id.hex()[:12])
+                if pg.state == "CREATED":
+                    pg.retry_backoff = 0.5
+                else:  # back off while unplaceable (cap: 5s)
+                    pg.retry_at = now + pg.retry_backoff
+                    pg.retry_backoff = min(pg.retry_backoff * 2, 5.0)
+
     async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
         """Pick nodes per strategy, then two-phase prepare/commit bundles.
 
         Parity: GcsPlacementGroupScheduler (gcs_placement_group_scheduler.h:265).
         """
+        if pg.scheduling or pg.state in ("CREATED", "REMOVED"):
+            return
+        pg.scheduling = True
+        try:
+            await self._schedule_pg_inner(pg)
+        finally:
+            pg.scheduling = False
+
+    def _set_pg_state(self, pg: PlacementGroupInfo, state: str) -> None:
+        """Transition + publish, but only on an actual change (the retry
+        loop would otherwise re-publish the same state twice a second)."""
+        if pg.state == state:
+            return
+        pg.state = state
+        self.publish(f"pg:{pg.pg_id.hex()}", {"state": state})
+
+    async def _rollback_bundles(self, pg: PlacementGroupInfo,
+                                placement: Dict[int, "NodeInfo"],
+                                indices: List[int]) -> None:
+        for index in indices:
+            node = placement[index]
+            try:
+                conn = await self.pool.get(node.raylet_address)
+                await conn.call("return_bundle",
+                                {"pg_id": pg.pg_id.binary(),
+                                 "bundle_index": index}, timeout=30.0)
+            except Exception:
+                pass
+
+    async def _schedule_pg_inner(self, pg: PlacementGroupInfo) -> None:
+        # a RESCHEDULING group may still hold bundles on surviving nodes
+        # from its previous placement; release them before re-planning so
+        # they neither block the new plan nor leak when it lands elsewhere
+        if pg.bundle_nodes:
+            await self._release_pg_bundles(pg, set(pg.bundle_nodes))
+            pg.bundle_nodes.clear()
         placement = self._plan_bundles(pg)
         if placement is None:
-            pg.state = "INFEASIBLE"
-            self.publish(f"pg:{pg.pg_id.hex()}", {"state": pg.state})
+            self._set_pg_state(pg, "INFEASIBLE")
             return
         # phase 1: prepare on every involved raylet
         prepared: List[int] = []
@@ -552,26 +623,26 @@ class GcsServer:
             except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
                 ok = False
                 break
-        if not ok:  # roll back phase-1 reservations
-            for index in prepared:
-                node = placement[index]
-                try:
+        if ok and pg.state != "REMOVED":
+            # phase 2: commit
+            try:
+                for index, node in placement.items():
                     conn = await self.pool.get(node.raylet_address)
-                    await conn.call("return_bundle",
+                    await conn.call("commit_bundle",
                                     {"pg_id": pg.pg_id.binary(),
                                      "bundle_index": index}, timeout=30.0)
-                except Exception:
-                    pass
-            pg.state = "PENDING"
-            self.publish(f"pg:{pg.pg_id.hex()}", {"state": pg.state})
+                    pg.bundle_nodes[index] = node.node_id
+            except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+                ok = False
+        if not ok or pg.state == "REMOVED":
+            # roll back every reservation (prepared and already-committed);
+            # dead nodes drop theirs implicitly when the raylet goes away
+            await self._rollback_bundles(
+                pg, placement, sorted(set(prepared) | set(pg.bundle_nodes)))
+            pg.bundle_nodes.clear()
+            if pg.state != "REMOVED":  # removal is terminal — don't resurrect
+                self._set_pg_state(pg, "PENDING")
             return
-        # phase 2: commit
-        for index, node in placement.items():
-            conn = await self.pool.get(node.raylet_address)
-            await conn.call("commit_bundle",
-                            {"pg_id": pg.pg_id.binary(),
-                             "bundle_index": index}, timeout=30.0)
-            pg.bundle_nodes[index] = node.node_id
         pg.state = "CREATED"
         self.publish(f"pg:{pg.pg_id.hex()}",
                      {"state": pg.state,

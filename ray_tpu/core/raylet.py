@@ -554,10 +554,12 @@ class Raylet:
     # ------------------------------------------------------------------
     async def handle_prepare_bundle(self, conn, data):
         resources = dict(data["resources"])
+        key = (data["pg_id"], data["bundle_index"])
+        if key in self._bundle_totals:
+            return True  # idempotent: GCS retry of an already-held bundle
         if not all(self.resources_available.get(k, 0.0) >= v
                    for k, v in resources.items()):
             return False
-        key = (data["pg_id"], data["bundle_index"])
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) - v
         self._bundles[key] = dict(resources)  # held but uncommitted

@@ -39,6 +39,7 @@ class PlacementGroup:
 
         # resolve by polling GCS on the io loop, then publishing the ref
         async def _poll():
+            delay = 0.05
             while True:
                 reply = await core.gcs_conn.call(
                     "placement_group_ready", {"pg_id": self.id.binary()})
@@ -46,7 +47,10 @@ class PlacementGroup:
                     from ray_tpu.core.serialization import serialize
                     core._publish(ref.id(), serialize(self).to_bytes())
                     return
-                if reply["state"] in ("REMOVED", "INFEASIBLE"):
+                # INFEASIBLE is transient: the GCS retries placement as
+                # resources free / nodes join (autoscaler hook).  Only
+                # REMOVED is terminal.
+                if reply["state"] == "REMOVED":
                     from ray_tpu.core.serialization import serialize_exception
                     core._publish(ref.id(), serialize_exception(
                         PlacementGroupUnschedulableError(
@@ -54,7 +58,8 @@ class PlacementGroup:
                     ).to_bytes())
                     return
                 import asyncio
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 1.0)  # unplaceable groups poll at 1 Hz
 
         core.memory_store.delete(ref.id())
         core._post(_poll())
@@ -68,7 +73,7 @@ class PlacementGroup:
                 "placement_group_ready", {"pg_id": self.id.binary()}))
             if reply["state"] == "CREATED":
                 return True
-            if reply["state"] in ("REMOVED", "INFEASIBLE"):
+            if reply["state"] == "REMOVED":
                 return False
             time.sleep(0.05)
         return False
