@@ -1,0 +1,102 @@
+"""util.collective tests (parity model: reference
+python/ray/util/collective/tests/ single-process-per-rank suites)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+@ray_tpu.remote
+class Rank:
+    """One collective participant per actor process."""
+
+    def init_collective_group(self, world_size, rank, backend, group_name):
+        col.init_collective_group(world_size, rank, backend, group_name)
+        self.rank = rank
+        self.world = world_size
+        self.group = group_name
+        return rank
+
+    def allreduce(self, value):
+        t = np.full((4,), float(value))
+        return col.allreduce(t, group_name=self.group)
+
+    def broadcast(self):
+        t = np.full((3,), float(self.rank))
+        return col.broadcast(t, src_rank=1, group_name=self.group)
+
+    def allgather(self):
+        out = []
+        col.allgather(out, np.array([self.rank], dtype=np.int64),
+                      group_name=self.group)
+        return out
+
+    def reducescatter(self):
+        shards = [np.full((2,), float(self.rank + 10 * i))
+                  for i in range(self.world)]
+        return col.reducescatter(np.zeros(2), shards, group_name=self.group)
+
+    def barrier_then_rank(self):
+        col.barrier(group_name=self.group)
+        return self.rank
+
+    def sendrecv(self):
+        if self.rank == 0:
+            col.send(np.arange(5.0), dst_rank=1, group_name=self.group)
+            return None
+        return col.recv(np.zeros(5), src_rank=0, group_name=self.group)
+
+    def rank_info(self):
+        return (col.get_rank(self.group),
+                col.get_collective_group_size(self.group))
+
+
+def _make_group(n, group_name):
+    actors = [Rank.remote() for _ in range(n)]
+    col.create_collective_group(actors, n, list(range(n)),
+                                group_name=group_name)
+    return actors
+
+
+def test_allreduce_sum():
+    actors = _make_group(3, "g_allreduce")
+    outs = ray_tpu.get([a.allreduce.remote(v) for a, v in
+                        zip(actors, [1, 2, 3])], timeout=60)
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((4,), 6.0))
+
+
+def test_broadcast():
+    actors = _make_group(3, "g_bcast")
+    outs = ray_tpu.get([a.broadcast.remote() for a in actors], timeout=60)
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((3,), 1.0))
+
+
+def test_allgather():
+    actors = _make_group(3, "g_gather")
+    outs = ray_tpu.get([a.allgather.remote() for a in actors], timeout=60)
+    for o in outs:
+        assert [int(x[0]) for x in o] == [0, 1, 2]
+
+
+def test_reducescatter():
+    actors = _make_group(2, "g_rs")
+    outs = ray_tpu.get([a.reducescatter.remote() for a in actors],
+                       timeout=60)
+    # stripe r = sum over ranks of (rank + 10*r)
+    np.testing.assert_allclose(outs[0], np.full((2,), 1.0))   # 0+1
+    np.testing.assert_allclose(outs[1], np.full((2,), 21.0))  # 10+11
+    ray_tpu.get(actors[0].rank_info.remote(), timeout=30) == (0, 2)
+
+
+def test_barrier_and_sendrecv():
+    actors = _make_group(2, "g_p2p")
+    assert sorted(ray_tpu.get(
+        [a.barrier_then_rank.remote() for a in actors], timeout=60)) == [0, 1]
+    outs = ray_tpu.get([a.sendrecv.remote() for a in actors], timeout=60)
+    np.testing.assert_allclose(outs[1], np.arange(5.0))
