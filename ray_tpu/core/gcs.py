@@ -64,6 +64,9 @@ class ActorInfo:
     owner_job: Optional[JobID] = None
     death_cause: str = ""
     class_name: str = ""
+    # gang binding: schedule onto this group's bundle, charged to it
+    pg_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
 
 
 @dataclass
@@ -335,6 +338,9 @@ class GcsServer:
             resources=dict(data.get("resources", {})),
             owner_job=JobID(data["job_id"]),
             class_name=data.get("class_name", ""),
+            pg_id=PlacementGroupID(data["placement_group_id"])
+            if data.get("placement_group_id") else None,
+            bundle_index=data.get("bundle_index", -1),
         )
         self.actors[actor_id] = info
         asyncio.get_running_loop().create_task(self._schedule_actor(info))
@@ -365,18 +371,43 @@ class GcsServer:
             while time.monotonic() < deadline:
                 if info.state == ACTOR_DEAD:
                     return
-                node = self._pick_node(info.resources,
-                                       getattr(info, "_pg_node", None))
-                if node is None:
-                    await asyncio.sleep(0.2)  # wait for resources/nodes
-                    continue
+                pg = self.placement_groups.get(info.pg_id) \
+                    if info.pg_id else None
+                if info.pg_id is not None:
+                    # gang-bound: the bundle's node is the only candidate,
+                    # and the lease is charged to the bundle's reservation
+                    # (the node pool already paid for it at prepare time)
+                    if pg is None or pg.state == "REMOVED":
+                        info.state = ACTOR_DEAD
+                        info.death_cause = "placement group removed"
+                        self._publish_actor(info)
+                        return
+                    if pg.state != "CREATED":
+                        await asyncio.sleep(0.1)
+                        continue
+                    if info.bundle_index >= 0:
+                        node_id = pg.bundle_nodes.get(info.bundle_index)
+                    else:
+                        node_id = next(iter(pg.bundle_nodes.values()), None)
+                    node = self.nodes.get(node_id) if node_id else None
+                    if node is None or not node.alive:
+                        await asyncio.sleep(0.2)
+                        continue
+                else:
+                    node = self._pick_node(info.resources)
+                    if node is None:
+                        await asyncio.sleep(0.2)  # wait for resources/nodes
+                        continue
                 try:
                     conn = await self.pool.get(node.raylet_address)
                     reply = await conn.call(
                         "lease_worker_for_actor",
                         {"actor_id": info.actor_id.binary(),
                          "resources": info.resources,
-                         "spec_blob": info.creation_spec_blob},
+                         "spec_blob": info.creation_spec_blob,
+                         "placement_group_id":
+                             info.pg_id.binary() if info.pg_id else None,
+                         "bundle_index": info.bundle_index},
                         timeout=60.0,
                     )
                 except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError) as e:
@@ -530,6 +561,13 @@ class GcsServer:
         pg.state = "REMOVED"
         pg.bundle_nodes.clear()
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": "REMOVED"})
+        # actors gang-bound to the group die with it (their worker
+        # processes are killed by the raylets' return_bundle path)
+        for info in self.actors.values():
+            if info.pg_id == pg.pg_id and info.state != ACTOR_DEAD:
+                info.state = ACTOR_DEAD
+                info.death_cause = "placement group removed"
+                self._publish_actor(info)
         return True
 
     async def _pg_retry_loop(self) -> None:
@@ -581,11 +619,13 @@ class GcsServer:
         pg.state = state
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": state})
 
-    async def _rollback_bundles(self, pg: PlacementGroupInfo,
-                                placement: Dict[int, "NodeInfo"],
-                                indices: List[int]) -> None:
-        for index in indices:
-            node = placement[index]
+    async def _return_bundles(self, pg: PlacementGroupInfo,
+                              targets: List[Tuple[int, "NodeInfo"]]) -> None:
+        """Best-effort return_bundle for each (index, node); dead or
+        unreachable raylets drop their reservations when they go away."""
+        for index, node in targets:
+            if node is None or not node.alive:
+                continue
             try:
                 conn = await self.pool.get(node.raylet_address)
                 await conn.call("return_bundle",
@@ -621,6 +661,9 @@ class GcsServer:
                     ok = False
                     break
             except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+                # the raylet may have reserved before the reply was lost —
+                # include it in the rollback so the reservation can't leak
+                prepared.append(index)
                 ok = False
                 break
         if ok and pg.state != "REMOVED":
@@ -628,17 +671,23 @@ class GcsServer:
             try:
                 for index, node in placement.items():
                     conn = await self.pool.get(node.raylet_address)
-                    await conn.call("commit_bundle",
-                                    {"pg_id": pg.pg_id.binary(),
-                                     "bundle_index": index}, timeout=30.0)
+                    committed = await conn.call(
+                        "commit_bundle",
+                        {"pg_id": pg.pg_id.binary(),
+                         "bundle_index": index}, timeout=30.0)
+                    if not committed:
+                        # raylet lost the bundle (e.g. restarted between
+                        # prepare and commit) — replan from scratch
+                        ok = False
+                        break
                     pg.bundle_nodes[index] = node.node_id
             except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
                 ok = False
         if not ok or pg.state == "REMOVED":
-            # roll back every reservation (prepared and already-committed);
-            # dead nodes drop theirs implicitly when the raylet goes away
-            await self._rollback_bundles(
-                pg, placement, sorted(set(prepared) | set(pg.bundle_nodes)))
+            # roll back every prepared reservation (committed indices are
+            # always a subset — bundle_nodes was cleared at entry)
+            await self._return_bundles(
+                pg, [(i, placement[i]) for i in sorted(prepared)])
             pg.bundle_nodes.clear()
             if pg.state != "REMOVED":  # removal is terminal — don't resurrect
                 self._set_pg_state(pg, "PENDING")
@@ -722,15 +771,6 @@ class GcsServer:
 
     async def _release_pg_bundles(self, pg: PlacementGroupInfo,
                                   indices: set) -> None:
-        for index in indices:
-            node_id = pg.bundle_nodes.get(index)
-            node = self.nodes.get(node_id) if node_id else None
-            if node is None or not node.alive:
-                continue
-            try:
-                conn = await self.pool.get(node.raylet_address)
-                await conn.call("return_bundle",
-                                {"pg_id": pg.pg_id.binary(),
-                                 "bundle_index": index}, timeout=30.0)
-            except Exception:
-                pass
+        node_of = lambda i: self.nodes.get(pg.bundle_nodes[i]) \
+            if pg.bundle_nodes.get(i) else None
+        await self._return_bundles(pg, [(i, node_of(i)) for i in indices])

@@ -336,8 +336,12 @@ class Raylet:
     def _give(self, resources: Dict[str, float],
               bundle: Optional[Tuple[bytes, int]]) -> None:
         if bundle is not None and bundle not in self._bundles:
-            return  # bundle was removed while leased
-        pool = self._resource_pool(bundle)
+            # bundle was returned while this lease was out: return_bundle
+            # refunded only the unleased remainder, so the leased share
+            # re-enters the node pool here
+            pool = self.resources_available
+        else:
+            pool = self._resource_pool(bundle)
         for k, v in resources.items():
             pool[k] = pool.get(k, 0.0) + v
 
@@ -572,12 +576,21 @@ class Raylet:
 
     async def handle_return_bundle(self, conn, data):
         key = (data["pg_id"], data["bundle_index"])
-        total = self._bundle_totals.pop(key, None)
-        self._bundles.pop(key, None)
-        if total is not None:
-            for k, v in total.items():
+        self._bundle_totals.pop(key, None)
+        remaining = self._bundles.pop(key, None)
+        if remaining is not None:
+            # refund only the unleased remainder; shares held by live
+            # leases come back through _give when each worker releases
+            for k, v in remaining.items():
                 self.resources_available[k] = \
                     self.resources_available.get(k, 0.0) + v
+        # gang semantics: leases from a returned bundle are revoked — kill
+        # their workers so the rescheduled gang can't double-book the chips
+        for worker in list(self.workers.values()):
+            if worker.leased and worker.lease_bundle == key:
+                if worker.proc is not None:
+                    worker.proc.terminate()
+                self._on_worker_dead(worker, "placement group bundle returned")
         self._maybe_schedule()
         return True
 
