@@ -153,6 +153,12 @@ class ActorClass:
                 self._class_id = core.register_function(self._pickled)
         return self._class_id
 
+    def bind(self, *args, **kwargs):
+        """Author an actor-instantiation DAG node (reference
+        ``dag/class_node.py``); methods of the node are bindable."""
+        from ray_tpu.dag.dag_node import ClassNode
+        return ClassNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> ActorHandle:
         core = worker_mod.global_worker()
         class_id = self._export(core)

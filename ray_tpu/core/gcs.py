@@ -383,6 +383,9 @@ class GcsServer:
                         self._publish_actor(info)
                         return
                     if pg.state != "CREATED":
+                        # group placement has its own retry loop; don't
+                        # burn the lease deadline while waiting for it
+                        deadline = time.monotonic() + 120.0
                         await asyncio.sleep(0.1)
                         continue
                     if info.bundle_index >= 0:
@@ -557,17 +560,22 @@ class GcsServer:
         pg = self.placement_groups.get(PlacementGroupID(data["pg_id"]))
         if pg is None:
             return False
-        await self._release_pg_bundles(pg, set(pg.bundle_nodes))
+        # terminal state BEFORE any await so concurrent _schedule_actor /
+        # _schedule_pg loops observe REMOVED and cannot re-lease against
+        # the group while bundles are being returned
         pg.state = "REMOVED"
+        targets = [(i, self.nodes.get(n)) for i, n in pg.bundle_nodes.items()]
         pg.bundle_nodes.clear()
-        self.publish(f"pg:{pg.pg_id.hex()}", {"state": "REMOVED"})
-        # actors gang-bound to the group die with it (their worker
-        # processes are killed by the raylets' return_bundle path)
-        for info in self.actors.values():
+        # actors gang-bound to the group die with it, through the common
+        # death path (clears named_actors; never restarts); their worker
+        # processes are killed by the raylets' return_bundle path
+        for info in list(self.actors.values()):
             if info.pg_id == pg.pg_id and info.state != ACTOR_DEAD:
-                info.state = ACTOR_DEAD
-                info.death_cause = "placement group removed"
-                self._publish_actor(info)
+                self._on_actor_worker_lost(info.actor_id,
+                                           "placement group removed",
+                                           allow_restart=False)
+        await self._return_bundles(pg, targets)
+        self.publish(f"pg:{pg.pg_id.hex()}", {"state": "REMOVED"})
         return True
 
     async def _pg_retry_loop(self) -> None:

@@ -524,6 +524,11 @@ class Raylet:
         if pg_bin is not None:
             bundle = self._resolve_bundle((pg_bin, data.get("bundle_index", -1)),
                                           resources)
+            if bundle is None:
+                # never fall back to the node pool: an unbound lease could
+                # not be revoked with the bundle (GCS will retry/replan)
+                return {"granted": False,
+                        "reason": "placement group bundle not on this node"}
         reply = await self.handle_request_worker_lease(conn, {
             "resources": resources,
             "job_id": data.get("job_id"),
@@ -591,6 +596,12 @@ class Raylet:
                 if worker.proc is not None:
                     worker.proc.terminate()
                 self._on_worker_dead(worker, "placement group bundle returned")
+        # queued leases against the bundle can never be granted now — fail
+        # them instead of leaving their futures pending forever
+        for lease in self._pending_leases:
+            if lease.bundle == key and not lease.future.done():
+                lease.future.set_result(
+                    {"error": "placement group bundle removed"})
         self._maybe_schedule()
         return True
 

@@ -1,0 +1,10 @@
+"""Lazy task/actor graphs (reference ``python/ray/dag/``)."""
+
+from ray_tpu.dag.dag_node import (  # noqa: F401
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+)

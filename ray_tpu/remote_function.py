@@ -85,6 +85,12 @@ class RemoteFunction:
                 self._function_id = core.register_function(self._pickled)
         return self._function_id
 
+    def bind(self, *args, **kwargs):
+        """Author a DAG node instead of submitting (reference
+        ``dag/function_node.py``); launch later with ``.execute()``."""
+        from ray_tpu.dag.dag_node import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         core = worker_mod.global_worker()
         function_id = self._export(core)
