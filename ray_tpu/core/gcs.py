@@ -383,10 +383,14 @@ class GcsServer:
                         self._publish_actor(info)
                         return
                     if pg.state != "CREATED":
-                        # group placement has its own retry loop; don't
-                        # burn the lease deadline while waiting for it
-                        deadline = time.monotonic() + 120.0
-                        await asyncio.sleep(0.1)
+                        # placement in progress has its own retry loop —
+                        # don't burn the lease deadline on it; but an
+                        # INFEASIBLE group keeps the fixed deadline so the
+                        # actor eventually dies with a diagnostic instead
+                        # of pending forever
+                        if pg.state != "INFEASIBLE":
+                            deadline = time.monotonic() + 120.0
+                        await asyncio.sleep(0.25)
                         continue
                     if info.bundle_index >= 0:
                         node_id = pg.bundle_nodes.get(info.bundle_index)
@@ -421,6 +425,11 @@ class GcsServer:
                 if not reply.get("granted"):
                     await asyncio.sleep(0.1)
                     continue
+                if info.state == ACTOR_DEAD:
+                    # killed while the lease was in flight (e.g. its
+                    # placement group was removed) — don't resurrect; the
+                    # raylet's bundle revocation reaps the leased worker
+                    return
                 info.node_id = node.node_id
                 info.address = tuple(reply["worker_task_address"])
                 info.state = ACTOR_ALIVE
@@ -453,6 +462,8 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None:
             return False
+        if info.state == ACTOR_DEAD:
+            return False  # killed while starting (e.g. pg removed)
         info.address = tuple(data["task_address"])
         info.state = ACTOR_ALIVE
         self._publish_actor(info)

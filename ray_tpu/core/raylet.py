@@ -519,21 +519,13 @@ class Raylet:
         """GCS asks this node to host an actor: lease a worker, push the
         creation task to it, reply with its task-server address."""
         resources = dict(data.get("resources", {}))
-        bundle = None
-        pg_bin = data.get("placement_group_id")
-        if pg_bin is not None:
-            bundle = self._resolve_bundle((pg_bin, data.get("bundle_index", -1)),
-                                          resources)
-            if bundle is None:
-                # never fall back to the node pool: an unbound lease could
-                # not be revoked with the bundle (GCS will retry/replan)
-                return {"granted": False,
-                        "reason": "placement group bundle not on this node"}
+        # the lease path resolves (and refuses missing) bundles itself, so
+        # an unbound fallback to the node pool is impossible by design
         reply = await self.handle_request_worker_lease(conn, {
             "resources": resources,
             "job_id": data.get("job_id"),
-            "placement_group_id": pg_bin if bundle else None,
-            "bundle_index": bundle[1] if bundle else -1,
+            "placement_group_id": data.get("placement_group_id"),
+            "bundle_index": data.get("bundle_index", -1),
             "strategy": "DEFAULT",
         })
         if not reply.get("granted"):

@@ -139,28 +139,47 @@ class FunctionNode(DAGNode):
 
 class ClassNode(DAGNode):
     """A bound actor instantiation.  Method access returns bindable
-    stubs: ``node.method.bind(...)`` (reference ``class_node.py``)."""
+    stubs: ``node.method.bind(...)`` (reference ``class_node.py``).
+
+    With constant constructor args the actor is created once and reused
+    across ``execute`` calls (stateful service pattern, as Serve uses);
+    if any constructor arg derives from another DAG node (e.g. the
+    InputNode), a fresh actor is created per execution — caching would
+    silently pin the first input's value.
+    """
 
     def __init__(self, actor_cls, args: tuple, kwargs: dict):
         super().__init__(args, kwargs)
         self._actor_cls = actor_cls
         self._lock = threading.Lock()
-        self._handle = None  # one actor per ClassNode across executes
+        self._handle = None
+
+        def has_node(v) -> bool:
+            if isinstance(v, DAGNode):
+                return True
+            if isinstance(v, (list, tuple)):
+                return any(has_node(x) for x in v)
+            if isinstance(v, dict):
+                return any(has_node(x) for x in v.values())
+            return False
+
+        self._input_dependent = any(has_node(a) for a in args) or \
+            any(has_node(a) for a in kwargs.values())
 
     def __getattr__(self, name: str) -> "_ClassMethodStub":
         if name.startswith("_"):
             raise AttributeError(name)
         return _ClassMethodStub(self, name)
 
-    def _get_or_create(self, ctx: _ExecContext):
+    def _execute_impl(self, ctx: _ExecContext):
+        if self._input_dependent:
+            args, kwargs = self._resolve_args(ctx)
+            return self._actor_cls.remote(*args, **kwargs)
         with self._lock:
             if self._handle is None:
                 args, kwargs = self._resolve_args(ctx)
                 self._handle = self._actor_cls.remote(*args, **kwargs)
         return self._handle
-
-    def _execute_impl(self, ctx: _ExecContext):
-        return self._get_or_create(ctx)
 
 
 class _ClassMethodStub:

@@ -1,0 +1,29 @@
+"""RLlib-equivalent: scalable reinforcement learning on the actor
+substrate with jax/TPU learners.
+
+Parity: reference ``rllib/`` — Algorithm + AlgorithmConfig driver,
+RolloutWorker actor fleets, SampleBatch, GAE postprocessing, jax
+policies with jitted updates.  Distributed pattern (SURVEY.md §3.6):
+driver Algorithm + rollout actor fleet sampling on host CPUs, learner
+stepping one compiled XLA program on TPU.
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm  # noqa: F401
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.env import (  # noqa: F401
+    Box,
+    CartPole,
+    Discrete,
+    RandomEnv,
+    make_env,
+    register_env,
+)
+from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
+from ray_tpu.rllib.postprocessing import compute_gae  # noqa: F401
+from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
+from ray_tpu.rllib.sample_batch import (  # noqa: F401
+    MultiAgentBatch,
+    SampleBatch,
+    concat_samples,
+)
+from ray_tpu.rllib.worker_set import WorkerSet  # noqa: F401

@@ -1,0 +1,124 @@
+"""Typed, chainable algorithm configuration.
+
+Parity: reference ``rllib/algorithms/algorithm_config.py`` — the builder
+pattern (``.environment().rollouts().training().build()``) with the same
+method/field names the reference uses, narrowed to the jax stack.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Type
+
+
+class AlgorithmConfig:
+    #: set by each algorithm's subclass
+    algo_class: Optional[type] = None
+
+    def __init__(self):
+        # environment
+        self.env: Any = None
+        self.env_config: Dict[str, Any] = {}
+        # rollouts
+        self.num_rollout_workers = 0
+        self.num_envs_per_worker = 1
+        self.rollout_fragment_length = 200
+        # training
+        self.lr = 5e-4
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.use_gae = True
+        self.train_batch_size = 4000
+        self.grad_clip = 0.0
+        self.model: Dict[str, Any] = {"fcnet_hiddens": (64, 64),
+                                      "fcnet_activation": "tanh",
+                                      "vf_share_layers": False}
+        # resources
+        self.num_cpus_per_worker = 1
+        self.num_tpus_per_learner = 0
+        # evaluation
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_duration = 10
+        # debugging
+        self.seed: Optional[int] = None
+        # fault tolerance
+        self.recreate_failed_workers = False
+
+    # -- chainable setters (reference naming) ---------------------------
+    def environment(self, env: Any = None, *,
+                    env_config: Optional[Dict[str, Any]] = None
+                    ) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None
+                 ) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = int(num_rollout_workers)
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = int(num_envs_per_worker)
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = int(rollout_fragment_length)
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def resources(self, *, num_cpus_per_worker: Optional[float] = None,
+                  num_tpus_per_learner: Optional[int] = None
+                  ) -> "AlgorithmConfig":
+        if num_cpus_per_worker is not None:
+            self.num_cpus_per_worker = num_cpus_per_worker
+        if num_tpus_per_learner is not None:
+            self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def framework(self, framework: str = "jax") -> "AlgorithmConfig":
+        if framework not in ("jax",):
+            raise ValueError("this stack is jax-native; framework='jax'")
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None
+                   ) -> "AlgorithmConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def fault_tolerance(self, *, recreate_failed_workers: Optional[bool]
+                        = None) -> "AlgorithmConfig":
+        if recreate_failed_workers is not None:
+            self.recreate_failed_workers = recreate_failed_workers
+        return self
+
+    # -- materialization ------------------------------------------------
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in vars(self).items()
+                if not k.startswith("_")}
+
+    def build(self, env: Any = None):
+        if env is not None:
+            self.env = env
+        if self.algo_class is None:
+            raise ValueError("use an algorithm-specific config "
+                             "(e.g. PPOConfig) to build()")
+        return self.algo_class(self)

@@ -1,0 +1,3 @@
+"""Algorithm zoo (reference ``rllib/algorithms/``)."""
+
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, PPOPolicy  # noqa: F401

@@ -1,0 +1,123 @@
+"""Proximal Policy Optimization.
+
+Parity: reference ``rllib/algorithms/ppo/ppo.py`` (``PPO.training_step``
+:319) and ``ppo_torch_policy.py`` loss — clipped surrogate objective,
+value-function clipping, entropy bonus, adaptive KL penalty, multi-epoch
+minibatch SGD.  jax-native: the whole minibatch update (loss + grads +
+Adam) is one jitted program with static minibatch shape; epochs replay
+that program, so the TPU sees a stream of identical compiled steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.execution import (standardize_advantages,
+                                     synchronous_parallel_sample)
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-5
+        self.clip_param = 0.3
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 1.0
+        self.entropy_coeff = 0.0
+        self.kl_coeff = 0.2
+        self.kl_target = 0.01
+        self.num_sgd_iter = 30
+        self.sgd_minibatch_size = 128
+        self.shuffle_sequences = True
+
+    @property
+    def algo_class(self):
+        return PPO
+
+
+class PPOPolicy(JaxPolicy):
+    def __init__(self, observation_space, action_space, config):
+        super().__init__(observation_space, action_space, config)
+        self.kl_coeff = float(config.get("kl_coeff", 0.2))
+
+    def loss(self, params, batch):
+        cfg = self.config
+        dist_inputs, vf = self.model.apply(params, batch[SampleBatch.OBS])
+        logp = self.dist.logp(dist_inputs, batch[SampleBatch.ACTIONS])
+        old_logp = batch[SampleBatch.ACTION_LOGP]
+        adv = batch[SampleBatch.ADVANTAGES]
+        ratio = jnp.exp(logp - old_logp)
+        clip = float(cfg.get("clip_param", 0.3))
+        surrogate = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+
+        targets = batch[SampleBatch.VALUE_TARGETS]
+        vf_err = jnp.square(vf - targets)
+        vf_clip = float(cfg.get("vf_clip_param", 10.0))
+        vf_loss = jnp.clip(vf_err, 0.0, vf_clip ** 2)
+
+        entropy = self.dist.entropy(dist_inputs)
+        # approximate KL(old || new) from logp ratios (Schulman estimator;
+        # exact per-distribution KL needs old dist inputs in the batch)
+        kl = jnp.mean((ratio - 1.0) - jnp.log(ratio))
+
+        total = jnp.mean(
+            -surrogate
+            + float(cfg.get("vf_loss_coeff", 1.0)) * vf_loss
+            - float(cfg.get("entropy_coeff", 0.0)) * entropy
+        ) + batch["kl_coeff"] * kl
+        stats = {
+            "policy_loss": -jnp.mean(surrogate),
+            "vf_loss": jnp.mean(vf_loss),
+            "entropy": jnp.mean(entropy),
+            "kl": kl,
+        }
+        return total, stats
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        cfg = self.config
+        mb_size = int(cfg.get("sgd_minibatch_size", 128))
+        epochs = int(cfg.get("num_sgd_iter", 30))
+        last_stats: Dict[str, float] = {}
+        kls = []
+        with self._on_device():
+            for _ in range(epochs):
+                for mb in batch.minibatches(mb_size, self._np_rng):
+                    dev = self._device_batch(mb)
+                    dev["kl_coeff"] = jnp.float32(self.kl_coeff)
+                    self.params, self.opt_state, stats = self._update(
+                        self.params, self.opt_state, dev)
+                    last_stats = {k: float(v) for k, v in stats.items()}
+                    kls.append(last_stats.get("kl", 0.0))
+        # adaptive KL penalty (reference ``PPO.update_kl``)
+        mean_kl = float(np.mean(kls)) if kls else 0.0
+        target = float(cfg.get("kl_target", 0.01))
+        if mean_kl > 2.0 * target:
+            self.kl_coeff *= 1.5
+        elif mean_kl < 0.5 * target:
+            self.kl_coeff *= 0.5
+        last_stats["kl_coeff"] = self.kl_coeff
+        last_stats["mean_kl"] = mean_kl
+        return last_stats
+
+
+class PPO(Algorithm):
+    policy_class = PPOPolicy
+
+    def training_step(self) -> Dict[str, Any]:
+        batch = synchronous_parallel_sample(
+            self.workers,
+            max_env_steps=int(self.config.get("train_batch_size", 4000)))
+        batch = standardize_advantages(batch)
+        self._timesteps_total += len(batch)
+        stats = self.workers.local_worker.policy.learn_on_batch(batch)
+        self.workers.sync_weights()
+        stats["num_env_steps_sampled_this_iter"] = len(batch)
+        return stats

@@ -1,0 +1,142 @@
+"""Environment API and built-in envs.
+
+Parity: reference ``rllib/env/`` — RLlib consumes gym-style envs
+(``reset() -> (obs, info)``, ``step(a) -> (obs, reward, terminated,
+truncated, info)``).  gym/gymnasium is not a dependency here: any object
+with that interface works, and we ship pure-python reference envs
+(CartPole — the classic control benchmark used by the reference's tuned
+examples — and a RandomEnv for plumbing tests).
+
+Spaces are the minimal ``Discrete``/``Box`` pair the policies need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Discrete:
+    n: int
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return ()
+
+    def sample(self, rng: np.random.Generator):
+        return int(rng.integers(self.n))
+
+
+@dataclasses.dataclass(frozen=True)
+class Box:
+    low: Any
+    high: Any
+    shape: Tuple[int, ...]
+    dtype: Any = np.float32
+
+    def sample(self, rng: np.random.Generator):
+        return rng.uniform(self.low, self.high, size=self.shape) \
+            .astype(self.dtype)
+
+
+class CartPole:
+    """Classic cart-pole balancing (standard Barto-Sutton-Anderson
+    dynamics, Euler integration, same constants as the gym version so
+    learning curves are comparable)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.length = 0.5  # half pole length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.x_threshold = 2.4
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.max_episode_steps = int(config.get("max_episode_steps", 500))
+        self.observation_space = Box(-np.inf, np.inf, (4,), np.float32)
+        self.action_space = Discrete(2)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._state = None
+        self._steps = 0
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=(4,))
+        self._steps = 0
+        return self._state.astype(np.float32).copy(), {}
+
+    def step(self, action):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot ** 2 * sintheta) \
+            / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costheta ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._steps += 1
+        terminated = bool(abs(x) > self.x_threshold
+                          or abs(theta) > self.theta_threshold)
+        truncated = self._steps >= self.max_episode_steps
+        return (self._state.astype(np.float32).copy(), 1.0, terminated,
+                truncated, {})
+
+
+class RandomEnv:
+    """Uniform-random observations/rewards; for plumbing tests."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.observation_space = Box(-1.0, 1.0,
+                                     tuple(config.get("obs_shape", (4,))),
+                                     np.float32)
+        self.action_space = Discrete(int(config.get("num_actions", 2)))
+        self.episode_len = int(config.get("episode_len", 10))
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._steps = 0
+
+    def reset(self, *, seed: Optional[int] = None):
+        self._steps = 0
+        return self.observation_space.sample(self._rng), {}
+
+    def step(self, action):
+        self._steps += 1
+        return (self.observation_space.sample(self._rng),
+                float(self._rng.random()),
+                False, self._steps >= self.episode_len, {})
+
+
+_ENV_REGISTRY: Dict[str, Any] = {
+    "CartPole-v1": CartPole,
+    "RandomEnv": RandomEnv,
+}
+
+
+def register_env(name: str, creator) -> None:
+    """Register an env creator callable(config) -> env (parity:
+    ``ray.tune.registry.register_env``)."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(env: Any, config: Optional[Dict[str, Any]] = None):
+    """Instantiate from a registered name, a class, or a callable."""
+    if isinstance(env, str):
+        if env not in _ENV_REGISTRY:
+            raise ValueError(f"unknown env {env!r}; register_env() it "
+                             f"(known: {sorted(_ENV_REGISTRY)})")
+        env = _ENV_REGISTRY[env]
+    return env(config or {})

@@ -1,0 +1,45 @@
+"""Execution utilities shared by algorithms.
+
+Parity: reference ``rllib/execution/rollout_ops.py``
+(``synchronous_parallel_sample``) and ``train_ops.py``
+(``train_one_step``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+
+
+def synchronous_parallel_sample(worker_set, *,
+                                max_env_steps: int) -> SampleBatch:
+    """Fan out ``sample()`` across the fleet until at least
+    ``max_env_steps`` env steps are gathered."""
+    batches: List[SampleBatch] = []
+    steps = 0
+    while steps < max_env_steps:
+        if worker_set.remote_workers:
+            round_batches = ray_tpu.get(
+                [w.sample.remote() for w in worker_set.remote_workers])
+        else:
+            round_batches = [worker_set.local_worker.sample()]
+        for b in round_batches:
+            batches.append(b)
+            steps += len(b)
+    return concat_samples(batches)
+
+
+def train_one_step(algorithm, batch: SampleBatch) -> Dict[str, float]:
+    """Learn on the local worker's policy (reference ``train_one_step``)."""
+    return algorithm.workers.local_worker.policy.learn_on_batch(batch)
+
+
+def standardize_advantages(batch: SampleBatch) -> SampleBatch:
+    adv = batch[SampleBatch.ADVANTAGES]
+    batch[SampleBatch.ADVANTAGES] = \
+        (adv - adv.mean()) / max(1e-4, adv.std())
+    return batch

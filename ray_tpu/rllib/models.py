@@ -1,0 +1,105 @@
+"""Policy/value networks.
+
+Parity: reference ``rllib/models/catalog.py`` + ``models/torch/fcnet.py``
+— a fully-connected torso producing action-distribution inputs and a
+value head.  jax/flax-native: one apply gives (dist_inputs, value) so the
+whole forward fits in a single XLA program; distributions are pure
+jnp functions usable inside jitted samplers and losses.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FCNet(nn.Module):
+    """Shared-torso MLP: obs -> (dist_inputs, value)."""
+
+    num_outputs: int
+    hiddens: Sequence[int] = (64, 64)
+    activation: str = "tanh"
+    #: separate value branch (reference vf_share_layers=False default)
+    vf_share_layers: bool = False
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        act = dict(tanh=nn.tanh, relu=nn.relu, swish=nn.swish)[self.activation]
+        x = obs
+        for i, h in enumerate(self.hiddens):
+            x = act(nn.Dense(h, name=f"fc_{i}")(x))
+        logits = nn.Dense(self.num_outputs, name="out",
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        if self.vf_share_layers:
+            v = nn.Dense(1, name="vf_out")(x)
+        else:
+            y = obs
+            for i, h in enumerate(self.hiddens):
+                y = act(nn.Dense(h, name=f"vf_{i}")(y))
+            v = nn.Dense(1, name="vf_out",
+                         kernel_init=nn.initializers.orthogonal(1.0))(y)
+        return logits, jnp.squeeze(v, axis=-1)
+
+
+class Categorical:
+    """Discrete action distribution over logits (pure-jnp, jit-safe)."""
+
+    @staticmethod
+    def sample(logits: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    @staticmethod
+    def logp(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(
+            logp_all, actions[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+
+    @staticmethod
+    def entropy(logits: jnp.ndarray) -> jnp.ndarray:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    @staticmethod
+    def kl(logits_p: jnp.ndarray, logits_q: jnp.ndarray) -> jnp.ndarray:
+        logp = jax.nn.log_softmax(logits_p, axis=-1)
+        logq = jax.nn.log_softmax(logits_q, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+
+class DiagGaussian:
+    """Continuous actions: dist_inputs = [mean, log_std] concatenated."""
+
+    @staticmethod
+    def _split(inputs: jnp.ndarray):
+        mean, log_std = jnp.split(inputs, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    @staticmethod
+    def sample(inputs: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+        mean, log_std = DiagGaussian._split(inputs)
+        return mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+
+    @staticmethod
+    def logp(inputs: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+        mean, log_std = DiagGaussian._split(inputs)
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * ((actions - mean) ** 2 / var)
+            - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
+    @staticmethod
+    def entropy(inputs: jnp.ndarray) -> jnp.ndarray:
+        _, log_std = DiagGaussian._split(inputs)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    @staticmethod
+    def kl(inputs_p: jnp.ndarray, inputs_q: jnp.ndarray) -> jnp.ndarray:
+        mp, lsp = DiagGaussian._split(inputs_p)
+        mq, lsq = DiagGaussian._split(inputs_q)
+        return jnp.sum(
+            lsq - lsp + (jnp.exp(2 * lsp) + (mp - mq) ** 2)
+            / (2 * jnp.exp(2 * lsq)) - 0.5, axis=-1)

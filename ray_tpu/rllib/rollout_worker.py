@@ -1,0 +1,138 @@
+"""Rollout workers: env stepping + trajectory collection.
+
+Parity: reference ``rllib/evaluation/rollout_worker.py`` (``RolloutWorker``
+:157, ``sample``:871) with the ``SyncSampler`` loop (``sampler.py``:145)
+inlined.  One worker steps ``num_envs_per_worker`` environments in
+lockstep so the policy forward is one batched (jitted) call per tick —
+the env loop stays python/numpy on host CPUs while the learner owns the
+TPU.  Workers run as actors (created by WorkerSet); weight sync is a
+plain ``set_weights`` actor call carrying numpy arrays over the object
+plane.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+
+
+class RolloutWorker:
+    def __init__(self, env_spec: Any, policy_cls: type,
+                 config: Dict[str, Any], worker_index: int = 0):
+        self.config = dict(config)
+        self.worker_index = worker_index
+        seed = config.get("seed")
+        if seed is not None:
+            seed = int(seed) + worker_index
+            self.config["seed"] = seed
+        if worker_index > 0:
+            # remote samplers run on host CPUs; the TPU belongs to the
+            # learner (reference: rollout workers get num_gpus=0)
+            self.config.setdefault("_device", "cpu")
+        n = int(config.get("num_envs_per_worker", 1))
+        env_config = dict(config.get("env_config", {}))
+        self.envs = []
+        for i in range(n):
+            cfg = dict(env_config)
+            if seed is not None:
+                cfg["seed"] = seed * 1000 + i
+            self.envs.append(make_env(env_spec, cfg))
+        env = self.envs[0]
+        self.policy = policy_cls(env.observation_space, env.action_space,
+                                 self.config)
+        self._obs = np.stack([e.reset()[0] for e in self.envs])
+        self._episode_buffers: List[List[Dict[str, Any]]] = \
+            [[] for _ in range(n)]
+        self._episode_rewards = np.zeros(n)
+        self._episode_lens = np.zeros(n, dtype=np.int64)
+        self._eps_ids = np.arange(n, dtype=np.int64)
+        self._next_eps_id = n
+        self._completed_returns: List[float] = []
+        self._completed_lens: List[int] = []
+
+    # ------------------------------------------------------------------
+    def sample(self) -> SampleBatch:
+        """Collect one fragment: rollout_fragment_length steps from each
+        env, GAE-postprocessed per episode chunk."""
+        fragment = int(self.config.get("rollout_fragment_length", 200))
+        n = len(self.envs)
+        chunks: List[SampleBatch] = []
+        rows: List[List[Dict[str, Any]]] = self._episode_buffers
+
+        for _ in range(fragment):
+            actions, extras = self.policy.compute_actions(self._obs)
+            next_obs = np.empty_like(self._obs)
+            for i, env in enumerate(self.envs):
+                obs2, rew, term, trunc, _ = env.step(
+                    actions[i] if actions.ndim else actions)
+                rows[i].append({
+                    SampleBatch.OBS: self._obs[i],
+                    SampleBatch.ACTIONS: actions[i],
+                    SampleBatch.REWARDS: rew,
+                    SampleBatch.TERMINATEDS: term,
+                    SampleBatch.TRUNCATEDS: trunc,
+                    SampleBatch.ACTION_LOGP:
+                        extras[SampleBatch.ACTION_LOGP][i],
+                    SampleBatch.VF_PREDS: extras[SampleBatch.VF_PREDS][i],
+                    SampleBatch.EPS_ID: self._eps_ids[i],
+                })
+                self._episode_rewards[i] += rew
+                self._episode_lens[i] += 1
+                if term or trunc:
+                    chunks.append(self._flush_episode(i, obs2, term))
+                    obs2, _ = env.reset()
+                next_obs[i] = obs2
+            self._obs = next_obs
+
+        # fragment boundary: flush in-progress episodes as truncated chunks
+        # (bootstrapped with V(s_last)) but keep episode stats running
+        for i in range(n):
+            if rows[i]:
+                chunks.append(self._postprocess(rows[i], self._obs[i],
+                                                truncated=True))
+                rows[i] = []
+        return concat_samples(chunks)
+
+    def _flush_episode(self, i: int, final_obs: np.ndarray,
+                       terminated: bool) -> SampleBatch:
+        batch = self._postprocess(self._episode_buffers[i], final_obs,
+                                  truncated=not terminated)
+        self._episode_buffers[i] = []
+        self._completed_returns.append(float(self._episode_rewards[i]))
+        self._completed_lens.append(int(self._episode_lens[i]))
+        self._episode_rewards[i] = 0.0
+        self._episode_lens[i] = 0
+        self._eps_ids[i] = self._next_eps_id
+        self._next_eps_id += 1
+        return batch
+
+    def _postprocess(self, rows: List[Dict[str, Any]],
+                     last_obs: np.ndarray, truncated: bool) -> SampleBatch:
+        batch = SampleBatch(
+            {k: np.stack([r[k] for r in rows]) for k in rows[0]})
+        return self.policy.postprocess_trajectory(batch, last_obs,
+                                                  truncated=truncated)
+
+    # ------------------------------------------------------------------
+    def metrics(self) -> Dict[str, Any]:
+        """Drain episode stats (reference ``collect_metrics``)."""
+        out = {"episode_returns": list(self._completed_returns),
+               "episode_lens": list(self._completed_lens)}
+        self._completed_returns = []
+        self._completed_lens = []
+        return out
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def apply(self, fn: Callable, *args):
+        """Run an arbitrary function on this worker (reference
+        ``RolloutWorker.apply``)."""
+        return fn(self, *args)

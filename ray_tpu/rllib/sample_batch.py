@@ -1,0 +1,92 @@
+"""Columnar experience batches.
+
+Parity: reference ``rllib/policy/sample_batch.py`` — ``SampleBatch``
+(:125) is a dict of parallel numpy columns with standard keys, plus
+``concat_samples``, slicing, shuffling, and minibatch iteration.
+Columns stay numpy on the host; policies move them to device in one
+transfer per learn call (TPU-friendly: one big H2D instead of per-step).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Sequence
+
+import numpy as np
+
+
+class SampleBatch(dict):
+    OBS = "obs"
+    NEXT_OBS = "new_obs"
+    ACTIONS = "actions"
+    REWARDS = "rewards"
+    TERMINATEDS = "terminateds"
+    TRUNCATEDS = "truncateds"
+    ACTION_LOGP = "action_logp"
+    VF_PREDS = "vf_preds"
+    ADVANTAGES = "advantages"
+    VALUE_TARGETS = "value_targets"
+    EPS_ID = "eps_id"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for k, v in list(self.items()):
+            if not isinstance(v, np.ndarray):
+                self[k] = np.asarray(v)
+
+    def __len__(self) -> int:
+        for v in self.values():
+            return int(v.shape[0])
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        perm = rng.permutation(len(self))
+        return SampleBatch({k: v[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int, rng: np.random.Generator
+                    ) -> Iterator["SampleBatch"]:
+        shuffled = self.shuffle(rng)
+        n = len(self)
+        for start in range(0, n - n % size or n, size):
+            mb = shuffled.slice(start, min(start + size, n))
+            if len(mb):
+                yield mb
+
+    def split_by_episode(self) -> List["SampleBatch"]:
+        if self.EPS_ID not in self:
+            return [self]
+        ids = self[self.EPS_ID]
+        out, start = [], 0
+        for i in range(1, len(self)):
+            if ids[i] != ids[start]:
+                out.append(self.slice(start, i))
+                start = i
+        out.append(self.slice(start, len(self)))
+        return out
+
+    def copy(self) -> "SampleBatch":
+        return SampleBatch({k: v.copy() for k, v in self.items()})
+
+
+def concat_samples(batches: Sequence[SampleBatch]) -> SampleBatch:
+    """Concatenate along time (reference ``SampleBatch.concat_samples``)."""
+    batches = [b for b in batches if b is not None and len(b)]
+    if not batches:
+        return SampleBatch()
+    keys = batches[0].keys()
+    return SampleBatch(
+        {k: np.concatenate([b[k] for b in batches], axis=0) for k in keys})
+
+
+class MultiAgentBatch(dict):
+    """policy_id -> SampleBatch (reference ``MultiAgentBatch``:1165)."""
+
+    @property
+    def count(self) -> int:
+        return sum(len(b) for b in self.values())
