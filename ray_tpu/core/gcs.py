@@ -426,9 +426,18 @@ class GcsServer:
                     await asyncio.sleep(0.1)
                     continue
                 if info.state == ACTOR_DEAD:
-                    # killed while the lease was in flight (e.g. its
-                    # placement group was removed) — don't resurrect; the
-                    # raylet's bundle revocation reaps the leased worker
+                    # killed while the lease was in flight — don't
+                    # resurrect.  pg-bound workers are reaped by bundle
+                    # revocation; plain actors need an explicit kill or
+                    # the leased worker (and its resources) leak
+                    try:
+                        worker_conn = await self.pool.get(
+                            tuple(reply["worker_task_address"]))
+                        worker_conn.push(
+                            "kill_actor",
+                            {"actor_id": info.actor_id.binary()})
+                    except Exception:
+                        pass
                     return
                 info.node_id = node.node_id
                 info.address = tuple(reply["worker_task_address"])

@@ -62,9 +62,11 @@ class Algorithm:
             self.workers.probe_and_recreate()
         t0 = time.time()
         result = self.training_step()
+        episodes_this_iter = 0
         for m in self.workers.foreach_worker(lambda w: w.metrics()):
             self._episode_returns.extend(m["episode_returns"])
             self._episode_lens.extend(m["episode_lens"])
+            episodes_this_iter += len(m["episode_returns"])
         self.iteration += 1
         result.update({
             "training_iteration": self.iteration,
@@ -75,7 +77,7 @@ class Algorithm:
             "episode_len_mean":
                 float(np.mean(self._episode_lens))
                 if self._episode_lens else np.nan,
-            "episodes_this_iter": len(self._episode_returns),
+            "episodes_this_iter": episodes_this_iter,
             "time_this_iter_s": time.time() - t0,
             "time_total_s": time.time() - self._start,
         })

@@ -1,3 +1,12 @@
 """Algorithm zoo (reference ``rllib/algorithms/``)."""
 
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, DQNPolicy  # noqa: F401
+from ray_tpu.rllib.algorithms.impala import (  # noqa: F401
+    APPO,
+    APPOConfig,
+    APPOPolicy,
+    IMPALA,
+    ImpalaConfig,
+    ImpalaPolicy,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, PPOPolicy  # noqa: F401

@@ -1,0 +1,204 @@
+"""Deep Q-Networks with replay and target network.
+
+Parity: reference ``rllib/algorithms/dqn/`` — epsilon-greedy
+exploration with linear decay, (prioritized) replay, double-DQN target,
+periodic target-network sync, n-step=1.  jax-native: the TD update is
+one jitted program; the target params are a second param tree passed
+into the same program (no module copies).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.execution import synchronous_parallel_sample
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.rollout_fragment_length = 4
+        self.replay_buffer_capacity = 50_000
+        self.prioritized_replay = False
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500  # env steps
+        self.double_q = True
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.02
+        self.epsilon_timesteps = 10_000
+        self.training_intensity = 1.0  # learn updates per sampled step
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+class DQNPolicy(JaxPolicy):
+    """Q-network policy: FCNet logits are Q-values; vf head unused."""
+
+    def __init__(self, observation_space, action_space, config):
+        super().__init__(observation_space, action_space, config)
+        self.target_params = self.params
+        self._steps = 0
+
+        model = self.model
+
+        @jax.jit
+        def _q(params, obs):
+            q, _ = model.apply(params, obs)
+            return q
+
+        self._q = _q
+
+    # -- exploration ----------------------------------------------------
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._steps
+                   / float(cfg.get("epsilon_timesteps", 10_000)))
+        e0 = float(cfg.get("epsilon_initial", 1.0))
+        e1 = float(cfg.get("epsilon_final", 0.02))
+        return e0 + frac * (e1 - e0)
+
+    def compute_actions(self, obs, explore: bool = True):
+        with self._on_device():
+            q = np.asarray(self._q(self.params,
+                                   jnp.asarray(obs, jnp.float32)))
+        actions = q.argmax(axis=-1)
+        if explore:
+            eps = self._epsilon()
+            self._steps += len(actions)
+            mask = self._np_rng.random(len(actions)) < eps
+            random_actions = self._np_rng.integers(
+                0, self.action_space.n, size=len(actions))
+            actions = np.where(mask, random_actions, actions)
+        return actions.astype(np.int64), {}
+
+    # -- no GAE: replay stores raw transitions -------------------------
+    def postprocess_trajectory(self, batch, last_obs=None, truncated=False):
+        return batch
+
+    # -- TD loss --------------------------------------------------------
+    def loss(self, params, batch):
+        cfg = self.config
+        gamma = float(cfg.get("gamma", 0.99))
+        q_all, _ = self.model.apply(params, batch[SampleBatch.OBS])
+        q_taken = jnp.take_along_axis(
+            q_all, batch[SampleBatch.ACTIONS][:, None].astype(jnp.int32),
+            axis=-1).squeeze(-1)
+        q_next_target, _ = self.model.apply(batch["target_params"],
+                                            batch[SampleBatch.NEXT_OBS])
+        if cfg.get("double_q", True):
+            q_next_online, _ = self.model.apply(
+                params, batch[SampleBatch.NEXT_OBS])
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next = jnp.take_along_axis(
+                q_next_target, best[:, None], axis=-1).squeeze(-1)
+        else:
+            q_next = jnp.max(q_next_target, axis=-1)
+        done = batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+        target = batch[SampleBatch.REWARDS] + gamma * (1.0 - done) * q_next
+        td_error = q_taken - jax.lax.stop_gradient(target)
+        weights = batch.get("weights")
+        huber = jnp.where(jnp.abs(td_error) < 1.0,
+                          0.5 * td_error ** 2,
+                          jnp.abs(td_error) - 0.5)
+        loss = jnp.mean(huber * weights) if weights is not None \
+            else jnp.mean(huber)
+        return loss, {"mean_q": jnp.mean(q_taken),
+                      "td_error_abs": jnp.mean(jnp.abs(td_error)),
+                      "_td_error": td_error}
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        with self._on_device():
+            dev = self._device_batch(batch)
+            dev["target_params"] = self.target_params
+            self.params, self.opt_state, stats = self._update(
+                self.params, self.opt_state, dev)
+        td = np.asarray(stats.pop("_td_error"))
+        out = {k: float(v) for k, v in stats.items()}
+        out["_td_error_np"] = td
+        return out
+
+    def update_target(self) -> None:
+        self.target_params = self.params
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = jax.tree_util.tree_map(
+            np.asarray, self.target_params)
+        state["steps"] = self._steps
+        return state
+
+    def set_state(self, state):
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.asarray, state["target_params"])
+        self._steps = int(state.get("steps", 0))
+
+
+class DQN(Algorithm):
+    policy_class = DQNPolicy
+
+    def setup(self) -> None:
+        super().setup()
+        cfg = self.config
+        if cfg.get("prioritized_replay"):
+            self.replay = PrioritizedReplayBuffer(
+                int(cfg.get("replay_buffer_capacity", 50_000)),
+                alpha=float(cfg.get("prioritized_replay_alpha", 0.6)),
+                beta=float(cfg.get("prioritized_replay_beta", 0.4)),
+                seed=cfg.get("seed"))
+        else:
+            self.replay = ReplayBuffer(
+                int(cfg.get("replay_buffer_capacity", 50_000)),
+                seed=cfg.get("seed"))
+        self._since_target_update = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy: DQNPolicy = self.workers.local_worker.policy
+        fragment = int(cfg.get("rollout_fragment_length", 4)) \
+            * max(1, int(cfg.get("num_envs_per_worker", 1)))
+        batch = synchronous_parallel_sample(self.workers,
+                                            max_env_steps=fragment)
+        self.replay.add(batch)
+        self._timesteps_total += len(batch)
+        self._since_target_update += len(batch)
+
+        stats: Dict[str, Any] = {"replay_size": len(self.replay)}
+        warmup = int(cfg.get("num_steps_sampled_before_learning_starts",
+                             1000))
+        if len(self.replay) >= max(warmup,
+                                   int(cfg.get("train_batch_size", 32))):
+            updates = max(1, round(float(cfg.get("training_intensity", 1.0))
+                                   * len(batch)
+                                   / int(cfg.get("train_batch_size", 32))))
+            for _ in range(updates):
+                mb = self.replay.sample(int(cfg.get("train_batch_size", 32)))
+                out = policy.learn_on_batch(mb)
+                td = out.pop("_td_error_np", None)
+                if td is not None and hasattr(self.replay,
+                                              "update_priorities"):
+                    self.replay.update_priorities(mb["batch_indexes"], td)
+                stats.update(out)
+            if self._since_target_update >= int(
+                    cfg.get("target_network_update_freq", 500)):
+                policy.update_target()
+                self._since_target_update = 0
+            self.workers.sync_weights()
+        return stats

@@ -1,0 +1,226 @@
+"""IMPALA and APPO: async off-policy actor-critic with V-trace.
+
+Parity: reference ``rllib/algorithms/impala/impala.py`` (:528 async
+sampling + learner) and ``rllib/algorithms/appo/`` — actors sample
+fixed-length unrolls continuously with (slightly) stale weights; the
+learner consumes whichever fragments are ready, corrects off-policyness
+with V-trace (Espeholt et al. 2018), and broadcasts fresh weights.
+
+jax-native: V-trace's reverse-time recursion is a ``lax.scan`` inside
+the jitted update — the whole correction + gradient step is one XLA
+program over a [B, T] unroll block (static shapes: B unrolls of
+``rollout_fragment_length``).  The reference's LearnerThread/minibatch
+buffer machinery collapses into async actor futures: overlap comes from
+re-dispatching ``sample`` before learning on the collected block.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.rollout_fragment_length = 50
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_c_threshold = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_aggregation_fragments = 1  # ready sample() results per step
+
+    @property
+    def algo_class(self):
+        return IMPALA
+
+
+class ImpalaPolicy(JaxPolicy):
+    """V-trace actor-critic over [B, T] unrolls."""
+
+    def _vtrace(self, vf, bootstrap_v, rewards, discounts, rhos):
+        """vs and pg advantages (Espeholt et al. eq. 1); all [B, T]."""
+        cfg = self.config
+        rho_bar = float(cfg.get("vtrace_clip_rho_threshold", 1.0))
+        c_bar = float(cfg.get("vtrace_clip_c_threshold", 1.0))
+        clipped_rho = jnp.minimum(rho_bar, rhos)
+        cs = jnp.minimum(c_bar, rhos)
+        v_next = jnp.concatenate([vf[:, 1:], bootstrap_v[:, None]], axis=1)
+        deltas = clipped_rho * (rewards + discounts * v_next - vf)
+
+        def step(acc, xs):
+            delta_t, disc_t, c_t = xs
+            acc = delta_t + disc_t * c_t * acc
+            return acc, acc
+
+        # reverse scan over time (transpose to [T, B])
+        _, vs_minus_v_rev = jax.lax.scan(
+            step, jnp.zeros_like(bootstrap_v),
+            (deltas.T[::-1], discounts.T[::-1], cs.T[::-1]))
+        vs_minus_v = vs_minus_v_rev[::-1].T
+        vs = vf + vs_minus_v
+        vs_next = jnp.concatenate([vs[:, 1:], bootstrap_v[:, None]], axis=1)
+        pg_adv = clipped_rho * (rewards + discounts * vs_next - vf)
+        return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+    def _forward_unrolls(self, params, batch):
+        obs = batch[SampleBatch.OBS]
+        B, T = obs.shape[0], obs.shape[1]
+        dist_inputs, vf = self.model.apply(
+            params, obs.reshape((B * T,) + obs.shape[2:]))
+        dist_inputs = dist_inputs.reshape((B, T) + dist_inputs.shape[1:])
+        vf = vf.reshape(B, T)
+        _, bootstrap_v = self.model.apply(params, batch["bootstrap_obs"])
+        target_logp = self.dist.logp(dist_inputs,
+                                     batch[SampleBatch.ACTIONS])
+        return dist_inputs, vf, bootstrap_v, target_logp
+
+    def loss(self, params, batch):
+        cfg = self.config
+        dist_inputs, vf, bootstrap_v, target_logp = \
+            self._forward_unrolls(params, batch)
+        rhos = jnp.exp(target_logp - batch[SampleBatch.ACTION_LOGP])
+        done = jnp.logical_or(
+            batch[SampleBatch.TERMINATEDS],
+            batch[SampleBatch.TRUNCATEDS]).astype(jnp.float32)
+        discounts = float(cfg.get("gamma", 0.99)) * (1.0 - done)
+        vs, pg_adv = self._vtrace(vf, bootstrap_v,
+                                  batch[SampleBatch.REWARDS],
+                                  discounts, jax.lax.stop_gradient(rhos))
+        policy_loss = -jnp.mean(target_logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean(jnp.square(vs - vf))
+        entropy = jnp.mean(self.dist.entropy(dist_inputs))
+        total = policy_loss \
+            + float(cfg.get("vf_loss_coeff", 0.5)) * vf_loss \
+            - float(cfg.get("entropy_coeff", 0.01)) * entropy
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_rho": jnp.mean(rhos)}
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        T = int(self.config.get("rollout_fragment_length", 50))
+        n = len(batch)
+        B = n // T
+        if B == 0:
+            return {}
+        with self._on_device():
+            dev = {}
+            for k, v in batch.items():
+                if v.dtype == object:
+                    continue
+                v = v[:B * T].reshape((B, T) + v.shape[1:])
+                dev[k] = jnp.asarray(v)
+            dev["bootstrap_obs"] = dev[SampleBatch.NEXT_OBS][:, -1]
+            self.params, self.opt_state, stats = self._update(
+                self.params, self.opt_state, dev)
+        return {k: float(v) for k, v in stats.items()}
+
+
+class APPOPolicy(ImpalaPolicy):
+    """PPO-clipped surrogate on V-trace advantages (reference
+    ``appo_torch_policy.py``)."""
+
+    def loss(self, params, batch):
+        cfg = self.config
+        dist_inputs, vf, bootstrap_v, target_logp = \
+            self._forward_unrolls(params, batch)
+        behaviour_logp = batch[SampleBatch.ACTION_LOGP]
+        rhos = jnp.exp(target_logp - behaviour_logp)
+        done = jnp.logical_or(
+            batch[SampleBatch.TERMINATEDS],
+            batch[SampleBatch.TRUNCATEDS]).astype(jnp.float32)
+        discounts = float(cfg.get("gamma", 0.99)) * (1.0 - done)
+        vs, pg_adv = self._vtrace(vf, bootstrap_v,
+                                  batch[SampleBatch.REWARDS],
+                                  discounts, jax.lax.stop_gradient(rhos))
+        clip = float(cfg.get("clip_param", 0.3))
+        surrogate = jnp.minimum(
+            rhos * pg_adv, jnp.clip(rhos, 1 - clip, 1 + clip) * pg_adv)
+        policy_loss = -jnp.mean(surrogate)
+        vf_loss = 0.5 * jnp.mean(jnp.square(vs - vf))
+        entropy = jnp.mean(self.dist.entropy(dist_inputs))
+        total = policy_loss \
+            + float(cfg.get("vf_loss_coeff", 0.5)) * vf_loss \
+            - float(cfg.get("entropy_coeff", 0.01)) * entropy
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy, "mean_rho": jnp.mean(rhos)}
+
+
+class IMPALA(Algorithm):
+    policy_class = ImpalaPolicy
+
+    def setup(self) -> None:
+        self.config["_raw_fragments"] = True
+        super().setup()
+        # seed the async pipeline: every remote worker starts sampling
+        self._inflight: Dict[Any, Any] = {}
+        for w in self.workers.remote_workers:
+            self._inflight[w.sample.remote()] = w
+
+    def training_step(self) -> Dict[str, Any]:
+        if not self.workers.remote_workers:
+            batch = self.workers.local_worker.sample()
+        else:
+            # reconcile the pipeline with the current fleet: workers
+            # replaced by probe_and_recreate (or not yet dispatched) get a
+            # sample() in flight; refs from removed workers are dropped
+            live = set(id(w) for w in self.workers.remote_workers)
+            inflight_ids = set(id(w) for w in self._inflight.values())
+            self._inflight = {ref: w for ref, w in self._inflight.items()
+                              if id(w) in live}
+            for w in self.workers.remote_workers:
+                if id(w) not in inflight_ids:
+                    self._inflight[w.sample.remote()] = w
+            want = int(self.config.get("num_aggregation_fragments", 1))
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=min(want,
+                                                    len(self._inflight)),
+                                    timeout=300)
+            batches: List[SampleBatch] = []
+            weights_ref = ray_tpu.put(
+                self.workers.local_worker.get_weights())
+            for ref in ready:
+                w = self._inflight.pop(ref)
+                try:
+                    batches.append(ray_tpu.get(ref))
+                except Exception:
+                    # dead worker: drop its fragment; the next train()'s
+                    # probe_and_recreate/reconcile restores throughput
+                    continue
+                # fresh weights, then immediately resume sampling (the
+                # actor queue preserves order: set_weights -> sample)
+                w.set_weights.remote(weights_ref)
+                self._inflight[w.sample.remote()] = w
+            batch = concat_samples(batches)
+        self._timesteps_total += len(batch)
+        stats = self.workers.local_worker.policy.learn_on_batch(batch)
+        stats["num_env_steps_sampled_this_iter"] = len(batch)
+        return stats
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.3
+
+    @property
+    def algo_class(self):
+        return APPO
+
+
+class APPO(IMPALA):
+    policy_class = APPOPolicy

@@ -57,8 +57,15 @@ class RolloutWorker:
     # ------------------------------------------------------------------
     def sample(self) -> SampleBatch:
         """Collect one fragment: rollout_fragment_length steps from each
-        env, GAE-postprocessed per episode chunk."""
+        env, GAE-postprocessed per episode chunk.
+
+        With config ``_raw_fragments`` (IMPALA-family), fragments are
+        fixed-length unrolls that run *across* episode resets (dones mark
+        the boundaries) and skip trajectory postprocessing — off-policy
+        corrections happen learner-side (V-trace).
+        """
         fragment = int(self.config.get("rollout_fragment_length", 200))
+        raw = bool(self.config.get("_raw_fragments", False))
         n = len(self.envs)
         chunks: List[SampleBatch] = []
         rows: List[List[Dict[str, Any]]] = self._episode_buffers
@@ -69,45 +76,61 @@ class RolloutWorker:
             for i, env in enumerate(self.envs):
                 obs2, rew, term, trunc, _ = env.step(
                     actions[i] if actions.ndim else actions)
-                rows[i].append({
+                row = {
                     SampleBatch.OBS: self._obs[i],
+                    SampleBatch.NEXT_OBS: obs2,
                     SampleBatch.ACTIONS: actions[i],
                     SampleBatch.REWARDS: rew,
                     SampleBatch.TERMINATEDS: term,
                     SampleBatch.TRUNCATEDS: trunc,
-                    SampleBatch.ACTION_LOGP:
-                        extras[SampleBatch.ACTION_LOGP][i],
-                    SampleBatch.VF_PREDS: extras[SampleBatch.VF_PREDS][i],
                     SampleBatch.EPS_ID: self._eps_ids[i],
-                })
+                }
+                for key, col in extras.items():
+                    row[key] = col[i]
+                rows[i].append(row)
                 self._episode_rewards[i] += rew
                 self._episode_lens[i] += 1
                 if term or trunc:
-                    chunks.append(self._flush_episode(i, obs2, term))
+                    if raw:
+                        self._note_episode_end(i)
+                    else:
+                        chunks.append(self._flush_episode(i, obs2, term))
                     obs2, _ = env.reset()
                 next_obs[i] = obs2
             self._obs = next_obs
 
-        # fragment boundary: flush in-progress episodes as truncated chunks
-        # (bootstrapped with V(s_last)) but keep episode stats running
-        for i in range(n):
-            if rows[i]:
-                chunks.append(self._postprocess(rows[i], self._obs[i],
-                                                truncated=True))
+        if raw:
+            # one fixed-length unroll per env, no postprocessing
+            for i in range(n):
+                chunks.append(SampleBatch(
+                    {k: np.stack([r[k] for r in rows[i]])
+                     for k in rows[i][0]}))
                 rows[i] = []
+        else:
+            # fragment boundary: flush in-progress episodes as truncated
+            # chunks (bootstrapped with V(s_last)); episode stats keep
+            # accumulating
+            for i in range(n):
+                if rows[i]:
+                    chunks.append(self._postprocess(rows[i], self._obs[i],
+                                                    truncated=True))
+                    rows[i] = []
         return concat_samples(chunks)
 
-    def _flush_episode(self, i: int, final_obs: np.ndarray,
-                       terminated: bool) -> SampleBatch:
-        batch = self._postprocess(self._episode_buffers[i], final_obs,
-                                  truncated=not terminated)
-        self._episode_buffers[i] = []
+    def _note_episode_end(self, i: int) -> None:
         self._completed_returns.append(float(self._episode_rewards[i]))
         self._completed_lens.append(int(self._episode_lens[i]))
         self._episode_rewards[i] = 0.0
         self._episode_lens[i] = 0
         self._eps_ids[i] = self._next_eps_id
         self._next_eps_id += 1
+
+    def _flush_episode(self, i: int, final_obs: np.ndarray,
+                       terminated: bool) -> SampleBatch:
+        batch = self._postprocess(self._episode_buffers[i], final_obs,
+                                  truncated=not terminated)
+        self._episode_buffers[i] = []
+        self._note_episode_end(i)
         return batch
 
     def _postprocess(self, rows: List[Dict[str, Any]],
