@@ -1,0 +1,16 @@
+"""Autoscaler (reference ``python/ray/autoscaler/``)."""
+
+from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
+    LoadMetrics,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.monitor import Monitor  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeMultiNodeProvider,
+    MockProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+)

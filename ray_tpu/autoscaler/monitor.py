@@ -1,0 +1,55 @@
+"""Autoscaler monitor: polls GCS load and drives the autoscaler.
+
+Parity: reference ``autoscaler/_private/monitor.py`` (``Monitor``:126) —
+the head-side process that reads resource load from the GCS and runs
+``StandardAutoscaler.update`` on a fixed period.  Here it can run as a
+thread inside the driver/head or standalone.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    def __init__(self, autoscaler: StandardAutoscaler,
+                 *, update_interval_s: float = 1.0):
+        self.autoscaler = autoscaler
+        self.update_interval_s = update_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _fetch_load(self) -> Dict[str, Any]:
+        from ray_tpu.core import worker as worker_mod
+        core = worker_mod.global_worker()
+        return core.gcs_call("get_cluster_load", {})
+
+    def run_once(self) -> Dict[str, Any]:
+        self.autoscaler.update_load_metrics(self._fetch_load())
+        return self.autoscaler.update()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("autoscaler update failed")
+            self._stop.wait(self.update_interval_s)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="autoscaler-monitor",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

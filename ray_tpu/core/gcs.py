@@ -40,6 +40,8 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     # load: number of queued+running lease requests, for hybrid scheduling
     load: int = 0
+    # queued resource shapes (autoscaler demand signal)
+    pending_demand: List[Dict[str, float]] = field(default_factory=list)
 
 
 ACTOR_PENDING = "PENDING_CREATION"
@@ -188,7 +190,29 @@ class GcsServer:
         info.last_heartbeat = time.monotonic()
         info.resources_available = dict(data["resources_available"])
         info.load = data.get("load", 0)
+        info.pending_demand = list(data.get("pending_demand", []))
         return {"acked": True}
+
+    async def handle_get_cluster_load(self, conn, data):
+        """Aggregate view for the autoscaler (parity: the monitor reading
+        resource load + demand from GCS)."""
+        pending_pgs = []
+        for pg in self.placement_groups.values():
+            if pg.state in ("PENDING", "INFEASIBLE"):
+                pending_pgs.append({"strategy": pg.strategy,
+                                    "bundles": pg.bundles})
+        return {
+            "nodes": [
+                {"node_id": n.node_id.hex(), "alive": n.alive,
+                 "resources_total": n.resources_total,
+                 "resources_available": n.resources_available,
+                 "load": n.load}
+                for n in self.nodes.values()
+            ],
+            "pending_demand": [d for n in self.nodes.values() if n.alive
+                               for d in n.pending_demand],
+            "pending_placement_groups": pending_pgs,
+        }
 
     async def handle_get_nodes(self, conn, data):
         return [

@@ -180,6 +180,10 @@ class Raylet:
                     "node_id": self.node_id.binary(),
                     "resources_available": self.resources_available,
                     "load": len(self._pending_leases),
+                    # queued resource shapes drive autoscaling (parity:
+                    # resource_load_by_shape in the reference's syncer)
+                    "pending_demand": [lease.resources for lease in
+                                       self._pending_leases[:100]],
                 }, timeout=5.0)
                 if not reply.get("acked"):
                     logger.error("GCS rejected health report; exiting raylet")

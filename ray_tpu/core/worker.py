@@ -1133,6 +1133,12 @@ class CoreWorker:
         raise ActorDiedError(state.actor_id.hex()[:12],
                              "timed out resolving actor address")
 
+    def gcs_call(self, method: str, data: Optional[dict] = None,
+                 timeout: float = 30.0):
+        """Generic GCS RPC (autoscaler monitor, state API, dashboards)."""
+        return self._run(self.gcs_conn.call(method, data or {},
+                                            timeout=timeout))
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._run(self.gcs_conn.call("kill_actor",
                                      {"actor_id": actor_id.binary()}))

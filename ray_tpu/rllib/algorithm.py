@@ -52,6 +52,12 @@ class Algorithm:
     def get_policy(self):
         return self.workers.local_worker.policy
 
+    def _collect_metrics(self):
+        """Episode stats from the fleet; async algorithms override to use
+        stats piggybacked on sample results instead of extra actor calls
+        (which would queue behind in-flight sampling)."""
+        return self.workers.foreach_worker(lambda w: w.metrics())
+
     # ------------------------------------------------------------------
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
@@ -63,7 +69,7 @@ class Algorithm:
         t0 = time.time()
         result = self.training_step()
         episodes_this_iter = 0
-        for m in self.workers.foreach_worker(lambda w: w.metrics()):
+        for m in self._collect_metrics():
             self._episode_returns.extend(m["episode_returns"])
             self._episode_lens.extend(m["episode_lens"])
             episodes_this_iter += len(m["episode_returns"])

@@ -48,56 +48,77 @@ class ImpalaConfig(AlgorithmConfig):
 class ImpalaPolicy(JaxPolicy):
     """V-trace actor-critic over [B, T] unrolls."""
 
-    def _vtrace(self, vf, bootstrap_v, rewards, discounts, rhos):
-        """vs and pg advantages (Espeholt et al. eq. 1); all [B, T]."""
+    def _vtrace(self, vf, v_next, rewards, gamma_boot, gamma_cut, done,
+                rhos):
+        """vs and pg advantages (Espeholt et al. eq. 1); all [B, T].
+
+        ``v_next`` is V(next_obs_t) from a full second forward — exact
+        even at truncation boundaries inside the unroll (where
+        vf[t+1] would be the value of the *reset* state).  ``gamma_boot``
+        zeroes only at true terminations (bootstrap through time limits);
+        ``gamma_cut`` zeroes at any episode boundary so the correction
+        recursion never crosses episodes.
+        """
         cfg = self.config
         rho_bar = float(cfg.get("vtrace_clip_rho_threshold", 1.0))
         c_bar = float(cfg.get("vtrace_clip_c_threshold", 1.0))
         clipped_rho = jnp.minimum(rho_bar, rhos)
         cs = jnp.minimum(c_bar, rhos)
-        v_next = jnp.concatenate([vf[:, 1:], bootstrap_v[:, None]], axis=1)
-        deltas = clipped_rho * (rewards + discounts * v_next - vf)
+        deltas = clipped_rho * (rewards + gamma_boot * v_next - vf)
 
         def step(acc, xs):
-            delta_t, disc_t, c_t = xs
-            acc = delta_t + disc_t * c_t * acc
+            delta_t, cut_t, c_t = xs
+            acc = delta_t + cut_t * c_t * acc
             return acc, acc
 
         # reverse scan over time (transpose to [T, B])
         _, vs_minus_v_rev = jax.lax.scan(
-            step, jnp.zeros_like(bootstrap_v),
-            (deltas.T[::-1], discounts.T[::-1], cs.T[::-1]))
+            step, jnp.zeros_like(vf[:, 0]),
+            (deltas.T[::-1], gamma_cut.T[::-1], cs.T[::-1]))
         vs_minus_v = vs_minus_v_rev[::-1].T
         vs = vf + vs_minus_v
-        vs_next = jnp.concatenate([vs[:, 1:], bootstrap_v[:, None]], axis=1)
-        pg_adv = clipped_rho * (rewards + discounts * vs_next - vf)
+        # vs_{t+1}: the corrected value of the successor state — at an
+        # episode boundary the successor is v_next itself (no correction
+        # propagates across episodes)
+        vs_shift = jnp.concatenate([vs[:, 1:], v_next[:, -1:]], axis=1)
+        vs_next = jnp.where(done > 0, v_next, vs_shift)
+        pg_adv = clipped_rho * (rewards + gamma_boot * vs_next - vf)
         return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
     def _forward_unrolls(self, params, batch):
         obs = batch[SampleBatch.OBS]
+        next_obs = batch[SampleBatch.NEXT_OBS]
         B, T = obs.shape[0], obs.shape[1]
         dist_inputs, vf = self.model.apply(
             params, obs.reshape((B * T,) + obs.shape[2:]))
         dist_inputs = dist_inputs.reshape((B, T) + dist_inputs.shape[1:])
         vf = vf.reshape(B, T)
-        _, bootstrap_v = self.model.apply(params, batch["bootstrap_obs"])
+        _, v_next = self.model.apply(
+            params, next_obs.reshape((B * T,) + next_obs.shape[2:]))
+        v_next = v_next.reshape(B, T)
         target_logp = self.dist.logp(dist_inputs,
                                      batch[SampleBatch.ACTIONS])
-        return dist_inputs, vf, bootstrap_v, target_logp
+        return dist_inputs, vf, v_next, target_logp
+
+    def _policy_loss(self, rhos, target_logp, pg_adv):
+        return -jnp.mean(target_logp * pg_adv)
 
     def loss(self, params, batch):
         cfg = self.config
-        dist_inputs, vf, bootstrap_v, target_logp = \
+        gamma = float(cfg.get("gamma", 0.99))
+        dist_inputs, vf, v_next, target_logp = \
             self._forward_unrolls(params, batch)
         rhos = jnp.exp(target_logp - batch[SampleBatch.ACTION_LOGP])
+        term = batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
         done = jnp.logical_or(
             batch[SampleBatch.TERMINATEDS],
             batch[SampleBatch.TRUNCATEDS]).astype(jnp.float32)
-        discounts = float(cfg.get("gamma", 0.99)) * (1.0 - done)
-        vs, pg_adv = self._vtrace(vf, bootstrap_v,
+        vs, pg_adv = self._vtrace(vf, v_next,
                                   batch[SampleBatch.REWARDS],
-                                  discounts, jax.lax.stop_gradient(rhos))
-        policy_loss = -jnp.mean(target_logp * pg_adv)
+                                  gamma * (1.0 - term),
+                                  gamma * (1.0 - done), done,
+                                  jax.lax.stop_gradient(rhos))
+        policy_loss = self._policy_loss(rhos, target_logp, pg_adv)
         vf_loss = 0.5 * jnp.mean(jnp.square(vs - vf))
         entropy = jnp.mean(self.dist.entropy(dist_inputs))
         total = policy_loss \
@@ -120,7 +141,6 @@ class ImpalaPolicy(JaxPolicy):
                     continue
                 v = v[:B * T].reshape((B, T) + v.shape[1:])
                 dev[k] = jnp.asarray(v)
-            dev["bootstrap_obs"] = dev[SampleBatch.NEXT_OBS][:, -1]
             self.params, self.opt_state, stats = self._update(
                 self.params, self.opt_state, dev)
         return {k: float(v) for k, v in stats.items()}
@@ -128,32 +148,13 @@ class ImpalaPolicy(JaxPolicy):
 
 class APPOPolicy(ImpalaPolicy):
     """PPO-clipped surrogate on V-trace advantages (reference
-    ``appo_torch_policy.py``)."""
+    ``appo_torch_policy.py``); everything else inherits from IMPALA."""
 
-    def loss(self, params, batch):
-        cfg = self.config
-        dist_inputs, vf, bootstrap_v, target_logp = \
-            self._forward_unrolls(params, batch)
-        behaviour_logp = batch[SampleBatch.ACTION_LOGP]
-        rhos = jnp.exp(target_logp - behaviour_logp)
-        done = jnp.logical_or(
-            batch[SampleBatch.TERMINATEDS],
-            batch[SampleBatch.TRUNCATEDS]).astype(jnp.float32)
-        discounts = float(cfg.get("gamma", 0.99)) * (1.0 - done)
-        vs, pg_adv = self._vtrace(vf, bootstrap_v,
-                                  batch[SampleBatch.REWARDS],
-                                  discounts, jax.lax.stop_gradient(rhos))
-        clip = float(cfg.get("clip_param", 0.3))
+    def _policy_loss(self, rhos, target_logp, pg_adv):
+        clip = float(self.config.get("clip_param", 0.3))
         surrogate = jnp.minimum(
             rhos * pg_adv, jnp.clip(rhos, 1 - clip, 1 + clip) * pg_adv)
-        policy_loss = -jnp.mean(surrogate)
-        vf_loss = 0.5 * jnp.mean(jnp.square(vs - vf))
-        entropy = jnp.mean(self.dist.entropy(dist_inputs))
-        total = policy_loss \
-            + float(cfg.get("vf_loss_coeff", 0.5)) * vf_loss \
-            - float(cfg.get("entropy_coeff", 0.01)) * entropy
-        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
-                       "entropy": entropy, "mean_rho": jnp.mean(rhos)}
+        return -jnp.mean(surrogate)
 
 
 class IMPALA(Algorithm):
@@ -164,8 +165,9 @@ class IMPALA(Algorithm):
         super().setup()
         # seed the async pipeline: every remote worker starts sampling
         self._inflight: Dict[Any, Any] = {}
+        self._pending_metrics: List[Dict[str, Any]] = []
         for w in self.workers.remote_workers:
-            self._inflight[w.sample.remote()] = w
+            self._inflight[w.sample_with_metrics.remote()] = w
 
     def training_step(self) -> Dict[str, Any]:
         if not self.workers.remote_workers:
@@ -180,7 +182,7 @@ class IMPALA(Algorithm):
                               if id(w) in live}
             for w in self.workers.remote_workers:
                 if id(w) not in inflight_ids:
-                    self._inflight[w.sample.remote()] = w
+                    self._inflight[w.sample_with_metrics.remote()] = w
             want = int(self.config.get("num_aggregation_fragments", 1))
             ready, _ = ray_tpu.wait(list(self._inflight),
                                     num_returns=min(want,
@@ -192,20 +194,28 @@ class IMPALA(Algorithm):
             for ref in ready:
                 w = self._inflight.pop(ref)
                 try:
-                    batches.append(ray_tpu.get(ref))
+                    fragment, metrics = ray_tpu.get(ref)
                 except Exception:
                     # dead worker: drop its fragment; the next train()'s
                     # probe_and_recreate/reconcile restores throughput
                     continue
+                batches.append(fragment)
+                self._pending_metrics.append(metrics)
                 # fresh weights, then immediately resume sampling (the
                 # actor queue preserves order: set_weights -> sample)
                 w.set_weights.remote(weights_ref)
-                self._inflight[w.sample.remote()] = w
+                self._inflight[w.sample_with_metrics.remote()] = w
             batch = concat_samples(batches)
         self._timesteps_total += len(batch)
         stats = self.workers.local_worker.policy.learn_on_batch(batch)
         stats["num_env_steps_sampled_this_iter"] = len(batch)
         return stats
+
+    def _collect_metrics(self):
+        out = [self.workers.local_worker.metrics()]
+        out.extend(self._pending_metrics)
+        self._pending_metrics = []
+        return out
 
     def stop(self) -> None:
         self._inflight.clear()

@@ -140,6 +140,13 @@ class RolloutWorker:
         return self.policy.postprocess_trajectory(batch, last_obs,
                                                   truncated=truncated)
 
+    def sample_with_metrics(self):
+        """One actor round-trip for async learners: piggybacks episode
+        stats on the fragment so no separate metrics() call has to queue
+        behind the next (already re-dispatched) sample()."""
+        batch = self.sample()
+        return batch, self.metrics()
+
     # ------------------------------------------------------------------
     def metrics(self) -> Dict[str, Any]:
         """Drain episode stats (reference ``collect_metrics``)."""

@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +40,9 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     autoscaling_config: Optional[Dict[str, Any]] = None
     version: int = 0
+    #: grace period for draining in-flight requests before a replaced or
+    #: scaled-down replica is killed (reference graceful_shutdown_*)
+    graceful_shutdown_timeout_s: float = 10.0
 
 
 @ray_tpu.remote
@@ -97,6 +101,8 @@ class ServeController:
         self._configs: Dict[str, DeploymentConfig] = {}
         self._lock = threading.Lock()
         self._stop = False
+        # replicas removed from routing, awaiting drain: (handle, deadline)
+        self._draining: List[Tuple[Any, float]] = []
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
         self._thread.start()
 
@@ -171,6 +177,13 @@ class ServeController:
                     ray_tpu.kill(r)
                 except Exception:  # noqa: BLE001
                     pass
+        # replicas still draining die with the app too
+        for replica, _, _ in self._draining:
+            try:
+                ray_tpu.kill(replica)
+            except Exception:  # noqa: BLE001
+                pass
+        self._draining = []
         return True
 
     # -- reconciliation ------------------------------------------------
@@ -190,6 +203,7 @@ class ServeController:
                 changed = self._reconcile_once()
                 if changed:
                     self._bump_routing()
+                self._reap_drained()
             except Exception:  # noqa: BLE001
                 logger.exception("serve control loop iteration failed")
             time.sleep(0.1)
@@ -213,10 +227,7 @@ class ServeController:
                     old = replicas[i]
                     replicas[i] = new
                     versions[i] = config.version
-                    try:
-                        ray_tpu.kill(old)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    self._drain(old, config)
                     changed = True
                     continue
             while len(replicas) < target:
@@ -229,12 +240,48 @@ class ServeController:
             while len(replicas) > target:
                 old = replicas.pop()
                 versions.pop()
-                try:
-                    ray_tpu.kill(old)
-                except Exception:  # noqa: BLE001
-                    pass
+                self._drain(old, config)
                 changed = True
         return changed
+
+    def _drain(self, replica: Any, config: DeploymentConfig) -> None:
+        """Stop routing to the replica (caller bumps routing) and kill it
+        only once its in-flight requests finish, or after the grace
+        deadline (parity: replica graceful shutdown,
+        deployment_state.py)."""
+        now = time.monotonic()
+        deadline = now \
+            + float(getattr(config, "graceful_shutdown_timeout_s", 10.0))
+        # minimum drain: requests already dispatched to the replica may
+        # still be in its inbox (inflight not yet incremented)
+        self._draining.append((replica, deadline, now + 0.5))
+
+    def _reap_drained(self) -> None:
+        if not self._draining:
+            return
+        still: List[Tuple[Any, float, float]] = []
+        for replica, deadline, not_before in self._draining:
+            now = time.monotonic()
+            if now < not_before:
+                still.append((replica, deadline, not_before))
+                continue
+            done = now > deadline
+            if not done:
+                try:
+                    m = ray_tpu.get(replica.metrics.remote(), timeout=5)
+                    done = m.get("inflight", 0) == 0
+                except ActorDiedError:
+                    done = True  # already dead
+                except Exception:  # noqa: BLE001
+                    pass  # busy/slow: keep draining until the deadline
+            if done:
+                try:
+                    ray_tpu.kill(replica)
+                except Exception:  # noqa: BLE001
+                    pass
+            else:
+                still.append((replica, deadline, not_before))
+        self._draining = still
 
     def _autoscaled_target(self, dep: Dict[str, Any],
                            config: DeploymentConfig) -> int:

@@ -1,0 +1,168 @@
+"""Autoscaler tests (parity model: reference test_autoscaler.py,
+test_resource_demand_scheduler.py, test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (MockProvider, NodeTypeConfig,
+                                ResourceDemandScheduler, StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import TAG_NODE_KIND, TAG_NODE_TYPE
+
+
+CPU4 = NodeTypeConfig(resources={"CPU": 4})
+TPU_HOST = NodeTypeConfig(resources={"CPU": 8, "TPU": 4})
+
+
+def test_demand_packs_onto_existing():
+    sched = ResourceDemandScheduler({"cpu4": CPU4})
+    out = sched.get_nodes_to_launch(
+        existing_nodes=[("cpu4", {"CPU": 4})],
+        demand=[{"CPU": 1}] * 4)
+    assert out == {}
+
+
+def test_demand_launches_minimum_nodes():
+    sched = ResourceDemandScheduler({"cpu4": CPU4})
+    out = sched.get_nodes_to_launch(
+        existing_nodes=[],
+        demand=[{"CPU": 1}] * 10)
+    assert out == {"cpu4": 3}
+
+
+def test_demand_picks_best_type():
+    sched = ResourceDemandScheduler({"cpu4": CPU4, "tpu": TPU_HOST})
+    out = sched.get_nodes_to_launch(
+        existing_nodes=[], demand=[{"TPU": 4}])
+    assert out == {"tpu": 1}
+    # pure-CPU demand should not launch TPU hosts
+    out = sched.get_nodes_to_launch(
+        existing_nodes=[], demand=[{"CPU": 2}])
+    assert out == {"cpu4": 1}
+
+
+def test_strict_spread_bundles_need_distinct_nodes():
+    sched = ResourceDemandScheduler({"cpu4": CPU4})
+    out = sched.get_nodes_to_launch(
+        existing_nodes=[],
+        demand=[],
+        pending_placement_groups=[{
+            "strategy": "STRICT_SPREAD",
+            "bundles": [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+        }])
+    assert out == {"cpu4": 3}
+
+
+def test_launching_counts_as_capacity():
+    sched = ResourceDemandScheduler({"cpu4": CPU4})
+    out = sched.get_nodes_to_launch(
+        existing_nodes=[], demand=[{"CPU": 1}] * 4,
+        launching={"cpu4": 1})
+    assert out == {}
+
+
+def test_infeasible_demand_not_launched():
+    sched = ResourceDemandScheduler({"cpu4": CPU4})
+    out = sched.get_nodes_to_launch(
+        existing_nodes=[], demand=[{"TPU": 8}])
+    assert out == {}
+
+
+def _snapshot(nodes, demand=(), pgs=()):
+    return {"nodes": nodes, "pending_demand": list(demand),
+            "pending_placement_groups": list(pgs)}
+
+
+def _gcs_node(nid, total, avail, load=0):
+    return {"node_id": nid + "0" * (32 - len(nid)), "alive": True,
+            "resources_total": total, "resources_available": avail,
+            "load": load}
+
+
+def test_autoscaler_scales_up_and_down():
+    provider = MockProvider()
+    asc = StandardAutoscaler(
+        provider, {"cpu4": NodeTypeConfig(resources={"CPU": 4},
+                                          min_workers=0, max_workers=5)},
+        idle_timeout_s=0.2)
+    # demand for 8 CPUs, head has none free
+    asc.update_load_metrics(_snapshot(
+        [_gcs_node("head", {"CPU": 1}, {"CPU": 0}, load=2)],
+        demand=[{"CPU": 1}] * 8))
+    r = asc.update()
+    assert r["launched"] == {"cpu4": 2}
+    workers = provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+    assert len(workers) == 2
+
+    # nodes joined the GCS and are now idle with no demand
+    asc.update_load_metrics(_snapshot(
+        [_gcs_node("head", {"CPU": 1}, {"CPU": 1})] +
+        [_gcs_node(w[:12], {"CPU": 4}, {"CPU": 4}) for w in workers]))
+    r = asc.update()
+    assert r["launched"] == {} and r["terminated"] == []
+    time.sleep(0.3)
+    r = asc.update()
+    assert len(r["terminated"]) == 2
+    assert provider.non_terminated_nodes({TAG_NODE_KIND: "worker"}) == []
+
+
+def test_autoscaler_min_workers_floor():
+    provider = MockProvider()
+    asc = StandardAutoscaler(
+        provider, {"cpu4": NodeTypeConfig(resources={"CPU": 4},
+                                          min_workers=2)},
+        idle_timeout_s=0.0)
+    asc.update_load_metrics(_snapshot([]))
+    r = asc.update()
+    assert r["launched"] == {"cpu4": 2}
+    # idle forever but never below the floor
+    workers = provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+    asc.update_load_metrics(_snapshot(
+        [_gcs_node(w[:12], {"CPU": 4}, {"CPU": 4}) for w in workers]))
+    asc.update()
+    time.sleep(0.05)
+    r = asc.update()
+    assert r["terminated"] == []
+
+
+@pytest.mark.usefixtures("shutdown_only")
+def test_autoscaler_fake_multinode_end_to_end():
+    """Infeasible task -> autoscaler launches a local raylet -> task runs
+    -> idle node scaled down (reference test_autoscaler_fake_multinode)."""
+    from ray_tpu.autoscaler import FakeMultiNodeProvider, Monitor
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    cluster.connect()
+    try:
+        node_types = {"cpu2": NodeTypeConfig(resources={"CPU": 2},
+                                             max_workers=2)}
+        provider = FakeMultiNodeProvider(
+            cluster, {"cpu2": {"resources": {"CPU": 2}}})
+        asc = StandardAutoscaler(provider, node_types, max_workers=2,
+                                 idle_timeout_s=2.0)
+        monitor = Monitor(asc, update_interval_s=0.5)
+        monitor.start()
+
+        @ray_tpu.remote(num_cpus=2)
+        def two_cpu_task():
+            return "scaled"
+
+        # head has 1 CPU: this queues until the autoscaler adds a node
+        result = ray_tpu.get(two_cpu_task.remote(), timeout=90)
+        assert result == "scaled"
+        assert len(provider.non_terminated_nodes({})) >= 1
+
+        # after going idle the worker is terminated
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if not provider.non_terminated_nodes({}):
+                break
+            time.sleep(0.5)
+        assert provider.non_terminated_nodes({}) == []
+        monitor.stop()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
