@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import atexit
 import logging
+import os
 import subprocess
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -77,6 +78,24 @@ def init(address: Optional[str] = None, *,
         from ray_tpu.core.ids import NodeID as _NodeID
         from ray_tpu.core.worker import CoreWorker
 
+        if address is None:
+            # job drivers launched by a JobSupervisor join the cluster
+            # via env var (reference: RAY_ADDRESS)
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
+        if address == "auto":
+            # find a running cluster: env var, else the head recorded by
+            # `ray-tpu start --head`
+            env_addr = os.environ.get("RAY_TPU_ADDRESS")
+            address = env_addr if env_addr and env_addr != "auto" else None
+            if address is None:
+                from ray_tpu.scripts.cli import _load_latest
+                latest = _load_latest()
+                if latest:
+                    address = "{}:{}".format(*latest["gcs_address"])
+            if address is None:
+                raise RayTpuError(
+                    "address='auto' but no running cluster found (set "
+                    "RAY_TPU_ADDRESS or run `ray-tpu start --head`)")
         if address is None:
             session_dir = node_mod.new_session_dir(config)
             res: Dict[str, float] = dict(resources or {})
@@ -239,3 +258,9 @@ def method(**options):
         m.__rtpu_method_options__ = options
         return m
     return decorate
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace export of task events (reference ``ray.timeline``)."""
+    from ray_tpu.experimental.state.api import timeline as _timeline
+    return _timeline(filename)

@@ -66,6 +66,8 @@ class StandardAutoscaler:
         self.load_metrics = LoadMetrics()
         self.scheduler = ResourceDemandScheduler(node_types, max_workers)
         self._idle_since: Dict[str, float] = {}
+        # provider ids we terminated, until the GCS notices they're gone
+        self._terminated_ids: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
     def update_load_metrics(self, snapshot: Dict[str, Any]) -> None:
@@ -91,7 +93,9 @@ class StandardAutoscaler:
             if joined(nid):
                 live.append((nid, ntype))
                 live_by_type[ntype] = live_by_type.get(ntype, 0) + 1
-            else:
+            elif ntype in self.node_types:
+                # unknown/untagged types can't be fed to the scheduler
+                # (it would KeyError on their resources) — ignore them
                 launching[ntype] = launching.get(ntype, 0) + 1
 
         # ---- scale up: min_workers floor + unfulfilled demand ----
@@ -112,11 +116,13 @@ class StandardAutoscaler:
             to_launch[name] = to_launch.get(name, 0) + count
 
         budget = self.max_workers - len(workers)
+        launched: Dict[str, int] = {}
         for name, count in to_launch.items():
             count = min(count, budget)
             if count <= 0:
                 continue
             budget -= count
+            launched[name] = count
             logger.info("autoscaler: launching %d x %s", count, name)
             self.provider.create_node(
                 self.node_types[name].node_config,
@@ -143,6 +149,7 @@ class StandardAutoscaler:
                             and live_by_type.get(ntype, 0) > floor:
                         logger.info("autoscaler: terminating idle %s", nid)
                         self.provider.terminate_node(nid)
+                        self._terminated_ids[nid] = now
                         live_by_type[ntype] -= 1
                         terminated.append(nid)
                         self._idle_since.pop(nid, None)
@@ -151,7 +158,7 @@ class StandardAutoscaler:
         else:
             self._idle_since.clear()
 
-        return {"launched": dict(to_launch), "terminated": terminated,
+        return {"launched": launched, "terminated": terminated,
                 "num_workers": len(self.provider.non_terminated_nodes(
                     {TAG_NODE_KIND: "worker"}))}
 
@@ -165,8 +172,17 @@ class StandardAutoscaler:
         return {}
 
     def _head_nodes(self) -> List[Tuple[str, Dict[str, float]]]:
-        """Head capacity also absorbs demand (it's not a provider node)."""
-        prefixes = self.provider.non_terminated_nodes({})
+        """Head capacity also absorbs demand (it's not a provider node).
+
+        Nodes we just terminated may still look alive in the GCS until
+        the heartbeat expires — they must not masquerade as phantom head
+        capacity and suppress needed launches."""
+        now = time.monotonic()
+        self._terminated_ids = {k: t for k, t in
+                                self._terminated_ids.items()
+                                if now - t < 600.0}
+        prefixes = list(self.provider.non_terminated_nodes({})) \
+            + list(self._terminated_ids)
         out = []
         for n in self.load_metrics.nodes:
             if not any(n["node_id"].startswith(p) for p in prefixes):

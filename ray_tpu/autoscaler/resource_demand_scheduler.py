@@ -12,7 +12,6 @@ score for what remains; strict-spread bundles force distinct nodes.
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 

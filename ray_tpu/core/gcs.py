@@ -109,6 +109,8 @@ class GcsServer:
         self._pg_retry_task: Optional[asyncio.Task] = None
         self._actor_creation_locks: Dict[ActorID, asyncio.Lock] = {}
         self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
+        # (name, sorted-tags) -> aggregated metric record
+        self._metrics: Dict[Any, Dict[str, Any]] = {}
 
     async def start(self) -> rpc.Address:
         address = await self.server.start()
@@ -323,6 +325,41 @@ class GcsServer:
         if overflow > 0:
             del self._task_events[:overflow]
         return True
+
+    # ------------------------------------------------------------------
+    # metrics aggregation (parity: MetricsAgent / OpenCensus proxy
+    # collector metrics_agent.py:188,374 — here the GCS is the hub)
+    # ------------------------------------------------------------------
+    async def handle_report_metrics(self, conn, data):
+        for rec in data.get("records", []):
+            key = (rec["name"], tuple(sorted(rec.get("tags", {}).items())))
+            cur = self._metrics.get(key)
+            if rec["type"] == "counter":
+                if cur is None:
+                    cur = dict(rec)
+                else:
+                    cur["value"] += rec["value"]
+            elif rec["type"] == "gauge":
+                cur = dict(rec)
+            elif rec["type"] == "histogram":
+                if cur is None:
+                    cur = dict(rec)
+                else:
+                    cur["buckets"] = [a + b for a, b in
+                                      zip(cur["buckets"], rec["buckets"])]
+                    cur["sum"] += rec["sum"]
+                    cur["count"] += rec["count"]
+            else:
+                continue
+            self._metrics[key] = cur
+        return True
+
+    async def handle_get_metrics(self, conn, data):
+        return list(self._metrics.values())
+
+    async def handle_list_jobs(self, conn, data):
+        return [{"job_id": jid.hex(), **{k: v for k, v in j.items()}}
+                for jid, j in self.jobs.items()]
 
     async def handle_get_task_events(self, conn, data):
         limit = data.get("limit", 1000)

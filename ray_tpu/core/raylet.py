@@ -228,7 +228,12 @@ class Raylet:
     # ------------------------------------------------------------------
     def _start_worker(self, job_id_bin: Optional[bytes],
                       needs_tpu: bool = False) -> None:
-        if self._starting + len(self.workers) >= self._max_workers:
+        # the cap bounds the *task pool*; workers holding actors live
+        # outside it (parity: reference WorkerPool — actor workers are
+        # dedicated, else a few CPU:0 actors starve all task execution)
+        pool_size = self._starting + sum(
+            1 for w in self.workers.values() if not w.is_actor)
+        if pool_size >= self._max_workers:
             return
         self._starting += 1
         env = dict(os.environ)
@@ -553,6 +558,31 @@ class Raylet:
                     "creation_error": True}
         return {"granted": True, "worker_task_address": worker.task_address,
                 "worker_id": worker.worker_id.binary()}
+
+    # ------------------------------------------------------------------
+    # state API (per-node sources; parity: raylet handlers behind
+    # StateDataSourceClient state_manager.py:130)
+    # ------------------------------------------------------------------
+    async def handle_list_workers(self, conn, data):
+        return [{"worker_id": w.worker_id.hex(), "pid": w.pid,
+                 "leased": w.leased, "is_actor": w.is_actor,
+                 "lease_resources": w.lease_resources}
+                for w in self.workers.values()]
+
+    async def handle_list_objects(self, conn, data):
+        limit = int(data.get("limit", 1000))
+        out = []
+        for oid in list(self._primary)[:limit]:
+            lease = self.store.lease(oid)
+            if lease is None:
+                continue
+            _, size = lease
+            self.store.release(oid)
+            out.append({"object_id": oid.hex(), "size": size,
+                        "node_id": self.node_id.hex()})
+        stats = await self.handle_store_stats(conn, {})
+        return {"objects": out, "store_stats": stats,
+                "num_spilled": stats["num_spilled"]}
 
     # ------------------------------------------------------------------
     # placement-group bundles (PlacementGroupResourceManager)

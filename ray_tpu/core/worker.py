@@ -1139,6 +1139,14 @@ class CoreWorker:
         return self._run(self.gcs_conn.call(method, data or {},
                                             timeout=timeout))
 
+    def raylet_call(self, address, method: str,
+                    data: Optional[dict] = None, timeout: float = 30.0):
+        """Generic RPC to any raylet (state API per-node sources)."""
+        async def _call():
+            conn = await self._pool.get(tuple(address))
+            return await conn.call(method, data or {}, timeout=timeout)
+        return self._run(_call())
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         self._run(self.gcs_conn.call("kill_actor",
                                      {"actor_id": actor_id.binary()}))

@@ -1,0 +1,159 @@
+"""Dashboard: JSON state endpoints + Prometheus metrics export.
+
+Parity: reference ``dashboard/head.py:70`` (aiohttp server) and the
+``dashboard/modules/{node,actor,job,metrics,...}`` REST surface — the
+React client is an explicit non-goal (SURVEY.md §7); all state is served
+as JSON, which the CLI and tests consume.  ``/metrics`` serves the
+aggregated GCS metrics table in Prometheus text format (parity:
+``metrics_agent.py:489`` service-discovery target).
+
+Job-submission REST (``/api/jobs``) is mounted here too, mirroring the
+reference where job endpoints live in the dashboard
+(``dashboard/modules/job/job_head.py:145``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+
+def _prometheus_text(records) -> str:
+    lines = []
+    seen_help = set()
+    for rec in records:
+        name = rec["name"].replace(".", "_").replace("-", "_")
+        if name not in seen_help:
+            if rec.get("description"):
+                lines.append(f"# HELP {name} {rec['description']}")
+            lines.append(f"# TYPE {name} {rec['type']}")
+            seen_help.add(name)
+        tags = ",".join(f'{k}="{v}"' for k, v in
+                        sorted(rec.get("tags", {}).items()))
+        label = f"{{{tags}}}" if tags else ""
+        if rec["type"] == "histogram":
+            cum = 0
+            bounds = rec["boundaries"] + ["+Inf"]
+            for count, bound in zip(rec["buckets"], bounds):
+                cum += count
+                btags = tags + ("," if tags else "") + f'le="{bound}"'
+                lines.append(f"{name}_bucket{{{btags}}} {cum}")
+            lines.append(f"{name}_sum{label} {rec['sum']}")
+            lines.append(f"{name}_count{label} {rec['count']}")
+        else:
+            lines.append(f"{name}{label} {rec['value']}")
+    return "\n".join(lines) + "\n"
+
+
+class Dashboard:
+    """JSON/Prometheus server over the driver's GCS connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+
+    # -- request handlers (each runs gcs/raylet calls in a worker
+    # thread so the serving loop never blocks) -------------------------
+    async def _state(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fn, *args)
+
+    def _json(self, data) -> web.Response:
+        return web.json_response(json.loads(json.dumps(data, default=str)))
+
+    async def handle_nodes(self, request):
+        from ray_tpu.experimental.state.api import list_nodes
+        return self._json(await self._state(list_nodes))
+
+    async def handle_actors(self, request):
+        from ray_tpu.experimental.state.api import list_actors
+        return self._json(await self._state(list_actors))
+
+    async def handle_tasks(self, request):
+        from ray_tpu.experimental.state.api import list_tasks
+        return self._json(await self._state(list_tasks))
+
+    async def handle_pgs(self, request):
+        from ray_tpu.experimental.state.api import list_placement_groups
+        return self._json(await self._state(list_placement_groups))
+
+    async def handle_cluster_status(self, request):
+        from ray_tpu.experimental.state.api import (available_resources,
+                                                    cluster_resources,
+                                                    object_store_stats)
+        total = await self._state(cluster_resources)
+        avail = await self._state(available_resources)
+        stores = await self._state(object_store_stats)
+        return self._json({"cluster_resources": total,
+                           "available_resources": avail,
+                           "object_store": stores})
+
+    async def handle_metrics(self, request):
+        from ray_tpu.core import worker as worker_mod
+
+        def fetch():
+            return worker_mod.global_worker().gcs_call("get_metrics", {})
+        records = await self._state(fetch)
+        return web.Response(text=_prometheus_text(records),
+                            content_type="text/plain")
+
+    # -- lifecycle ------------------------------------------------------
+    def _make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/api/nodes", self.handle_nodes)
+        app.router.add_get("/api/actors", self.handle_actors)
+        app.router.add_get("/api/tasks", self.handle_tasks)
+        app.router.add_get("/api/placement_groups", self.handle_pgs)
+        app.router.add_get("/api/cluster_status", self.handle_cluster_status)
+        app.router.add_get("/metrics", self.handle_metrics)
+        try:
+            from ray_tpu.job.job_head import add_job_routes
+            add_job_routes(app)
+        except ImportError:
+            pass
+        return app
+
+    def start(self) -> str:
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def serve():
+                self._runner = web.AppRunner(self._make_app())
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, self.host, self.port)
+                await site.start()
+                if self.port == 0:
+                    self.port = self._runner.addresses[0][1]
+                self._started.set()
+
+            self._loop.run_until_complete(serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, name="dashboard",
+                                        daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("dashboard failed to start")
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            async def shutdown():
+                if self._runner is not None:
+                    await self._runner.cleanup()
+                self._loop.stop()
+            asyncio.run_coroutine_threadsafe(shutdown(), self._loop)
+            if self._thread is not None:
+                self._thread.join(timeout=5)

@@ -1,0 +1,16 @@
+"""State API (reference ``python/ray/experimental/state/``)."""
+
+from ray_tpu.experimental.state.api import (  # noqa: F401
+    available_resources,
+    cluster_resources,
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    object_store_stats,
+    summarize_tasks,
+    timeline,
+)

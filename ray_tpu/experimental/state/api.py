@@ -1,0 +1,175 @@
+"""Cluster state API.
+
+Parity: reference ``python/ray/experimental/state/api.py``
+(``list_tasks/actors/objects/nodes/placement_groups/jobs/workers``,
+``summarize_tasks``) backed by ``StateAPIManager``
+(``dashboard/state_aggregator.py:132``) fanning out to GCS + per-node
+raylet sources (``state_manager.py:130``).  Here the fan-out happens
+client-side: GCS tables for cluster-scoped state, raylet RPCs for
+per-node workers/objects.
+
+Also home of the chrome-trace ``timeline`` export (reference
+``ray timeline``, built from per-task profile events).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import worker as worker_mod
+
+
+def _core():
+    return worker_mod.global_worker()
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[List[tuple]]) -> List[Dict[str, Any]]:
+    """filters: [(key, "=" | "!=", value)] (reference StateApiClient)."""
+    for key, op, value in filters or []:
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return rows
+
+
+def list_nodes(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _core().gcs_call("get_nodes", {})
+    for r in rows:
+        r["node_id"] = r["node_id"].hex() \
+            if isinstance(r["node_id"], bytes) else r["node_id"]
+        r["state"] = "ALIVE" if r.pop("alive", False) else "DEAD"
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_actors(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = _core().gcs_call("list_actors", {})
+    for r in rows:
+        for k in ("actor_id", "node_id"):
+            if isinstance(r.get(k), bytes):
+                r[k] = r[k].hex()
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_placement_groups(filters=None, limit: int = 1000
+                          ) -> List[Dict[str, Any]]:
+    rows = _core().gcs_call("list_placement_groups", {})
+    for r in rows:
+        if isinstance(r.get("pg_id"), bytes):
+            r["placement_group_id"] = r.pop("pg_id").hex()
+        r["bundle_nodes"] = {i: (n.hex() if isinstance(n, bytes) else n)
+                             for i, n in r.get("bundle_nodes", {}).items()}
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_jobs(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    return _apply_filters(_core().gcs_call("list_jobs", {}),
+                          filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 1000,
+               latest_state_only: bool = True) -> List[Dict[str, Any]]:
+    """Task rows from the GCS task-event buffer; by default one row per
+    task attempt, carrying its latest state."""
+    events = _core().gcs_call("get_task_events", {"limit": 100_000})
+    if not latest_state_only:
+        return _apply_filters(events, filters)[:limit]
+    latest: Dict[tuple, Dict[str, Any]] = {}
+    for ev in events:
+        key = (ev["task_id"], ev.get("attempt", 0))
+        cur = latest.get(key)
+        if cur is None or ev["time"] >= cur["time"]:
+            latest[key] = ev
+    rows = sorted(latest.values(), key=lambda e: e["time"])
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """{func_name: {state: count}} (reference ``ray summary tasks``)."""
+    out: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    for row in list_tasks(limit=100_000):
+        out[row["name"]][row["state"]] += 1
+    return {k: dict(v) for k, v in out.items()}
+
+
+def _each_raylet(method: str, data: Dict[str, Any]) -> List[Any]:
+    core = _core()
+    out = []
+    for n in core.gcs_call("get_nodes", {}):
+        if not n.get("alive"):
+            continue
+        try:
+            out.append(core.raylet_call(tuple(n["address"]), method, data))
+        except Exception:
+            continue
+    return out
+
+
+def list_workers(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = [w for per_node in _each_raylet("list_workers", {})
+            for w in per_node]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
+    rows = [o for per_node in _each_raylet("list_objects",
+                                           {"limit": limit})
+            for o in per_node["objects"]]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def object_store_stats() -> List[Dict[str, Any]]:
+    """Per-node store stats (used/capacity/spilled; ``ray memory``)."""
+    return [dict(per_node["store_stats"],
+                 num_spilled=per_node["num_spilled"])
+            for per_node in _each_raylet("list_objects", {"limit": 0})]
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = defaultdict(float)
+    for n in list_nodes():
+        if n["state"] == "ALIVE":
+            for k, v in n["resources_total"].items():
+                total[k] += v
+    return dict(total)
+
+
+def available_resources() -> Dict[str, float]:
+    avail: Dict[str, float] = defaultdict(float)
+    for n in list_nodes():
+        if n["state"] == "ALIVE":
+            for k, v in n["resources_available"].items():
+                avail[k] += v
+    return dict(avail)
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace (``chrome://tracing`` / Perfetto) export of task
+    events (reference ``ray timeline``, profiling.h events)."""
+    events = _core().gcs_call("get_task_events", {"limit": 100_000})
+    # pair RUNNING -> FINISHED/FAILED per (task, attempt)
+    starts: Dict[tuple, Dict[str, Any]] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in sorted(events, key=lambda e: e["time"]):
+        key = (ev["task_id"], ev.get("attempt", 0))
+        if ev["state"] == "RUNNING":
+            starts[key] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and key in starts:
+            start = starts.pop(key)
+            trace.append({
+                "name": ev["name"], "ph": "X", "cat": "task",
+                "ts": start["time"] * 1e6,
+                "dur": (ev["time"] - start["time"]) * 1e6,
+                "pid": ev.get("worker_id", "worker")[:8],
+                "tid": ev["task_id"][:8],
+                "args": {"state": ev["state"], "attempt": ev.get("attempt")},
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,12 @@
+"""Job submission (reference ``dashboard/modules/job/``)."""
+
+from ray_tpu.job.job_manager import (  # noqa: F401
+    FAILED,
+    PENDING,
+    RUNNING,
+    STOPPED,
+    SUCCEEDED,
+    JobManager,
+    JobSupervisor,
+)
+from ray_tpu.job.sdk import JobSubmissionClient  # noqa: F401

@@ -1,0 +1,281 @@
+"""The ``ray-tpu`` command line interface.
+
+Parity: reference ``python/ray/scripts/scripts.py`` (``ray start/stop/
+status/timeline/memory/microbenchmark``) and
+``experimental/state/state_cli.py`` (``ray list/summary``) plus the job
+CLI (``dashboard/modules/job/cli.py``).  argparse-based (click is a
+dependency we don't take).
+
+``start --head`` daemonizes a head node and records its address at
+``<session_root>/latest_head.json`` so later CLI invocations (and
+``init(address="auto")``) find it without arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Any, Dict, Optional
+
+LATEST = "latest_head.json"
+
+
+def _session_root() -> str:
+    from ray_tpu.core.config import Config
+    return Config().apply_env_overrides().session_root
+
+
+def _latest_path() -> str:
+    return os.path.join(_session_root(), LATEST)
+
+
+def _load_latest() -> Optional[Dict[str, Any]]:
+    try:
+        with open(_latest_path()) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) \
+        or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr:
+        latest = _load_latest()
+        if latest:
+            addr = "{}:{}".format(*latest["gcs_address"])
+    if not addr:
+        sys.exit("no cluster address: pass --address, set "
+                 "RAY_TPU_ADDRESS, or run `ray-tpu start --head`")
+    return addr
+
+
+def _connect(args) -> None:
+    import ray_tpu
+    ray_tpu.init(address=_resolve_address(args))
+
+
+# ----------------------------------------------------------------------
+def cmd_start(args) -> None:
+    from ray_tpu.core.config import Config
+    from ray_tpu.core import node as node_mod
+
+    config = Config().apply_env_overrides()
+    resources: Dict[str, float] = {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.num_tpus is not None:
+        resources["TPU"] = float(args.num_tpus)
+    if args.resources:
+        resources.update(json.loads(args.resources))
+
+    if args.head:
+        session_dir = node_mod.new_session_dir(config)
+        proc, handshake = node_mod.spawn_head(config, session_dir,
+                                              resources or None)
+        record = dict(handshake, pid=proc.pid)
+        with open(_latest_path(), "w") as f:
+            json.dump(record, f)
+        gcs = handshake["gcs_address"]
+        print(f"head started (pid {proc.pid})")
+        print(f"  GCS address: {gcs[0]}:{gcs[1]}")
+        print(f"  session dir: {handshake['session_dir']}")
+        print(f"connect with: ray_tpu.init(address=\"{gcs[0]}:{gcs[1]}\")"
+              f" or ray_tpu.init(address=\"auto\") with "
+              f"RAY_TPU_ADDRESS={gcs[0]}:{gcs[1]}")
+    else:
+        addr = _resolve_address(args)
+        host, port = addr.rsplit(":", 1)
+        session_dir = node_mod.new_session_dir(config)
+        proc, handshake = node_mod.spawn_node(
+            config, session_dir, (host, int(port)), resources or None)
+        print(f"worker node started (pid {proc.pid}) joined {addr}")
+
+
+def cmd_stop(args) -> None:
+    latest = _load_latest()
+    if latest is None:
+        sys.exit("no recorded head (nothing started via `ray-tpu start`)")
+    pid = latest.get("pid")
+    try:
+        os.kill(pid, signal.SIGTERM)
+        print(f"sent SIGTERM to head (pid {pid})")
+    except ProcessLookupError:
+        print(f"head (pid {pid}) already gone")
+    try:
+        os.remove(_latest_path())
+    except FileNotFoundError:
+        pass
+
+
+def cmd_status(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental.state import api as state
+    nodes = state.list_nodes()
+    total = state.cluster_resources()
+    avail = state.available_resources()
+    print(f"nodes: {len(nodes)} "
+          f"({sum(1 for n in nodes if n['state'] == 'ALIVE')} alive)")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
+
+
+def cmd_list(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental.state import api as state
+    fn = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "workers": state.list_workers,
+        "objects": state.list_objects,
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+    }[args.resource]
+    rows = fn(limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental.state import api as state
+    print(json.dumps(state.summarize_tasks(), indent=2))
+
+
+def cmd_timeline(args) -> None:
+    _connect(args)
+    import ray_tpu
+    events = ray_tpu.timeline(args.output)
+    print(f"wrote {len(events)} trace events to {args.output}")
+
+
+def cmd_memory(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental.state import api as state
+    for i, s in enumerate(state.object_store_stats()):
+        print(f"node {i}: {s['used']}/{s['capacity']} bytes, "
+              f"{s['num_objects']} objects, {s['num_spilled']} spilled")
+    objs = state.list_objects(limit=args.limit)
+    for o in objs:
+        print(f"  {o['object_id'][:16]}…  {o['size']:>10} B  "
+              f"node {o['node_id'][:8]}")
+
+
+def cmd_dashboard(args) -> None:
+    _connect(args)
+    from ray_tpu.dashboard import Dashboard
+    dash = Dashboard(host=args.host, port=args.port)
+    url = dash.start()
+    print(f"dashboard at {url} (ctrl-c to exit)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.stop()
+
+
+def cmd_job(args) -> None:
+    from ray_tpu.job import JobSubmissionClient
+    client = JobSubmissionClient(args.dashboard_address)
+    if args.job_cmd == "submit":
+        sid = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(f"submitted: {sid}")
+        if args.wait:
+            status = client.wait_until_finished(sid)
+            print(f"{sid}: {status}")
+            print(client.get_job_logs(sid))
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id))
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.submission_id))
+    elif args.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+
+
+def cmd_microbenchmark(args) -> None:
+    from ray_tpu.scripts.ray_perf import main as perf_main
+    perf_main()
+
+
+# ----------------------------------------------------------------------
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray-tpu", description="TPU-native distributed runtime CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS address to join (worker mode)")
+    sp.add_argument("--num-cpus", type=float)
+    sp.add_argument("--num-tpus", type=float)
+    sp.add_argument("--resources", help="extra resources as JSON")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the recorded head node")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resource summary")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("resource", choices=[
+        "tasks", "actors", "nodes", "workers", "objects",
+        "placement-groups", "jobs"])
+    sp.add_argument("--limit", type=int, default=100)
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="task summary by function/state")
+    sp.add_argument("resource", choices=["tasks"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="export chrome trace")
+    sp.add_argument("--output", "-o", default="timeline.json")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("memory", help="object store usage")
+    sp.add_argument("--limit", type=int, default=20)
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("dashboard", help="serve the JSON dashboard")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8265)
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_dashboard)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    j.add_argument("--wait", action="store_true")
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("submission_id")
+    jsub.add_parser("list")
+    sp.add_argument("--dashboard-address",
+                    default=os.environ.get("RAY_TPU_DASHBOARD",
+                                           "http://127.0.0.1:8265"))
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("microbenchmark", help="core perf suite")
+    sp.set_defaults(fn=cmd_microbenchmark)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

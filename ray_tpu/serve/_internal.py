@@ -177,13 +177,16 @@ class ServeController:
                     ray_tpu.kill(r)
                 except Exception:  # noqa: BLE001
                     pass
-        # replicas still draining die with the app too
-        for replica, _, _ in self._draining:
+        # replicas still draining die with the app too (under the lock:
+        # the control loop may be appending concurrently)
+        with self._lock:
+            draining = list(self._draining)
+            self._draining = []
+        for replica, _, _ in draining:
             try:
                 ray_tpu.kill(replica)
             except Exception:  # noqa: BLE001
                 pass
-        self._draining = []
         return True
 
     # -- reconciliation ------------------------------------------------
@@ -254,13 +257,23 @@ class ServeController:
             + float(getattr(config, "graceful_shutdown_timeout_s", 10.0))
         # minimum drain: requests already dispatched to the replica may
         # still be in its inbox (inflight not yet incremented)
-        self._draining.append((replica, deadline, now + 0.5))
+        with self._lock:
+            if self._stop:
+                # shutdown already swept _draining; kill directly
+                try:
+                    ray_tpu.kill(replica)
+                except Exception:  # noqa: BLE001
+                    pass
+                return
+            self._draining.append((replica, deadline, now + 0.5))
 
     def _reap_drained(self) -> None:
-        if not self._draining:
+        with self._lock:
+            draining = list(self._draining)
+        if not draining:
             return
         still: List[Tuple[Any, float, float]] = []
-        for replica, deadline, not_before in self._draining:
+        for replica, deadline, not_before in draining:
             now = time.monotonic()
             if now < not_before:
                 still.append((replica, deadline, not_before))
@@ -281,7 +294,9 @@ class ServeController:
                     pass
             else:
                 still.append((replica, deadline, not_before))
-        self._draining = still
+        with self._lock:
+            if not self._stop:
+                self._draining = still
 
     def _autoscaled_target(self, dep: Dict[str, Any],
                            config: DeploymentConfig) -> int:

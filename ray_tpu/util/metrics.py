@@ -1,0 +1,175 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Parity: reference ``python/ray/util/metrics.py`` — the same three
+classes and tag semantics, flowing through the same pipeline the C++
+runtime metrics use (``src/ray/stats/`` → node agent →
+Prometheus): here each process's registry flushes deltas to the GCS
+metrics table, and the dashboard exports Prometheus text from it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name required")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        # per-tagset state; counters accumulate deltas since last flush
+        self._values: Dict[Tuple, float] = {}
+        with _registry_lock:
+            _registry.append(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        extra = set(out) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"unknown tag keys {sorted(extra)} for "
+                             f"metric {self.name!r} (declared "
+                             f"{self.tag_keys})")
+        return out
+
+    def _flush(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def _flush(self):
+        with self._lock:
+            out = [{"name": self.name, "type": self.TYPE,
+                    "description": self.description,
+                    "tags": dict(k), "value": v}
+                   for k, v in self._values.items() if v]
+            self._values.clear()
+        return out
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _flush(self):
+        with self._lock:
+            return [{"name": self.name, "type": self.TYPE,
+                     "description": self.description,
+                     "tags": dict(k), "value": v}
+                    for k, v in self._values.items()]
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                                  0.5, 1, 2.5, 5, 10])
+        self._buckets: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            buckets = self._buckets.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            buckets[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def _flush(self):
+        with self._lock:
+            out = [{"name": self.name, "type": self.TYPE,
+                    "description": self.description,
+                    "tags": dict(k), "buckets": list(b),
+                    "boundaries": self.boundaries,
+                    "sum": self._sums.get(k, 0.0),
+                    "count": self._counts.get(k, 0)}
+                   for k, b in self._buckets.items()]
+            self._buckets.clear()
+            self._sums.clear()
+            self._counts.clear()
+        return out
+
+
+def flush_all() -> List[Dict[str, Any]]:
+    """Collect pending records from every metric in this process."""
+    with _registry_lock:
+        metrics = list(_registry)
+    out: List[Dict[str, Any]] = []
+    for m in metrics:
+        out.extend(m._flush())
+    return out
+
+
+_flusher_started = False
+
+
+def start_flusher(period_s: float = 5.0) -> None:
+    """Push this process's metrics to the GCS periodically (parity: the
+    per-node MetricsAgent pipeline, metrics_agent.py:374)."""
+    global _flusher_started
+    if _flusher_started:
+        return
+    _flusher_started = True
+
+    def loop():
+        from ray_tpu.core import worker as worker_mod
+        while True:
+            time.sleep(period_s)
+            try:
+                core = worker_mod.global_worker_or_none()
+                if core is None:
+                    continue
+                records = flush_all()
+                if records:
+                    core.gcs_call("report_metrics", {"records": records})
+            except Exception:
+                pass
+
+    threading.Thread(target=loop, name="metrics-flusher",
+                     daemon=True).start()

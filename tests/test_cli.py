@@ -1,0 +1,63 @@
+"""CLI tests (parity model: reference python/ray/tests/test_cli.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(*argv, env_extra=None, timeout=120):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *argv],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO)
+
+
+@pytest.fixture
+def cli_cluster(tmp_path):
+    """A head started via the CLI, torn down via the CLI."""
+    root = str(tmp_path / "sessions")
+    os.makedirs(root, exist_ok=True)
+    env = {"RAY_TPU_SESSION_ROOT": root}
+    out = _run("start", "--head", "--num-cpus", "2", env_extra=env)
+    assert out.returncode == 0, out.stderr
+    addr = [ln for ln in out.stdout.splitlines()
+            if "GCS address" in ln][0].split(": ")[1]
+    yield addr, env
+    _run("stop", env_extra=env)
+
+
+def test_cli_start_status_list_stop(cli_cluster):
+    addr, env = cli_cluster
+    out = _run("status", "--address", addr, env_extra=env)
+    assert out.returncode == 0, out.stderr
+    assert "alive" in out.stdout and "CPU" in out.stdout
+
+    out = _run("list", "nodes", "--address", addr, env_extra=env)
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    assert rows and rows[0]["state"] == "ALIVE"
+
+    # default address resolution via latest_head.json
+    out = _run("list", "actors", env_extra=env)
+    assert out.returncode == 0, out.stderr
+
+    out = _run("stop", env_extra=env)
+    assert out.returncode == 0, out.stderr
+    assert "SIGTERM" in out.stdout or "already gone" in out.stdout
+
+
+def test_cli_memory_and_summary(cli_cluster):
+    addr, env = cli_cluster
+    out = _run("memory", "--address", addr, env_extra=env)
+    assert out.returncode == 0, out.stderr
+    assert "bytes" in out.stdout
+    out = _run("summary", "tasks", "--address", addr, env_extra=env)
+    assert out.returncode == 0, out.stderr

@@ -1,0 +1,131 @@
+"""State API / dashboard / job submission / metrics tests (parity
+model: reference python/ray/tests/test_state_api.py,
+dashboard/modules/job/tests, python/ray/tests/test_metrics_agent.py)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental.state import api as state
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+@ray_tpu.remote
+def quick(x):
+    return x + 1
+
+
+@ray_tpu.remote
+class Named:
+    def ping(self):
+        return "pong"
+
+
+def test_list_tasks_and_summary():
+    ray_tpu.get([quick.remote(i) for i in range(5)], timeout=60)
+    time.sleep(1.5)  # task event flush period
+    rows = state.list_tasks()
+    mine = [r for r in rows if "quick" in r["name"]]
+    assert len(mine) >= 5
+    assert all(r["state"] == "FINISHED" for r in mine)
+    summary = state.summarize_tasks()
+    name = next(k for k in summary if "quick" in k)
+    assert summary[name]["FINISHED"] >= 5
+
+
+def test_list_actors_nodes_workers():
+    a = Named.options(name="state-test-actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    actors = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert any(r.get("name") == "state-test-actor" for r in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["state"] == "ALIVE"
+    workers = state.list_workers()
+    assert any(w["is_actor"] for w in workers)
+
+
+def test_list_objects_and_store_stats():
+    refs = [ray_tpu.put(bytes(2_000_000)) for _ in range(3)]
+    objs = state.list_objects()
+    assert len(objs) >= 3
+    stats = state.object_store_stats()
+    assert stats and stats[0]["used"] > 0
+    del refs
+
+
+def test_timeline_chrome_trace(tmp_path):
+    ray_tpu.get([quick.remote(i) for i in range(3)], timeout=60)
+    time.sleep(1.5)
+    path = tmp_path / "trace.json"
+    events = ray_tpu.timeline(str(path))
+    assert any("quick" in e["name"] for e in events)
+    loaded = json.loads(path.read_text())
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in loaded)
+
+
+def test_metrics_pipeline():
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests", "test counter",
+                        tag_keys=("route",))
+    c.inc(3.0, tags={"route": "/a"})
+    g = metrics.Gauge("test_inflight", tag_keys=())
+    g.set(7.0)
+    h = metrics.Histogram("test_latency", boundaries=[0.1, 1.0],
+                          tag_keys=())
+    h.observe(0.05)
+    h.observe(5.0)
+    core = ray_tpu.get_runtime_context()  # ensure initialized
+    from ray_tpu.core import worker as worker_mod
+    worker_mod.global_worker().gcs_call(
+        "report_metrics", {"records": metrics.flush_all()})
+    records = worker_mod.global_worker().gcs_call("get_metrics", {})
+    by_name = {r["name"]: r for r in records}
+    assert by_name["test_requests"]["value"] == 3.0
+    assert by_name["test_inflight"]["value"] == 7.0
+    assert by_name["test_latency"]["count"] == 2
+    assert by_name["test_latency"]["buckets"] == [1, 0, 1]
+
+
+def test_dashboard_and_job_submission(tmp_path):
+    from ray_tpu.dashboard import Dashboard
+    from ray_tpu.job import JobSubmissionClient
+
+    dash = Dashboard(port=0)
+    url = dash.start()
+    try:
+        with urllib.request.urlopen(url + "/api/nodes", timeout=30) as r:
+            nodes = json.loads(r.read())
+        assert nodes and nodes[0]["state"] == "ALIVE"
+        with urllib.request.urlopen(url + "/api/cluster_status",
+                                    timeout=30) as r:
+            status = json.loads(r.read())
+        assert status["cluster_resources"].get("CPU", 0) > 0
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "# TYPE" in text or text.strip() == ""
+
+        client = JobSubmissionClient(url)
+        script = tmp_path / "job.py"
+        script.write_text(
+            "import ray_tpu\n"
+            "ray_tpu.init()\n"
+            "@ray_tpu.remote\n"
+            "def f():\n"
+            "    return 40 + 2\n"
+            "print('answer:', ray_tpu.get(f.remote()))\n")
+        sid = client.submit_job(
+            entrypoint=f"python {script}",
+            runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}})
+        final = client.wait_until_finished(sid, timeout=120)
+        logs = client.get_job_logs(sid)
+        assert final == "SUCCEEDED", logs
+        assert "answer: 42" in logs
+        jobs = client.list_jobs()
+        assert any(j["submission_id"] == sid for j in jobs)
+    finally:
+        dash.stop()
