@@ -180,6 +180,10 @@ class ActorClass:
             lifetime_detached=opts.get("lifetime") == "detached",
             max_concurrency=int(opts.get("max_concurrency", 1)),
         )
+        renv = opts.get("runtime_env")
+        if renv:
+            from ray_tpu import runtime_env as renv_mod
+            renv = renv_mod.package(renv_mod.validate(renv), core.kv_put)
         actor_id = core.create_actor(
             class_id,
             self._descriptor,
@@ -190,6 +194,7 @@ class ActorClass:
             scheduling_strategy=_resolve_strategy(
                 opts.get("scheduling_strategy")),
             get_if_exists=bool(opts.get("get_if_exists", False)),
+            runtime_env=renv,
         )
         return ActorHandle(actor_id, self._descriptor,
                            max_task_retries=creation.max_task_retries,

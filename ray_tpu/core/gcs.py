@@ -69,6 +69,7 @@ class ActorInfo:
     # gang binding: schedule onto this group's bundle, charged to it
     pg_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
+    env_hash: Optional[str] = None
 
 
 @dataclass
@@ -402,6 +403,7 @@ class GcsServer:
             pg_id=PlacementGroupID(data["placement_group_id"])
             if data.get("placement_group_id") else None,
             bundle_index=data.get("bundle_index", -1),
+            env_hash=data.get("env_hash"),
         )
         self.actors[actor_id] = info
         asyncio.get_running_loop().create_task(self._schedule_actor(info))
@@ -475,7 +477,8 @@ class GcsServer:
                          "spec_blob": info.creation_spec_blob,
                          "placement_group_id":
                              info.pg_id.binary() if info.pg_id else None,
-                         "bundle_index": info.bundle_index},
+                         "bundle_index": info.bundle_index,
+                         "env_hash": info.env_hash},
                         timeout=60.0,
                     )
                 except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError) as e:

@@ -54,6 +54,9 @@ class WorkerHandle:
     # whether this process kept the host's accelerator plugin env (slow to
     # import); plain pool workers strip it for fast startup
     tpu_capable: bool = True
+    # runtime env this worker has applied (workers are env-dedicated once
+    # an env lands on them; parity: runtime-env-keyed WorkerPool)
+    env_hash: "Optional[str]" = None
     # lease state
     leased: bool = False
     lease_resources: Dict[str, float] = field(default_factory=dict)
@@ -68,6 +71,7 @@ class PendingLease:
     job_id_bin: Optional[bytes]
     resources: Dict[str, float]
     bundle: Optional[Tuple[bytes, int]]
+    env_hash: Optional[str] = None
     enqueued_at: float = field(default_factory=time.monotonic)
 
 
@@ -390,7 +394,8 @@ class Raylet:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending_leases.append(PendingLease(
             request=data, future=fut, job_id_bin=job_id_bin,
-            resources=resources, bundle=bundle))
+            resources=resources, bundle=bundle,
+            env_hash=data.get("env_hash")))
         self._maybe_schedule()
         return await fut
 
@@ -472,7 +477,8 @@ class Raylet:
                 remaining.append(lease)
                 continue
             needs_tpu = lease.resources.get("TPU", 0) > 0
-            worker = self._pop_idle(lease.job_id_bin, needs_tpu)
+            worker = self._pop_idle(lease.job_id_bin, needs_tpu,
+                                    lease.env_hash)
             if worker is None:
                 remaining.append(lease)
                 want_workers.append((lease.job_id_bin, needs_tpu))
@@ -481,6 +487,8 @@ class Raylet:
             worker.leased = True
             worker.lease_resources = lease.resources
             worker.lease_bundle = lease.bundle
+            if lease.env_hash is not None:
+                worker.env_hash = lease.env_hash
             lease.future.set_result({
                 "granted": True,
                 "worker_address": worker.task_address,
@@ -494,14 +502,28 @@ class Raylet:
             self._start_worker(job_id_bin, needs_tpu)
 
     def _pop_idle(self, job_id_bin: Optional[bytes],
-                  needs_tpu: bool = False) -> Optional[WorkerHandle]:
+                  needs_tpu: bool = False,
+                  env_hash: Optional[str] = None
+                  ) -> Optional[WorkerHandle]:
         # job-dedicated workers: a worker that has loaded job code serves
-        # only that job (parity: WorkerPool per-job isolation)
-        for i, w in enumerate(self._idle):
+        # only that job (parity: WorkerPool per-job isolation); likewise a
+        # worker that applied a runtime env serves only that env, and
+        # env-tasks never land on differently-polluted workers.  Two
+        # passes: exact env match first, then pristine workers.
+        def eligible(w, want_env):
             if needs_tpu and not w.tpu_capable:
-                continue
-            if w.job_id_bin is None or job_id_bin is None or \
-                    w.job_id_bin == job_id_bin:
+                return False
+            if w.env_hash != want_env:
+                return False
+            return w.job_id_bin is None or job_id_bin is None or \
+                w.job_id_bin == job_id_bin
+
+        if env_hash is not None:
+            for i, w in enumerate(self._idle):
+                if eligible(w, env_hash):
+                    return self._idle.pop(i)
+        for i, w in enumerate(self._idle):
+            if eligible(w, None):
                 return self._idle.pop(i)
         return None
 
@@ -536,6 +558,7 @@ class Raylet:
             "placement_group_id": data.get("placement_group_id"),
             "bundle_index": data.get("bundle_index", -1),
             "strategy": "DEFAULT",
+            "env_hash": data.get("env_hash"),
         })
         if not reply.get("granted"):
             return {"granted": False, "reason": str(reply)}

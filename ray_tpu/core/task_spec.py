@@ -74,12 +74,15 @@ class TaskSpec:
     sequence_number: int = 0
     # Name of the concurrency group for async actors ("" = default).
     concurrency_group: str = ""
+    # Runtime environment (env_vars/working_dir/py_modules, packaged) —
+    # part of the scheduling key: workers are dedicated per env.
+    runtime_env: Optional[Dict[str, Any]] = None
+    runtime_env_hash: Optional[str] = None
     # Attempt counter (incremented on retries) — return object IDs stay
     # stable across attempts, matching the reference's semantics.
     attempt_number: int = 0
     # Depth in the lineage tree (driver = 0), bounds reconstruction.
     depth: int = 0
-    runtime_env: Optional[Dict[str, Any]] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [
@@ -98,6 +101,7 @@ class TaskSpec:
             strat.node_id_hex,
             strat.placement_group_id,
             strat.bundle_index,
+            self.runtime_env_hash,
         )
 
     def debug_name(self) -> str:

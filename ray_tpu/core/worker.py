@@ -76,6 +76,13 @@ logger = logging.getLogger(__name__)
 
 PLASMA_MARKER = b"__RTPU_IN_PLASMA__"
 
+
+def _renv_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
+    if not runtime_env:
+        return None
+    from ray_tpu.runtime_env import env_hash
+    return env_hash(runtime_env)
+
 _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
 
@@ -700,6 +707,7 @@ class CoreWorker:
                     max_retries: Optional[int] = None,
                     retry_exceptions: bool = False,
                     scheduling_strategy: Optional[SchedulingStrategy] = None,
+                    runtime_env: Optional[Dict[str, Any]] = None,
                     ) -> List[ObjectRef]:
         task_id = TaskID.for_normal_task(self.job_id)
         task_args, holds = self._build_args(args, kwargs)
@@ -718,6 +726,8 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy or SchedulingStrategy(),
             owner_address=self.address,
             depth=self._ctx.attempt_number,
+            runtime_env=runtime_env,
+            runtime_env_hash=_renv_hash(runtime_env),
         )
         self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
@@ -834,6 +844,7 @@ class CoreWorker:
                     if strat.placement_group_id else None,
                 "bundle_index": strat.bundle_index,
                 "backlog": len(state.backlog),
+                "env_hash": spec.runtime_env_hash,
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             self._fail_backlog(state, WorkerCrashedError(
@@ -950,7 +961,8 @@ class CoreWorker:
                      kwargs: dict, *, resources: Dict[str, float],
                      creation_spec: ActorCreationSpec,
                      scheduling_strategy: Optional[SchedulingStrategy] = None,
-                     get_if_exists: bool = False) -> ActorID:
+                     get_if_exists: bool = False,
+                     runtime_env: Optional[Dict[str, Any]] = None) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         task_id = TaskID.for_actor_task(actor_id)
         task_args, holds = self._build_args(args, kwargs)
@@ -966,6 +978,8 @@ class CoreWorker:
             actor_id=actor_id,
             actor_creation_spec=creation_spec,
             scheduling_strategy=scheduling_strategy or SchedulingStrategy(),
+            runtime_env=runtime_env,
+            runtime_env_hash=_renv_hash(runtime_env),
         )
         strat = spec.scheduling_strategy
         reply = self._run(self.gcs_conn.call("register_actor", {
@@ -983,6 +997,7 @@ class CoreWorker:
                 strat.placement_group_id.binary()
                 if strat.placement_group_id else None,
             "bundle_index": strat.bundle_index,
+            "env_hash": spec.runtime_env_hash,
         }))
         # pin creation args for the actor's lifetime (restarts re-run the
         # creation task and need them)
@@ -1347,6 +1362,7 @@ class CoreWorker:
             self.job_id = spec.job_id
         try:
             self._apply_job_syspath(spec.job_id)
+            self._ensure_runtime_env(spec)
             args, kwargs = self._resolve_args(spec)
             fn = self._resolve_callable(spec)
             value = fn(*args, **kwargs)
@@ -1429,6 +1445,17 @@ class CoreWorker:
                 return None
             return _construct
         return fn_or_class
+
+    def _ensure_runtime_env(self, spec: TaskSpec) -> None:
+        if not spec.runtime_env:
+            return
+        mgr = getattr(self, "_runtime_env_mgr", None)
+        if mgr is None:
+            from ray_tpu.runtime_env import RuntimeEnvManager
+            mgr = RuntimeEnvManager(
+                lambda key, ns: self.kv_get(key, namespace=ns))
+            self._runtime_env_mgr = mgr
+        mgr.ensure_applied(spec.runtime_env)
 
     def _apply_job_syspath(self, job_id: Optional[JobID]) -> None:
         """Merge the driver's import paths into this worker (parity: the

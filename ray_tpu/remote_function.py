@@ -54,6 +54,7 @@ class RemoteFunction:
         self._descriptor = f"{fn.__module__}.{fn.__qualname__}"
         self._function_id: Optional[str] = None
         self._pickled: Optional[bytes] = None
+        self._packaged_env: Optional[Dict[str, Any]] = None
         self._export_lock = threading.Lock()
         self.__name__ = getattr(fn, "__name__", "remote_function")
         self.__doc__ = fn.__doc__
@@ -115,5 +116,16 @@ class RemoteFunction:
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             scheduling_strategy=_resolve_strategy(
                 opts.get("scheduling_strategy")),
+            runtime_env=self._packaged_runtime_env(core),
         )
         return refs[0] if num_returns == 1 else refs
+
+    def _packaged_runtime_env(self, core) -> Optional[Dict[str, Any]]:
+        renv = self._options.get("runtime_env")
+        if not renv:
+            return None
+        if self._packaged_env is None:
+            from ray_tpu import runtime_env as renv_mod
+            self._packaged_env = renv_mod.package(
+                renv_mod.validate(renv), core.kv_put)
+        return self._packaged_env

@@ -1,0 +1,182 @@
+"""Per-task/actor runtime environments.
+
+Parity: reference ``python/ray/_private/runtime_env/`` — the
+``runtime_env={"env_vars", "working_dir", "py_modules"}`` option on
+``@remote`` functions/actors, with content-addressed packaging
+(``packaging.py`` URI cache): the driver zips ``working_dir`` /
+``py_modules`` into the GCS KV keyed by content hash, and each worker
+extracts once into a per-host cache before applying.
+
+``pip``/``conda`` isolation requires spawning interpreters into built
+environments; this deployment forbids package installation, so those
+keys raise immediately instead of failing later (the plug point is
+``ensure_applied``).  Env semantics match the reference's dedicated
+workers: applying an env marks the worker, and the raylet routes tasks
+of other envs to other workers (env hash is part of the lease, like the
+reference's runtime-env-keyed WorkerPool).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_CACHE_ROOT = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                           "ray_tpu_runtime_env_cache")
+
+SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+UNSUPPORTED = {"pip", "conda", "container"}
+
+
+def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    if not runtime_env:
+        return {}
+    bad = set(runtime_env) & UNSUPPORTED
+    if bad:
+        raise ValueError(
+            f"runtime_env keys {sorted(bad)} are unsupported here: this "
+            f"deployment forbids package installation (bake dependencies "
+            f"into the image; see SURVEY note)")
+    unknown = set(runtime_env) - SUPPORTED
+    if unknown:
+        raise ValueError(f"unknown runtime_env keys {sorted(unknown)} "
+                         f"(supported: {sorted(SUPPORTED)})")
+    return dict(runtime_env)
+
+
+def env_hash(runtime_env: Dict[str, Any]) -> str:
+    """Stable identity for worker dedication + caching."""
+    return hashlib.sha256(
+        json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _walk_files(path: str):
+    out = []
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        if "__pycache__" in root:
+            continue
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            out.append((os.path.relpath(full, path), full))
+    return out
+
+
+def _content_digest(entries) -> str:
+    """Digest of (relpath, file bytes) pairs — stable across mtimes and
+    filesystem walk order, unlike hashing the zip bytes."""
+    h = hashlib.sha256()
+    for rel, full in entries:
+        h.update(rel.encode())
+        with open(full, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _zip_entries(entries, arc_prefix: str = "") -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for rel, full in entries:
+            zf.write(full, os.path.join(arc_prefix, rel))
+    return buf.getvalue()
+
+
+# packaged form cached per env content so repeated .remote() calls (e.g.
+# an actor class instantiated in a loop) zip + upload once
+_package_cache: Dict[str, Dict[str, Any]] = {}
+
+
+def package(runtime_env: Dict[str, Any], kv_put) -> Dict[str, Any]:
+    """Driver side: replace local dirs with content-addressed URIs
+    (reference ``upload_package_if_needed``)."""
+    cache_key = env_hash(runtime_env)
+    hit = _package_cache.get(cache_key)
+    if hit is not None:
+        return hit
+    out = dict(runtime_env)
+    if "working_dir" in out and not str(out["working_dir"]).startswith(
+            "kv://"):
+        entries = _walk_files(out["working_dir"])
+        digest = _content_digest(entries)
+        kv_put(f"pkg:{digest}", _zip_entries(entries), "_runtime_env")
+        out["working_dir"] = f"kv://{digest}"
+    if "py_modules" in out:
+        uris: List[str] = []
+        for mod in out["py_modules"]:
+            if str(mod).startswith("kv://"):
+                uris.append(mod)
+                continue
+            # a module dir is zipped with its top-level name preserved so
+            # the extraction root can go on sys.path
+            base = os.path.basename(os.path.abspath(mod))
+            entries = _walk_files(mod)
+            digest = _content_digest([(os.path.join(base, r), f)
+                                      for r, f in entries])
+            kv_put(f"pkg:{digest}", _zip_entries(entries, base),
+                   "_runtime_env")
+            uris.append(f"kv://{digest}")
+        out["py_modules"] = uris
+    _package_cache[cache_key] = out
+    return out
+
+
+def _extract(uri: str, kv_get) -> str:
+    digest = uri[len("kv://"):]
+    dest = os.path.join(_CACHE_ROOT, digest)
+    if not os.path.isdir(dest):
+        blob = kv_get(f"pkg:{digest}", "_runtime_env")
+        if blob is None:
+            raise RuntimeError(f"runtime env package {uri} missing from KV")
+        # extract to a private temp dir, then atomically rename into
+        # place: concurrent workers never observe half-written files
+        os.makedirs(_CACHE_ROOT, exist_ok=True)
+        tmp = tempfile.mkdtemp(prefix=f".{digest}-", dir=_CACHE_ROOT)
+        try:
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(tmp)
+            os.rename(tmp, dest)
+        except OSError:
+            # another worker won the rename race
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.isdir(dest):
+                raise
+    return dest
+
+
+class RuntimeEnvManager:
+    """Worker side: apply envs once per (env, process).
+
+    Parity: the runtime-env agent's ``CreateRuntimeEnv`` +
+    ``RuntimeEnvManager`` URI bookkeeping, collapsed into the worker
+    (no separate agent process — extraction is cheap and cached)."""
+
+    def __init__(self, kv_get):
+        self._kv_get = kv_get
+        self._applied: set = set()
+
+    def ensure_applied(self, runtime_env: Optional[Dict[str, Any]]) -> None:
+        if not runtime_env:
+            return
+        key = env_hash(runtime_env)
+        if key in self._applied:
+            return
+        for k, v in runtime_env.get("env_vars", {}).items():
+            os.environ[str(k)] = str(v)
+        for uri in runtime_env.get("py_modules", []):
+            root = _extract(uri, self._kv_get)
+            if root not in sys.path:
+                sys.path.insert(0, root)
+        wd = runtime_env.get("working_dir")
+        if wd:
+            root = _extract(wd, self._kv_get)
+            os.chdir(root)
+            if root not in sys.path:
+                sys.path.insert(0, root)
+        self._applied.add(key)

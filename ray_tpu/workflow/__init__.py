@@ -1,0 +1,16 @@
+"""Durable workflows (reference ``python/ray/workflow/``)."""
+
+from ray_tpu.workflow.workflow import (  # noqa: F401
+    FAILED,
+    RESUMABLE,
+    RUNNING,
+    SUCCEEDED,
+    WorkflowStorage,
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+)

@@ -1,0 +1,243 @@
+"""Durable DAG execution.
+
+Parity: reference ``python/ray/workflow/`` — ``workflow.run`` executes a
+task DAG with every step's output persisted
+(``WorkflowStorage``:229, ``workflow_storage.py``), so a crashed or
+interrupted workflow resumes (``workflow.resume``) by replaying only the
+steps whose outputs are not yet on disk; observable outputs are
+exactly-once (steps themselves are at-least-once, same contract as the
+reference).  DAG structure comes from ``ray_tpu.dag``
+(``workflow_state_from_dag.py`` analog).
+
+Step identity is positional: a deterministic DFS numbering of the DAG,
+qualified by the function name — stable across runs of the same
+program, which is what resume correctness needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag.dag_node import (ClassMethodNode, ClassNode, DAGNode,
+                                  FunctionNode, InputAttributeNode,
+                                  InputNode)
+
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+RESUMABLE = "RESUMABLE"
+
+_storage_dir: Optional[str] = None
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the workflow storage root (reference ``workflow.init``)."""
+    global _storage_dir
+    _storage_dir = storage or _storage_dir or os.path.join(
+        os.path.expanduser("~"), ".ray_tpu_workflows")
+    os.makedirs(_storage_dir, exist_ok=True)
+
+
+def _root() -> str:
+    if _storage_dir is None:
+        init()
+    return _storage_dir
+
+
+class WorkflowStorage:
+    """Filesystem step-output store (reference ``WorkflowStorage``:229).
+
+    Writes are atomic (tmp + rename) so a crash can't leave a partial
+    output that later reads as completed.
+    """
+
+    def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(_root(), workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self._step_path(step_id), "rb") as f:
+            return cloudpickle.load(f)
+
+    def save_step(self, step_id: str, value: Any) -> None:
+        path = self._step_path(step_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, path)
+
+    # -- workflow metadata ---------------------------------------------
+    def save_meta(self, meta: Dict[str, Any]) -> None:
+        path = os.path.join(self.dir, "meta.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
+    def load_meta(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self.dir, "meta.json")) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def save_dag(self, dag: DAGNode, args: tuple, kwargs: dict) -> None:
+        path = os.path.join(self.dir, "dag.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump((dag, args, kwargs), f)
+        os.replace(tmp, path)
+
+    def load_dag(self):
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def delete(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def _assign_step_ids(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic DFS numbering -> '<index>_<fn_name>'."""
+    ids: Dict[int, str] = {}
+    counter = [0]
+
+    def visit(node: Any) -> None:
+        if not isinstance(node, DAGNode) or id(node) in ids:
+            return
+        # children first so ids follow dependency order
+        for a in list(node._bound_args) + list(node._bound_kwargs.values()):
+            walk(a)
+        if isinstance(node, ClassMethodNode):
+            visit(node._class_node)
+        if isinstance(node, FunctionNode):
+            name = getattr(node._remote_fn, "__name__", "step")
+        elif isinstance(node, ClassMethodNode):
+            name = node._method_name
+        else:
+            name = type(node).__name__
+        ids[id(node)] = f"{counter[0]:04d}_{name}"
+        counter[0] += 1
+
+    def walk(v: Any) -> None:
+        if isinstance(v, DAGNode):
+            visit(v)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                walk(x)
+        elif isinstance(v, dict):
+            for x in v.values():
+                walk(x)
+
+    visit(dag)
+    return ids
+
+
+class _DurableContext:
+    """DAG executor with per-step persistence (memoized like
+    dag._ExecContext, plus storage read-through/write-back)."""
+
+    def __init__(self, storage: WorkflowStorage, step_ids: Dict[int, str],
+                 input_args: tuple, input_kwargs: dict):
+        self.storage = storage
+        self.step_ids = step_ids
+        self.input_args = input_args
+        self.input_kwargs = input_kwargs
+        self._results: Dict[int, Any] = {}
+
+    def result_of(self, node: DAGNode):
+        key = id(node)
+        if key in self._results:
+            return self._results[key]
+        step_id = self.step_ids.get(key)
+        durable = isinstance(node, (FunctionNode, ClassMethodNode)) \
+            and step_id is not None
+        if durable and self.storage.has_step(step_id):
+            value = self.storage.load_step(step_id)
+        else:
+            out = node._execute_impl(self)
+            value = ray_tpu.get(out) if isinstance(
+                out, ray_tpu.ObjectRef) else out
+            if durable:
+                self.storage.save_step(step_id, value)
+        self._results[key] = value
+        return value
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        **kwargs) -> Any:
+    """Execute the DAG durably; returns the terminal value (reference
+    ``workflow.run``)."""
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1000)}"
+    storage = WorkflowStorage(workflow_id)
+    if storage.load_meta() is not None:
+        raise ValueError(
+            f"workflow {workflow_id!r} already exists; use resume() to "
+            f"continue it or delete() to discard it (reference raises on "
+            f"duplicate workflow ids too)")
+    storage.save_dag(dag, args, kwargs)
+    return _drive(storage, dag, args, kwargs)
+
+
+def _drive(storage: WorkflowStorage, dag: DAGNode, args: tuple,
+           kwargs: dict) -> Any:
+    storage.save_meta({"status": RUNNING, "start_time": time.time()})
+    step_ids = _assign_step_ids(dag)
+    ctx = _DurableContext(storage, step_ids, args, kwargs)
+    try:
+        result = ctx.result_of(dag)
+    except Exception as e:
+        storage.save_meta({"status": RESUMABLE, "error": repr(e),
+                           "time": time.time()})
+        raise
+    storage.save_step("__output__", result)
+    storage.save_meta({"status": SUCCEEDED, "time": time.time()})
+    return result
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-drive a workflow; completed steps load from storage
+    (reference ``workflow.resume``)."""
+    storage = WorkflowStorage(workflow_id)
+    dag, args, kwargs = storage.load_dag()
+    return _drive(storage, dag, args, kwargs)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    meta = WorkflowStorage(workflow_id).load_meta()
+    return meta["status"] if meta else None
+
+
+def get_output(workflow_id: str) -> Any:
+    storage = WorkflowStorage(workflow_id)
+    if not storage.has_step("__output__"):
+        raise ValueError(f"workflow {workflow_id!r} has no output "
+                         f"(status: {get_status(workflow_id)})")
+    return storage.load_step("__output__")
+
+
+def list_all() -> List[Dict[str, Any]]:
+    out = []
+    root = _root()
+    for wid in sorted(os.listdir(root)):
+        meta = WorkflowStorage(wid).load_meta()
+        if meta is not None:
+            out.append({"workflow_id": wid, **meta})
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    WorkflowStorage(workflow_id).delete()

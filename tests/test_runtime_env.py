@@ -1,0 +1,78 @@
+"""Runtime env tests (parity model: reference
+python/ray/tests/test_runtime_env*.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+def test_env_vars():
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "hello"}})
+    def read_env():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "hello"
+
+
+def test_env_vars_do_not_leak_to_plain_tasks():
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_LEAK_TEST": "set"}})
+    def with_env():
+        return os.environ.get("RTPU_LEAK_TEST")
+
+    @ray_tpu.remote
+    def without_env():
+        return os.environ.get("RTPU_LEAK_TEST")
+
+    assert ray_tpu.get(with_env.remote(), timeout=60) == "set"
+    # env-dedicated workers: the plain task must not see the env var
+    assert ray_tpu.get(without_env.remote(), timeout=60) is None
+
+
+def test_py_modules(tmp_path):
+    mod = tmp_path / "my_test_module"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("MAGIC = 1234\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def import_it():
+        import my_test_module
+        return my_test_module.MAGIC
+
+    assert ray_tpu.get(import_it.remote(), timeout=60) == 1234
+
+
+def test_working_dir(tmp_path):
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-42")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_file.remote(), timeout=60) == "payload-42"
+
+
+def test_actor_runtime_env():
+    @ray_tpu.remote
+    class EnvActor:
+        def read(self):
+            return os.environ.get("RTPU_ACTOR_ENV")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"RTPU_ACTOR_ENV": "actor-env"}}).remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "actor-env"
+
+
+def test_unsupported_keys_rejected():
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="unsupported"):
+        f.remote()

@@ -1,0 +1,94 @@
+"""Workflow tests (parity model: reference python/ray/workflow/tests/
+test_basic_workflows.py, test_recovery.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+@pytest.fixture(autouse=True)
+def wf_storage(tmp_path):
+    workflow.init(str(tmp_path / "wf"))
+    yield
+
+
+@ray_tpu.remote
+def double(x):
+    return x * 2
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+def test_run_and_output():
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 1)
+    assert workflow.run(dag, 5, workflow_id="w1") == 11
+    assert workflow.get_status("w1") == workflow.SUCCEEDED
+    assert workflow.get_output("w1") == 11
+    rows = workflow.list_all()
+    assert any(r["workflow_id"] == "w1" for r in rows)
+
+
+def test_resume_skips_completed_steps():
+    calls = {"n": 0}
+
+    @ray_tpu.remote
+    def count_calls(x):
+        import os
+        # count via filesystem (steps run in other processes)
+        path = "/tmp/_wf_count_test"
+        with open(path, "a") as f:
+            f.write("x")
+        return x + 1
+
+    @ray_tpu.remote
+    def flaky(x):
+        import os
+        if not os.path.exists("/tmp/_wf_flaky_ok"):
+            raise RuntimeError("first attempt fails")
+        return x * 10
+
+    import os
+    for p in ("/tmp/_wf_count_test", "/tmp/_wf_flaky_ok"):
+        if os.path.exists(p):
+            os.remove(p)
+
+    dag = flaky.bind(count_calls.bind(3))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == workflow.RESUMABLE
+    # count_calls ran exactly once
+    assert os.path.getsize("/tmp/_wf_count_test") == 1
+
+    open("/tmp/_wf_flaky_ok", "w").close()
+    assert workflow.resume("w2") == 40
+    # resume did NOT re-run the completed first step
+    assert os.path.getsize("/tmp/_wf_count_test") == 1
+    assert workflow.get_status("w2") == workflow.SUCCEEDED
+    for p in ("/tmp/_wf_count_test", "/tmp/_wf_flaky_ok"):
+        os.remove(p)
+
+
+def test_diamond_runs_once_and_persists():
+    with InputNode() as inp:
+        shared = double.bind(inp)
+        dag = add.bind(shared, shared)
+    assert workflow.run(dag, 4, workflow_id="w3") == 16
+    # both steps persisted
+    storage = workflow.WorkflowStorage("w3")
+    assert storage.has_step("0001_double")
+    assert storage.has_step("0002_add")
+
+
+def test_delete():
+    dag = double.bind(1)
+    workflow.run(dag, workflow_id="w4")
+    workflow.delete("w4")
+    assert workflow.get_status("w4") is None
