@@ -5,6 +5,7 @@ and execution model.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import ActorPoolStrategy, Dataset, GroupedDataset  # noqa: F401
 from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
 from ray_tpu.data.read_api import (  # noqa: F401
@@ -19,5 +20,6 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_json,
     read_numpy,
     read_parquet,
+    read_text,
 )
 from ray_tpu.data import preprocessors  # noqa: F401

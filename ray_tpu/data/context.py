@@ -1,0 +1,33 @@
+"""Dataset execution context.
+
+Parity: reference ``python/ray/data/context.py`` — a per-driver
+singleton of execution knobs; the subset that changes behavior here is
+the shuffle strategy selection (``use_push_based_shuffle``, reference
+``DatasetContext.use_push_based_shuffle``) and merge factor.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class DataContext:
+    _instance: Optional["DataContext"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        #: two-stage pipelined shuffle (reference push_based_shuffle.py)
+        #: instead of the all-to-all pull shuffle
+        self.use_push_based_shuffle = False
+        #: mapper outputs merged in groups of this size per round
+        self.push_based_shuffle_merge_factor = 2
+        #: rows per batch when iterating without an explicit batch_size
+        self.target_batch_size = 256
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DataContext()
+            return cls._instance

@@ -80,6 +80,13 @@ def _shuffle_reduce(seed: Optional[int], *parts: Block) -> Block:
 
 
 @ray_tpu.remote
+def _shuffle_merge(*parts: Block) -> Block:
+    """Intermediate merge of one round's mapper outputs for one reducer
+    (parity: the merge stage of push_based_shuffle.py:330)."""
+    return concat_blocks(list(parts))
+
+
+@ray_tpu.remote
 def _sort_sample(block: Block, key) -> np.ndarray:
     acc = BlockAccessor(block)
     if acc.num_rows() == 0:
@@ -310,7 +317,12 @@ class Dataset:
 
     def random_shuffle(self, *, seed: Optional[int] = None,
                        num_blocks: Optional[int] = None) -> "Dataset":
+        from ray_tpu.data.context import DataContext
+        ctx = DataContext.get_current()
         n_red = num_blocks or max(self.num_blocks(), 1)
+        if ctx.use_push_based_shuffle and self.num_blocks() > 2:
+            return self._push_based_shuffle(
+                n_red, seed, ctx.push_based_shuffle_merge_factor)
         fns = [fn for _, fn in self._stages]
         maps = [_shuffle_map.options(num_returns=n_red).remote(
             b, n_red, None if seed is None else seed + i, fns)
@@ -320,6 +332,35 @@ class Dataset:
             _shuffle_reduce.remote(
                 None if seed is None else seed + 1000 + r,
                 *[m[r] for m in maps])
+            for r in range(n_red)
+        ]
+        return Dataset(reduces)
+
+    def _push_based_shuffle(self, n_red: int, seed: Optional[int],
+                            merge_factor: int) -> "Dataset":
+        """Two-stage pipelined shuffle (parity: PushBasedShufflePlan,
+        push_based_shuffle.py:330): mappers run in rounds of
+        ``merge_factor``; each round's per-reducer parts are folded into
+        a running merged partial, so reducer-side memory stays bounded by
+        one round and merging overlaps the next round's map work (the
+        scheduler interleaves them — no barrier between rounds)."""
+        fns = [fn for _, fn in self._stages]
+        # per reducer, one merged partial per round; the final reduce is
+        # variadic over rounds, so data moves O(B) (not a re-concat chain)
+        rounds: List[List[ray_tpu.ObjectRef]] = [[] for _ in range(n_red)]
+        blocks = self._blocks
+        for start in range(0, len(blocks), max(1, merge_factor)):
+            round_blocks = blocks[start:start + max(1, merge_factor)]
+            maps = [_shuffle_map.options(num_returns=n_red).remote(
+                b, n_red, None if seed is None else seed + start + i, fns)
+                for i, b in enumerate(round_blocks)]
+            maps = [[m] if n_red == 1 else list(m) for m in maps]
+            for r in range(n_red):
+                rounds[r].append(_shuffle_merge.remote(
+                    *[m[r] for m in maps]))
+        reduces = [
+            _shuffle_reduce.remote(
+                None if seed is None else seed + 1000 + r, *rounds[r])
             for r in range(n_red)
         ]
         return Dataset(reduces)
@@ -420,6 +461,17 @@ class Dataset:
     # ------------------------------------------------------------------
     def num_blocks(self) -> int:
         return len(self._blocks)
+
+    def size_bytes(self) -> int:
+        """Total bytes across materialized blocks (reference
+        ``Dataset.size_bytes``)."""
+
+        @ray_tpu.remote
+        def _sz(block: Block) -> int:
+            return BlockAccessor(block).size_bytes()
+
+        return int(sum(ray_tpu.get(
+            [_sz.remote(b) for b in self._executed_blocks()])))
 
     def count(self) -> int:
         return int(sum(BlockAccessor(b).num_rows()

@@ -137,6 +137,23 @@ def read_parquet(paths: Union[str, List[str]], **kwargs) -> Dataset:
     return Dataset([_read_parquet_file.remote(p, kwargs) for p in files])
 
 
+@ray_tpu.remote
+def _read_text_file(path: str, encoding: str, drop_empty: bool) -> Block:
+    with open(path, encoding=encoding) as f:
+        lines = [ln.rstrip("\n") for ln in f]
+    if drop_empty:
+        lines = [ln for ln in lines if ln]
+    return {"text": np.asarray(lines, dtype=object)}
+
+
+def read_text(paths: Union[str, List[str]], *, encoding: str = "utf-8",
+              drop_empty_lines: bool = False) -> Dataset:
+    """One row per line (reference ``read_text``)."""
+    files = _expand_paths(paths, ".txt")
+    return Dataset([_read_text_file.remote(p, encoding, drop_empty_lines)
+                    for p in files])
+
+
 def read_binary_files(paths: Union[str, List[str]], **kwargs) -> Dataset:
     @ray_tpu.remote
     def _read(path: str) -> Block:

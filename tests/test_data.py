@@ -197,3 +197,30 @@ def test_to_jax():
     batches = list(ds.to_jax(batch_size=16))
     assert len(batches) == 2
     assert batches[0]["id"].shape == (16,)
+
+
+def test_push_based_shuffle_matches_pull():
+    from ray_tpu.data.context import DataContext
+
+    ds = ray_tpu.data.range(200, parallelism=8)
+    ctx = DataContext.get_current()
+    try:
+        ctx.use_push_based_shuffle = True
+        pushed = ds.random_shuffle(seed=7)
+        rows_push = sorted(r["id"] for r in pushed.take_all())
+    finally:
+        ctx.use_push_based_shuffle = False
+    pulled = ds.random_shuffle(seed=7)
+    rows_pull = sorted(r["id"] for r in pulled.take_all())
+    assert rows_push == list(range(200)) == rows_pull
+    # actually shuffled (not identity order)
+    assert [r["id"] for r in pushed.take(20)] != list(range(20))
+
+
+def test_read_text_and_size_bytes(tmp_path):
+    f = tmp_path / "lines.txt"
+    f.write_text("alpha\nbeta\ngamma\n")
+    ds = ray_tpu.data.read_text(str(f))
+    assert [r["text"] for r in ds.take_all()] == ["alpha", "beta", "gamma"]
+    nums = ray_tpu.data.range(100, parallelism=4)
+    assert nums.size_bytes() >= 100 * 8

@@ -1,0 +1,61 @@
+"""multiprocessing.Pool / joblib / parallel iterator tests (parity
+model: reference python/ray/tests/test_multiprocessing.py,
+test_joblib.py, test_iter.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util import iter as par_iter
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_pool_map_and_star():
+    with Pool(processes=2) as pool:
+        assert pool.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(_add, (5, 6)) == 11
+        r = pool.apply_async(_sq, (9,))
+        assert r.get(timeout=60) == 81
+
+
+def test_pool_imap():
+    with Pool(processes=2) as pool:
+        assert list(pool.imap(_sq, range(8), chunksize=2)) == \
+            [x * x for x in range(8)]
+        assert sorted(pool.imap_unordered(_sq, range(8), chunksize=2)) == \
+            sorted(x * x for x in range(8))
+
+
+def test_joblib_backend():
+    import joblib
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
+    assert out == [0, 1, 4, 9, 16, 25]
+
+
+def test_parallel_iterator_sync_and_async():
+    it = par_iter.from_range(20, num_shards=3).for_each(lambda x: x * 2)
+    assert sorted(it.gather_sync()) == sorted(x * 2 for x in range(20))
+    it2 = par_iter.from_range(10, num_shards=2) \
+        .filter(lambda x: x % 2 == 0).for_each(lambda x: x + 1)
+    assert sorted(it2.gather_async()) == [1, 3, 5, 7, 9]
+
+
+def test_parallel_iterator_batch():
+    it = par_iter.from_range(10, num_shards=2).batch(3)
+    batches = list(it.gather_sync())
+    assert all(isinstance(b, list) for b in batches)
+    assert sorted(x for b in batches for x in b) == list(range(10))
