@@ -80,6 +80,11 @@ def _shuffle_reduce(seed: Optional[int], *parts: Block) -> Block:
 
 
 @ray_tpu.remote
+def _block_size_bytes(block: Block) -> int:
+    return BlockAccessor(block).size_bytes()
+
+
+@ray_tpu.remote
 def _shuffle_merge(*parts: Block) -> Block:
     """Intermediate merge of one round's mapper outputs for one reducer
     (parity: the merge stage of push_based_shuffle.py:330)."""
@@ -465,13 +470,9 @@ class Dataset:
     def size_bytes(self) -> int:
         """Total bytes across materialized blocks (reference
         ``Dataset.size_bytes``)."""
-
-        @ray_tpu.remote
-        def _sz(block: Block) -> int:
-            return BlockAccessor(block).size_bytes()
-
         return int(sum(ray_tpu.get(
-            [_sz.remote(b) for b in self._executed_blocks()])))
+            [_block_size_bytes.remote(b)
+             for b in self._executed_blocks()])))
 
     def count(self) -> int:
         return int(sum(BlockAccessor(b).num_rows()

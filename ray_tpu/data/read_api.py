@@ -140,7 +140,7 @@ def read_parquet(paths: Union[str, List[str]], **kwargs) -> Dataset:
 @ray_tpu.remote
 def _read_text_file(path: str, encoding: str, drop_empty: bool) -> Block:
     with open(path, encoding=encoding) as f:
-        lines = [ln.rstrip("\n") for ln in f]
+        lines = [ln.rstrip("\r\n") for ln in f]
     if drop_empty:
         lines = [ln for ln in lines if ln]
     return {"text": np.asarray(lines, dtype=object)}

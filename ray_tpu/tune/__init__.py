@@ -16,7 +16,7 @@ from ray_tpu.tune.execution import TrialRunner
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler,  # noqa: F401
                                      FIFOScheduler, MedianStoppingRule,
                                      PopulationBasedTraining, TrialScheduler)
-from ray_tpu.tune.search import (BasicVariantGenerator, Searcher,  # noqa: F401
+from ray_tpu.tune.search import (BasicVariantGenerator, BayesOptSearch, Searcher,  # noqa: F401
                                  choice, grid_search, loguniform, quniform,
                                  randint, sample_from, uniform)
 from ray_tpu.tune.trial import (ERROR, TERMINATED, Trial,  # noqa: F401
@@ -32,6 +32,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 0
     scheduler: Optional[TrialScheduler] = None
+    #: sequential suggester (e.g. BayesOptSearch); when set, param_space
+    #: sampling is delegated to it, fed back trial results
+    search_alg: Optional[Searcher] = None
     search_seed: Optional[int] = None
 
 
@@ -121,6 +124,9 @@ class Tuner:
         trainable = self.trainable
         if hasattr(trainable, "as_trainable"):
             trainable = trainable.as_trainable()
+        search_alg = self.tune_config.search_alg
+        if search_alg is not None:
+            return self._fit_with_searcher(trainable, search_alg)
         gen = BasicVariantGenerator(seed=self.tune_config.search_seed)
         configs = gen.generate(self.param_space,
                                self.tune_config.num_samples)
@@ -141,9 +147,65 @@ class Tuner:
                           self.tune_config.mode)
 
 
+    def _fit_with_searcher(self, trainable, search_alg) -> ResultGrid:
+        """Sequential suggest -> run -> feed-back loop (parity:
+        SearchGenerator driving the reference TrialRunner); concurrency
+        within a wave = max_concurrent_trials."""
+        if search_alg.metric is None:
+            search_alg.metric = self.tune_config.metric
+        if self.tune_config.metric is not None:
+            # the run's direction always wins — a searcher left at its
+            # default mode must not silently optimize the wrong way
+            search_alg.mode = self.tune_config.mode
+        # non-Domain param_space entries are constants merged into every
+        # suggestion (suggestions win on conflicts)
+        from ray_tpu.tune.search import Domain
+        constants = {k: v for k, v in self.param_space.items()
+                     if not isinstance(v, Domain) and not _is_grid_entry(v)}
+        scheduler = self.tune_config.scheduler
+        if scheduler is not None and \
+                getattr(scheduler, "metric", None) is None:
+            scheduler.metric = self.tune_config.metric
+            scheduler.mode = self.tune_config.mode
+        wave = max(1, self.tune_config.max_concurrent_trials or 1)
+        all_trials: List[Trial] = []
+        remaining = self.tune_config.num_samples
+        i = 0
+        while remaining > 0:
+            batch = []
+            for _ in range(min(wave, remaining)):
+                cfg = search_alg.suggest(f"sugg_{i}")
+                if cfg is None:
+                    remaining = 0
+                    break
+                batch.append((f"sugg_{i}",
+                              Trial(config={**constants, **cfg})))
+                i += 1
+            if not batch:
+                break
+            remaining -= len(batch)
+            runner = TrialRunner(
+                trainable, [t for _, t in batch],
+                scheduler=scheduler,
+                max_concurrent=len(batch),
+                resources_per_trial=self.resources_per_trial,
+                run_config=self.run_config)
+            runner.run()
+            for sid, trial in batch:
+                search_alg.on_trial_complete(sid, trial.last_result)
+                all_trials.append(trial)
+        return ResultGrid(all_trials, self.tune_config.metric,
+                          self.tune_config.mode)
+
+
+def _is_grid_entry(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
 def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
         num_samples: int = 1, metric: Optional[str] = None,
         mode: str = "max", scheduler: Optional[TrialScheduler] = None,
+        search_alg: Optional[Searcher] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
         max_concurrent_trials: int = 0, **_ignored) -> ResultGrid:
     """Functional entry point (parity: ``tune.run`` tune.py:131)."""
@@ -151,6 +213,7 @@ def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
         trainable, param_space=config,
         tune_config=TuneConfig(metric=metric, mode=mode,
                                num_samples=num_samples, scheduler=scheduler,
+                               search_alg=search_alg,
                                max_concurrent_trials=max_concurrent_trials),
         resources_per_trial=resources_per_trial)
     return tuner.fit()

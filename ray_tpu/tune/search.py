@@ -168,3 +168,90 @@ class Searcher:
     def on_trial_complete(self, trial_id: str,
                           result: Optional[Dict[str, Any]] = None) -> None:
         pass
+
+
+class BayesOptSearch(Searcher):
+    """Gaussian-process Bayesian optimization (parity role: reference
+    ``tune/search/bayesopt`` — that wraps the external bayesian-
+    optimization package; here the GP comes from scikit-learn, which is
+    part of this image, so the capability is native).
+
+    Numeric domains (Uniform/LogUniform/RandInt/Quantized) are encoded
+    to [0,1]; Choice is one-hot-free ordinal (fine at these dims).
+    Suggestions maximize UCB (kappa-weighted) over random candidates —
+    after ``n_initial_points`` random draws.
+    """
+
+    def __init__(self, space: Dict[str, Any], *,
+                 metric: Optional[str] = None, mode: str = "max",
+                 n_initial_points: int = 5, kappa: float = 2.5,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self.space = {k: v for k, v in space.items()
+                      if isinstance(v, Domain)}
+        self.constants = {k: v for k, v in space.items()
+                          if not isinstance(v, Domain)}
+        self.n_initial = n_initial_points
+        self.kappa = kappa
+        self._rng = random.Random(seed)
+        self._np_rng = __import__("numpy").random.default_rng(seed)
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._pending: Dict[str, List[float]] = {}
+
+    # -- decode from the unit cube -------------------------------------
+    def _decode(self, x: List[float]) -> Dict[str, Any]:
+        import math
+        out = dict(self.constants)
+        for u, (key, dom) in zip(x, sorted(self.space.items())):
+            u = min(1.0, max(0.0, u))
+            if isinstance(dom, Uniform):
+                out[key] = dom.low + u * (dom.high - dom.low)
+            elif isinstance(dom, LogUniform):
+                out[key] = math.exp(
+                    math.log(dom.low)
+                    + u * (math.log(dom.high) - math.log(dom.low)))
+            elif isinstance(dom, RandInt):
+                # exclusive high, matching RandInt.sample's randrange
+                out[key] = min(dom.high - 1,
+                               int(dom.low + u * (dom.high - dom.low)))
+            elif isinstance(dom, Quantized):
+                base = dom.base
+                raw = base.low + u * (base.high - base.low)
+                out[key] = round(raw / dom.q) * dom.q
+            elif isinstance(dom, Choice):
+                idx = int(round(u * (len(dom.categories) - 1)))
+                out[key] = dom.categories[idx]
+            else:
+                out[key] = dom.sample(self._rng)
+        return out
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        import numpy as np
+        dims = len(self.space)
+        if len(self._X) < self.n_initial or dims == 0:
+            x = [self._rng.random() for _ in range(dims)]
+        else:
+            from sklearn.gaussian_process import GaussianProcessRegressor
+            from sklearn.gaussian_process.kernels import Matern
+
+            gp = GaussianProcessRegressor(
+                kernel=Matern(nu=2.5), alpha=1e-6, normalize_y=True)
+            y = np.asarray(self._y)
+            if self.mode == "min":
+                y = -y
+            gp.fit(np.asarray(self._X), y)
+            cands = self._np_rng.random((256, dims))
+            mu, sigma = gp.predict(cands, return_std=True)
+            x = list(map(float, cands[int(np.argmax(
+                mu + self.kappa * sigma))]))
+        self._pending[trial_id] = x
+        return self._decode(x)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None) -> None:
+        x = self._pending.pop(trial_id, None)
+        if x is None or result is None or self.metric not in result:
+            return
+        self._X.append(x)
+        self._y.append(float(result[self.metric]))

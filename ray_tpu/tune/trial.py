@@ -45,13 +45,16 @@ class _SharedTrialState:
 _session = threading.local()  # .shared -> _SharedTrialState
 
 
-def report(metrics: Dict[str, Any], *,
+def report(metrics: Optional[Dict[str, Any]] = None, *,
            checkpoint: Optional[Checkpoint] = None, **kw) -> None:
     """In-trial reporting (parity: ``ray.air.session.report`` /
-    ``tune.report``)."""
+    ``tune.report`` — both ``report({"loss": x})`` and the legacy
+    ``report(loss=x)`` kwarg style work)."""
     sh: _SharedTrialState = getattr(_session, "shared", None)
     if sh is None:
         raise RuntimeError("tune.report() called outside a trial")
+    if metrics is None:
+        metrics = {}
     if not isinstance(metrics, dict):
         raise TypeError("metrics must be a dict")
     metrics = {**metrics, **kw}

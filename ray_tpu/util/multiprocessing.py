@@ -88,6 +88,7 @@ class Pool:
                 lambda _: initializer(*initargs), [None], False)
                 for w in self._workers])
         self._closed = False
+        self._pending: List[AsyncResult] = []
 
     # ------------------------------------------------------------------
     def _chunks(self, iterable: Iterable, chunksize: Optional[int]
@@ -104,6 +105,11 @@ class Pool:
         return [next(workers).run_batch.remote(fn, chunk, star)
                 for chunk in chunks]
 
+    def _track(self, result: "AsyncResult") -> "AsyncResult":
+        self._pending = [r for r in self._pending if not r.ready()]
+        self._pending.append(result)
+        return result
+
     # -- stdlib surface -------------------------------------------------
     def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
         return self.apply_async(fn, args, kwds).get()
@@ -114,8 +120,8 @@ class Pool:
         kwds = kwds or {}
         f = (lambda a: fn(*a, **kwds))
         refs = self._dispatch(f, [[args]], star=False)
-        return AsyncResult(refs, single=True, callback=callback,
-                           error_callback=error_callback)
+        return self._track(AsyncResult(refs, single=True, callback=callback,
+                                       error_callback=error_callback))
 
     def map(self, fn: Callable, iterable: Iterable,
             chunksize: Optional[int] = None) -> List[Any]:
@@ -126,18 +132,20 @@ class Pool:
                   callback=None, error_callback=None) -> AsyncResult:
         chunks = self._chunks(iterable, chunksize)
         refs = self._dispatch(fn, chunks, star=False)
-        return AsyncResult(refs, callback=callback,
-                           error_callback=error_callback)
+        return self._track(AsyncResult(refs, callback=callback,
+                                       error_callback=error_callback))
 
     def starmap(self, fn: Callable, iterable: Iterable[tuple],
                 chunksize: Optional[int] = None) -> List[Any]:
         chunks = self._chunks(iterable, chunksize)
-        return AsyncResult(self._dispatch(fn, chunks, star=True)).get()
+        return self._track(
+            AsyncResult(self._dispatch(fn, chunks, star=True))).get()
 
     def starmap_async(self, fn: Callable, iterable: Iterable[tuple],
                       chunksize: Optional[int] = None) -> AsyncResult:
         chunks = self._chunks(iterable, chunksize)
-        return AsyncResult(self._dispatch(fn, chunks, star=True))
+        return self._track(
+            AsyncResult(self._dispatch(fn, chunks, star=True)))
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: Optional[int] = None):
@@ -174,6 +182,10 @@ class Pool:
     def join(self) -> None:
         if not self._closed:
             raise ValueError("Pool is still running")
+        # stdlib contract: block until all submitted work completes
+        for r in self._pending:
+            r.wait()
+        self._pending = []
 
     def __enter__(self) -> "Pool":
         return self

@@ -1,0 +1,106 @@
+"""AIR namespace / predictors / sklearn trainer / BayesOpt / serve DAG
+driver tests (parity model: reference air/tests, train/tests,
+tune/tests/test_searchers, serve/tests/test_deployment_graph)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import air, serve, tune
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+def test_air_namespace_surface():
+    assert air.Checkpoint.from_dict({"a": 1}).to_dict()["a"] == 1
+    cfg = air.ScalingConfig(num_workers=2)
+    assert cfg.worker_resources()["CPU"] == 1.0
+    r = air.Result(metrics={"loss": 0.5})
+    assert r.metrics["loss"] == 0.5
+
+
+def test_sklearn_trainer_and_batch_predictor():
+    from sklearn.linear_model import LinearRegression
+    from ray_tpu.train.predictor import BatchPredictor, SklearnPredictor
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.default_rng(0)
+    x1 = rng.random(200)
+    x2 = rng.random(200)
+    y = 3.0 * x1 - 2.0 * x2 + 0.5
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_numpy(np.stack([x1, x2, y], axis=1))
+    # reshape into named columns
+    ds = ds.map_batches(
+        lambda b: {"x1": b["data"][:, 0], "x2": b["data"][:, 1],
+                   "y": b["data"][:, 2]}, batch_format="numpy")
+
+    trainer = SklearnTrainer(estimator=LinearRegression(),
+                             datasets={"train": ds, "valid": ds},
+                             label_column="y")
+    result = trainer.fit()
+    assert result.metrics["train_score"] > 0.99
+    assert result.metrics["valid_score"] > 0.99
+
+    bp = BatchPredictor.from_checkpoint(result.checkpoint,
+                                        SklearnPredictor)
+    preds = bp.predict(ds.limit(50), batch_size=25)
+    rows = preds.take_all()
+    assert len(rows) == 50
+    assert np.isfinite(rows[0]["predictions"])
+
+
+def test_bayesopt_search_converges_better_than_random():
+    """GP-UCB on a smooth 1-d objective: later suggestions should
+    cluster near the optimum (x=0.7)."""
+    space = {"x": tune.uniform(0.0, 1.0)}
+    searcher = tune.BayesOptSearch(space, metric="score", mode="max",
+                                   n_initial_points=4, seed=0)
+    xs = []
+    for i in range(16):
+        cfg = searcher.suggest(f"t{i}")
+        score = -(cfg["x"] - 0.7) ** 2
+        searcher.on_trial_complete(f"t{i}", {"score": score})
+        xs.append(cfg["x"])
+    late = xs[10:]
+    assert np.mean([abs(x - 0.7) for x in late]) < 0.2, xs
+
+
+def test_bayesopt_with_tuner():
+    space = {"lr": tune.loguniform(1e-4, 1e-1)}
+
+    def objective(config):
+        import math
+        tune.report(loss=(math.log10(config["lr"]) + 2.5) ** 2)
+
+    results = tune.run(
+        objective, config=space, num_samples=6, metric="loss", mode="min",
+        search_alg=tune.BayesOptSearch(space, metric="loss", mode="min",
+                                       n_initial_points=3, seed=1))
+    best = results.get_best_result()
+    assert best.metrics["loss"] < 2.0
+
+
+def test_serve_dag_driver():
+    from ray_tpu.serve.drivers import DAGDriver, deployment_node
+    from ray_tpu.dag import InputNode
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    serve.run(Doubler.bind())
+
+    @ray_tpu.remote
+    def add_ten(x):
+        return x + 10
+
+    with InputNode() as inp:
+        dag = add_ten.bind(deployment_node("Doubler").bind(inp))
+
+    serve.run(DAGDriver().bind(dag))
+    h = serve.get_deployment_handle("DAGDriver")
+    assert ray_tpu.get(h.remote(5), timeout=60) == 20
+    assert ray_tpu.get(h.remote(1), timeout=30) == 12
