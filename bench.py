@@ -47,7 +47,7 @@ def bench_gpt2() -> dict:
     on_accel = jax.default_backend() in ("tpu", "axon", "gpu")
     if on_accel:
         cfg = GPT2Config.gpt2_small(max_seq_len=1024)
-        batch = 8
+        batch = 32  # fits thanks to the chunked LM head
     else:  # CPU smoke fallback so the harness always gets a line
         cfg = GPT2Config.tiny(dtype=jnp.float32)
         batch = 2
@@ -70,15 +70,17 @@ def bench_gpt2() -> dict:
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    # warmup + compile
+    # warmup + compile; float() is a device->host transfer — the only
+    # reliable barrier through remote-dispatch backends, where
+    # block_until_ready can return before execution finishes
     params, opt_state, loss = step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+    float(loss)
 
     n_steps = 20 if on_accel else 3
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
+    float(loss)
     elapsed = time.perf_counter() - t0
 
     tokens_per_step = batch * seq

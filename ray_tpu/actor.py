@@ -125,6 +125,7 @@ class ActorClass:
         self._descriptor = f"{cls.__module__}.{cls.__qualname__}"
         self._class_id: Optional[str] = None
         self._pickled: Optional[bytes] = None
+        self._exported_core: Optional[Any] = None
         self._export_lock = threading.Lock()
 
     def __call__(self, *args, **kwargs):
@@ -147,10 +148,13 @@ class ActorClass:
 
     def _export(self, core) -> str:
         with self._export_lock:
-            if self._class_id is None:
+            # core-identity cache (see RemoteFunction._export)
+            if self._class_id is None or self._exported_core is not core:
                 if self._pickled is None:
-                    self._pickled = cloudpickle.dumps(_wrap_actor_class(self._cls))
+                    self._pickled = cloudpickle.dumps(
+                        _wrap_actor_class(self._cls))
                 self._class_id = core.register_function(self._pickled)
+                self._exported_core = core
         return self._class_id
 
     def bind(self, *args, **kwargs):

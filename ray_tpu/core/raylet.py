@@ -135,6 +135,9 @@ class Raylet:
 
         # cluster view for spillback (refreshed from GCS health replies)
         self._cluster_view: List[Dict[str, Any]] = []
+        # log monitor state: file path -> (offset, pid)
+        self._log_pids: Dict[str, int] = {}
+        self._log_offsets: Dict[str, int] = {}
         self._tasks: List[asyncio.Task] = []
         self._closing = False
 
@@ -155,6 +158,7 @@ class Raylet:
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._health_loop()))
         self._tasks.append(loop.create_task(self._reap_loop()))
+        self._tasks.append(loop.create_task(self._log_monitor_loop()))
         n_prestart = self.config.num_prestart_workers
         if n_prestart < 0:
             n_prestart = min(4, int(self.resources_total.get("CPU", 1)))
@@ -209,6 +213,59 @@ class Raylet:
                     logger.error("GCS dead; raylet exiting")
                     os._exit(0)
             await asyncio.sleep(self.config.health_report_period_s)
+
+    def _forget_worker_logs(self, pid: int) -> None:
+        for path in [p for p, wpid in self._log_pids.items()
+                     if wpid == pid]:
+            self._log_pids.pop(path, None)
+            self._log_offsets.pop(path, None)
+
+    async def _log_monitor_loop(self) -> None:
+        """Tail worker stdout/stderr files and publish new lines to the
+        GCS so drivers can echo them (parity: log_monitor.py:100 ->
+        pubsub -> driver '(pid=...)' prefixes)."""
+        while not self._closing:
+            await asyncio.sleep(0.5)
+            try:
+                batch: List[Dict[str, Any]] = []
+                for path, pid in list(self._log_pids.items()):
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    offset = self._log_offsets.get(path, 0)
+                    if size <= offset:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read(min(size - offset, 1 << 20))
+                    # only complete lines; partial tail re-read next
+                    # tick.  A single line longer than the read window
+                    # would never complete — force-flush so the offset
+                    # always advances.
+                    cut = chunk.rfind(b"\n")
+                    if cut < 0:
+                        if len(chunk) < (1 << 20):
+                            continue
+                        cut = len(chunk) - 1
+                    self._log_offsets[path] = offset + cut + 1
+                    lines = chunk[:cut + 1].decode(errors="replace") \
+                        .splitlines()
+                    if lines:
+                        batch.append({"pid": pid,
+                                      "is_err": path.endswith(".err"),
+                                      "lines": lines})
+                if batch and self.gcs_conn and not self.gcs_conn.closed:
+                    await self.gcs_conn.call("publish", {
+                        "channel": "worker_logs",
+                        "message": {
+                            "node_id": self.node_id.hex()[:8],
+                            "records": batch,
+                        }})
+            except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+                pass
+            except Exception:
+                logger.exception("log monitor iteration failed")
 
     async def _reap_loop(self) -> None:
         """Detect dead worker processes (parity: WorkerPool SIGCHLD path)."""
@@ -267,6 +324,9 @@ class Raylet:
         err = open(log_base + ".err", "ab")
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err,
                                 cwd=os.getcwd())
+        # log monitor maps these files to the worker pid for prefixes
+        self._log_pids[log_base + ".out"] = proc.pid
+        self._log_pids[log_base + ".err"] = proc.pid
         # handle registered later in handle_register_worker; remember proc
         self._spawned_procs.append((proc, tpu_capable))
 
@@ -308,6 +368,13 @@ class Raylet:
 
     def _on_worker_dead(self, worker: WorkerHandle, reason: str) -> None:
         self.workers.pop(worker.worker_id, None)
+        # stop tailing the dead worker's logs after one more tick (which
+        # drains any final lines)
+        try:
+            asyncio.get_event_loop().call_later(
+                2.0, self._forget_worker_logs, worker.pid)
+        except RuntimeError:
+            self._forget_worker_logs(worker.pid)
         if worker in self._idle:
             self._idle.remove(worker)
         if worker.leased:

@@ -182,6 +182,7 @@ class CoreWorker:
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
         self._exec_threads: List[threading.Thread] = []
         self._function_cache: Dict[str, Any] = {}
+        self._registered_functions: set = set()
         self._syspath_applied: set = set()
         self._actor_instance: Any = None
         self._actor_id: Optional[ActorID] = None
@@ -229,6 +230,11 @@ class CoreWorker:
         self.gcs_conn = await rpc.connect(self.gcs_address,
                                           handler=self.task_server)
         self.gcs_conn.set_push_handler(self._on_gcs_push)
+        if self.mode == "driver" and self.config.log_to_driver:
+            # stream worker stdout/stderr to this driver (parity: the
+            # reference's log monitor -> driver echo with pid prefixes)
+            await self.gcs_conn.call("subscribe",
+                                     {"channel": "worker_logs"})
         if self.mode == "driver" and self.job_id is None:
             reply = await self.gcs_conn.call(
                 "register_job", {"driver_address": self.task_address})
@@ -696,9 +702,13 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def register_function(self, blob: bytes) -> str:
         function_id = hashlib.sha256(blob).hexdigest()[:32]
-        if function_id not in self._function_cache:
+        # idempotent per THIS cluster connection — the registered set
+        # lives on the CoreWorker so a fresh cluster in the same process
+        # re-exports module-level remote functions
+        if function_id not in self._registered_functions:
             self._run(self.gcs_conn.call("register_function", {
                 "function_id": function_id, "blob": blob}))
+            self._registered_functions.add(function_id)
         return function_id
 
     def submit_task(self, function_id: str, descriptor: str, args: tuple,
@@ -1243,6 +1253,15 @@ class CoreWorker:
         return total
 
     def _on_gcs_push(self, channel: str, message: Any) -> None:
+        if channel == "worker_logs":
+            import sys as _sys
+            node = message.get("node_id", "")
+            for rec in message.get("records", []):
+                stream = _sys.stderr if rec.get("is_err") else _sys.stdout
+                for line in rec.get("lines", []):
+                    print(f"(pid={rec['pid']}, node={node}) {line}",
+                          file=stream)
+            return
         if channel.startswith("actor:"):
             actor_id = ActorID.from_hex(channel.split(":", 1)[1])
             state = self._actor_states.get(actor_id)

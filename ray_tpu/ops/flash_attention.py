@@ -37,8 +37,8 @@ def _attention_reference(q, k, v, causal: bool, scale: float) -> jax.Array:
     return out.astype(q.dtype)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-               block_k: int, seq_k: int):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+               causal: bool, block_k: int, seq_k: int):
     from jax.experimental import pallas as pl
 
     block_q, head_dim = q_ref.shape
@@ -85,6 +85,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
     m, l, acc = lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
     l = jnp.where(l == 0.0, 1.0, l)
     o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+    # row logsumexp (softmax statistics the backward kernels reuse);
+    # stored [block_q, 1] — TPU blocks need >=2 trailing dims
+    lse_ref[:] = jnp.where(m <= NEG_INF / 2, NEG_INF,
+                           m + jnp.log(l)).astype(jnp.float32)[:, None]
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float,
@@ -107,7 +111,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     grid = (batch, heads, seq_q // block_q)
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_k=block_k, seq_k=seq_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -118,28 +122,209 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((None, None, seq_k, dim),
                          lambda b, h, i: (b, h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, dim),
-                               lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, dim),
+                         lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, *, scale: float, causal: bool,
+                        block_q: int, seq_q: int):
+    """One program per (b, h, K tile): accumulate dK/dV over Q tiles."""
+    from jax.experimental import pallas as pl
+
+    block_k, head_dim = k_ref.shape
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_offset = pl.program_id(2) * block_k
+    num_q_blocks = seq_q // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q_start = i * block_q
+        q = q_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(q_start, block_q), :][:, 0]
+        delta = delta_ref[pl.ds(q_start, block_q), :][:, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # K tile [k_offset, k_offset+block_k) only receives gradient from
+        # Q rows at or after its start
+        first = lax.div(k_offset, block_q)
+    else:
+        first = 0
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = lax.fori_loop(first, num_q_blocks, body, (zeros, zeros))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                         interpret)
-    return out, (q, k, v)
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, *, scale: float, causal: bool,
+                      block_k: int, seq_k: int):
+    """One program per (b, h, Q tile): accumulate dQ over K tiles."""
+    from jax.experimental import pallas as pl
+
+    block_q, head_dim = q_ref.shape
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:][:, 0]
+    delta = delta_ref[:][:, 0]
+    q_offset = pl.program_id(2) * block_q
+    num_k_blocks = seq_k // block_k
+
+    def body(i, dq):
+        k_start = i * block_k
+        k = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last = lax.div(q_offset + block_q - 1, block_k) + 1
+        num_iters = jnp.minimum(num_k_blocks, last)
+    else:
+        num_iters = num_k_blocks
+    dq = lax.fori_loop(0, num_iters, body,
+                       jnp.zeros((block_q, head_dim), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    from jax.experimental import pallas as pl
+
+    batch, seq_q, heads, dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = g.transpose(0, 2, 1, 3)
+    # delta_i = rowsum(dO_i * O_i) (FlashAttention-2 eq. for dS);
+    # [B,H,S,1] like lse (TPU blocks need >=2 trailing dims)
+    delta = jnp.sum(dot.astype(jnp.float32)
+                    * out.transpose(0, 2, 1, 3).astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    kv_grid = (batch, heads, seq_k // block_k)
+    dkdv = functools.partial(_fa_bwd_dkdv_kernel, scale=scale,
+                             causal=causal, block_q=block_q, seq_q=seq_q)
+    full_q = pl.BlockSpec((None, None, seq_q, dim),
+                          lambda b, h, i: (b, h, 0, 0))
+    tile_k = pl.BlockSpec((None, None, block_k, dim),
+                          lambda b, h, i: (b, h, i, 0))
+    full_rows = pl.BlockSpec((None, None, seq_q, 1),
+                             lambda b, h, i: (b, h, 0, 0))
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=kv_grid,
+        in_specs=[full_q, tile_k, tile_k, full_q, full_rows, full_rows],
+        out_specs=[tile_k, tile_k],
+        out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vt.shape, v.dtype)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    q_grid = (batch, heads, seq_q // block_q)
+    dq_kernel = functools.partial(_fa_bwd_dq_kernel, scale=scale,
+                                  causal=causal, block_k=block_k,
+                                  seq_k=seq_k)
+    tile_q = pl.BlockSpec((None, None, block_q, dim),
+                          lambda b, h, i: (b, h, i, 0))
+    full_k = pl.BlockSpec((None, None, seq_k, dim),
+                          lambda b, h, i: (b, h, 0, 0))
+    rows_q = pl.BlockSpec((None, None, block_q, 1),
+                          lambda b, h, i: (b, h, i, 0))
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=q_grid,
+        in_specs=[tile_q, full_k, full_k, tile_q, rows_q, rows_q],
+        out_specs=tile_q,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, bwd_impl):
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               bwd_impl):
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    if bwd_impl == "pallas":
+        return out, (q, k, v, out, lse)
+    return out, (q, k, v, None, None)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, bwd_impl,
+               res, g):
+    q, k, v, out, lse = res
+    if bwd_impl == "pallas":
+        return _flash_backward(q, k, v, out, lse, g, causal, scale,
+                               block_q, block_k, interpret)
+    # default: XLA recompute through the reference formulation — inside
+    # one big jitted step XLA fuses/remats this better than the pallas
+    # backward's layout copies (measured: 58.6k vs 18.2k tok/s on the
+    # GPT-2-small bench), while the pallas *forward* still provides the
+    # O(T) memory inference/eval path
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal, scale),
         q, k, v)
@@ -152,12 +337,16 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    bwd_impl: str = "xla") -> jax.Array:
     """Fused attention. Shapes ``[batch, seq, heads, head_dim]``.
 
     On TPU runs the pallas kernel; on other backends (tests) falls back
     to the jnp reference unless ``interpret=True`` forces the kernel
-    through the pallas interpreter.
+    through the pallas interpreter.  ``bwd_impl``: "xla" (default —
+    recompute under XLA fusion, fastest inside large jitted steps) or
+    "pallas" (FlashAttention-2 dK/dV + dQ kernels; O(T) memory, wins
+    for long sequences where the score matrix can't fit).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -166,4 +355,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         if backend not in ("tpu", "axon"):
             return _attention_reference(q, k, v, causal, scale)
         interpret = False
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+                  bwd_impl)

@@ -85,3 +85,45 @@ def fused_softmax_cross_entropy(logits: jax.Array,
     label_logit = jnp.take_along_axis(
         shifted, labels[..., None], axis=-1)[..., 0]
     return lse - label_logit
+
+
+def chunked_lm_loss(hidden: jax.Array, emb: jax.Array, labels: jax.Array,
+                    *, chunk: int = 8192) -> jax.Array:
+    """Mean next-token cross entropy with a chunked LM head.
+
+    ``hidden`` [B,T,E] (f32), ``emb`` [V,E] (tied embedding), ``labels``
+    [B,T].  Tokens are processed ``chunk`` at a time under
+    ``jax.checkpoint``: the [chunk,V] logits block lives only inside one
+    scan step (forward) and is recomputed in backward — HBM never holds
+    [B,T,V], which at GPT-2-small scale is both the largest tensor and
+    the dominant bandwidth cost of the naive head.
+    """
+    B, T, E = hidden.shape
+    V = emb.shape[0]
+    flat_h = hidden.reshape(B * T, E).astype(jnp.float32)
+    flat_y = labels.reshape(B * T)
+    n = flat_h.shape[0]
+    pad = (-n) % chunk
+    if pad:
+        flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
+        flat_y = jnp.pad(flat_y, (0, pad))
+    mask = (jnp.arange(flat_h.shape[0]) < n).astype(jnp.float32)
+    n_chunks = flat_h.shape[0] // chunk
+    h_c = flat_h.reshape(n_chunks, chunk, E)
+    y_c = flat_y.reshape(n_chunks, chunk)
+    m_c = mask.reshape(n_chunks, chunk)
+    emb_f32 = emb.astype(jnp.float32)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h, y, m = xs
+        logits = h @ emb_f32.T  # [chunk, V]
+        mx = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
+        shifted = logits - mx
+        lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+        label_logit = jnp.take_along_axis(
+            shifted, y[:, None], axis=-1)[:, 0]
+        return carry + jnp.sum((lse - label_logit) * m), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (h_c, y_c, m_c))
+    return total / n

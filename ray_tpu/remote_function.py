@@ -55,6 +55,7 @@ class RemoteFunction:
         self._function_id: Optional[str] = None
         self._pickled: Optional[bytes] = None
         self._packaged_env: Optional[Dict[str, Any]] = None
+        self._exported_core: Optional[Any] = None
         self._export_lock = threading.Lock()
         self.__name__ = getattr(fn, "__name__", "remote_function")
         self.__doc__ = fn.__doc__
@@ -80,10 +81,14 @@ class RemoteFunction:
 
     def _export(self, core) -> str:
         with self._export_lock:
-            if self._function_id is None:
+            # cache is valid only for the cluster it exported to; a fresh
+            # CoreWorker (reconnect in the same process) re-exports —
+            # without re-hashing the blob on every submission
+            if self._function_id is None or self._exported_core is not core:
                 if self._pickled is None:
                     self._pickled = cloudpickle.dumps(self._fn)
                 self._function_id = core.register_function(self._pickled)
+                self._exported_core = core
         return self._function_id
 
     def bind(self, *args, **kwargs):

@@ -34,11 +34,20 @@ def start(detached: bool = True) -> Any:
     return controller
 
 
+_router_core = None
+
+
 def _get_router() -> Router:
-    global _router
+    global _router, _router_core
+    from ray_tpu.core import worker as _worker_mod
+    core = _worker_mod.global_worker()
     with _router_lock:
-        if _router is None:
+        # a cached router is only valid for the cluster it was built on —
+        # reconnecting (tests, notebooks) must rebuild against the new
+        # controller
+        if _router is None or _router_core is not core:
             _router = Router(start())
+            _router_core = core
         return _router
 
 

@@ -28,7 +28,9 @@ class TuneConfig:
     """Parity: reference ``tune/tune_config.py``."""
 
     metric: Optional[str] = None
-    mode: str = "max"
+    #: None = unset; resolved to "max" where needed so an explicitly
+    #: configured searcher's mode is never silently overridden
+    mode: Optional[str] = None
     num_samples: int = 1
     max_concurrent_trials: int = 0
     scheduler: Optional[TrialScheduler] = None
@@ -136,7 +138,7 @@ class Tuner:
             # propagate metric/mode if the scheduler was built without them
             if getattr(scheduler, "metric", None) is None:
                 scheduler.metric = self.tune_config.metric
-                scheduler.mode = self.tune_config.mode
+                scheduler.mode = self.tune_config.mode or "max"
         runner = TrialRunner(
             trainable, trials, scheduler=scheduler,
             max_concurrent=self.tune_config.max_concurrent_trials,
@@ -144,7 +146,7 @@ class Tuner:
             run_config=self.run_config)
         runner.run()
         return ResultGrid(trials, self.tune_config.metric,
-                          self.tune_config.mode)
+                          self.tune_config.mode or "max")
 
 
     def _fit_with_searcher(self, trainable, search_alg) -> ResultGrid:
@@ -153,20 +155,20 @@ class Tuner:
         within a wave = max_concurrent_trials."""
         if search_alg.metric is None:
             search_alg.metric = self.tune_config.metric
-        if self.tune_config.metric is not None:
-            # the run's direction always wins — a searcher left at its
-            # default mode must not silently optimize the wrong way
+        if self.tune_config.mode is not None:
+            # explicit run-level direction wins; when the run didn't set
+            # one, the searcher's own mode stands
             search_alg.mode = self.tune_config.mode
         # non-Domain param_space entries are constants merged into every
         # suggestion (suggestions win on conflicts)
-        from ray_tpu.tune.search import Domain
+        from ray_tpu.tune.search import Domain, _is_grid
         constants = {k: v for k, v in self.param_space.items()
-                     if not isinstance(v, Domain) and not _is_grid_entry(v)}
+                     if not isinstance(v, Domain) and not _is_grid(v)}
         scheduler = self.tune_config.scheduler
         if scheduler is not None and \
                 getattr(scheduler, "metric", None) is None:
             scheduler.metric = self.tune_config.metric
-            scheduler.mode = self.tune_config.mode
+            scheduler.mode = self.tune_config.mode or "max"
         wave = max(1, self.tune_config.max_concurrent_trials or 1)
         all_trials: List[Trial] = []
         remaining = self.tune_config.num_samples
@@ -195,16 +197,13 @@ class Tuner:
                 search_alg.on_trial_complete(sid, trial.last_result)
                 all_trials.append(trial)
         return ResultGrid(all_trials, self.tune_config.metric,
-                          self.tune_config.mode)
-
-
-def _is_grid_entry(v) -> bool:
-    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+                          self.tune_config.mode or "max")
 
 
 def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
         num_samples: int = 1, metric: Optional[str] = None,
-        mode: str = "max", scheduler: Optional[TrialScheduler] = None,
+        mode: Optional[str] = None,
+        scheduler: Optional[TrialScheduler] = None,
         search_alg: Optional[Searcher] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
         max_concurrent_trials: int = 0, **_ignored) -> ResultGrid:

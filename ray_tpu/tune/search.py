@@ -187,6 +187,12 @@ class BayesOptSearch(Searcher):
                  n_initial_points: int = 5, kappa: float = 2.5,
                  seed: Optional[int] = None):
         super().__init__(metric, mode)
+        grids = [k for k, v in space.items() if _is_grid(v)]
+        if grids:
+            raise ValueError(
+                f"grid_search entries {grids} are incompatible with a "
+                f"sequential searcher; enumerate them as Choice domains "
+                f"or use BasicVariantGenerator")
         self.space = {k: v for k, v in space.items()
                       if isinstance(v, Domain)}
         self.constants = {k: v for k, v in space.items()
