@@ -1,0 +1,78 @@
+"""Chaos-testing helpers.
+
+Parity: reference ``python/ray/_private/test_utils.py`` —
+``NodeKillerActor`` (:1301) / ``_kill_raylet`` (:1377) used by
+``test_chaos.py``'s ``set_kill_interval`` (:27): SIGKILL random worker
+raylets on an interval while a workload runs, asserting the job still
+completes through retries + lineage reconstruction.
+
+Runs as a driver-side thread rather than an actor (killing the node an
+actor lives on from inside it is the one placement we can't allow).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class NodeKiller:
+    """Kills random *worker* nodes of a ``cluster_utils.Cluster`` on an
+    interval; the head is never a target."""
+
+    def __init__(self, cluster, *, kill_interval_s: float = 1.0,
+                 max_kills: Optional[int] = None,
+                 seed: Optional[int] = None):
+        self.cluster = cluster
+        self.kill_interval_s = kill_interval_s
+        self.max_kills = max_kills
+        self.killed: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.kill_interval_s):
+            if self.max_kills is not None and \
+                    len(self.killed) >= self.max_kills:
+                return
+            victims = [n for n in self.cluster.worker_nodes
+                       if n.proc.poll() is None]
+            if not victims:
+                continue
+            node = self._rng.choice(victims)
+            node_id = node.handshake["node_id"][:12]
+            node.kill()  # SIGKILL — no graceful teardown, like the chaos suite
+            self.killed.append(node_id)
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="node-killer", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> List[str]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return list(self.killed)
+
+
+def wait_for_condition(predicate, timeout: float = 30.0,
+                       retry_interval_ms: float = 100.0) -> None:
+    """Poll until predicate() is truthy (reference ``wait_for_condition``)."""
+    deadline = time.monotonic() + timeout
+    last_exc: Optional[BaseException] = None
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return
+        except Exception as e:  # noqa: BLE001
+            last_exc = e
+        time.sleep(retry_interval_ms / 1000.0)
+    msg = f"condition not met within {timeout}s"
+    if last_exc is not None:
+        raise TimeoutError(msg) from last_exc
+    raise TimeoutError(msg)

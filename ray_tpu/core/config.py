@@ -20,6 +20,14 @@ _ENV_PREFIX = "RAY_TPU_"
 
 @dataclass
 class Config:
+    # ---- memory monitor (reference memory_monitor.h:52 +
+    # worker_killing_policy.h:30) -----------------------------------------
+    #: host memory-used fraction above which the raylet kills a retriable
+    #: task worker instead of risking the OS OOM killer (0 disables)
+    memory_usage_threshold: float = 0.95
+    #: how often the monitor samples /proc/meminfo (ms; 0 disables)
+    memory_monitor_refresh_ms: int = 250
+
     # ---- object store ----------------------------------------------------
     #: Bytes of shared memory for the per-node object store (0 = auto: 30%
     #: of system memory, capped).

@@ -61,6 +61,9 @@ class WorkerHandle:
     leased: bool = False
     lease_resources: Dict[str, float] = field(default_factory=dict)
     lease_bundle: Optional[Tuple[bytes, int]] = None  # (pg_id, bundle_index)
+    #: whether the leased work survives a kill (owner retries it)
+    lease_retriable: bool = True
+    lease_granted_at: float = 0.0
     is_actor: bool = False
 
 
@@ -72,6 +75,7 @@ class PendingLease:
     resources: Dict[str, float]
     bundle: Optional[Tuple[bytes, int]]
     env_hash: Optional[str] = None
+    retriable: bool = True
     enqueued_at: float = field(default_factory=time.monotonic)
 
 
@@ -159,6 +163,10 @@ class Raylet:
         self._tasks.append(loop.create_task(self._health_loop()))
         self._tasks.append(loop.create_task(self._reap_loop()))
         self._tasks.append(loop.create_task(self._log_monitor_loop()))
+        if self.config.memory_monitor_refresh_ms > 0 and \
+                self.config.memory_usage_threshold > 0:
+            self._tasks.append(
+                loop.create_task(self._memory_monitor_loop()))
         n_prestart = self.config.num_prestart_workers
         if n_prestart < 0:
             n_prestart = min(4, int(self.resources_total.get("CPU", 1)))
@@ -213,6 +221,71 @@ class Raylet:
                     logger.error("GCS dead; raylet exiting")
                     os._exit(0)
             await asyncio.sleep(self.config.health_report_period_s)
+
+    # ------------------------------------------------------------------
+    # memory monitor + worker killing policy (parity:
+    # src/ray/common/memory_monitor.h:52, raylet/worker_killing_policy.h:30)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _memory_used_fraction() -> float:
+        """Host memory pressure from /proc/meminfo (MemAvailable)."""
+        try:
+            total = avail = None
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = float(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = float(line.split()[1])
+                    if total is not None and avail is not None:
+                        break
+            if not total or avail is None:
+                # unknown availability must read as "no pressure", not
+                # 100% used — else the monitor becomes a kill loop
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    def _pick_oom_victim(self) -> Optional[WorkerHandle]:
+        """Retriable-LIFO (reference policy): among leased workers,
+        prefer retriable plain tasks (owners resubmit them), newest
+        lease first; non-retriable tasks next; actors only as the last
+        resort (killing one loses state)."""
+        leased = [w for w in self.workers.values()
+                  if w.leased and w.proc is not None]
+        for group in (
+            [w for w in leased if not w.is_actor and w.lease_retriable],
+            [w for w in leased if not w.is_actor and not w.lease_retriable],
+            [w for w in leased if w.is_actor],
+        ):
+            if group:
+                return max(group, key=lambda w: w.lease_granted_at)
+        return None
+
+    async def _memory_monitor_loop(self) -> None:
+        period = self.config.memory_monitor_refresh_ms / 1000.0
+        threshold = self.config.memory_usage_threshold
+        while not self._closing:
+            await asyncio.sleep(period)
+            try:
+                used = self._memory_used_fraction()
+                if used <= threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                logger.warning(
+                    "memory pressure %.0f%% > %.0f%%: killing worker "
+                    "%s (pid %d) to protect the node; its task will be "
+                    "retried", used * 100, threshold * 100,
+                    victim.worker_id.hex()[:12], victim.pid)
+                victim.proc.kill()
+                self._on_worker_dead(
+                    victim, f"killed by memory monitor at "
+                            f"{used:.0%} used")
+            except Exception:
+                logger.exception("memory monitor iteration failed")
 
     def _forget_worker_logs(self, pid: int) -> None:
         for path in [p for p, wpid in self._log_pids.items()
@@ -462,7 +535,8 @@ class Raylet:
         self._pending_leases.append(PendingLease(
             request=data, future=fut, job_id_bin=job_id_bin,
             resources=resources, bundle=bundle,
-            env_hash=data.get("env_hash")))
+            env_hash=data.get("env_hash"),
+            retriable=bool(data.get("retriable", True))))
         self._maybe_schedule()
         return await fut
 
@@ -554,6 +628,8 @@ class Raylet:
             worker.leased = True
             worker.lease_resources = lease.resources
             worker.lease_bundle = lease.bundle
+            worker.lease_retriable = lease.retriable
+            worker.lease_granted_at = time.monotonic()
             if lease.env_hash is not None:
                 worker.env_hash = lease.env_hash
             lease.future.set_result({

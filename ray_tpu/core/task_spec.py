@@ -78,6 +78,8 @@ class TaskSpec:
     # part of the scheduling key: workers are dedicated per env.
     runtime_env: Optional[Dict[str, Any]] = None
     runtime_env_hash: Optional[str] = None
+    # W3C traceparent carrier (opt-in tracing; util/tracing)
+    trace_context: Optional[Dict[str, str]] = None
     # Attempt counter (incremented on retries) — return object IDs stay
     # stable across attempts, matching the reference's semantics.
     attempt_number: int = 0

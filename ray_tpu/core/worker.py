@@ -83,6 +83,14 @@ def _renv_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
     from ray_tpu.runtime_env import env_hash
     return env_hash(runtime_env)
 
+
+def _trace_carrier() -> Optional[Dict[str, str]]:
+    from ray_tpu.util.tracing.tracing_helper import (current_trace_context,
+                                                     is_tracing_enabled)
+    if not is_tracing_enabled():
+        return None
+    return current_trace_context()
+
 _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
 
@@ -738,6 +746,7 @@ class CoreWorker:
             depth=self._ctx.attempt_number,
             runtime_env=runtime_env,
             runtime_env_hash=_renv_hash(runtime_env),
+            trace_context=_trace_carrier(),
         )
         self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
@@ -855,9 +864,15 @@ class CoreWorker:
                 "bundle_index": strat.bundle_index,
                 "backlog": len(state.backlog),
                 "env_hash": spec.runtime_env_hash,
+                "retriable": spec.max_retries > 0,
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
-            self._fail_backlog(state, WorkerCrashedError(
+            if raylet_address != self.raylet_address:
+                self._pool.invalidate(raylet_address)
+            # the raylet died mid-lease (e.g. its node was killed): a
+            # crash-class fault, so queued tasks retry against a fresh
+            # lease (their retry budgets apply) instead of failing
+            self._retry_backlog(state, WorkerCrashedError(
                 f"lease request failed: {e}"))
             return
         if reply.get("spillback"):
@@ -878,6 +893,12 @@ class CoreWorker:
         while state.backlog:
             spec = state.backlog.popleft()
             self._fail_task(spec, error)
+
+    def _retry_backlog(self, state: "_LeaseState",
+                       error: Exception) -> None:
+        while state.backlog:
+            spec = state.backlog.popleft()
+            self._retry_or_fail(spec, error)
 
     async def _push_task(self, state: "_LeaseState", worker: "_LeasedWorker",
                          spec: TaskSpec) -> None:
@@ -990,6 +1011,7 @@ class CoreWorker:
             scheduling_strategy=scheduling_strategy or SchedulingStrategy(),
             runtime_env=runtime_env,
             runtime_env_hash=_renv_hash(runtime_env),
+            trace_context=_trace_carrier(),
         )
         strat = spec.scheduling_strategy
         reply = self._run(self.gcs_conn.call("register_actor", {
@@ -1038,6 +1060,7 @@ class CoreWorker:
             max_retries=max_task_retries,
             owner_address=self.address,
             actor_id=actor_id,
+            trace_context=_trace_carrier(),
         )
         self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
@@ -1384,7 +1407,14 @@ class CoreWorker:
             self._ensure_runtime_env(spec)
             args, kwargs = self._resolve_args(spec)
             fn = self._resolve_callable(spec)
-            value = fn(*args, **kwargs)
+            if spec.trace_context is not None:
+                from ray_tpu.util.tracing.tracing_helper import \
+                    execute_with_trace
+                value = execute_with_trace(fn, spec.function_descriptor,
+                                           spec.trace_context,
+                                           *args, **kwargs)
+            else:
+                value = fn(*args, **kwargs)
             if asyncio.iscoroutine(value):
                 value = asyncio.run(value)
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:

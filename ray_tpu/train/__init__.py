@@ -9,5 +9,12 @@ from ray_tpu.train.config import (  # noqa: F401
     RunConfig,
     ScalingConfig,
 )
-from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401
+from ray_tpu.train.predictor import (  # noqa: F401
+    BatchPredictor,
+    JaxPredictor,
+    Predictor,
+    SklearnPredictor,
+)
+from ray_tpu.train.sklearn import SklearnTrainer  # noqa: F401
+from ray_tpu.train.trainer import JaxTrainer, Result, TorchTrainer  # noqa: F401
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401

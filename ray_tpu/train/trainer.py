@@ -50,6 +50,9 @@ class Result:
 
 
 class JaxTrainer:
+    #: which runtime setup_backend installs on the gang
+    _backend = "jax"
+
     def __init__(self, train_loop_per_worker: Callable[[Dict[str, Any]], None],
                  *, train_loop_config: Optional[Dict[str, Any]] = None,
                  scaling_config: Optional[ScalingConfig] = None,
@@ -101,7 +104,7 @@ class JaxTrainer:
         group = WorkerGroup(self.scaling_config)
         try:
             group.start()
-            group.setup_backend()
+            group.setup_backend(self._backend)
             shards = self._shard_datasets()
             group.run(self._fn, self._config, shards, resume)
             last_metrics: Dict[str, Any] = {}
@@ -156,3 +159,17 @@ class _GangFailure(RuntimeError):
 
 class _TrainLoopError(RuntimeError):
     pass
+
+
+class TorchTrainer(JaxTrainer):
+    """Data-parallel torch training over gang actors.
+
+    Parity: reference ``train/torch/torch_trainer.py`` — same fit/report
+    contract as :class:`JaxTrainer`, but ``setup_backend`` runs the gloo
+    process-group rendezvous so ``train_loop_per_worker`` can use
+    ``torch.distributed`` collectives / DDP.  In this TPU-first stack
+    torch is the CPU on-ramp (feature parity for torch users); the
+    accelerator path is :class:`JaxTrainer`.
+    """
+
+    _backend = "torch"

@@ -52,6 +52,20 @@ class TrainWorker:
         s.close()
         return (socket.gethostbyname(socket.gethostname()), port)
 
+    def setup_torch(self, init_method: str) -> bool:
+        """torch.distributed gloo rendezvous (parity: the reference's
+        _setup_torch_process_group, train/torch/config.py:69-113 — TCP
+        store at rank 0; gloo because this stack's accelerators speak
+        XLA, so torch collectives run on host CPU)."""
+        import torch.distributed as dist
+
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        dist.init_process_group("gloo", init_method=init_method,
+                                rank=self.world_rank,
+                                world_size=self.world_size)
+        return True
+
     def setup_jax(self, coordinator: Optional[str], use_tpu: bool) -> bool:
         """Initialize the jax runtime for this worker.
 
@@ -162,7 +176,13 @@ class WorkerGroup:
         # barrier: all actors alive
         ray_tpu.get([w.__ray_ready__() for w in self.workers], timeout=300)
 
-    def setup_backend(self) -> None:
+    def setup_backend(self, backend: str = "jax") -> None:
+        if backend == "torch":
+            host, port = ray_tpu.get(
+                self.workers[0].hostname_and_port.remote(), timeout=60)
+            ray_tpu.get([w.setup_torch.remote(f"tcp://{host}:{port}")
+                         for w in self.workers], timeout=600)
+            return
         use_tpu = (self.scaling.tpus_per_worker or 0) > 0
         coordinator = None
         if self.scaling.num_workers > 1 and use_tpu:

@@ -143,3 +143,40 @@ def test_lineage_reconstruction():
         "object_free", {"object_ids": [ref.id().binary()]}))
     out = ray_tpu.get(ref, timeout=120)
     assert out[0] == 7.0 and out.shape == (500_000,)
+
+
+def test_memory_monitor_victim_policy():
+    """Retriable-LIFO: task workers before actors (parity:
+    worker_killing_policy.h:30). Pure-unit on the policy function."""
+    from ray_tpu.core.raylet import Raylet, WorkerHandle
+    from ray_tpu.core.ids import WorkerID
+
+    class FakeProc:
+        def kill(self):
+            self.killed = True
+
+    def handle(is_actor, granted_at, retriable=True):
+        return WorkerHandle(
+            worker_id=WorkerID.from_random(), pid=0, job_id_bin=None,
+            conn=None, task_address=("x", 0), proc=FakeProc(),
+            leased=True, is_actor=is_actor,
+            lease_retriable=retriable, lease_granted_at=granted_at)
+
+    workers = {}
+    a = handle(True, 5.0)
+    t_nonretry = handle(False, 4.0, retriable=False)
+    t1 = handle(False, 1.0)
+    t2 = handle(False, 2.0)
+    for w in (a, t_nonretry, t1, t2):
+        workers[w.worker_id] = w
+    fake = type("R", (), {"workers": workers})()
+    # retriable tasks first (newest lease), then non-retriable tasks,
+    # actors only as last resort
+    assert Raylet._pick_oom_victim(fake) is t2
+    t2.leased = False
+    assert Raylet._pick_oom_victim(fake) is t1
+    t1.leased = False
+    assert Raylet._pick_oom_victim(fake) is t_nonretry
+    t_nonretry.leased = False
+    assert Raylet._pick_oom_victim(fake) is a
+    assert Raylet._memory_used_fraction() > 0.0

@@ -121,3 +121,32 @@ def test_jax_training_loop_on_workers():
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["final_loss"] < 0.1
+
+
+def test_torch_trainer_ddp_gloo():
+    """TorchTrainer: gloo process group across gang actors; allreduce
+    averages gradients like DDP (parity model: reference
+    train/tests/test_torch_trainer.py)."""
+    from ray_tpu.train import ScalingConfig, TorchTrainer
+    from ray_tpu.train import session
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+
+        rank = session.get_world_rank()
+        world = session.get_world_size()
+        assert dist.is_initialized()
+        assert dist.get_rank() == rank
+        # simple DDP step: each rank holds rank-dependent "gradients";
+        # allreduce-mean must agree everywhere
+        t = torch.full((4,), float(rank))
+        dist.all_reduce(t, op=dist.ReduceOp.SUM)
+        t /= world
+        session.report({"avg0": float(t[0]), "rank": rank})
+
+    trainer = TorchTrainer(loop,
+                           scaling_config=ScalingConfig(num_workers=2))
+    result = trainer.fit()
+    expected = (0 + 1) / 2
+    assert result.metrics["avg0"] == expected
