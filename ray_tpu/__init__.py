@@ -260,6 +260,17 @@ def method(**options):
     return decorate
 
 
+def get_tpu_ids() -> List[int]:
+    """Chips leased to the current worker (parity: ``ray.get_gpu_ids``).
+
+    The raylet assigns the least-loaded chip indices to each TPU lease
+    and pushes them to the worker; inside a task or actor the list is
+    stable for the lease's lifetime (actors keep theirs across method
+    calls).  Fractional demands share a chip, whole-chip demands get
+    disjoint ids."""
+    return _worker_mod.global_worker().current_tpu_ids()
+
+
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Chrome-trace export of task events (reference ``ray.timeline``)."""
     from ray_tpu.experimental.state.api import timeline as _timeline

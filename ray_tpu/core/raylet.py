@@ -64,6 +64,10 @@ class WorkerHandle:
     #: whether the leased work survives a kill (owner retries it)
     lease_retriable: bool = True
     lease_granted_at: float = 0.0
+    #: chip indices assigned to this lease (parity: raylet GPU-id
+    #: assignment backing ray.get_gpu_ids)
+    lease_tpu_ids: List[int] = field(default_factory=list)
+    lease_tpu_share: float = 0.0
     is_actor: bool = False
 
 
@@ -139,6 +143,11 @@ class Raylet:
 
         # cluster view for spillback (refreshed from GCS health replies)
         self._cluster_view: List[Dict[str, Any]] = []
+        # per-chip fractional load for TPU-id assignment (whole-chip
+        # leases get disjoint ids because availability gating keeps the
+        # total demand <= chip count)
+        self._tpu_load: Dict[int, float] = {
+            i: 0.0 for i in range(int(self.resources_total.get("TPU", 0)))}
         # log monitor state: file path -> (offset, pid)
         self._log_pids: Dict[str, int] = {}
         self._log_offsets: Dict[str, int] = {}
@@ -632,6 +641,7 @@ class Raylet:
             worker.lease_granted_at = time.monotonic()
             if lease.env_hash is not None:
                 worker.env_hash = lease.env_hash
+            self._assign_tpu_ids(worker, lease.resources.get("TPU", 0.0))
             lease.future.set_result({
                 "granted": True,
                 "worker_address": worker.task_address,
@@ -682,12 +692,41 @@ class Raylet:
         self._maybe_schedule()
         return True
 
+    def _assign_tpu_ids(self, worker: WorkerHandle, tpus: float) -> None:
+        """Pick the least-loaded chips for this lease and tell the worker
+        (parity: the reference raylet's GPU-id resource assignment that
+        ray.get_gpu_ids reads).  Fractional demands share a chip."""
+        if tpus <= 0 or not self._tpu_load:
+            return
+        k = max(1, int(tpus))
+        ids = sorted(self._tpu_load, key=self._tpu_load.get)[:k]
+        share = tpus / k
+        for i in ids:
+            self._tpu_load[i] += share
+        worker.lease_tpu_ids = ids
+        worker.lease_tpu_share = share
+        try:
+            worker.conn.push("lease_tpu_ids", {"ids": ids})
+        except Exception:
+            pass
+
     def _release_lease_resources(self, worker: WorkerHandle) -> None:
         if worker.leased:
             self._give(worker.lease_resources, worker.lease_bundle)
             worker.leased = False
             worker.lease_resources = {}
             worker.lease_bundle = None
+            if worker.lease_tpu_ids:
+                for i in worker.lease_tpu_ids:
+                    if i in self._tpu_load:
+                        self._tpu_load[i] = max(
+                            0.0, self._tpu_load[i] - worker.lease_tpu_share)
+                worker.lease_tpu_ids = []
+                worker.lease_tpu_share = 0.0
+                try:
+                    worker.conn.push("lease_tpu_ids", {"ids": []})
+                except Exception:
+                    pass
 
     async def handle_lease_worker_for_actor(self, conn, data):
         """GCS asks this node to host an actor: lease a worker, push the

@@ -148,6 +148,8 @@ class _TaskContext(threading.local):
     put_counter: Optional[_Counter] = None
     actor_id: Optional[ActorID] = None
     attempt_number: int = 0
+    #: resource demand of the task currently executing on this thread
+    current_resources: Optional[Dict[str, float]] = None
 
 
 class CoreWorker:
@@ -209,6 +211,7 @@ class CoreWorker:
         self.task_address: Optional[rpc.Address] = None
         self._shutdown = False
         self._task_events: List[Dict[str, Any]] = []
+        self._lease_tpu_ids: List[int] = []
 
         self._run(self._async_init())
         set_global_worker(self)
@@ -1181,6 +1184,11 @@ class CoreWorker:
         raise ActorDiedError(state.actor_id.hex()[:12],
                              "timed out resolving actor address")
 
+    def current_lease_resources(self) -> Dict[str, float]:
+        """Resource demand of the currently-executing task (empty in a
+        driver or outside task execution)."""
+        return dict(self._ctx.current_resources or {})
+
     def gcs_call(self, method: str, data: Optional[dict] = None,
                  timeout: float = 30.0):
         """Generic GCS RPC (autoscaler monitor, state API, dashboards)."""
@@ -1396,12 +1404,13 @@ class CoreWorker:
     def _execute_task(self, spec: TaskSpec) -> Dict[str, Any]:
         """Run one task on this thread; returns the wire reply."""
         prev = (self._ctx.task_id, self._ctx.put_counter,
-                self._ctx.attempt_number)
+                self._ctx.attempt_number, self._ctx.current_resources)
         self._ctx.task_id = spec.task_id
         self._ctx.put_counter = _Counter()
         self._ctx.attempt_number = spec.attempt_number
         if self.job_id is None:
             self.job_id = spec.job_id
+        self._ctx.current_resources = dict(spec.resources)
         try:
             self._apply_job_syspath(spec.job_id)
             self._ensure_runtime_env(spec)
@@ -1442,7 +1451,7 @@ class CoreWorker:
             return {"results": results, "app_error": True}
         finally:
             (self._ctx.task_id, self._ctx.put_counter,
-             self._ctx.attempt_number) = prev
+             self._ctx.attempt_number, self._ctx.current_resources) = prev
 
     def _post_return(self, object_id: ObjectID, value: Any,
                      spec: TaskSpec) -> Tuple[bytes, str, Any]:
@@ -1537,6 +1546,13 @@ class CoreWorker:
             fn = cloudpickle.loads(blob)
             self._function_cache[function_id] = fn
         return fn
+
+    def push_lease_tpu_ids(self, conn, data) -> None:
+        """Raylet tells this worker which chips its lease holds."""
+        self._lease_tpu_ids = list(data.get("ids", []))
+
+    def current_tpu_ids(self) -> List[int]:
+        return list(self._lease_tpu_ids)
 
     def push_kill_actor(self, conn, data) -> None:
         """Forced actor kill (GCS or owner initiated)."""

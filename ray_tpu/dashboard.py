@@ -99,6 +99,23 @@ class Dashboard:
                            "available_resources": avail,
                            "object_store": stores})
 
+    async def handle_serve(self, request):
+        """Serve application status (parity: serve REST api/serve/
+        applications — reference serve/schema.py status surface)."""
+        def fetch():
+            import ray_tpu
+            from ray_tpu.serve._internal import CONTROLLER_NAME
+            try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            except Exception:
+                return {}  # serve never started — a GET must not start it
+            try:
+                return ray_tpu.get(
+                    controller.list_deployments.remote(), timeout=30)
+            except Exception:
+                return {}
+        return self._json(await self._state(fetch))
+
     async def handle_metrics(self, request):
         from ray_tpu.core import worker as worker_mod
 
@@ -116,6 +133,7 @@ class Dashboard:
         app.router.add_get("/api/tasks", self.handle_tasks)
         app.router.add_get("/api/placement_groups", self.handle_pgs)
         app.router.add_get("/api/cluster_status", self.handle_cluster_status)
+        app.router.add_get("/api/serve/applications", self.handle_serve)
         app.router.add_get("/metrics", self.handle_metrics)
         try:
             from ray_tpu.job.job_head import add_job_routes

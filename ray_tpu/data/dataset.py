@@ -46,6 +46,11 @@ def _fused_map_meta(block: Block, stages) -> Tuple[Block, BlockMetadata]:
     return out, BlockAccessor(out).metadata()
 
 
+def _py(v):
+    """numpy scalar -> python scalar for json writers."""
+    return v.item() if hasattr(v, "item") else v
+
+
 @ray_tpu.remote
 def _concat_task(*blocks: Block) -> Block:
     return concat_blocks(list(blocks))
@@ -466,6 +471,50 @@ class Dataset:
     # ------------------------------------------------------------------
     def num_blocks(self) -> int:
         return len(self._blocks)
+
+    # -- writes (reference Dataset.write_csv/json/parquet/numpy) -------
+    def _write_blocks(self, path: str, writer, extension: str) -> List[str]:
+        import os as _os
+
+        _os.makedirs(path, exist_ok=True)
+        blocks = self._executed_blocks()
+
+        @ray_tpu.remote
+        def _write(block: Block, out_path: str) -> str:
+            writer(block, out_path)
+            return out_path
+
+        outs = [
+            _write.remote(b, _os.path.join(
+                path, f"part-{i:05d}.{extension}"))
+            for i, b in enumerate(blocks)
+        ]
+        return ray_tpu.get(outs)
+
+    def write_csv(self, path: str) -> List[str]:
+        def w(block, out):
+            BlockAccessor(block).to_pandas().to_csv(out, index=False)
+        return self._write_blocks(path, w, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        def w(block, out):
+            import json as _json
+            df = BlockAccessor(block).to_pandas()
+            with open(out, "w") as f:
+                for rec in df.to_dict(orient="records"):
+                    f.write(_json.dumps(
+                        {k: _py(v) for k, v in rec.items()}) + "\n")
+        return self._write_blocks(path, w, "json")
+
+    def write_parquet(self, path: str) -> List[str]:
+        def w(block, out):
+            BlockAccessor(block).to_pandas().to_parquet(out)
+        return self._write_blocks(path, w, "parquet")
+
+    def write_numpy(self, path: str, column: str = "data") -> List[str]:
+        def w(block, out):
+            np.save(out, BlockAccessor(block).to_numpy(column))
+        return self._write_blocks(path, w, "npy")
 
     def size_bytes(self) -> int:
         """Total bytes across materialized blocks (reference

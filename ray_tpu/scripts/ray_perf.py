@@ -116,6 +116,26 @@ def main() -> List[Dict[str, float]]:
     print(f"{'get throughput':<44} {res['gbps']:>12.2f} Gbps")
     r(res)
 
+    # -- control plane --------------------------------------------------
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    def pg_cycle():
+        pg = placement_group([{"CPU": 0.01}])
+        pg.wait(30)
+        remove_placement_group(pg)
+    r(timeit("placement group create+remove", pg_cycle))
+
+    def actor_burst():
+        # pipelined creation (how the reference's many_actors suite
+        # measures actors/s — creations overlap worker spawns)
+        actors = [_Actor.remote() for _ in range(16)]
+        ray_tpu.get([a.noop.remote() for a in actors])
+        for a in actors:
+            ray_tpu.kill(a)
+    r(timeit("actor create+first-call (pipelined x16)", actor_burst,
+             multiplier=16))
+
     print(json.dumps({"microbenchmark": results}, default=float))
     if own:
         ray_tpu.shutdown()

@@ -175,3 +175,38 @@ def test_runtime_context():
     task_id, node_id = ray_tpu.get(get_ctx.remote(), timeout=60)
     assert task_id is not None
     assert node_id == ctx.get_node_id()  # single-node cluster
+
+
+def test_get_tpu_ids():
+    """parity: ray.get_gpu_ids — chips leased to the running task."""
+    @ray_tpu.remote
+    def no_tpu():
+        return ray_tpu.get_tpu_ids()
+
+    assert ray_tpu.get(no_tpu.remote(), timeout=60) == []
+    assert ray_tpu.get_tpu_ids() == []  # driver holds no lease
+
+
+def test_get_tpu_ids_assignment(shutdown_only):
+    """Raylet assigns disjoint chip ids to whole-chip leases; actors
+    keep theirs across calls."""
+    import ray_tpu as rt
+    rt.shutdown()
+    rt.init(num_cpus=4, resources={"TPU": 4})
+
+    @rt.remote(num_tpus=2)
+    def two_chips():
+        return rt.get_tpu_ids()
+
+    ids = rt.get(two_chips.remote(), timeout=60)
+    assert len(ids) == 2 and len(set(ids)) == 2
+
+    @rt.remote(num_tpus=1)
+    class ChipActor:
+        def ids(self):
+            return rt.get_tpu_ids()
+
+    a = ChipActor.remote()
+    first = rt.get(a.ids.remote(), timeout=60)
+    assert len(first) == 1
+    assert rt.get(a.ids.remote(), timeout=30) == first  # stable

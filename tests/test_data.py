@@ -224,3 +224,14 @@ def test_read_text_and_size_bytes(tmp_path):
     assert [r["text"] for r in ds.take_all()] == ["alpha", "beta", "gamma"]
     nums = ray_tpu.data.range(100, parallelism=4)
     assert nums.size_bytes() >= 100 * 8
+
+
+def test_write_and_read_roundtrip(tmp_path):
+    ds = ray_tpu.data.range(50, parallelism=4)
+    paths = ds.write_csv(str(tmp_path / "csv"))
+    assert len(paths) == 4
+    back = ray_tpu.data.read_csv(str(tmp_path / "csv"))
+    assert sorted(r["id"] for r in back.take_all()) == list(range(50))
+    ds.write_json(str(tmp_path / "json"))
+    back = ray_tpu.data.read_json(str(tmp_path / "json"))
+    assert sorted(r["id"] for r in back.take_all()) == list(range(50))
