@@ -16,7 +16,6 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
-from ray_tpu.air import Result
 from ray_tpu.train.checkpoint import Checkpoint
 
 
@@ -54,7 +53,11 @@ class SklearnTrainer:
         self.feature_columns = feature_columns
         self.fit_params = fit_params or {}
 
-    def fit(self) -> Result:
+    def fit(self):
+        # air.Result; imported here — ray_tpu.air re-exports train
+        # modules, so a module-level import would be circular
+        from ray_tpu.air import Result
+
         train_ds = self.datasets["train"]
         blocks = train_ds.get_internal_block_refs()
         fitted_pkl, train_score, cols = ray_tpu.get(
