@@ -10,3 +10,4 @@ from ray_tpu.rllib.algorithms.impala import (  # noqa: F401
     ImpalaPolicy,
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, PPOPolicy  # noqa: F401
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACPolicy  # noqa: F401

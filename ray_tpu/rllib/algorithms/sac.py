@@ -1,0 +1,328 @@
+"""Soft Actor-Critic (continuous control, off-policy).
+
+Parity: reference ``rllib/algorithms/sac/`` — squashed-Gaussian actor,
+twin Q critics with target networks (clipped double-Q), entropy-
+regularized objectives with a learned temperature alpha against a
+target entropy, replay-driven updates.  jax-native: actor, critic and
+alpha updates run in one jitted program per minibatch; targets are
+parameter trees passed into the same program and Polyak-averaged
+outside it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env import Box
+from ray_tpu.rllib.execution import synchronous_parallel_sample
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005  # Polyak factor for target critics
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 1
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.initial_alpha = 1.0
+        self.target_entropy: Any = "auto"  # -|A| when auto
+        self.training_intensity = 1.0
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+class _SquashedActor(nn.Module):
+    act_dim: int
+    hiddens: tuple = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+        mean = nn.Dense(self.act_dim, name="mean")(x)
+        log_std = jnp.clip(nn.Dense(self.act_dim, name="log_std")(x),
+                           -20.0, 2.0)
+        return mean, log_std
+
+
+class _TwinQ(nn.Module):
+    hiddens: tuple = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        def q(name):
+            x = jnp.concatenate([obs, act], axis=-1)
+            for i, h in enumerate(self.hiddens):
+                x = nn.relu(nn.Dense(h, name=f"{name}_fc_{i}")(x))
+            return nn.Dense(1, name=f"{name}_out")(x)[..., 0]
+        return q("q1"), q("q2")
+
+
+def _sample_squashed(mean, log_std, rng):
+    """tanh-squashed Gaussian sample + log prob (SAC appendix C)."""
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    act = jnp.tanh(pre)
+    logp = jnp.sum(
+        -0.5 * (eps ** 2) - log_std - 0.5 * jnp.log(2 * jnp.pi)
+        - jnp.log(1 - act ** 2 + 1e-6), axis=-1)
+    return act, logp
+
+
+class SACPolicy(JaxPolicy):
+    """Replaces the FCNet actor-critic wholesale: SAC needs its own
+    actor/critic/alpha structure, so only the rollout-facing surface of
+    JaxPolicy is reused."""
+
+    def __init__(self, observation_space, action_space, config):
+        if not isinstance(action_space, Box):
+            raise ValueError("SAC requires a continuous (Box) action space")
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config
+        self.act_dim = int(np.prod(action_space.shape))
+        obs_dim = int(np.prod(observation_space.shape))
+        # bounds for rescaling tanh output into the env's range
+        self._low = np.asarray(action_space.low, np.float32)
+        self._high = np.asarray(action_space.high, np.float32)
+
+        if config.get("_device") == "cpu":
+            self._device = jax.devices("cpu")[0]
+        else:
+            self._device = None
+
+        with self._on_device():
+            rng = jax.random.PRNGKey(int(config.get("seed", 0) or 0))
+            self._rng, a_rng, c_rng = jax.random.split(rng, 3)
+            dummy_o = jnp.zeros((1, obs_dim))
+            dummy_a = jnp.zeros((1, self.act_dim))
+            self.actor = _SquashedActor(self.act_dim)
+            self.critic = _TwinQ()
+            self.actor_params = self.actor.init(a_rng, dummy_o)
+            self.critic_params = self.critic.init(c_rng, dummy_o, dummy_a)
+            self.target_critic_params = self.critic_params
+            self.log_alpha = jnp.log(
+                jnp.float32(config.get("initial_alpha", 1.0)))
+            lr = float(config.get("lr", 3e-4))
+            self.actor_opt = optax.adam(lr)
+            self.critic_opt = optax.adam(lr)
+            self.alpha_opt = optax.adam(lr)
+            self.actor_opt_state = self.actor_opt.init(self.actor_params)
+            self.critic_opt_state = self.critic_opt.init(self.critic_params)
+            self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self._np_rng = np.random.default_rng(int(config.get("seed", 0) or 0))
+
+        te = config.get("target_entropy", "auto")
+        self.target_entropy = float(-self.act_dim if te == "auto" else te)
+        gamma = float(config.get("gamma", 0.99))
+        target_entropy = self.target_entropy
+        actor, critic = self.actor, self.critic
+
+        @jax.jit
+        def _act(actor_params, obs, rng):
+            mean, log_std = actor.apply(actor_params, obs)
+            act, _ = _sample_squashed(mean, log_std, rng)
+            return act
+
+        @jax.jit
+        def _act_greedy(actor_params, obs):
+            mean, _ = actor.apply(actor_params, obs)
+            return jnp.tanh(mean)
+
+        @jax.jit
+        def _update(actor_params, critic_params, target_params, log_alpha,
+                    a_opt, c_opt, al_opt, batch, rng):
+            obs = batch[SampleBatch.OBS]
+            nobs = batch[SampleBatch.NEXT_OBS]
+            acts = batch[SampleBatch.ACTIONS]
+            rew = batch[SampleBatch.REWARDS]
+            done = batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+            rng1, rng2 = jax.random.split(rng)
+            alpha = jnp.exp(log_alpha)
+
+            # critic target: r + gamma * (min Q_target(s', a') - alpha logp)
+            nmean, nlstd = actor.apply(actor_params, nobs)
+            nact, nlogp = _sample_squashed(nmean, nlstd, rng1)
+            tq1, tq2 = critic.apply(target_params, nobs, nact)
+            target = rew + gamma * (1 - done) * (
+                jnp.minimum(tq1, tq2) - alpha * nlogp)
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(p):
+                q1, q2 = critic.apply(p, obs, acts)
+                return jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(critic_params)
+            c_up, c_opt = self.critic_opt.update(c_grads, c_opt)
+            critic_params = optax.apply_updates(critic_params, c_up)
+
+            def actor_loss(p):
+                mean, log_std = actor.apply(p, obs)
+                act, logp = _sample_squashed(mean, log_std, rng2)
+                q1, q2 = critic.apply(critic_params, obs, act)
+                return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(actor_params)
+            a_up, a_opt = self.actor_opt.update(a_grads, a_opt)
+            actor_params = optax.apply_updates(actor_params, a_up)
+
+            def alpha_loss(la):
+                return -jnp.mean(jnp.exp(la)
+                                 * jax.lax.stop_gradient(
+                                     logp + target_entropy))
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(log_alpha)
+            al_up, al_opt = self.alpha_opt.update(al_grad, al_opt)
+            log_alpha = optax.apply_updates(log_alpha, al_up)
+
+            stats = {"critic_loss": c_loss, "actor_loss": a_loss,
+                     "alpha": jnp.exp(log_alpha),
+                     "mean_q": jnp.mean(target)}
+            return (actor_params, critic_params, log_alpha,
+                    a_opt, c_opt, al_opt, stats)
+
+        self._act_fn = _act
+        self._act_greedy_fn = _act_greedy
+        self._update_fn = _update
+
+    # _on_device / _device_batch inherited from JaxPolicy (they only
+    # depend on self._device)
+
+    def _rescale(self, act: np.ndarray) -> np.ndarray:
+        if np.all(np.isfinite(self._low)) and np.all(np.isfinite(self._high)):
+            return (self._low + (act + 1.0) * 0.5
+                    * (self._high - self._low)).astype(np.float32)
+        return act
+
+    # -- rollout surface (matches JaxPolicy's contract) -----------------
+    def compute_actions(self, obs, explore: bool = True):
+        with self._on_device():
+            obs = jnp.asarray(obs, jnp.float32)
+            if explore:
+                self._rng, rng = jax.random.split(self._rng)
+                act = self._act_fn(self.actor_params, obs, rng)
+            else:
+                act = self._act_greedy_fn(self.actor_params, obs)
+        return self._rescale(np.asarray(act)), {}
+
+    def postprocess_trajectory(self, batch, last_obs=None, truncated=False):
+        return batch  # replay stores raw transitions
+
+    def _normalize_actions(self, acts: np.ndarray) -> np.ndarray:
+        """Env-scale -> tanh-scale: the critic/actor operate entirely in
+        [-1, 1]; replay stores what the env consumed."""
+        if np.all(np.isfinite(self._low)) and np.all(np.isfinite(self._high)):
+            return (2.0 * (acts - self._low)
+                    / (self._high - self._low) - 1.0).astype(np.float32)
+        return acts
+
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        tau = float(self.config.get("tau", 0.005))
+        batch = SampleBatch(dict(
+            batch, **{SampleBatch.ACTIONS: self._normalize_actions(
+                np.asarray(batch[SampleBatch.ACTIONS]))}))
+        with self._on_device():
+            dev = self._device_batch(batch)
+            self._rng, rng = jax.random.split(self._rng)
+            (self.actor_params, self.critic_params, self.log_alpha,
+             self.actor_opt_state, self.critic_opt_state,
+             self.alpha_opt_state, stats) = self._update_fn(
+                self.actor_params, self.critic_params,
+                self.target_critic_params, self.log_alpha,
+                self.actor_opt_state, self.critic_opt_state,
+                self.alpha_opt_state, dev, rng)
+            # Polyak target update
+            self.target_critic_params = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p,
+                self.target_critic_params, self.critic_params)
+        return {k: float(v) for k, v in stats.items()}
+
+    # -- weights --------------------------------------------------------
+    def get_weights(self):
+        # rollout workers only act — the critic stays learner-side
+        # (halves weight-broadcast bytes; checkpoints carry it via
+        # get_state)
+        return jax.tree_util.tree_map(
+            np.asarray, {"actor": self.actor_params})
+
+    def set_weights(self, weights) -> None:
+        with self._on_device():
+            self.actor_params = jax.tree_util.tree_map(
+                jnp.asarray, weights["actor"])
+            if "critic" in weights:
+                self.critic_params = jax.tree_util.tree_map(
+                    jnp.asarray, weights["critic"])
+
+    def get_state(self):
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)
+        return {"weights": {"actor": to_np(self.actor_params),
+                            "critic": to_np(self.critic_params)},
+                "target_critic": to_np(self.target_critic_params),
+                "log_alpha": float(self.log_alpha),
+                "opt_states": to_np((self.actor_opt_state,
+                                     self.critic_opt_state,
+                                     self.alpha_opt_state))}
+
+    def set_state(self, state):
+        self.set_weights(state["weights"])
+        with self._on_device():
+            to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+            self.target_critic_params = to_dev(state["target_critic"])
+            self.log_alpha = jnp.float32(state["log_alpha"])
+            if "opt_states" in state:
+                (self.actor_opt_state, self.critic_opt_state,
+                 self.alpha_opt_state) = to_dev(state["opt_states"])
+
+    def compute_values(self, obs):  # JaxPolicy surface; unused by SAC
+        return np.zeros(len(obs), np.float32)
+
+
+class SAC(Algorithm):
+    policy_class = SACPolicy
+
+    def setup(self) -> None:
+        super().setup()
+        cfg = self.config
+        self.replay = ReplayBuffer(
+            int(cfg.get("replay_buffer_capacity", 100_000)),
+            seed=cfg.get("seed"))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy: SACPolicy = self.workers.local_worker.policy
+        fragment = max(1, int(cfg.get("rollout_fragment_length", 1))
+                       * int(cfg.get("num_envs_per_worker", 1)))
+        batch = synchronous_parallel_sample(self.workers,
+                                            max_env_steps=fragment)
+        self.replay.add(batch)
+        self._timesteps_total += len(batch)
+        stats: Dict[str, Any] = {"replay_size": len(self.replay)}
+        warmup = int(cfg.get("num_steps_sampled_before_learning_starts",
+                             1000))
+        bs = int(cfg.get("train_batch_size", 256))
+        if len(self.replay) >= max(warmup, bs):
+            updates = max(1, round(float(cfg.get("training_intensity", 1.0))
+                                   * len(batch)))
+            for _ in range(updates):
+                stats.update(policy.learn_on_batch(self.replay.sample(bs)))
+            self.workers.sync_weights()
+        return stats

@@ -124,3 +124,93 @@ def test_appo_smoke():
     assert np.isfinite(r["total_loss"])
     assert "mean_rho" in r
     algo.stop()
+
+
+class Pendulum:
+    """Classic pendulum swing-up (standard dynamics) — the canonical
+    continuous-control smoke env for SAC."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        from ray_tpu.rllib.env import Box
+        self.max_speed = 8.0
+        self.max_torque = 2.0
+        self.dt = 0.05
+        self.observation_space = Box(-np.inf, np.inf, (3,), np.float32)
+        self.action_space = Box(-self.max_torque, self.max_torque, (1,),
+                                np.float32)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self.max_episode_steps = int(config.get("max_episode_steps", 200))
+
+    def _obs(self):
+        th, thdot = self._state
+        return np.array([np.cos(th), np.sin(th), thdot], np.float32)
+
+    def reset(self, *, seed=None):
+        self._state = self._rng.uniform([-np.pi, -1.0], [np.pi, 1.0])
+        self._steps = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        th, thdot = self._state
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.max_torque, self.max_torque))
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = np.clip(
+            thdot + (3 * 10.0 / 2 * np.sin(th) + 3.0 * u) * self.dt,
+            -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        self._state = (th, thdot)
+        self._steps += 1
+        return self._obs(), -cost, False, self._steps >= self.max_episode_steps, {}
+
+
+def test_sac_learns_pendulum():
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    config = (SACConfig()
+              .environment(Pendulum,
+                           env_config={"max_episode_steps": 200,
+                                       "seed": 0})
+              .rollouts(rollout_fragment_length=64, num_envs_per_worker=1)
+              .training(train_batch_size=256, lr=1e-3,
+                        num_steps_sampled_before_learning_starts=500,
+                        training_intensity=1.0)
+              .debugging(seed=0))
+    algo = config.build()
+    best = -np.inf
+    for i in range(140):  # ~7k-9k env steps, ~60-80s
+        r = algo.train()
+        rm = r.get("episode_reward_mean")
+        if not np.isnan(rm):
+            best = max(best, rm)
+        if best > -650:
+            break
+    algo.stop()
+    # random pendulum policy sits near -1100..-1300
+    assert best > -650, best
+
+
+def test_sac_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    config = (SACConfig()
+              .environment(Pendulum, env_config={"max_episode_steps": 32,
+                                                 "seed": 1})
+              .rollouts(rollout_fragment_length=4)
+              .training(train_batch_size=32,
+                        num_steps_sampled_before_learning_starts=16)
+              .debugging(seed=1))
+    algo = config.build()
+    for _ in range(10):
+        algo.train()
+    path = algo.save(str(tmp_path / "sac"))
+    obs = np.zeros((1, 3), np.float32)
+    act_before, _ = algo.get_policy().compute_actions(obs, explore=False)
+    algo2 = config.build()
+    algo2.restore(path)
+    act_after, _ = algo2.get_policy().compute_actions(obs, explore=False)
+    np.testing.assert_allclose(act_before, act_after, rtol=1e-5)
+    algo.stop()
+    algo2.stop()
