@@ -14,6 +14,7 @@ from ray_tpu.rllib.env import (  # noqa: F401
     Box,
     CartPole,
     Discrete,
+    Pendulum,
     RandomEnv,
     make_env,
     register_env,

@@ -1,6 +1,34 @@
 """Algorithm zoo (reference ``rllib/algorithms/``)."""
 
-from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig, DQNPolicy  # noqa: F401
+from ray_tpu.rllib.algorithms.bandit import (  # noqa: F401
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+    BanditLinUCBConfig,
+)
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig, CQLPolicy  # noqa: F401
+from ray_tpu.rllib.algorithms.ddpg import (  # noqa: F401
+    DDPG,
+    DDPGConfig,
+    DDPGPolicy,
+    TD3,
+    TD3Config,
+)
+from ray_tpu.rllib.algorithms.dqn import (  # noqa: F401
+    ApexDQN,
+    ApexDQNConfig,
+    DQN,
+    DQNConfig,
+    DQNPolicy,
+    SimpleQ,
+    SimpleQConfig,
+)
+from ray_tpu.rllib.algorithms.es import (  # noqa: F401
+    ARS,
+    ARSConfig,
+    ES,
+    ESConfig,
+)
 from ray_tpu.rllib.algorithms.impala import (  # noqa: F401
     APPO,
     APPOConfig,
@@ -8,6 +36,23 @@ from ray_tpu.rllib.algorithms.impala import (  # noqa: F401
     IMPALA,
     ImpalaConfig,
     ImpalaPolicy,
+)
+from ray_tpu.rllib.algorithms.marwil import (  # noqa: F401
+    BC,
+    BCConfig,
+    MARWIL,
+    MARWILConfig,
+    MARWILPolicy,
+)
+from ray_tpu.rllib.algorithms.pg import (  # noqa: F401
+    A2C,
+    A2CConfig,
+    A2CPolicy,
+    A3C,
+    A3CConfig,
+    PG,
+    PGConfig,
+    PGPolicy,
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, PPOPolicy  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACPolicy  # noqa: F401

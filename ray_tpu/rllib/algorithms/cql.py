@@ -1,0 +1,177 @@
+"""CQL — Conservative Q-Learning (offline continuous control).
+
+Parity: reference ``rllib/algorithms/cql/`` — SAC machinery plus the
+conservative regularizer: logsumexp of Q over sampled (random + policy)
+actions minus Q on dataset actions, pushing Q down on out-of-
+distribution actions.  Trains purely from offline data (no env
+sampling); evaluation rolls real episodes.  jax-native: the penalty is
+computed inside the same single jitted update program as the SAC
+losses, with the N action samples drawn as one batched
+``jax.random`` call (no python loop over samples).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.sac import (SAC, SACConfig, SACPolicy,
+                                          _sample_squashed)
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.input_: Any = None          # offline data path (required)
+        self.cql_weight = 5.0            # alpha_prime on the penalty
+        self.cql_n_actions = 4           # sampled actions per state
+        self.train_batch_size = 256
+        self.updates_per_iteration = 10
+
+    def offline_data(self, *, input_: Any = None) -> "CQLConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    @property
+    def algo_class(self):
+        return CQL
+
+
+class CQLPolicy(SACPolicy):
+    """SACPolicy with the critic loss replaced by TD + conservative
+    penalty; actor/alpha updates unchanged."""
+
+    def __init__(self, observation_space, action_space, config):
+        super().__init__(observation_space, action_space, config)
+        actor, critic = self.actor, self.critic
+        gamma = float(config.get("gamma", 0.99))
+        n_act = int(config.get("cql_n_actions", 4))
+        cql_w = float(config.get("cql_weight", 5.0))
+        act_dim = self.act_dim
+        target_entropy = self.target_entropy
+
+        @jax.jit
+        def _update(actor_params, critic_params, target_params, log_alpha,
+                    a_opt, c_opt, al_opt, batch, rng):
+            obs = batch[SampleBatch.OBS]
+            nobs = batch[SampleBatch.NEXT_OBS]
+            acts = batch[SampleBatch.ACTIONS]
+            rew = batch[SampleBatch.REWARDS]
+            done = batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+            B = obs.shape[0]
+            rng1, rng2, rng3, rng4 = jax.random.split(rng, 4)
+            alpha = jnp.exp(log_alpha)
+
+            # --- SAC TD target
+            nmean, nlstd = actor.apply(actor_params, nobs)
+            nact, nlogp = _sample_squashed(nmean, nlstd, rng1)
+            tq1, tq2 = critic.apply(target_params, nobs, nact)
+            target = rew + gamma * (1 - done) * (
+                jnp.minimum(tq1, tq2) - alpha * nlogp)
+            target = jax.lax.stop_gradient(target)
+
+            # candidate actions for the conservative term: N uniform +
+            # N current-policy samples, evaluated batched via reshape
+            rand_act = jax.random.uniform(
+                rng3, (n_act * B, act_dim), minval=-1.0, maxval=1.0)
+            mean, lstd = actor.apply(actor_params, obs)
+            mean_r = jnp.repeat(mean, n_act, axis=0)
+            lstd_r = jnp.repeat(lstd, n_act, axis=0)
+            pol_act, pol_logp = _sample_squashed(mean_r, lstd_r, rng4)
+            pol_act = jax.lax.stop_gradient(pol_act)
+            pol_logp = jax.lax.stop_gradient(pol_logp)
+            obs_r = jnp.repeat(obs, n_act, axis=0)
+
+            def critic_loss(p):
+                q1, q2 = critic.apply(p, obs, acts)
+                td = jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+                rq1, rq2 = critic.apply(p, obs_r, rand_act)
+                pq1, pq2 = critic.apply(p, obs_r, pol_act)
+                # importance-weighted logsumexp (CQL(H)): uniform density
+                # 0.5^d for random actions, policy logp for policy actions
+                log_u = -act_dim * jnp.log(2.0)
+                cat1 = jnp.concatenate([
+                    rq1.reshape(B, n_act) - log_u,
+                    pq1.reshape(B, n_act) - pol_logp.reshape(B, n_act)],
+                    axis=1)
+                cat2 = jnp.concatenate([
+                    rq2.reshape(B, n_act) - log_u,
+                    pq2.reshape(B, n_act) - pol_logp.reshape(B, n_act)],
+                    axis=1)
+                gap1 = jax.scipy.special.logsumexp(cat1, axis=1) \
+                    - jnp.log(2.0 * n_act) - q1
+                gap2 = jax.scipy.special.logsumexp(cat2, axis=1) \
+                    - jnp.log(2.0 * n_act) - q2
+                penalty = jnp.mean(gap1) + jnp.mean(gap2)
+                return td + cql_w * penalty, (td, penalty)
+
+            (c_loss, (td, penalty)), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(critic_params)
+            c_up, c_opt = self.critic_opt.update(c_grads, c_opt)
+            critic_params = optax.apply_updates(critic_params, c_up)
+
+            # --- SAC actor + alpha updates (unchanged)
+            def actor_loss(p):
+                m, ls = actor.apply(p, obs)
+                a, logp = _sample_squashed(m, ls, rng2)
+                q1, q2 = critic.apply(critic_params, obs, a)
+                return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(actor_params)
+            a_up, a_opt = self.actor_opt.update(a_grads, a_opt)
+            actor_params = optax.apply_updates(actor_params, a_up)
+
+            def alpha_loss(la):
+                return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(
+                    logp + target_entropy))
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(log_alpha)
+            al_up, al_opt = self.alpha_opt.update(al_grad, al_opt)
+            log_alpha = optax.apply_updates(log_alpha, al_up)
+
+            stats = {"critic_loss": c_loss, "td_loss": td,
+                     "cql_penalty": penalty, "actor_loss": a_loss,
+                     "alpha": jnp.exp(log_alpha)}
+            return (actor_params, critic_params, log_alpha,
+                    a_opt, c_opt, al_opt, stats)
+
+        self._update_fn = _update
+
+
+class CQL(SAC):
+    policy_class = CQLPolicy
+
+    def setup(self) -> None:
+        if not self.config.get("input_"):
+            raise ValueError("CQL requires offline data: "
+                             "config.offline_data(input_=path)")
+        super().setup()
+        # preload the entire offline dataset into the replay buffer
+        reader = JsonReader(self.config["input_"])
+        data = reader.read()
+        self.replay = ReplayBuffer(max(len(data), 1),
+                                   seed=self.config.get("seed"))
+        self.replay.add(data)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy: CQLPolicy = self.workers.local_worker.policy
+        bs = int(cfg.get("train_batch_size", 256))
+        stats: Dict[str, Any] = {"replay_size": len(self.replay)}
+        for _ in range(int(cfg.get("updates_per_iteration", 10))):
+            stats.update(policy.learn_on_batch(self.replay.sample(bs)))
+            self._timesteps_total += bs
+        self.workers.sync_weights()
+        return stats
+
+    def _collect_metrics(self):
+        return []  # offline: no env episodes

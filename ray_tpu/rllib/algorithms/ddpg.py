@@ -1,0 +1,310 @@
+"""DDPG and TD3 (deterministic continuous control, off-policy).
+
+Parity: reference ``rllib/algorithms/ddpg/`` and ``rllib/algorithms/td3/``
+— deterministic actor + Q critic with target networks and exploration
+noise; TD3 adds twin critics (clipped double-Q), target-policy
+smoothing, and delayed policy updates.  jax-native: the critic and
+(conditionally-executed, via ``lax.cond``) actor updates are one jitted
+program per minibatch, so the delayed-update schedule costs no
+recompilation; targets are Polyak-averaged in the same program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env import Box
+from ray_tpu.rllib.execution import synchronous_parallel_sample
+from ray_tpu.rllib.models import TwinQNetwork
+from ray_tpu.rllib.policy import (JaxPolicy, normalize_actions,
+                                  rescale_actions)
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 1
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.exploration_noise = 0.1   # N(0, sigma) on actions
+        self.training_intensity = 1.0
+        # TD3 extensions, off for plain DDPG
+        self.twin_q = False
+        self.policy_delay = 1
+        self.smooth_target_policy = False
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+
+    @property
+    def algo_class(self):
+        return DDPG
+
+
+class TD3Config(DDPGConfig):
+    """TD3 = DDPG + twin critics + delayed & smoothed policy updates
+    (reference ``td3/td3.py`` — a DDPGConfig preset)."""
+
+    def __init__(self):
+        super().__init__()
+        self.twin_q = True
+        self.policy_delay = 2
+        self.smooth_target_policy = True
+        self.exploration_noise = 0.1
+
+    @property
+    def algo_class(self):
+        return TD3
+
+
+class _DetActor(nn.Module):
+    act_dim: int
+    hiddens: tuple = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+        return jnp.tanh(nn.Dense(self.act_dim, name="out")(x))
+
+
+class DDPGPolicy(JaxPolicy):
+    """Deterministic-actor policy; like SACPolicy it replaces the FCNet
+    actor-critic wholesale and reuses only JaxPolicy's rollout-facing
+    surface (``_on_device``/``_device_batch``)."""
+
+    def __init__(self, observation_space, action_space, config):
+        if not isinstance(action_space, Box):
+            raise ValueError("DDPG requires a continuous (Box) action space")
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config
+        self.act_dim = int(np.prod(action_space.shape))
+        obs_dim = int(np.prod(observation_space.shape))
+        self._low = np.asarray(action_space.low, np.float32)
+        self._high = np.asarray(action_space.high, np.float32)
+        if config.get("_device") == "cpu":
+            self._device = jax.devices("cpu")[0]
+        else:
+            self._device = None
+
+        twin = bool(config.get("twin_q", False))
+        gamma = float(config.get("gamma", 0.99))
+        tau = float(config.get("tau", 0.005))
+        delay = int(config.get("policy_delay", 1))
+        smooth = bool(config.get("smooth_target_policy", False))
+        tnoise = float(config.get("target_noise", 0.2))
+        tclip = float(config.get("target_noise_clip", 0.5))
+
+        with self._on_device():
+            rng = jax.random.PRNGKey(int(config.get("seed", 0) or 0))
+            self._rng, a_rng, c_rng = jax.random.split(rng, 3)
+            dummy_o = jnp.zeros((1, obs_dim))
+            dummy_a = jnp.zeros((1, self.act_dim))
+            self.actor = _DetActor(self.act_dim)
+            self.critic = TwinQNetwork(twin=twin)
+            self.actor_params = self.actor.init(a_rng, dummy_o)
+            self.critic_params = self.critic.init(c_rng, dummy_o, dummy_a)
+            self.target_actor_params = self.actor_params
+            self.target_critic_params = self.critic_params
+            self.actor_opt = optax.adam(float(config.get("actor_lr", 1e-3)))
+            self.critic_opt = optax.adam(float(config.get("critic_lr", 1e-3)))
+            self.actor_opt_state = self.actor_opt.init(self.actor_params)
+            self.critic_opt_state = self.critic_opt.init(self.critic_params)
+        self._np_rng = np.random.default_rng(int(config.get("seed", 0) or 0))
+        self._updates = 0
+        actor, critic = self.actor, self.critic
+        actor_opt, critic_opt = self.actor_opt, self.critic_opt
+
+        @jax.jit
+        def _act(actor_params, obs):
+            return actor.apply(actor_params, obs)
+
+        @jax.jit
+        def _update(actor_params, critic_params, t_actor, t_critic,
+                    a_opt, c_opt, batch, rng, do_actor):
+            obs = batch[SampleBatch.OBS]
+            nobs = batch[SampleBatch.NEXT_OBS]
+            acts = batch[SampleBatch.ACTIONS]
+            rew = batch[SampleBatch.REWARDS]
+            done = batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+
+            nact = actor.apply(t_actor, nobs)
+            if smooth:
+                noise = jnp.clip(
+                    tnoise * jax.random.normal(rng, nact.shape),
+                    -tclip, tclip)
+                nact = jnp.clip(nact + noise, -1.0, 1.0)
+            tq1, tq2 = critic.apply(t_critic, nobs, nact)
+            target = rew + gamma * (1 - done) * jnp.minimum(tq1, tq2)
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(p):
+                q1, q2 = critic.apply(p, obs, acts)
+                if twin:
+                    return jnp.mean((q1 - target) ** 2
+                                    + (q2 - target) ** 2)
+                return jnp.mean((q1 - target) ** 2)
+
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(critic_params)
+            c_up, c_opt = critic_opt.update(c_grads, c_opt)
+            critic_params = optax.apply_updates(critic_params, c_up)
+
+            def actor_step(operand):
+                actor_params, a_opt = operand
+
+                def actor_loss(p):
+                    q1, _ = critic.apply(critic_params, obs,
+                                         actor.apply(p, obs))
+                    return -jnp.mean(q1)
+
+                a_loss, a_grads = jax.value_and_grad(actor_loss)(actor_params)
+                a_up, a_opt = actor_opt.update(a_grads, a_opt)
+                return (optax.apply_updates(actor_params, a_up), a_opt,
+                        a_loss)
+
+            # delayed policy update without recompilation
+            actor_params, a_opt, a_loss = jax.lax.cond(
+                do_actor, actor_step,
+                lambda op: (op[0], op[1], jnp.float32(0.0)),
+                (actor_params, a_opt))
+
+            t_actor = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, t_actor, actor_params)
+            t_critic = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, t_critic,
+                critic_params)
+            stats = {"critic_loss": c_loss, "actor_loss": a_loss,
+                     "mean_q_target": jnp.mean(target)}
+            return (actor_params, critic_params, t_actor, t_critic,
+                    a_opt, c_opt, stats)
+
+        self._act_fn = _act
+        self._update_fn = _update
+        self._policy_delay = delay
+
+    def _rescale(self, act: np.ndarray) -> np.ndarray:
+        return rescale_actions(act, self._low, self._high)
+
+    def _normalize_actions(self, acts: np.ndarray) -> np.ndarray:
+        return normalize_actions(acts, self._low, self._high)
+
+    # -- rollout surface -------------------------------------------------
+    def compute_actions(self, obs, explore: bool = True):
+        with self._on_device():
+            act = np.asarray(
+                self._act_fn(self.actor_params,
+                             jnp.asarray(obs, jnp.float32)))
+        if explore:
+            sigma = float(self.config.get("exploration_noise", 0.1))
+            act = np.clip(
+                act + self._np_rng.normal(0.0, sigma, act.shape),
+                -1.0, 1.0).astype(np.float32)
+        return self._rescale(act), {}
+
+    def postprocess_trajectory(self, batch, last_obs=None, truncated=False):
+        return batch
+
+    def compute_values(self, obs):
+        return np.zeros(len(obs), np.float32)
+
+    # -- learning --------------------------------------------------------
+    def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        batch = SampleBatch(dict(
+            batch, **{SampleBatch.ACTIONS: self._normalize_actions(
+                np.asarray(batch[SampleBatch.ACTIONS]))}))
+        self._updates += 1
+        do_actor = (self._updates % self._policy_delay) == 0
+        with self._on_device():
+            self._rng, rng = jax.random.split(self._rng)
+            (self.actor_params, self.critic_params,
+             self.target_actor_params, self.target_critic_params,
+             self.actor_opt_state, self.critic_opt_state, stats) = \
+                self._update_fn(
+                    self.actor_params, self.critic_params,
+                    self.target_actor_params, self.target_critic_params,
+                    self.actor_opt_state, self.critic_opt_state,
+                    self._device_batch(batch), rng, do_actor)
+        return {k: float(v) for k, v in stats.items()}
+
+    # -- weights ---------------------------------------------------------
+    def get_weights(self):
+        return jax.tree_util.tree_map(
+            np.asarray, {"actor": self.actor_params})
+
+    def set_weights(self, weights) -> None:
+        with self._on_device():
+            self.actor_params = jax.tree_util.tree_map(
+                jnp.asarray, weights["actor"])
+
+    def get_state(self):
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)
+        return {"weights": {"actor": to_np(self.actor_params)},
+                "critic": to_np(self.critic_params),
+                "targets": to_np((self.target_actor_params,
+                                  self.target_critic_params)),
+                "opt_states": to_np((self.actor_opt_state,
+                                     self.critic_opt_state)),
+                "updates": self._updates}
+
+    def set_state(self, state):
+        with self._on_device():
+            to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+            self.actor_params = to_dev(state["weights"]["actor"])
+            self.critic_params = to_dev(state["critic"])
+            self.target_actor_params, self.target_critic_params = \
+                to_dev(state["targets"])
+            self.actor_opt_state, self.critic_opt_state = \
+                to_dev(state["opt_states"])
+        self._updates = int(state.get("updates", 0))
+
+
+class DDPG(Algorithm):
+    policy_class = DDPGPolicy
+
+    def setup(self) -> None:
+        super().setup()
+        cfg = self.config
+        self.replay = ReplayBuffer(
+            int(cfg.get("replay_buffer_capacity", 100_000)),
+            seed=cfg.get("seed"))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy: DDPGPolicy = self.workers.local_worker.policy
+        fragment = max(1, int(cfg.get("rollout_fragment_length", 1))
+                       * int(cfg.get("num_envs_per_worker", 1)))
+        batch = synchronous_parallel_sample(self.workers,
+                                            max_env_steps=fragment)
+        self.replay.add(batch)
+        self._timesteps_total += len(batch)
+        stats: Dict[str, Any] = {"replay_size": len(self.replay)}
+        warmup = int(cfg.get("num_steps_sampled_before_learning_starts",
+                             1000))
+        bs = int(cfg.get("train_batch_size", 256))
+        if len(self.replay) >= max(warmup, bs):
+            updates = max(1, round(float(cfg.get("training_intensity", 1.0))
+                                   * len(batch)))
+            for _ in range(updates):
+                stats.update(policy.learn_on_batch(self.replay.sample(bs)))
+            self.workers.sync_weights()
+        return stats
+
+
+class TD3(DDPG):
+    pass

@@ -202,3 +202,44 @@ class DQN(Algorithm):
                 self._since_target_update = 0
             self.workers.sync_weights()
         return stats
+
+
+class SimpleQConfig(DQNConfig):
+    """SimpleQ: DQN without double-Q or prioritized replay (reference
+    ``rllib/algorithms/simple_q/``)."""
+
+    def __init__(self):
+        super().__init__()
+        self.double_q = False
+        self.prioritized_replay = False
+
+    @property
+    def algo_class(self):
+        return SimpleQ
+
+
+class SimpleQ(DQN):
+    pass
+
+
+class ApexDQNConfig(DQNConfig):
+    """Ape-X: DQN with a large distributed sampler fleet feeding
+    prioritized replay (reference ``rllib/algorithms/apex_dqn/``).  The
+    execution skeleton maps onto our actor fleet directly: many rollout
+    workers with per-worker epsilons, prioritized replay on the driver,
+    high training intensity."""
+
+    def __init__(self):
+        super().__init__()
+        self.prioritized_replay = True
+        self.num_rollout_workers = 4
+        self.training_intensity = 4.0
+        self.target_network_update_freq = 2000
+
+    @property
+    def algo_class(self):
+        return ApexDQN
+
+
+class ApexDQN(DQN):
+    pass

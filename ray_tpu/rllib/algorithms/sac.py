@@ -23,7 +23,9 @@ from ray_tpu.rllib.algorithm import Algorithm
 from ray_tpu.rllib.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.env import Box
 from ray_tpu.rllib.execution import synchronous_parallel_sample
-from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.models import TwinQNetwork
+from ray_tpu.rllib.policy import (JaxPolicy, normalize_actions,
+                                  rescale_actions)
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.sample_batch import SampleBatch
 
@@ -60,19 +62,6 @@ class _SquashedActor(nn.Module):
         log_std = jnp.clip(nn.Dense(self.act_dim, name="log_std")(x),
                            -20.0, 2.0)
         return mean, log_std
-
-
-class _TwinQ(nn.Module):
-    hiddens: tuple = (256, 256)
-
-    @nn.compact
-    def __call__(self, obs, act):
-        def q(name):
-            x = jnp.concatenate([obs, act], axis=-1)
-            for i, h in enumerate(self.hiddens):
-                x = nn.relu(nn.Dense(h, name=f"{name}_fc_{i}")(x))
-            return nn.Dense(1, name=f"{name}_out")(x)[..., 0]
-        return q("q1"), q("q2")
 
 
 def _sample_squashed(mean, log_std, rng):
@@ -115,7 +104,7 @@ class SACPolicy(JaxPolicy):
             dummy_o = jnp.zeros((1, obs_dim))
             dummy_a = jnp.zeros((1, self.act_dim))
             self.actor = _SquashedActor(self.act_dim)
-            self.critic = _TwinQ()
+            self.critic = TwinQNetwork()
             self.actor_params = self.actor.init(a_rng, dummy_o)
             self.critic_params = self.critic.init(c_rng, dummy_o, dummy_a)
             self.target_critic_params = self.critic_params
@@ -208,10 +197,7 @@ class SACPolicy(JaxPolicy):
     # depend on self._device)
 
     def _rescale(self, act: np.ndarray) -> np.ndarray:
-        if np.all(np.isfinite(self._low)) and np.all(np.isfinite(self._high)):
-            return (self._low + (act + 1.0) * 0.5
-                    * (self._high - self._low)).astype(np.float32)
-        return act
+        return rescale_actions(act, self._low, self._high)
 
     # -- rollout surface (matches JaxPolicy's contract) -----------------
     def compute_actions(self, obs, explore: bool = True):
@@ -228,12 +214,7 @@ class SACPolicy(JaxPolicy):
         return batch  # replay stores raw transitions
 
     def _normalize_actions(self, acts: np.ndarray) -> np.ndarray:
-        """Env-scale -> tanh-scale: the critic/actor operate entirely in
-        [-1, 1]; replay stores what the env consumed."""
-        if np.all(np.isfinite(self._low)) and np.all(np.isfinite(self._high)):
-            return (2.0 * (acts - self._low)
-                    / (self._high - self._low) - 1.0).astype(np.float32)
-        return acts
+        return normalize_actions(acts, self._low, self._high)
 
     def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
         tau = float(self.config.get("tau", 0.005))

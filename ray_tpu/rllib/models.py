@@ -103,3 +103,22 @@ class DiagGaussian:
         return jnp.sum(
             lsq - lsp + (jnp.exp(2 * lsp) + (mp - mq) ** 2)
             / (2 * jnp.exp(2 * lsq)) - 0.5, axis=-1)
+
+
+class TwinQNetwork(nn.Module):
+    """Q(s, a) MLP critic; ``twin=True`` adds the second head for
+    clipped double-Q (SAC/TD3 — both heads share nothing but the input,
+    as in the reference's ``SACTorchModel`` twin_q)."""
+
+    twin: bool = True
+    hiddens: Tuple[int, ...] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray, act: jnp.ndarray):
+        def q(name):
+            x = jnp.concatenate([obs, act], axis=-1)
+            for i, h in enumerate(self.hiddens):
+                x = nn.relu(nn.Dense(h, name=f"{name}_fc_{i}")(x))
+            return nn.Dense(1, name=f"{name}_out")(x)[..., 0]
+        q1 = q("q1")
+        return (q1, q("q2")) if self.twin else (q1, q1)

@@ -27,6 +27,23 @@ from ray_tpu.rllib.postprocessing import compute_gae
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
+def rescale_actions(act: np.ndarray, low: np.ndarray, high: np.ndarray
+                    ) -> np.ndarray:
+    """tanh-scale [-1, 1] -> env scale (no-op for unbounded spaces)."""
+    if np.all(np.isfinite(low)) and np.all(np.isfinite(high)):
+        return (low + (act + 1.0) * 0.5 * (high - low)).astype(np.float32)
+    return act
+
+
+def normalize_actions(act: np.ndarray, low: np.ndarray, high: np.ndarray
+                      ) -> np.ndarray:
+    """Env scale -> tanh-scale [-1, 1]: actors/critics operate entirely
+    in [-1, 1]; replay stores what the env consumed."""
+    if np.all(np.isfinite(low)) and np.all(np.isfinite(high)):
+        return (2.0 * (act - low) / (high - low) - 1.0).astype(np.float32)
+    return act
+
+
 class JaxPolicy:
     """Base class; algorithms override :meth:`loss` (and optionally
     :meth:`learn_on_batch` for multi-epoch schemes)."""
@@ -96,6 +113,8 @@ class JaxPolicy:
         self._act_greedy = _act_greedy
         self._values = _values
         self._update = jax.jit(self._update_impl)
+        self._grads = jax.jit(self._grads_impl)
+        self._apply = jax.jit(self._apply_impl)
 
     def _on_device(self):
         if self._device is None:
@@ -146,6 +165,33 @@ class JaxPolicy:
         stats["total_loss"] = loss
         stats["grad_gnorm"] = optax.global_norm(grads)
         return params, opt_state, stats
+
+    def _grads_impl(self, params, batch):
+        (loss, stats), grads = jax.value_and_grad(
+            self.loss, has_aux=True)(params, batch)
+        stats = dict(stats)
+        stats["total_loss"] = loss
+        return grads, stats
+
+    def _apply_impl(self, params, opt_state, grads):
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def compute_gradients(self, batch: SampleBatch):
+        """Gradients without applying them (reference
+        ``Policy.compute_gradients`` — the A3C path where workers compute
+        grads and the driver applies them asynchronously)."""
+        with self._on_device():
+            grads, stats = self._grads(self.params,
+                                       self._device_batch(batch))
+            grads = jax.tree_util.tree_map(np.asarray, grads)
+        return grads, {k: float(v) for k, v in stats.items()}
+
+    def apply_gradients(self, grads) -> None:
+        with self._on_device():
+            grads = jax.tree_util.tree_map(jnp.asarray, grads)
+            self.params, self.opt_state = self._apply(
+                self.params, self.opt_state, grads)
 
     def _device_batch(self, batch: SampleBatch) -> Dict[str, jnp.ndarray]:
         return {k: jnp.asarray(v) for k, v in batch.items()

@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 
 import ray_tpu
-from ray_tpu.rllib import CartPole, RandomEnv, SampleBatch
+from ray_tpu.rllib import CartPole, Pendulum, RandomEnv, SampleBatch
 from ray_tpu.rllib.algorithms.dqn import DQNConfig
 from ray_tpu.rllib.algorithms.impala import APPOConfig, ImpalaConfig
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
@@ -124,46 +124,6 @@ def test_appo_smoke():
     assert np.isfinite(r["total_loss"])
     assert "mean_rho" in r
     algo.stop()
-
-
-class Pendulum:
-    """Classic pendulum swing-up (standard dynamics) — the canonical
-    continuous-control smoke env for SAC."""
-
-    def __init__(self, config=None):
-        config = config or {}
-        from ray_tpu.rllib.env import Box
-        self.max_speed = 8.0
-        self.max_torque = 2.0
-        self.dt = 0.05
-        self.observation_space = Box(-np.inf, np.inf, (3,), np.float32)
-        self.action_space = Box(-self.max_torque, self.max_torque, (1,),
-                                np.float32)
-        self._rng = np.random.default_rng(config.get("seed"))
-        self.max_episode_steps = int(config.get("max_episode_steps", 200))
-
-    def _obs(self):
-        th, thdot = self._state
-        return np.array([np.cos(th), np.sin(th), thdot], np.float32)
-
-    def reset(self, *, seed=None):
-        self._state = self._rng.uniform([-np.pi, -1.0], [np.pi, 1.0])
-        self._steps = 0
-        return self._obs(), {}
-
-    def step(self, action):
-        th, thdot = self._state
-        u = float(np.clip(np.asarray(action).reshape(-1)[0],
-                          -self.max_torque, self.max_torque))
-        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
-        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
-        thdot = np.clip(
-            thdot + (3 * 10.0 / 2 * np.sin(th) + 3.0 * u) * self.dt,
-            -self.max_speed, self.max_speed)
-        th = th + thdot * self.dt
-        self._state = (th, thdot)
-        self._steps += 1
-        return self._obs(), -cost, False, self._steps >= self.max_episode_steps, {}
 
 
 def test_sac_learns_pendulum():
