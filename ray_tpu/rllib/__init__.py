@@ -14,6 +14,8 @@ from ray_tpu.rllib.env import (  # noqa: F401
     Box,
     CartPole,
     Discrete,
+    MultiAgentCartPole,
+    MultiAgentEnv,
     Pendulum,
     RandomEnv,
     make_env,

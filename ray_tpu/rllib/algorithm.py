@@ -25,6 +25,8 @@ from ray_tpu.rllib.worker_set import WorkerSet
 class Algorithm:
     #: overridden by subclasses
     policy_class: Optional[type] = None
+    #: set True by algorithms whose training_step handles MultiAgentBatch
+    supports_multi_agent: bool = False
 
     def __init__(self, config: Union[AlgorithmConfig, Dict[str, Any]],
                  env: Any = None, **kwargs):
@@ -45,12 +47,24 @@ class Algorithm:
 
     # ------------------------------------------------------------------
     def setup(self) -> None:
+        if self.config.get("policies") and not self.supports_multi_agent:
+            raise ValueError(
+                f"{type(self).__name__} does not support multi-agent "
+                f"training (its training_step consumes plain "
+                f"SampleBatches); use PPO, or drop .multi_agent(...)")
         self.workers = WorkerSet(self.config["env"], self.policy_class,
                                  self.config)
         self.workers.sync_weights()
 
-    def get_policy(self):
-        return self.workers.local_worker.policy
+    def get_policy(self, policy_id: Optional[str] = None):
+        worker = self.workers.local_worker
+        if policy_id is not None:
+            return worker.policy_map[policy_id]
+        if len(worker.policy_map) > 1:
+            raise ValueError(
+                f"multiple policies {sorted(worker.policy_map)}: "
+                f"get_policy(policy_id=...) must name one")
+        return worker.policy
 
     def _collect_metrics(self):
         """Episode stats from the fleet; async algorithms override to use
@@ -95,9 +109,11 @@ class Algorithm:
     def evaluate(self) -> Dict[str, Any]:
         """Greedy-policy episodes on a fresh env (reference
         ``Algorithm.evaluate``)."""
-        from ray_tpu.rllib.env import make_env
+        from ray_tpu.rllib.env import MultiAgentEnv, make_env
         env = make_env(self.config["env"],
                        dict(self.config.get("env_config", {})))
+        if isinstance(env, MultiAgentEnv):
+            return self._evaluate_multi_agent(env)
         policy = self.get_policy()
         returns = []
         for _ in range(int(self.config.get("evaluation_duration", 10))):
@@ -113,6 +129,30 @@ class Algorithm:
                 "episode_reward_min": float(np.min(returns)),
                 "episode_reward_max": float(np.max(returns))}
 
+    def _evaluate_multi_agent(self, env) -> Dict[str, Any]:
+        worker = self.workers.local_worker
+        mapping = worker.policy_mapping_fn
+        returns = []
+        for _ in range(int(self.config.get("evaluation_duration", 10))):
+            obs, _ = env.reset()
+            total, done, steps = 0.0, False, 0
+            while not done and steps < 10_000:
+                actions = {}
+                for a, o in obs.items():
+                    act, _ = worker.policy_map[mapping(a)].compute_actions(
+                        np.asarray(o)[None], explore=False)
+                    actions[a] = np.asarray(act)[0]
+                obs, rew, term, trunc, _ = env.step(actions)
+                total += float(sum(rew.values()))
+                obs = {a: o for a, o in obs.items()
+                       if not (term.get(a, False) or trunc.get(a, False))}
+                done = term.get("__all__") or trunc.get("__all__")
+                steps += 1
+            returns.append(total)
+        return {"episode_reward_mean": float(np.mean(returns)),
+                "episode_reward_min": float(np.min(returns)),
+                "episode_reward_max": float(np.max(returns))}
+
     def compute_single_action(self, obs: np.ndarray, explore: bool = False):
         action, _ = self.get_policy().compute_actions(
             np.asarray(obs)[None], explore=explore)
@@ -123,8 +163,13 @@ class Algorithm:
         os.makedirs(checkpoint_dir, exist_ok=True)
         path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
         with open(path, "wb") as f:
+            worker = self.workers.local_worker
             pickle.dump({
-                "policy_state": self.get_policy().get_state(),
+                "policy_state": self.get_policy().get_state()
+                if not worker.policy_map else None,
+                "policy_map_state": {
+                    pid: p.get_state()
+                    for pid, p in worker.policy_map.items()},
                 "iteration": self.iteration,
                 "timesteps_total": self._timesteps_total,
                 "config": {k: v for k, v in self.config.items()
@@ -137,7 +182,10 @@ class Algorithm:
         path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
         with open(path, "rb") as f:
             state = pickle.load(f)
-        self.get_policy().set_state(state["policy_state"])
+        for pid, ps in state.get("policy_map_state", {}).items():
+            self.get_policy(pid).set_state(ps)
+        if state.get("policy_state") is not None:
+            self.get_policy().set_state(state["policy_state"])
         self.iteration = state["iteration"]
         self._timesteps_total = state["timesteps_total"]
         self.workers.sync_weights()

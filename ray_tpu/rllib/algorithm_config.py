@@ -43,6 +43,10 @@ class AlgorithmConfig:
         self.seed: Optional[int] = None
         # fault tolerance
         self.recreate_failed_workers = False
+        # multi-agent (empty == single-agent mode)
+        self.policies: Dict[str, Any] = {}
+        self.policy_mapping_fn: Optional[Any] = None
+        self.policies_to_train: Optional[Any] = None
 
     # -- chainable setters (reference naming) ---------------------------
     def environment(self, env: Any = None, *,
@@ -80,6 +84,23 @@ class AlgorithmConfig:
             self.num_cpus_per_worker = num_cpus_per_worker
         if num_tpus_per_learner is not None:
             self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
+                    policy_mapping_fn: Optional[Any] = None,
+                    policies_to_train: Optional[Any] = None
+                    ) -> "AlgorithmConfig":
+        """Configure multi-agent training (reference
+        ``AlgorithmConfig.multi_agent``).  ``policies`` maps policy id ->
+        None (infer spaces from the env's first mapped agent) or
+        ``(obs_space, act_space, config_overrides)``;
+        ``policy_mapping_fn(agent_id)`` -> policy id."""
+        if policies is not None:
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        if policies_to_train is not None:
+            self.policies_to_train = list(policies_to_train)
         return self
 
     def framework(self, framework: str = "jax") -> "AlgorithmConfig":

@@ -110,11 +110,32 @@ class PPOPolicy(JaxPolicy):
 
 class PPO(Algorithm):
     policy_class = PPOPolicy
+    supports_multi_agent = True
 
     def training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.sample_batch import MultiAgentBatch
+
         batch = synchronous_parallel_sample(
             self.workers,
             max_env_steps=int(self.config.get("train_batch_size", 4000)))
+        if isinstance(batch, MultiAgentBatch):
+            # learn each trainable policy on its own sub-batch
+            worker = self.workers.local_worker
+            to_train = self.config.get("policies_to_train") \
+                or list(worker.policy_map)
+            self._timesteps_total += batch.env_steps()
+            stats: Dict[str, Any] = {}
+            for pid in to_train:
+                if pid not in batch or not len(batch[pid]):
+                    continue
+                sub = standardize_advantages(batch[pid])
+                for k, v in worker.policy_map[pid].learn_on_batch(
+                        sub).items():
+                    stats[f"{pid}/{k}"] = v
+            self.workers.sync_weights()
+            stats["num_env_steps_sampled_this_iter"] = batch.env_steps()
+            stats["num_agent_steps_sampled_this_iter"] = batch.count
+            return stats
         batch = standardize_advantages(batch)
         self._timesteps_total += len(batch)
         stats = self.workers.local_worker.policy.learn_on_batch(batch)

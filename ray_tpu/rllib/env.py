@@ -162,10 +162,77 @@ class RandomEnv:
                 False, self._steps >= self.episode_len, {})
 
 
+class MultiAgentEnv:
+    """Base class for multi-agent environments (reference
+    ``rllib/env/multi_agent_env.py``): dict-keyed observations/actions
+    per agent id; ``step`` returns per-agent dicts plus the ``__all__``
+    key in the terminated/truncated dicts.  Agents may appear and
+    disappear between steps (only act for agents present in obs)."""
+
+    #: per-agent spaces; override or fill in __init__
+    observation_spaces: Dict[Any, Any]
+    action_spaces: Dict[Any, Any]
+
+    def observation_space_for(self, agent_id) -> Any:
+        return self.observation_spaces[agent_id]
+
+    def action_space_for(self, agent_id) -> Any:
+        return self.action_spaces[agent_id]
+
+    @property
+    def agent_ids(self):
+        return list(self.observation_spaces)
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[Any, Any]):
+        raise NotImplementedError
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent cart-poles, one per agent (the reference's standard
+    multi-agent smoke env, ``rllib/examples/env/multi_agent.py``)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.num_agents = int(config.get("num_agents", 2))
+        seed = config.get("seed")
+        self._envs = {
+            i: CartPole(dict(config,
+                             seed=None if seed is None else seed + i))
+            for i in range(self.num_agents)}
+        self.observation_spaces = {
+            i: e.observation_space for i, e in self._envs.items()}
+        self.action_spaces = {
+            i: e.action_space for i, e in self._envs.items()}
+
+    def reset(self, *, seed: Optional[int] = None):
+        self._done = {i: False for i in self._envs}
+        obs, infos = {}, {}
+        for i, e in self._envs.items():
+            obs[i], infos[i] = e.reset(seed=seed)
+        return obs, infos
+
+    def step(self, action_dict):
+        obs, rew, term, trunc, info = {}, {}, {}, {}, {}
+        for i, a in action_dict.items():
+            if self._done[i]:
+                continue
+            obs[i], rew[i], term[i], trunc[i], info[i] = \
+                self._envs[i].step(a)
+            if term[i] or trunc[i]:
+                self._done[i] = True
+        term["__all__"] = all(self._done.values())
+        trunc["__all__"] = False
+        return obs, rew, term, trunc, info
+
+
 _ENV_REGISTRY: Dict[str, Any] = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "RandomEnv": RandomEnv,
+    "MultiAgentCartPole": MultiAgentCartPole,
 }
 
 

@@ -12,14 +12,16 @@ from typing import Any, Dict, List
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+from ray_tpu.rllib.sample_batch import (MultiAgentBatch, SampleBatch,
+                                        concat_samples)
 
 
 def synchronous_parallel_sample(worker_set, *,
-                                max_env_steps: int) -> SampleBatch:
+                                max_env_steps: int):
     """Fan out ``sample()`` across the fleet until at least
-    ``max_env_steps`` env steps are gathered."""
-    batches: List[SampleBatch] = []
+    ``max_env_steps`` env steps are gathered.  Returns a SampleBatch, or
+    a MultiAgentBatch (concatenated per policy) in multi-agent mode."""
+    batches: List[Any] = []
     steps = 0
     while steps < max_env_steps:
         if worker_set.remote_workers:
@@ -29,7 +31,14 @@ def synchronous_parallel_sample(worker_set, *,
             round_batches = [worker_set.local_worker.sample()]
         for b in round_batches:
             batches.append(b)
-            steps += len(b)
+            steps += b.env_steps() if isinstance(b, MultiAgentBatch) \
+                else len(b)
+    if isinstance(batches[0], MultiAgentBatch):
+        pids = {pid for b in batches for pid in b}
+        return MultiAgentBatch(
+            {pid: concat_samples([b[pid] for b in batches if pid in b])
+             for pid in pids},
+            env_steps=sum(b.env_steps() for b in batches))
     return concat_samples(batches)
 
 

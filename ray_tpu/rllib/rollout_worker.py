@@ -12,12 +12,14 @@ plane.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from ray_tpu.rllib.env import make_env
-from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
+from ray_tpu.rllib.env import MultiAgentEnv, make_env
+from ray_tpu.rllib.sample_batch import (MultiAgentBatch, SampleBatch,
+                                        concat_samples)
 
 
 class RolloutWorker:
@@ -35,13 +37,61 @@ class RolloutWorker:
             self.config.setdefault("_device", "cpu")
         n = int(config.get("num_envs_per_worker", 1))
         env_config = dict(config.get("env_config", {}))
-        self.envs = []
-        for i in range(n):
+        first = make_env(env_spec, dict(
+            env_config, **({} if seed is None else {"seed": seed * 1000})))
+        self._ma = isinstance(first, MultiAgentEnv)
+        if self._ma and n > 1:
+            logging.getLogger(__name__).warning(
+                "num_envs_per_worker=%d ignored for MultiAgentEnv "
+                "(multi-agent sampling steps one env per worker)", n)
+            n = 1
+        self.envs = [first]
+        for i in range(1, n):
             cfg = dict(env_config)
             if seed is not None:
                 cfg["seed"] = seed * 1000 + i
             self.envs.append(make_env(env_spec, cfg))
         env = self.envs[0]
+
+        self.policy_map: Dict[str, Any] = {}
+        if self._ma:
+            policies = config.get("policies") or {}
+            if not policies:
+                raise ValueError("a MultiAgentEnv needs config"
+                                 ".multi_agent(policies=..., "
+                                 "policy_mapping_fn=...)")
+            self.policy_mapping_fn = config.get("policy_mapping_fn") \
+                or (lambda agent_id: next(iter(policies)))
+            for pid, spec in policies.items():
+                if spec is None:
+                    # infer spaces from the first agent mapped to pid
+                    agent = next(
+                        (a for a in env.agent_ids
+                         if self.policy_mapping_fn(a) == pid), None)
+                    if agent is None:
+                        raise ValueError(
+                            f"policy {pid!r} has spaces=None but "
+                            f"policy_mapping_fn maps no agent of "
+                            f"{sorted(env.agent_ids)} to it; pass "
+                            f"(obs_space, act_space, overrides) "
+                            f"explicitly or fix the mapping")
+                    obs_s = env.observation_space_for(agent)
+                    act_s = env.action_space_for(agent)
+                    overrides = {}
+                else:
+                    obs_s, act_s, overrides = spec
+                pcfg = dict(self.config, **(overrides or {}))
+                self.policy_map[pid] = policy_cls(obs_s, act_s, pcfg)
+            self.policy = next(iter(self.policy_map.values()))
+            self._ma_env = env
+            self._ma_obs, _ = env.reset()
+            self._ma_buffers: Dict[Any, List[Dict[str, Any]]] = {}
+            self._ma_episode_reward = 0.0
+            self._ma_episode_len = 0
+            self._completed_returns = []
+            self._completed_lens = []
+            return
+
         self.policy = policy_cls(env.observation_space, env.action_space,
                                  self.config)
         self._obs = np.stack([e.reset()[0] for e in self.envs])
@@ -64,6 +114,8 @@ class RolloutWorker:
         the boundaries) and skip trajectory postprocessing — off-policy
         corrections happen learner-side (V-trace).
         """
+        if self._ma:
+            return self._sample_multi_agent()
         fragment = int(self.config.get("rollout_fragment_length", 200))
         raw = bool(self.config.get("_raw_fragments", False))
         n = len(self.envs)
@@ -117,6 +169,97 @@ class RolloutWorker:
                     rows[i] = []
         return concat_samples(chunks)
 
+    # -- multi-agent sampling -------------------------------------------
+    def _sample_multi_agent(self) -> MultiAgentBatch:
+        """One fragment from the multi-agent env: per-agent trajectories,
+        postprocessed by each agent's mapped policy, grouped per policy
+        (reference ``env_runner_v2.py`` multi-agent collection)."""
+        fragment = int(self.config.get("rollout_fragment_length", 200))
+        env = self._ma_env
+        chunks: Dict[str, List[SampleBatch]] = {}
+        env_steps = 0
+
+        for _ in range(fragment):
+            env_steps += 1
+            # group live agents by policy for one batched forward each
+            agents = list(self._ma_obs)
+            by_pid: Dict[str, List[Any]] = {}
+            for a in agents:
+                by_pid.setdefault(self.policy_mapping_fn(a), []).append(a)
+            actions: Dict[Any, Any] = {}
+            extras_by_agent: Dict[Any, Dict[str, Any]] = {}
+            for pid, members in by_pid.items():
+                obs = np.stack([self._ma_obs[a] for a in members])
+                acts, extras = self.policy_map[pid].compute_actions(obs)
+                for j, a in enumerate(members):
+                    actions[a] = np.asarray(acts)[j]
+                    extras_by_agent[a] = {k: v[j]
+                                          for k, v in extras.items()}
+            obs2, rew, term, trunc, _ = env.step(actions)
+            for a in actions:
+                if a not in rew:
+                    continue  # agent was already done
+                row = {
+                    SampleBatch.OBS: self._ma_obs[a],
+                    SampleBatch.NEXT_OBS: obs2[a],
+                    SampleBatch.ACTIONS: actions[a],
+                    SampleBatch.REWARDS: rew[a],
+                    SampleBatch.TERMINATEDS: term.get(a, False),
+                    SampleBatch.TRUNCATEDS: trunc.get(a, False),
+                }
+                row.update(extras_by_agent[a])
+                self._ma_buffers.setdefault(a, []).append(row)
+                self._ma_episode_reward += float(rew[a])
+                done_a = term.get(a, False) or trunc.get(a, False)
+                if done_a:
+                    self._flush_agent(a, obs2[a], term.get(a, False),
+                                      chunks)
+            self._ma_episode_len += 1
+            if term.get("__all__") or trunc.get("__all__"):
+                for a, rows in list(self._ma_buffers.items()):
+                    if rows:
+                        self._flush_agent(
+                            a, obs2.get(a, rows[-1][SampleBatch.NEXT_OBS]),
+                            term.get(a, False), chunks)
+                self._completed_returns.append(self._ma_episode_reward)
+                self._completed_lens.append(self._ma_episode_len)
+                self._ma_episode_reward = 0.0
+                self._ma_episode_len = 0
+                self._ma_obs, _ = env.reset()
+            else:
+                # keep only obs for agents still alive (done agents'
+                # terminal obs must not be acted on again)
+                self._ma_obs = {
+                    a: o for a, o in obs2.items()
+                    if not (term.get(a, False) or trunc.get(a, False))}
+
+        # fragment boundary: flush in-progress trajectories as truncated
+        for a, rows in list(self._ma_buffers.items()):
+            if rows:
+                self._flush_agent(a, self._ma_obs.get(
+                    a, rows[-1][SampleBatch.NEXT_OBS]), False, chunks,
+                    truncated=True)
+        return MultiAgentBatch(
+            {pid: concat_samples(parts) for pid, parts in chunks.items()},
+            env_steps=env_steps)
+
+    def _flush_agent(self, agent: Any, last_obs: np.ndarray,
+                     terminated: bool,
+                     chunks: Dict[str, List[SampleBatch]],
+                     truncated: Optional[bool] = None) -> None:
+        rows = self._ma_buffers.pop(agent, [])
+        if not rows:
+            return
+        pid = self.policy_mapping_fn(agent)
+        batch = SampleBatch(
+            {k: np.stack([np.asarray(r[k]) for r in rows])
+             for k in rows[0]})
+        if truncated is None:
+            truncated = not terminated
+        batch = self.policy_map[pid].postprocess_trajectory(
+            batch, np.asarray(last_obs), truncated=truncated)
+        chunks.setdefault(pid, []).append(batch)
+
     def _note_episode_end(self, i: int) -> None:
         self._completed_returns.append(float(self._episode_rewards[i]))
         self._completed_lens.append(int(self._episode_lens[i]))
@@ -157,10 +300,17 @@ class RolloutWorker:
         return out
 
     def get_weights(self):
+        if self.policy_map:
+            return {pid: p.get_weights()
+                    for pid, p in self.policy_map.items()}
         return self.policy.get_weights()
 
     def set_weights(self, weights) -> None:
-        self.policy.set_weights(weights)
+        if self.policy_map:
+            for pid, w in weights.items():
+                self.policy_map[pid].set_weights(w)
+        else:
+            self.policy.set_weights(weights)
 
     def apply(self, fn: Callable, *args):
         """Run an arbitrary function on this worker (reference

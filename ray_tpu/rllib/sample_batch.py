@@ -85,8 +85,21 @@ def concat_samples(batches: Sequence[SampleBatch]) -> SampleBatch:
 
 
 class MultiAgentBatch(dict):
-    """policy_id -> SampleBatch (reference ``MultiAgentBatch``:1165)."""
+    """policy_id -> SampleBatch (reference ``MultiAgentBatch``:1165).
+
+    ``env_steps`` counts environment ticks (the reference's
+    ``env_steps()``); ``count`` sums per-policy rows (agent steps)."""
+
+    def __init__(self, *args, env_steps: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._env_steps = int(env_steps)
 
     @property
     def count(self) -> int:
         return sum(len(b) for b in self.values())
+
+    def env_steps(self) -> int:
+        return self._env_steps or self.count
+
+    def agent_steps(self) -> int:
+        return self.count
