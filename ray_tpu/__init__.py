@@ -44,8 +44,14 @@ _head_proc: Optional[subprocess.Popen] = None
 _owns_head = False
 
 
+def _client_or_none():
+    from ray_tpu.util import client as _client_mod
+    return _client_mod._client
+
+
 def is_initialized() -> bool:
-    return _worker_mod.global_worker_or_none() is not None
+    return (_worker_mod.global_worker_or_none() is not None
+            or _client_or_none() is not None)
 
 
 def init(address: Optional[str] = None, *,
@@ -68,6 +74,14 @@ def init(address: Optional[str] = None, *,
             if ignore_reinit_error:
                 return connection_info()
             raise RayTpuError("ray_tpu.init() called twice")
+
+        if address and address.startswith("ray://"):
+            # remote-driver (client) mode: no local runtime, everything
+            # proxies through the cluster's client server
+            from ray_tpu.util import client as client_mod
+            client_mod.connect(address[len("ray://"):])
+            atexit.register(shutdown)
+            return {"address": address, "mode": "client"}
 
         config = Config().apply_env_overrides().apply_overrides(_system_config)
         if object_store_memory:
@@ -165,6 +179,10 @@ def _discover_via_gcs(gcs_address: Tuple[str, int]) -> Dict[str, Any]:
 
 
 def connection_info() -> Dict[str, Any]:
+    client = _client_or_none()
+    if client is not None:
+        return {"address": "ray://{}:{}".format(*client._address),
+                "mode": "client"}
     core = _worker_mod.global_worker()
     return {
         "gcs_address": core.gcs_address,
@@ -178,6 +196,8 @@ def connection_info() -> Dict[str, Any]:
 def shutdown() -> None:
     global _head_proc, _owns_head
     with _init_lock:
+        from ray_tpu.util import client as client_mod
+        client_mod.disconnect()
         core = _worker_mod.global_worker_or_none()
         if core is not None:
             core.shutdown()
@@ -194,6 +214,12 @@ def remote(*args, **options):
     """``@remote`` decorator for functions and classes (parity:
     ``ray.remote``)."""
     def decorate(fn_or_class):
+        if _client_or_none() is not None:
+            from ray_tpu.util.client import (ClientActorClass,
+                                             ClientRemoteFunction)
+            if isinstance(fn_or_class, type):
+                return ClientActorClass(fn_or_class, **options)
+            return ClientRemoteFunction(fn_or_class, **options)
         if isinstance(fn_or_class, type):
             return ActorClass(fn_or_class, **options)
         return RemoteFunction(fn_or_class, **options)
@@ -207,6 +233,11 @@ def remote(*args, **options):
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None) -> Any:
+    client = _client_or_none()
+    if client is not None:
+        single = isinstance(refs, ObjectRef)
+        out = client.get([refs] if single else list(refs), timeout=timeout)
+        return out[0] if single else out
     core = _worker_mod.global_worker()
     single = isinstance(refs, ObjectRef)
     out = core.get([refs] if single else list(refs), timeout=timeout)
@@ -214,17 +245,27 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
 
 
 def put(value: Any) -> ObjectRef:
+    client = _client_or_none()
+    if client is not None:
+        return client.put(value)
     return _worker_mod.global_worker().put(value)
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: Optional[float] = None
          ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    client = _client_or_none()
+    if client is not None:
+        return client.wait(refs, num_returns=num_returns, timeout=timeout)
     return _worker_mod.global_worker().wait(
         refs, num_returns=num_returns, timeout=timeout)
 
 
-def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+def kill(actor: "ActorHandle", *, no_restart: bool = True) -> None:
+    client = _client_or_none()
+    if client is not None:
+        client.kill_actor(actor.actor_id, no_restart=no_restart)
+        return
     _worker_mod.global_worker().kill_actor(actor.actor_id,
                                            no_restart=no_restart)
 
@@ -241,15 +282,33 @@ def free(refs: Sequence[ObjectRef]) -> None:
 
 
 def nodes() -> List[Dict[str, Any]]:
+    client = _client_or_none()
+    if client is not None:
+        return client.cluster_info("nodes")
     return _worker_mod.global_worker().get_nodes()
 
 
 def cluster_resources() -> Dict[str, float]:
+    client = _client_or_none()
+    if client is not None:
+        return client.cluster_info("cluster_resources")
     return _worker_mod.global_worker().cluster_resources()
 
 
 def available_resources() -> Dict[str, float]:
+    client = _client_or_none()
+    if client is not None:
+        return client.cluster_info("available_resources")
     return _worker_mod.global_worker().available_resources()
+
+
+def get_actor(name: str, namespace: str = "default"):
+    """Look up a named actor (parity: ``ray.get_actor``)."""
+    client = _client_or_none()
+    if client is not None:
+        return client.get_named_actor(name, namespace)
+    from ray_tpu import actor as _actor_mod
+    return _actor_mod.get_actor(name, namespace)
 
 
 def method(**options):

@@ -84,6 +84,18 @@ def cmd_start(args) -> None:
         print(f"head started (pid {proc.pid})")
         print(f"  GCS address: {gcs[0]}:{gcs[1]}")
         print(f"  session dir: {handshake['session_dir']}")
+        if args.ray_client_server_port:
+            import subprocess as sp
+            client_proc = sp.Popen(
+                [sys.executable, "-m", "ray_tpu.util.client.server",
+                 "--address", f"{gcs[0]}:{gcs[1]}",
+                 "--port", str(args.ray_client_server_port)])
+            record["client_server_pid"] = client_proc.pid
+            with open(_latest_path(), "w") as f:
+                json.dump(record, f)
+            print(f"  client server: "
+                  f"ray://{gcs[0]}:{args.ray_client_server_port} "
+                  f"(pid {client_proc.pid})")
         print(f"connect with: ray_tpu.init(address=\"{gcs[0]}:{gcs[1]}\")"
               f" or ray_tpu.init(address=\"auto\") with "
               f"RAY_TPU_ADDRESS={gcs[0]}:{gcs[1]}")
@@ -101,6 +113,13 @@ def cmd_stop(args) -> None:
     if latest is None:
         sys.exit("no recorded head (nothing started via `ray-tpu start`)")
     pid = latest.get("pid")
+    client_pid = latest.get("client_server_pid")
+    if client_pid:
+        try:
+            os.kill(client_pid, signal.SIGTERM)
+            print(f"sent SIGTERM to client server (pid {client_pid})")
+        except ProcessLookupError:
+            pass
     try:
         os.kill(pid, signal.SIGTERM)
         print(f"sent SIGTERM to head (pid {pid})")
@@ -211,6 +230,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("start", help="start a head or worker node")
     sp.add_argument("--head", action="store_true")
+    sp.add_argument("--ray-client-server-port", type=int, default=None,
+                    help="also start a ray:// client server on this port")
     sp.add_argument("--address", help="GCS address to join (worker mode)")
     sp.add_argument("--num-cpus", type=float)
     sp.add_argument("--num-tpus", type=float)

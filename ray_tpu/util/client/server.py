@@ -1,0 +1,212 @@
+"""Client server: the cluster-side proxy for remote drivers.
+
+Parity: reference ``python/ray/util/client/server/server.py``
+(``RayletServicer``:96, ``Schedule``:593) — a server process on the
+cluster holding a real driver connection; remote clients speak a thin
+RPC protocol (here the runtime's framed asyncio RPC instead of gRPC)
+and the server executes ``put/get/wait/submit/actor`` on their behalf.
+The server owns every ObjectRef a client holds (owner-based lifetime,
+reference ``proxier.py`` semantics): refs are tracked per client
+connection and released when the client disconnects or sends
+``release``.
+
+Run with ``python -m ray_tpu.util.client.server --address <gcs>
+--port 10001`` or let ``ray-tpu start --head`` spawn it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Any, Dict, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core import rpc
+from ray_tpu.core.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+def _unpickle_with_refs(payload: bytes, refs: Dict[bytes, ObjectRef]):
+    """Client args arrive cloudpickled; ObjectRefs inside them unpickle
+    into unregistered stubs — swap in the server-owned refs so ownership
+    bookkeeping stays with the server's driver connection."""
+    value = cloudpickle.loads(payload)
+
+    def swap(x):
+        if isinstance(x, ObjectRef):
+            owned = refs.get(x.binary())
+            return owned if owned is not None else x
+        if isinstance(x, (list, tuple)):
+            out = [swap(v) for v in x]
+            return type(x)(out) if isinstance(x, tuple) else out
+        if isinstance(x, dict):
+            return {k: swap(v) for k, v in x.items()}
+        return x
+
+    return swap(value)
+
+
+class ClientService:
+    """One service for all client connections; per-connection ref/actor
+    tables keyed by the Connection object."""
+
+    def __init__(self):
+        self._refs: Dict[Any, Dict[bytes, ObjectRef]] = {}
+        self._actors: Dict[Any, Dict[bytes, Any]] = {}
+        self._functions: Dict[str, Any] = {}
+        self._actor_classes: Dict[str, Any] = {}
+
+    # -- connection lifecycle -------------------------------------------
+    def on_connection(self, conn) -> None:
+        self._refs[conn] = {}
+        self._actors[conn] = {}
+
+    def on_disconnection(self, conn) -> None:
+        # dropping the table drops the server-side refs -> distributed GC
+        self._refs.pop(conn, None)
+        self._actors.pop(conn, None)
+
+    def _track(self, conn, ref: ObjectRef) -> Dict[str, Any]:
+        self._refs[conn][ref.binary()] = ref
+        return {"id": ref.binary(), "owner": ref.owner_address()}
+
+    # -- data plane ------------------------------------------------------
+    async def handle_put(self, conn, data) -> Dict[str, Any]:
+        value = _unpickle_with_refs(data["value"], self._refs[conn])
+        ref = await asyncio.to_thread(ray_tpu.put, value)
+        return self._track(conn, ref)
+
+    async def handle_get(self, conn, data) -> Dict[str, Any]:
+        refs = [self._resolve(conn, b) for b in data["ids"]]
+        values = await asyncio.to_thread(
+            ray_tpu.get, refs, timeout=data.get("timeout"))
+        return {"values": [cloudpickle.dumps(v) for v in values]}
+
+    async def handle_wait(self, conn, data) -> Dict[str, Any]:
+        refs = [self._resolve(conn, b) for b in data["ids"]]
+        ready, pending = await asyncio.to_thread(
+            ray_tpu.wait, refs, num_returns=data.get("num_returns", 1),
+            timeout=data.get("timeout"))
+        return {"ready": [r.binary() for r in ready],
+                "pending": [r.binary() for r in pending]}
+
+    async def handle_release(self, conn, data) -> None:
+        for b in data["ids"]:
+            self._refs[conn].pop(b, None)
+
+    def _resolve(self, conn, id_bin: bytes) -> ObjectRef:
+        ref = self._refs[conn].get(id_bin)
+        if ref is None:
+            raise rpc.RpcError(f"client ref {id_bin.hex()} unknown "
+                               f"(released or from another session)")
+        return ref
+
+    # -- tasks -----------------------------------------------------------
+    async def handle_register_function(self, conn, data) -> None:
+        fid = data["id"]
+        if fid not in self._functions:
+            fn = cloudpickle.loads(data["pickled"])
+            self._functions[fid] = ray_tpu.remote(fn)
+
+    async def handle_task(self, conn, data) -> Dict[str, Any]:
+        fn = self._functions[data["id"]]
+        if data.get("options"):
+            fn = fn.options(**data["options"])
+        args = _unpickle_with_refs(data["args"], self._refs[conn])
+        kwargs = _unpickle_with_refs(data["kwargs"], self._refs[conn])
+        ref = await asyncio.to_thread(fn.remote, *args, **kwargs)
+        if isinstance(ref, list):  # num_returns > 1
+            return {"ids": [self._track(conn, r) for r in ref]}
+        return self._track(conn, ref)
+
+    # -- actors ----------------------------------------------------------
+    async def handle_register_actor_class(self, conn, data) -> None:
+        cid = data["id"]
+        if cid not in self._actor_classes:
+            cls = cloudpickle.loads(data["pickled"])
+            self._actor_classes[cid] = ray_tpu.remote(cls)
+
+    async def handle_create_actor(self, conn, data) -> Dict[str, Any]:
+        ac = self._actor_classes[data["id"]]
+        if data.get("options"):
+            ac = ac.options(**data["options"])
+        args = _unpickle_with_refs(data["args"], self._refs[conn])
+        kwargs = _unpickle_with_refs(data["kwargs"], self._refs[conn])
+        handle = await asyncio.to_thread(ac.remote, *args, **kwargs)
+        self._actors[conn][handle.actor_id.binary()] = handle
+        return {"actor_id": handle.actor_id.binary()}
+
+    async def handle_actor_call(self, conn, data) -> Dict[str, Any]:
+        handle = self._actors[conn][data["actor_id"]]
+        method = getattr(handle, data["method"])
+        args = _unpickle_with_refs(data["args"], self._refs[conn])
+        kwargs = _unpickle_with_refs(data["kwargs"], self._refs[conn])
+        ref = await asyncio.to_thread(method.remote, *args, **kwargs)
+        if isinstance(ref, list):
+            return {"ids": [self._track(conn, r) for r in ref]}
+        return self._track(conn, ref)
+
+    async def handle_get_named_actor(self, conn, data) -> Dict[str, Any]:
+        handle = await asyncio.to_thread(
+            ray_tpu.get_actor, data["name"],
+            namespace=data.get("namespace") or "default")
+        # don't displace an owning handle for the same actor — dropping
+        # it would GC-kill the actor out from under the client
+        self._actors[conn].setdefault(handle.actor_id.binary(), handle)
+        return {"actor_id": handle.actor_id.binary()}
+
+    async def handle_kill_actor(self, conn, data) -> None:
+        handle = self._actors[conn].get(data["actor_id"])
+        if handle is not None:
+            await asyncio.to_thread(
+                ray_tpu.kill, handle,
+                no_restart=data.get("no_restart", True))
+
+    # -- introspection ---------------------------------------------------
+    async def handle_cluster_info(self, conn, data) -> Dict[str, Any]:
+        kind = data["kind"]
+        if kind == "nodes":
+            return {"value": await asyncio.to_thread(ray_tpu.nodes)}
+        if kind == "cluster_resources":
+            return {"value": await asyncio.to_thread(
+                ray_tpu.cluster_resources)}
+        if kind == "available_resources":
+            return {"value": await asyncio.to_thread(
+                ray_tpu.available_resources)}
+        if kind == "ping":
+            return {"value": "pong"}
+        raise rpc.RpcError(f"unknown cluster_info kind {kind!r}")
+
+
+async def _serve(host: str, port: int) -> None:
+    server = rpc.Server(ClientService(), host=host, port=port)
+    addr = await server.start()
+    logger.info("client server listening on %s:%s", *addr)
+    print(f"ray_tpu client server ready on ray://{addr[0]}:{addr[1]}",
+          flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="ray_tpu client server (remote-driver proxy)")
+    parser.add_argument("--address", required=True,
+                        help="GCS address host:port of the cluster")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=10001)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    # init outside the event loop (driver connection is synchronous)
+    ray_tpu.init(address=args.address)
+    asyncio.run(_serve(args.host, args.port))
+
+
+if __name__ == "__main__":
+    main()
