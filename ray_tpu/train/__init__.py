@@ -11,10 +11,18 @@ from ray_tpu.train.config import (  # noqa: F401
 )
 from ray_tpu.train.predictor import (  # noqa: F401
     BatchPredictor,
+    HuggingFacePredictor,
     JaxPredictor,
     Predictor,
     SklearnPredictor,
 )
+from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer  # noqa: F401
+from ray_tpu.train.huggingface import HuggingFaceTrainer  # noqa: F401
 from ray_tpu.train.sklearn import SklearnTrainer  # noqa: F401
-from ray_tpu.train.trainer import JaxTrainer, Result, TorchTrainer  # noqa: F401
+from ray_tpu.train.trainer import (  # noqa: F401
+    JaxTrainer,
+    Result,
+    TensorflowTrainer,
+    TorchTrainer,
+)
 from ray_tpu.train.worker_group import TrainWorker, WorkerGroup  # noqa: F401

@@ -63,7 +63,11 @@ class Checkpoint:
 
     def to_directory(self, path: Optional[str] = None) -> str:
         if self._dir is not None:
-            return self._dir
+            if path is None or \
+                    os.path.abspath(path) == os.path.abspath(self._dir):
+                return self._dir
+            shutil.copytree(self._dir, path, dirs_exist_ok=True)
+            return path
         path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
         os.makedirs(path, exist_ok=True)
         for key, value in self._data.items():
@@ -71,6 +75,25 @@ class Checkpoint:
             with open(os.path.join(path, key), "wb") as f:
                 f.write(blob)
         return path
+
+    def as_directory(self):
+        """Context manager yielding a directory view (reference
+        ``Checkpoint.as_directory``); temp dirs for dict-backed
+        checkpoints are cleaned up on exit."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            if self._dir is not None:
+                yield self._dir
+                return
+            path = self.to_directory()
+            try:
+                yield path
+            finally:
+                shutil.rmtree(path, ignore_errors=True)
+
+        return _cm()
 
     def to_pytree(self, target: Any) -> Any:
         """Restore a pytree saved by ``from_pytree`` (``target`` supplies

@@ -78,6 +78,42 @@ class SklearnPredictor(Predictor):
         return {"predictions": self._est.predict(self._features(batch))}
 
 
+class HuggingFacePredictor(Predictor):
+    """Inference from a HuggingFaceTrainer checkpoint (reference
+    ``train/huggingface/huggingface_predictor.py``): the checkpoint
+    directory is a ``from_pretrained``-loadable model."""
+
+    def __init__(self, model: Any, tokenizer: Any = None):
+        self._model = model
+        self._tokenizer = tokenizer
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, *,
+                        model_cls: Any = None,
+                        tokenizer_cls: Any = None,
+                        **kwargs) -> "HuggingFacePredictor":
+        import transformers
+
+        model_cls = model_cls or transformers.AutoModel
+        with checkpoint.as_directory() as d:
+            model = model_cls.from_pretrained(d, **kwargs)
+            tokenizer = None
+            if tokenizer_cls is not None:
+                tokenizer = tokenizer_cls.from_pretrained(d)
+        model.eval()
+        return cls(model, tokenizer)
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        import torch
+
+        with torch.no_grad():
+            tensors = {k: torch.as_tensor(np.asarray(v))
+                       for k, v in batch.items()}
+            out = self._model(**tensors)
+        logits = out.logits if hasattr(out, "logits") else out[0]
+        return {"predictions": logits.numpy()}
+
+
 class BatchPredictor:
     """Checkpoint + predictor class -> Dataset map (reference
     ``batch_predictor.py``).  Uses actor-pool compute so each worker

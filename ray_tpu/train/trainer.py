@@ -144,7 +144,10 @@ class JaxTrainer:
         n = self.scaling_config.num_workers
         shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, dataset in self.datasets.items():
-            if hasattr(dataset, "split"):
+            if hasattr(dataset, "shard"):  # huggingface datasets API
+                parts = [dataset.shard(num_shards=n, index=i)
+                         for i in range(n)]
+            elif callable(getattr(dataset, "split", None)):
                 parts = dataset.split(n)
             else:
                 parts = [dataset] * n
@@ -173,3 +176,15 @@ class TorchTrainer(JaxTrainer):
     """
 
     _backend = "torch"
+
+
+class TensorflowTrainer(JaxTrainer):
+    """Data-parallel TensorFlow training over gang actors.
+
+    Parity: reference ``train/tensorflow/tensorflow_trainer.py`` —
+    ``setup_backend`` writes TF_CONFIG across the gang so the user loop
+    can build ``tf.distribute.MultiWorkerMirroredStrategy()``; same
+    fit/report contract as :class:`JaxTrainer`.
+    """
+
+    _backend = "tensorflow"

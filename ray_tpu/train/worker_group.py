@@ -86,6 +86,19 @@ class TrainWorker:
                 process_id=self.world_rank)
         return True
 
+    def setup_tensorflow(self, cluster_workers: List[str]) -> bool:
+        """Write TF_CONFIG for MultiWorkerMirroredStrategy (parity:
+        reference ``train/tensorflow/config.py`` ``_setup_tensorflow_
+        environment`` — cluster spec of every gang member plus this
+        worker's task index)."""
+        import json
+
+        os.environ["TF_CONFIG"] = json.dumps({
+            "cluster": {"worker": cluster_workers},
+            "task": {"type": "worker", "index": self.world_rank},
+        })
+        return True
+
     def run(self, fn: Callable, config: Dict[str, Any],
             dataset_shard: Any = None, resume_checkpoint=None) -> bool:
         """Start the user loop on a background thread; returns
@@ -181,6 +194,14 @@ class WorkerGroup:
             host, port = ray_tpu.get(
                 self.workers[0].hostname_and_port.remote(), timeout=60)
             ray_tpu.get([w.setup_torch.remote(f"tcp://{host}:{port}")
+                         for w in self.workers], timeout=600)
+            return
+        if backend == "tensorflow":
+            addrs = ray_tpu.get(
+                [w.hostname_and_port.remote() for w in self.workers],
+                timeout=60)
+            cluster = [f"{h}:{p}" for h, p in addrs]
+            ray_tpu.get([w.setup_tensorflow.remote(cluster)
                          for w in self.workers], timeout=600)
             return
         use_tpu = (self.scaling.tpus_per_worker or 0) > 0
