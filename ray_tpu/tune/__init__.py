@@ -14,11 +14,13 @@ from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig
 from ray_tpu.tune import schedulers  # noqa: F401
 from ray_tpu.tune.execution import TrialRunner
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler,  # noqa: F401
-                                     FIFOScheduler, MedianStoppingRule,
+                                     FIFOScheduler, HyperBandScheduler,
+                                     MedianStoppingRule,
                                      PopulationBasedTraining, TrialScheduler)
-from ray_tpu.tune.search import (BasicVariantGenerator, BayesOptSearch, Searcher,  # noqa: F401
-                                 choice, grid_search, loguniform, quniform,
-                                 randint, sample_from, uniform)
+from ray_tpu.tune.search import (BasicVariantGenerator, BayesOptSearch,  # noqa: F401
+                                 HyperOptSearch, OptunaSearch, Searcher,
+                                 TPESearch, choice, grid_search, loguniform,
+                                 quniform, randint, sample_from, uniform)
 from ray_tpu.tune.trial import (ERROR, TERMINATED, Trial,  # noqa: F401
                                 get_checkpoint, report)
 

@@ -76,8 +76,16 @@ class TrialRunner:
             trial.actor = None
         trial.status = status
 
+    def requeue_trial(self, trial: Trial) -> None:
+        """Move a PAUSED trial back to the pending queue (sync-HyperBand
+        promotion, PBT exploit targets)."""
+        if trial.status == PAUSED:
+            trial.status = PENDING
+            self._pending.append(trial)
+
     def run(self, poll_period: float = 0.05) -> List[Trial]:
-        pending = [t for t in self.trials if t.status == PENDING]
+        self._pending = pending = [t for t in self.trials
+                                   if t.status == PENDING]
         live: List[Trial] = []
         while pending or live:
             while pending and len(live) < self.max_concurrent:
@@ -118,6 +126,13 @@ class TrialRunner:
                         trial.checkpoint = ckpt
                     trial.status = PENDING
                     pending.append(trial)
+                    continue
+                if decision == sched_mod.PAUSE:
+                    # sync-scheduler pause (no exploit attached): park the
+                    # trial; the scheduler promotes via requeue_trial
+                    self._stop_trial(trial, PAUSED)
+                    live.remove(trial)
+                    self.scheduler.on_trial_paused(self, trial)
                     continue
                 if polls["done"]:
                     live.remove(trial)

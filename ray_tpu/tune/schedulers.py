@@ -25,6 +25,9 @@ class TrialScheduler:
                           result: Optional[Dict[str, Any]]) -> None:
         pass
 
+    def on_trial_paused(self, runner, trial: "Trial") -> None:
+        pass
+
 
 class FIFOScheduler(TrialScheduler):
     pass
@@ -171,3 +174,99 @@ class PopulationBasedTraining(TrialScheduler):
                 if isinstance(config[key], (int, float)):
                     config[key] = type(config[key])(config[key] * factor)
         return config
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous successive halving (parity: reference
+    ``tune/schedulers/hyperband.py``, single-bracket model): every trial
+    reaching a rung milestone PAUSES; once the whole rung population has
+    reported, the top 1/eta are promoted (requeued from checkpoint) and
+    the rest terminated.  Differs from ASHA by never promoting on
+    partial information — the trade is stragglers gate each rung."""
+
+    def __init__(self, *, metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self._milestones: List[int] = []
+        milestone = grace_period
+        while milestone < max_t:
+            self._milestones.append(milestone)
+            milestone = int(milestone * reduction_factor)
+        # rung index -> {trial_id: metric at rung}
+        self._rung_results: Dict[int, Dict[str, float]] = {}
+        # rung index -> population size expected to report there
+        self._rung_population: Dict[int, int] = {}
+        self._started = False
+        self._trial_rung: Dict[str, int] = {}  # next milestone index
+
+    def _value(self, result) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def _ensure_started(self, runner) -> None:
+        if not self._started:
+            self._started = True
+            self._rung_population[0] = len(runner.trials)
+            for t in runner.trials:
+                self._trial_rung[t.trial_id] = 0
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        self._ensure_started(runner)
+        rung = self._trial_rung.get(trial.trial_id, 0)
+        if rung >= len(self._milestones):
+            if result.get(self.time_attr, 0) >= self.max_t:
+                return STOP
+            return CONTINUE
+        if result.get(self.time_attr, 0) < self._milestones[rung]:
+            return CONTINUE
+        v = self._value(result)
+        if v is None:
+            return CONTINUE
+        self._rung_results.setdefault(rung, {})[trial.trial_id] = v
+        return PAUSE
+
+    def on_trial_paused(self, runner, trial) -> None:
+        self._maybe_promote(runner, self._trial_rung.get(trial.trial_id, 0))
+
+    def on_trial_complete(self, runner, trial, result) -> None:
+        # a trial finishing early still counts toward its rung quorum
+        rung = self._trial_rung.pop(trial.trial_id, None)
+        if rung is None or rung >= len(self._milestones):
+            return
+        v = self._value(result or trial.last_result or {})
+        self._rung_results.setdefault(rung, {}) \
+            .setdefault(trial.trial_id, v if v is not None else float("-inf"))
+        self._maybe_promote(runner, rung)
+
+    def _maybe_promote(self, runner, rung: int) -> None:
+        results = self._rung_results.get(rung, {})
+        expected = self._rung_population.get(rung, 0)
+        if len(results) < expected or expected == 0:
+            return  # rung not complete yet
+        keep = max(1, int(math.floor(len(results) / self.rf)))
+        ranked = sorted(results.items(), key=lambda kv: kv[1], reverse=True)
+        promoted = {tid for tid, _ in ranked[:keep]}
+        self._rung_population[rung + 1] = 0
+        from ray_tpu.tune.trial import PAUSED, TERMINATED
+
+        for tid, _ in ranked:
+            trial = runner.get_trial(tid)
+            if trial is None or trial.status != PAUSED:
+                # finished/errored trials cannot be promoted
+                continue
+            if tid in promoted and rung + 1 <= len(self._milestones):
+                self._trial_rung[tid] = rung + 1
+                self._rung_population[rung + 1] += 1
+                runner.requeue_trial(trial)
+            else:
+                trial.status = TERMINATED
+        self._rung_results[rung] = dict(results)  # freeze
+        self._rung_population[rung] = 0  # promotion done
