@@ -3,4 +3,10 @@ annotations consumed by ``ray_tpu.parallel.sharding``."""
 
 from ray_tpu.models.gpt2 import GPT2, GPT2Config  # noqa: F401
 from ray_tpu.models.llama import Llama, LlamaConfig  # noqa: F401
+from ray_tpu.models.moe import (  # noqa: F401
+    MoEConfig,
+    MoETransformer,
+    SparseMoEMLP,
+)
 from ray_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401
+from ray_tpu.models.vit import ViT, ViTConfig  # noqa: F401

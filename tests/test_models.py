@@ -169,3 +169,110 @@ def test_resnet_forward_backward():
 
     grads = jax.grad(loss)(variables["params"])
     assert jax.tree.leaves(grads)
+
+
+def test_moe_forward_loss_and_routing():
+    from ray_tpu.models import MoEConfig, MoETransformer
+    from ray_tpu.models.moe import loss_fn as moe_loss
+
+    cfg = MoEConfig.tiny(dtype=jnp.float32, attn_impl="reference")
+    model = MoETransformer(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init_params(rng, batch=2, seq=16)
+    tokens = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # sparse: active params strictly below total (top-2 of 4 experts)
+    assert cfg.active_params_per_token() < cfg.num_params()
+
+    tx = optax.adam(3e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(p, o, t):
+        loss, grads = jax.value_and_grad(
+            lambda p_: moe_loss(model, p_, t))(p)
+        updates, o = tx.update(grads, o)
+        return optax.apply_updates(p, updates), o, loss
+
+    losses = []
+    for _ in range(15):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_moe_expert_parallel_sharded_step():
+    """MoE train step under EP rules on the 8-device mesh: experts
+    sharded over ep, GSPMD inserts the dispatch all-to-alls."""
+    import flax
+
+    from ray_tpu.models import MoEConfig, MoETransformer
+    from ray_tpu.models.moe import loss_fn as moe_loss
+    from ray_tpu.parallel.sharding import EP_RULES, logical_to_mesh
+
+    mesh = build_mesh(MeshConfig(dp=2, ep=4))
+    rules = EP_RULES.merged(batch=("dp",), embed=None, mlp=None,
+                            heads=None, kv=None, vocab=None)
+    cfg = MoEConfig.tiny(dtype=jnp.float32, num_experts=4,
+                         attn_impl="reference")
+    model = MoETransformer(cfg)
+    rng = jax.random.PRNGKey(0)
+    abstract = jax.eval_shape(
+        lambda: model.init(rng, jnp.zeros((1, 16), jnp.int32))["params"])
+    specs = logical_to_mesh(rules, nn_logical_specs(abstract))
+    params = model.init(rng, jnp.zeros((1, 16), jnp.int32))["params"]
+    flat_params = flax.traverse_util.flatten_dict(
+        flax.core.unfreeze(params))
+    flat_specs = flax.traverse_util.flatten_dict(specs)
+    placed = {}
+    for key, val in flat_params.items():
+        leaf = val.unbox() if hasattr(val, "unbox") else val
+        placed[key] = jax.device_put(
+            leaf, NamedSharding(mesh, flat_specs.get(key, P())))
+    params = flax.traverse_util.unflatten_dict(placed)
+    # expert-stacked weights actually sharded over ep
+    moe_up = placed[("h0", "moe", "up")]
+    assert moe_up.sharding.spec == P("ep", None, None)
+
+    tokens = jax.device_put(
+        jnp.zeros((4, 16), jnp.int32),
+        NamedSharding(mesh, P(("dp",), None)))
+
+    @jax.jit
+    def step(p, t):
+        return jax.grad(lambda p_: moe_loss(model, p_, t))(p)
+
+    grads = step(params, tokens)
+    chex_assert_finite(grads)
+
+
+def test_vit_forward_backward_and_learns():
+    from ray_tpu.models import ViT, ViTConfig
+    from ray_tpu.models.vit import loss_fn as vit_loss
+
+    cfg = ViTConfig.tiny(dtype=jnp.float32, attn_impl="reference")
+    model = ViT(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init_params(rng, batch=4)
+    images = jax.random.normal(rng, (4, 32, 32, 3))
+    labels = jnp.array([0, 1, 2, 3])
+    logits = model.apply({"params": params}, images)
+    assert logits.shape == (4, 10)
+
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        loss, grads = jax.value_and_grad(
+            lambda p_: vit_loss(model, p_, images, labels))(p)
+        updates, o = tx.update(grads, o)
+        return optax.apply_updates(p, updates), o, loss
+
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7
