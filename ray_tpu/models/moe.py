@@ -234,8 +234,10 @@ def loss_fn(model: MoETransformer, params, tokens: jax.Array) -> jax.Array:
     (x, wte), state = model.apply(
         {"params": params}, tokens, method=MoETransformer.hidden,
         mutable=["intermediates"])
+    compute = jnp.bfloat16 if model.config.dtype == jnp.bfloat16 else None
     lm = chunked_lm_loss(x[:, :-1].astype(jnp.float32),
-                         wte.astype(jnp.float32), tokens[:, 1:])
+                         wte.astype(jnp.float32), tokens[:, 1:],
+                         compute_dtype=compute)
     aux_leaves = jax.tree_util.tree_leaves(
         state.get("intermediates", {}))
     aux = sum(jnp.sum(a) for a in aux_leaves) if aux_leaves else 0.0

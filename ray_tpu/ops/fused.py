@@ -8,7 +8,7 @@ the kernels are forward-only with ``custom_vjp`` recompute backward).
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -88,7 +88,8 @@ def fused_softmax_cross_entropy(logits: jax.Array,
 
 
 def chunked_lm_loss(hidden: jax.Array, emb: jax.Array, labels: jax.Array,
-                    *, chunk: int = 8192) -> jax.Array:
+                    *, chunk: int = 8192,
+                    compute_dtype: Any = None) -> jax.Array:
     """Mean next-token cross entropy with a chunked LM head.
 
     ``hidden`` [B,T,E] (f32), ``emb`` [V,E] (tied embedding), ``labels``
@@ -117,7 +118,15 @@ def chunked_lm_loss(hidden: jax.Array, emb: jax.Array, labels: jax.Array,
     @jax.checkpoint
     def body(carry, xs):
         h, y, m = xs
-        logits = h @ emb_f32.T  # [chunk, V]
+        if compute_dtype is not None:
+            # MXU path: bf16 operands, f32 accumulation — the lse/label
+            # math below stays f32
+            logits = jax.lax.dot_general(
+                h.astype(compute_dtype), emb_f32.astype(compute_dtype),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            logits = h @ emb_f32.T  # [chunk, V]
         mx = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
         shifted = logits - mx
         lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
