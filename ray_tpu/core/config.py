@@ -51,7 +51,7 @@ class Config:
     scheduler_spread_threshold: float = 0.5
     #: Max tasks in flight to a single leased worker before requesting more
     #: workers (pipelining depth).
-    max_tasks_in_flight_per_worker: int = 10
+    max_tasks_in_flight_per_worker: int = 64
     #: Seconds a leased idle worker is kept before being returned.
     idle_worker_lease_timeout_s: float = 0.25
     #: Number of workers each raylet keeps pre-started.

@@ -91,31 +91,53 @@ class SerializedObject:
         return bytes(out[:n])
 
 
-def serialize(value: Any) -> SerializedObject:
-    """Serialize ``value``, extracting large buffers out-of-band and
-    collecting any contained ObjectRefs."""
-    from ray_tpu.core.object_ref import ObjectRef  # cycle-free at call time
+class _RefAwarePickler(cloudpickle.CloudPickler):
+    """CloudPickler that records contained ObjectRefs via persistent_id.
 
-    buffers: List = []
-    contained: List = []
+    Defined at module scope — building this class per serialize() call
+    (a closure class) measured 63 us per empty-dict serialize, i.e. the
+    bulk of the per-task submission cost on the hot path."""
 
-    def buffer_callback(buf: pickle.PickleBuffer) -> bool:
+    def __init__(self, sink, buffers: List, contained: List):
+        super().__init__(sink, protocol=5,
+                         buffer_callback=self._buffer_callback)
+        self._oob_buffers = buffers
+        self._contained = contained
+
+    def _buffer_callback(self, buf: pickle.PickleBuffer) -> bool:
         view = buf.raw()
         if view.nbytes >= 512:  # tiny buffers travel in-band
-            buffers.append(view)
+            self._oob_buffers.append(view)
             return False  # out-of-band
         return True
 
-    class _Pickler(cloudpickle.CloudPickler):
-        def persistent_id(self, obj):  # noqa: N802 (pickle API name)
-            if isinstance(obj, ObjectRef):
-                contained.append(obj)
-                return ("rtpu_ref", obj.binary(), obj.owner_address())
-            return None
+    def persistent_id(self, obj):  # noqa: N802 (pickle API name)
+        from ray_tpu.core.object_ref import ObjectRef
 
+        if isinstance(obj, ObjectRef):
+            self._contained.append(obj)
+            return ("rtpu_ref", obj.binary(), obj.owner_address())
+        return None
+
+
+_EMPTY_DICT_WIRE: Any = None
+
+
+def serialize(value: Any) -> SerializedObject:
+    """Serialize ``value``, extracting large buffers out-of-band and
+    collecting any contained ObjectRefs."""
+    global _EMPTY_DICT_WIRE
+    if type(value) is dict and not value:
+        # every no-kwarg task submission serializes {}; cache the bytes
+        if _EMPTY_DICT_WIRE is None:
+            sink = io.BytesIO()
+            _RefAwarePickler(sink, [], []).dump({})
+            _EMPTY_DICT_WIRE = sink.getvalue()
+        return SerializedObject(_EMPTY_DICT_WIRE, [], [])
+    buffers: List = []
+    contained: List = []
     sink = io.BytesIO()
-    pickler = _Pickler(sink, protocol=5, buffer_callback=buffer_callback)
-    pickler.dump(value)
+    _RefAwarePickler(sink, buffers, contained).dump(value)
     return SerializedObject(sink.getvalue(), buffers, contained)
 
 

@@ -815,12 +815,27 @@ class CoreWorker:
         # Phase 3 — pipeline further tasks onto busy workers up to the
         # in-flight cap (throughput for sub-millisecond tasks), but always
         # leave at least one queued task per pending lease grant so new
-        # workers (possibly on other nodes) get work on arrival.
+        # workers (possibly on other nodes) get work on arrival.  Tasks
+        # for one worker ship as ONE batched RPC frame: per-task frames
+        # measured ~420 us of event-loop work each on nop storms.
         reserve = max(1, state.requesting)
         for worker in list(state.workers.values()):
-            while len(state.backlog) > reserve and \
-                    worker.inflight < self.config.max_tasks_in_flight_per_worker:
-                self._dispatch_to_worker(state, worker)
+            room = self.config.max_tasks_in_flight_per_worker \
+                - worker.inflight
+            batch: List[TaskSpec] = []
+            while len(state.backlog) > reserve and room > 0:
+                batch.append(state.backlog.popleft())
+                room -= 1
+            if not batch:
+                continue
+            worker.inflight += len(batch)
+            if len(batch) == 1:
+                task = self._loop.create_task(
+                    self._push_task(state, worker, batch[0]))
+            else:
+                task = self._loop.create_task(
+                    self._push_task_batch(state, worker, batch))
+            task.add_done_callback(lambda t: t.exception())
         # Phase 4 — arm a return timer on every lease left idle, so leased
         # resources flow back to the raylet for other scheduling keys
         # (leaked leases deadlock the node once CPUs are exhausted)
@@ -924,6 +939,34 @@ class CoreWorker:
             return
         worker.inflight -= 1
         self._handle_task_reply(spec, reply)
+        self._pump_lease_queue(state)
+
+    async def _push_task_batch(self, state: "_LeaseState",
+                               worker: "_LeasedWorker",
+                               specs: List[TaskSpec]) -> None:
+        """Ship several specs to one leased worker in one RPC frame."""
+        if worker.return_handle is not None:
+            worker.return_handle.cancel()
+            worker.return_handle = None
+        try:
+            conn = await self._pool.get(worker.address)
+            for spec in specs:
+                self._record_task_event(spec, "RUNNING")
+            reply = await conn.call(
+                "push_tasks", {"specs_blob": cloudpickle.dumps(specs)},
+                timeout=None)
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            worker.inflight -= len(specs)
+            state.workers.pop(worker.worker_id, None)
+            self._pool.invalidate(worker.address)
+            for spec in specs:
+                self._retry_or_fail(spec, WorkerCrashedError(
+                    f"worker died while running {spec.debug_name()}: {e}"))
+            self._pump_lease_queue(state)
+            return
+        worker.inflight -= len(specs)
+        for spec, one in zip(specs, reply["replies"]):
+            self._handle_task_reply(spec, one)
         self._pump_lease_queue(state)
 
     async def _return_lease(self, state: "_LeaseState",
@@ -1358,6 +1401,16 @@ class CoreWorker:
         # enqueue synchronously (before any await) to preserve arrival order
         self._exec_queue.put((spec, reply_fut))
         return await reply_fut
+
+    async def handle_push_tasks(self, conn, data):
+        """Batched variant of push_task: one frame, ordered enqueue."""
+        specs: List[TaskSpec] = cloudpickle.loads(data["specs_blob"])
+        futs = []
+        for spec in specs:
+            reply_fut = self._loop.create_future()
+            self._exec_queue.put((spec, reply_fut))
+            futs.append(reply_fut)
+        return {"replies": list(await asyncio.gather(*futs))}
 
     async def handle_push_actor_task(self, conn, data):
         if self._actor_instance is None:
