@@ -21,7 +21,19 @@ from ray_tpu.rllib.env import (  # noqa: F401
     make_env,
     register_env,
 )
+from ray_tpu.rllib.connectors import (  # noqa: F401
+    ClipActions,
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    NormalizeObs,
+)
 from ray_tpu.rllib.policy import JaxPolicy  # noqa: F401
+from ray_tpu.rllib.policy_server import (  # noqa: F401
+    PolicyClient,
+    PolicyServerInput,
+)
 from ray_tpu.rllib.postprocessing import compute_gae  # noqa: F401
 from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
 from ray_tpu.rllib.sample_batch import (  # noqa: F401

@@ -58,6 +58,12 @@ class AlgorithmConfig:
             self.env_config = dict(env_config)
         return self
 
+    def rollouts_input(self, input_: Any) -> "AlgorithmConfig":
+        """External sampling input: callable(worker) -> reader with
+        ``.next()`` (reference ``input_`` — e.g. PolicyServerInput)."""
+        self.input_ = input_
+        return self
+
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
                  num_envs_per_worker: Optional[int] = None,
                  rollout_fragment_length: Optional[int] = None

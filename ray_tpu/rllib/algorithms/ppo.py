@@ -49,7 +49,21 @@ class PPOPolicy(JaxPolicy):
 
     def loss(self, params, batch):
         cfg = self.config
-        dist_inputs, vf = self.model.apply(params, batch[SampleBatch.OBS])
+        if "seq_mask" in batch:
+            # recurrent: [S, L, ...] padded sequences, scan from the
+            # sampled initial carry; padded steps carry zero weight
+            mask = batch["seq_mask"]
+            carry = (batch["state_in_c"], batch["state_in_h"])
+            dist_inputs, vf, _ = self.model.apply(
+                params, batch[SampleBatch.OBS], carry)
+            denom = jnp.maximum(mask.sum(), 1.0)
+
+            def mmean(x):
+                return jnp.sum(x * mask) / denom
+        else:
+            dist_inputs, vf = self.model.apply(params,
+                                               batch[SampleBatch.OBS])
+            mmean = jnp.mean
         logp = self.dist.logp(dist_inputs, batch[SampleBatch.ACTIONS])
         old_logp = batch[SampleBatch.ACTION_LOGP]
         adv = batch[SampleBatch.ADVANTAGES]
@@ -66,17 +80,17 @@ class PPOPolicy(JaxPolicy):
         entropy = self.dist.entropy(dist_inputs)
         # approximate KL(old || new) from logp ratios (Schulman estimator;
         # exact per-distribution KL needs old dist inputs in the batch)
-        kl = jnp.mean((ratio - 1.0) - jnp.log(ratio))
+        safe_ratio = jnp.where(ratio <= 0, 1.0, ratio)
+        kl = mmean((safe_ratio - 1.0) - jnp.log(safe_ratio))
 
-        total = jnp.mean(
-            -surrogate
-            + float(cfg.get("vf_loss_coeff", 1.0)) * vf_loss
-            - float(cfg.get("entropy_coeff", 0.0)) * entropy
-        ) + batch["kl_coeff"] * kl
+        total = (mmean(-surrogate)
+                 + float(cfg.get("vf_loss_coeff", 1.0)) * mmean(vf_loss)
+                 - float(cfg.get("entropy_coeff", 0.0)) * mmean(entropy)
+                 ) + batch["kl_coeff"] * kl
         stats = {
-            "policy_loss": -jnp.mean(surrogate),
-            "vf_loss": jnp.mean(vf_loss),
-            "entropy": jnp.mean(entropy),
+            "policy_loss": mmean(-surrogate),
+            "vf_loss": mmean(vf_loss),
+            "entropy": mmean(entropy),
             "kl": kl,
         }
         return total, stats
@@ -89,7 +103,7 @@ class PPOPolicy(JaxPolicy):
         kls = []
         with self._on_device():
             for _ in range(epochs):
-                for mb in batch.minibatches(mb_size, self._np_rng):
+                for mb in self._iter_minibatches(batch, mb_size):
                     dev = self._device_batch(mb)
                     dev["kl_coeff"] = jnp.float32(self.kl_coeff)
                     self.params, self.opt_state, stats = self._update(
@@ -98,6 +112,29 @@ class PPOPolicy(JaxPolicy):
                     kls.append(last_stats.get("kl", 0.0))
         # adaptive KL penalty (reference ``PPO.update_kl``)
         mean_kl = float(np.mean(kls)) if kls else 0.0
+        return self._finish_learn(last_stats, mean_kl)
+
+    def _iter_minibatches(self, batch: SampleBatch, mb_size: int):
+        if not self.recurrent:
+            yield from batch.minibatches(mb_size, self._np_rng)
+            return
+        # recurrent: shuffle and minibatch over SEQUENCES so carries
+        # stay aligned with their unrolls (reference rnn_sequencing)
+        from ray_tpu.rllib.sample_batch import build_sequences
+
+        max_len = int(self.config.get("model", {})
+                      .get("max_seq_len", 16))
+        seq = build_sequences(batch, max_len)
+        S = seq["seq_mask"].shape[0]
+        per_mb = max(1, mb_size // max_len)
+        perm = self._np_rng.permutation(S)
+        for start in range(0, S - S % per_mb or S, per_mb):
+            idx = perm[start:start + per_mb]
+            if len(idx):
+                yield {k: v[idx] for k, v in seq.items()}
+
+    def _finish_learn(self, last_stats, mean_kl):
+        cfg = self.config
         target = float(cfg.get("kl_target", 0.01))
         if mean_kl > 2.0 * target:
             self.kl_coeff *= 1.5

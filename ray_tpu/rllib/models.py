@@ -122,3 +122,41 @@ class TwinQNetwork(nn.Module):
             return nn.Dense(1, name=f"{name}_out")(x)[..., 0]
         q1 = q("q1")
         return (q1, q("q2")) if self.twin else (q1, q1)
+
+
+class LSTMNet(nn.Module):
+    """Recurrent torso (reference ``models/torch/recurrent_net.py`` /
+    model-config ``use_lstm``): obs -> Dense embed -> LSTM -> policy +
+    value heads.  Operates on sequences so training scans the whole
+    unroll in one XLA program; single-step acting passes T=1 sequences
+    with the carry threaded by the sampler."""
+
+    num_outputs: int
+    cell_size: int = 64
+    embed_size: int = 64
+    activation: str = "tanh"
+
+    @nn.compact
+    def __call__(self, obs_seq: jnp.ndarray, carry):
+        """obs_seq [B, T, obs_dim]; carry (c, h) each [B, cell_size].
+        Returns (dist_inputs [B,T,num_outputs], values [B,T], carry)."""
+        act = dict(tanh=nn.tanh, relu=nn.relu,
+                   swish=nn.swish)[self.activation]
+        x = act(nn.Dense(self.embed_size, name="embed")(obs_seq))
+        lstm = nn.scan(
+            nn.OptimizedLSTMCell,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1, out_axes=1,
+        )(features=self.cell_size, name="lstm")
+        carry, outs = lstm(tuple(carry), x)
+        logits = nn.Dense(self.num_outputs, name="out",
+                          kernel_init=nn.initializers.orthogonal(0.01)
+                          )(outs)
+        v = nn.Dense(1, name="vf_out",
+                     kernel_init=nn.initializers.orthogonal(1.0))(outs)
+        return logits, jnp.squeeze(v, axis=-1), carry
+
+    def initial_carry(self, batch: int):
+        zeros = jnp.zeros((batch, self.cell_size), jnp.float32)
+        return (zeros, zeros)

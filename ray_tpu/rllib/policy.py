@@ -62,12 +62,25 @@ class JaxPolicy:
         else:
             raise ValueError(f"unsupported action space {action_space!r}")
         model_cfg = config.get("model", {})
-        self.model = FCNet(
-            num_outputs=num_outputs,
-            hiddens=tuple(model_cfg.get("fcnet_hiddens", (64, 64))),
-            activation=model_cfg.get("fcnet_activation", "tanh"),
-            vf_share_layers=bool(model_cfg.get("vf_share_layers", False)),
-        )
+        self.recurrent = bool(model_cfg.get("use_lstm", False))
+        if self.recurrent:
+            from ray_tpu.rllib.models import LSTMNet
+
+            self.model = LSTMNet(
+                num_outputs=num_outputs,
+                cell_size=int(model_cfg.get("lstm_cell_size", 64)),
+                embed_size=int(model_cfg.get("fcnet_hiddens",
+                                             (64,))[-1]),
+                activation=model_cfg.get("fcnet_activation", "tanh"),
+            )
+        else:
+            self.model = FCNet(
+                num_outputs=num_outputs,
+                hiddens=tuple(model_cfg.get("fcnet_hiddens", (64, 64))),
+                activation=model_cfg.get("fcnet_activation", "tanh"),
+                vf_share_layers=bool(model_cfg.get("vf_share_layers",
+                                                   False)),
+            )
         # samplers pin to host CPU (config "_device": "cpu") so rollout
         # actor fleets never contend for — or tunnel to — the TPU; the
         # learner keeps the default (accelerator) backend
@@ -79,8 +92,13 @@ class JaxPolicy:
             self._rng = jax.random.PRNGKey(int(config.get("seed", 0) or 0))
             self._rng, init_rng = jax.random.split(self._rng)
             obs_dim = int(np.prod(observation_space.shape))
-            dummy = jnp.zeros((1, obs_dim), jnp.float32)
-            self.params = self.model.init(init_rng, dummy)
+            if self.recurrent:
+                dummy = jnp.zeros((1, 1, obs_dim), jnp.float32)
+                self.params = self.model.init(
+                    init_rng, dummy, self.model.initial_carry(1))
+            else:
+                dummy = jnp.zeros((1, obs_dim), jnp.float32)
+                self.params = self.model.init(init_rng, dummy)
             self.opt = self._make_optimizer()
             self.opt_state = self.opt.init(self.params)
         self._np_rng = np.random.default_rng(int(config.get("seed", 0) or 0))
@@ -88,30 +106,63 @@ class JaxPolicy:
         model = self.model
         dist = self.dist
 
-        @jax.jit
-        def _act(params, obs, rng):
-            dist_inputs, vf = model.apply(params, obs)
-            actions = dist.sample(dist_inputs, rng)
-            logp = dist.logp(dist_inputs, actions)
-            return actions, logp, vf, dist_inputs
+        if self.recurrent:
+            @jax.jit
+            def _act_rnn(params, obs, c, h, rng):
+                logits, vf, (c2, h2) = model.apply(params, obs[:, None],
+                                                   (c, h))
+                dist_inputs = logits[:, 0]
+                actions = dist.sample(dist_inputs, rng)
+                logp = dist.logp(dist_inputs, actions)
+                return actions, logp, vf[:, 0], dist_inputs, c2, h2
 
-        @jax.jit
-        def _act_greedy(params, obs):
-            dist_inputs, vf = model.apply(params, obs)
-            if dist is Categorical:
-                actions = jnp.argmax(dist_inputs, axis=-1)
-            else:
-                actions, _ = jnp.split(dist_inputs, 2, axis=-1)
-            return actions, vf
+            @jax.jit
+            def _act_rnn_greedy(params, obs, c, h):
+                logits, vf, (c2, h2) = model.apply(params, obs[:, None],
+                                                   (c, h))
+                dist_inputs = logits[:, 0]
+                if dist is Categorical:
+                    actions = jnp.argmax(dist_inputs, axis=-1)
+                else:
+                    actions, _ = jnp.split(dist_inputs, 2, axis=-1)
+                return actions, vf[:, 0], c2, h2
 
-        @jax.jit
-        def _values(params, obs):
-            _, vf = model.apply(params, obs)
-            return vf
+            @jax.jit
+            def _values_rnn(params, obs, c, h):
+                _, vf, _ = model.apply(params, obs[:, None], (c, h))
+                return vf[:, 0]
 
-        self._act = _act
-        self._act_greedy = _act_greedy
-        self._values = _values
+            self._act_rnn = _act_rnn
+            self._act_rnn_greedy = _act_rnn_greedy
+            self._values_rnn = _values_rnn
+            #: set by the sampler before postprocess_trajectory so the
+            #: truncation bootstrap evaluates V(s_last | carry)
+            self._bootstrap_state: Optional[Tuple] = None
+        else:
+            @jax.jit
+            def _act(params, obs, rng):
+                dist_inputs, vf = model.apply(params, obs)
+                actions = dist.sample(dist_inputs, rng)
+                logp = dist.logp(dist_inputs, actions)
+                return actions, logp, vf, dist_inputs
+
+            @jax.jit
+            def _act_greedy(params, obs):
+                dist_inputs, vf = model.apply(params, obs)
+                if dist is Categorical:
+                    actions = jnp.argmax(dist_inputs, axis=-1)
+                else:
+                    actions, _ = jnp.split(dist_inputs, 2, axis=-1)
+                return actions, vf
+
+            @jax.jit
+            def _values(params, obs):
+                _, vf = model.apply(params, obs)
+                return vf
+
+            self._act = _act
+            self._act_greedy = _act_greedy
+            self._values = _values
         self._update = jax.jit(self._update_impl)
         self._grads = jax.jit(self._grads_impl)
         self._apply = jax.jit(self._apply_impl)
@@ -133,6 +184,39 @@ class JaxPolicy:
     def loss(self, params, batch: Dict[str, jnp.ndarray]
              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         raise NotImplementedError
+
+    # -- recurrent surface ----------------------------------------------
+    def get_initial_state(self, batch: int) -> Tuple[np.ndarray, ...]:
+        """Zero LSTM carry for ``batch`` parallel envs (reference
+        ``Policy.get_initial_state``)."""
+        cell = self.model.cell_size
+        return (np.zeros((batch, cell), np.float32),
+                np.zeros((batch, cell), np.float32))
+
+    def compute_actions_rnn(self, obs: np.ndarray, state: Tuple,
+                            explore: bool = True):
+        """One env tick with carry: returns (actions, state_out, extras);
+        extras carry the *input* state columns for sequence training."""
+        with self._on_device():
+            obs_j = jnp.asarray(obs, jnp.float32)
+            c, h = (jnp.asarray(state[0]), jnp.asarray(state[1]))
+            if explore:
+                self._rng, rng = jax.random.split(self._rng)
+                actions, logp, vf, _, c2, h2 = self._act_rnn(
+                    self.params, obs_j, c, h, rng)
+                extras = {SampleBatch.ACTION_LOGP: np.asarray(logp),
+                          SampleBatch.VF_PREDS: np.asarray(vf),
+                          "state_in_c": np.asarray(state[0]),
+                          "state_in_h": np.asarray(state[1])}
+            else:
+                actions, vf, c2, h2 = self._act_rnn_greedy(
+                    self.params, obs_j, c, h)
+                extras = {SampleBatch.VF_PREDS: np.asarray(vf),
+                          "state_in_c": np.asarray(state[0]),
+                          "state_in_h": np.asarray(state[1])}
+            # writable copies: the sampler zeroes per-env rows on resets
+            return (np.asarray(actions), (np.array(c2), np.array(h2)),
+                    extras)
 
     # -- acting ---------------------------------------------------------
     def compute_actions(self, obs: np.ndarray, explore: bool = True
@@ -211,7 +295,15 @@ class JaxPolicy:
                                truncated: bool = False) -> SampleBatch:
         """Default: GAE advantages (reference ``postprocessing.py``)."""
         if truncated and last_obs is not None:
-            last_value = float(self.compute_values(last_obs[None])[0])
+            if self.recurrent:
+                state = self._bootstrap_state or self.get_initial_state(1)
+                with self._on_device():
+                    last_value = float(self._values_rnn(
+                        self.params, jnp.asarray(last_obs[None],
+                                                 jnp.float32),
+                        jnp.asarray(state[0]), jnp.asarray(state[1]))[0])
+            else:
+                last_value = float(self.compute_values(last_obs[None])[0])
         else:
             last_value = 0.0
         return compute_gae(

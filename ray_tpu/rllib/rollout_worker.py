@@ -94,7 +94,25 @@ class RolloutWorker:
 
         self.policy = policy_cls(env.observation_space, env.action_space,
                                  self.config)
-        self._obs = np.stack([e.reset()[0] for e in self.envs])
+        # connector pipelines transform at the env boundary so OBS,
+        # NEXT_OBS, and bootstrap values all see the same space
+        # (reference rllib/connectors agent/action connectors)
+        from ray_tpu.rllib.connectors import ConnectorPipeline
+
+        self.obs_connectors = ConnectorPipeline(
+            list(config.get("obs_connectors") or []))
+        self.action_connectors = ConnectorPipeline(
+            list(config.get("action_connectors") or []))
+        self._obs = np.stack([self._connect_obs(e.reset()[0])
+                              for e in self.envs])
+        # external sampling input (reference input_ / InputReader
+        # contract: a callable(ioctx) -> reader with .next()); e.g.
+        # PolicyServerInput for client-server RL
+        input_fn = config.get("input_")
+        self._input_reader = input_fn(self) if callable(input_fn) else None
+        self._recurrent = bool(getattr(self.policy, "recurrent", False))
+        if self._recurrent:
+            self._rnn_state = self.policy.get_initial_state(n)
         self._episode_buffers: List[List[Dict[str, Any]]] = \
             [[] for _ in range(n)]
         self._episode_rewards = np.zeros(n)
@@ -116,6 +134,8 @@ class RolloutWorker:
         """
         if self._ma:
             return self._sample_multi_agent()
+        if self._input_reader is not None:
+            return self._input_reader.next()
         fragment = int(self.config.get("rollout_fragment_length", 200))
         raw = bool(self.config.get("_raw_fragments", False))
         n = len(self.envs)
@@ -123,11 +143,20 @@ class RolloutWorker:
         rows: List[List[Dict[str, Any]]] = self._episode_buffers
 
         for _ in range(fragment):
-            actions, extras = self.policy.compute_actions(self._obs)
+            if self._recurrent:
+                actions, self._rnn_state, extras = \
+                    self.policy.compute_actions_rnn(self._obs,
+                                                    self._rnn_state)
+            else:
+                actions, extras = self.policy.compute_actions(self._obs)
+            env_actions = actions
+            if self.action_connectors.connectors:
+                env_actions = self.action_connectors(np.asarray(actions))
             next_obs = np.empty_like(self._obs)
             for i, env in enumerate(self.envs):
                 obs2, rew, term, trunc, _ = env.step(
-                    actions[i] if actions.ndim else actions)
+                    env_actions[i] if np.ndim(env_actions) else env_actions)
+                obs2 = self._connect_obs(obs2)
                 row = {
                     SampleBatch.OBS: self._obs[i],
                     SampleBatch.NEXT_OBS: obs2,
@@ -147,7 +176,11 @@ class RolloutWorker:
                         self._note_episode_end(i)
                     else:
                         chunks.append(self._flush_episode(i, obs2, term))
-                    obs2, _ = env.reset()
+                    obs2 = self._connect_obs(env.reset()[0])
+                    if self._recurrent:
+                        # fresh episode -> zero carry for this env
+                        for arr in self._rnn_state:
+                            arr[i] = 0.0
                 next_obs[i] = obs2
             self._obs = next_obs
 
@@ -164,10 +197,22 @@ class RolloutWorker:
             # accumulating
             for i in range(n):
                 if rows[i]:
+                    if self._recurrent:
+                        # the carry that would process s_last, for the
+                        # truncation bootstrap V(s_last | carry)
+                        self.policy._bootstrap_state = tuple(
+                            arr[i:i + 1] for arr in self._rnn_state)
                     chunks.append(self._postprocess(rows[i], self._obs[i],
                                                     truncated=True))
                     rows[i] = []
+            if self._recurrent:
+                self.policy._bootstrap_state = None
         return concat_samples(chunks)
+
+    def _connect_obs(self, obs: np.ndarray) -> np.ndarray:
+        if not self.obs_connectors.connectors:
+            return obs
+        return self.obs_connectors(np.asarray(obs)[None])[0]
 
     # -- multi-agent sampling -------------------------------------------
     def _sample_multi_agent(self) -> MultiAgentBatch:

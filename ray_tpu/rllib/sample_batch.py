@@ -103,3 +103,37 @@ class MultiAgentBatch(dict):
 
     def agent_steps(self) -> int:
         return self.count
+
+
+def build_sequences(batch: SampleBatch, max_seq_len: int,
+                    state_keys: Sequence[str] = ("state_in_c",
+                                                 "state_in_h"),
+                    ) -> Dict[str, np.ndarray]:
+    """Chunk an episode-ordered batch into padded fixed-length sequences
+    for recurrent training (reference ``policy/rnn_sequencing.py``).
+
+    Returns a dict of [S, L, ...] arrays plus ``seq_mask`` [S, L]
+    (1.0 on real steps) and the per-sequence initial state columns
+    ([S, cell], taken from the first row of each chunk).
+    """
+    chunks: List[SampleBatch] = []
+    for ep in batch.split_by_episode():
+        for start in range(0, len(ep), max_seq_len):
+            chunks.append(ep.slice(start, min(start + max_seq_len,
+                                              len(ep))))
+    out: Dict[str, np.ndarray] = {}
+    S, L = len(chunks), max_seq_len
+    for key in batch.keys():
+        first = np.asarray(chunks[0][key])
+        if key in state_keys:
+            out[key] = np.stack([np.asarray(c[key])[0] for c in chunks])
+            continue
+        arr = np.zeros((S, L) + first.shape[1:], first.dtype)
+        for i, c in enumerate(chunks):
+            arr[i, :len(c)] = c[key]
+        out[key] = arr
+    mask = np.zeros((S, L), np.float32)
+    for i, c in enumerate(chunks):
+        mask[i, :len(c)] = 1.0
+    out["seq_mask"] = mask
+    return out
