@@ -13,4 +13,6 @@ from ray_tpu.workflow.workflow import (  # noqa: F401
     list_all,
     resume,
     run,
+    send_event,
+    wait_for_event,
 )

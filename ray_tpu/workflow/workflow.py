@@ -162,6 +162,10 @@ class _DurableContext:
         key = id(node)
         if key in self._results:
             return self._results[key]
+        if isinstance(node, EventNode):
+            value = _wait_event(self.storage, node)
+            self._results[key] = value
+            return value
         step_id = self.step_ids.get(key)
         durable = isinstance(node, (FunctionNode, ClassMethodNode)) \
             and step_id is not None
@@ -241,3 +245,51 @@ def list_all() -> List[Dict[str, Any]]:
 
 def delete(workflow_id: str) -> None:
     WorkflowStorage(workflow_id).delete()
+
+
+# ---------------------------------------------------------------------------
+# events (reference ``workflow.wait_for_event`` + http_event_provider)
+# ---------------------------------------------------------------------------
+
+class EventNode(DAGNode):
+    """A DAG node that resolves when an external event is delivered.
+
+    Parity: reference ``workflow/api.py`` ``wait_for_event`` — the
+    workflow pauses at this step until :func:`send_event` persists the
+    payload; the payload is durable, so a resumed workflow sees the
+    event exactly once, without re-waiting.
+    """
+
+    def __init__(self, key: str, *, timeout: Optional[float] = None,
+                 poll_interval: float = 0.2):
+        super().__init__((), {})
+        self.key = key
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+
+    def _execute_impl(self, ctx):  # non-durable contexts just wait too
+        raise RuntimeError("EventNode only executes inside workflow.run")
+
+
+def wait_for_event(key: str, *, timeout: Optional[float] = None
+                   ) -> EventNode:
+    return EventNode(key, timeout=timeout)
+
+
+def send_event(workflow_id: str, key: str, payload: Any = None) -> None:
+    """Deliver an event durably (the storage IS the event channel, so
+    delivery survives crashes on either side)."""
+    WorkflowStorage(workflow_id).save_step(f"__event__{key}", payload)
+
+
+def _wait_event(storage: WorkflowStorage, node: EventNode) -> Any:
+    step = f"__event__{node.key}"
+    deadline = None if node.timeout is None \
+        else time.time() + node.timeout
+    while not storage.has_step(step):
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f"event {node.key!r} not delivered within "
+                f"{node.timeout}s")
+        time.sleep(node.poll_interval)
+    return storage.load_step(step)

@@ -59,3 +59,70 @@ def test_parallel_iterator_batch():
     batches = list(it.gather_sync())
     assert all(isinstance(b, list) for b in batches)
     assert sorted(x for b in batches for x in b) == list(range(10))
+
+
+# ---------------------------------------------------------------------------
+# dask scheduler shim
+# ---------------------------------------------------------------------------
+
+def test_dask_scheduler_on_raw_graph(ray_start_regular):
+    """The scheduler implements the dask graph protocol directly, so it
+    is testable without the dask package (parity model: reference
+    python/ray/util/dask tests)."""
+    from operator import add, mul
+
+    from ray_tpu.util.dask import ray_tpu_dask_get
+
+    dsk = {
+        "a": 1,
+        "b": (add, "a", 2),          # 3
+        "c": (mul, "b", "b"),        # 9
+        "d": (sum, ["a", "b", "c"]),  # 13
+        "alias": "c",
+    }
+    assert ray_tpu_dask_get(dsk, "d") == 13
+    assert ray_tpu_dask_get(dsk, ["c", "alias", ["a", "b"]]) \
+        == [9, 9, [1, 3]]
+
+
+def test_dask_scheduler_detects_cycles(ray_start_regular):
+    from operator import add
+
+    from ray_tpu.util.dask import ray_tpu_dask_get
+
+    with pytest.raises(ValueError, match="cycle"):
+        ray_tpu_dask_get({"a": (add, "b", 1), "b": (add, "a", 1)}, "a")
+
+
+def test_enable_dask_gate():
+    from ray_tpu.util.dask import enable_dask_on_ray_tpu
+
+    try:
+        import dask  # noqa: F401
+        enable_dask_on_ray_tpu()  # no error when present
+    except ImportError:
+        with pytest.raises(ImportError, match="dask"):
+            enable_dask_on_ray_tpu()
+
+
+# ---------------------------------------------------------------------------
+# usage telemetry
+# ---------------------------------------------------------------------------
+
+def test_usage_telemetry_local_only(tmp_path, monkeypatch):
+    from ray_tpu import usage
+
+    usage._RECORDS.clear()
+    usage.record_library_usage("train")
+    usage.record_library_usage("tune")
+    usage.record_extra_usage_tag("mesh", "dp2xtp4")
+    report = usage.usage_report()
+    assert report["libraries"] == ["train", "tune"]
+    assert report["tags"]["mesh"] == "dp2xtp4"
+    path = usage.flush_to_session_dir(str(tmp_path))
+    import json
+    assert json.load(open(path))["libraries"] == ["train", "tune"]
+    # opt-out drops collection
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    usage.record_library_usage("serve")
+    assert "serve" not in usage.usage_report()["libraries"]

@@ -92,3 +92,47 @@ def test_delete():
     workflow.run(dag, workflow_id="w4")
     workflow.delete("w4")
     assert workflow.get_status("w4") is None
+
+
+def test_workflow_event_step(ray_start_regular, tmp_path):
+    """A workflow pauses at wait_for_event until send_event delivers,
+    and a resumed run sees the SAME payload (exactly-once)."""
+    import threading
+
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf_events"))
+
+    @ray_tpu.remote
+    def process(evt, base):
+        return f"{base}:{evt['user']}"
+
+    dag = process.bind(workflow.wait_for_event("approval"), "order7")
+    wid = "evt_flow"
+
+    def deliver():
+        import time as _t
+        _t.sleep(0.8)
+        workflow.send_event(wid, "approval", {"user": "alice"})
+
+    t = threading.Thread(target=deliver)
+    t.start()
+    out = workflow.run(dag, workflow_id=wid)
+    t.join()
+    assert out == "order7:alice"
+    # the event payload is durable: resume() reuses it without waiting
+    assert workflow.resume(wid) == "order7:alice"
+
+
+def test_workflow_event_timeout(ray_start_regular, tmp_path):
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf_events_to"))
+
+    @ray_tpu.remote
+    def consume(evt):
+        return evt
+
+    dag = consume.bind(workflow.wait_for_event("never", timeout=0.5))
+    with pytest.raises(TimeoutError, match="never"):
+        workflow.run(dag, workflow_id="evt_timeout")
