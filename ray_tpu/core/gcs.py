@@ -804,6 +804,20 @@ class GcsServer:
             return None
         alive.sort(key=lambda n: (n.topology.get("slice", ""),
                                   n.topology.get("worker_index", 0)))
+        try:
+            # native bundle placement (src/sched_core.cc — parity with
+            # the reference's C++ bundle_scheduling_policy.cc); node
+            # order above keeps same-slice nodes adjacent for PACK
+            from ray_tpu.core import native
+
+            assignment = native.sched_place_bundles(
+                [n.resources_available for n in alive], pg.bundles,
+                pg.strategy)
+            if assignment is None:
+                return None
+            return {i: alive[idx] for i, idx in enumerate(assignment)}
+        except OSError:  # toolchain unavailable: python fallback
+            pass
         avail = {n.node_id: dict(n.resources_available) for n in alive}
 
         def fits(node: NodeInfo, bundle: Dict[str, float]) -> bool:

@@ -18,7 +18,7 @@ _REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")
 _SRC_DIR = os.path.join(_REPO_ROOT, "src")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "librtpu.so")
-_SOURCES = ["object_store.cc"]
+_SOURCES = ["object_store.cc", "sched_core.cc"]
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -84,8 +84,75 @@ def load() -> ctypes.CDLL:
                                                   ctypes.c_char_p, u64]
         lib.rtpu_store_stats.restype = None
         lib.rtpu_store_stats.argtypes = [ctypes.c_void_p, p_u64, p_u64, p_u64]
+
+        f64p = ctypes.POINTER(ctypes.c_double)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.rtpu_sched_pick_node.restype = ctypes.c_int
+        lib.rtpu_sched_pick_node.argtypes = [
+            f64p, i64p, ctypes.c_int, ctypes.c_int, f64p, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int]
+        lib.rtpu_sched_place_bundles.restype = ctypes.c_int
+        lib.rtpu_sched_place_bundles.argtypes = [
+            f64p, ctypes.c_int, ctypes.c_int, f64p, ctypes.c_int,
+            ctypes.c_int, i32p]
         _lib = lib
         return _lib
+
+
+# ---------------------------------------------------------------------------
+# scheduling-core wrappers (dict-of-resources <-> flat matrices)
+# ---------------------------------------------------------------------------
+
+def sched_pick_node(candidates, demand: dict, *, strategy: str,
+                    local_utilization: float, spread_threshold: float,
+                    local_feasible: bool):
+    """C++ hybrid/spread spillback choice.  ``candidates`` is a list of
+    (available_resources_dict, load_int); returns the chosen candidate
+    index or None (stay local)."""
+    lib = load()
+    keys = sorted({k for a, _ in candidates for k in a} | set(demand))
+    n_nodes, n_res = len(candidates), max(len(keys), 1)
+    avail = (ctypes.c_double * (n_nodes * n_res))()
+    load_arr = (ctypes.c_int64 * max(n_nodes, 1))()
+    for i, (a, load_val) in enumerate(candidates):
+        for r, k in enumerate(keys):
+            avail[i * n_res + r] = float(a.get(k, 0.0))
+        load_arr[i] = int(load_val)
+    dem = (ctypes.c_double * n_res)()
+    for r, k in enumerate(keys):
+        dem[r] = float(demand.get(k, 0.0))
+    out = lib.rtpu_sched_pick_node(
+        avail, load_arr, n_nodes, n_res, dem,
+        1 if strategy == "SPREAD" else 0,
+        float(local_utilization), float(spread_threshold),
+        1 if local_feasible else 0)
+    return None if out < 0 else int(out)
+
+
+def sched_place_bundles(node_avail, bundles, strategy: str):
+    """C++ bundle placement.  ``node_avail``: list of resource dicts in
+    the caller's (topology-sorted) node order; ``bundles``: list of
+    resource dicts.  Returns a list of node indices or None."""
+    lib = load()
+    keys = sorted({k for a in node_avail for k in a}
+                  | {k for b in bundles for k in b})
+    n_nodes, n_res = len(node_avail), max(len(keys), 1)
+    n_bundles = len(bundles)
+    avail = (ctypes.c_double * (n_nodes * n_res))()
+    for i, a in enumerate(node_avail):
+        for r, k in enumerate(keys):
+            avail[i * n_res + r] = float(a.get(k, 0.0))
+    bnd = (ctypes.c_double * max(n_bundles * n_res, 1))()
+    for b, bd in enumerate(bundles):
+        for r, k in enumerate(keys):
+            bnd[b * n_res + r] = float(bd.get(k, 0.0))
+    out = (ctypes.c_int32 * max(n_bundles, 1))()
+    strategies = {"PACK": 0, "SPREAD": 1, "STRICT_PACK": 2,
+                  "STRICT_SPREAD": 3}
+    ok = lib.rtpu_sched_place_bundles(
+        avail, n_nodes, n_res, bnd, n_bundles, strategies[strategy], out)
+    return list(out[:n_bundles]) if ok else None
 
 
 if __name__ == "__main__":

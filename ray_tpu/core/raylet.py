@@ -583,13 +583,31 @@ class Raylet:
         strategy = data.get("strategy", "DEFAULT")
         if strategy == "NODE_AFFINITY" or data.get("placement_group_id"):
             return None  # pinned to this node
+        remotes = [n for n in self._cluster_view
+                   if n.get("alive")
+                   and bytes(n["node_id"]) != self.node_id.binary()]
+        if not remotes:
+            return None
+        try:
+            # the hybrid/spread decision runs in the native scheduling
+            # core (src/sched_core.cc — the reference's
+            # ClusterResourceScheduler/hybrid policy is C++ too)
+            from ray_tpu.core import native
+
+            idx = native.sched_pick_node(
+                [(n.get("resources_available", {}), n.get("load", 0))
+                 for n in remotes],
+                resources,
+                strategy=strategy,
+                local_utilization=self._utilization(),
+                spread_threshold=self.config.scheduler_spread_threshold,
+                local_feasible=self._feasible_ever(resources, None))
+            return None if idx is None else tuple(remotes[idx]["address"])
+        except OSError:  # toolchain unavailable: python fallback
+            pass
         best = None
         best_load = None
-        for node in self._cluster_view:
-            if not node.get("alive"):
-                continue
-            if bytes(node["node_id"]) == self.node_id.binary():
-                continue
+        for node in remotes:
             avail = node.get("resources_available", {})
             if all(avail.get(k, 0.0) >= v for k, v in resources.items()):
                 load = node.get("load", 0)

@@ -1,0 +1,130 @@
+"""Native scheduling core vs python-model cross-check (parity model:
+reference cluster_task_manager_test.cc / bundle scheduling policy
+tests — randomized agreement + strategy semantics)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import native
+
+
+def _py_pick(cands, demand, strategy, local_util, threshold, feasible):
+    best, best_load = None, None
+    for i, (avail, load) in enumerate(cands):
+        if all(avail.get(k, 0.0) >= v for k, v in demand.items()):
+            if best is None or load < best_load:
+                best, best_load = i, load
+    if best is None:
+        return None
+    if strategy == "SPREAD":
+        return best
+    if local_util < threshold and feasible:
+        return None
+    return best
+
+
+def _py_place(node_avail, bundles, strategy):
+    avail = [dict(a) for a in node_avail]
+
+    def fits(i, b):
+        return all(avail[i].get(k, 0.0) >= v for k, v in b.items())
+
+    def take(i, b):
+        for k, v in b.items():
+            avail[i][k] = avail[i].get(k, 0.0) - v
+
+    out = []
+    if strategy in ("PACK", "STRICT_PACK"):
+        for i in range(len(avail)):
+            trial = dict(avail[i])
+            ok = True
+            for b in bundles:
+                if all(trial.get(k, 0.0) >= v for k, v in b.items()):
+                    for k, v in b.items():
+                        trial[k] = trial.get(k, 0.0) - v
+                else:
+                    ok = False
+                    break
+            if ok:
+                for b in bundles:
+                    take(i, b)
+                return [i] * len(bundles)
+        if strategy == "STRICT_PACK":
+            return None
+        for b in bundles:
+            i = next((j for j in range(len(avail)) if fits(j, b)), None)
+            if i is None:
+                return None
+            out.append(i)
+            take(i, b)
+        return out
+    used = set()
+    for b in bundles:
+        i = next((j for j in range(len(avail))
+                  if j not in used and fits(j, b)), None)
+        if i is None:
+            if strategy == "STRICT_SPREAD":
+                return None
+            i = next((j for j in range(len(avail)) if fits(j, b)), None)
+            if i is None:
+                return None
+        out.append(i)
+        used.add(i)
+        take(i, b)
+    return out
+
+
+def test_pick_node_agrees_with_python_model():
+    rng = np.random.default_rng(0)
+    for trial in range(200):
+        n = int(rng.integers(0, 6))
+        cands = [({"CPU": float(rng.integers(0, 8)),
+                   "TPU": float(rng.integers(0, 4))},
+                  int(rng.integers(0, 100))) for _ in range(n)]
+        demand = {"CPU": float(rng.integers(1, 6))}
+        if rng.random() < 0.5:
+            demand["TPU"] = float(rng.integers(1, 4))
+        strategy = "SPREAD" if rng.random() < 0.3 else "DEFAULT"
+        util = float(rng.random())
+        thr = 0.5
+        feasible = bool(rng.random() < 0.8)
+        got = native.sched_pick_node(
+            cands, demand, strategy=strategy, local_utilization=util,
+            spread_threshold=thr, local_feasible=feasible)
+        want = _py_pick(cands, demand, strategy, util, thr, feasible)
+        assert got == want, (trial, cands, demand, strategy, util,
+                             feasible, got, want)
+
+
+@pytest.mark.parametrize("strategy", ["PACK", "SPREAD", "STRICT_PACK",
+                                      "STRICT_SPREAD"])
+def test_place_bundles_agrees_with_python_model(strategy):
+    rng = np.random.default_rng(hash(strategy) % 2 ** 31)
+    for trial in range(150):
+        n_nodes = int(rng.integers(1, 5))
+        nodes = [{"CPU": float(rng.integers(0, 8)),
+                  "TPU": float(rng.integers(0, 4))}
+                 for _ in range(n_nodes)]
+        n_bundles = int(rng.integers(1, 5))
+        bundles = [{"CPU": float(rng.integers(1, 4))}
+                   for _ in range(n_bundles)]
+        got = native.sched_place_bundles(nodes, bundles, strategy)
+        want = _py_place(nodes, bundles, strategy)
+        assert got == want, (trial, nodes, bundles, strategy, got, want)
+
+
+def test_strategy_semantics():
+    nodes = [{"CPU": 4.0}, {"CPU": 4.0}, {"CPU": 4.0}]
+    bundles = [{"CPU": 2.0}, {"CPU": 2.0}, {"CPU": 2.0}]
+    # STRICT_PACK needs one node with room for all -> infeasible at 4
+    assert native.sched_place_bundles(nodes, bundles,
+                                      "STRICT_PACK") is None
+    # PACK soft-fills: first node takes 2, spillover to the second
+    assert native.sched_place_bundles(nodes, bundles, "PACK") == [0, 0, 1]
+    # STRICT_SPREAD: one bundle per distinct node
+    assert native.sched_place_bundles(nodes, bundles,
+                                      "STRICT_SPREAD") == [0, 1, 2]
+    # SPREAD reuses nodes once fresh ones run out
+    many = [{"CPU": 1.0}] * 4
+    assert native.sched_place_bundles([{"CPU": 4.0}, {"CPU": 1.0}],
+                                      many, "SPREAD") == [0, 1, 0, 0]
