@@ -100,6 +100,41 @@ def bench_gpt2() -> dict:
     }
 
 
+def bench_long_context() -> dict:
+    """Long-sequence attention (SURVEY: long-context is first-class):
+    pallas flash attention fwd+bwd at 32k tokens — the O(T)-memory path
+    where a materialized [T, T] f32 score matrix (4 GiB/head-batch)
+    would not fit."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    if jax.default_backend() not in ("tpu", "axon", "gpu"):
+        return {}
+    B, T, H, D = 1, 32768, 12, 64
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (B, T, H, D), jnp.bfloat16)
+
+    @jax.jit
+    def step(q):
+        grads = jax.grad(
+            lambda a: flash_attention(a, q, q, causal=True)
+            .astype(jnp.float32).sum())(q)
+        return grads.astype(jnp.float32).mean()
+
+    float(step(q))  # compile
+    n = 5
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = step(q)
+    float(out)
+    el = (time.perf_counter() - t0) / n
+    return {"long_context_seq": T,
+            "long_context_attn_fwd_bwd_ms": round(el * 1000, 2),
+            "long_context_tokens_per_sec": round(B * T / el, 1)}
+
+
 def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
     """Task-throughput microbenchmark (reference ``ray microbenchmark``,
     BASELINE.md single-client async tasks: 10,905/s)."""
@@ -157,6 +192,10 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
 def main() -> None:
     model_stats = bench_gpt2()
     details = dict(model_stats)
+    try:
+        details.update(bench_long_context())
+    except Exception as e:  # noqa: BLE001 — flagship line must print
+        details["long_context_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("RAY_TPU_BENCH_RUNTIME", "1") != "0":
         details.update(bench_runtime_tasks())
     result = {

@@ -601,6 +601,40 @@ class Dataset:
             else:
                 yield torch.as_tensor(np.ascontiguousarray(batch))
 
+    def iter_tf_batches(self, **kwargs) -> Iterator[Any]:
+        """Batches as tf tensors (reference ``iter_tf_batches``)."""
+        import tensorflow as tf
+
+        for batch in self.iter_batches(**{**kwargs,
+                                          "batch_format": "numpy"}):
+            if isinstance(batch, dict):
+                yield {k: tf.convert_to_tensor(v)
+                       for k, v in batch.items()}
+            else:
+                yield tf.convert_to_tensor(batch)
+
+    def to_tf(self, *, batch_size: int = 256):
+        """A ``tf.data.Dataset`` over this dataset's batches (reference
+        ``Dataset.to_tf``); built from a generator so blocks stream
+        without materializing the whole dataset."""
+        import tensorflow as tf
+
+        first = next(iter(self.iter_batches(batch_size=2,
+                                            batch_format="numpy")))
+        if isinstance(first, dict):
+            signature = {
+                k: tf.TensorSpec(shape=(None,) + v.shape[1:],
+                                 dtype=tf.as_dtype(v.dtype))
+                for k, v in first.items()}
+        else:
+            signature = tf.TensorSpec(
+                shape=(None,) + first.shape[1:],
+                dtype=tf.as_dtype(first.dtype))
+        return tf.data.Dataset.from_generator(
+            lambda: self.iter_batches(batch_size=batch_size,
+                                      batch_format="numpy"),
+            output_signature=signature)
+
     def to_jax(self, *, batch_size: Optional[int] = 256,
                drop_last: bool = True) -> Iterator[Any]:
         """Batches as jax arrays (device-put by the consumer's jit)."""

@@ -18,6 +18,7 @@ from ray_tpu.train.predictor import (  # noqa: F401
 )
 from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer  # noqa: F401
 from ray_tpu.train.huggingface import HuggingFaceTrainer  # noqa: F401
+from ray_tpu.train.rl import RLTrainer  # noqa: F401
 from ray_tpu.train.sklearn import SklearnTrainer  # noqa: F401
 from ray_tpu.train.trainer import (  # noqa: F401
     JaxTrainer,

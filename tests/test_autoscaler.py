@@ -166,3 +166,93 @@ def test_autoscaler_fake_multinode_end_to_end():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# GCP TPU-VM provider (fake gcloud runner — parity model: reference
+# autoscaler gcp tests with mocked API clients)
+# ---------------------------------------------------------------------------
+
+class _FakeGcloud:
+    """Records gcloud invocations; keeps a tiny TPU-VM fleet in memory."""
+
+    def __init__(self):
+        self.calls = []
+        self.nodes = {}
+
+    def __call__(self, args):
+        import json as _json
+        self.calls.append(args)
+        if "list" in args:
+            return _json.dumps(list(self.nodes.values()))
+        if "create" in args:
+            name = args[args.index("create") + 1]
+            labels = {}
+            if "--labels" in args:
+                for pair in args[args.index("--labels") + 1].split(","):
+                    k, v = pair.split("=")
+                    labels[k] = v
+            self.nodes[name] = {"name": f"projects/p/nodes/{name}",
+                                "state": "READY", "labels": labels}
+            return ""
+        if "delete" in args:
+            name = args[args.index("delete") + 1]
+            self.nodes[name]["state"] = "TERMINATED"
+            return ""
+        raise AssertionError(f"unexpected gcloud call: {args}")
+
+
+def test_gcp_tpu_provider_lifecycle():
+    from ray_tpu.autoscaler.gcp import GCPTPUNodeProvider
+    from ray_tpu.autoscaler.node_provider import (TAG_NODE_KIND,
+                                                  TAG_NODE_TYPE)
+
+    fake = _FakeGcloud()
+    provider = GCPTPUNodeProvider(
+        {"project_id": "p", "zone": "us-central2-b",
+         "accelerator_type": "v5litepod-8"},
+        cluster_name="c1", runner=fake)
+    assert provider.non_terminated_nodes({}) == []
+    provider.create_node({}, {TAG_NODE_KIND: "worker",
+                              TAG_NODE_TYPE: "tpu_v5e"}, count=2)
+    nodes = provider.non_terminated_nodes({})
+    assert len(nodes) == 2
+    assert all(n.startswith("ray-tpu-c1-") for n in nodes)
+    # tag filtering maps through TPU labels
+    assert provider.non_terminated_nodes(
+        {TAG_NODE_TYPE: "tpu_v5e"}) == nodes
+    assert provider.non_terminated_nodes(
+        {TAG_NODE_TYPE: "other"}) == []
+    assert provider.is_running(nodes[0])
+    assert provider.node_tags(nodes[0])[TAG_NODE_KIND] == "worker"
+    # create used the configured accelerator/version
+    create = next(c for c in fake.calls if "create" in c)
+    assert "v5litepod-8" in create
+    provider.terminate_node(nodes[0])
+    assert len(provider.non_terminated_nodes({})) == 1
+
+
+def test_gcp_tpu_provider_with_autoscaler():
+    """The demand-driven autoscaler drives the gcloud-backed provider
+    exactly like the mock one."""
+    from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+    from ray_tpu.autoscaler.gcp import GCPTPUNodeProvider
+    from ray_tpu.autoscaler.node_provider import TAG_NODE_TYPE
+    from ray_tpu.autoscaler.resource_demand_scheduler import \
+        NodeTypeConfig
+
+    fake = _FakeGcloud()
+    provider = GCPTPUNodeProvider(
+        {"project_id": "p", "zone": "z"}, cluster_name="c2", runner=fake)
+    autoscaler = StandardAutoscaler(
+        provider,
+        node_types={"tpu_host": NodeTypeConfig(
+            resources={"TPU": 4.0, "CPU": 8.0}, max_workers=4)},
+        idle_timeout_s=3600)
+    autoscaler.update_load_metrics(
+        {"nodes": [], "pending_demand": [{"TPU": 4.0}] * 3,
+         "pending_placement_groups": []})
+    autoscaler.update()
+    # 3 TPU-hosts' worth of demand -> 3 nodes
+    assert len(provider.non_terminated_nodes(
+        {TAG_NODE_TYPE: "tpu_host"})) == 3

@@ -235,3 +235,19 @@ def test_write_and_read_roundtrip(tmp_path):
     ds.write_json(str(tmp_path / "json"))
     back = ray_tpu.data.read_json(str(tmp_path / "json"))
     assert sorted(r["id"] for r in back.take_all()) == list(range(50))
+
+
+def test_iter_tf_batches_and_to_tf(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu.data import read_api
+
+    ds = read_api.range_tensor(64, shape=(4,), parallelism=4)
+    batches = list(ds.iter_tf_batches(batch_size=16))
+    assert len(batches) == 4
+    import tensorflow as tf
+
+    assert isinstance(batches[0]["id"], tf.Tensor)
+    tfds = ds.to_tf(batch_size=16)
+    total = sum(int(b["id"].shape[0]) for b in tfds)
+    assert total == 64

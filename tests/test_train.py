@@ -150,3 +150,30 @@ def test_torch_trainer_ddp_gloo():
     result = trainer.fit()
     expected = (0 + 1) / 2
     assert result.metrics["avg0"] == expected
+
+
+def test_rl_trainer_bridge():
+    """RLTrainer runs an RLlib algorithm under the Train fit contract
+    (parity model: reference train/rl tests)."""
+    from ray_tpu.rllib import CartPole
+    from ray_tpu.train import RLTrainer
+
+    trainer = RLTrainer(
+        algorithm="PG",
+        config={"env": CartPole,
+                "env_config": {"max_episode_steps": 50},
+                "train_batch_size": 200, "lr": 4e-3, "seed": 0},
+        stop={"training_iteration": 3})
+    result = trainer.fit()
+    assert result.metrics["training_iteration"] == 3
+    assert result.checkpoint is not None
+    # the checkpoint restores into a fresh algorithm
+    from ray_tpu.rllib.algorithms import PGConfig
+
+    algo = (PGConfig()
+            .environment(CartPole, env_config={"max_episode_steps": 50})
+            .debugging(seed=0)).build()
+    with result.checkpoint.as_directory() as d:
+        algo.restore(d)
+    assert algo.iteration == 3
+    algo.stop()
