@@ -177,3 +177,211 @@ class Chain(Preprocessor):
         for p in self.preprocessors:
             batch = p.transform_batch(batch)
         return batch
+
+
+class MaxAbsScaler(Preprocessor):
+    """x / max|x| per column (reference ``MaxAbsScaler``)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, float] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        for c in self.columns:
+            lo, hi = ds.min(c), ds.max(c)
+            self.stats_[c] = max(abs(lo), abs(hi)) or 1.0
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            batch[c] = batch[c] / self.stats_[c]
+        return batch
+
+
+class RobustScaler(Preprocessor):
+    """(x - median) / IQR per column (reference ``RobustScaler``);
+    quantiles computed from a materialized column pull."""
+
+    def __init__(self, columns: List[str], *,
+                 quantile_range: tuple = (0.25, 0.75)):
+        self.columns = columns
+        self.quantile_range = quantile_range
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        lo_q, hi_q = self.quantile_range
+        for c in self.columns:
+            values = np.concatenate(
+                [np.asarray(b[c]) for b in
+                 ds.iter_batches(batch_size=None, batch_format="numpy")])
+            lo, med, hi = np.quantile(values, [lo_q, 0.5, hi_q])
+            self.stats_[c] = (med, (hi - lo) or 1.0)
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            med, iqr = self.stats_[c]
+            batch[c] = (batch[c] - med) / iqr
+        return batch
+
+
+class Normalizer(Preprocessor):
+    """Row-wise Lp normalization across ``columns`` (reference
+    ``Normalizer``); stateless."""
+
+    def __init__(self, columns: List[str], norm: str = "l2"):
+        self.columns = columns
+        self.norm = norm
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_numpy(self, batch):
+        stack = np.column_stack([batch[c] for c in self.columns])
+        if self.norm == "l2":
+            denom = np.sqrt((stack ** 2).sum(axis=1))
+        elif self.norm == "l1":
+            denom = np.abs(stack).sum(axis=1)
+        else:  # max
+            denom = np.abs(stack).max(axis=1)
+        denom = np.where(denom == 0, 1.0, denom)
+        for i, c in enumerate(self.columns):
+            batch[c] = stack[:, i] / denom
+        return batch
+
+
+class SimpleImputer(Preprocessor):
+    """Fill NaNs with the column mean / a constant (reference
+    ``SimpleImputer``)."""
+
+    def __init__(self, columns: List[str], *, strategy: str = "mean",
+                 fill_value: Optional[float] = None):
+        self.columns = columns
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: Dict[str, float] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        for c in self.columns:
+            if self.strategy == "constant":
+                self.stats_[c] = float(self.fill_value or 0.0)
+            else:
+                values = np.concatenate(
+                    [np.asarray(b[c], np.float64) for b in
+                     ds.iter_batches(batch_size=None,
+                                     batch_format="numpy")])
+                if self.strategy == "median":
+                    self.stats_[c] = float(np.nanmedian(values))
+                else:
+                    self.stats_[c] = float(np.nanmean(values))
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            col = np.asarray(batch[c], np.float64)
+            batch[c] = np.where(np.isnan(col), self.stats_[c], col)
+        return batch
+
+
+class OrdinalEncoder(Preprocessor):
+    """Category -> integer rank (reference ``OrdinalEncoder``)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Dict[Any, int]] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        for c in self.columns:
+            values = sorted({v for b in
+                             ds.iter_batches(batch_size=None,
+                                             batch_format="numpy")
+                             for v in np.asarray(b[c]).tolist()})
+            self.stats_[c] = {v: i for i, v in enumerate(values)}
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            table = self.stats_[c]
+            batch[c] = np.asarray(
+                [table.get(v, -1) for v in np.asarray(batch[c]).tolist()],
+                np.int64)
+        return batch
+
+
+class Tokenizer(Preprocessor):
+    """Split text columns into token lists (reference ``Tokenizer``);
+    stateless."""
+
+    def __init__(self, columns: List[str],
+                 tokenization_fn: Optional[Callable[[str], List[str]]]
+                 = None):
+        self.columns = columns
+        self.fn = tokenization_fn or (lambda s: s.split())
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            batch[c] = np.asarray(
+                [self.fn(str(v)) for v in np.asarray(batch[c]).tolist()],
+                dtype=object)
+        return batch
+
+
+class CountVectorizer(Preprocessor):
+    """Token counts against a fitted vocabulary (reference
+    ``CountVectorizer``); emits one ``{col}_{token}`` column per
+    vocabulary entry."""
+
+    def __init__(self, columns: List[str], *, max_features: int = 100,
+                 tokenization_fn: Optional[Callable[[str], List[str]]]
+                 = None):
+        self.columns = columns
+        self.max_features = max_features
+        self.fn = tokenization_fn or (lambda s: s.split())
+        self.stats_: Dict[str, List[str]] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        from collections import Counter
+        for c in self.columns:
+            counts: Counter = Counter()
+            for b in ds.iter_batches(batch_size=None,
+                                     batch_format="numpy"):
+                for v in np.asarray(b[c]).tolist():
+                    counts.update(self.fn(str(v)))
+            self.stats_[c] = [t for t, _ in
+                              counts.most_common(self.max_features)]
+
+    def _transform_numpy(self, batch):
+        for c in self.columns:
+            vocab = self.stats_[c]
+            docs = [self.fn(str(v))
+                    for v in np.asarray(batch[c]).tolist()]
+            for token in vocab:
+                batch[f"{c}_{token}"] = np.asarray(
+                    [d.count(token) for d in docs], np.int64)
+            del batch[c]
+        return batch
+
+
+class FeatureHasher(Preprocessor):
+    """Hash token lists into a fixed-width count vector (reference
+    ``FeatureHasher``); stateless, vocabulary-free."""
+
+    def __init__(self, columns: List[str], num_features: int = 64):
+        self.columns = columns
+        self.num_features = num_features
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_numpy(self, batch):
+        import hashlib
+        for c in self.columns:
+            out = np.zeros((len(batch[c]), self.num_features), np.int64)
+            for i, v in enumerate(np.asarray(batch[c]).tolist()):
+                tokens = v if isinstance(v, (list, np.ndarray)) \
+                    else str(v).split()
+                for t in tokens:
+                    h = int(hashlib.md5(str(t).encode()).hexdigest(), 16)
+                    out[i, h % self.num_features] += 1
+            batch[f"{c}_hashed"] = out
+            del batch[c]
+        return batch

@@ -56,7 +56,8 @@ def _resolve_address(args) -> str:
 
 def _connect(args) -> None:
     import ray_tpu
-    ray_tpu.init(address=_resolve_address(args))
+    ray_tpu.init(address=_resolve_address(args),
+                 ignore_reinit_error=True)
 
 
 # ----------------------------------------------------------------------
@@ -184,6 +185,23 @@ def cmd_memory(args) -> None:
               f"node {o['node_id'][:8]}")
 
 
+def cmd_serve(args) -> None:
+    """Serve status/shutdown against a running cluster (reference
+    ``serve status`` / ``serve shutdown`` CLI)."""
+    _connect(args)
+    from ray_tpu import serve as serve_mod
+
+    if args.serve_cmd == "status":
+        try:
+            status = serve_mod.status()
+        except Exception as e:  # noqa: BLE001
+            sys.exit(f"serve is not running: {e}")
+        print(json.dumps(status, indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve_mod.shutdown()
+        print("serve shut down")
+
+
 def cmd_dashboard(args) -> None:
     _connect(args)
     from ray_tpu.dashboard import Dashboard
@@ -287,6 +305,13 @@ def build_parser() -> argparse.ArgumentParser:
                     default=os.environ.get("RAY_TPU_DASHBOARD",
                                            "http://127.0.0.1:8265"))
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("serve", help="serve application control")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    for name in ("status", "shutdown"):
+        child = ssub.add_parser(name)
+        child.add_argument("--address")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("microbenchmark", help="core perf suite")
     sp.set_defaults(fn=cmd_microbenchmark)

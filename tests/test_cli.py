@@ -61,3 +61,37 @@ def test_cli_memory_and_summary(cli_cluster):
     assert "bytes" in out.stdout
     out = _run("summary", "tasks", "--address", addr, env_extra=env)
     assert out.returncode == 0, out.stderr
+
+
+def test_cli_serve_status(cli_cluster):
+    """`ray-tpu serve status` against a cluster with a live deployment."""
+    import subprocess
+    import sys
+    import textwrap
+
+    addr, env = cli_cluster
+    script = textwrap.dedent(f"""
+        import ray_tpu
+        from ray_tpu import serve
+        ray_tpu.init(address="{addr}")
+
+        @serve.deployment(num_replicas=1)
+        def hello(req):
+            return "ok"
+
+        serve.run(hello.bind(), name="cli_app")
+        print("DEPLOYED", flush=True)
+        from ray_tpu.scripts.cli import main
+        main(["serve", "status", "--address", "{addr}"])
+        main(["serve", "shutdown", "--address", "{addr}"])
+    """)
+    import os as _os
+
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=180,
+                         env={**_os.environ, **env,
+                              "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "DEPLOYED" in out.stdout
+    assert "hello" in out.stdout  # deployment visible in status
+    assert "serve shut down" in out.stdout

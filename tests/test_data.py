@@ -251,3 +251,47 @@ def test_iter_tf_batches_and_to_tf(ray_start_regular):
     tfds = ds.to_tf(batch_size=16)
     total = sum(int(b["id"].shape[0]) for b in tfds)
     assert total == 64
+
+
+def test_extended_preprocessors(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu.data import read_api
+    from ray_tpu.data.preprocessors import (CountVectorizer, FeatureHasher,
+                                            MaxAbsScaler, Normalizer,
+                                            OrdinalEncoder, RobustScaler,
+                                            SimpleImputer, Tokenizer)
+
+    rows = [{"x": float(i - 4), "y": float(i) if i != 3 else np.nan,
+             "cat": ["a", "b", "c"][i % 3],
+             "text": ["red fish", "blue fish", "one fish two"][i % 3]}
+            for i in range(9)]
+    ds = read_api.from_items(rows)
+
+    out = MaxAbsScaler(["x"]).fit_transform(ds).to_pandas()
+    assert abs(out["x"]).max() <= 1.0
+
+    out = RobustScaler(["x"]).fit_transform(ds).to_pandas()
+    assert abs(out["x"].median()) < 1e-9
+
+    out = Normalizer(["x", "y"]).transform(ds).to_pandas()
+    norms = np.sqrt(out["x"] ** 2 + out["y"] ** 2).dropna()
+    assert np.allclose(norms[norms > 0], 1.0)
+
+    out = SimpleImputer(["y"], strategy="mean").fit_transform(ds) \
+        .to_pandas()
+    assert not out["y"].isna().any()
+
+    out = OrdinalEncoder(["cat"]).fit_transform(ds).to_pandas()
+    assert set(out["cat"]) == {0, 1, 2}
+
+    out = Tokenizer(["text"]).transform(ds).to_pandas()
+    assert list(out["text"][0]) == ["red", "fish"]
+
+    out = CountVectorizer(["text"], max_features=3) \
+        .fit_transform(ds).to_pandas()
+    assert "text_fish" in out.columns
+    assert out["text_fish"].sum() == 9  # one "fish" per row
+
+    out = FeatureHasher(["text"], num_features=8).transform(ds).to_pandas()
+    assert np.asarray(out["text_hashed"][0]).sum() == 2  # two tokens
