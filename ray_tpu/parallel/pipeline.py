@@ -95,3 +95,16 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         in_specs=(param_spec, P()), out_specs=P(),
         check_vma=False,
     )(stage_params, microbatches)
+
+
+def stack_block_params(per_block_params: list) -> Any:
+    """Stack N structurally-identical per-block param trees into one
+    tree with a leading ``[n_stages]`` dim — the layout
+    :func:`pipeline_apply` shards one-stage-per-device.  Use with a
+    transformer's layer params (``params["h0"], params["h1"], ...``) to
+    pipeline real models without restructuring them."""
+    import numpy as np
+
+    return jax.tree.map(lambda *leaves: jnp.stack(
+        [jnp.asarray(np.asarray(x)) for x in leaves]),
+        *per_block_params)

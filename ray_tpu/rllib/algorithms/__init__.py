@@ -55,4 +55,5 @@ from ray_tpu.rllib.algorithms.pg import (  # noqa: F401
     PGPolicy,
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, PPOPolicy  # noqa: F401
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config, R2D2Policy  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACPolicy  # noqa: F401

@@ -1,0 +1,242 @@
+"""R2D2 — Recurrent Replay Distributed DQN.
+
+Parity: reference ``rllib/algorithms/r2d2/`` — an LSTM Q-network
+trained on replayed SEQUENCES with stored recurrent states (the
+"stored state" strategy of the R2D2 paper; burn-in length 0), double-Q
+targets from a target network scanned over the same sequences, and
+epsilon-greedy acting with the carry threaded through the sampler.
+jax-native: the whole sequence update (two scans + TD + Adam) is one
+jitted program with static [S, L] shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.execution import synchronous_parallel_sample
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.sample_batch import (SampleBatch, build_sequences,
+                                        concat_samples)
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 32          # sequences per update
+        self.rollout_fragment_length = 40
+        self.replay_buffer_capacity = 2000  # sequences
+        self.num_steps_sampled_before_learning_starts = 200
+        self.target_network_update_freq = 800  # env steps
+        self.double_q = True
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 8000
+        self.training_intensity = 1.0
+        self.model = {"use_lstm": True, "lstm_cell_size": 64,
+                      "max_seq_len": 20, "fcnet_hiddens": (64,)}
+
+    @property
+    def algo_class(self):
+        return R2D2
+
+
+class _SequenceReplay:
+    """Uniform replay over fixed-length padded sequences."""
+
+    def __init__(self, capacity: int, seed: Optional[int] = None):
+        self.capacity = capacity
+        self._seqs: List[Dict[str, np.ndarray]] = []
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return len(self._seqs)
+
+    def add_batch(self, batch: SampleBatch, max_seq_len: int) -> None:
+        seq = build_sequences(batch, max_seq_len)
+        for i in range(seq["seq_mask"].shape[0]):
+            item = {k: v[i] for k, v in seq.items()}
+            if len(self._seqs) < self.capacity:
+                self._seqs.append(item)
+            else:
+                self._seqs[self._next] = item
+                self._next = (self._next + 1) % self.capacity
+
+    def sample(self, n: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, len(self._seqs), n)
+        return {k: np.stack([self._seqs[i][k] for i in idx])
+                for k in self._seqs[0]}
+
+
+class R2D2Policy(JaxPolicy):
+    """LSTM Q-network policy; the JaxPolicy recurrent surface provides
+    carry-threaded sampling, this class swaps acting to epsilon-greedy
+    over Q and the update to sequence double-Q TD."""
+
+    def __init__(self, observation_space, action_space, config):
+        config = dict(config)
+        model_cfg = dict(config.get("model") or {})
+        model_cfg["use_lstm"] = True
+        config["model"] = model_cfg
+        super().__init__(observation_space, action_space, config)
+        self.target_params = self.params
+        self._steps = 0
+        model = self.model
+        gamma = float(config.get("gamma", 0.99))
+        double_q = bool(config.get("double_q", True))
+
+        @jax.jit
+        def _q_step(params, obs, c, h):
+            q, _, (c2, h2) = model.apply(params, obs[:, None], (c, h))
+            return q[:, 0], c2, h2
+
+        @jax.jit
+        def _seq_update(params, target_params, opt_state, batch):
+            def loss_fn(p):
+                carry = (batch["state_in_c"], batch["state_in_h"])
+                q_online, _, _ = model.apply(p, batch[SampleBatch.OBS],
+                                             carry)
+                q_target, _, _ = model.apply(
+                    target_params, batch[SampleBatch.OBS], carry)
+                # shift within the sequence: step t bootstraps t+1
+                q_next_t = q_target[:, 1:]
+                if double_q:
+                    best = jnp.argmax(q_online[:, 1:], axis=-1)
+                    q_next = jnp.take_along_axis(
+                        q_next_t, best[..., None], axis=-1)[..., 0]
+                else:
+                    q_next = q_next_t.max(axis=-1)
+                acts = batch[SampleBatch.ACTIONS][:, :-1].astype(jnp.int32)
+                q_taken = jnp.take_along_axis(
+                    q_online[:, :-1], acts[..., None], axis=-1)[..., 0]
+                rew = batch[SampleBatch.REWARDS][:, :-1]
+                done = batch[SampleBatch.TERMINATEDS][:, :-1] \
+                    .astype(jnp.float32)
+                target = rew + gamma * (1.0 - done) * q_next
+                # the (t+1) step must be real for the bootstrap
+                mask = batch["seq_mask"][:, :-1] * batch["seq_mask"][:, 1:]
+                td = (q_taken - jax.lax.stop_gradient(target)) * mask
+                denom = jnp.maximum(mask.sum(), 1.0)
+                huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                                  jnp.abs(td) - 0.5)
+                loss = huber.sum() / denom
+                return loss, (jnp.sum(q_taken * mask) / denom,
+                              jnp.sum(jnp.abs(td)) / denom)
+
+            (loss, (mean_q, td_abs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p + u, params, updates)
+            return params, opt_state, {"loss": loss, "mean_q": mean_q,
+                                       "td_error_abs": td_abs}
+
+        self._q_step = _q_step
+        self._seq_update = _seq_update
+
+    # -- epsilon-greedy recurrent acting -------------------------------
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._steps
+                   / float(cfg.get("epsilon_timesteps", 8000)))
+        e0 = float(cfg.get("epsilon_initial", 1.0))
+        e1 = float(cfg.get("epsilon_final", 0.05))
+        return e0 + frac * (e1 - e0)
+
+    def compute_actions_rnn(self, obs, state, explore: bool = True):
+        with self._on_device():
+            q, c2, h2 = self._q_step(
+                self.params, jnp.asarray(obs, jnp.float32),
+                jnp.asarray(state[0]), jnp.asarray(state[1]))
+        q = np.asarray(q)
+        actions = q.argmax(axis=-1)
+        if explore:
+            eps = self._epsilon()
+            self._steps += len(actions)
+            mask = self._np_rng.random(len(actions)) < eps
+            random_actions = self._np_rng.integers(
+                0, self.action_space.n, size=len(actions))
+            actions = np.where(mask, random_actions, actions)
+        extras = {"state_in_c": np.asarray(state[0]),
+                  "state_in_h": np.asarray(state[1])}
+        return (actions.astype(np.int64), (np.array(c2), np.array(h2)),
+                extras)
+
+    def postprocess_trajectory(self, batch, last_obs=None,
+                               truncated=False):
+        return batch  # raw transitions; targets come from the replay
+
+    # -- learning -------------------------------------------------------
+    def learn_on_sequences(self, seq: Dict[str, np.ndarray]
+                           ) -> Dict[str, float]:
+        with self._on_device():
+            dev = {k: jnp.asarray(v) for k, v in seq.items()}
+            self.params, self.opt_state, stats = self._seq_update(
+                self.params, self.target_params, self.opt_state, dev)
+        return {k: float(v) for k, v in stats.items()}
+
+    def update_target(self) -> None:
+        self.target_params = self.params
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = jax.tree_util.tree_map(
+            np.asarray, self.target_params)
+        state["steps"] = self._steps
+        return state
+
+    def set_state(self, state):
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.asarray, state["target_params"])
+        self._steps = int(state.get("steps", 0))
+
+
+class R2D2(Algorithm):
+    policy_class = R2D2Policy
+
+    def setup(self) -> None:
+        super().setup()
+        cfg = self.config
+        self.replay = _SequenceReplay(
+            int(cfg.get("replay_buffer_capacity", 2000)),
+            seed=cfg.get("seed"))
+        self._since_target_update = 0
+        self._max_seq_len = int(
+            (cfg.get("model") or {}).get("max_seq_len", 20))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy: R2D2Policy = self.workers.local_worker.policy
+        fragment = int(cfg.get("rollout_fragment_length", 40)) \
+            * max(1, int(cfg.get("num_envs_per_worker", 1)))
+        batch = synchronous_parallel_sample(self.workers,
+                                            max_env_steps=fragment)
+        self.replay.add_batch(batch, self._max_seq_len)
+        self._timesteps_total += len(batch)
+        self._since_target_update += len(batch)
+        stats: Dict[str, Any] = {"replay_sequences": len(self.replay)}
+        warmup = int(cfg.get("num_steps_sampled_before_learning_starts",
+                             200))
+        n_seq = int(cfg.get("train_batch_size", 32))
+        if len(self.replay) * self._max_seq_len >= warmup \
+                and len(self.replay) >= n_seq:
+            updates = max(1, round(float(cfg.get("training_intensity",
+                                                 1.0))))
+            for _ in range(updates):
+                stats.update(policy.learn_on_sequences(
+                    self.replay.sample(n_seq)))
+            if self._since_target_update >= int(
+                    cfg.get("target_network_update_freq", 800)):
+                policy.update_target()
+                self._since_target_update = 0
+            self.workers.sync_weights()
+        return stats

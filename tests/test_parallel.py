@@ -157,3 +157,55 @@ def test_pipeline_under_jit():
 
     out = run(ws, xs)
     assert out.shape == xs.shape
+
+
+def test_pipeline_real_transformer_blocks():
+    """Model-level PP: GPT-2 blocks pipelined over pp=4 match the
+    sequential forward, and the pipelined step differentiates."""
+    import flax
+    import numpy as np
+
+    from ray_tpu.models.gpt2 import Block, GPT2Config
+    from ray_tpu.parallel.pipeline import (pipeline_apply,
+                                           stack_block_params)
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32, num_layers=4,
+                          attn_impl="reference")
+    rng = jax.random.PRNGKey(0)
+    D = cfg.embed_dim
+    x = jax.random.normal(rng, (8, 2, 16, D))  # [n_micro, mb, T, D]
+
+    block = Block(cfg)
+    per_layer = []
+    for i in range(cfg.num_layers):
+        p = block.init(jax.random.PRNGKey(i), x[0])["params"]
+        per_layer.append(flax.core.unfreeze(
+            jax.tree.map(lambda v: v.unbox() if hasattr(v, "unbox")
+                         else v, p,
+                         is_leaf=lambda v: hasattr(v, "unbox"))))
+    stacked = stack_block_params(per_layer)
+
+    def stage_fn(params, act):
+        return block.apply({"params": params}, act)
+
+    # sequential reference
+    want = x
+    out_parts = []
+    for m in range(x.shape[0]):
+        act = x[m]
+        for p in per_layer:
+            act = stage_fn(p, act)
+        out_parts.append(act)
+    want = jnp.stack(out_parts)
+
+    mesh = build_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+    got = jax.jit(lambda s, xs: pipeline_apply(
+        stage_fn, s, xs, mesh=mesh))(stacked, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    # gradients flow through the schedule
+    grads = jax.jit(jax.grad(lambda s: pipeline_apply(
+        stage_fn, s, x, mesh=mesh).mean()))(stacked)
+    for leaf in jax.tree.leaves(grads):
+        assert bool(jnp.all(jnp.isfinite(leaf)))

@@ -204,3 +204,33 @@ def test_fcnet_cannot_solve_memory_task():
             best = max(best, rm)
     assert best < 15.0, best
     algo.stop()
+
+
+def test_r2d2_solves_memory_task():
+    """Recurrent replay DQN on the memory env (parity model: reference
+    rllib/algorithms/r2d2 tests on stateless cartpole)."""
+    from ray_tpu.rllib.algorithms import R2D2Config
+
+    config = (R2D2Config()
+              .environment(RepeatPrevEnv, env_config={"episode_len": 20})
+              .rollouts(rollout_fragment_length=40,
+                        num_envs_per_worker=4)
+              .training(train_batch_size=32, lr=2e-3, gamma=0.4,
+                        training_intensity=4.0,
+                        num_steps_sampled_before_learning_starts=400,
+                        target_network_update_freq=600,
+                        epsilon_timesteps=5000, epsilon_final=0.05)
+              .debugging(seed=0))
+    config.model = {"use_lstm": True, "lstm_cell_size": 32,
+                    "max_seq_len": 20, "fcnet_hiddens": (32,)}
+    algo = config.build()
+    best = -np.inf
+    for _ in range(80):
+        r = algo.train()
+        rm = r.get("episode_reward_mean", np.nan)
+        if not np.isnan(rm):
+            best = max(best, rm)
+        if best >= 16.0:
+            break
+    assert best >= 16.0, best
+    algo.stop()
