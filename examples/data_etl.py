@@ -1,0 +1,30 @@
+"""Dataset ETL: read -> preprocess -> shuffle -> consume as jax batches.
+
+Usage: python examples/data_etl.py
+"""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import read_api
+from ray_tpu.data.preprocessors import Chain, SimpleImputer, StandardScaler
+
+
+def main():
+    ray_tpu.init(ignore_reinit_error=True)
+    rng = np.random.default_rng(0)
+    rows = [{"x": float(v) if i % 7 else float("nan"),
+             "y": float(v * 2 + 1)}
+            for i, v in enumerate(rng.normal(5, 2, 1000))]
+    ds = read_api.from_items(rows, parallelism=8)
+    prep = Chain(SimpleImputer(["x"]), StandardScaler(["x"]))
+    ds = prep.fit_transform(ds).random_shuffle(seed=0)
+    n, mean = 0, 0.0
+    for batch in ds.to_jax(batch_size=128):
+        n += batch["x"].shape[0]
+        mean += float(batch["x"].sum())
+    print(f"consumed {n} rows; post-scaling mean={mean / n:+.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Train PPO on CartPole with a fleet of rollout actors.
+
+Usage: python examples/rllib_ppo.py [--workers 2]
+"""
+
+import argparse
+
+import ray_tpu
+from ray_tpu.rllib import CartPole
+from ray_tpu.rllib.algorithms import PPOConfig
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--workers", type=int, default=0)
+    parser.add_argument("--target", type=float, default=150.0)
+    args = parser.parse_args()
+
+    ray_tpu.init(ignore_reinit_error=True)
+    config = (PPOConfig()
+              .environment(CartPole,
+                           env_config={"max_episode_steps": 200})
+              .rollouts(num_rollout_workers=args.workers,
+                        rollout_fragment_length=200,
+                        num_envs_per_worker=4)
+              .training(train_batch_size=1600, lr=3e-4, num_sgd_iter=6,
+                        sgd_minibatch_size=128)
+              .debugging(seed=0))
+    algo = config.build()
+    for i in range(60):
+        r = algo.train()
+        rew = r.get("episode_reward_mean", float("nan"))
+        if i % 5 == 0:
+            print(f"iter {i}: reward={rew:.1f}")
+        if rew >= args.target:
+            print(f"solved at iter {i}: {rew:.1f}")
+            break
+    algo.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Serve a jitted model behind HTTP with autoscaling replicas.
+
+Usage: python examples/serve_inference.py
+"""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=2, max_concurrent_queries=16)
+class Classifier:
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import ViT, ViTConfig
+
+        cfg = ViTConfig.tiny(dtype=jnp.float32, attn_impl="reference")
+        self.model = ViT(cfg)
+        self.params = self.model.init_params(jax.random.PRNGKey(0))
+        self._fwd = jax.jit(
+            lambda p, x: self.model.apply({"params": p}, x))
+
+    async def __call__(self, request):
+        x = np.asarray(request["image"], np.float32)[None]
+        logits = np.asarray(self._fwd(self.params, x))[0]
+        return {"class": int(logits.argmax()),
+                "logits": logits.tolist()}
+
+
+def main():
+    ray_tpu.init(ignore_reinit_error=True)
+    handle = serve.run(Classifier.bind(), name="classifier")
+    image = np.random.default_rng(0).random((32, 32, 3)).astype(float)
+    out = ray_tpu.get(handle.remote({"image": image.tolist()}))
+    print(f"predicted class: {out['class']}")
+    serve.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Train GPT-2 with JaxTrainer: gang actors + mesh data parallelism.
+
+Usage: python examples/train_gpt2.py [--steps 30] [--model tiny|small]
+"""
+
+import argparse
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.train import Checkpoint, JaxTrainer, ScalingConfig, session
+
+
+def train_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import GPT2, GPT2Config
+    from ray_tpu.models.gpt2 import loss_fn
+
+    cfg = (GPT2Config.tiny(dtype=jnp.float32)
+           if config["model"] == "tiny" else GPT2Config.gpt2_small())
+    model = GPT2(cfg)
+    rng = jax.random.PRNGKey(session.get_world_rank())
+    seq = min(cfg.max_seq_len, 128)
+    params = model.init_params(rng, batch=1, seq=seq)
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, tokens))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for i in range(config["steps"]):
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(i), (config["batch"], seq), 0,
+            cfg.vocab_size)
+        params, opt_state, loss = step(params, opt_state, tokens)
+        if i % 10 == 0 or i == config["steps"] - 1:
+            ckpt = Checkpoint.from_pytree(params) \
+                if session.get_world_rank() == 0 else None
+            session.report({"step": i, "loss": float(loss)},
+                           checkpoint=ckpt)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--model", default="tiny",
+                        choices=("tiny", "small"))
+    parser.add_argument("--num-workers", type=int, default=1)
+    args = parser.parse_args()
+
+    ray_tpu.init(ignore_reinit_error=True)
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"steps": args.steps, "batch": args.batch,
+                           "model": args.model},
+        scaling_config=ScalingConfig(num_workers=args.num_workers,
+                                     cpus_per_worker=1))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    print(f"final loss: {result.metrics['loss']:.4f} "
+          f"(steps={result.metrics['step'] + 1}, "
+          f"checkpoint={'yes' if result.checkpoint else 'no'})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Hyperparameter sweep with ASHA early stopping.
+
+Usage: python examples/tune_asha.py
+"""
+
+import ray_tpu
+from ray_tpu import tune
+
+
+def trainable(config):
+    # a fake training curve: converges faster with better lr
+    quality = 1.0 / (1.0 + abs(config["lr"] - 3e-3) * 300)
+    for i in range(20):
+        tune.report({"accuracy": quality * (1 - 0.8 ** (i + 1)),
+                     "training_iteration": i + 1})
+
+
+def main():
+    ray_tpu.init(ignore_reinit_error=True)
+    results = tune.run(
+        trainable,
+        config={"lr": tune.loguniform(1e-5, 1e-1),
+                "batch": tune.choice([16, 32, 64])},
+        num_samples=8,
+        metric="accuracy", mode="max",
+        scheduler=tune.AsyncHyperBandScheduler(
+            metric="accuracy", mode="max", max_t=20, grace_period=4))
+    best = results.get_best_result()
+    print(f"best lr={best.config['lr']:.2e} "
+          f"accuracy={best.metrics['accuracy']:.3f}")
+
+
+if __name__ == "__main__":
+    main()

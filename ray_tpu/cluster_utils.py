@@ -48,12 +48,51 @@ class Cluster:
         self.worker_nodes: List[ClusterNode] = []
         if initialize_head:
             args = dict(head_node_args or {})
-            resources = self._resources_from_args(args)
+            self._head_resources = self._resources_from_args(args)
             proc, handshake = node_mod.spawn_head(
-                self.config, self.session_dir, resources)
+                self.config, self.session_dir, self._head_resources)
             self.head = ClusterNode(proc, handshake)
         if connect:
             self.connect()
+
+    def restart_head(self, wait_s: float = 15.0) -> None:
+        """Kill and respawn the head (GCS + head raylet) in place,
+        rebinding the SAME GCS port so surviving side-node raylets
+        re-register (parity model: reference GCS restart fault
+        tolerance, test_gcs_fault_tolerance.py).  Durable GCS tables
+        restore from the session-dir snapshot."""
+        import time as _time
+
+        gcs_port = self.gcs_address[1]
+        self.head.kill()
+        # the port releases when the process dies; rebind it explicitly
+        proc, handshake = node_mod.spawn_head(
+            self.config, self.session_dir, self._head_resources,
+            gcs_port=gcs_port)
+        self.head = ClusterNode(proc, handshake)
+        # wait for the side raylets to re-register
+        deadline = _time.monotonic() + wait_s
+        import asyncio
+
+        from ray_tpu.core import rpc
+
+        want = 1 + len(self.worker_nodes)
+        while _time.monotonic() < deadline:
+            async def _count():
+                conn = await rpc.connect(self.gcs_address)
+                try:
+                    nodes = await conn.call("get_nodes", {})
+                finally:
+                    conn.close()
+                return sum(1 for n in nodes if n["alive"])
+            try:
+                if asyncio.run(_count()) >= want:
+                    return
+            except OSError:
+                pass
+            _time.sleep(0.2)
+        raise TimeoutError(
+            f"side raylets did not re-register within {wait_s}s")
 
     @staticmethod
     def _resources_from_args(args: Dict[str, Any]) -> Optional[Dict[str, float]]:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -89,7 +90,8 @@ class PlacementGroupInfo:
 class GcsServer:
     """All GCS tables + managers in one asyncio service."""
 
-    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, config: Config, host: str = "127.0.0.1",
+                 port: int = 0, snapshot_path: Optional[str] = None):
         self.config = config
         self.server = rpc.Server(self, host=host, port=port)
         self.pool = rpc.ConnectionPool()
@@ -112,6 +114,63 @@ class GcsServer:
         self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
         # (name, sorted-tags) -> aggregated metric record
         self._metrics: Dict[Any, Dict[str, Any]] = {}
+        # durable tables (reference: GcsTableStorage over Redis — here a
+        # session-dir pickle snapshot): kv, functions, jobs, and DETACHED
+        # actors survive a GCS/head restart; nodes re-register live
+        self._snapshot_path = snapshot_path
+        self._persist_handle: Optional[asyncio.TimerHandle] = None
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._restore_snapshot()
+
+    def _restore_snapshot(self) -> None:
+        import pickle
+
+        try:
+            with open(self._snapshot_path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception as e:  # noqa: BLE001 — a torn snapshot loses
+            logger.warning("GCS snapshot unreadable (%s); cold start", e)
+            return
+        self.kv = snap.get("kv", {})
+        self.functions = snap.get("functions", {})
+        self.jobs = snap.get("jobs", {})
+        self.job_counter = snap.get("job_counter", 0)
+        for info in snap.get("detached_actors", []):
+            self.actors[info.actor_id] = info
+            if info.name:
+                self.named_actors[(info.namespace or "default",
+                                   info.name)] = info.actor_id
+        logger.info(
+            "GCS restored from snapshot: %d kv namespaces, %d functions, "
+            "%d jobs, %d detached actors",
+            len(self.kv), len(self.functions), len(self.jobs),
+            len([a for a in self.actors.values()]))
+
+    def _schedule_persist(self) -> None:
+        """Debounced snapshot write (coalesces mutation bursts)."""
+        if not self._snapshot_path or self._persist_handle is not None:
+            return
+        loop = asyncio.get_running_loop()
+        self._persist_handle = loop.call_later(0.2, self._persist_now)
+
+    def _persist_now(self) -> None:
+        import pickle
+
+        self._persist_handle = None
+        if not self._snapshot_path:
+            return
+        detached = [a for a in self.actors.values()
+                    if a.detached and a.state != ACTOR_DEAD]
+        snap = {"kv": self.kv, "functions": self.functions,
+                "jobs": self.jobs, "job_counter": self.job_counter,
+                "detached_actors": detached}
+        tmp = self._snapshot_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(snap, f)
+            os.replace(tmp, self._snapshot_path)
+        except OSError as e:
+            logger.warning("GCS snapshot write failed: %s", e)
 
     async def start(self) -> rpc.Address:
         address = await self.server.start()
@@ -272,6 +331,7 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_kv_put(self, conn, data):
         ns = self.kv.setdefault(data.get("namespace", ""), {})
+        self._schedule_persist()
         existed = data["key"] in ns
         if data.get("overwrite", True) or not existed:
             ns[data["key"]] = data["value"]
@@ -281,6 +341,7 @@ class GcsServer:
         return self.kv.get(data.get("namespace", ""), {}).get(data["key"])
 
     async def handle_kv_del(self, conn, data):
+        self._schedule_persist()
         ns = self.kv.get(data.get("namespace", ""), {})
         return ns.pop(data["key"], None) is not None
 
@@ -294,6 +355,7 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_register_function(self, conn, data):
         self.functions[data["function_id"]] = data["blob"]
+        self._schedule_persist()
         return True
 
     async def handle_get_function(self, conn, data):
@@ -305,12 +367,14 @@ class GcsServer:
     async def handle_register_job(self, conn, data):
         self.job_counter += 1
         job_id = JobID.from_int(self.job_counter)
+        self._schedule_persist()
         self.jobs[job_id] = {"start_time": time.time(),
                              "driver_address": data.get("driver_address"),
                              "alive": True}
         return {"job_id": job_id.binary()}
 
     async def handle_job_finished(self, conn, data):
+        self._schedule_persist()
         job = self.jobs.get(JobID(data["job_id"]))
         if job:
             job["alive"] = False
@@ -540,6 +604,8 @@ class GcsServer:
         info.address = tuple(data["task_address"])
         info.state = ACTOR_ALIVE
         self._publish_actor(info)
+        if info.detached:
+            self._schedule_persist()
         return True
 
     async def handle_actor_creation_failed(self, conn, data):
@@ -590,6 +656,8 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None or info.state == ACTOR_DEAD:
             return
+        if info.detached:
+            self._schedule_persist()
         if allow_restart and info.num_restarts < info.max_restarts:
             info.num_restarts += 1
             info.state = ACTOR_RESTARTING

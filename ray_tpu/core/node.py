@@ -86,11 +86,17 @@ def detect_topology() -> Dict[str, Any]:
 
 async def run_head(config: Config, session_dir: str,
                    resources: Optional[Dict[str, float]],
-                   handshake_path: str, host: str = "127.0.0.1") -> None:
+                   handshake_path: str, host: str = "127.0.0.1",
+                   gcs_port: int = 0) -> None:
     from ray_tpu.core.gcs import GcsServer
     from ray_tpu.core.raylet import Raylet
 
-    gcs = GcsServer(config, host=host)
+    # durable GCS tables: kv/jobs/functions/detached actors survive a
+    # head restart (reference: GCS recovery from Redis,
+    # test_gcs_fault_tolerance.py); the snapshot lives in the session dir
+    gcs = GcsServer(config, host=host, port=gcs_port,
+                    snapshot_path=os.path.join(session_dir,
+                                               "gcs_snapshot.pkl"))
     gcs_address = await gcs.start()
     merged = dict(resources or {})
     for k, v in detect_tpu_resources().items():
@@ -146,9 +152,12 @@ async def run_node(config: Config, gcs_address: Tuple[str, int],
 
 def spawn_head(config: Config, session_dir: str,
                resources: Optional[Dict[str, float]] = None,
+               gcs_port: int = 0,
                ) -> Tuple[subprocess.Popen, Dict[str, Any]]:
     """Spawn the head node subprocess; returns (proc, handshake)."""
     handshake = os.path.join(session_dir, "head_handshake.json")
+    if os.path.exists(handshake):  # restart: await a FRESH handshake
+        os.remove(handshake)
     cmd = [sys.executable, "-m", "ray_tpu.core.node",
            "--mode", "head",
            "--session-dir", session_dir,
@@ -156,6 +165,8 @@ def spawn_head(config: Config, session_dir: str,
            "--config", config.to_json()]
     if resources is not None:
         cmd += ["--resources", json.dumps(resources)]
+    if gcs_port:
+        cmd += ["--gcs-port", str(gcs_port)]
     proc = _spawn(cmd, session_dir, "head")
     return proc, _await_handshake(proc, handshake)
 
@@ -186,12 +197,16 @@ def _spawn(cmd, session_dir: str, tag: str) -> subprocess.Popen:
     env = dict(os.environ)
     # node daemons never need an accelerator
     env["JAX_PLATFORMS"] = "cpu"
-    return subprocess.Popen(cmd, stdout=out, stderr=err, env=env,
+    proc = subprocess.Popen(cmd, stdout=out, stderr=err, env=env,
                             cwd=os.getcwd())
+    proc._rtpu_err_path = log_base + ".err"  # for handshake diagnostics
+    return proc
 
 
 def _await_handshake(proc: subprocess.Popen, path: str,
-                     timeout: float = 30.0) -> Dict[str, Any]:
+                     timeout: float = 60.0) -> Dict[str, Any]:
+    # 60s: heavily loaded CI boxes (full-suite runs with TF/torch tests
+    # hogging cores) have shown >30s fork-to-listen latency
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(path):
@@ -200,10 +215,24 @@ def _await_handshake(proc: subprocess.Popen, path: str,
         if proc.poll() is not None:
             raise RuntimeError(
                 f"node process exited with code {proc.returncode} before "
-                f"handshake; see logs in the session dir")
+                f"handshake: {_stderr_tail(proc)}")
         time.sleep(0.02)
     proc.terminate()
     raise TimeoutError("timed out waiting for node handshake")
+
+
+def _stderr_tail(proc: subprocess.Popen, limit: int = 2000) -> str:
+    """Last bytes of the daemon's .err log for exception messages."""
+    try:
+        err = getattr(proc, "_rtpu_err_path", None)
+        if err and os.path.exists(err):
+            with open(err, "rb") as f:
+                f.seek(max(0, os.path.getsize(err) - limit))
+                return f.read().decode(errors="replace").strip() \
+                    or "(empty stderr)"
+    except OSError:
+        pass
+    return "see logs in the session dir"
 
 
 def main() -> None:
@@ -214,6 +243,7 @@ def main() -> None:
     parser.add_argument("--handshake", required=True)
     parser.add_argument("--config", required=True)
     parser.add_argument("--resources", default=None)
+    parser.add_argument("--gcs-port", type=int, default=0)
     args = parser.parse_args()
 
     logging.basicConfig(
@@ -224,7 +254,7 @@ def main() -> None:
     resources = json.loads(args.resources) if args.resources else None
     if args.mode == "head":
         asyncio.run(run_head(config, args.session_dir, resources,
-                             args.handshake))
+                             args.handshake, gcs_port=args.gcs_port))
     else:
         host, port = args.gcs.rsplit(":", 1)
         asyncio.run(run_node(config, (host, int(port)), args.session_dir,

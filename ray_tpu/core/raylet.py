@@ -159,6 +159,7 @@ class Raylet:
     # ------------------------------------------------------------------
     async def start(self) -> rpc.Address:
         address = await self.server.start()
+        self.address = address
         self.gcs_conn = await rpc.connect(self.gcs_address)
         reply = await self.gcs_conn.call("register_node", {
             "node_id": self.node_id.binary(),
@@ -223,13 +224,39 @@ class Raylet:
                 self._gcs_misses = getattr(self, "_gcs_misses", 0) + 1
                 logger.warning("GCS unreachable from raylet %s (%d)",
                                self.node_id.hex()[:12], self._gcs_misses)
+                # the GCS may be RESTARTING (reference: raylets buffer
+                # through a GCS restart and re-register —
+                # test_gcs_fault_tolerance.py): reconnect + re-register
+                # with the same node id before giving up
+                if await self._try_gcs_reconnect():
+                    self._gcs_misses = 0
+                    continue
                 if self._gcs_misses * self.config.health_report_period_s > \
                         self.config.health_timeout_s * 3:
-                    # head is gone: tear down this node (workers follow via
-                    # their raylet connections dropping)
+                    # head is gone for good: tear down this node (workers
+                    # follow via their raylet connections dropping)
                     logger.error("GCS dead; raylet exiting")
                     os._exit(0)
             await asyncio.sleep(self.config.health_report_period_s)
+
+    async def _try_gcs_reconnect(self) -> bool:
+        try:
+            conn = await rpc.connect(self.gcs_address, timeout=3.0)
+            reply = await conn.call("register_node", {
+                "node_id": self.node_id.binary(),
+                "raylet_address": list(self.address),
+                "resources": self.resources_total,
+                "topology": self.topology,
+            }, timeout=5.0)
+            if self.gcs_conn is not None:
+                self.gcs_conn.close()
+            self.gcs_conn = conn
+            logger.info("raylet %s re-registered with restarted GCS",
+                        self.node_id.hex()[:12])
+            return bool(reply)
+        except (rpc.ConnectionLost, rpc.RpcError, OSError,
+                asyncio.TimeoutError):
+            return False
 
     # ------------------------------------------------------------------
     # memory monitor + worker killing policy (parity:

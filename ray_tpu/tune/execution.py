@@ -83,12 +83,28 @@ class TrialRunner:
             trial.status = PENDING
             self._pending.append(trial)
 
+    def _effective_max_concurrent(self) -> int:
+        """Cap concurrency at what the cluster can actually place:
+        ``_start_trial`` blocks on actor placement, so starting more
+        trials than fit would deadlock the event loop against its own
+        finished-but-unreaped trials."""
+        cap = self.max_concurrent
+        try:
+            total = ray_tpu.cluster_resources()
+        except Exception:  # noqa: BLE001 — sizing is best-effort
+            return cap
+        for res, need in self.resources.items():
+            if need and total.get(res):
+                cap = min(cap, max(1, int(total[res] // need)))
+        return cap
+
     def run(self, poll_period: float = 0.05) -> List[Trial]:
         self._pending = pending = [t for t in self.trials
                                    if t.status == PENDING]
         live: List[Trial] = []
+        max_concurrent = self._effective_max_concurrent()
         while pending or live:
-            while pending and len(live) < self.max_concurrent:
+            while pending and len(live) < max_concurrent:
                 trial = pending.pop(0)
                 try:
                     self._start_trial(trial)

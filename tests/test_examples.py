@@ -1,0 +1,48 @@
+"""The examples/ scripts run end-to-end (parity model: reference
+doc/example CI jobs)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO)
+    assert out.returncode == 0, \
+        f"{script} failed:\n{out.stdout[-1000:]}\n{out.stderr[-2000:]}"
+    return out.stdout
+
+
+def test_example_train_gpt2():
+    out = _run("train_gpt2.py", "--steps", "12", "--batch", "2")
+    assert "final loss:" in out
+
+
+def test_example_serve_inference():
+    out = _run("serve_inference.py")
+    assert "predicted class:" in out
+
+
+def test_example_tune_asha():
+    out = _run("tune_asha.py")
+    assert "best lr=" in out
+
+
+def test_example_rllib_ppo():
+    out = _run("rllib_ppo.py", "--target", "60")
+    assert "solved" in out or "reward=" in out
+
+
+def test_example_data_etl():
+    out = _run("data_etl.py")
+    assert "consumed 1000 rows" in out
