@@ -198,6 +198,14 @@ def shutdown() -> None:
     with _init_lock:
         from ray_tpu.util import client as client_mod
         client_mod.disconnect()
+        # retire any serve router poll thread bound to this cluster
+        import sys as _sys
+        _serve = _sys.modules.get("ray_tpu.serve")
+        if _serve is not None and getattr(_serve, "_router", None) is not None:
+            with _serve._router_lock:
+                if _serve._router is not None:
+                    _serve._router.stop()
+                _serve._router = None
         core = _worker_mod.global_worker_or_none()
         if core is not None:
             core.shutdown()

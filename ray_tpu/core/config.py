@@ -52,6 +52,10 @@ class Config:
     #: Max tasks in flight to a single leased worker before requesting more
     #: workers (pipelining depth).
     max_tasks_in_flight_per_worker: int = 64
+    #: Tasks per push RPC frame.  Smaller chunks stream completions back
+    #: while the worker executes the next chunk; one cap-sized frame would
+    #: serialize driver and worker into lock-step.
+    task_push_chunk_size: int = 16
     #: Seconds a leased idle worker is kept before being returned.
     idle_worker_lease_timeout_s: float = 0.25
     #: Number of workers each raylet keeps pre-started.

@@ -140,6 +140,12 @@ class GcsServer:
             if info.name:
                 self.named_actors[(info.namespace or "default",
                                    info.name)] = info.actor_id
+        # placement groups: bundles stay committed on surviving raylets;
+        # restoring the table keeps lookup/removal working after restart
+        # (parity: reference GcsTableStorage persists the PG table too)
+        for pg_id, info in snap.get("placement_groups", {}).items():
+            info.scheduling = False
+            self.placement_groups[pg_id] = info
         logger.info(
             "GCS restored from snapshot: %d kv namespaces, %d functions, "
             "%d jobs, %d detached actors",
@@ -161,9 +167,12 @@ class GcsServer:
             return
         detached = [a for a in self.actors.values()
                     if a.detached and a.state != ACTOR_DEAD]
+        pgs = {pid: info for pid, info in self.placement_groups.items()
+               if info.state != "REMOVED"}
         snap = {"kv": self.kv, "functions": self.functions,
                 "jobs": self.jobs, "job_counter": self.job_counter,
-                "detached_actors": detached}
+                "detached_actors": detached,
+                "placement_groups": pgs}
         tmp = self._snapshot_path + ".tmp"
         try:
             with open(tmp, "wb") as f:
@@ -688,6 +697,7 @@ class GcsServer:
         )
         self.placement_groups[pg.pg_id] = pg
         await self._schedule_pg(pg)
+        self._schedule_persist()
         return {"state": pg.state}
 
     async def handle_placement_group_ready(self, conn, data):
@@ -728,6 +738,7 @@ class GcsServer:
                                            allow_restart=False)
         await self._return_bundles(pg, targets)
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": "REMOVED"})
+        self._schedule_persist()
         return True
 
     async def _pg_retry_loop(self) -> None:

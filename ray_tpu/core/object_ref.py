@@ -86,7 +86,10 @@ class ObjectRef:
 
             core = worker_mod.global_worker_or_none()
             if core is not None:
-                core.reference_counter.remove_local_ref(self._id)
+                # Deferred: finalizers may run on any thread while it holds
+                # unrelated locks; the refcount mutation happens on the io
+                # loop (see CoreWorker.deferred_remove_local_ref).
+                core.deferred_remove_local_ref(self._id)
         except Exception:
             pass  # interpreter shutdown
 

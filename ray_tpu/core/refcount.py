@@ -53,6 +53,19 @@ class Reference:
 
 
 class ReferenceCounter:
+    """Thread-safe refcount table.
+
+    Locking discipline (parity: the reference posts release callbacks to the
+    io_service instead of invoking them under its mutex,
+    ``src/ray/core_worker/reference_count.cc``): ``self._lock`` protects only
+    the table; the ``on_free`` / ``on_borrow_*`` callbacks are ALWAYS invoked
+    after the lock is released.  Callbacks may therefore take other locks
+    (e.g. the TaskManager's) without risking lock-order inversion — the
+    round-1 AB-BA deadlock was exactly ``remove_local_ref`` (RC lock held)
+    → ``evict_lineage`` (wants TM lock) racing ``TaskManager.register``
+    (TM lock held) → ``add_owned`` (wants RC lock).
+    """
+
     def __init__(self, on_free: Callable[[ObjectID, Reference], None],
                  on_borrow_added: Callable[[ObjectID, Optional[tuple]], None],
                  on_borrow_removed: Callable[[ObjectID, Optional[tuple]], None]):
@@ -61,6 +74,20 @@ class ReferenceCounter:
         self._on_free = on_free
         self._on_borrow_added = on_borrow_added
         self._on_borrow_removed = on_borrow_removed
+
+    # A "release action" is computed under the lock and fired outside it.
+    def _fire(self, action: Optional[tuple]) -> None:
+        if action is None:
+            return
+        kind, object_id, payload = action
+        try:
+            if kind == "free":
+                self._on_free(object_id, payload)
+            else:  # "borrow_removed"
+                self._on_borrow_removed(object_id, payload)
+        except Exception:  # callbacks must never poison the caller
+            logger.exception("refcount release callback failed for %s",
+                             object_id)
 
     def _get(self, object_id: ObjectID) -> Reference:
         ref = self._refs.get(object_id)
@@ -87,7 +114,8 @@ class ReferenceCounter:
             if ref is None:
                 return
             ref.local_refs -= 1
-            self._maybe_release(object_id, ref)
+            action = self._maybe_release(object_id, ref)
+        self._fire(action)
 
     def add_submitted_ref(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -99,7 +127,8 @@ class ReferenceCounter:
             if ref is None:
                 return
             ref.submitted_refs -= 1
-            self._maybe_release(object_id, ref)
+            action = self._maybe_release(object_id, ref)
+        self._fire(action)
 
     def add_borrower(self, object_id: ObjectID, borrower: tuple) -> None:
         with self._lock:
@@ -111,7 +140,8 @@ class ReferenceCounter:
             if ref is None:
                 return
             ref.borrowers.discard(borrower)
-            self._maybe_release(object_id, ref)
+            action = self._maybe_release(object_id, ref)
+        self._fire(action)
 
     def add_location(self, object_id: ObjectID, node_address: tuple) -> None:
         with self._lock:
@@ -154,20 +184,20 @@ class ReferenceCounter:
             self._on_borrow_added(object_id, owner_address)
 
     # -- release ----------------------------------------------------------
-    def _maybe_release(self, object_id: ObjectID, ref: Reference) -> None:
+    def _maybe_release(self, object_id: ObjectID,
+                       ref: Reference) -> Optional[tuple]:
+        """Called with self._lock held.  Returns the release action to fire
+        AFTER the lock is released (never invokes callbacks inline)."""
         if ref.local_refs > 0 or ref.submitted_refs > 0 or ref.borrowers:
-            return
+            return None
         if ref.freed:
-            return
+            return None
+        ref.freed = True
+        del self._refs[object_id]
         if ref.owned:
-            ref.freed = True
-            del self._refs[object_id]
-            self._on_free(object_id, ref)
-        else:
-            # last local borrow released: tell the owner
-            ref.freed = True
-            del self._refs[object_id]
-            self._on_borrow_removed(object_id, ref.owner_address)
+            return ("free", object_id, ref)
+        # last local borrow released: tell the owner
+        return ("borrow_removed", object_id, ref.owner_address)
 
     def owned_count(self) -> int:
         with self._lock:
@@ -199,6 +229,12 @@ class TaskManager:
     memory store; the spec is retained (lineage) while any return object
     may still need reconstruction.  On worker/node failure the task is
     resubmitted if its retry budget allows.
+
+    Locking discipline: the TM lock protects only the pending/lineage
+    tables.  All ReferenceCounter calls happen OUTSIDE the TM lock (the RC
+    may fire free callbacks that re-enter ``evict_lineage``), so the only
+    nesting that ever occurs is "no lock held → RC lock" and "no lock held
+    → TM lock" — no AB-BA cycle is possible.
     """
 
     def __init__(self, reference_counter: ReferenceCounter):
@@ -208,14 +244,14 @@ class TaskManager:
         self._rc = reference_counter
 
     def register(self, spec: TaskSpec) -> None:
+        for ret in spec.return_ids():
+            self._rc.add_owned(ret, producing_task=spec.task_id)
+        for arg in spec.args:
+            if arg.object_id is not None:
+                self._rc.add_submitted_ref(arg.object_id)
         with self._lock:
             self._pending[spec.task_id] = PendingTask(
                 spec=spec, retries_left=spec.max_retries)
-            for ret in spec.return_ids():
-                self._rc.add_owned(ret, producing_task=spec.task_id)
-            for arg in spec.args:
-                if arg.object_id is not None:
-                    self._rc.add_submitted_ref(arg.object_id)
 
     def is_pending(self, task_id: TaskID) -> bool:
         with self._lock:
@@ -232,10 +268,10 @@ class TaskManager:
             if entry is None:
                 return None
             self._lineage[task_id] = entry.spec
-            for arg in entry.spec.args:
-                if arg.object_id is not None:
-                    self._rc.remove_submitted_ref(arg.object_id)
-            return entry.spec
+        for arg in entry.spec.args:
+            if arg.object_id is not None:
+                self._rc.remove_submitted_ref(arg.object_id)
+        return entry.spec
 
     def take_for_retry(self, task_id: TaskID) -> Optional[TaskSpec]:
         """Consume one retry; returns the bumped spec or None if exhausted."""
@@ -252,10 +288,10 @@ class TaskManager:
             entry = self._pending.pop(task_id, None)
             if entry is None:
                 return None
-            for arg in entry.spec.args:
-                if arg.object_id is not None:
-                    self._rc.remove_submitted_ref(arg.object_id)
-            return entry.spec
+        for arg in entry.spec.args:
+            if arg.object_id is not None:
+                self._rc.remove_submitted_ref(arg.object_id)
+        return entry.spec
 
     def lineage_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
         with self._lock:
@@ -272,10 +308,10 @@ class TaskManager:
                 return None  # already being re-executed
             spec.attempt_number += 1
             self._pending[task_id] = PendingTask(spec=spec, retries_left=0)
-            for arg in spec.args:
-                if arg.object_id is not None:
-                    self._rc.add_submitted_ref(arg.object_id)
-            return spec
+        for arg in spec.args:
+            if arg.object_id is not None:
+                self._rc.add_submitted_ref(arg.object_id)
+        return spec
 
     def evict_lineage(self, task_id: TaskID) -> None:
         with self._lock:

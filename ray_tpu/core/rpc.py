@@ -67,9 +67,36 @@ class Connection:
         self._push_handler: Optional[Callable[[str, Any], None]] = None
         self._closed = False
         self.peername = writer.get_extra_info("peername")
-        self._loop_task = asyncio.get_running_loop().create_task(self._run())
+        # Outbound frames produced within one event-loop tick coalesce
+        # into a single transport write (one send(2) instead of one per
+        # frame) — the per-frame syscall dominated nop-task storms.
+        self._wbuf: list = []
+        self._wflush_scheduled = False
+        self._loop = asyncio.get_running_loop()
+        self._loop_task = self._loop.create_task(self._run())
         # Application state slot (e.g. the worker/node this conn belongs to).
         self.context: Dict[str, Any] = {}
+
+    def _send_frame(self, message: Any) -> None:
+        payload = pickle.dumps(message, protocol=5)
+        self._wbuf.append(_LEN.pack(len(payload)))
+        self._wbuf.append(payload)
+        if not self._wflush_scheduled:
+            self._wflush_scheduled = True
+            self._loop.call_soon(self._flush_wbuf)
+
+    def _flush_wbuf(self) -> None:
+        self._wflush_scheduled = False
+        if not self._wbuf:
+            return
+        buf = b"".join(self._wbuf)
+        self._wbuf.clear()
+        if self._closed:
+            return
+        try:
+            self._writer.write(buf)
+        except Exception:
+            self._teardown()
 
     def set_push_handler(self, fn: Callable[[str, Any], None]) -> None:
         self._push_handler = fn
@@ -110,6 +137,7 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        self._wbuf.clear()
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost())
@@ -135,23 +163,24 @@ class Connection:
             reply = (msg_id, KIND_ERR, method, f"{type(e).__name__}: {e}")
         if not self._closed:
             try:
-                _write_frame(self._writer, reply)
+                self._send_frame(reply)
             except Exception:
                 self._teardown()
 
     def start_call(self, method: str, data: Any = None) -> asyncio.Future:
-        """Write the request frame now and return the reply future.
+        """Queue the request frame and return the reply future.
 
-        The frame hits the stream before this returns, so callers that need
-        ordered delivery (e.g. per-actor sequential submission) can sequence
-        their ``start_call``s without waiting for replies.
+        Frames are delivered in ``start_call`` order (the write buffer is
+        FIFO and flushed once per loop tick), so callers that need ordered
+        delivery (e.g. per-actor sequential submission) can sequence their
+        ``start_call``s without waiting for replies.
         """
         if self._closed:
             raise ConnectionLost()
         msg_id = next(self._msg_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        _write_frame(self._writer, (msg_id, KIND_REQ, method, data))
+        self._send_frame((msg_id, KIND_REQ, method, data))
         return fut
 
     async def call(self, method: str, data: Any = None,
@@ -166,7 +195,7 @@ class Connection:
         if self._closed:
             return
         try:
-            _write_frame(self._writer, (0, KIND_PUSH, channel, data))
+            self._send_frame((0, KIND_PUSH, channel, data))
         except Exception:
             self._teardown()
 
@@ -175,6 +204,7 @@ class Connection:
         return self._closed
 
     async def drain(self) -> None:
+        self._flush_wbuf()
         await self._writer.drain()
 
     def close(self) -> None:

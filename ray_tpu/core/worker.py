@@ -28,6 +28,7 @@ import concurrent.futures
 import hashlib
 import logging
 import os
+import pickle
 import queue as queue_mod
 import threading
 import time
@@ -35,6 +36,20 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
+
+
+def _spec_dumps(obj) -> bytes:
+    """Wire-serialize a TaskSpec (or list of them).
+
+    Specs are plain dataclasses of ids/bytes/strings — the C pickler
+    handles them ~20x faster than cloudpickle (user functions never
+    travel here; they're in the GCS function table by id).  Loading uses
+    plain ``pickle.loads`` either way.
+    """
+    try:
+        return pickle.dumps(obj, protocol=5)
+    except Exception:  # e.g. an exotic strategy payload — keep working
+        return cloudpickle.dumps(obj)
 
 from ray_tpu.core import rpc
 from ray_tpu.core.config import Config, get_config, set_config
@@ -210,8 +225,22 @@ class CoreWorker:
         self.task_server: Optional[rpc.Server] = None
         self.task_address: Optional[rpc.Address] = None
         self._shutdown = False
-        self._task_events: List[Dict[str, Any]] = []
+        self._task_events: List[tuple] = []  # raw task-state tuples, formatted at flush
         self._lease_tpu_ids: List[int] = []
+
+        # GC-driven ref releases (ObjectRef.__del__) are deferred here and
+        # drained on the io loop: __del__ can fire on ANY thread at ANY
+        # bytecode boundary — including while that thread holds unrelated
+        # locks — so the refcount mutation and its free callbacks must not
+        # run inline (parity: reference_count.cc posts deletions to the
+        # io_service).  deque.append is GC-reentrancy-safe.
+        self._gc_release_queue: deque = deque()
+        self._gc_drain_scheduled = False
+
+        # Submissions from the driver thread batch into one loop wakeup
+        # (one call_soon_threadsafe per burst instead of per task).
+        self._submit_queue: deque = deque()
+        self._submit_drain_scheduled = False
 
         self._run(self._async_init())
         set_global_worker(self)
@@ -610,6 +639,35 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # refcount callbacks (may fire on any thread, incl. GC)
     # ------------------------------------------------------------------
+    def deferred_remove_local_ref(self, object_id: ObjectID) -> None:
+        """GC-safe local-ref release for ObjectRef.__del__.
+
+        The actual refcount mutation (and any free callback it triggers)
+        runs on the io loop, never inline in the finalizer.
+        """
+        self._gc_release_queue.append(object_id)
+        if self._shutdown:
+            return
+        if not self._gc_drain_scheduled:
+            self._gc_drain_scheduled = True
+            try:
+                self._loop.call_soon_threadsafe(self._drain_gc_releases)
+            except (RuntimeError, AttributeError):
+                self._gc_drain_scheduled = False  # loop torn down
+
+    def _drain_gc_releases(self) -> None:
+        # Clear the flag BEFORE draining: a producer appending after the
+        # final popleft then sees False and schedules a fresh drain.
+        self._gc_drain_scheduled = False
+        rc = self.reference_counter
+        q = self._gc_release_queue
+        while True:
+            try:
+                oid = q.popleft()
+            except IndexError:
+                return
+            rc.remove_local_ref(oid)
+
     def _on_object_freed(self, object_id: ObjectID, ref_info) -> None:
         self.memory_store.delete(object_id)
         if ref_info.in_plasma and not self._shutdown:
@@ -786,7 +844,36 @@ class CoreWorker:
 
     def _submit_to_lease_queue(self, spec: TaskSpec) -> None:
         self._record_task_event(spec, "PENDING")
-        self._loop.call_soon_threadsafe(self._enqueue_for_lease, spec)
+        self._submit_queue.append(spec)
+        if not self._submit_drain_scheduled:
+            self._submit_drain_scheduled = True
+            try:
+                self._loop.call_soon_threadsafe(self._drain_submit_queue)
+            except (RuntimeError, AttributeError):
+                self._submit_drain_scheduled = False  # loop torn down
+
+    def _drain_submit_queue(self) -> None:
+        # flag cleared BEFORE draining (same protocol as _drain_gc_releases)
+        self._submit_drain_scheduled = False
+        touched: Dict[Tuple, "_LeaseState"] = {}
+        q = self._submit_queue
+        while True:
+            try:
+                spec = q.popleft()
+            except IndexError:
+                break
+            if spec.task_type == TaskType.ACTOR_TASK:
+                self._enqueue_actor_task(spec)
+                continue
+            key = spec.scheduling_key()
+            state = self._lease_states.get(key)
+            if state is None:
+                state = _LeaseState(key)
+                self._lease_states[key] = state
+            state.backlog.append(spec)
+            touched[key] = state
+        for state in touched.values():
+            self._pump_lease_queue(state)
 
     def _enqueue_for_lease(self, spec: TaskSpec) -> None:
         key = spec.scheduling_key()
@@ -816,26 +903,32 @@ class CoreWorker:
         # in-flight cap (throughput for sub-millisecond tasks), but always
         # leave at least one queued task per pending lease grant so new
         # workers (possibly on other nodes) get work on arrival.  Tasks
-        # for one worker ship as ONE batched RPC frame: per-task frames
-        # measured ~420 us of event-loop work each on nop storms.
+        # ship as batched RPC frames (per-task frames measured ~420 us of
+        # event-loop work each on nop storms) — but in CHUNKS, not one
+        # cap-sized batch: the worker replies per chunk, so completions
+        # stream back and refill while it executes the next chunk instead
+        # of ping-ponging one giant batch per round trip.
         reserve = max(1, state.requesting)
+        chunk_size = self.config.task_push_chunk_size
         for worker in list(state.workers.values()):
             room = self.config.max_tasks_in_flight_per_worker \
                 - worker.inflight
-            batch: List[TaskSpec] = []
             while len(state.backlog) > reserve and room > 0:
-                batch.append(state.backlog.popleft())
-                room -= 1
-            if not batch:
-                continue
-            worker.inflight += len(batch)
-            if len(batch) == 1:
-                task = self._loop.create_task(
-                    self._push_task(state, worker, batch[0]))
-            else:
-                task = self._loop.create_task(
-                    self._push_task_batch(state, worker, batch))
-            task.add_done_callback(lambda t: t.exception())
+                batch: List[TaskSpec] = []
+                while (len(state.backlog) > reserve and room > 0
+                       and len(batch) < chunk_size):
+                    batch.append(state.backlog.popleft())
+                    room -= 1
+                if not batch:
+                    break
+                worker.inflight += len(batch)
+                if len(batch) == 1:
+                    task = self._loop.create_task(
+                        self._push_task(state, worker, batch[0]))
+                else:
+                    task = self._loop.create_task(
+                        self._push_task_batch(state, worker, batch))
+                task.add_done_callback(lambda t: t.exception())
         # Phase 4 — arm a return timer on every lease left idle, so leased
         # resources flow back to the raylet for other scheduling keys
         # (leaked leases deadlock the node once CPUs are exhausted)
@@ -927,7 +1020,7 @@ class CoreWorker:
             conn = await self._pool.get(worker.address)
             self._record_task_event(spec, "RUNNING")
             reply = await conn.call(
-                "push_task", {"spec_blob": cloudpickle.dumps(spec)},
+                "push_task", {"spec_blob": _spec_dumps(spec)},
                 timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             worker.inflight -= 1
@@ -953,7 +1046,7 @@ class CoreWorker:
             for spec in specs:
                 self._record_task_event(spec, "RUNNING")
             reply = await conn.call(
-                "push_tasks", {"specs_blob": cloudpickle.dumps(specs)},
+                "push_tasks", {"specs_blob": _spec_dumps(specs)},
                 timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             worker.inflight -= len(specs)
@@ -1062,7 +1155,7 @@ class CoreWorker:
         strat = spec.scheduling_strategy
         reply = self._run(self.gcs_conn.call("register_actor", {
             "actor_id": actor_id.binary(),
-            "spec_blob": cloudpickle.dumps(spec),
+            "spec_blob": _spec_dumps(spec),
             "resources": resources,
             "name": creation_spec.name,
             "namespace": creation_spec.namespace,
@@ -1111,8 +1204,9 @@ class CoreWorker:
         self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
         refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
-        self._record_task_event(spec, "PENDING")
-        self._loop.call_soon_threadsafe(self._enqueue_actor_task, spec)
+        # same batched loop-wakeup path as normal tasks; FIFO drain keeps
+        # per-actor sequence-number order equal to submission order
+        self._submit_to_lease_queue(spec)
         return refs
 
     def _enqueue_actor_task(self, spec: TaskSpec) -> None:
@@ -1151,7 +1245,7 @@ class CoreWorker:
             self._record_task_event(spec, "RUNNING")
             try:
                 reply_fut = conn.start_call(
-                    "push_actor_task", {"spec_blob": cloudpickle.dumps(spec)})
+                    "push_actor_task", {"spec_blob": _spec_dumps(spec)})
             except rpc.ConnectionLost:
                 self._pool.invalidate(address)
                 state.address = None
@@ -1349,16 +1443,25 @@ class CoreWorker:
     # task events (state API feed)
     # ------------------------------------------------------------------
     def _record_task_event(self, spec: TaskSpec, state: str) -> None:
-        self._task_events.append({
-            "task_id": spec.task_id.hex(),
-            "name": spec.function_descriptor,
+        # raw tuple on the hot path; formatted into dicts at flush time
+        self._task_events.append(
+            (spec.task_id, spec.function_descriptor, state,
+             spec.task_type, spec.actor_id, time.time(),
+             spec.attempt_number))
+
+    def _format_task_events(self, batch) -> List[Dict[str, Any]]:
+        wid = self.worker_id.hex()
+        return [{
+            "task_id": task_id.hex(),
+            "name": name,
             "state": state,
-            "type": spec.task_type.name,
-            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
-            "time": time.time(),
-            "attempt": spec.attempt_number,
-            "worker_id": self.worker_id.hex(),
-        })
+            "type": task_type.name,
+            "actor_id": actor_id.hex() if actor_id else None,
+            "time": ts,
+            "attempt": attempt,
+            "worker_id": wid,
+        } for task_id, name, state, task_type, actor_id, ts, attempt
+            in batch]
 
     async def _task_event_flush_loop(self) -> None:
         while not self._shutdown:
@@ -1366,8 +1469,9 @@ class CoreWorker:
             if self._task_events and self.gcs_conn and not self.gcs_conn.closed:
                 batch, self._task_events = self._task_events, []
                 try:
-                    await self.gcs_conn.call("report_task_events",
-                                             {"events": batch})
+                    await self.gcs_conn.call(
+                        "report_task_events",
+                        {"events": self._format_task_events(batch)})
                 except (rpc.ConnectionLost, rpc.RpcError):
                     pass
 
@@ -1385,7 +1489,10 @@ class CoreWorker:
             if item is None:
                 break
             spec, reply_fut = item
-            reply = self._execute_task(spec)
+            if isinstance(spec, list):  # batched push: one handoff per batch
+                reply = [self._execute_task(s) for s in spec]
+            else:
+                reply = self._execute_task(spec)
             self._loop.call_soon_threadsafe(_set_future, reply_fut, reply)
 
     def _start_extra_exec_threads(self, n: int) -> None:
@@ -1396,26 +1503,24 @@ class CoreWorker:
             self._exec_threads.append(t)
 
     async def handle_push_task(self, conn, data):
-        spec: TaskSpec = cloudpickle.loads(data["spec_blob"])
+        spec: TaskSpec = pickle.loads(data["spec_blob"])
         reply_fut = self._loop.create_future()
         # enqueue synchronously (before any await) to preserve arrival order
         self._exec_queue.put((spec, reply_fut))
         return await reply_fut
 
     async def handle_push_tasks(self, conn, data):
-        """Batched variant of push_task: one frame, ordered enqueue."""
-        specs: List[TaskSpec] = cloudpickle.loads(data["specs_blob"])
-        futs = []
-        for spec in specs:
-            reply_fut = self._loop.create_future()
-            self._exec_queue.put((spec, reply_fut))
-            futs.append(reply_fut)
-        return {"replies": list(await asyncio.gather(*futs))}
+        """Batched variant of push_task: one frame, one exec handoff, one
+        reply frame for the whole batch."""
+        specs: List[TaskSpec] = pickle.loads(data["specs_blob"])
+        reply_fut = self._loop.create_future()
+        self._exec_queue.put((specs, reply_fut))
+        return {"replies": await reply_fut}
 
     async def handle_push_actor_task(self, conn, data):
         if self._actor_instance is None:
             return {"actor_dead": True, "reason": "no actor in this worker"}
-        spec: TaskSpec = cloudpickle.loads(data["spec_blob"])
+        spec: TaskSpec = pickle.loads(data["spec_blob"])
         caller = spec.owner_address[3] if spec.owner_address else ""
         cache_key = (caller, spec.sequence_number, spec.task_id.binary())
         cached = self._actor_reply_cache.get(cache_key)
@@ -1430,7 +1535,7 @@ class CoreWorker:
         return reply
 
     async def handle_create_actor(self, conn, data):
-        spec: TaskSpec = cloudpickle.loads(data["spec_blob"])
+        spec: TaskSpec = pickle.loads(data["spec_blob"])
         reply_fut = self._loop.create_future()
         self._exec_queue.put((spec, reply_fut))
         reply = await reply_fut

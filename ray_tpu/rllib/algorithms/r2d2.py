@@ -120,8 +120,11 @@ class R2D2Policy(JaxPolicy):
                 done = batch[SampleBatch.TERMINATEDS][:, :-1] \
                     .astype(jnp.float32)
                 target = rew + gamma * (1.0 - done) * q_next
-                # the (t+1) step must be real for the bootstrap
-                mask = batch["seq_mask"][:, :-1] * batch["seq_mask"][:, 1:]
+                # a real (t+1) step is needed for the bootstrap — except at
+                # terminals, where the target is just r (q_next is already
+                # zeroed by (1-done)), so terminal rewards still train Q
+                mask = batch["seq_mask"][:, :-1] * jnp.maximum(
+                    batch["seq_mask"][:, 1:], done)
                 td = (q_taken - jax.lax.stop_gradient(target)) * mask
                 denom = jnp.maximum(mask.sum(), 1.0)
                 huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,

@@ -46,6 +46,8 @@ def _get_router() -> Router:
         # reconnecting (tests, notebooks) must rebuild against the new
         # controller
         if _router is None or _router_core is not core:
+            if _router is not None:
+                _router.stop()  # retire the stale cluster's poll thread
             _router = Router(start())
             _router_core = core
         return _router
@@ -53,14 +55,16 @@ def _get_router() -> Router:
 
 def shutdown() -> None:
     global _router
+    with _router_lock:
+        if _router is not None:
+            _router.stop()
+        _router = None
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)
         ray_tpu.kill(controller)
     except ValueError:
         pass
-    with _router_lock:
-        _router = None
 
 
 class DeploymentHandle:

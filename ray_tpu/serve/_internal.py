@@ -347,9 +347,17 @@ class Router:
         self._rr: Dict[str, int] = {}
         self._inflight: Dict[Tuple[str, bytes], int] = {}
         self._lock = threading.Lock()
+        self._stop = threading.Event()
         self._refresh(block=True)
         self._thread = threading.Thread(target=self._poll_loop, daemon=True)
         self._thread.start()
+
+    def stop(self) -> None:
+        """Terminate the long-poll thread (parity: reference
+        long_poll.py:68 LongPollClient teardown). Idempotent; safe to call
+        while a poll RPC is in flight — the flag is re-checked after each
+        refresh returns or errors."""
+        self._stop.set()
 
     def _refresh(self, block: bool = False) -> None:
         reply = ray_tpu.get(self._controller.get_routing_table.remote(
@@ -359,11 +367,11 @@ class Router:
             self._table = reply["table"]
 
     def _poll_loop(self) -> None:
-        while True:
+        while not self._stop.is_set():
             try:
                 self._refresh()
             except Exception:  # noqa: BLE001
-                time.sleep(1.0)
+                self._stop.wait(1.0)
 
     def assign(self, deployment: str):
         """Pick a replica (round-robin, skipping saturated ones).  Unknown

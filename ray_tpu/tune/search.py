@@ -2,9 +2,10 @@
 
 Parity: reference ``python/ray/tune/search/`` — sample-space primitives
 (``tune.uniform`` … ``tune.grid_search``, sample.py), the
-``BasicVariantGenerator`` grid/random resolver (basic_variant.py), and a
-native TPE-free BayesOpt-style searcher is out of scope (pluggable via
-``Searcher``)."""
+``BasicVariantGenerator`` grid/random resolver (basic_variant.py), plus
+native model-based searchers: ``BayesOptSearch`` (GP expected
+improvement) and ``TPESearch`` below; external Optuna/HyperOpt adapters
+are gated behind soft imports."""
 
 from __future__ import annotations
 

@@ -57,18 +57,25 @@ class ClientService:
     def __init__(self):
         self._refs: Dict[Any, Dict[bytes, ObjectRef]] = {}
         self._actors: Dict[Any, Dict[bytes, Any]] = {}
-        self._functions: Dict[str, Any] = {}
-        self._actor_classes: Dict[str, Any] = {}
+        # per-connection, like _refs/_actors: client-supplied ids must not
+        # collide across clients (an id collision would silently run
+        # another client's function)
+        self._functions: Dict[Any, Dict[str, Any]] = {}
+        self._actor_classes: Dict[Any, Dict[str, Any]] = {}
 
     # -- connection lifecycle -------------------------------------------
     def on_connection(self, conn) -> None:
         self._refs[conn] = {}
         self._actors[conn] = {}
+        self._functions[conn] = {}
+        self._actor_classes[conn] = {}
 
     def on_disconnection(self, conn) -> None:
         # dropping the table drops the server-side refs -> distributed GC
         self._refs.pop(conn, None)
         self._actors.pop(conn, None)
+        self._functions.pop(conn, None)
+        self._actor_classes.pop(conn, None)
 
     def _track(self, conn, ref: ObjectRef) -> Dict[str, Any]:
         self._refs[conn][ref.binary()] = ref
@@ -108,12 +115,17 @@ class ClientService:
     # -- tasks -----------------------------------------------------------
     async def handle_register_function(self, conn, data) -> None:
         fid = data["id"]
-        if fid not in self._functions:
+        table = self._functions[conn]
+        if fid not in table:
             fn = cloudpickle.loads(data["pickled"])
-            self._functions[fid] = ray_tpu.remote(fn)
+            table[fid] = ray_tpu.remote(fn)
 
     async def handle_task(self, conn, data) -> Dict[str, Any]:
-        fn = self._functions[data["id"]]
+        fn = self._functions[conn].get(data["id"])
+        if fn is None:
+            raise rpc.RpcError(
+                f"client function {data['id']!r} is not registered on "
+                f"this connection (reconnect re-registers functions)")
         if data.get("options"):
             fn = fn.options(**data["options"])
         args = _unpickle_with_refs(data["args"], self._refs[conn])
@@ -126,12 +138,17 @@ class ClientService:
     # -- actors ----------------------------------------------------------
     async def handle_register_actor_class(self, conn, data) -> None:
         cid = data["id"]
-        if cid not in self._actor_classes:
+        table = self._actor_classes[conn]
+        if cid not in table:
             cls = cloudpickle.loads(data["pickled"])
-            self._actor_classes[cid] = ray_tpu.remote(cls)
+            table[cid] = ray_tpu.remote(cls)
 
     async def handle_create_actor(self, conn, data) -> Dict[str, Any]:
-        ac = self._actor_classes[data["id"]]
+        ac = self._actor_classes[conn].get(data["id"])
+        if ac is None:
+            raise rpc.RpcError(
+                f"client actor class {data['id']!r} is not registered on "
+                f"this connection (reconnect re-registers classes)")
         if data.get("options"):
             ac = ac.options(**data["options"])
         args = _unpickle_with_refs(data["args"], self._refs[conn])
@@ -141,7 +158,11 @@ class ClientService:
         return {"actor_id": handle.actor_id.binary()}
 
     async def handle_actor_call(self, conn, data) -> Dict[str, Any]:
-        handle = self._actors[conn][data["actor_id"]]
+        handle = self._actors[conn].get(data["actor_id"])
+        if handle is None:
+            raise rpc.RpcError(
+                f"actor {data['actor_id'].hex()} unknown on this "
+                f"connection (killed or from another session)")
         method = getattr(handle, data["method"])
         args = _unpickle_with_refs(data["args"], self._refs[conn])
         kwargs = _unpickle_with_refs(data["kwargs"], self._refs[conn])
