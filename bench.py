@@ -136,28 +136,22 @@ def bench_long_context() -> dict:
 
 
 def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
-    """Task-throughput microbenchmark (reference ``ray microbenchmark``,
-    BASELINE.md single-client async tasks: 10,905/s)."""
+    """Runtime microbenchmarks covering every BASELINE.md row the
+    reference's ``ray microbenchmark`` publishes: task throughput
+    (sync/async, single/multi client), actor calls (1:1 sync/async,
+    n:n), object-store put/get ops and put Gbps, and placement-group
+    create+remove rate."""
+    import numpy as np
+
     import ray_tpu
 
     out: dict = {}
     try:
-        ray_tpu.init(num_cpus=4,
-                     object_store_memory=512 * 1024 * 1024)
+        ray_tpu.init(object_store_memory=2 * 1024 * 1024 * 1024)
 
         @ray_tpu.remote(num_cpus=0)
         def nop():
             return None
-
-        # warm the worker pool
-        ray_tpu.get([nop.remote() for _ in range(100)], timeout=60)
-        t0 = time.perf_counter()
-        n = 2000
-        refs = [nop.remote() for _ in range(n)]
-        ray_tpu.get(refs, timeout=budget_s)
-        elapsed = time.perf_counter() - t0
-        out["tasks_per_sec_async"] = n / elapsed
-        out["vs_ref_single_client_async"] = (n / elapsed) / 10905.0
 
         @ray_tpu.remote(num_cpus=0)
         class Counter:
@@ -168,15 +162,100 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
                 self.x += 1
                 return self.x
 
+        @ray_tpu.remote(num_cpus=0)
+        class Caller:
+            """Drives task/actor bursts from inside the cluster."""
+
+            def do_tasks(self, n):
+                ray_tpu.get([nop.remote() for _ in range(n)])
+                return n
+
+            def do_actor_calls(self, handle, n):
+                ray_tpu.get([handle.incr.remote() for _ in range(n)])
+                return n
+
+        # warm the worker pool
+        ray_tpu.get([nop.remote() for _ in range(200)], timeout=60)
+
+        def rate(fn, n, reps=1):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn()
+            return n * reps / (time.perf_counter() - t0)
+
+        # -- tasks ----------------------------------------------------
+        out["tasks_per_sec_sync"] = rate(
+            lambda: ray_tpu.get(nop.remote(), timeout=30), 1, reps=300)
+        out["tasks_per_sec_async"] = rate(
+            lambda: ray_tpu.get([nop.remote() for _ in range(1000)],
+                                timeout=budget_s), 1000, reps=3)
+        out["vs_ref_single_client_async"] = \
+            out["tasks_per_sec_async"] / 10905.0
+        callers = [Caller.remote() for _ in range(4)]
+        ray_tpu.get([c.do_tasks.remote(10) for c in callers], timeout=60)
+        out["multi_client_tasks_per_sec_async"] = rate(
+            lambda: ray_tpu.get([c.do_tasks.remote(250) for c in callers],
+                                timeout=budget_s), 1000, reps=3)
+
+        # -- actor calls ----------------------------------------------
         counter = Counter.remote()
         ray_tpu.get(counter.incr.remote(), timeout=30)
+        out["actor_calls_per_sec_sync"] = rate(
+            lambda: ray_tpu.get(counter.incr.remote(), timeout=30), 1,
+            reps=300)
+        out["actor_calls_per_sec_async"] = rate(
+            lambda: ray_tpu.get(
+                [counter.incr.remote() for _ in range(1000)],
+                timeout=budget_s), 1000, reps=3)
+        out["vs_ref_1_1_actor_async"] = \
+            out["actor_calls_per_sec_async"] / 5770.0
+        targets = [Counter.remote() for _ in range(4)]
+        ray_tpu.get([t.incr.remote() for t in targets], timeout=30)
+        out["n_n_actor_calls_per_sec_async"] = rate(
+            lambda: ray_tpu.get(
+                [c.do_actor_calls.remote(t, 250)
+                 for c, t in zip(callers, targets)], timeout=budget_s),
+            1000, reps=3)
+
+        # -- object store ---------------------------------------------
+        small = b"x" * 1024
+        out["put_small_per_sec"] = rate(
+            lambda: ray_tpu.put(small), 1, reps=1000)
+        ref_small = ray_tpu.put(small)
+        out["get_small_per_sec"] = rate(
+            lambda: ray_tpu.get(ref_small), 1, reps=1000)
+        big = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+        gbits = big.nbytes * 8 / 1e9
+        out["put_gbps_single_client"] = gbits * rate(
+            lambda: ray_tpu.put(big), 1, reps=8)
+
+        @ray_tpu.remote(num_cpus=0)
+        class Putter:
+            def put_big(self, mb):
+                import numpy as _np
+
+                import ray_tpu as _rt
+                data = _np.zeros(mb * 1024 * 1024, dtype=_np.uint8)
+                _rt.put(data)
+                return mb
+
+        putters = [Putter.remote() for _ in range(4)]
+        ray_tpu.get([p.put_big.remote(1) for p in putters], timeout=60)
         t0 = time.perf_counter()
-        n = 2000
-        ray_tpu.get([counter.incr.remote() for _ in range(n)],
+        ray_tpu.get([p.put_big.remote(64) for p in putters],
                     timeout=budget_s)
-        elapsed = time.perf_counter() - t0
-        out["actor_calls_per_sec_async"] = n / elapsed
-        out["vs_ref_1_1_actor_async"] = (n / elapsed) / 5770.0
+        out["put_gbps_multi_client"] = 4 * gbits / (
+            time.perf_counter() - t0)
+
+        # -- placement groups -----------------------------------------
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+
+        def pg_cycle():
+            pg = placement_group([{"CPU": 0.01}])
+            pg.wait(30)
+            remove_placement_group(pg)
+        out["pg_create_remove_per_sec"] = rate(pg_cycle, 1, reps=100)
     except Exception as e:  # noqa: BLE001 — benchmark must always report
         out["runtime_bench_error"] = f"{type(e).__name__}: {e}"
     finally:
@@ -189,6 +268,81 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
     return out
 
 
+def bench_cluster_scale(budget_s: float = 120.0) -> dict:
+    """Reduced-scale many_tasks / many_actors / many_pgs over a
+    multi-node virtual cluster (parity: reference release/benchmarks —
+    BASELINE.md's 64-node envelope rows, shrunk to one machine)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    out: dict = {}
+    c = None
+    try:
+        c = Cluster(initialize_head=True,
+                    head_node_args={"num_cpus": 4})
+        for _ in range(3):  # 4 nodes total
+            c.add_node(num_cpus=4)
+        c.connect()
+        c.wait_for_nodes()
+
+        @ray_tpu.remote(num_cpus=0.01)
+        def nop():
+            return None
+
+        @ray_tpu.remote(num_cpus=0.01)
+        class A:
+            def ping(self):
+                return 1
+
+        # many_tasks: end-to-end completion of a burst across nodes
+        ray_tpu.get([nop.remote() for _ in range(100)], timeout=60)
+        n = 2000
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=budget_s)
+        out["many_tasks_per_sec_4node"] = n / (time.perf_counter() - t0)
+
+        # many_actors: creation-to-ready rate
+        n_actors = 100
+        t0 = time.perf_counter()
+        actors = [A.remote() for _ in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=budget_s)
+        out["many_actors_per_sec_4node"] = n_actors / (
+            time.perf_counter() - t0)
+        out["vs_ref_many_actors"] = \
+            out["many_actors_per_sec_4node"] / 600.4
+        for a in actors:
+            ray_tpu.kill(a)
+
+        # many_pgs: create N groups, then remove them
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+        n_pgs = 100
+        t0 = time.perf_counter()
+        pgs = [placement_group([{"CPU": 0.01}]) for _ in range(n_pgs)]
+        for pg in pgs:
+            pg.wait(30)
+        out["many_pgs_per_sec_4node"] = n_pgs / (
+            time.perf_counter() - t0)
+        out["vs_ref_many_pgs"] = out["many_pgs_per_sec_4node"] / 16.8
+        for pg in pgs:
+            remove_placement_group(pg)
+    except Exception as e:  # noqa: BLE001
+        out["cluster_scale_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        if c is not None:
+            try:
+                c.shutdown()
+            except Exception:
+                pass
+    return out
+
+
 def main() -> None:
     model_stats = bench_gpt2()
     details = dict(model_stats)
@@ -198,6 +352,7 @@ def main() -> None:
         details["long_context_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("RAY_TPU_BENCH_RUNTIME", "1") != "0":
         details.update(bench_runtime_tasks())
+        details.update(bench_cluster_scale())
     result = {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
         "value": round(model_stats["tokens_per_sec_per_chip"], 2),

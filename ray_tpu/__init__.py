@@ -118,7 +118,8 @@ def init(address: Optional[str] = None, *,
             if num_tpus is not None:
                 res["TPU"] = float(num_tpus)
             _head_proc, handshake = node_mod.spawn_head(
-                config, session_dir, res or None)
+                config, session_dir, res or None,
+                die_with_parent=node_mod.safe_die_with_parent())
             _owns_head = True
         else:
             host, port = address.rsplit(":", 1)
@@ -201,11 +202,8 @@ def shutdown() -> None:
         # retire any serve router poll thread bound to this cluster
         import sys as _sys
         _serve = _sys.modules.get("ray_tpu.serve")
-        if _serve is not None and getattr(_serve, "_router", None) is not None:
-            with _serve._router_lock:
-                if _serve._router is not None:
-                    _serve._router.stop()
-                _serve._router = None
+        if _serve is not None:
+            _serve._stop_router()
         core = _worker_mod.global_worker_or_none()
         if core is not None:
             core.shutdown()

@@ -50,7 +50,8 @@ class Cluster:
             args = dict(head_node_args or {})
             self._head_resources = self._resources_from_args(args)
             proc, handshake = node_mod.spawn_head(
-                self.config, self.session_dir, self._head_resources)
+                self.config, self.session_dir, self._head_resources,
+                die_with_parent=node_mod.safe_die_with_parent())
             self.head = ClusterNode(proc, handshake)
         if connect:
             self.connect()
@@ -68,7 +69,8 @@ class Cluster:
         # the port releases when the process dies; rebind it explicitly
         proc, handshake = node_mod.spawn_head(
             self.config, self.session_dir, self._head_resources,
-            gcs_port=gcs_port)
+            gcs_port=gcs_port,
+            die_with_parent=node_mod.safe_die_with_parent())
         self.head = ClusterNode(proc, handshake)
         # wait for the side raylets to re-register
         deadline = _time.monotonic() + wait_s
@@ -111,7 +113,8 @@ class Cluster:
         assert self.head is not None, "cluster has no head"
         resources = self._resources_from_args(args)
         proc, handshake = node_mod.spawn_node(
-            self.config, self.session_dir, self.gcs_address, resources)
+            self.config, self.session_dir, self.gcs_address, resources,
+            die_with_parent=node_mod.safe_die_with_parent())
         node = ClusterNode(proc, handshake)
         self.worker_nodes.append(node)
         return node

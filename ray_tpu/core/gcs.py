@@ -43,6 +43,8 @@ class NodeInfo:
     load: int = 0
     # queued resource shapes (autoscaler demand signal)
     pending_demand: List[Dict[str, float]] = field(default_factory=list)
+    # per-node reporter payload: cpu/mem + per-worker process stats
+    stats: Dict[str, Any] = field(default_factory=dict)
 
 
 ACTOR_PENDING = "PENDING_CREATION"
@@ -91,10 +93,23 @@ class GcsServer:
     """All GCS tables + managers in one asyncio service."""
 
     def __init__(self, config: Config, host: str = "127.0.0.1",
-                 port: int = 0, snapshot_path: Optional[str] = None):
+                 port: int = 0, snapshot_path: Optional[str] = None,
+                 session_dir: Optional[str] = None):
         self.config = config
         self.server = rpc.Server(self, host=host, port=port)
         self.pool = rpc.ConnectionPool()
+        # structured events (parity: src/ray/util/event.h + the
+        # dashboard event module): own emissions + pushes from every
+        # process land in one ring buffer behind list_events
+        from ray_tpu.util import event as event_mod
+        self._event_mod = event_mod
+        event_mod.init("GCS", session_dir)
+        from collections import deque as _deque
+        self._events: "_deque" = _deque(maxlen=10000)
+        # versioned resource-view broadcast (ray_syncer equivalent)
+        self._sync_version = 0
+        self._sync_dirty: set = set()
+        self._sync_task: Optional[asyncio.Task] = None
         # tables
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
@@ -145,6 +160,10 @@ class GcsServer:
         # (parity: reference GcsTableStorage persists the PG table too)
         for pg_id, info in snap.get("placement_groups", {}).items():
             info.scheduling = False
+            # retry_at is a monotonic timestamp from the previous boot —
+            # meaningless now; reset so pending groups reschedule promptly
+            info.retry_at = 0.0
+            info.retry_backoff = 0.5
             self.placement_groups[pg_id] = info
         logger.info(
             "GCS restored from snapshot: %d kv namespaces, %d functions, "
@@ -189,10 +208,59 @@ class GcsServer:
         self._pg_retry_task = asyncio.get_running_loop().create_task(
             self._pg_retry_loop()
         )
+        self._sync_task = asyncio.get_running_loop().create_task(
+            self._resource_sync_loop()
+        )
+        if getattr(self.config, "event_stats", True):
+            from ray_tpu.util.event_stats import HandlerStats, LoopMonitor
+            self.server.handler_stats = HandlerStats()
+            self._loop_monitor = LoopMonitor("gcs",
+                                             self.server.handler_stats)
+            self._loop_monitor.start()
         logger.info("GCS listening on %s", address)
         return address
 
+    async def handle_debug_state(self, conn, data):
+        """Event-loop lag + per-handler timing snapshot (parity: the
+        reference's event_stats / debug_state.txt dump)."""
+        mon = getattr(self, "_loop_monitor", None)
+        return mon.snapshot() if mon is not None else {}
+
+    # -- versioned resource broadcast (parity: ray_syncer.h:27-60 —
+    # batched, versioned snapshots of per-node resource views instead of
+    # every raylet polling the full node table each heartbeat) ---------
+    def _mark_sync_dirty(self, node_id: NodeID) -> None:
+        self._sync_dirty.add(node_id)
+
+    def _node_view_entry(self, info: "NodeInfo") -> Dict[str, Any]:
+        return {
+            "node_id": info.node_id.binary(),
+            "address": info.raylet_address,
+            "alive": info.alive,
+            "resources_total": info.resources_total,
+            "resources_available": info.resources_available,
+            "topology": info.topology,
+            "load": info.load,
+        }
+
+    async def _resource_sync_loop(self) -> None:
+        period = getattr(self.config, "resource_broadcast_period_s", 0.1)
+        while True:
+            await asyncio.sleep(period)
+            if not self._sync_dirty:
+                continue
+            dirty, self._sync_dirty = self._sync_dirty, set()
+            self._sync_version += 1
+            entries = [self._node_view_entry(self.nodes[nid])
+                       for nid in dirty if nid in self.nodes]
+            self.publish("resource_view", {
+                "version": self._sync_version,
+                "nodes": entries,
+            })
+
     async def stop(self) -> None:
+        if getattr(self, "_sync_task", None):
+            self._sync_task.cancel()
         if self._health_task:
             self._health_task.cancel()
         if self._pg_retry_task:
@@ -237,6 +305,12 @@ class GcsServer:
     # node membership + health (GcsNodeManager / GcsHealthCheckManager)
     # ------------------------------------------------------------------
     async def handle_register_node(self, conn, data):
+        peer_proto = data.get("protocol_version", rpc.PROTOCOL_VERSION)
+        if peer_proto != rpc.PROTOCOL_VERSION:
+            raise rpc.RpcError(
+                f"wire protocol mismatch: node speaks v{peer_proto}, "
+                f"GCS speaks v{rpc.PROTOCOL_VERSION} — upgrade the "
+                f"older side")
         node_id = NodeID(data["node_id"])
         info = NodeInfo(
             node_id=node_id,
@@ -250,6 +324,7 @@ class GcsServer:
         conn.context["node_id"] = node_id
         self.publish("nodes", {"event": "alive", "node_id": node_id.binary(),
                                "address": info.raylet_address})
+        self._mark_sync_dirty(node_id)
         logger.info("node %s registered: %s", node_id.hex()[:12], info.resources_total)
         return {"config": self.config.to_json()}
 
@@ -262,6 +337,9 @@ class GcsServer:
         info.resources_available = dict(data["resources_available"])
         info.load = data.get("load", 0)
         info.pending_demand = list(data.get("pending_demand", []))
+        if data.get("node_stats"):
+            info.stats = data["node_stats"]
+        self._mark_sync_dirty(node_id)
         return {"acked": True}
 
     async def handle_get_cluster_load(self, conn, data):
@@ -295,6 +373,7 @@ class GcsServer:
                 "resources_available": n.resources_available,
                 "topology": n.topology,
                 "load": n.load,
+                "stats": n.stats,
             }
             for n in self.nodes.values()
         ]
@@ -304,6 +383,22 @@ class GcsServer:
         self._mark_node_dead(node_id, data.get("reason", "drained"))
         return True
 
+    def _emit_event(self, severity: str, label: str, message: str,
+                    **fields: Any) -> None:
+        self._events.append(
+            self._event_mod.emit(severity, label, message, **fields))
+
+    def push_cluster_events(self, conn, record) -> None:
+        """Event records pushed by raylets/workers (see util/event.py)."""
+        self._events.append(record)
+
+    async def handle_list_events(self, conn, data):
+        severity = (data or {}).get("severity")
+        limit = (data or {}).get("limit", 1000)
+        out = [e for e in self._events
+               if severity is None or e.get("severity") == severity]
+        return out[-limit:]
+
     def _mark_node_dead(self, node_id: NodeID, reason: str) -> None:
         info = self.nodes.get(node_id)
         if info is None or not info.alive:
@@ -312,6 +407,10 @@ class GcsServer:
         info.resources_available = {}
         self._node_conns.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id.hex()[:12], reason)
+        self._mark_sync_dirty(node_id)
+        self._emit_event("ERROR", "NODE_DEAD",
+                         f"node {node_id.hex()[:12]} dead: {reason}",
+                         node_id=node_id.hex())
         self.publish("nodes", {"event": "dead", "node_id": node_id.binary(),
                                "address": info.raylet_address})
         # fail actors on the node (restart if budget remains)
@@ -676,11 +775,20 @@ class GcsServer:
             logger.info("restarting actor %s (%d/%d): %s",
                         actor_id.hex()[:12], info.num_restarts,
                         info.max_restarts, reason)
+            self._emit_event(
+                "WARNING", "ACTOR_RESTARTING",
+                f"actor {actor_id.hex()[:12]} restarting "
+                f"({info.num_restarts}/{info.max_restarts}): {reason}",
+                actor_id=actor_id.hex(), class_name=info.class_name)
             asyncio.get_running_loop().create_task(self._schedule_actor(info))
         else:
             info.state = ACTOR_DEAD
             info.death_cause = reason
             info.address = None
+            self._emit_event(
+                "ERROR", "ACTOR_DEAD",
+                f"actor {actor_id.hex()[:12]} dead: {reason}",
+                actor_id=actor_id.hex(), class_name=info.class_name)
             self._publish_actor(info)
             if info.name is not None:
                 self.named_actors.pop((info.namespace, info.name), None)
@@ -789,6 +897,7 @@ class GcsServer:
             return
         pg.state = state
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": state})
+        self._schedule_persist()
 
     async def _return_bundles(self, pg: PlacementGroupInfo,
                               targets: List[Tuple[int, "NodeInfo"]]) -> None:
@@ -868,6 +977,7 @@ class GcsServer:
                      {"state": pg.state,
                       "bundle_nodes": {i: n.binary()
                                        for i, n in pg.bundle_nodes.items()}})
+        self._schedule_persist()
 
     def _plan_bundles(self, pg: PlacementGroupInfo
                       ) -> Optional[Dict[int, NodeInfo]]:

@@ -96,7 +96,8 @@ async def run_head(config: Config, session_dir: str,
     # test_gcs_fault_tolerance.py); the snapshot lives in the session dir
     gcs = GcsServer(config, host=host, port=gcs_port,
                     snapshot_path=os.path.join(session_dir,
-                                               "gcs_snapshot.pkl"))
+                                               "gcs_snapshot.pkl"),
+                    session_dir=session_dir)
     gcs_address = await gcs.start()
     merged = dict(resources or {})
     for k, v in detect_tpu_resources().items():
@@ -150,9 +151,35 @@ async def run_node(config: Config, gcs_address: Tuple[str, int],
     await raylet.stop()
 
 
+def safe_die_with_parent() -> bool:
+    """PDEATHSIG fires when the spawning THREAD exits, not the process
+    (man prctl) — only arm it when spawning from the main thread, else a
+    driver calling init() from a short-lived worker thread would have its
+    cluster SIGTERMed when that thread finishes."""
+    import threading
+
+    return threading.current_thread() is threading.main_thread()
+
+
+def preexec_die_with_parent():
+    """preexec_fn: SIGTERM this child when its parent dies (Linux
+    PR_SET_PDEATHSIG).  Driver-owned clusters must not orphan their head
+    when the driver is SIGKILLed; CLI-started daemons do NOT use this
+    (a ``ray-tpu start`` cluster outlives the CLI process).  Callers
+    must gate on :func:`safe_die_with_parent`."""
+    try:
+        import ctypes
+        import signal as sig
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, sig.SIGTERM)  # PR_SET_PDEATHSIG = 1
+    except Exception:  # non-Linux: best effort only
+        pass
+
+
 def spawn_head(config: Config, session_dir: str,
                resources: Optional[Dict[str, float]] = None,
-               gcs_port: int = 0,
+               gcs_port: int = 0, die_with_parent: bool = False,
                ) -> Tuple[subprocess.Popen, Dict[str, Any]]:
     """Spawn the head node subprocess; returns (proc, handshake)."""
     handshake = os.path.join(session_dir, "head_handshake.json")
@@ -167,13 +194,14 @@ def spawn_head(config: Config, session_dir: str,
         cmd += ["--resources", json.dumps(resources)]
     if gcs_port:
         cmd += ["--gcs-port", str(gcs_port)]
-    proc = _spawn(cmd, session_dir, "head")
+    proc = _spawn(cmd, session_dir, "head", die_with_parent)
     return proc, _await_handshake(proc, handshake)
 
 
 def spawn_node(config: Config, session_dir: str,
                gcs_address: Tuple[str, int],
                resources: Optional[Dict[str, float]] = None,
+               die_with_parent: bool = False,
                ) -> Tuple[subprocess.Popen, Dict[str, Any]]:
     handshake = os.path.join(
         session_dir, f"node_handshake_{uuid.uuid4().hex[:8]}.json")
@@ -185,11 +213,12 @@ def spawn_node(config: Config, session_dir: str,
            "--config", config.to_json()]
     if resources is not None:
         cmd += ["--resources", json.dumps(resources)]
-    proc = _spawn(cmd, session_dir, "node")
+    proc = _spawn(cmd, session_dir, "node", die_with_parent)
     return proc, _await_handshake(proc, handshake)
 
 
-def _spawn(cmd, session_dir: str, tag: str) -> subprocess.Popen:
+def _spawn(cmd, session_dir: str, tag: str,
+           die_with_parent: bool = False) -> subprocess.Popen:
     log_base = os.path.join(session_dir, "logs",
                             f"{tag}-{uuid.uuid4().hex[:8]}")
     out = open(log_base + ".out", "ab")
@@ -197,8 +226,9 @@ def _spawn(cmd, session_dir: str, tag: str) -> subprocess.Popen:
     env = dict(os.environ)
     # node daemons never need an accelerator
     env["JAX_PLATFORMS"] = "cpu"
-    proc = subprocess.Popen(cmd, stdout=out, stderr=err, env=env,
-                            cwd=os.getcwd())
+    proc = subprocess.Popen(
+        cmd, stdout=out, stderr=err, env=env, cwd=os.getcwd(),
+        preexec_fn=preexec_die_with_parent if die_with_parent else None)
     proc._rtpu_err_path = log_base + ".err"  # for handshake diagnostics
     return proc
 

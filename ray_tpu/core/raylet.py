@@ -164,12 +164,33 @@ class Raylet:
         reply = await self.gcs_conn.call("register_node", {
             "node_id": self.node_id.binary(),
             "raylet_address": address,
+            "protocol_version": rpc.PROTOCOL_VERSION,
             "resources": self.resources_total,
             "topology": self.topology,
         })
         # adopt the cluster-wide config decided by the head node
         self.config = Config.from_json(reply["config"])
         loop = asyncio.get_running_loop()
+        from ray_tpu.util import event as event_mod
+        self._event_mod = event_mod
+        event_mod.init("RAYLET", self.session_dir, gcs_conn=self.gcs_conn,
+                       loop=loop)
+        # versioned resource-view subscription (parity: ray_syncer —
+        # delta broadcasts replace per-beat full-table polling)
+        self._view_by_id: Dict[bytes, Dict[str, Any]] = {}
+        self._view_version = 0
+        self._view_stale = True
+        self._view_subscribed = False
+        self.gcs_conn.set_push_handler(self._on_gcs_push)
+        await self.gcs_conn.call("subscribe", {"channel": "resource_view"})
+        self._view_subscribed = True
+        if getattr(self.config, "event_stats", True):
+            from ray_tpu.util.event_stats import HandlerStats, LoopMonitor
+            self.server.handler_stats = HandlerStats()
+            self._loop_monitor = LoopMonitor(
+                f"raylet-{self.node_id.hex()[:8]}",
+                self.server.handler_stats)
+            self._loop_monitor.start()
         self._tasks.append(loop.create_task(self._health_loop()))
         self._tasks.append(loop.create_task(self._reap_loop()))
         self._tasks.append(loop.create_task(self._log_monitor_loop()))
@@ -199,6 +220,30 @@ class Raylet:
         self.pool.close_all()
         self.store.close()
 
+    def _on_gcs_push(self, channel: str, data: Any) -> None:
+        if channel != "resource_view":
+            return
+        version = data.get("version", 0)
+        if self._view_stale or version != self._view_version + 1:
+            # gap (missed a broadcast, or fresh connection): resync with
+            # one full fetch — the syncer contract (versioned deltas +
+            # snapshot-on-gap, ray_syncer.h)
+            self._view_version = version
+            self._view_stale = True
+            return
+        self._view_version = version
+        for entry in data.get("nodes", []):
+            self._view_by_id[bytes(entry["node_id"])] = entry
+        self._cluster_view = list(self._view_by_id.values())
+        self._maybe_schedule()  # fresh capacity may unblock queued work
+
+    async def _resync_view(self) -> None:
+        view = await self.gcs_conn.call("get_nodes", {}, timeout=5.0)
+        self._view_by_id = {bytes(n["node_id"]): n for n in view}
+        self._cluster_view = list(self._view_by_id.values())
+        self._view_stale = False
+        self._maybe_schedule()
+
     async def _health_loop(self) -> None:
         while not self._closing:
             try:
@@ -210,14 +255,32 @@ class Raylet:
                     # resource_load_by_shape in the reference's syncer)
                     "pending_demand": [lease.resources for lease in
                                        self._pending_leases[:100]],
+                    # per-node reporter payload (parity:
+                    # dashboard/modules/reporter) — node cpu/mem plus
+                    # per-worker cpu%/rss
+                    "node_stats": self._collect_node_stats(),
                 }, timeout=5.0)
                 if not reply.get("acked"):
                     logger.error("GCS rejected health report; exiting raylet")
                     break
-                view = await self.gcs_conn.call("get_nodes", {}, timeout=5.0)
-                self._cluster_view = view
+                if not self._view_subscribed:
+                    # a re-register's subscribe failed: retry every beat
+                    # (without the subscription the view would freeze on
+                    # its last snapshot forever)
+                    try:
+                        await self.gcs_conn.call(
+                            "subscribe", {"channel": "resource_view"},
+                            timeout=5.0)
+                        self._view_subscribed = True
+                        self._view_stale = True  # catch missed deltas
+                    except (rpc.ConnectionLost, rpc.RpcError,
+                            asyncio.TimeoutError):
+                        pass
+                if self._view_stale:
+                    # deltas flow via the resource_view subscription; a
+                    # full fetch happens only on startup or version gap
+                    await self._resync_view()
                 self._gcs_misses = 0
-                self._maybe_schedule()  # fresh view may unblock queued work
             except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
                 if self._closing:
                     break
@@ -245,12 +308,23 @@ class Raylet:
             reply = await conn.call("register_node", {
                 "node_id": self.node_id.binary(),
                 "raylet_address": list(self.address),
+                "protocol_version": rpc.PROTOCOL_VERSION,
                 "resources": self.resources_total,
                 "topology": self.topology,
             }, timeout=5.0)
             if self.gcs_conn is not None:
                 self.gcs_conn.close()
             self.gcs_conn = conn
+            conn.set_push_handler(self._on_gcs_push)
+            self._view_stale = True
+            self._view_subscribed = False
+            try:
+                await conn.call("subscribe", {"channel": "resource_view"},
+                                timeout=5.0)
+                self._view_subscribed = True
+            except (rpc.ConnectionLost, rpc.RpcError,
+                    asyncio.TimeoutError):
+                pass  # the health loop retries each beat
             logger.info("raylet %s re-registered with restarted GCS",
                         self.node_id.hex()[:12])
             return bool(reply)
@@ -299,6 +373,39 @@ class Raylet:
                 return max(group, key=lambda w: w.lease_granted_at)
         return None
 
+    def _collect_node_stats(self) -> Dict[str, Any]:
+        """Node + per-worker process stats (parity: the reference's
+        dashboard reporter agent collecting psutil stats per node)."""
+        try:
+            import psutil
+        except ImportError:
+            return {}
+        try:
+            vm = psutil.virtual_memory()
+            stats: Dict[str, Any] = {
+                "cpu_percent": psutil.cpu_percent(interval=None),
+                "mem_percent": vm.percent,
+                "mem_used": int(vm.used),
+                "mem_total": int(vm.total),
+                "workers": [],
+            }
+            for w in list(self.workers.values()):
+                try:
+                    p = psutil.Process(w.pid)
+                    with p.oneshot():
+                        stats["workers"].append({
+                            "pid": w.pid,
+                            "worker_id": w.worker_id.hex(),
+                            "cpu_percent": p.cpu_percent(interval=None),
+                            "rss": int(p.memory_info().rss),
+                            "is_actor": bool(w.is_actor),
+                        })
+                except (psutil.NoSuchProcess, psutil.AccessDenied):
+                    pass
+            return stats
+        except Exception:  # noqa: BLE001 — stats must never hurt health
+            return {}
+
     async def _memory_monitor_loop(self) -> None:
         period = self.config.memory_monitor_refresh_ms / 1000.0
         threshold = self.config.memory_usage_threshold
@@ -317,6 +424,11 @@ class Raylet:
                     "retried", used * 100, threshold * 100,
                     victim.worker_id.hex()[:12], victim.pid)
                 victim.proc.kill()
+                self._event_mod.emit(
+                    "ERROR", "OOM_KILL",
+                    f"memory monitor killed worker pid {victim.pid} at "
+                    f"{used:.0%} used", node_id=self.node_id.hex(),
+                    worker_id=victim.worker_id.hex(), pid=victim.pid)
                 self._on_worker_dead(
                     victim, f"killed by memory monitor at "
                             f"{used:.0%} used")
@@ -431,8 +543,17 @@ class Raylet:
             cmd += ["--job-id", job_id_bin.hex()]
         out = open(log_base + ".out", "ab")
         err = open(log_base + ".err", "ab")
-        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=err,
-                                cwd=os.getcwd())
+        from ray_tpu.core.node import (preexec_die_with_parent,
+                                       safe_die_with_parent)
+
+        # workers die with their raylet (a worker without its raylet is
+        # unreachable; reference workers exit on raylet death).  The
+        # raylet loop runs on the process main thread, so the PDEATHSIG
+        # thread caveat doesn't bite; gate anyway for exotic embeddings.
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=out, stderr=err, cwd=os.getcwd(),
+            preexec_fn=preexec_die_with_parent
+            if safe_die_with_parent() else None)
         # log monitor maps these files to the worker pid for prefixes
         self._log_pids[log_base + ".out"] = proc.pid
         self._log_pids[log_base + ".err"] = proc.pid
@@ -813,6 +934,11 @@ class Raylet:
     # state API (per-node sources; parity: raylet handlers behind
     # StateDataSourceClient state_manager.py:130)
     # ------------------------------------------------------------------
+    async def handle_debug_state(self, conn, data):
+        """Event-loop lag + per-handler timings (event_stats parity)."""
+        mon = getattr(self, "_loop_monitor", None)
+        return mon.snapshot() if mon is not None else {}
+
     async def handle_list_workers(self, conn, data):
         return [{"worker_id": w.worker_id.hex(), "pid": w.pid,
                  "leased": w.leased, "is_actor": w.is_actor,

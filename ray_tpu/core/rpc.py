@@ -22,6 +22,11 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+#: Wire-protocol version (parity: the reference's versioned protobuf
+#: schemas).  Carried in node/job registration handshakes; the GCS
+#: rejects mismatched peers instead of failing obscurely mid-stream.
+PROTOCOL_VERSION = 1
+
 _LEN = struct.Struct("<Q")
 
 KIND_REQ = 0
@@ -137,7 +142,14 @@ class Connection:
         if self._closed:
             return
         self._closed = True
-        self._wbuf.clear()
+        if self._wbuf:
+            # hand already-queued frames (e.g. a reply written this tick)
+            # to the transport so writer.close() can flush them
+            try:
+                self._writer.write(b"".join(self._wbuf))
+            except Exception:
+                pass
+            self._wbuf.clear()
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost())
@@ -221,6 +233,11 @@ class Server:
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
         self.connections: set[Connection] = set()
+        #: optional HandlerStats (util/event_stats.py) — when set, every
+        #: dispatched handler records its wall duration (parity:
+        #: instrumented_io_context handler stats).  Wall time includes
+        #: awaits, so long-poll methods legitimately read "slow".
+        self.handler_stats = None
 
     async def start(self) -> Address:
         self._server = await asyncio.start_server(
@@ -255,7 +272,16 @@ class Server:
         )
         if handler is None:
             raise RpcError(f"{type(self._service).__name__} has no method {method}")
-        return await handler(conn, data)
+        stats = self.handler_stats
+        if stats is None:
+            return await handler(conn, data)
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            return await handler(conn, data)
+        finally:
+            stats.record(method, _time.monotonic() - t0)
 
     def dispatch_push(self, conn: Connection, channel: str, data: Any) -> None:
         handler = getattr(self._service, f"push_{channel}", None)

@@ -850,7 +850,11 @@ class CoreWorker:
             try:
                 self._loop.call_soon_threadsafe(self._drain_submit_queue)
             except (RuntimeError, AttributeError):
-                self._submit_drain_scheduled = False  # loop torn down
+                # loop torn down: surface it — swallowing would hand the
+                # caller ObjectRefs that can never resolve
+                self._submit_drain_scheduled = False
+                raise RayTpuError(
+                    "cannot submit task: the runtime is shut down")
 
     def _drain_submit_queue(self) -> None:
         # flag cleared BEFORE draining (same protocol as _drain_gc_releases)
@@ -865,24 +869,22 @@ class CoreWorker:
             if spec.task_type == TaskType.ACTOR_TASK:
                 self._enqueue_actor_task(spec)
                 continue
-            key = spec.scheduling_key()
-            state = self._lease_states.get(key)
-            if state is None:
-                state = _LeaseState(key)
-                self._lease_states[key] = state
-            state.backlog.append(spec)
-            touched[key] = state
+            state = self._backlog_enqueue(spec)
+            touched[state.key] = state
         for state in touched.values():
             self._pump_lease_queue(state)
 
-    def _enqueue_for_lease(self, spec: TaskSpec) -> None:
+    def _backlog_enqueue(self, spec: TaskSpec) -> "_LeaseState":
         key = spec.scheduling_key()
         state = self._lease_states.get(key)
         if state is None:
             state = _LeaseState(key)
             self._lease_states[key] = state
         state.backlog.append(spec)
-        self._pump_lease_queue(state)
+        return state
+
+    def _enqueue_for_lease(self, spec: TaskSpec) -> None:
+        self._pump_lease_queue(self._backlog_enqueue(spec))
 
     def _pump_lease_queue(self, state: "_LeaseState") -> None:
         # Phase 1 — breadth first: one task per idle worker, so independent

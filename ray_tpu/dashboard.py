@@ -116,6 +116,14 @@ class Dashboard:
                 return {}
         return self._json(await self._state(fetch))
 
+    async def handle_events(self, request):
+        from ray_tpu.experimental.state.api import list_cluster_events
+        return self._json(await self._state(list_cluster_events))
+
+    async def handle_node_stats(self, request):
+        from ray_tpu.experimental.state.api import node_stats
+        return self._json(await self._state(node_stats))
+
     async def handle_metrics(self, request):
         from ray_tpu.core import worker as worker_mod
 
@@ -134,6 +142,8 @@ class Dashboard:
         app.router.add_get("/api/placement_groups", self.handle_pgs)
         app.router.add_get("/api/cluster_status", self.handle_cluster_status)
         app.router.add_get("/api/serve/applications", self.handle_serve)
+        app.router.add_get("/api/events", self.handle_events)
+        app.router.add_get("/api/node_stats", self.handle_node_stats)
         app.router.add_get("/metrics", self.handle_metrics)
         try:
             from ray_tpu.job.job_head import add_job_routes

@@ -9,6 +9,7 @@ from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import ActorPoolStrategy, Dataset, GroupedDataset  # noqa: F401
 from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
 from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow,
     from_huggingface,
     from_items,
     from_numpy,
@@ -17,9 +18,11 @@ from ray_tpu.data.read_api import (  # noqa: F401
     range_tensor,
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
 )
 from ray_tpu.data import preprocessors  # noqa: F401

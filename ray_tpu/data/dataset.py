@@ -35,9 +35,24 @@ def _apply_stages(block: Block, stages: List[Callable[[Block], Block]]) -> Block
     return block
 
 
-@ray_tpu.remote
-def _fused_map(block: Block, stages: List[Callable[[Block], Block]]) -> Block:
-    return _apply_stages(block, stages)
+@ray_tpu.remote(num_returns=2)
+def _fused_map_stats(block: Block, named_stages) -> Tuple[Block, list]:
+    """materialize() body: runs each fused stage under a timer and returns
+    (block, per-stage stats) as two objects so the stats travel separately
+    from the (possibly large) data (parity: data/_internal/stats.py
+    per-stage wall/mem accounting)."""
+    import time as _time
+
+    stats = []
+    for name, fn in named_stages:
+        t0 = _time.perf_counter()
+        block = fn(block)
+        acc = BlockAccessor(block)
+        stats.append({"stage": name,
+                      "wall_s": _time.perf_counter() - t0,
+                      "rows": acc.num_rows(),
+                      "bytes": acc.size_bytes()})
+    return block, stats
 
 
 @ray_tpu.remote
@@ -59,7 +74,11 @@ def _concat_task(*blocks: Block) -> Block:
 @ray_tpu.remote
 def _split_task(block: Block, bounds: List[int]) -> List[Block]:
     acc = BlockAccessor(block)
-    return [acc.slice(s, e) for s, e in zip([0] + bounds, bounds + [acc.num_rows()])]
+    parts = [acc.slice(s, e)
+             for s, e in zip([0] + bounds, bounds + [acc.num_rows()])]
+    # num_returns == len(parts): a 1-part scatter must return the part
+    # itself (num_returns=1 stores the return value verbatim)
+    return parts[0] if len(parts) == 1 else parts
 
 
 @ray_tpu.remote
@@ -71,8 +90,9 @@ def _shuffle_map(block: Block, n_reducers: int, seed: Optional[int],
     n = acc.num_rows()
     rng = np.random.default_rng(seed)
     assignment = rng.integers(0, n_reducers, size=n)
-    return [acc.take_indices(np.nonzero(assignment == r)[0])
-            for r in range(n_reducers)]
+    parts = [acc.take_indices(np.nonzero(assignment == r)[0])
+             for r in range(n_reducers)]
+    return parts[0] if n_reducers == 1 else parts
 
 
 @ray_tpu.remote
@@ -130,7 +150,7 @@ def _sort_map(block: Block, key, boundaries: np.ndarray,
     for c in list(cuts) + [acc.num_rows()]:
         parts.append(acc.slice(int(prev), int(c)))
         prev = c
-    return parts
+    return parts[0] if len(parts) == 1 else parts
 
 
 @ray_tpu.remote
@@ -158,13 +178,15 @@ def _groupby_map(block: Block, key, n_reducers: int, stages) -> List[Block]:
     block = _apply_stages(block, stages)
     acc = BlockAccessor(block)
     if acc.num_rows() == 0:
-        return [[] for _ in range(n_reducers)]
+        return [] if n_reducers == 1 else [[] for _ in range(n_reducers)]
     if acc.is_table:
         col = np.asarray(block[key])
     else:
         col = np.asarray([r[key] for r in block])
     h = np.asarray([hash(x) % n_reducers for x in col])
-    return [acc.take_indices(np.nonzero(h == r)[0]) for r in range(n_reducers)]
+    parts = [acc.take_indices(np.nonzero(h == r)[0])
+             for r in range(n_reducers)]
+    return parts[0] if n_reducers == 1 else parts
 
 
 class Dataset:
@@ -172,10 +194,14 @@ class Dataset:
 
     def __init__(self, blocks: List[ray_tpu.ObjectRef],
                  stages: Optional[List[Stage]] = None,
-                 metadata: Optional[List[Optional[BlockMetadata]]] = None):
+                 metadata: Optional[List[Optional[BlockMetadata]]] = None,
+                 stats: Optional[List[ray_tpu.ObjectRef]] = None):
         self._blocks = list(blocks)
         self._stages: List[Stage] = list(stages or [])
         self._metadata = metadata if metadata and not self._stages else None
+        # per-block stats refs from the materialize() that produced these
+        # blocks (each resolves to a list of per-stage dicts)
+        self._stats_refs = stats
 
     # ------------------------------------------------------------------
     # plan & execution
@@ -185,12 +211,15 @@ class Dataset:
 
     def materialize(self) -> "Dataset":
         """Execute pending fused stages, one task per block (parity:
-        ``ExecutionPlan.execute`` plan.py:295)."""
+        ``ExecutionPlan.execute`` plan.py:295); per-stage wall/rows/bytes
+        are recorded and surfaced by ``stats()``."""
         if not self._stages:
             return self
-        fns = [fn for _, fn in self._stages]
-        out = [_fused_map.remote(b, fns) for b in self._blocks]
-        return Dataset(out)
+        pairs = [_fused_map_stats.remote(b, self._stages)
+                 for b in self._blocks]
+        out = [p[0] for p in pairs]
+        stats = [p[1] for p in pairs]
+        return Dataset(out, stats=stats)
 
     def fully_executed(self) -> "Dataset":
         return self.materialize()
@@ -199,8 +228,37 @@ class Dataset:
         return self.materialize()._blocks
 
     def stats(self) -> str:
-        stages = " -> ".join(name for name, _ in self._stages) or "(materialized)"
-        return f"Dataset({self.num_blocks()} blocks): {stages}"
+        """Per-stage execution summary (parity: data/_internal/stats.py).
+
+        For an executed dataset, prints wall-time min/mean/max across
+        blocks plus output rows/bytes per stage; before execution, prints
+        the pending plan."""
+        if self._stats_refs is None and self._stages:
+            return ("Dataset(%d blocks, pending): %s" % (
+                self.num_blocks(),
+                " -> ".join(name for name, _ in self._stages)))
+        if not self._stats_refs:
+            return f"Dataset({self.num_blocks()} blocks): (materialized)"
+        per_block = ray_tpu.get(list(self._stats_refs))
+        by_stage: Dict[str, List[Dict[str, Any]]] = {}
+        order: List[str] = []
+        for stats in per_block:
+            for s in stats:
+                if s["stage"] not in by_stage:
+                    order.append(s["stage"])
+                by_stage.setdefault(s["stage"], []).append(s)
+        lines = [f"Dataset({self.num_blocks()} blocks) execution stats:"]
+        for name in order:
+            entries = by_stage[name]
+            walls = [e["wall_s"] for e in entries]
+            rows = sum(e["rows"] for e in entries)
+            size = sum(e["bytes"] for e in entries)
+            lines.append(
+                f"  {name}: {len(entries)} blocks, wall "
+                f"min={min(walls)*1e3:.1f}ms mean={sum(walls)/len(walls)*1e3:.1f}ms "
+                f"max={max(walls)*1e3:.1f}ms, out {rows} rows / "
+                f"{size/2**20:.2f} MiB")
+        return "\n".join(lines)
 
     # ------------------------------------------------------------------
     # transforms (lazy, fused per block)

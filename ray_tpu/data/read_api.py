@@ -1,6 +1,8 @@
 """Dataset creation APIs (parity: reference ``python/ray/data/read_api.py``
 + ``data/datasource/``).  Reads are parallel tasks, one per file/partition;
-arrow is unavailable here so tabular formats go through pandas/numpy."""
+``read_parquet`` produces Arrow blocks (zero-copy through the object
+plane); csv/json/numpy/text/tfrecords/images produce numpy-column or
+simple blocks."""
 
 from __future__ import annotations
 
@@ -58,10 +60,11 @@ def _read_numpy_file(path: str) -> Block:
 
 @ray_tpu.remote
 def _read_parquet_file(path: str, kwargs: Dict[str, Any]) -> Block:
-    import pandas as pd
+    # arrow-native (parity: datasource/parquet_datasource.py); the Table
+    # block travels the object plane with out-of-band buffers (zero-copy)
+    import pyarrow.parquet as pq
 
-    df = pd.read_parquet(path, **kwargs)  # needs a parquet engine
-    return {str(c): df[c].to_numpy() for c in df.columns}
+    return pq.read_table(path, **kwargs)
 
 
 @ray_tpu.remote
@@ -164,6 +167,156 @@ def read_binary_files(paths: Union[str, List[str]], **kwargs) -> Dataset:
     return Dataset([_read.remote(p) for p in files])
 
 
+def from_arrow(tables) -> Dataset:
+    """One block per pyarrow.Table (parity: ``from_arrow``)."""
+    if not isinstance(tables, list):
+        tables = [tables]
+    return Dataset([ray_tpu.put(t) for t in tables])
+
+
+@ray_tpu.remote
+def _read_tfrecord_file(path: str) -> Block:
+    """Parse a TFRecord file of tf.train.Example protos without a tf
+    dependency (parity: datasource/tfrecords_datasource.py).
+
+    Record framing: [8B length][4B masked-crc(length)][data]
+    [4B masked-crc(data)].  Example protos are decoded with a minimal
+    hand-rolled protobuf walk (fields: features -> feature map ->
+    bytes_list/float_list/int64_list)."""
+    rows = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = __import__("struct").unpack("<Q", header)
+            f.read(4)  # length crc
+            data = f.read(length)
+            f.read(4)  # data crc
+            rows.append(_parse_tf_example(data))
+    return build_block(rows)
+
+
+def _read_varint(buf: bytes, pos: int):
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _parse_tf_example(data: bytes) -> Dict[str, Any]:
+    """Minimal decoder for tf.train.Example: Example{1: Features},
+    Features{1: map<string, Feature>}, Feature{1: BytesList, 2: FloatList,
+    3: Int64List}."""
+    import struct as struct_mod
+
+    def parse_fields(buf):
+        pos = 0
+        while pos < len(buf):
+            tag, pos = _read_varint(buf, pos)
+            field, wire = tag >> 3, tag & 7
+            if wire == 2:  # length-delimited
+                ln, pos = _read_varint(buf, pos)
+                yield field, buf[pos:pos + ln]
+                pos += ln
+            elif wire == 0:
+                val, pos = _read_varint(buf, pos)
+                yield field, val
+            elif wire == 5:
+                yield field, buf[pos:pos + 4]
+                pos += 4
+            elif wire == 1:
+                yield field, buf[pos:pos + 8]
+                pos += 8
+            else:
+                raise ValueError(f"unsupported wire type {wire}")
+
+    row: Dict[str, Any] = {}
+    for f1, features in parse_fields(data):
+        if f1 != 1:
+            continue
+        for f2, entry in parse_fields(features):
+            if f2 != 1:
+                continue
+            name = None
+            value: Any = None
+            for fk, fv in parse_fields(entry):
+                if fk == 1:
+                    name = fv.decode()
+                elif fk == 2:
+                    for ft, payload in parse_fields(fv):
+                        if ft == 1:  # BytesList{repeated bytes value=1}
+                            vals = [v for t, v in parse_fields(payload)
+                                    if t == 1]
+                            value = vals[0] if len(vals) == 1 else vals
+                        elif ft == 2:  # FloatList{repeated float value=1}
+                            floats: List[float] = []
+                            for t, v in parse_fields(payload):
+                                if t != 1:
+                                    continue
+                                # wire 5 yields 4 bytes; packed (wire 2)
+                                # yields a multiple of 4 — same decode
+                                floats.extend(struct_mod.unpack(
+                                    f"<{len(v)//4}f", v))
+                            value = (floats[0] if len(floats) == 1
+                                     else np.asarray(floats, np.float32))
+                        elif ft == 3:  # Int64List{repeated int64 value=1}
+                            ints: List[int] = []
+                            for t, v in parse_fields(payload):
+                                if t != 1:
+                                    continue
+                                if isinstance(v, int):  # unpacked varint
+                                    ints.append(v)
+                                else:  # packed varints
+                                    p = 0
+                                    while p < len(v):
+                                        iv, p = _read_varint(v, p)
+                                        ints.append(iv)
+                            ints = [i - (1 << 64) if i >= 1 << 63 else i
+                                    for i in ints]
+                            value = (ints[0] if len(ints) == 1
+                                     else np.asarray(ints, np.int64))
+            if name is not None:
+                row[name] = value
+    return row
+
+
+def read_tfrecords(paths: Union[str, List[str]], **kwargs) -> Dataset:
+    """TFRecord files of tf.train.Example protos → one row per record
+    (parity: ``read_tfrecords``)."""
+    files = _expand_paths(paths, ".tfrecords")
+    return Dataset([_read_tfrecord_file.remote(p) for p in files])
+
+
+@ray_tpu.remote
+def _read_image_file(path: str, size, mode) -> Block:
+    from PIL import Image  # soft dep, like the reference's datasource
+
+    img = Image.open(path)
+    if mode is not None:
+        img = img.convert(mode)
+    if size is not None:
+        img = img.resize(size)
+    return {"image": np.asarray(img)[None], "path": np.asarray([path])}
+
+
+def read_images(paths: Union[str, List[str]], *, size=None, mode=None,
+                **kwargs) -> Dataset:
+    """Image files → rows of {"image": HWC array, "path"} (parity:
+    ``read_images`` / image_datasource.py)."""
+    files = _expand_paths(paths, "")
+    return Dataset([_read_image_file.remote(p, size, mode) for p in files])
+
+
 def from_huggingface(dataset) -> Dataset:
-    """Convert a datasets.Dataset (hf) via pandas."""
+    """Convert a datasets.Dataset (hf) via its arrow table when exposed,
+    else pandas."""
+    table = getattr(dataset, "data", None)
+    if table is not None and hasattr(table, "table"):
+        return from_arrow(table.table)
     return from_pandas(dataset.to_pandas())

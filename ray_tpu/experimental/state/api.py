@@ -89,6 +89,23 @@ def list_tasks(filters=None, limit: int = 1000,
     return _apply_filters(rows, filters)[:limit]
 
 
+def list_cluster_events(filters=None, limit: int = 1000,
+                        severity: Optional[str] = None
+                        ) -> List[Dict[str, Any]]:
+    """Structured cluster events (parity: reference ``ray list
+    cluster-events`` / dashboard event module; see util/event.py)."""
+    rows = _core().gcs_call("list_events",
+                            {"limit": limit, "severity": severity})
+    return _apply_filters(rows, filters)[:limit]
+
+
+def node_stats() -> List[Dict[str, Any]]:
+    """Per-node reporter payloads: cpu/mem + per-worker cpu%/rss
+    (parity: dashboard/modules/reporter)."""
+    return [{"node_id": n["node_id"], "state": n["state"],
+             **(n.get("stats") or {})} for n in list_nodes()]
+
+
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
     """{func_name: {state: count}} (reference ``ray summary tasks``)."""
     out: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))

@@ -37,6 +37,11 @@ from ray_tpu.rllib.algorithms.impala import (  # noqa: F401
     ImpalaConfig,
     ImpalaPolicy,
 )
+from ray_tpu.rllib.algorithms.maddpg import (  # noqa: F401
+    MADDPG,
+    MADDPGConfig,
+    SimpleTargetChase,
+)
 from ray_tpu.rllib.algorithms.marwil import (  # noqa: F401
     BC,
     BCConfig,
@@ -55,5 +60,6 @@ from ray_tpu.rllib.algorithms.pg import (  # noqa: F401
     PGPolicy,
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, PPOPolicy  # noqa: F401
+from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config, R2D2Policy  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACPolicy  # noqa: F401

@@ -1,0 +1,373 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Parity: reference ``rllib/algorithms/maddpg/`` — per-agent deterministic
+actors μ_i(o_i) trained through per-agent centralized critics
+Q_i(o_1..o_n, a_1..a_n) (critics see the joint observation/action, so
+the environment is stationary from each critic's view), soft target
+networks for both.  jax-native: all agents' actors and critics live in
+one param tree and train in one jitted program per step — n small
+matmuls batch into one XLA graph instead of n torch modules.
+
+Scope: continuous (Box) action spaces — the classic MADDPG setting.
+Sampling drives the env inline in ``training_step`` (cooperative team
+envs step as one unit).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env import Box, MultiAgentEnv, make_env
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.gamma = 0.95
+        self.tau = 0.05  # soft target update
+        self.train_batch_size = 64
+        self.replay_buffer_capacity = 50_000
+        self.actor_hiddens = (64, 64)
+        self.critic_hiddens = (64, 64)
+        self.exploration_noise = 0.4
+        self.num_steps_sampled_before_learning_starts = 500
+        self.rollout_episodes_per_step = 4
+        self.updates_per_step = 8
+
+    @property
+    def algo_class(self):
+        return MADDPG
+
+
+class _Actor(nn.Module):
+    act_dim: int
+    hiddens: Tuple[int, ...] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs
+        for i, h in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+        return nn.tanh(nn.Dense(self.act_dim, name="out")(x))
+
+
+class _Critic(nn.Module):
+    hiddens: Tuple[int, ...] = (64, 64)
+
+    @nn.compact
+    def __call__(self, joint_obs: jnp.ndarray,
+                 joint_act: jnp.ndarray) -> jnp.ndarray:
+        x = jnp.concatenate([joint_obs, joint_act], axis=-1)
+        for i, h in enumerate(self.hiddens):
+            x = nn.relu(nn.Dense(h, name=f"fc_{i}")(x))
+        return nn.Dense(1, name="out")(x)[..., 0]
+
+
+class _PerAgentNets(nn.Module):
+    """All agents' actors + critics in one module/param tree."""
+
+    n_agents: int
+    act_dim: int
+    actor_hiddens: Tuple[int, ...]
+    critic_hiddens: Tuple[int, ...]
+
+    def setup(self):
+        self.actors = [_Actor(self.act_dim, self.actor_hiddens)
+                       for _ in range(self.n_agents)]
+        self.critics = [_Critic(self.critic_hiddens)
+                        for _ in range(self.n_agents)]
+
+    def act(self, obs: jnp.ndarray) -> jnp.ndarray:
+        """obs [B, n, obs_dim] -> actions [B, n, act_dim]."""
+        return jnp.stack([self.actors[i](obs[:, i])
+                          for i in range(self.n_agents)], axis=1)
+
+    def critic_values(self, joint_obs: jnp.ndarray,
+                      joint_act: jnp.ndarray) -> jnp.ndarray:
+        """-> [B, n] per-agent centralized Q."""
+        return jnp.stack([self.critics[i](joint_obs, joint_act)
+                          for i in range(self.n_agents)], axis=1)
+
+    def __call__(self, obs, joint_obs, joint_act):  # init entry point
+        return self.act(obs), self.critic_values(joint_obs, joint_act)
+
+
+class SimpleTargetChase(MultiAgentEnv):
+    """Tiny continuous cooperative env for MADDPG smoke/regression runs:
+    each agent moves on a line toward its own target; shared reward is
+    the negative summed distance (cooperative; critics benefit from the
+    joint view because obs include only the own position/target)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.n = int(config.get("num_agents", 2))
+        self.horizon = int(config.get("horizon", 25))
+        self._rng = np.random.default_rng(config.get("seed"))
+        obs_space = Box(-2.0, 2.0, (2,))
+        act_space = Box(-1.0, 1.0, (1,))
+        self.observation_spaces = {i: obs_space for i in range(self.n)}
+        self.action_spaces = {i: act_space for i in range(self.n)}
+
+    def _obs(self):
+        return {i: np.asarray([self.pos[i], self.targets[i]], np.float32)
+                for i in range(self.n)}
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.pos = self._rng.uniform(-1, 1, self.n)
+        self.targets = self._rng.uniform(-1, 1, self.n)
+        self.t = 0
+        return self._obs(), {i: {} for i in range(self.n)}
+
+    def step(self, action_dict):
+        for i in range(self.n):
+            self.pos[i] = float(np.clip(
+                self.pos[i] + 0.1 * float(np.asarray(
+                    action_dict[i]).ravel()[0]), -2.0, 2.0))
+        self.t += 1
+        dist = sum(abs(self.pos[i] - self.targets[i])
+                   for i in range(self.n))
+        rew = {i: -dist / self.n for i in range(self.n)}
+        done = self.t >= self.horizon
+        terms = {i: False for i in range(self.n)}
+        terms["__all__"] = False
+        truncs = {i: done for i in range(self.n)}
+        truncs["__all__"] = done
+        return self._obs(), rew, terms, truncs, {i: {} for i in range(self.n)}
+
+
+class MADDPG(Algorithm):
+    supports_multi_agent = True
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.env = make_env(cfg["env"], dict(cfg.get("env_config", {})))
+        if not isinstance(self.env, MultiAgentEnv):
+            raise ValueError("MADDPG requires a MultiAgentEnv")
+        self.agent_ids: List[Any] = list(self.env.agent_ids)
+        n = len(self.agent_ids)
+        act_space = self.env.action_space_for(self.agent_ids[0])
+        if not isinstance(act_space, Box):
+            raise ValueError("this MADDPG supports continuous (Box) "
+                             "action spaces")
+        obs_space = self.env.observation_space_for(self.agent_ids[0])
+        self.n_agents = n
+        self.act_dim = int(np.prod(act_space.shape))
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self._act_low = np.asarray(act_space.low, np.float32)
+        self._act_high = np.asarray(act_space.high, np.float32)
+
+        self.model = _PerAgentNets(
+            n_agents=n, act_dim=self.act_dim,
+            actor_hiddens=tuple(cfg.get("actor_hiddens", (64, 64))),
+            critic_hiddens=tuple(cfg.get("critic_hiddens", (64, 64))))
+        rng = jax.random.PRNGKey(int(cfg.get("seed", 0) or 0))
+        self._rng, init_rng = jax.random.split(rng)
+        dummy_obs = jnp.zeros((1, n, self.obs_dim), jnp.float32)
+        dummy_jobs = jnp.zeros((1, n * self.obs_dim), jnp.float32)
+        dummy_jact = jnp.zeros((1, n * self.act_dim), jnp.float32)
+        self.params = self.model.init(init_rng, dummy_obs, dummy_jobs,
+                                      dummy_jact)
+        self.target_params = self.params
+        self.opt = optax.adam(float(cfg.get("critic_lr", 1e-3)))
+        self.opt_state = self.opt.init(self.params)
+
+        model = self.model
+        gamma = float(cfg.get("gamma", 0.95))
+        tau = float(cfg.get("tau", 0.01))
+
+        @jax.jit
+        def _policy_act(params, obs):
+            return model.apply(params, obs, method=model.act)
+
+        def _zero_critic_grads(grads):
+            """The actor objective -Q_i(s, μ_i(o_i), a_-i) must move only
+            actor params — without masking, its gradient would also teach
+            the critics to inflate Q."""
+            inner = dict(grads["params"])
+            for key in inner:
+                if key.startswith("critics"):
+                    inner[key] = jax.tree_util.tree_map(
+                        jnp.zeros_like, inner[key])
+            return {**grads, "params": inner}
+
+        @jax.jit
+        def _update(params, target_params, opt_state, batch):
+            b = batch["obs"].shape[0]
+            joint_obs = batch["obs"].reshape(b, -1)
+            joint_next_obs = batch["next_obs"].reshape(b, -1)
+            joint_act = batch["actions"].reshape(b, -1)
+            # target joint actions from target actors
+            next_acts = model.apply(target_params, batch["next_obs"],
+                                    method=model.act).reshape(b, -1)
+            q_next = model.apply(target_params, joint_next_obs, next_acts,
+                                 method=model.critic_values)  # [B, n]
+            target = batch["rewards"] + gamma \
+                * (1.0 - batch["dones"][:, None]) * q_next
+
+            def critic_loss_fn(p):
+                q = model.apply(p, joint_obs, joint_act,
+                                method=model.critic_values)
+                return jnp.mean((q - jax.lax.stop_gradient(target)) ** 2)
+
+            def actor_loss_fn(p):
+                # each agent's action from its actor, other agents'
+                # actions from the batch
+                acts = model.apply(p, batch["obs"], method=model.act)
+                actor_losses = []
+                for i in range(model.n_agents):
+                    mixed = batch["actions"].at[:, i].set(acts[:, i])
+                    qi = model.apply(p, joint_obs, mixed.reshape(b, -1),
+                                     method=model.critic_values)[:, i]
+                    actor_losses.append(-jnp.mean(qi))
+                return jnp.stack(actor_losses).sum()
+
+            critic_loss, g_critic = jax.value_and_grad(critic_loss_fn)(
+                params)
+            actor_loss, g_actor = jax.value_and_grad(actor_loss_fn)(
+                params)
+            grads = jax.tree_util.tree_map(
+                jnp.add, g_critic, _zero_critic_grads(g_actor))
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree_util.tree_map(
+                lambda t, s: (1.0 - tau) * t + tau * s, target_params,
+                params)
+            return params, target, opt_state, critic_loss, actor_loss
+
+        self._policy_act = _policy_act
+        self._update = _update
+        self._replay: deque = deque(
+            maxlen=int(cfg.get("replay_buffer_capacity", 50_000)))
+        self._np_rng = np.random.default_rng(int(cfg.get("seed", 0) or 0))
+        self._pending_returns: List[float] = []
+        self._pending_lens: List[int] = []
+
+    # -- sampling -------------------------------------------------------
+    def _stack_obs(self, obs) -> np.ndarray:
+        return np.stack([np.asarray(obs[a], np.float32).ravel()
+                         for a in self.agent_ids])
+
+    def _act(self, stacked: np.ndarray, explore: bool) -> np.ndarray:
+        acts = np.asarray(self._policy_act(
+            self.params, jnp.asarray(stacked[None])))[0]  # [n, act_dim]
+        if explore:
+            noise = float(self.config.get("exploration_noise", 0.1))
+            acts = acts + noise * self._np_rng.standard_normal(acts.shape)
+        return np.clip(acts, self._act_low, self._act_high) \
+            .astype(np.float32)
+
+    def _run_episode(self, explore: bool = True) -> Tuple[float, int]:
+        obs, _ = self.env.reset()
+        total, steps = 0.0, 0
+        while True:
+            stacked = self._stack_obs(obs)
+            actions = self._act(stacked, explore)
+            action_dict = {a: actions[i]
+                           for i, a in enumerate(self.agent_ids)}
+            obs, rews, terms, truncs, _ = self.env.step(action_dict)
+            rew_vec = np.asarray([float(rews[a]) for a in self.agent_ids],
+                                 np.float32)
+            done = bool(terms.get("__all__") or truncs.get("__all__"))
+            self._replay.append((stacked, actions, rew_vec,
+                                 self._stack_obs(obs), float(done)))
+            total += float(rew_vec.sum())
+            steps += 1
+            self._timesteps_total += 1
+            if done:
+                return total, steps
+
+    # -- training -------------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        for _ in range(int(cfg.get("rollout_episodes_per_step", 4))):
+            ret, length = self._run_episode()
+            self._pending_returns.append(ret)
+            self._pending_lens.append(length)
+        stats: Dict[str, Any] = {"replay_size": len(self._replay)}
+        warmup = int(cfg.get("num_steps_sampled_before_learning_starts",
+                             500))
+        bs = int(cfg.get("train_batch_size", 64))
+        if len(self._replay) >= max(warmup, bs):
+            for _ in range(int(cfg.get("updates_per_step", 4))):
+                idx = self._np_rng.integers(0, len(self._replay), bs)
+                rows = [self._replay[i] for i in idx]
+                batch = {
+                    "obs": jnp.asarray(np.stack([r[0] for r in rows])),
+                    "actions": jnp.asarray(
+                        np.stack([r[1] for r in rows])),
+                    "rewards": jnp.asarray(
+                        np.stack([r[2] for r in rows])),
+                    "next_obs": jnp.asarray(
+                        np.stack([r[3] for r in rows])),
+                    "dones": jnp.asarray(
+                        np.asarray([r[4] for r in rows], np.float32)),
+                }
+                (self.params, self.target_params, self.opt_state,
+                 critic_loss, actor_loss) = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    batch)
+            stats["critic_loss"] = float(critic_loss)
+            stats["actor_loss"] = float(actor_loss)
+        return stats
+
+    # -- Algorithm plumbing without a worker fleet ----------------------
+    def _collect_metrics(self):
+        out = [{"episode_returns": list(self._pending_returns),
+                "episode_lens": list(self._pending_lens)}]
+        self._pending_returns.clear()
+        self._pending_lens.clear()
+        return out
+
+    def evaluate(self) -> Dict[str, Any]:
+        returns = []
+        for _ in range(int(self.config.get("evaluation_duration", 10))):
+            ret, _ = self._run_episode(explore=False)
+            returns.append(ret)
+        return {"episode_reward_mean": float(np.mean(returns)),
+                "episode_reward_min": float(np.min(returns)),
+                "episode_reward_max": float(np.max(returns))}
+
+    def save(self, checkpoint_dir: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump({
+                "params": jax.tree_util.tree_map(np.asarray, self.params),
+                "target_params": jax.tree_util.tree_map(
+                    np.asarray, self.target_params),
+                "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total,
+            }, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self.target_params = jax.tree_util.tree_map(
+            jnp.asarray, state["target_params"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+
+    def stop(self) -> None:
+        pass

@@ -228,12 +228,77 @@ class MultiAgentCartPole(MultiAgentEnv):
         return obs, rew, term, trunc, info
 
 
+class TwoStepGame(MultiAgentEnv):
+    """Cooperative 2-agent matrix game with a state transition (the QMIX
+    paper's didactic env; reference ``rllib/examples/env/two_step_game.py``).
+
+    Step 1: agent_0's action picks the second-stage game (0 -> 2A,
+    1 -> 2B).  Step 2A: any joint action pays 7.  Step 2B: payoff
+    [[0, 1], [1, 8]] — the global optimum (8) needs coordinated (1, 1),
+    which value-decomposition without a state-conditioned mixer cannot
+    represent.  Team reward is shared; per-agent obs is the one-hot
+    state plus the agent id.
+    """
+
+    PAYOFF_2B = [[0.0, 1.0], [1.0, 8.0]]
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.state = 0  # 0 = step1, 1 = 2A, 2 = 2B
+        obs_space = Box(0.0, 1.0, (4,))
+        act_space = Discrete(2)
+        self.observation_spaces = {0: obs_space, 1: obs_space}
+        self.action_spaces = {0: act_space, 1: act_space}
+
+    def _obs(self):
+        out = {}
+        for aid in (0, 1):
+            v = np.zeros(4, np.float32)
+            v[self.state] = 1.0
+            v[3] = float(aid)
+            out[aid] = v
+        return out
+
+    def global_state(self) -> np.ndarray:
+        v = np.zeros(3, np.float32)
+        v[self.state] = 1.0
+        return v
+
+    def reset(self, *, seed: Optional[int] = None):
+        self.state = 0
+        return self._obs(), {0: {}, 1: {}}
+
+    def step(self, action_dict):
+        a0, a1 = int(action_dict[0]), int(action_dict[1])
+        if self.state == 0:
+            self.state = 1 if a0 == 0 else 2
+            rew, done = 0.0, False
+        elif self.state == 1:
+            rew, done = 7.0, True
+        else:
+            rew, done = self.PAYOFF_2B[a0][a1], True
+        obs = self._obs()
+        rews = {0: rew / 2.0, 1: rew / 2.0}  # shared team reward
+        terms = {0: done, 1: done, "__all__": done}
+        truncs = {0: False, 1: False, "__all__": False}
+        return obs, rews, terms, truncs, {0: {}, 1: {}}
+
+
 _ENV_REGISTRY: Dict[str, Any] = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "RandomEnv": RandomEnv,
     "MultiAgentCartPole": MultiAgentCartPole,
+    "TwoStepGame": TwoStepGame,
 }
+
+
+def _register_extra_envs():
+    """Late registration for envs defined in algorithm modules."""
+    try:
+        from ray_tpu.rllib.algorithms.maddpg import SimpleTargetChase
+        _ENV_REGISTRY.setdefault("SimpleTargetChase", SimpleTargetChase)
+    except ImportError:
+        pass
 
 
 def register_env(name: str, creator) -> None:
@@ -245,6 +310,8 @@ def register_env(name: str, creator) -> None:
 def make_env(env: Any, config: Optional[Dict[str, Any]] = None):
     """Instantiate from a registered name, a class, or a callable."""
     if isinstance(env, str):
+        if env not in _ENV_REGISTRY:
+            _register_extra_envs()
         if env not in _ENV_REGISTRY:
             raise ValueError(f"unknown env {env!r}; register_env() it "
                              f"(known: {sorted(_ENV_REGISTRY)})")

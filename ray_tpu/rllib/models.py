@@ -160,3 +160,89 @@ class LSTMNet(nn.Module):
     def initial_carry(self, batch: int):
         zeros = jnp.zeros((batch, self.cell_size), jnp.float32)
         return (zeros, zeros)
+
+
+class _GatedTransformerBlock(nn.Module):
+    """One GTrXL block: memory-augmented causal self-attention and a
+    position-wise MLP, each behind a GRU-style sigmoid gate (reference
+    ``models/torch/attention_net.py`` GTrXLNet blocks)."""
+
+    dim: int
+    heads: int = 4
+
+    @nn.compact
+    def __call__(self, x, mem, mem_mask):
+        """x [B,T,D] layer input; mem [B,M,D] cached inputs from earlier
+        timesteps; mem_mask [B,M] validity.  Returns [B,T,D]."""
+        batch, t, _ = x.shape
+        m = mem.shape[1]
+        kv = jnp.concatenate([mem, x], axis=1)  # [B, M+T, D]
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(mem_mask[:, None, :], (batch, t, m)),
+             jnp.broadcast_to(causal[None], (batch, t, t))], axis=-1)
+        y = nn.LayerNorm(name="ln_attn")(x)
+        ykv = nn.LayerNorm(name="ln_kv")(kv)
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, name="attn")(
+                y, ykv, mask=mask[:, None])
+        gate = nn.sigmoid(nn.Dense(self.dim, name="gate_attn")(
+            jnp.concatenate([x, attn], axis=-1)))
+        x = x + gate * attn
+        z = nn.LayerNorm(name="ln_ff")(x)
+        ff = nn.Dense(self.dim, name="ff_out")(
+            nn.relu(nn.Dense(2 * self.dim, name="ff_in")(z)))
+        gate2 = nn.sigmoid(nn.Dense(self.dim, name="gate_ff")(
+            jnp.concatenate([x, ff], axis=-1)))
+        return x + gate2 * ff
+
+
+class AttentionNet(nn.Module):
+    """GTrXL-style attention torso with sliding window memory (reference
+    ``models/torch/attention_net.py`` — model config ``use_attention``).
+
+    Same carry interface as :class:`LSTMNet` so samplers/losses thread it
+    identically: carry is two per-env arrays —
+    ``mem_flat [B, layers*memory_len*dim]`` (cached layer inputs, stop-
+    gradient like Transformer-XL) and ``count [B, 1]`` (how many memory
+    slots are valid).
+    """
+
+    num_outputs: int
+    dim: int = 64
+    num_layers: int = 2
+    memory_len: int = 16
+    heads: int = 4
+
+    @nn.compact
+    def __call__(self, obs_seq: jnp.ndarray, carry):
+        mem_flat, count = carry
+        batch, t, _ = obs_seq.shape
+        mems = mem_flat.reshape(batch, self.num_layers, self.memory_len,
+                                self.dim)
+        # slot m is valid iff it is within the last `count` positions
+        idx = jnp.arange(self.memory_len)[None, :]
+        mem_mask = idx >= (self.memory_len - count)  # [B, M] bool
+        x = nn.Dense(self.dim, name="embed")(obs_seq)
+        new_mems = []
+        for layer in range(self.num_layers):
+            layer_in = x
+            new_mems.append(jax.lax.stop_gradient(
+                jnp.concatenate([mems[:, layer], layer_in],
+                                axis=1)[:, -self.memory_len:]))
+            x = _GatedTransformerBlock(
+                dim=self.dim, heads=self.heads,
+                name=f"block_{layer}")(x, mems[:, layer], mem_mask)
+        logits = nn.Dense(self.num_outputs, name="out",
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        v = nn.Dense(1, name="vf_out",
+                     kernel_init=nn.initializers.orthogonal(1.0))(x)
+        new_count = jnp.minimum(count + t, self.memory_len)
+        new_carry = (jnp.stack(new_mems, axis=1).reshape(batch, -1),
+                     new_count.astype(count.dtype))
+        return logits, jnp.squeeze(v, axis=-1), new_carry
+
+    def initial_carry(self, batch: int):
+        return (jnp.zeros(
+            (batch, self.num_layers * self.memory_len * self.dim),
+            jnp.float32), jnp.zeros((batch, 1), jnp.float32))

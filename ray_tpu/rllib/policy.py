@@ -62,8 +62,21 @@ class JaxPolicy:
         else:
             raise ValueError(f"unsupported action space {action_space!r}")
         model_cfg = config.get("model", {})
-        self.recurrent = bool(model_cfg.get("use_lstm", False))
-        if self.recurrent:
+        self.recurrent = bool(model_cfg.get("use_lstm", False)
+                              or model_cfg.get("use_attention", False))
+        if model_cfg.get("use_attention", False):
+            from ray_tpu.rllib.models import AttentionNet
+
+            self.model = AttentionNet(
+                num_outputs=num_outputs,
+                dim=int(model_cfg.get("attention_dim", 64)),
+                num_layers=int(model_cfg.get(
+                    "attention_num_transformer_units", 2)),
+                memory_len=int(model_cfg.get("attention_memory_inference",
+                                             16)),
+                heads=int(model_cfg.get("attention_num_heads", 4)),
+            )
+        elif self.recurrent:
             from ray_tpu.rllib.models import LSTMNet
 
             self.model = LSTMNet(
@@ -187,11 +200,11 @@ class JaxPolicy:
 
     # -- recurrent surface ----------------------------------------------
     def get_initial_state(self, batch: int) -> Tuple[np.ndarray, ...]:
-        """Zero LSTM carry for ``batch`` parallel envs (reference
-        ``Policy.get_initial_state``)."""
-        cell = self.model.cell_size
-        return (np.zeros((batch, cell), np.float32),
-                np.zeros((batch, cell), np.float32))
+        """Zero recurrent carry for ``batch`` parallel envs (reference
+        ``Policy.get_initial_state``) — LSTM (c, h) or attention
+        (memory, count); both are pairs of per-env arrays."""
+        return tuple(np.asarray(c) for c in
+                     self.model.initial_carry(batch))
 
     def compute_actions_rnn(self, obs: np.ndarray, state: Tuple,
                             explore: bool = True):

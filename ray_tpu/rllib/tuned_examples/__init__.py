@@ -1,0 +1,75 @@
+"""Regression configs (parity: reference ``rllib/tuned_examples/`` — the
+yaml files driven nightly by release/rllib_tests).  Each yaml names an
+algorithm, an env, a config dict, and a pass criterion; ``load`` builds
+the Algorithm and ``run`` trains until the criterion or the iteration
+budget."""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+_DIR = os.path.dirname(__file__)
+
+_ALGO_BY_NAME = None
+
+
+def _algo_config(name: str):
+    global _ALGO_BY_NAME
+    if _ALGO_BY_NAME is None:
+        from ray_tpu.rllib import algorithms as algos
+
+        _ALGO_BY_NAME = {
+            "PPO": algos.PPOConfig, "APPO": algos.APPOConfig,
+            "IMPALA": algos.ImpalaConfig, "DQN": algos.DQNConfig,
+            "SimpleQ": algos.SimpleQConfig, "SAC": algos.SACConfig,
+            "DDPG": algos.DDPGConfig, "TD3": algos.TD3Config,
+            "PG": algos.PGConfig, "A2C": algos.A2CConfig,
+            "QMIX": algos.QMixConfig, "MADDPG": algos.MADDPGConfig,
+            "R2D2": algos.R2D2Config, "ES": algos.ESConfig,
+        }
+    return _ALGO_BY_NAME[name]()
+
+
+def list_examples() -> List[str]:
+    return sorted(glob.glob(os.path.join(_DIR, "*.yaml")))
+
+
+def load(path: str):
+    """Build (algorithm, spec) from a tuned-example yaml."""
+    with open(path) as f:
+        spec = yaml.safe_load(f)
+    config = _algo_config(spec["run"])
+    config.environment(spec["env"],
+                       env_config=spec.get("env_config") or {})
+    for key, value in (spec.get("config") or {}).items():
+        setattr(config, key, value)
+    if spec.get("seed") is not None:
+        config.debugging(seed=int(spec["seed"]))
+    return config.build(), spec
+
+
+def run(path: str, max_iters: Optional[int] = None) -> Dict[str, Any]:
+    """Train until the yaml's stop criterion; returns the last result
+    plus ``passed``."""
+    algo, spec = load(path)
+    stop = spec.get("stop") or {}
+    target = stop.get("episode_reward_mean")
+    iters = int(max_iters or stop.get("training_iteration", 50))
+    result: Dict[str, Any] = {}
+    passed = target is None
+    try:
+        for _ in range(iters):
+            result = algo.train()
+            rm = result.get("episode_reward_mean")
+            if target is not None and rm is not None and rm == rm \
+                    and rm >= target:
+                passed = True
+                break
+    finally:
+        algo.stop()
+    result["passed"] = passed
+    return result

@@ -7,13 +7,18 @@ Parity: reference ``python/ray/_private/runtime_env/`` — the
 ``py_modules`` into the GCS KV keyed by content hash, and each worker
 extracts once into a per-host cache before applying.
 
-``pip``/``conda`` isolation requires spawning interpreters into built
-environments; this deployment forbids package installation, so those
-keys raise immediately instead of failing later (the plug point is
-``ensure_applied``).  Env semantics match the reference's dedicated
-workers: applying an env marks the worker, and the raylet routes tasks
-of other envs to other workers (env hash is part of the lease, like the
-reference's runtime-env-keyed WorkerPool).
+``pip`` envs (reference ``runtime_env/pip.py``) build once into a
+content-addressed ``pip install --target`` directory and are applied by
+prepending that directory to ``sys.path`` of a *dedicated* worker —
+workers are keyed by env hash (the raylet's WorkerPool routes other
+envs to other workers), so the injection never leaks across envs.  This
+is the TPU-deployment equivalent of the reference's per-env venv
+interpreter: same isolation contract, no interpreter respawn.  By
+default installs consult the configured index; air-gapped deployments
+pass ``pip_install_options`` (e.g. ``--no-index --find-links …``).
+
+``conda``/``container`` remain unsupported (no conda binary / container
+runtime in this deployment) and raise immediately.
 """
 
 from __future__ import annotations
@@ -31,8 +36,8 @@ from typing import Any, Dict, List, Optional
 _CACHE_ROOT = os.path.join(os.environ.get("TMPDIR", "/tmp"),
                            "ray_tpu_runtime_env_cache")
 
-SUPPORTED = {"env_vars", "working_dir", "py_modules"}
-UNSUPPORTED = {"pip", "conda", "container"}
+SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip"}
+UNSUPPORTED = {"conda", "container"}
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -41,14 +46,31 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     bad = set(runtime_env) & UNSUPPORTED
     if bad:
         raise ValueError(
-            f"runtime_env keys {sorted(bad)} are unsupported here: this "
-            f"deployment forbids package installation (bake dependencies "
-            f"into the image; see SURVEY note)")
+            f"runtime_env keys {sorted(bad)} are unsupported here: no "
+            f"conda binary / container runtime in this deployment (bake "
+            f"those dependencies into the image)")
     unknown = set(runtime_env) - SUPPORTED
     if unknown:
         raise ValueError(f"unknown runtime_env keys {sorted(unknown)} "
                          f"(supported: {sorted(SUPPORTED)})")
-    return dict(runtime_env)
+    out = dict(runtime_env)
+    if "pip" in out:
+        out["pip"] = _normalize_pip(out["pip"])
+    return out
+
+
+def _normalize_pip(spec: Any) -> Dict[str, Any]:
+    """Accept ``["six"]`` or ``{"packages": [...],
+    "pip_install_options": [...]}`` (reference pip field shapes)."""
+    if isinstance(spec, (list, tuple)):
+        return {"packages": [str(p) for p in spec],
+                "pip_install_options": []}
+    if isinstance(spec, dict):
+        return {"packages": [str(p) for p in spec.get("packages", [])],
+                "pip_install_options": [
+                    str(o) for o in spec.get("pip_install_options", [])]}
+    raise ValueError(f"runtime_env['pip'] must be a list or dict, got "
+                     f"{type(spec).__name__}")
 
 
 def env_hash(runtime_env: Dict[str, Any]) -> str:
@@ -150,6 +172,52 @@ def _extract(uri: str, kv_get) -> str:
     return dest
 
 
+def _ensure_pip_env(pip_spec: Dict[str, Any]) -> str:
+    """Build (once, content-addressed) a ``pip install --target`` dir for
+    the given package set; returns the directory to put on sys.path.
+
+    Concurrency: an exclusive flock around the build plus an atomic
+    rename-into-place, so parallel workers race safely and losers reuse
+    the winner's build (reference ``pip.py`` builds under a per-URI
+    lock the same way).
+    """
+    import subprocess
+
+    packages = pip_spec.get("packages", [])
+    opts = pip_spec.get("pip_install_options", [])
+    if not packages:
+        raise ValueError("runtime_env['pip'] has no packages")
+    digest = hashlib.sha256(
+        json.dumps([packages, opts, sys.version_info[:2]],
+                   sort_keys=True).encode()).hexdigest()[:16]
+    root = os.path.join(_CACHE_ROOT, "pip")
+    dest = os.path.join(root, digest)
+    if os.path.isdir(dest):
+        return dest
+    os.makedirs(root, exist_ok=True)
+    import fcntl
+
+    lock_path = os.path.join(root, f".{digest}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.isdir(dest):  # another worker built it while we waited
+            return dest
+        tmp = tempfile.mkdtemp(prefix=f".{digest}-", dir=root)
+        cmd = [sys.executable, "-m", "pip", "install",
+               "--target", tmp, "--quiet", *opts, *packages]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip runtime env build failed "
+                    f"({' '.join(cmd)}):\n{proc.stderr[-4000:]}")
+            os.rename(tmp, dest)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
 class RuntimeEnvManager:
     """Worker side: apply envs once per (env, process).
 
@@ -169,6 +237,10 @@ class RuntimeEnvManager:
             return
         for k, v in runtime_env.get("env_vars", {}).items():
             os.environ[str(k)] = str(v)
+        if runtime_env.get("pip"):
+            pip_dir = _ensure_pip_env(_normalize_pip(runtime_env["pip"]))
+            if pip_dir not in sys.path:
+                sys.path.insert(0, pip_dir)
         for uri in runtime_env.get("py_modules", []):
             root = _extract(uri, self._kv_get)
             if root not in sys.path:

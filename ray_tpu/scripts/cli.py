@@ -142,6 +142,32 @@ def cmd_status(args) -> None:
           f"({sum(1 for n in nodes if n['state'] == 'ALIVE')} alive)")
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
+    # per-node reporter: cpu/mem + per-worker process stats
+    for n in nodes:
+        stats = n.get("stats") or {}
+        if not stats:
+            continue
+        print(f"node {n['node_id'][:12]}: "
+              f"cpu {stats.get('cpu_percent', 0):.0f}%  "
+              f"mem {stats.get('mem_percent', 0):.0f}% "
+              f"({stats.get('mem_used', 0)/2**30:.1f}/"
+              f"{stats.get('mem_total', 0)/2**30:.1f} GiB)")
+        for w in stats.get("workers", []):
+            kind = "actor " if w.get("is_actor") else "worker"
+            print(f"    {kind} pid {w['pid']:>7}  "
+                  f"cpu {w.get('cpu_percent', 0):5.1f}%  "
+                  f"rss {w.get('rss', 0)/2**20:8.1f} MiB")
+
+
+def cmd_events(args) -> None:
+    _connect(args)
+    from ray_tpu.experimental.state import api as state
+    rows = state.list_cluster_events(limit=args.limit,
+                                     severity=args.severity)
+    for r in rows:
+        ts = time.strftime("%H:%M:%S", time.localtime(r["timestamp"]))
+        print(f"{ts} [{r['severity']:>7}] {r['source_type']:<8} "
+              f"{r['label']:<18} {r['message']}")
 
 
 def cmd_list(args) -> None:
@@ -155,6 +181,7 @@ def cmd_list(args) -> None:
         "objects": state.list_objects,
         "placement-groups": state.list_placement_groups,
         "jobs": state.list_jobs,
+        "cluster-events": state.list_cluster_events,
     }[args.resource]
     rows = fn(limit=args.limit)
     print(json.dumps(rows, indent=2, default=str))
@@ -266,10 +293,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("resource", choices=[
         "tasks", "actors", "nodes", "workers", "objects",
-        "placement-groups", "jobs"])
+        "placement-groups", "jobs", "cluster-events"])
     sp.add_argument("--limit", type=int, default=100)
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("events", help="structured cluster events")
+    sp.add_argument("--limit", type=int, default=200)
+    sp.add_argument("--severity", default=None,
+                    choices=[None, "DEBUG", "INFO", "WARNING", "ERROR",
+                             "FATAL"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("summary", help="task summary by function/state")
     sp.add_argument("resource", choices=["tasks"])

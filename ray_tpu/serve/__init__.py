@@ -53,12 +53,19 @@ def _get_router() -> Router:
         return _router
 
 
-def shutdown() -> None:
-    global _router
+def _stop_router() -> None:
+    """Retire the process-wide router (poll thread + cache).  Called from
+    ``serve.shutdown()`` and from ``ray_tpu.shutdown()``."""
+    global _router, _router_core
     with _router_lock:
         if _router is not None:
             _router.stop()
         _router = None
+        _router_core = None
+
+
+def shutdown() -> None:
+    _stop_router()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)

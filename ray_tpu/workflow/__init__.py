@@ -5,12 +5,16 @@ from ray_tpu.workflow.workflow import (  # noqa: F401
     RESUMABLE,
     RUNNING,
     SUCCEEDED,
+    Continuation,
+    WorkflowManagementActor,
     WorkflowStorage,
+    continuation,
     delete,
     get_output,
     get_status,
     init,
     list_all,
+    options,
     resume,
     run,
     send_event,

@@ -64,7 +64,8 @@ class WorkflowStorage:
         os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
 
     def _step_path(self, step_id: str) -> str:
-        return os.path.join(self.dir, "steps", f"{step_id}.pkl")
+        # continuation steps are namespaced "parent/child" — nested dirs
+        return os.path.join(self.dir, "steps", *step_id.split("/")) + ".pkl"
 
     def has_step(self, step_id: str) -> bool:
         return os.path.exists(self._step_path(step_id))
@@ -75,6 +76,7 @@ class WorkflowStorage:
 
     def save_step(self, step_id: str, value: Any) -> None:
         path = self._step_path(step_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             cloudpickle.dump(value, f)
@@ -146,16 +148,48 @@ def _assign_step_ids(dag: DAGNode) -> Dict[int, str]:
     return ids
 
 
+def options(node: DAGNode, *, max_retries: int = 0,
+            catch_exceptions: bool = False) -> DAGNode:
+    """Annotate a bound step with workflow execution options (parity:
+    reference ``workflow.options(max_retries=…, catch_exceptions=…)``).
+
+    ``max_retries``: re-execute a raising step up to N extra times before
+    surfacing the error.  ``catch_exceptions``: the step's durable result
+    becomes ``(value, None)`` on success or ``(None, exception)`` on
+    failure — downstream steps decide, nothing is raised.
+    """
+    node._workflow_options = {"max_retries": int(max_retries),
+                              "catch_exceptions": bool(catch_exceptions)}
+    return node
+
+
+class Continuation:
+    """A step's returned sub-workflow (parity: reference
+    ``workflow.continuation`` — a step that returns a DAG continues into
+    it; the sub-DAG's steps are durable under the parent step's id)."""
+
+    def __init__(self, dag: DAGNode):
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    return Continuation(dag)
+
+
 class _DurableContext:
     """DAG executor with per-step persistence (memoized like
-    dag._ExecContext, plus storage read-through/write-back)."""
+    dag._ExecContext, plus storage read-through/write-back).
+
+    ``prefix`` namespaces step ids of dynamic continuations under their
+    parent step, so resume skips completed sub-steps too."""
 
     def __init__(self, storage: WorkflowStorage, step_ids: Dict[int, str],
-                 input_args: tuple, input_kwargs: dict):
+                 input_args: tuple, input_kwargs: dict, prefix: str = ""):
         self.storage = storage
         self.step_ids = step_ids
         self.input_args = input_args
         self.input_kwargs = input_kwargs
+        self.prefix = prefix
         self._results: Dict[int, Any] = {}
 
     def result_of(self, node: DAGNode):
@@ -167,18 +201,50 @@ class _DurableContext:
             self._results[key] = value
             return value
         step_id = self.step_ids.get(key)
+        if step_id is not None:
+            step_id = self.prefix + step_id
         durable = isinstance(node, (FunctionNode, ClassMethodNode)) \
             and step_id is not None
         if durable and self.storage.has_step(step_id):
             value = self.storage.load_step(step_id)
         else:
-            out = node._execute_impl(self)
-            value = ray_tpu.get(out) if isinstance(
-                out, ray_tpu.ObjectRef) else out
+            value = self._run_step(node, step_id)
             if durable:
                 self.storage.save_step(step_id, value)
         self._results[key] = value
         return value
+
+    def _run_step(self, node: DAGNode, step_id: Optional[str]):
+        opts = getattr(node, "_workflow_options", None) or {}
+        retries_left = opts.get("max_retries", 0)
+        catch = opts.get("catch_exceptions", False)
+        while True:
+            try:
+                out = node._execute_impl(self)
+                value = ray_tpu.get(out) if isinstance(
+                    out, ray_tpu.ObjectRef) else out
+                value = self._maybe_continue(value, step_id)
+                return (value, None) if catch else value
+            except Exception as e:  # noqa: BLE001 — step failure policy
+                if retries_left > 0:
+                    retries_left -= 1
+                    continue
+                if catch:
+                    return (None, e)
+                raise
+
+    def _maybe_continue(self, value: Any, step_id: Optional[str]):
+        """A step returning a Continuation (or bare DAG) executes it in
+        place, durably, namespaced under the parent step."""
+        if isinstance(value, Continuation):
+            value = value.dag
+        if not isinstance(value, DAGNode):
+            return value
+        sub_ids = _assign_step_ids(value)
+        sub = _DurableContext(
+            self.storage, sub_ids, self.input_args, self.input_kwargs,
+            prefix=(step_id or "dyn") + "/")
+        return sub.result_of(value)
 
 
 def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
@@ -196,9 +262,60 @@ def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
     return _drive(storage, dag, args, kwargs)
 
 
+MANAGEMENT_ACTOR_NAME = "__workflow_management__"
+
+
+@ray_tpu.remote
+class WorkflowManagementActor:
+    """Cluster-wide workflow registry (parity: reference
+    ``workflow_access.py`` WorkflowManagementActor) — live status for
+    ``list_all``/``get_status`` without scanning storage, and a single
+    place that could serialize concurrent ``resume`` calls."""
+
+    def __init__(self):
+        self._status: Dict[str, Dict[str, Any]] = {}
+
+    def set_status(self, workflow_id: str, status: str) -> None:
+        self._status[workflow_id] = {"status": status,
+                                     "time": time.time()}
+
+    def get_status(self, workflow_id: str) -> Optional[str]:
+        entry = self._status.get(workflow_id)
+        return entry["status"] if entry else None
+
+    def list_status(self) -> Dict[str, Dict[str, Any]]:
+        return dict(self._status)
+
+
+def _management_actor():
+    """Get-or-create the detached management actor; None when no cluster
+    is up (workflows also run driver-local against bare storage)."""
+    if not ray_tpu.is_initialized():
+        return None
+    try:
+        return ray_tpu.get_actor(MANAGEMENT_ACTOR_NAME)
+    except ValueError:
+        try:
+            return WorkflowManagementActor.options(
+                name=MANAGEMENT_ACTOR_NAME, lifetime="detached",
+                get_if_exists=True).remote()
+        except Exception:  # noqa: BLE001 — registry is best-effort
+            return None
+
+
+def _report_status(workflow_id: str, status: str) -> None:
+    actor = _management_actor()
+    if actor is not None:
+        try:
+            actor.set_status.remote(workflow_id, status)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _drive(storage: WorkflowStorage, dag: DAGNode, args: tuple,
            kwargs: dict) -> Any:
     storage.save_meta({"status": RUNNING, "start_time": time.time()})
+    _report_status(storage.workflow_id, RUNNING)
     step_ids = _assign_step_ids(dag)
     ctx = _DurableContext(storage, step_ids, args, kwargs)
     try:
@@ -206,9 +323,11 @@ def _drive(storage: WorkflowStorage, dag: DAGNode, args: tuple,
     except Exception as e:
         storage.save_meta({"status": RESUMABLE, "error": repr(e),
                            "time": time.time()})
+        _report_status(storage.workflow_id, RESUMABLE)
         raise
     storage.save_step("__output__", result)
     storage.save_meta({"status": SUCCEEDED, "time": time.time()})
+    _report_status(storage.workflow_id, SUCCEEDED)
     return result
 
 

@@ -42,3 +42,19 @@ def ray_start_regular():
     ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
     yield None
     ray_tpu.shutdown()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long regression runs (deselect with -m 'not slow')")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("-m"):
+        return
+    import pytest as _pytest
+
+    skip_slow = _pytest.mark.skip(reason="slow regression; run -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)

@@ -234,3 +234,117 @@ def test_r2d2_solves_memory_task():
             break
     assert best >= 16.0, best
     algo.stop()
+
+
+def test_qmix_two_step_game():
+    """QMIX learns the coordinated optimum of TwoStepGame (reward 8 via
+    joint action (1,1) in state 2B — unreachable for VDN-style additive
+    mixers); we assert solid progress toward it in bounded iters."""
+    from ray_tpu.rllib.algorithms import QMixConfig
+
+    config = QMixConfig().environment("TwoStepGame").debugging(seed=0)
+    config.rollout_episodes_per_step = 16
+    config.epsilon_timesteps = 1200
+    config.target_network_update_freq = 100
+    algo = config.build()
+    best = -np.inf
+    for _ in range(60):
+        r = algo.train()
+        rm = r.get("episode_reward_mean")
+        if rm is not None and not np.isnan(rm):
+            best = max(best, rm)
+        if best >= 6.9:
+            break
+    assert best >= 6.9, best  # ≥ the 7-reward safe branch
+    # greedy evaluation is deterministic and at least matches it
+    ev = algo.evaluate()
+    assert ev["episode_reward_mean"] >= 6.9
+    algo.stop()
+
+
+def test_maddpg_target_chase(tmp_path):
+    """MADDPG improves the cooperative continuous objective and
+    round-trips its checkpoint."""
+    from ray_tpu.rllib.algorithms import MADDPGConfig
+
+    config = MADDPGConfig().environment(
+        "SimpleTargetChase", env_config={"num_agents": 2, "horizon": 25,
+                                         "seed": 0}).debugging(seed=0)
+    config.rollout_episodes_per_step = 4
+    config.updates_per_step = 8
+    config.num_steps_sampled_before_learning_starts = 200
+    algo = config.build()
+    curve = []
+    for i in range(22):
+        r = algo.train()
+        rm = r.get("episode_reward_mean")
+        if rm is not None and not np.isnan(rm):
+            curve.append(rm)
+    assert len(curve) >= 10
+    # learning signal: the late window beats the early one (the
+    # episode_reward_mean is a running 100-episode window, so early
+    # exploration noise dominates the first entries)
+    assert np.mean(curve[-3:]) > np.mean(curve[2:5]) - 0.5, curve
+    assert np.isfinite(r["critic_loss"])
+    path = algo.save(str(tmp_path / "maddpg"))
+    algo2 = config.build()
+    algo2.restore(path)
+    import jax
+
+    a = jax.tree_util.tree_leaves(algo.params)[0]
+    b = jax.tree_util.tree_leaves(algo2.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    algo.stop()
+    algo2.stop()
+
+
+def test_attention_net_ppo():
+    """PPO with model.use_attention trains through the GTrXL torso with
+    windowed memory carry (parity: attention_net.py GTrXLNet)."""
+    from ray_tpu.rllib.algorithms import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .rollouts(rollout_fragment_length=100)
+              .training(train_batch_size=200, num_sgd_iter=2,
+                        sgd_minibatch_size=64,
+                        model={"use_attention": True,
+                               "attention_dim": 32,
+                               "attention_num_transformer_units": 1,
+                               "attention_memory_inference": 8,
+                               "attention_num_heads": 2})
+              .debugging(seed=0))
+    algo = config.build()
+    r1 = algo.train()
+    r2 = algo.train()
+    assert np.isfinite(r2.get("total_loss", r2.get("policy_loss", 0.0)))
+    assert r2["timesteps_total"] > r1["timesteps_total"] > 0
+    algo.stop()
+
+
+def test_tuned_examples_registry():
+    """Every tuned-example yaml loads and builds (the full regression
+    run is the slow marked test below)."""
+    from ray_tpu.rllib import tuned_examples
+
+    paths = tuned_examples.list_examples()
+    assert len(paths) >= 5
+    for p in paths:
+        algo, spec = tuned_examples.load(p)
+        assert spec["run"] and spec["env"]
+        algo.stop()
+
+
+@pytest.mark.slow
+def test_tuned_examples_regression():
+    """Run the full tuned-example suite to its stop criteria (parity:
+    reference release/rllib_tests nightly regression).  Marked slow —
+    run with `pytest -m slow`."""
+    from ray_tpu.rllib import tuned_examples
+
+    failures = []
+    for p in tuned_examples.list_examples():
+        result = tuned_examples.run(p)
+        if not result.get("passed"):
+            failures.append((p, result.get("episode_reward_mean")))
+    assert not failures, failures

@@ -70,9 +70,39 @@ def test_actor_runtime_env():
 
 
 def test_unsupported_keys_rejected():
-    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["requests"]}})
     def f():
         return 1
 
     with pytest.raises(ValueError, match="unsupported"):
         f.remote()
+
+
+def test_pip_runtime_env(tmp_path):
+    """A task with runtime_env={"pip": [...]} runs in a dedicated worker
+    that imports the package while the driver env lacks it (parity:
+    reference runtime_env/pip.py).  Uses a local source package so the
+    build needs no network."""
+    pkg_src = tmp_path / "rtpu_pip_probe_src"
+    mod = pkg_src / "rtpu_pip_probe"
+    mod.mkdir(parents=True)
+    (mod / "__init__.py").write_text("VALUE = 1234\n")
+    (pkg_src / "setup.py").write_text(
+        "from setuptools import setup, find_packages\n"
+        "setup(name='rtpu_pip_probe', version='0.1',"
+        " packages=find_packages())\n")
+
+    with pytest.raises(ImportError):
+        import rtpu_pip_probe  # noqa: F401 — driver must NOT have it
+
+    env = {"pip": {"packages": [str(pkg_src)],
+                   "pip_install_options": ["--no-index",
+                                           "--no-build-isolation"]}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def probe():
+        import rtpu_pip_probe
+
+        return rtpu_pip_probe.VALUE
+
+    assert ray_tpu.get(probe.remote(), timeout=180) == 1234

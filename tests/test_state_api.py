@@ -148,3 +148,61 @@ def test_tracing_propagation():
     finally:
         import ray_tpu.util.tracing.tracing_helper as th
         th._enabled = False
+
+
+def test_cluster_events_and_node_stats(ray_start_regular):
+    """Structured events reach the GCS ring buffer and per-node reporter
+    stats appear in the node table (parity: src/ray/util/event.h +
+    dashboard reporter module)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.experimental.state import api as state
+
+    # actor death emits an ACTOR_DEAD event through the GCS event path
+    @ray_tpu.remote
+    class Doomed:
+        def ping(self):
+            return 1
+
+    a = Doomed.remote()
+    ray_tpu.get(a.ping.remote())
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 30
+    events = []
+    while time.monotonic() < deadline:
+        events = state.list_cluster_events()
+        if any(e["label"] == "ACTOR_DEAD" for e in events):
+            break
+        time.sleep(0.2)
+    assert any(e["label"] == "ACTOR_DEAD" for e in events), events[-5:]
+    assert all("severity" in e and "source_type" in e for e in events)
+
+    # reporter: the raylet ships cpu/mem + per-worker stats each beat
+    deadline = time.monotonic() + 30
+    stats = []
+    while time.monotonic() < deadline:
+        stats = state.node_stats()
+        if stats and stats[0].get("mem_total"):
+            break
+        time.sleep(0.2)
+    assert stats and stats[0]["mem_total"] > 0
+    assert "workers" in stats[0]
+
+
+def test_debug_state_handler_stats(ray_start_regular):
+    """debug_state returns loop-lag + per-handler timing snapshots from
+    GCS and raylet (parity: instrumented_io_context event_stats)."""
+    import ray_tpu
+    from ray_tpu.core import worker as worker_mod
+
+    core = worker_mod.global_worker()
+    gcs = core.gcs_call("debug_state", {})
+    assert gcs.get("loop") == "gcs"
+    assert "max_lag_s" in gcs
+    # plenty of RPCs have happened by now; the handler table is non-empty
+    assert gcs["handlers"]
+
+    raylet = core.raylet_call(tuple(core.raylet_address),
+                              "debug_state", {})
+    assert str(raylet.get("loop", "")).startswith("raylet-")

@@ -136,3 +136,124 @@ def test_workflow_event_timeout(ray_start_regular, tmp_path):
     dag = consume.bind(workflow.wait_for_event("never", timeout=0.5))
     with pytest.raises(TimeoutError, match="never"):
         workflow.run(dag, workflow_id="evt_timeout")
+
+
+def test_step_max_retries(tmp_path):
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote
+    def flaky():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n < 2:
+            raise RuntimeError("transient")
+        return "ok"
+
+    dag = workflow.options(flaky.bind(), max_retries=3)
+    assert workflow.run(dag, workflow_id="w_retry") == "ok"
+    assert int(marker.read_text()) == 3  # 2 failures + 1 success
+
+
+def test_step_catch_exceptions():
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("nope")
+
+    dag = workflow.options(boom.bind(), catch_exceptions=True)
+    value, err = workflow.run(dag, workflow_id="w_catch")
+    assert value is None
+    assert "nope" in str(err)
+    assert workflow.get_status("w_catch") == workflow.SUCCEEDED
+
+
+def test_dynamic_continuation():
+    @ray_tpu.remote
+    def fib_cont(n):
+        if n <= 1:
+            return n
+        return workflow.continuation(
+            add.bind(fib_cont.bind(n - 1), fib_cont.bind(n - 2)))
+
+    assert workflow.run(fib_cont.bind(7), workflow_id="w_fib") == 13
+    # steps of the continuation were persisted (nested step dirs exist)
+    storage = workflow.WorkflowStorage("w_fib")
+    import os
+    nested = [d for d, _, files in os.walk(storage.dir) if files]
+    assert len(nested) > 1
+
+
+def test_management_actor_status():
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    workflow.run(dag, 4, workflow_id="w_mgmt")
+    actor = ray_tpu.get_actor(workflow.workflow.MANAGEMENT_ACTOR_NAME)
+    listing = ray_tpu.get(actor.list_status.remote(), timeout=30)
+    assert listing.get("w_mgmt", {}).get("status") == workflow.SUCCEEDED
+
+
+def test_crash_recovery_each_step_once(tmp_path):
+    """kill -9 the driver mid-workflow; resume() completes with each
+    completed step having executed exactly once (parity model:
+    reference test_recovery.py)."""
+    import os
+    import subprocess
+    import sys
+
+    store = tmp_path / "wfstore"
+    counts = tmp_path / "counts"
+    counts.mkdir()
+    script = f"""
+import os, sys, threading, time
+sys.path.insert(0, {repr(os.getcwd())})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import ray_tpu
+from ray_tpu import workflow
+
+ray_tpu.init(num_cpus=2)
+workflow.init({repr(str(store))})
+COUNTS = {repr(str(counts))}
+
+@ray_tpu.remote
+def step_a():
+    open(COUNTS + "/a", "a").write("x")
+    return 1
+
+@ray_tpu.remote
+def step_b(x):
+    open(COUNTS + "/b", "a").write("x")
+    if os.environ.get("WF_CRASH"):
+        time.sleep(60)  # hold the step so the driver dies mid-step
+    return x + 1
+
+@ray_tpu.remote
+def step_c(x):
+    open(COUNTS + "/c", "a").write("x")
+    return x + 1
+
+if os.environ.get("WF_CRASH"):
+    # SIGKILL-equivalent: hard-exit the driver once step_b is running,
+    # BEFORE its output is persisted (persistence is driver-side)
+    def _killer():
+        while not os.path.exists(COUNTS + "/b"):
+            time.sleep(0.01)
+        os._exit(9)
+    threading.Thread(target=_killer, daemon=True).start()
+    dag = step_c.bind(step_b.bind(step_a.bind()))
+    print(workflow.run(dag, workflow_id="w_crash"))
+else:
+    print(workflow.resume("w_crash"))
+"""
+    env = dict(os.environ, WF_CRASH="1")
+    p = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert p.returncode == 9, (p.returncode, p.stderr[-2000:])
+    env.pop("WF_CRASH")
+    p = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert p.stdout.strip().endswith("3")
+    # step_a persisted before the crash: exactly one execution ever.
+    # step_b crashed before persisting: re-executed once on resume.
+    assert len((counts / "a").read_text()) == 1
+    assert len((counts / "b").read_text()) == 2
+    assert len((counts / "c").read_text()) == 1
