@@ -20,7 +20,9 @@ def main():
     prep = Chain(SimpleImputer(["x"]), StandardScaler(["x"]))
     ds = prep.fit_transform(ds).random_shuffle(seed=0)
     n, mean = 0, 0.0
-    for batch in ds.to_jax(batch_size=128):
+    # drop_last defaults True (static shapes for jit); ETL counting wants
+    # the ragged tail too
+    for batch in ds.to_jax(batch_size=128, drop_last=False):
         n += batch["x"].shape[0]
         mean += float(batch["x"].sum())
     print(f"consumed {n} rows; post-scaling mean={mean / n:+.4f}")

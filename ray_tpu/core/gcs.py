@@ -261,6 +261,8 @@ class GcsServer:
     async def stop(self) -> None:
         if getattr(self, "_sync_task", None):
             self._sync_task.cancel()
+        if getattr(self, "_loop_monitor", None) is not None:
+            self._loop_monitor.stop()
         if self._health_task:
             self._health_task.cancel()
         if self._pg_retry_task:

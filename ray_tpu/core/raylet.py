@@ -209,6 +209,8 @@ class Raylet:
 
     async def stop(self) -> None:
         self._closing = True
+        if getattr(self, "_loop_monitor", None) is not None:
+            self._loop_monitor.stop()
         for t in self._tasks:
             t.cancel()
         for w in list(self.workers.values()):
@@ -238,10 +240,14 @@ class Raylet:
         self._maybe_schedule()  # fresh capacity may unblock queued work
 
     async def _resync_view(self) -> None:
+        version_before = self._view_version
         view = await self.gcs_conn.call("get_nodes", {}, timeout=5.0)
         self._view_by_id = {bytes(n["node_id"]): n for n in view}
         self._cluster_view = list(self._view_by_id.values())
-        self._view_stale = False
+        # deltas that landed during the await were dropped (stale mode)
+        # but may POSTDATE this snapshot (e.g. a node death that never
+        # re-dirties) — refetch next beat rather than trusting it
+        self._view_stale = self._view_version != version_before
         self._maybe_schedule()
 
     async def _health_loop(self) -> None:

@@ -9,6 +9,13 @@ plane), so pickling cost is bounded by control-message size.
 
 Frame layout: ``[8B little-endian length][payload]`` where payload is
 ``pickle((msg_id, kind, method, data))``.
+
+Transport: a raw ``asyncio.Protocol`` (not StreamReader/Writer) — frames
+are parsed in ``data_received`` with zero coroutine overhead and all
+frames arriving in one TCP segment dispatch in one tight loop; outbound
+frames produced within one event-loop tick coalesce into a single
+transport write.  On nop-task storms the reader-coroutine version spent
+~40% of loop time in readexactly wakeups.
 """
 
 from __future__ import annotations
@@ -45,43 +52,126 @@ class ConnectionLost(Exception):
     pass
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Any:
-    header = await reader.readexactly(_LEN.size)
-    (length,) = _LEN.unpack(header)
-    payload = await reader.readexactly(length)
-    return pickle.loads(payload)
+class _FrameProtocol(asyncio.Protocol):
+    """Length-prefixed frame parser bound to one Connection."""
 
+    def __init__(self, handler: Optional["Server"] = None,
+                 on_close: Optional[Callable[["Connection"], None]] = None,
+                 server_side: bool = False):
+        self._handler = handler
+        self._on_close = on_close
+        self._server_side = server_side
+        self._buf = bytearray()
+        self.conn: Optional[Connection] = None
 
-def _write_frame(writer: asyncio.StreamWriter, message: Any) -> None:
-    payload = pickle.dumps(message, protocol=5)
-    writer.write(_LEN.pack(len(payload)) + payload)
+    def connection_made(self, transport) -> None:
+        self.conn = Connection(transport, self, handler=self._handler,
+                               on_close=self._on_close)
+        # only server-ACCEPTED links join server.connections / fire the
+        # on_connection hook; client-initiated links may carry a handler
+        # (so the peer can call back) without being tracked
+        if self._server_side and self._handler is not None:
+            self._handler._on_connect(self.conn)
+
+    def connection_lost(self, exc) -> None:
+        if self.conn is not None:
+            self.conn._teardown()
+
+    def pause_writing(self) -> None:
+        if self.conn is not None:
+            self.conn._writable.clear()
+
+    def resume_writing(self) -> None:
+        if self.conn is not None:
+            self.conn._writable.set()
+
+    def data_received(self, data: bytes) -> None:
+        buf = self._buf
+        buf += data
+        offset = 0
+        total = len(buf)
+        conn = self.conn
+        while True:
+            if total - offset < 8:
+                break
+            (length,) = _LEN.unpack_from(buf, offset)
+            if total - offset - 8 < length:
+                break
+            frame_end = offset + 8 + length
+            try:
+                message = pickle.loads(
+                    memoryview(buf)[offset + 8:frame_end])
+            except Exception:
+                logger.exception("undecodable frame from %s",
+                                 conn.peername if conn else "?")
+                offset = frame_end
+                continue
+            offset = frame_end
+            if conn is not None:
+                try:
+                    conn._on_frame(message)
+                except Exception:
+                    # a malformed frame (e.g. not a 4-tuple) must skip,
+                    # not fatal-error the transport and kill every
+                    # in-flight RPC on the link
+                    logger.exception("bad frame from %s", conn.peername)
+        if offset:
+            del buf[:offset]
 
 
 class Connection:
     """One bidirectional peer link; usable as client and/or server side."""
 
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+    def __init__(self, transport, protocol: _FrameProtocol,
                  handler: Optional["Server"] = None,
                  on_close: Optional[Callable[["Connection"], None]] = None):
-        self._reader = reader
-        self._writer = writer
+        self._transport = transport
+        self._protocol = protocol
         self._handler = handler
         self._on_close = on_close
         self._msg_ids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._push_handler: Optional[Callable[[str, Any], None]] = None
         self._closed = False
-        self.peername = writer.get_extra_info("peername")
+        self.peername = transport.get_extra_info("peername")
         # Outbound frames produced within one event-loop tick coalesce
         # into a single transport write (one send(2) instead of one per
         # frame) — the per-frame syscall dominated nop-task storms.
         self._wbuf: list = []
         self._wflush_scheduled = False
         self._loop = asyncio.get_running_loop()
-        self._loop_task = self._loop.create_task(self._run())
+        self._writable = asyncio.Event()
+        self._writable.set()
         # Application state slot (e.g. the worker/node this conn belongs to).
         self.context: Dict[str, Any] = {}
 
+    # -- receive path ----------------------------------------------------
+    def _on_frame(self, message: Any) -> None:
+        msg_id, kind, method, data = message
+        if kind == KIND_REQ:
+            self._loop.create_task(self._dispatch(msg_id, method, data))
+        elif kind == KIND_REP:
+            fut = self._pending.pop(msg_id, None)
+            if fut is not None and not fut.done():
+                fut.set_result(data)
+        elif kind == KIND_ERR:
+            fut = self._pending.pop(msg_id, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(RpcError(data))
+        elif kind == KIND_PUSH:
+            try:
+                if self._push_handler is not None:
+                    self._push_handler(method, data)
+                elif self._handler is not None:
+                    # server side: route to service push_<channel>
+                    self._handler.dispatch_push(self, method, data)
+            except Exception:
+                logger.exception("push handler failed: %s", method)
+
+    def set_push_handler(self, fn: Callable[[str, Any], None]) -> None:
+        self._push_handler = fn
+
+    # -- send path -------------------------------------------------------
     def _send_frame(self, message: Any) -> None:
         payload = pickle.dumps(message, protocol=5)
         self._wbuf.append(_LEN.pack(len(payload)))
@@ -99,43 +189,8 @@ class Connection:
         if self._closed:
             return
         try:
-            self._writer.write(buf)
+            self._transport.write(buf)
         except Exception:
-            self._teardown()
-
-    def set_push_handler(self, fn: Callable[[str, Any], None]) -> None:
-        self._push_handler = fn
-
-    async def _run(self) -> None:
-        try:
-            while True:
-                msg_id, kind, method, data = await _read_frame(self._reader)
-                if kind == KIND_REQ:
-                    asyncio.get_running_loop().create_task(
-                        self._dispatch(msg_id, method, data)
-                    )
-                elif kind == KIND_REP:
-                    fut = self._pending.pop(msg_id, None)
-                    if fut is not None and not fut.done():
-                        fut.set_result(data)
-                elif kind == KIND_ERR:
-                    fut = self._pending.pop(msg_id, None)
-                    if fut is not None and not fut.done():
-                        fut.set_exception(RpcError(data))
-                elif kind == KIND_PUSH:
-                    try:
-                        if self._push_handler is not None:
-                            self._push_handler(method, data)
-                        elif self._handler is not None:
-                            # server side: route to service push_<channel>
-                            self._handler.dispatch_push(self, method, data)
-                    except Exception:
-                        logger.exception("push handler failed: %s", method)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            pass
-        except Exception:
-            logger.exception("connection loop failed")
-        finally:
             self._teardown()
 
     def _teardown(self) -> None:
@@ -144,9 +199,9 @@ class Connection:
         self._closed = True
         if self._wbuf:
             # hand already-queued frames (e.g. a reply written this tick)
-            # to the transport so writer.close() can flush them
+            # to the transport so close() can flush them
             try:
-                self._writer.write(b"".join(self._wbuf))
+                self._transport.write(b"".join(self._wbuf))
             except Exception:
                 pass
             self._wbuf.clear()
@@ -154,8 +209,10 @@ class Connection:
             if not fut.done():
                 fut.set_exception(ConnectionLost())
         self._pending.clear()
+        # wake any drain() waiter parked on a paused transport
+        self._writable.set()
         try:
-            self._writer.close()
+            self._transport.close()
         except Exception:
             pass
         if self._on_close is not None:
@@ -217,7 +274,9 @@ class Connection:
 
     async def drain(self) -> None:
         self._flush_wbuf()
-        await self._writer.drain()
+        await self._writable.wait()
+        if self._closed:
+            raise ConnectionLost()
 
     def close(self) -> None:
         self._teardown()
@@ -240,9 +299,12 @@ class Server:
         self.handler_stats = None
 
     async def start(self) -> Address:
-        self._server = await asyncio.start_server(
-            self._on_connect, self._host, self._port
-        )
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_server(
+            lambda: _FrameProtocol(handler=self,
+                                   on_close=self._on_disconnect,
+                                   server_side=True),
+            self._host, self._port)
         sock = self._server.sockets[0]
         self._host, self._port = sock.getsockname()[:2]
         return (self._host, self._port)
@@ -251,10 +313,7 @@ class Server:
     def address(self) -> Address:
         return (self._host, self._port)
 
-    async def _on_connect(self, reader: asyncio.StreamReader,
-                          writer: asyncio.StreamWriter) -> None:
-        conn = Connection(reader, writer, handler=self,
-                          on_close=self._on_disconnect)
+    def _on_connect(self, conn: Connection) -> None:
         self.connections.add(conn)
         hook = getattr(self._service, "on_connection", None)
         if hook is not None:
@@ -303,10 +362,14 @@ class Server:
 
 async def connect(address: Address, handler: Optional[Server] = None,
                   timeout: float = 10.0) -> Connection:
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(address[0], address[1]), timeout
-    )
-    return Connection(reader, writer, handler=handler)
+    loop = asyncio.get_running_loop()
+    _, protocol = await asyncio.wait_for(
+        loop.create_connection(
+            lambda: _FrameProtocol(handler=handler), address[0],
+            address[1]),
+        timeout)
+    assert protocol.conn is not None
+    return protocol.conn
 
 
 class ConnectionPool:

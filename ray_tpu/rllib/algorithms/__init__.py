@@ -14,6 +14,10 @@ from ray_tpu.rllib.algorithms.ddpg import (  # noqa: F401
     TD3,
     TD3Config,
 )
+from ray_tpu.rllib.algorithms.alpha_zero import (  # noqa: F401
+    AlphaZero,
+    AlphaZeroConfig,
+)
 from ray_tpu.rllib.algorithms.dqn import (  # noqa: F401
     ApexDQN,
     ApexDQNConfig,
@@ -23,6 +27,7 @@ from ray_tpu.rllib.algorithms.dqn import (  # noqa: F401
     SimpleQ,
     SimpleQConfig,
 )
+from ray_tpu.rllib.algorithms.dt import DT, DTConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.es import (  # noqa: F401
     ARS,
     ARSConfig,
@@ -62,4 +67,9 @@ from ray_tpu.rllib.algorithms.pg import (  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, PPOPolicy  # noqa: F401
 from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config, R2D2Policy  # noqa: F401
+from ray_tpu.rllib.algorithms.slateq import (  # noqa: F401
+    SimpleRecEnv,
+    SlateQ,
+    SlateQConfig,
+)
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig, SACPolicy  # noqa: F401

@@ -1,0 +1,309 @@
+"""AlphaZero: MCTS-guided policy iteration.
+
+Parity: reference ``rllib/algorithms/alpha_zero/`` — PUCT tree search
+over a *cloneable* environment with priors/values from a policy+value
+network, trained on (visit-count distribution, observed return) targets.
+Like the reference's single-player variant, the env contract is
+``get_state()/set_state()`` (deep-copyable state) and deterministic
+transitions; the bundled smoke target is deterministic CartPole via
+state snapshotting.
+
+jax-native: batch leaf evaluation is one jitted forward; the tree walk
+itself is host-side Python (tiny and branchy — exactly what should NOT
+be lowered to XLA).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env import Discrete, make_env
+from ray_tpu.rllib.models import FCNet
+
+
+class AlphaZeroConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.num_simulations = 30
+        self.c_puct = 1.5
+        self.dirichlet_alpha = 0.3
+        self.dirichlet_frac = 0.25
+        self.temperature_steps = 20  # sample by visit counts this long
+        self.train_batch_size = 128
+        self.replay_buffer_capacity = 20_000
+        self.rollout_episodes_per_step = 2
+        self.updates_per_step = 8
+        self.max_episode_steps = 200
+        self.gamma = 0.997
+
+    @property
+    def algo_class(self):
+        return AlphaZero
+
+
+class _Node:
+    __slots__ = ("prior", "visits", "value_sum", "children", "reward",
+                 "state", "obs", "done")
+
+    def __init__(self, prior: float):
+        self.prior = prior
+        self.visits = 0
+        self.value_sum = 0.0
+        self.children: Dict[int, "_Node"] = {}
+        self.reward = 0.0
+        self.state = None
+        self.obs = None
+        self.done = False
+
+    @property
+    def value(self) -> float:
+        return self.value_sum / self.visits if self.visits else 0.0
+
+
+def _env_state(env):
+    """Snapshot for tree search: env.get_state() when provided, else a
+    deepcopy of the env's __dict__ (works for the bundled pure-python
+    envs — the reference similarly requires cloneable envs)."""
+    fn = getattr(env, "get_state", None)
+    if fn is not None:
+        return fn()
+    return copy.deepcopy(env.__dict__)
+
+
+def _env_restore(env, state) -> None:
+    fn = getattr(env, "set_state", None)
+    if fn is not None:
+        fn(state)
+    else:
+        env.__dict__.update(copy.deepcopy(state))
+
+
+class AlphaZero(Algorithm):
+    def setup(self) -> None:
+        cfg = self.config
+        self.env = make_env(cfg["env"], dict(cfg.get("env_config", {})))
+        if not isinstance(self.env.action_space, Discrete):
+            raise ValueError("AlphaZero requires a Discrete action space")
+        self.num_actions = int(self.env.action_space.n)
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.model = FCNet(num_outputs=self.num_actions,
+                           hiddens=(64, 64), vf_share_layers=True)
+        rng = jax.random.PRNGKey(int(cfg.get("seed", 0) or 0))
+        self._rng, init_rng = jax.random.split(rng)
+        self.params = self.model.init(
+            init_rng, jnp.zeros((1, self.obs_dim), jnp.float32))
+        self.opt = optax.adam(float(cfg.get("lr", 1e-3)))
+        self.opt_state = self.opt.init(self.params)
+
+        model = self.model
+
+        @jax.jit
+        def _infer(params, obs):
+            logits, value = model.apply(params, obs)
+            return jax.nn.softmax(logits, axis=-1), value
+
+        @jax.jit
+        def _update(params, opt_state, batch):
+            def loss_fn(p):
+                logits, value = model.apply(p, batch["obs"])
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                policy_loss = -jnp.mean(
+                    jnp.sum(batch["pi"] * logp, axis=-1))
+                value_loss = jnp.mean((value - batch["z"]) ** 2)
+                return policy_loss + value_loss, (policy_loss, value_loss)
+
+            (_, (pl, vl)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, pl, vl
+
+        self._infer = _infer
+        self._update = _update
+        from collections import deque
+        self._replay: deque = deque(
+            maxlen=int(cfg.get("replay_buffer_capacity", 20_000)))
+        self._np_rng = np.random.default_rng(int(cfg.get("seed", 0) or 0))
+        self._pending_returns: List[float] = []
+        self._pending_lens: List[int] = []
+
+    # -- MCTS -----------------------------------------------------------
+    def _evaluate(self, obs: np.ndarray) -> Tuple[np.ndarray, float]:
+        priors, value = self._infer(
+            self.params, jnp.asarray(obs[None], jnp.float32))
+        return np.asarray(priors)[0], float(np.asarray(value)[0])
+
+    def _mcts(self, env, obs: np.ndarray, explore: bool) -> np.ndarray:
+        cfg = self.config
+        n_sim = int(cfg.get("num_simulations", 30))
+        c_puct = float(cfg.get("c_puct", 1.5))
+        gamma = float(cfg.get("gamma", 0.997))
+
+        root = _Node(0.0)
+        root.state = _env_state(env)
+        root.obs = obs
+        priors, value = self._evaluate(obs)
+        if explore:
+            noise = self._np_rng.dirichlet(
+                [float(cfg.get("dirichlet_alpha", 0.3))] * self.num_actions)
+            frac = float(cfg.get("dirichlet_frac", 0.25))
+            priors = (1 - frac) * priors + frac * noise
+        for a in range(self.num_actions):
+            root.children[a] = _Node(float(priors[a]))
+        root.visits = 1
+        root.value_sum = value
+
+        for _ in range(n_sim):
+            node = root
+            path = [root]
+            # select to a leaf
+            while node.children and not node.done:
+                total = math.sqrt(node.visits)
+                best, best_score = None, -float("inf")
+                for a, child in node.children.items():
+                    u = child.value + c_puct * child.prior * total / (
+                        1 + child.visits)
+                    if u > best_score:
+                        best, best_score = a, u
+                action = best
+                parent = node
+                node = node.children[action]
+                if node.state is None:
+                    # expand: step a restored copy of the env
+                    _env_restore(env, parent.state)
+                    nobs, rew, term, trunc, _ = env.step(action)
+                    node.state = _env_state(env)
+                    node.obs = np.asarray(nobs, np.float32)
+                    node.reward = float(rew)
+                    node.done = bool(term or trunc)
+                path.append(node)
+            # evaluate leaf
+            if node.done:
+                leaf_value = 0.0
+            else:
+                priors, leaf_value = self._evaluate(node.obs)
+                if not node.children:
+                    for a in range(self.num_actions):
+                        node.children[a] = _Node(float(priors[a]))
+            # backup (discounted through the path's rewards)
+            value = leaf_value
+            for n in reversed(path):
+                n.visits += 1
+                n.value_sum += value
+                value = n.reward + gamma * value
+        counts = np.asarray(
+            [root.children[a].visits for a in range(self.num_actions)],
+            np.float64)
+        _env_restore(env, root.state)
+        return counts / counts.sum()
+
+    # -- self-play ------------------------------------------------------
+    def _run_episode(self, explore: bool = True) -> Tuple[float, int]:
+        cfg = self.config
+        obs, _ = self.env.reset()
+        obs = np.asarray(obs, np.float32)
+        history: List[Tuple[np.ndarray, np.ndarray, float]] = []
+        total, steps = 0.0, 0
+        max_steps = int(cfg.get("max_episode_steps", 200))
+        temp_steps = int(cfg.get("temperature_steps", 20))
+        while steps < max_steps:
+            pi = self._mcts(self.env, obs, explore)
+            if explore and steps < temp_steps:
+                action = int(self._np_rng.choice(self.num_actions, p=pi))
+            else:
+                action = int(np.argmax(pi))
+            nobs, rew, term, trunc, _ = self.env.step(action)
+            history.append((obs, pi, float(rew)))
+            total += float(rew)
+            steps += 1
+            self._timesteps_total += 1
+            obs = np.asarray(nobs, np.float32)
+            if term or trunc:
+                break
+        # returns-to-go as value targets
+        gamma = float(cfg.get("gamma", 0.997))
+        z = 0.0
+        for obs_t, pi_t, rew_t in reversed(history):
+            z = rew_t + gamma * z
+            self._replay.append((obs_t, pi_t.astype(np.float32),
+                                 float(z)))
+        return total, steps
+
+    # -- training -------------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        for _ in range(int(cfg.get("rollout_episodes_per_step", 2))):
+            ret, length = self._run_episode()
+            self._pending_returns.append(ret)
+            self._pending_lens.append(length)
+        stats: Dict[str, Any] = {"replay_size": len(self._replay)}
+        bs = int(cfg.get("train_batch_size", 128))
+        if len(self._replay) >= bs:
+            for _ in range(int(cfg.get("updates_per_step", 8))):
+                idx = self._np_rng.integers(0, len(self._replay), bs)
+                rows = [self._replay[i] for i in idx]
+                batch = {
+                    "obs": jnp.asarray(np.stack([r[0] for r in rows])),
+                    "pi": jnp.asarray(np.stack([r[1] for r in rows])),
+                    "z": jnp.asarray(
+                        np.asarray([r[2] for r in rows], np.float32)),
+                }
+                self.params, self.opt_state, pl, vl = self._update(
+                    self.params, self.opt_state, batch)
+            stats["policy_loss"] = float(pl)
+            stats["value_loss"] = float(vl)
+        return stats
+
+    # -- Algorithm plumbing without a worker fleet ----------------------
+    def _collect_metrics(self):
+        out = [{"episode_returns": list(self._pending_returns),
+                "episode_lens": list(self._pending_lens)}]
+        self._pending_returns.clear()
+        self._pending_lens.clear()
+        return out
+
+    def evaluate(self) -> Dict[str, Any]:
+        returns = []
+        for _ in range(int(self.config.get("evaluation_duration", 5))):
+            ret, _ = self._run_episode(explore=False)
+            returns.append(ret)
+        return {"episode_reward_mean": float(np.mean(returns)),
+                "episode_reward_min": float(np.min(returns)),
+                "episode_reward_max": float(np.max(returns))}
+
+    def save(self, checkpoint_dir: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump({
+                "params": jax.tree_util.tree_map(np.asarray, self.params),
+                "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total,
+            }, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+
+    def stop(self) -> None:
+        pass

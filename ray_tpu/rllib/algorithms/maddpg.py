@@ -181,7 +181,19 @@ class MADDPG(Algorithm):
         self.params = self.model.init(init_rng, dummy_obs, dummy_jobs,
                                       dummy_jact)
         self.target_params = self.params
-        self.opt = optax.adam(float(cfg.get("critic_lr", 1e-3)))
+
+        def _labels(params):
+            # top-level flax names are actors_<i> / critics_<i>
+            return {**params, "params": {
+                k: jax.tree_util.tree_map(
+                    lambda _: "actor" if k.startswith("actors")
+                    else "critic", v)
+                for k, v in params["params"].items()}}
+
+        self.opt = optax.multi_transform(
+            {"actor": optax.adam(float(cfg.get("actor_lr", 1e-3))),
+             "critic": optax.adam(float(cfg.get("critic_lr", 1e-3)))},
+            _labels)
         self.opt_state = self.opt.init(self.params)
 
         model = self.model

@@ -30,6 +30,8 @@ def _algo_config(name: str):
             "PG": algos.PGConfig, "A2C": algos.A2CConfig,
             "QMIX": algos.QMixConfig, "MADDPG": algos.MADDPGConfig,
             "R2D2": algos.R2D2Config, "ES": algos.ESConfig,
+            "SlateQ": algos.SlateQConfig,
+            "AlphaZero": algos.AlphaZeroConfig, "DT": algos.DTConfig,
         }
     return _ALGO_BY_NAME[name]()
 

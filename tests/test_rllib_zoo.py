@@ -369,3 +369,90 @@ def test_multi_agent_per_agent_policies_and_checkpoint(tmp_path):
     assert np.isfinite(ev["episode_reward_mean"])
     algo.stop()
     algo2.stop()
+
+
+
+def test_decision_transformer_offline():
+    """DT trains on offline episodes and a return-conditioned rollout
+    runs end-to-end (parity model: rllib/algorithms/dt)."""
+    from ray_tpu.rllib.algorithms import DTConfig
+
+    # synthesize offline data from a scripted cartpole-ish controller
+    from ray_tpu.rllib import CartPole
+
+    env = CartPole({"seed": 0})
+    episodes = []
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        obs, _ = env.reset()
+        o_l, a_l, r_l = [], [], []
+        done = False
+        while not done:
+            action = int(obs[2] > 0)  # lean-following heuristic
+            if rng.random() < 0.2:
+                action = int(rng.integers(2))
+            o_l.append(np.asarray(obs, np.float32))
+            nobs, rew, term, trunc, _ = env.step(action)
+            a_l.append(action)
+            r_l.append(rew)
+            obs = nobs
+            done = term or trunc
+        episodes.append({"obs": np.stack(o_l),
+                         "actions": np.asarray(a_l, np.int64),
+                         "rewards": np.asarray(r_l, np.float32)})
+
+    config = DTConfig().environment("CartPole-v1").debugging(seed=0)
+    config.input_ = episodes
+    config.num_sgd_iter_per_step = 30
+    algo = config.build()
+    r1 = algo.train()
+    r2 = algo.train()
+    assert np.isfinite(r2["loss"]) and r2["loss"] < r1["loss"] * 1.5
+    ev = algo.evaluate()
+    assert np.isfinite(ev["episode_reward_mean"])
+    algo.stop()
+
+
+def test_slateq_learns_clicks():
+    """SlateQ improves click reward on the bundled RecSim-style env."""
+    from ray_tpu.rllib.algorithms import SlateQConfig
+
+    config = SlateQConfig().environment("SimpleRecEnv",
+                                        env_config={"seed": 0})
+    config.rollout_episodes_per_step = 8
+    config.epsilon_timesteps = 1500
+    config.num_steps_sampled_before_learning_starts = 300
+    algo = config.build()
+    curve = []
+    for _ in range(15):
+        r = algo.train()
+        rm = r.get("episode_reward_mean")
+        if rm is not None and not np.isnan(rm):
+            curve.append(rm)
+    assert curve and np.isfinite(curve[-1])
+    # the greedy slate beats random exploration's early average
+    ev = algo.evaluate()
+    assert ev["episode_reward_mean"] > curve[0] - 0.5
+    algo.stop()
+
+
+def test_alpha_zero_cartpole_smoke():
+    """AlphaZero's MCTS + policy/value training runs and produces a
+    playable policy (short smoke: full learning is the slow suite)."""
+    from ray_tpu.rllib.algorithms import AlphaZeroConfig
+
+    config = AlphaZeroConfig().environment(
+        "CartPole-v1", env_config={"seed": 0}).debugging(seed=0)
+    config.num_simulations = 12
+    config.rollout_episodes_per_step = 1
+    config.max_episode_steps = 60
+    config.train_batch_size = 64
+    algo = config.build()
+    r = None
+    for _ in range(4):
+        r = algo.train()
+    assert r["timesteps_total"] > 0
+    assert np.isfinite(r.get("policy_loss", 0.0))
+    ev = algo.evaluate()
+    assert ev["episode_reward_mean"] > 5  # search alone clears a bar
+    algo.stop()
