@@ -71,6 +71,102 @@ class WorkerHandle:
     is_actor: bool = False
 
 
+class _ForkedProc:
+    """Popen-compatible handle for a zygote-forked worker (pid only)."""
+
+    __slots__ = ("pid", "returncode")
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            self.returncode = -1  # reaped by the zygote's SIGCHLD ignore
+            return self.returncode
+        except PermissionError:
+            return None
+
+    def _signal(self, sig: int) -> None:
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            pass
+
+    def terminate(self) -> None:
+        import signal as _signal
+
+        self._signal(_signal.SIGTERM)
+
+    def kill(self) -> None:
+        import signal as _signal
+
+        self._signal(_signal.SIGKILL)
+
+
+class _ZygoteClient:
+    """Raylet-side handle on the worker fork-server (worker_zygote.py).
+
+    ``spawn`` is a blocking call (write request line, read pid line) —
+    the raylet invokes it via ``run_in_executor``; a lock serializes
+    concurrent spawns over the single pipe pair."""
+
+    def __init__(self, session_dir: str):
+        import threading
+
+        self._session_dir = session_dir
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+
+    def _ensure_started(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            return
+        from ray_tpu.core.node import (preexec_die_with_parent,
+                                       safe_die_with_parent)
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # no accelerator plugin
+        env["RAY_TPU_WORKER"] = "1"
+        log = open(os.path.join(self._session_dir, "logs",
+                                "worker_zygote.err"), "ab")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_zygote"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=log,
+            env=env, cwd=os.getcwd(), text=True,
+            preexec_fn=preexec_die_with_parent
+            if safe_die_with_parent() else None)
+        ready = self._proc.stdout.readline()
+        if "ready" not in ready:
+            raise RuntimeError(f"worker zygote failed to start: {ready!r}")
+
+    def spawn(self, argv, env_updates, log_base) -> int:
+        import json as json_mod
+
+        with self._lock:
+            self._ensure_started()
+            req = {"argv": list(argv), "env": env_updates,
+                   "log_base": log_base}
+            self._proc.stdin.write(json_mod.dumps(req) + "\n")
+            self._proc.stdin.flush()
+            reply = self._proc.stdout.readline()
+            return int(json_mod.loads(reply)["pid"])
+
+    def stop(self) -> None:
+        proc, self._proc = self._proc, None
+        if proc is not None:
+            try:
+                proc.stdin.write('{"exit": true}\n')
+                proc.stdin.flush()
+            except Exception:
+                pass
+            proc.terminate()
+
+
 @dataclass
 class PendingLease:
     request: Dict[str, Any]
@@ -211,6 +307,8 @@ class Raylet:
         self._closing = True
         if getattr(self, "_loop_monitor", None) is not None:
             self._loop_monitor.stop()
+        if getattr(self, "_zygote", None) is not None:
+            self._zygote.stop()
         for t in self._tasks:
             t.cancel()
         for w in list(self.workers.values()):
@@ -536,8 +634,7 @@ class Raylet:
         log_base = os.path.join(self.session_dir, "logs",
                                 f"worker-{os.getpid()}-{self._starting}-{time.monotonic_ns()}")
         os.makedirs(os.path.dirname(log_base), exist_ok=True)
-        cmd = [
-            sys.executable, "-m", "ray_tpu.core.worker_main",
+        worker_args = [
             "--raylet", f"{self.server.address[0]}:{self.server.address[1]}",
             "--gcs", f"{self.gcs_address[0]}:{self.gcs_address[1]}",
             "--node-id", self.node_id.hex(),
@@ -546,7 +643,23 @@ class Raylet:
             "--session-dir", self.session_dir,
         ]
         if job_id_bin is not None:
-            cmd += ["--job-id", job_id_bin.hex()]
+            worker_args += ["--job-id", job_id_bin.hex()]
+        if not needs_tpu and time.monotonic() >= getattr(
+                self, "_zygote_broken_until", 0.0):
+            # fork from the warm zygote (~10 ms) instead of a cold
+            # interpreter (~300 ms) — actor-creation rate on many-core
+            # hosts is bounded by this.  Forked workers stay TPU-capable
+            # unless the host uses an import-time accelerator plugin
+            # (sitecustomize only runs at real interpreter start).
+            self._spawn_via_zygote(worker_args, log_base, tpu_capable,
+                                   env)
+            return
+        self._spawn_cold(worker_args, log_base, env, tpu_capable)
+
+    def _spawn_cold(self, worker_args, log_base: str, env: Dict[str, str],
+                    tpu_capable: bool) -> None:
+        cmd = [sys.executable, "-m", "ray_tpu.core.worker_main",
+               *worker_args]
         out = open(log_base + ".out", "ab")
         err = open(log_base + ".err", "ab")
         from ray_tpu.core.node import (preexec_die_with_parent,
@@ -565,6 +678,48 @@ class Raylet:
         self._log_pids[log_base + ".err"] = proc.pid
         # handle registered later in handle_register_worker; remember proc
         self._spawned_procs.append((proc, tpu_capable))
+
+    def _spawn_via_zygote(self, worker_args, log_base: str,
+                          tpu_capable: bool, env: Dict[str, str]) -> None:
+        if getattr(self, "_zygote", None) is None:
+            self._zygote = _ZygoteClient(self.session_dir)
+        loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(
+            None, self._zygote.spawn, worker_args,
+            {"RAY_TPU_WORKER": "1"}, log_base)
+
+        def _done(f):
+            try:
+                pid = f.result()
+            except Exception:
+                # broken zygote: cold-spawn this worker now and stop
+                # using the fork path for a while (a hot retry loop
+                # would pay a failed ~300ms zygote start per lease)
+                logger.exception(
+                    "zygote spawn failed; cold-spawning and backing off")
+                self._zygote_broken_until = time.monotonic() + 30.0
+                try:
+                    self._zygote.stop()
+                except Exception:
+                    pass
+                self._zygote = None
+                self._spawn_cold(worker_args, log_base, env, tpu_capable)
+                return
+            handle = _ForkedProc(pid)
+            self._log_pids[log_base + ".out"] = pid
+            self._log_pids[log_base + ".err"] = pid
+            # the child usually registers AFTER this callback (it must
+            # finish CoreWorker init first), but adopt either ordering
+            for worker in self.workers.values():
+                if worker.pid == pid and worker.proc is None:
+                    worker.proc = handle
+                    worker.tpu_capable = tpu_capable
+                    self._starting -= 1
+                    self._maybe_schedule()  # freed pool capacity
+                    return
+            self._spawned_procs.append((handle, tpu_capable))
+
+        fut.add_done_callback(_done)
 
     async def handle_register_worker(self, conn, data):
         if data.get("is_driver"):
