@@ -12,6 +12,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig
 from ray_tpu.tune import schedulers  # noqa: F401
+from ray_tpu.tune.bohb import BOHBSearcher, HyperBandForBOHB  # noqa: F401
 from ray_tpu.tune.execution import TrialRunner
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler,  # noqa: F401
                                      FIFOScheduler, HyperBandScheduler,
@@ -182,8 +183,11 @@ class Tuner:
                 if cfg is None:
                     remaining = 0
                     break
-                batch.append((f"sugg_{i}",
-                              Trial(config={**constants, **cfg})))
+                trial = Trial(config={**constants, **cfg})
+                # schedulers report mid-run observations to the searcher
+                # under this id (see HyperBandForBOHB)
+                trial.searcher_id = f"sugg_{i}"
+                batch.append((f"sugg_{i}", trial))
                 i += 1
             if not batch:
                 break
