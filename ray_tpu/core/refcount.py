@@ -49,6 +49,11 @@ class Reference:
     in_plasma: bool = False
     # lineage: the task that produces this object (owner-side)
     producing_task: Optional[TaskID] = None
+    # refs nested inside this object's serialized bytes: pinned (as
+    # submitted refs) until this object itself is freed, so readers can
+    # always borrow them (parity: the reference records nested ids on
+    # the owning reference)
+    contained_ids: List[ObjectID] = field(default_factory=list)
     freed: bool = False
 
 
@@ -83,6 +88,10 @@ class ReferenceCounter:
         try:
             if kind == "free":
                 self._on_free(object_id, payload)
+                # the freed object's nested refs lose their containment
+                # pin (may cascade; we are outside the lock)
+                for cid in payload.contained_ids:
+                    self.remove_submitted_ref(cid)
             else:  # "borrow_removed"
                 self._on_borrow_removed(object_id, payload)
         except Exception:  # callbacks must never poison the caller
@@ -107,6 +116,17 @@ class ReferenceCounter:
     def add_local_ref(self, object_id: ObjectID) -> None:
         with self._lock:
             self._get(object_id).local_refs += 1
+
+    def set_contained(self, object_id: ObjectID,
+                      contained: List[ObjectID]) -> None:
+        """Pin refs nested inside ``object_id``'s serialized value for
+        the outer object's lifetime (released on its free)."""
+        if not contained:
+            return
+        with self._lock:
+            self._get(object_id).contained_ids = list(contained)
+            for cid in contained:
+                self._get(cid).submitted_refs += 1
 
     def remove_local_ref(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -243,12 +263,20 @@ class TaskManager:
         self._lineage: Dict[TaskID, TaskSpec] = {}
         self._rc = reference_counter
 
+    @staticmethod
+    def _arg_ids(spec: TaskSpec):
+        """Every object id a task's flight must pin: direct ref args plus
+        refs nested inside inlined values."""
+        for arg in spec.args:
+            if arg.object_id is not None:
+                yield arg.object_id
+            yield from arg.contained_ids
+
     def register(self, spec: TaskSpec) -> None:
         for ret in spec.return_ids():
             self._rc.add_owned(ret, producing_task=spec.task_id)
-        for arg in spec.args:
-            if arg.object_id is not None:
-                self._rc.add_submitted_ref(arg.object_id)
+        for oid in self._arg_ids(spec):
+            self._rc.add_submitted_ref(oid)
         with self._lock:
             self._pending[spec.task_id] = PendingTask(
                 spec=spec, retries_left=spec.max_retries)
@@ -268,9 +296,8 @@ class TaskManager:
             if entry is None:
                 return None
             self._lineage[task_id] = entry.spec
-        for arg in entry.spec.args:
-            if arg.object_id is not None:
-                self._rc.remove_submitted_ref(arg.object_id)
+        for oid in self._arg_ids(entry.spec):
+            self._rc.remove_submitted_ref(oid)
         return entry.spec
 
     def take_for_retry(self, task_id: TaskID) -> Optional[TaskSpec]:
@@ -288,9 +315,8 @@ class TaskManager:
             entry = self._pending.pop(task_id, None)
             if entry is None:
                 return None
-        for arg in entry.spec.args:
-            if arg.object_id is not None:
-                self._rc.remove_submitted_ref(arg.object_id)
+        for oid in self._arg_ids(entry.spec):
+            self._rc.remove_submitted_ref(oid)
         return entry.spec
 
     def lineage_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
@@ -308,9 +334,8 @@ class TaskManager:
                 return None  # already being re-executed
             spec.attempt_number += 1
             self._pending[task_id] = PendingTask(spec=spec, retries_left=0)
-        for arg in spec.args:
-            if arg.object_id is not None:
-                self._rc.add_submitted_ref(arg.object_id)
+        for oid in self._arg_ids(spec):
+            self._rc.add_submitted_ref(oid)
         return spec
 
     def evict_lineage(self, task_id: TaskID) -> None:

@@ -32,6 +32,11 @@ class TaskArg:
     value_bytes: Optional[bytes] = None
     object_id: Optional[ObjectID] = None
     owner_address: Optional[OwnerAddress] = None
+    # ObjectRefs nested INSIDE an inlined value (e.g. a dict of refs):
+    # pinned as submitted-refs for the task's flight so the owner cannot
+    # free them before the borrowing worker registers (parity: the
+    # reference's borrowing protocol pins args until execution).
+    contained_ids: List[ObjectID] = field(default_factory=list)
 
     def is_inline(self) -> bool:
         return self.value_bytes is not None

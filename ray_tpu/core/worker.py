@@ -409,6 +409,10 @@ class CoreWorker:
         object_id = self._next_put_id()
         ser = serialize(value)
         self.reference_counter.add_owned(object_id)
+        # refs nested inside the stored value stay alive for the stored
+        # object's lifetime — any later reader must be able to borrow
+        self.reference_counter.set_contained(
+            object_id, [r.id() for r in ser.contained_refs])
         ref = ObjectRef(object_id, self.address)
         if ser.total_size() <= self.config.max_direct_call_object_size:
             self._publish(object_id, ser.to_bytes())
@@ -839,7 +843,16 @@ class CoreWorker:
                 out.append(TaskArg(object_id=ref.id(),
                                    owner_address=ref.owner_address()))
             else:
-                out.append(TaskArg(value_bytes=ser.to_bytes()))
+                # refs nested inside the value must survive until the
+                # executing worker borrows them — record them so the
+                # TaskManager pins submitted-refs for the flight; they
+                # also join `holds` so paths that never register a task
+                # (actor creation keeps holds for the actor's lifetime)
+                # still pin them
+                out.append(TaskArg(
+                    value_bytes=ser.to_bytes(),
+                    contained_ids=[r.id() for r in ser.contained_refs]))
+                holds.extend(ser.contained_refs)
         return out, holds
 
     def _submit_to_lease_queue(self, spec: TaskSpec) -> None:

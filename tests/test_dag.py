@@ -89,3 +89,62 @@ def test_nested_structure_args():
     with InputNode() as inp:
         dag = total.bind({"values": [mul.bind(inp, 2), mul.bind(inp, 5)]})
     assert ray_tpu.get(dag.execute(3), timeout=60) == 21
+
+
+def test_nested_refs_pinned_while_task_in_flight():
+    """Refs nested inside an inlined arg are pinned as submitted-refs for
+    the task's flight: even if the driver drops its local refs right
+    after submission, the borrowing worker can still fetch the values
+    (this was a flaky free-vs-borrow race before contained_ids)."""
+    import gc
+    import time
+
+    @ray_tpu.remote
+    def slow_sum(d):
+        time.sleep(0.5)  # widen the window: driver GC runs first
+        return sum(ray_tpu.get(list(d["refs"])))
+
+    @ray_tpu.remote
+    def make(x):
+        return x
+
+    refs = [make.remote(i) for i in range(4)]
+    out = slow_sum.remote({"refs": refs})
+    del refs  # driver's locals gone; only the in-flight pin remains
+    gc.collect()
+    assert ray_tpu.get(out, timeout=60) == 6
+
+
+def test_nested_refs_pinned_inside_promoted_and_put_objects():
+    """Nested refs survive inside (a) large args promoted to the object
+    store and (b) explicit put() objects, for the outer object's
+    lifetime — not just the task flight."""
+    import gc
+    import numpy as np
+    import time
+
+    @ray_tpu.remote
+    def make(x):
+        return x
+
+    @ray_tpu.remote
+    def slow_sum(d):
+        time.sleep(0.3)
+        return sum(ray_tpu.get(list(d["refs"])))
+
+    refs = [make.remote(i) for i in range(3)]
+    # (a) pad the dict over max_direct_call_object_size -> promoted arg
+    big = {"refs": refs, "pad": np.zeros(1_000_000, np.uint8)}
+    out = slow_sum.remote(big)
+    del refs, big
+    gc.collect()
+    assert ray_tpu.get(out, timeout=60) == 3
+
+    # (b) put() an object containing refs; drop locals; read much later
+    inner = [make.remote(10), make.remote(20)]
+    stored = ray_tpu.put({"refs": inner})
+    del inner
+    gc.collect()
+    time.sleep(0.3)
+    got = ray_tpu.get(stored)
+    assert sum(ray_tpu.get(list(got["refs"]))) == 30
