@@ -27,6 +27,10 @@ from ray_tpu.rllib.algorithms.dqn import (  # noqa: F401
     SimpleQ,
     SimpleQConfig,
 )
+from ray_tpu.rllib.algorithms.dreamer import (  # noqa: F401
+    Dreamer,
+    DreamerConfig,
+)
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.es import (  # noqa: F401
     ARS,

@@ -1,0 +1,499 @@
+"""Dreamer: model-based RL — learn a latent world model, train the
+policy in imagination.
+
+Parity: reference ``rllib/algorithms/dreamer/`` (DreamerV1, scoped to
+vector observations): an RSSM world model (deterministic GRU path +
+stochastic latent) trained on replayed sequences with reconstruction,
+reward, and KL losses; an actor and a value function trained on
+imagined latent rollouts with lambda-returns.
+
+jax-native: both the RSSM posterior walk over a replayed sequence and
+the imagination rollout are ``lax.scan``s, so world-model and behavior
+updates are each ONE jitted program — no per-step Python in the hot
+loop, exactly the shape the MXU/XLA want.  Model sizes are deliberately
+small (vector envs); the structure, not the capacity, is the parity
+target.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env import Discrete, make_env
+
+
+class DreamerConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.model_lr = 3e-4
+        self.actor_lr = 8e-5
+        self.critic_lr = 8e-5
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.deter_size = 64
+        self.stoch_size = 16
+        self.hidden_size = 64
+        self.batch_size = 16
+        self.batch_length = 20
+        self.imagine_horizon = 10
+        self.free_nats = 1.0
+        self.kl_scale = 1.0
+        self.replay_buffer_capacity = 500  # episodes
+        self.prefill_episodes = 5
+        self.rollout_episodes_per_step = 1
+        self.train_iters_per_step = 20
+        self.explore_noise = 0.3  # epsilon for discrete actions
+
+
+    @property
+    def algo_class(self):
+        return Dreamer
+
+
+class _RSSM(nn.Module):
+    """Recurrent state-space model: deter (GRU) + stoch (gaussian)."""
+
+    deter_size: int
+    stoch_size: int
+    hidden_size: int
+    obs_dim: int
+    num_actions: int
+
+    def setup(self):
+        self.gru = nn.GRUCell(features=self.deter_size)
+        self.pre_gru = nn.Dense(self.hidden_size, name="pre_gru")
+        self.prior_net = nn.Dense(2 * self.stoch_size, name="prior")
+        self.post_net = nn.Dense(2 * self.stoch_size, name="post")
+        self.obs_embed = nn.Dense(self.hidden_size, name="obs_embed")
+        self.decoder = nn.Sequential([
+            nn.Dense(self.hidden_size), nn.elu,
+            nn.Dense(self.obs_dim)])
+        self.reward_head = nn.Sequential([
+            nn.Dense(self.hidden_size), nn.elu, nn.Dense(1)])
+        self.cont_head = nn.Sequential([
+            nn.Dense(self.hidden_size), nn.elu, nn.Dense(1)])
+
+    # -- single transitions --------------------------------------------
+    def _split(self, stats):
+        mean, std = jnp.split(stats, 2, axis=-1)
+        return mean, nn.softplus(std) + 0.1
+
+    def prior_step(self, deter, stoch, action, rng):
+        """(h, z, a) -> next (h, prior stats, z')."""
+        x = nn.elu(self.pre_gru(jnp.concatenate(
+            [stoch, action], axis=-1)))
+        deter, _ = self.gru(deter, x)
+        stats = self.prior_net(deter)
+        mean, std = self._split(stats)
+        stoch = mean + std * jax.random.normal(rng, mean.shape)
+        return deter, (mean, std), stoch
+
+    def posterior(self, deter, obs):
+        emb = nn.elu(self.obs_embed(obs))
+        stats = self.post_net(jnp.concatenate([deter, emb], axis=-1))
+        return self._split(stats)
+
+    def features(self, deter, stoch):
+        return jnp.concatenate([deter, stoch], axis=-1)
+
+    def decode(self, feat):
+        return self.decoder(feat)
+
+    def reward(self, feat):
+        return self.reward_head(feat)[..., 0]
+
+    def cont(self, feat):
+        return self.cont_head(feat)[..., 0]
+
+    def __call__(self, deter, stoch, action, obs, rng):  # init entry
+        deter, prior, prior_stoch = self.prior_step(deter, stoch, action,
+                                                    rng)
+        post = self.posterior(deter, obs)
+        feat = self.features(deter, prior_stoch)
+        return self.decode(feat), self.reward(feat), self.cont(feat), \
+            prior, post
+
+
+class _Head(nn.Module):
+    out: int
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.elu(nn.Dense(self.hidden)(x))
+        x = nn.elu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.out)(x)
+
+
+class Dreamer(Algorithm):
+    def setup(self) -> None:
+        cfg = self.config
+        self.env = make_env(cfg["env"], dict(cfg.get("env_config", {})))
+        if not isinstance(self.env.action_space, Discrete):
+            raise ValueError("this Dreamer supports Discrete actions")
+        self.num_actions = int(self.env.action_space.n)
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        deter = int(cfg.get("deter_size", 64))
+        stoch = int(cfg.get("stoch_size", 16))
+        hidden = int(cfg.get("hidden_size", 64))
+
+        self.wm = _RSSM(deter_size=deter, stoch_size=stoch,
+                        hidden_size=hidden, obs_dim=self.obs_dim,
+                        num_actions=self.num_actions)
+        self.actor = _Head(self.num_actions, hidden)
+        self.critic = _Head(1, hidden)
+
+        rng = jax.random.PRNGKey(int(cfg.get("seed", 0) or 0))
+        self._rng, k1, k2, k3 = jax.random.split(rng, 4)
+        feat_dim = deter + stoch
+        self.wm_params = self.wm.init(
+            k1, jnp.zeros((1, deter)), jnp.zeros((1, stoch)),
+            jnp.zeros((1, self.num_actions)),
+            jnp.zeros((1, self.obs_dim)), k1)
+        self.actor_params = self.actor.init(
+            k2, jnp.zeros((1, feat_dim)))
+        self.critic_params = self.critic.init(
+            k3, jnp.zeros((1, feat_dim)))
+        self.wm_opt = optax.adam(float(cfg.get("model_lr", 3e-4)))
+        self.actor_opt = optax.adam(float(cfg.get("actor_lr", 8e-5)))
+        self.critic_opt = optax.adam(float(cfg.get("critic_lr", 8e-5)))
+        self.wm_opt_state = self.wm_opt.init(self.wm_params)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.critic_opt_state = self.critic_opt.init(self.critic_params)
+
+        wm, actor, critic = self.wm, self.actor, self.critic
+        gamma = float(cfg.get("gamma", 0.99))
+        lam = float(cfg.get("lambda_", 0.95))
+        horizon = int(cfg.get("imagine_horizon", 10))
+        free_nats = float(cfg.get("free_nats", 1.0))
+        kl_scale = float(cfg.get("kl_scale", 1.0))
+        n_act = self.num_actions
+
+        def observe_sequence(wp, obs_seq, act_seq, rng):
+            """Posterior walk over [B,T,...]; returns features + stats."""
+            batch = obs_seq.shape[0]
+
+            def step(carry, inputs):
+                deter, stoch, rng_ = carry
+                obs_t, act_t = inputs
+                rng_, k = jax.random.split(rng_)
+                deter, (pm, ps), _ = wm.apply(
+                    wp, deter, stoch, act_t, k, method=wm.prior_step)
+                qm, qs = wm.apply(wp, deter, obs_t, method=wm.posterior)
+                stoch = qm + qs * jax.random.normal(k, qm.shape)
+                return (deter, stoch, rng_), (deter, stoch, pm, ps, qm, qs)
+
+            deter0 = jnp.zeros((batch, wm.deter_size))
+            stoch0 = jnp.zeros((batch, wm.stoch_size))
+            (_, _, _), outs = jax.lax.scan(
+                step, (deter0, stoch0, rng),
+                (obs_seq.transpose(1, 0, 2), act_seq.transpose(1, 0, 2)))
+            return [o.transpose(1, 0, 2) if o.ndim == 3 else o
+                    for o in outs]
+
+        @jax.jit
+        def _wm_update(wp, opt_state, batch, rng):
+            mask = batch["mask"]  # [B, T] — zero-padded steps carry no loss
+            denom = jnp.maximum(mask.sum(), 1.0)
+
+            def masked_mean(x):  # x [B, T] or [B, T, D]
+                if x.ndim == 3:
+                    x = x.mean(-1)
+                return (x * mask).sum() / denom
+
+            def loss_fn(p):
+                # actions_onehot[t] is a_{t-1} (zero at sequence start):
+                # the transition INTO step t conditions on the previous
+                # action, matching _policy_step's online filter
+                deter, stoch, pm, ps, qm, qs = observe_sequence(
+                    p, batch["obs"], batch["actions_onehot"], rng)
+                feat = jnp.concatenate([deter, stoch], axis=-1)
+                recon = wm.apply(p, feat, method=wm.decode)
+                rew = wm.apply(p, feat, method=wm.reward)
+                cont = wm.apply(p, feat, method=wm.cont)
+                recon_loss = masked_mean((recon - batch["obs"]) ** 2)
+                reward_loss = masked_mean(
+                    (rew - batch["rewards"]) ** 2)
+                cont_loss = masked_mean(
+                    optax.sigmoid_binary_cross_entropy(
+                        cont, 1.0 - batch["dones"]))
+                kl = (jnp.log(ps / qs) + (qs ** 2 + (qm - pm) ** 2)
+                      / (2 * ps ** 2) - 0.5).sum(-1)
+                kl_loss = (jnp.maximum(kl, free_nats) * mask).sum() \
+                    / denom
+                total = recon_loss + reward_loss + cont_loss \
+                    + kl_scale * kl_loss
+                return total, (recon_loss, reward_loss, kl_loss,
+                               deter, stoch)
+
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(wp)
+            updates, opt_state = self.wm_opt.update(grads, opt_state)
+            return optax.apply_updates(wp, updates), opt_state, loss, aux
+
+        @jax.jit
+        def _behavior_update(wp, ap, cp, a_opt, c_opt, deter, stoch,
+                             start_mask, rng):
+            """Imagine from (valid) posterior states; train actor+critic.
+
+            Index scheme: s_t := from_feats[t] (t = 0..H-1) is the state
+            action a_t is taken FROM; r_t / c_t are the reward/continue
+            heads at the arrived state feats[t]; λ-returns G_t sit at s_t
+            and bootstrap through V(s_{t+1}) = critic(feats[t])."""
+            b, t = deter.shape[0], deter.shape[1]
+            deter0 = deter.reshape(b * t, -1)
+            stoch0 = stoch.reshape(b * t, -1)
+            weight = start_mask.reshape(b * t)  # padded starts train nothing
+            w_denom = jnp.maximum(weight.sum() * horizon, 1.0)
+
+            def imagine_step(carry, rng_t):
+                deter_, stoch_ = carry
+                feat = jnp.concatenate([deter_, stoch_], axis=-1)
+                logits = actor.apply(ap, feat)
+                k1, k2 = jax.random.split(rng_t)
+                act = jax.random.categorical(k1, logits)
+                onehot = jax.nn.one_hot(act, n_act)
+                deter_, _, stoch_ = wm.apply(
+                    wp, deter_, stoch_, onehot, k2,
+                    method=wm.prior_step)
+                return (deter_, stoch_), (deter_, stoch_, act)
+
+            rngs = jax.random.split(rng, horizon)
+            in_feats = jnp.concatenate([deter0, stoch0], axis=-1)
+            _, (deters, stochs, acts) = jax.lax.scan(
+                imagine_step, (deter0, stoch0), rngs)
+            feats = jnp.concatenate([deters, stochs], axis=-1)  # [H,BT,F]
+            from_feats = jnp.concatenate(
+                [in_feats[None], feats[:-1]], axis=0)  # [H,BT,F]
+            rewards = wm.apply(wp, feats, method=wm.reward)
+            conts = jax.nn.sigmoid(wm.apply(wp, feats, method=wm.cont))
+            v_next = critic.apply(cp, feats)[..., 0]  # V(s_{t+1})
+
+            # λ-returns at s_t, bootstrapped through V(s_{t+1})
+            def lam_step(nxt, inputs):
+                r_t, c_t, v_t = inputs
+                ret = r_t + gamma * c_t * (
+                    (1 - lam) * v_t + lam * nxt)
+                return ret, ret
+
+            _, returns = jax.lax.scan(
+                lam_step, v_next[-1], (rewards, conts, v_next),
+                reverse=True)  # [H, BT]
+
+            def actor_loss_fn(p):
+                # REINFORCE over the imagined trajectory (discrete
+                # actions aren't reparameterizable): increase logp of
+                # actions whose λ-return beats the PRE-action baseline
+                # V(s_t) — baselining with the post-action value would
+                # cancel the action's own effect out of the advantage
+                logits = actor.apply(p, jax.lax.stop_gradient(from_feats))
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits),
+                    acts[..., None], axis=-1)[..., 0]
+                v_pre = critic.apply(cp, jax.lax.stop_gradient(
+                    from_feats))[..., 0]
+                adv = jax.lax.stop_gradient(returns - v_pre)
+                ent = -(jax.nn.softmax(logits)
+                        * jax.nn.log_softmax(logits)).sum(-1)
+                per_step = -(logp * adv) - 1e-3 * ent
+                return (per_step * weight[None, :]).sum() / w_denom
+
+            def critic_loss_fn(p):
+                v = critic.apply(p, jax.lax.stop_gradient(
+                    from_feats))[..., 0]
+                sq = (v - jax.lax.stop_gradient(returns)) ** 2
+                return (sq * weight[None, :]).sum() / w_denom
+
+            a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(ap)
+            c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(cp)
+            a_updates, a_opt = self.actor_opt.update(a_grads, a_opt)
+            c_updates, c_opt = self.critic_opt.update(c_grads, c_opt)
+            return (optax.apply_updates(ap, a_updates),
+                    optax.apply_updates(cp, c_updates), a_opt, c_opt,
+                    a_loss, c_loss)
+
+        @jax.jit
+        def _policy_step(wp, ap, deter, stoch, action_onehot, obs, rng):
+            """Online acting: posterior filter + actor sample."""
+            k1, k2 = jax.random.split(rng)
+            deter, _, _ = wm.apply(wp, deter, stoch, action_onehot, k1,
+                                   method=wm.prior_step)
+            qm, qs = wm.apply(wp, deter, obs, method=wm.posterior)
+            stoch = qm + qs * jax.random.normal(k1, qm.shape)
+            feat = jnp.concatenate([deter, stoch], axis=-1)
+            logits = actor.apply(ap, feat)
+            action = jax.random.categorical(k2, logits)
+            return deter, stoch, action
+
+        self._wm_update = _wm_update
+        self._behavior_update = _behavior_update
+        self._policy_step = _policy_step
+        self._episodes: deque = deque(
+            maxlen=int(cfg.get("replay_buffer_capacity", 500)))
+        self._np_rng = np.random.default_rng(int(cfg.get("seed", 0) or 0))
+        self._pending_returns: List[float] = []
+        self._pending_lens: List[int] = []
+
+    # -- environment interaction ---------------------------------------
+    def _run_episode(self, explore: bool = True) -> Tuple[float, int]:
+        cfg = self.config
+        obs, _ = self.env.reset()
+        deter = jnp.zeros((1, self.wm.deter_size))
+        stoch = jnp.zeros((1, self.wm.stoch_size))
+        prev_onehot = jnp.zeros((1, self.num_actions))
+        o_l, a_l, r_l, d_l = [], [], [], []
+        total, steps, done = 0.0, 0, False
+        while not done and steps < 1000:
+            self._rng, k = jax.random.split(self._rng)
+            obs_j = jnp.asarray(np.asarray(obs, np.float32))[None]
+            deter, stoch, action = self._policy_step(
+                self.wm_params, self.actor_params, deter, stoch,
+                prev_onehot, obs_j, k)
+            act = int(np.asarray(action)[0])
+            if explore and self._np_rng.random() < float(
+                    cfg.get("explore_noise", 0.3)):
+                act = int(self._np_rng.integers(self.num_actions))
+            nobs, rew, term, trunc, _ = self.env.step(act)
+            o_l.append(np.asarray(obs, np.float32))
+            a_l.append(act)
+            r_l.append(float(rew))
+            d_l.append(bool(term))
+            prev_onehot = jnp.asarray(
+                np.eye(self.num_actions, dtype=np.float32)[act])[None]
+            obs = nobs
+            total += float(rew)
+            steps += 1
+            self._timesteps_total += 1
+            done = bool(term or trunc)
+        self._episodes.append({
+            "obs": np.stack(o_l),
+            "actions": np.asarray(a_l, np.int64),
+            "rewards": np.asarray(r_l, np.float32),
+            "dones": np.asarray(d_l, np.float32)})
+        return total, steps
+
+    def _sample_sequences(self, bs: int, length: int) -> Dict[str, Any]:
+        obs = np.zeros((bs, length, self.obs_dim), np.float32)
+        act = np.zeros((bs, length, self.num_actions), np.float32)
+        rew = np.zeros((bs, length), np.float32)
+        done = np.zeros((bs, length), np.float32)
+        mask = np.zeros((bs, length), np.float32)
+        eye = np.eye(self.num_actions, dtype=np.float32)
+        for b in range(bs):
+            ep = self._episodes[self._np_rng.integers(len(self._episodes))]
+            T = len(ep["rewards"])
+            if T <= length:
+                start, n = 0, T
+            else:
+                start = int(self._np_rng.integers(0, T - length + 1))
+                n = length
+            seg = slice(start, start + n)
+            obs[b, :n] = ep["obs"][seg]
+            # step t conditions on the PREVIOUS action (zero at episode
+            # start) — the same alignment the online filter uses
+            prev = eye[ep["actions"]]
+            act[b, 1:n] = prev[start:start + n - 1]
+            if start > 0:
+                act[b, 0] = prev[start - 1]
+            rew[b, :n] = ep["rewards"][seg]
+            done[b, :n] = ep["dones"][seg]
+            mask[b, :n] = 1.0
+        return {"obs": jnp.asarray(obs), "actions_onehot": jnp.asarray(act),
+                "rewards": jnp.asarray(rew), "dones": jnp.asarray(done),
+                "mask": jnp.asarray(mask)}
+
+    # -- training -------------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        while len(self._episodes) < int(cfg.get("prefill_episodes", 5)):
+            ret, length = self._run_episode()
+            self._pending_returns.append(ret)
+            self._pending_lens.append(length)
+        for _ in range(int(cfg.get("rollout_episodes_per_step", 1))):
+            ret, length = self._run_episode()
+            self._pending_returns.append(ret)
+            self._pending_lens.append(length)
+        stats: Dict[str, Any] = {"episodes_in_buffer": len(self._episodes)}
+        for _ in range(int(cfg.get("train_iters_per_step", 20))):
+            batch = self._sample_sequences(
+                int(cfg.get("batch_size", 16)),
+                int(cfg.get("batch_length", 20)))
+            self._rng, k1, k2 = jax.random.split(self._rng, 3)
+            self.wm_params, self.wm_opt_state, wm_loss, aux = \
+                self._wm_update(self.wm_params, self.wm_opt_state,
+                                batch, k1)
+            recon, rloss, kl, deter, stoch = aux
+            (self.actor_params, self.critic_params,
+             self.actor_opt_state, self.critic_opt_state,
+             a_loss, c_loss) = self._behavior_update(
+                self.wm_params, self.actor_params, self.critic_params,
+                self.actor_opt_state, self.critic_opt_state,
+                jax.lax.stop_gradient(deter),
+                jax.lax.stop_gradient(stoch), batch["mask"], k2)
+        stats.update({"world_model_loss": float(wm_loss),
+                      "recon_loss": float(recon),
+                      "reward_loss": float(rloss),
+                      "kl_loss": float(kl),
+                      "actor_loss": float(a_loss),
+                      "critic_loss": float(c_loss)})
+        return stats
+
+    # -- Algorithm plumbing without a worker fleet ----------------------
+    def _collect_metrics(self):
+        out = [{"episode_returns": list(self._pending_returns),
+                "episode_lens": list(self._pending_lens)}]
+        self._pending_returns.clear()
+        self._pending_lens.clear()
+        return out
+
+    def evaluate(self) -> Dict[str, Any]:
+        returns = [self._run_episode(explore=False)[0] for _ in range(
+            int(self.config.get("evaluation_duration", 5)))]
+        return {"episode_reward_mean": float(np.mean(returns)),
+                "episode_reward_min": float(np.min(returns)),
+                "episode_reward_max": float(np.max(returns))}
+
+    def save(self, checkpoint_dir: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump({
+                "wm": jax.tree_util.tree_map(np.asarray, self.wm_params),
+                "actor": jax.tree_util.tree_map(np.asarray,
+                                                self.actor_params),
+                "critic": jax.tree_util.tree_map(np.asarray,
+                                                 self.critic_params),
+                "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total,
+            }, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.wm_params = jax.tree_util.tree_map(jnp.asarray, state["wm"])
+        self.actor_params = jax.tree_util.tree_map(jnp.asarray,
+                                                   state["actor"])
+        self.critic_params = jax.tree_util.tree_map(jnp.asarray,
+                                                    state["critic"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+
+    def stop(self) -> None:
+        pass
