@@ -239,6 +239,9 @@ class Raylet:
 
         # cluster view for spillback (refreshed from GCS health replies)
         self._cluster_view: List[Dict[str, Any]] = []
+        # node_id -> (spill count, last-charge time): local charge for
+        # spill decisions between resource-view broadcasts
+        self._spill_pressure: Dict[bytes, Tuple[float, float]] = {}
         # per-chip fractional load for TPU-id assignment (whole-chip
         # leases get disjoint ids because availability gating keeps the
         # total demand <= chip count)
@@ -897,6 +900,36 @@ class Raylet:
                    and bytes(n["node_id"]) != self.node_id.binary()]
         if not remotes:
             return None
+        # broadcast load is up to one sync period stale: every spill in
+        # that window would pile onto the same "least loaded" node.
+        # Charge each spill decision locally with exponential decay
+        # (half-life = one sync period, when fresh broadcasts fold the
+        # real load back in) so consecutive spills fan out without
+        # double-counting for long (parity: the reference tracks its own
+        # backlog per node between resource-view updates).
+        now = time.monotonic()
+        pressure = self._spill_pressure
+        half_life = self.config.resource_broadcast_period_s
+
+        def decayed_count(key) -> float:
+            entry = pressure.get(key)
+            if entry is None:
+                return 0.0
+            count, ts = entry
+            value = count * 0.5 ** ((now - ts) / half_life)
+            if value < 0.05:  # expired: drop so dead nodes don't pile up
+                del pressure[key]
+                return 0.0
+            return value
+
+        def charged_load(node) -> float:
+            return node.get("load", 0) + decayed_count(
+                bytes(node["node_id"]))
+
+        def charge(node) -> None:
+            key = bytes(node["node_id"])
+            pressure[key] = (decayed_count(key) + 1.0, now)
+
         try:
             # the hybrid/spread decision runs in the native scheduling
             # core (src/sched_core.cc — the reference's
@@ -904,14 +937,17 @@ class Raylet:
             from ray_tpu.core import native
 
             idx = native.sched_pick_node(
-                [(n.get("resources_available", {}), n.get("load", 0))
+                [(n.get("resources_available", {}), charged_load(n))
                  for n in remotes],
                 resources,
                 strategy=strategy,
                 local_utilization=self._utilization(),
                 spread_threshold=self.config.scheduler_spread_threshold,
                 local_feasible=self._feasible_ever(resources, None))
-            return None if idx is None else tuple(remotes[idx]["address"])
+            if idx is None:
+                return None
+            charge(remotes[idx])
+            return tuple(remotes[idx]["address"])
         except OSError:  # toolchain unavailable: python fallback
             pass
         best = None
@@ -919,17 +955,19 @@ class Raylet:
         for node in remotes:
             avail = node.get("resources_available", {})
             if all(avail.get(k, 0.0) >= v for k, v in resources.items()):
-                load = node.get("load", 0)
+                load = charged_load(node)
                 if best is None or load < best_load:
                     best, best_load = node, load
         if best is None:
             return None
         if strategy == "SPREAD":
+            charge(best)
             return tuple(best["address"])
         # hybrid: stay local while below the spread threshold and feasible
         if self._utilization() < self.config.scheduler_spread_threshold and \
                 self._feasible_ever(resources, None):
             return None
+        charge(best)
         return tuple(best["address"])
 
     def _maybe_schedule(self) -> None:
