@@ -220,13 +220,18 @@ def cmd_serve(args) -> None:
 
     if args.serve_cmd == "status":
         try:
-            status = serve_mod.status()
+            from ray_tpu.serve.schema import status_config
+            status = status_config()
         except Exception as e:  # noqa: BLE001
             sys.exit(f"serve is not running: {e}")
         print(json.dumps(status, indent=2, default=str))
     elif args.serve_cmd == "shutdown":
         serve_mod.shutdown()
         print("serve shut down")
+    elif args.serve_cmd == "deploy":
+        from ray_tpu.serve.schema import deploy_config
+        names = deploy_config(args.config_file)
+        print(f"deployed: {', '.join(names)}")
 
 
 def cmd_dashboard(args) -> None:
@@ -346,6 +351,9 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("status", "shutdown"):
         child = ssub.add_parser(name)
         child.add_argument("--address")
+    child = ssub.add_parser("deploy", help="deploy a serve config yaml")
+    child.add_argument("config_file")
+    child.add_argument("--address")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("microbenchmark", help="core perf suite")

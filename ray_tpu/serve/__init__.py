@@ -218,7 +218,13 @@ def delete(name: str) -> None:
 
 
 def status() -> Dict[str, Any]:
-    controller = start()
+    """Deployment table; raises if serve is not running (a read-only
+    status query must not start a controller as a side effect)."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        raise RuntimeError("serve is not running on this cluster "
+                           "(serve.run() starts it)") from None
     return ray_tpu.get(controller.list_deployments.remote(), timeout=30)
 
 

@@ -182,3 +182,56 @@ def test_http_proxy():
     with urllib.request.urlopen(req, timeout=30) as resp:
         body = json.loads(resp.read())
     assert body["result"]["echoed"]["hello"] == "world"
+
+
+def test_serve_config_deploy(tmp_path, ray_start_regular):
+    """Declarative config deploy: yaml -> import_path -> overridden
+    deployments, idempotent re-deploy (parity: serve/schema.py +
+    `serve deploy`)."""
+    import textwrap
+
+    from ray_tpu.serve.schema import deploy_config, status_config
+
+    # an importable module providing a deployment
+    mod = tmp_path / "serve_cfg_app.py"
+    mod.write_text(textwrap.dedent("""
+        import ray_tpu
+        from ray_tpu import serve
+
+        @serve.deployment
+        class Doubler:
+            def __call__(self, x):
+                return x * 2
+
+        app = Doubler.bind()
+    """))
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        config = {
+            "applications": [{
+                "name": "doubler",
+                "import_path": "serve_cfg_app:app",
+                "deployments": [{"name": "Doubler", "num_replicas": 2}],
+            }]
+        }
+        names = deploy_config(config)
+        assert names == ["Doubler"]
+        from ray_tpu import serve
+
+        handle = serve.get_deployment_handle("Doubler")
+        assert ray_tpu.get(handle.remote(21), timeout=60) == 42
+        st = status_config()
+        assert st["applications"]["Doubler"]["status"] == "RUNNING"
+
+        # yaml path + re-deploy (rolls, stays healthy)
+        cfg_file = tmp_path / "serve.yaml"
+        import yaml as yaml_mod
+
+        cfg_file.write_text(yaml_mod.safe_dump(config))
+        assert deploy_config(str(cfg_file)) == ["Doubler"]
+        assert ray_tpu.get(handle.remote(5), timeout=60) == 10
+        serve.shutdown()
+    finally:
+        sys.path.remove(str(tmp_path))
