@@ -7,6 +7,7 @@ from ray_tpu.rllib.algorithms.bandit import (  # noqa: F401
     BanditLinUCBConfig,
 )
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig, CQLPolicy  # noqa: F401
+from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig, CRRPolicy  # noqa: F401
 from ray_tpu.rllib.algorithms.ddpg import (  # noqa: F401
     DDPG,
     DDPGConfig,

@@ -1,0 +1,137 @@
+"""CRR — Critic-Regularized Regression (offline continuous control).
+
+Parity: reference ``rllib/algorithms/crr/`` (Wang et al. 2020) — an
+offline actor-critic where the actor is trained by *advantage-weighted
+behavioral cloning*: maximize ``f(A(s,a)) · log π(a|s)`` on dataset
+actions, with ``A(s,a) = Q(s,a) − E_{a'∼π} Q(s,a')`` and ``f`` either
+``exp(A/β)`` clipped (``weight_type="exp"``) or the binary indicator
+``A > 0`` (``weight_type="bin"``).  The critic is plain TD with a
+Polyak target — no conservatism penalty needed because the actor never
+strays from dataset actions.
+
+jax-native: reuses SAC's squashed-Gaussian actor/twin-critic modules;
+the dataset-action log-prob inverts the tanh squash in-graph, and the
+m policy samples for the advantage baseline are one batched draw.
+Plugs into the SACPolicy update interface (log_alpha is carried but
+unused — CRR has no temperature).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.sac import SACPolicy, _sample_squashed
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class CRRConfig(CQLConfig):
+    def __init__(self):
+        super().__init__()
+        self.weight_type = "exp"   # "exp" | "bin"
+        self.beta = 1.0            # temperature for the exp weights
+        self.weight_clip = 20.0    # cap on exp weights (paper's CWP)
+        self.advantage_samples = 4  # m policy samples for the baseline
+
+    @property
+    def algo_class(self):
+        return CRR
+
+
+def _squashed_logp(mean, log_std, actions):
+    """log π(a|s) of a tanh-squashed Gaussian at given (dataset) actions:
+    invert the squash, then Gaussian logp + tanh Jacobian."""
+    a = jnp.clip(actions, -1.0 + 1e-5, 1.0 - 1e-5)
+    pre = jnp.arctanh(a)
+    std = jnp.exp(log_std)
+    eps = (pre - mean) / std
+    return jnp.sum(
+        -0.5 * (eps ** 2) - log_std - 0.5 * jnp.log(2 * jnp.pi)
+        - jnp.log(1 - a ** 2 + 1e-6), axis=-1)
+
+
+class CRRPolicy(SACPolicy):
+    """SACPolicy scaffolding with the CRR update program."""
+
+    def __init__(self, observation_space, action_space, config):
+        super().__init__(observation_space, action_space, config)
+        actor, critic = self.actor, self.critic
+        gamma = float(config.get("gamma", 0.99))
+        m = int(config.get("advantage_samples", 4))
+        beta = float(config.get("beta", 1.0))
+        clip = float(config.get("weight_clip", 20.0))
+        weight_type = config.get("weight_type", "exp")
+        if weight_type not in ("exp", "bin"):
+            raise ValueError(f"weight_type must be 'exp' or 'bin', got "
+                             f"{weight_type!r}")
+
+        @jax.jit
+        def _update(actor_params, critic_params, target_params, log_alpha,
+                    a_opt, c_opt, al_opt, batch, rng):
+            obs = batch[SampleBatch.OBS]
+            nobs = batch[SampleBatch.NEXT_OBS]
+            acts = batch[SampleBatch.ACTIONS]
+            rew = batch[SampleBatch.REWARDS]
+            done = batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+            B = obs.shape[0]
+            rng1, rng2 = jax.random.split(rng)
+
+            # --- critic: TD toward target net, next action from π
+            nmean, nlstd = actor.apply(actor_params, nobs)
+            nact, _ = _sample_squashed(nmean, nlstd, rng1)
+            tq1, tq2 = critic.apply(target_params, nobs, nact)
+            target = jax.lax.stop_gradient(
+                rew + gamma * (1 - done) * jnp.minimum(tq1, tq2))
+
+            def critic_loss(p):
+                q1, q2 = critic.apply(p, obs, acts)
+                return jnp.mean((q1 - target) ** 2
+                                + (q2 - target) ** 2)
+
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                critic_params)
+            c_up, c_opt = self.critic_opt.update(c_grads, c_opt)
+            critic_params = optax.apply_updates(critic_params, c_up)
+
+            # --- advantage of the DATASET action vs the policy baseline
+            mean, lstd = actor.apply(actor_params, obs)
+            mean_r = jnp.repeat(mean, m, axis=0)
+            lstd_r = jnp.repeat(lstd, m, axis=0)
+            pol_act, _ = _sample_squashed(mean_r, lstd_r, rng2)
+            obs_r = jnp.repeat(obs, m, axis=0)
+            bq1, bq2 = critic.apply(critic_params, obs_r,
+                                    jax.lax.stop_gradient(pol_act))
+            baseline = jnp.minimum(bq1, bq2).reshape(B, m).mean(axis=1)
+            dq1, dq2 = critic.apply(critic_params, obs, acts)
+            adv = jnp.minimum(dq1, dq2) - baseline
+            if weight_type == "bin":
+                weights = (adv > 0).astype(jnp.float32)
+            else:
+                weights = jnp.minimum(jnp.exp(adv / beta), clip)
+            weights = jax.lax.stop_gradient(weights)
+
+            # --- actor: advantage-weighted behavioral cloning
+            def actor_loss(p):
+                am, als = actor.apply(p, obs)
+                logp = _squashed_logp(am, als, acts)
+                return -jnp.mean(weights * logp)
+
+            a_loss, a_grads = jax.value_and_grad(actor_loss)(actor_params)
+            a_up, a_opt = self.actor_opt.update(a_grads, a_opt)
+            actor_params = optax.apply_updates(actor_params, a_up)
+
+            stats = {"critic_loss": c_loss, "actor_loss": a_loss,
+                     "mean_advantage": jnp.mean(adv),
+                     "mean_weight": jnp.mean(weights)}
+            return (actor_params, critic_params, log_alpha,
+                    a_opt, c_opt, al_opt, stats)
+
+        self._update_fn = _update
+
+
+class CRR(CQL):
+    """Same offline driver as CQL (preloaded replay, no env sampling)."""
+
+    policy_class = CRRPolicy
