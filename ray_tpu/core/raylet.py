@@ -1325,7 +1325,9 @@ class Raylet:
             # secondary copy: not pinned, evictable
             return True
         except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
-                IOError):
+                OSError):
+            # OSError covers connect-refused to a dead holder: treat the
+            # location as gone and let the caller try the next one
             return False
 
     async def handle_object_pull_start(self, conn, data):

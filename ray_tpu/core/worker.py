@@ -241,6 +241,9 @@ class CoreWorker:
         # (one call_soon_threadsafe per burst instead of per task).
         self._submit_queue: deque = deque()
         self._submit_drain_scheduled = False
+        # batched pushes stream per-task results back; this maps
+        # task_id -> (spec, lease state, worker) until settled
+        self._streamed: Dict[bytes, tuple] = {}
 
         self._run(self._async_init())
         set_global_worker(self)
@@ -524,10 +527,19 @@ class CoreWorker:
         }, timeout=None)
         lease = reply.get(object_id.binary())
         if lease is None:
-            # lost object: attempt lineage reconstruction, owner-side only
-            if depth < self.config.max_lineage_reconstruction_depth and \
-                    await self._try_reconstruct(object_id):
-                return await self._get_one(ref, deadline, depth + 1)
+            # lost object: lineage reconstruction.  The OWNER resubmits
+            # the producing task; a borrower (e.g. a worker whose task
+            # arg was lost with a node) asks the owner to do so — without
+            # this, chained loss (input AND output gone) never recovers
+            # because only the leaf's owner acts (parity:
+            # ObjectRecoveryManager recovers via the object's owner).
+            if depth < self.config.max_lineage_reconstruction_depth:
+                recovered = await self._try_reconstruct(object_id)
+                if not recovered:
+                    recovered = await self._ask_owner_reconstruct(
+                        object_id, ref.owner_address(), deadline)
+                if recovered:
+                    return await self._get_one(ref, deadline, depth + 1)
             if timeout is not None:
                 return _PendingMarker()
             raise ObjectLostError(object_id.hex(),
@@ -547,6 +559,36 @@ class CoreWorker:
                 "object_release", {"object_ids": [object_id_bin]})
         except (rpc.ConnectionLost, rpc.RpcError):
             pass
+
+    async def _ask_owner_reconstruct(self, object_id: ObjectID,
+                                     owner: Optional[OwnerAddress],
+                                     deadline: Optional[float]) -> bool:
+        """Borrower-side recovery: the owner holds the lineage, so route
+        the reconstruction request to it and wait for completion."""
+        if owner is None or owner[3] == self.worker_id.hex():
+            return False
+        try:
+            conn = await self._pool.get((owner[1], owner[2]))
+            timeout = None if deadline is None else max(
+                1.0, deadline - time.monotonic())
+            logger.info("asking owner %s to reconstruct %s",
+                        owner[1:3], object_id.hex()[:16])
+            reply = await conn.call(
+                "reconstruct_object",
+                {"object_id": object_id.binary()},
+                timeout=timeout)
+            logger.info("owner reconstruct %s -> %s",
+                        object_id.hex()[:16], reply)
+            return bool(reply)
+        except (rpc.ConnectionLost, rpc.RpcError,
+                asyncio.TimeoutError) as e:
+            logger.info("owner reconstruct %s failed: %s",
+                        object_id.hex()[:16], e)
+            return False
+
+    async def handle_reconstruct_object(self, conn, data):
+        """Owner-side service endpoint for borrower-initiated recovery."""
+        return await self._try_reconstruct(ObjectID(data["object_id"]))
 
     async def _try_reconstruct(self, object_id: ObjectID) -> bool:
         """Lineage reconstruction: resubmit the producing task
@@ -1037,7 +1079,8 @@ class CoreWorker:
             reply = await conn.call(
                 "push_task", {"spec_blob": _spec_dumps(spec)},
                 timeout=None)
-        except (rpc.ConnectionLost, rpc.RpcError) as e:
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
+                OSError) as e:
             worker.inflight -= 1
             state.workers.pop(worker.worker_id, None)
             self._pool.invalidate(worker.address)
@@ -1052,29 +1095,64 @@ class CoreWorker:
     async def _push_task_batch(self, state: "_LeaseState",
                                worker: "_LeasedWorker",
                                specs: List[TaskSpec]) -> None:
-        """Ship several specs to one leased worker in one RPC frame."""
+        """Ship several specs to one leased worker in one RPC frame.
+
+        Results STREAM back as task_result pushes while the batch runs
+        (processed by _on_worker_push — required so intra-batch and
+        cross-worker dependencies resolve without waiting for the whole
+        batch); the final reply settles whatever pushes didn't cover."""
         if worker.return_handle is not None:
             worker.return_handle.cancel()
             worker.return_handle = None
+        # key by (task_id, attempt): a retried task re-registers under
+        # its new attempt, so a stale batch's final reply cannot steal
+        # (and double-settle) the retry's entry
+        keys = [(spec.task_id.binary(), spec.attempt_number)
+                for spec in specs]
+        for spec, key in zip(specs, keys):
+            self._streamed[key] = (spec, state, worker)
         try:
             conn = await self._pool.get(worker.address)
+            conn.set_push_handler(self._on_worker_push)
             for spec in specs:
                 self._record_task_event(spec, "RUNNING")
-            reply = await conn.call(
+            await conn.call(
                 "push_tasks", {"specs_blob": _spec_dumps(specs)},
                 timeout=None)
-        except (rpc.ConnectionLost, rpc.RpcError) as e:
-            worker.inflight -= len(specs)
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
+                OSError) as e:
             state.workers.pop(worker.worker_id, None)
             self._pool.invalidate(worker.address)
-            for spec in specs:
+            for spec, key in zip(specs, keys):
+                # tasks whose results already streamed in are complete;
+                # only the rest died with the worker
+                if self._streamed.pop(key, None) is None:
+                    continue
+                worker.inflight -= 1
                 self._retry_or_fail(spec, WorkerCrashedError(
                     f"worker died while running {spec.debug_name()}: {e}"))
             self._pump_lease_queue(state)
             return
-        worker.inflight -= len(specs)
-        for spec, one in zip(specs, reply["replies"]):
-            self._handle_task_reply(spec, one)
+        # results stream on the same FIFO connection BEFORE the final
+        # ack, so leftovers here mean a lost push — retry them
+        for spec, key in zip(specs, keys):
+            if self._streamed.pop(key, None) is None:
+                continue
+            worker.inflight -= 1
+            self._retry_or_fail(spec, WorkerCrashedError(
+                f"streamed result missing for {spec.debug_name()}"))
+        self._pump_lease_queue(state)
+
+    def _on_worker_push(self, channel: str, data: Any) -> None:
+        if channel != "task_result":
+            return
+        entry = self._streamed.pop((data["task_id"], data["attempt"]),
+                                   None)
+        if entry is None:
+            return  # a stale attempt's late push
+        spec, state, worker = entry
+        worker.inflight -= 1
+        self._handle_task_reply(spec, data["reply"])
         self._pump_lease_queue(state)
 
     async def _return_lease(self, state: "_LeaseState",
@@ -1109,8 +1187,9 @@ class CoreWorker:
     def _retry_or_fail(self, spec: TaskSpec, error: Exception) -> None:
         retry_spec = self.task_manager.take_for_retry(spec.task_id)
         if retry_spec is not None:
-            logger.info("retrying %s (attempt %d)", spec.debug_name(),
-                        retry_spec.attempt_number)
+            logger.info("retrying %s (attempt %d): %s",
+                        spec.debug_name(), retry_spec.attempt_number,
+                        type(error).__name__)
             self._loop.call_soon_threadsafe(self._enqueue_for_lease, retry_spec)
         else:
             self._fail_task(spec, error)
@@ -1503,11 +1582,22 @@ class CoreWorker:
             item = self._exec_queue.get()
             if item is None:
                 break
+            if len(item) == 3:  # batched push with per-task streaming
+                specs, reply_fut, stream = item
+                replies = []
+                for s in specs:
+                    r = self._execute_task(s)
+                    replies.append(r)
+                    # stream each result the moment it exists: a later
+                    # task in THIS batch (or on another worker) may
+                    # depend on it — withholding results until the whole
+                    # batch returns deadlocks intra-batch dependencies
+                    self._loop.call_soon_threadsafe(stream, s, r)
+                self._loop.call_soon_threadsafe(_set_future, reply_fut,
+                                                replies)
+                continue
             spec, reply_fut = item
-            if isinstance(spec, list):  # batched push: one handoff per batch
-                reply = [self._execute_task(s) for s in spec]
-            else:
-                reply = self._execute_task(spec)
+            reply = self._execute_task(spec)
             self._loop.call_soon_threadsafe(_set_future, reply_fut, reply)
 
     def _start_extra_exec_threads(self, n: int) -> None:
@@ -1525,12 +1615,24 @@ class CoreWorker:
         return await reply_fut
 
     async def handle_push_tasks(self, conn, data):
-        """Batched variant of push_task: one frame, one exec handoff, one
-        reply frame for the whole batch."""
+        """Batched variant of push_task: one frame, one exec handoff.
+        Each task's result is PUSHED back as it completes (see
+        _consume_exec_queue); the final reply carries the full list as
+        the authoritative completion for bookkeeping."""
         specs: List[TaskSpec] = pickle.loads(data["specs_blob"])
         reply_fut = self._loop.create_future()
-        self._exec_queue.put((specs, reply_fut))
-        return {"replies": await reply_fut}
+
+        def stream(spec: TaskSpec, reply: Dict[str, Any]) -> None:
+            conn.push("task_result", {"task_id": spec.task_id.binary(),
+                                      "attempt": spec.attempt_number,
+                                      "reply": reply})
+
+        self._exec_queue.put((specs, reply_fut, stream))
+        await reply_fut
+        # results already streamed (FIFO before this reply); the ack
+        # only closes the call — shipping the replies again would double
+        # the bandwidth of every inline result
+        return {"acked": len(specs)}
 
     async def handle_push_actor_task(self, conn, data):
         if self._actor_instance is None:
