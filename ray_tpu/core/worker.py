@@ -33,7 +33,8 @@ import queue as queue_mod
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Awaitable, Callable, Dict, List, Optional, Sequence,
+                    Tuple)
 
 import cloudpickle
 
@@ -99,15 +100,35 @@ def _renv_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
     return env_hash(runtime_env)
 
 
+_tracing_fns: Optional[tuple] = None
+
+
 def _trace_carrier() -> Optional[Dict[str, str]]:
-    from ray_tpu.util.tracing.tracing_helper import (current_trace_context,
-                                                     is_tracing_enabled)
-    if not is_tracing_enabled():
+    global _tracing_fns
+    fns = _tracing_fns
+    if fns is None:
+        from ray_tpu.util.tracing.tracing_helper import (
+            current_trace_context, is_tracing_enabled)
+        fns = _tracing_fns = (is_tracing_enabled, current_trace_context)
+    if not fns[0]():
         return None
-    return current_trace_context()
+    return fns[1]()
 
 _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
+
+# Shared wire bytes for the trailing empty-kwargs arg every no-kwarg task
+# carries (serializing {} per submission measured ~17 us on nop storms).
+_empty_kwargs_cache: Optional[TaskArg] = None
+
+
+def _empty_kwargs_arg() -> TaskArg:
+    global _empty_kwargs_cache
+    arg = _empty_kwargs_cache
+    if arg is None:
+        arg = TaskArg(value_bytes=serialize({}).to_bytes(), contained_ids=[])
+        _empty_kwargs_cache = arg
+    return arg
 
 
 def global_worker() -> "CoreWorker":
@@ -233,14 +254,15 @@ class CoreWorker:
         # bytecode boundary — including while that thread holds unrelated
         # locks — so the refcount mutation and its free callbacks must not
         # run inline (parity: reference_count.cc posts deletions to the
-        # io_service).  deque.append is GC-reentrancy-safe.
-        self._gc_release_queue: deque = deque()
-        self._gc_drain_scheduled = False
+        # io_service).
+        self._gc_release_queue = _BurstQueue(
+            self._loop, self.reference_counter.remove_local_ref)
 
         # Submissions from the driver thread batch into one loop wakeup
         # (one call_soon_threadsafe per burst instead of per task).
-        self._submit_queue: deque = deque()
-        self._submit_drain_scheduled = False
+        self._touched_states: Dict[Tuple, "_LeaseState"] = {}
+        self._submit_queue = _BurstQueue(
+            self._loop, self._route_submit, self._flush_submits)
         # batched pushes stream per-task results back; this maps
         # task_id -> (spec, lease state, worker) until settled
         self._streamed: Dict[bytes, tuple] = {}
@@ -380,7 +402,7 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def _publish(self, object_id: ObjectID, data: bytes) -> None:
         self.memory_store.put(object_id, data)
-        self._loop.call_soon_threadsafe(self._wake_object_waiters, object_id)
+        self._call_on_loop(self._wake_object_waiters, object_id)
 
     def _wake_object_waiters(self, object_id: ObjectID) -> None:
         event = self._object_events.pop(object_id, None)
@@ -468,28 +490,74 @@ class CoreWorker:
 
     async def _get_async(self, refs: List[ObjectRef],
                          deadline: Optional[float]) -> List[Any]:
-        return list(await asyncio.gather(
-            *[self._get_one(ref, deadline) for ref in refs]))
+        # ONE deadline for the whole batch: asyncio.wait_for costs ~40 us
+        # per call (Timeout context manager + timer handle), so per-ref
+        # deadlines dominated large gets.  get() raises on ANY pending ref,
+        # so cancelling the whole gather at the deadline is equivalent.
+        if deadline is None:
+            return list(await asyncio.gather(
+                *[self._get_one(ref, None) for ref in refs]))
+        timeout = deadline - time.monotonic()
+        if timeout <= 0:
+            # expired/zero timeout (non-blocking poll): the per-ref path
+            # still returns objects that are ALREADY local — wait_for(0)
+            # would cancel the gather before any child could check
+            return list(await asyncio.gather(
+                *[self._get_one(ref, deadline) for ref in refs]))
+        # batch_managed: ONE wait_for for the whole batch (a per-ref
+        # Timeout context measured ~40 us each); remote legs still carry
+        # the cooperative deadline and are shielded from the cancellation
+        # (see _shielded) so raylet leases/long-polls complete cleanly.
+        gathered = asyncio.gather(
+            *[self._get_one(ref, deadline, batch_managed=True)
+              for ref in refs])
+        try:
+            return list(await asyncio.wait_for(gathered, timeout))
+        except asyncio.TimeoutError:
+            return [_PendingMarker() for _ in refs]
 
     async def _get_one(self, ref: ObjectRef, deadline: Optional[float],
-                       _reconstruction_depth: int = 0) -> Any:
+                       _reconstruction_depth: int = 0,
+                       batch_managed: bool = False) -> Any:
+        """``batch_managed``: an enclosing batch wait_for owns the deadline
+        and will CANCEL this coroutine at expiry.  Local-store waits then
+        skip their own (expensive) deadline plumbing — cancellation is safe
+        there — while remote legs keep the cooperative deadline AND run
+        shielded, because a raylet ``object_get`` cancelled between lease
+        grant and reply would leak the lease (and strand the server-side
+        pull loop) with nobody left to release it."""
         object_id = ref.id()
         owner = ref.owner_address()
         is_owner = owner is None or owner[3] == self.worker_id.hex()
         if is_owner:
-            data = await self._wait_local_object(object_id, deadline)
+            data = await self._wait_local_object(
+                object_id, None if batch_managed else deadline)
             if data is None:
                 return _PendingMarker()
         else:
             data = self.memory_store.get(object_id)  # borrower-side cache
             if data is None:
-                data = await self._fetch_from_owner(object_id, owner, deadline)
+                fetch = self._fetch_from_owner(object_id, owner, deadline)
+                data = await (self._shielded(fetch) if batch_managed
+                              else fetch)
                 if data is None:
                     return _PendingMarker()
         if data == PLASMA_MARKER:
-            return await self._get_plasma(ref, deadline, _reconstruction_depth)
+            inner = self._get_plasma(ref, deadline, _reconstruction_depth)
+            return await (self._shielded(inner) if batch_managed else inner)
         value, is_exc = deserialize(data)
         return value if not is_exc else value  # TaskError instance either way
+
+    def _shielded(self, coro) -> Awaitable:
+        """Wrap a remote-protocol coroutine so caller cancellation (batch
+        get deadline) detaches from it instead of killing it mid-RPC; the
+        inner task runs to its own cooperative deadline and releases any
+        resources it acquired.  A result that lands after detachment is
+        dropped — plasma pins release via GC of the orphaned value."""
+        task = self._loop.create_task(coro)
+        task.add_done_callback(
+            lambda t: None if t.cancelled() else t.exception())
+        return asyncio.shield(task)
 
     async def _fetch_from_owner(self, object_id: ObjectID,
                                 owner: OwnerAddress,
@@ -691,28 +759,10 @@ class CoreWorker:
         The actual refcount mutation (and any free callback it triggers)
         runs on the io loop, never inline in the finalizer.
         """
-        self._gc_release_queue.append(object_id)
-        if self._shutdown:
-            return
-        if not self._gc_drain_scheduled:
-            self._gc_drain_scheduled = True
-            try:
-                self._loop.call_soon_threadsafe(self._drain_gc_releases)
-            except (RuntimeError, AttributeError):
-                self._gc_drain_scheduled = False  # loop torn down
-
-    def _drain_gc_releases(self) -> None:
-        # Clear the flag BEFORE draining: a producer appending after the
-        # final popleft then sees False and schedules a fresh drain.
-        self._gc_drain_scheduled = False
-        rc = self.reference_counter
-        q = self._gc_release_queue
-        while True:
-            try:
-                oid = q.popleft()
-            except IndexError:
-                return
-            rc.remove_local_ref(oid)
+        try:
+            self._gc_release_queue.push(object_id)
+        except (RuntimeError, AttributeError):
+            pass  # loop torn down — nothing left to free against
 
     def _on_object_freed(self, object_id: ObjectID, ref_info) -> None:
         self.memory_store.delete(object_id)
@@ -871,9 +921,16 @@ class CoreWorker:
         otherwise a promoted arg would be freed the instant this function
         returns.
         """
+        if not kwargs and not args:
+            # the overwhelmingly common no-arg call: one shared TaskArg
+            # carrying pre-serialized {} (read-only everywhere)
+            return [_empty_kwargs_arg()], []
         out: List[TaskArg] = []
         holds: List[ObjectRef] = []
         for value in list(args) + [kwargs or {}]:
+            if type(value) is dict and not value:
+                out.append(_empty_kwargs_arg())
+                continue
             if isinstance(value, ObjectRef):
                 out.append(TaskArg(object_id=value.id(),
                                    owner_address=value.owner_address()))
@@ -899,33 +956,23 @@ class CoreWorker:
 
     def _submit_to_lease_queue(self, spec: TaskSpec) -> None:
         self._record_task_event(spec, "PENDING")
-        self._submit_queue.append(spec)
-        if not self._submit_drain_scheduled:
-            self._submit_drain_scheduled = True
-            try:
-                self._loop.call_soon_threadsafe(self._drain_submit_queue)
-            except (RuntimeError, AttributeError):
-                # loop torn down: surface it — swallowing would hand the
-                # caller ObjectRefs that can never resolve
-                self._submit_drain_scheduled = False
-                raise RayTpuError(
-                    "cannot submit task: the runtime is shut down")
+        try:
+            self._submit_queue.push(spec)
+        except (RuntimeError, AttributeError):
+            # loop torn down: surface it — swallowing would hand the
+            # caller ObjectRefs that can never resolve
+            raise RayTpuError(
+                "cannot submit task: the runtime is shut down") from None
 
-    def _drain_submit_queue(self) -> None:
-        # flag cleared BEFORE draining (same protocol as _drain_gc_releases)
-        self._submit_drain_scheduled = False
-        touched: Dict[Tuple, "_LeaseState"] = {}
-        q = self._submit_queue
-        while True:
-            try:
-                spec = q.popleft()
-            except IndexError:
-                break
-            if spec.task_type == TaskType.ACTOR_TASK:
-                self._enqueue_actor_task(spec)
-                continue
-            state = self._backlog_enqueue(spec)
-            touched[state.key] = state
+    def _route_submit(self, spec: TaskSpec) -> None:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            self._enqueue_actor_task(spec)
+            return
+        state = self._backlog_enqueue(spec)
+        self._touched_states[state.key] = state
+
+    def _flush_submits(self) -> None:
+        touched, self._touched_states = self._touched_states, {}
         for state in touched.values():
             self._pump_lease_queue(state)
 
@@ -1144,16 +1191,23 @@ class CoreWorker:
         self._pump_lease_queue(state)
 
     def _on_worker_push(self, channel: str, data: Any) -> None:
-        if channel != "task_result":
+        if channel == "task_results":
+            items = data
+        elif channel == "task_result":  # single-result legacy channel
+            items = [(data["task_id"], data["attempt"], data["reply"])]
+        else:
             return
-        entry = self._streamed.pop((data["task_id"], data["attempt"]),
-                                   None)
-        if entry is None:
-            return  # a stale attempt's late push
-        spec, state, worker = entry
-        worker.inflight -= 1
-        self._handle_task_reply(spec, data["reply"])
-        self._pump_lease_queue(state)
+        states = {}
+        for task_id_bin, attempt, reply in items:
+            entry = self._streamed.pop((task_id_bin, attempt), None)
+            if entry is None:
+                continue  # a stale attempt's late push
+            spec, state, worker = entry
+            worker.inflight -= 1
+            self._handle_task_reply(spec, reply)
+            states[id(state)] = state
+        for state in states.values():
+            self._pump_lease_queue(state)
 
     async def _return_lease(self, state: "_LeaseState",
                             worker: "_LeasedWorker") -> None:
@@ -1194,6 +1248,14 @@ class CoreWorker:
         else:
             self._fail_task(spec, error)
 
+    def _call_on_loop(self, fn, *args) -> None:
+        """Run ``fn`` on the io loop — directly when already there (avoids
+        the self-pipe write call_soon_threadsafe pays per call)."""
+        if threading.current_thread() is self._loop_thread:
+            fn(*args)
+        else:
+            self._loop.call_soon_threadsafe(fn, *args)
+
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self.task_manager.fail(spec.task_id)
         blob = serialize_exception(
@@ -1203,7 +1265,7 @@ class CoreWorker:
         for ret in spec.return_ids():
             self._publish(ret, blob)
         self._record_task_event(spec, "FAILED")
-        self._loop.call_soon_threadsafe(self._signal_task_done, spec.task_id)
+        self._call_on_loop(self._signal_task_done, spec.task_id)
 
     def _complete_task(self, spec: TaskSpec, results: List[Tuple]) -> None:
         """Store task results as owner (parity: TaskManager::CompletePendingTask)."""
@@ -1216,7 +1278,7 @@ class CoreWorker:
                 self.reference_counter.add_location(object_id, tuple(payload))
                 self._publish(object_id, PLASMA_MARKER)
         self._record_task_event(spec, "FINISHED")
-        self._loop.call_soon_threadsafe(self._signal_task_done, spec.task_id)
+        self._call_on_loop(self._signal_task_done, spec.task_id)
 
     # ------------------------------------------------------------------
     # actors: creation + submission
@@ -1585,14 +1647,25 @@ class CoreWorker:
             if len(item) == 3:  # batched push with per-task streaming
                 specs, reply_fut, stream = item
                 replies = []
+                # Results stream out the moment they exist: a later task
+                # in THIS batch (or on another worker) may depend on one —
+                # withholding results until the whole batch returns
+                # deadlocks intra-batch dependencies.  But one loop wakeup
+                # per result is a self-pipe syscall each; instead results
+                # accumulate in a deque and ONE scheduled drain ships
+                # whatever is ready (promptness preserved: the drain runs
+                # as soon as the loop wakes, typically within ~10us).
+                out_batch: list = []
+
+                def _ship(out_batch=out_batch, stream=stream):
+                    if out_batch:
+                        stream(out_batch[:])
+                        out_batch.clear()
+                ready = _BurstQueue(self._loop, out_batch.append, _ship)
                 for s in specs:
                     r = self._execute_task(s)
                     replies.append(r)
-                    # stream each result the moment it exists: a later
-                    # task in THIS batch (or on another worker) may
-                    # depend on it — withholding results until the whole
-                    # batch returns deadlocks intra-batch dependencies
-                    self._loop.call_soon_threadsafe(stream, s, r)
+                    ready.push((s, r))
                 self._loop.call_soon_threadsafe(_set_future, reply_fut,
                                                 replies)
                 continue
@@ -1622,10 +1695,10 @@ class CoreWorker:
         specs: List[TaskSpec] = pickle.loads(data["specs_blob"])
         reply_fut = self._loop.create_future()
 
-        def stream(spec: TaskSpec, reply: Dict[str, Any]) -> None:
-            conn.push("task_result", {"task_id": spec.task_id.binary(),
-                                      "attempt": spec.attempt_number,
-                                      "reply": reply})
+        def stream(items: List[Tuple[TaskSpec, Dict[str, Any]]]) -> None:
+            conn.push("task_results", [
+                (s.task_id.binary(), s.attempt_number, r)
+                for s, r in items])
 
         self._exec_queue.put((specs, reply_fut, stream))
         await reply_fut
@@ -1750,8 +1823,12 @@ class CoreWorker:
 
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         resolved: List[Any] = []
+        empty_kwargs = _empty_kwargs_arg().value_bytes
         for arg in spec.args:
             if arg.is_inline():
+                if arg.value_bytes == empty_kwargs:
+                    resolved.append({})
+                    continue
                 value, is_exc = deserialize(arg.value_bytes)
                 if is_exc:
                     raise value.cause or value
@@ -1843,6 +1920,70 @@ class CoreWorker:
 def _set_future(fut: asyncio.Future, value: Any) -> None:
     if not fut.done():
         fut.set_result(value)
+
+
+class _BurstQueue:
+    """Cross-thread deque + scheduled-drain flag: the wakeup-elision
+    protocol shared by task submission, GC ref releases, and worker-side
+    result streaming.
+
+    Invariants (all three call sites depend on these — fix races HERE):
+    - producer: ``append`` then check-flag; ``deque.append`` is
+      GC-reentrancy-safe so finalizers may push.
+    - the first push of a burst pays one ``call_soon_threadsafe``
+      (self-pipe write); while the burst lasts, the drain re-polls each
+      loop tick via plain ``call_soon`` with the flag left True.
+    - the flag is repaired in a ``finally`` so an exception from
+      ``on_item``/``on_flush`` can never strand queued items.
+    - the closed race (append between the final popleft and the flag
+      clear) is caught by re-checking the deque after clearing.
+    """
+
+    __slots__ = ("_q", "_scheduled", "_loop", "_on_item", "_on_flush")
+
+    def __init__(self, loop, on_item: Callable[[Any], None],
+                 on_flush: Optional[Callable[[], None]] = None):
+        self._q: deque = deque()
+        self._scheduled = False
+        self._loop = loop
+        self._on_item = on_item
+        self._on_flush = on_flush
+
+    def push(self, item: Any) -> None:
+        """Any thread.  Raises if the loop is torn down (after restoring
+        the flag so a later push can try again)."""
+        self._q.append(item)
+        if not self._scheduled:
+            self._scheduled = True
+            try:
+                self._loop.call_soon_threadsafe(self._drain)
+            except (RuntimeError, AttributeError):
+                self._scheduled = False
+                raise
+
+    def _drain(self) -> None:
+        q = self._q
+        drained = 0
+        try:
+            try:
+                while True:
+                    try:
+                        item = q.popleft()
+                    except IndexError:
+                        break
+                    drained += 1
+                    self._on_item(item)
+            finally:
+                if drained and self._on_flush is not None:
+                    self._on_flush()
+        finally:
+            if drained:
+                self._loop.call_soon(self._drain)
+            else:
+                self._scheduled = False
+                if q:
+                    self._scheduled = True
+                    self._loop.call_soon(self._drain)
 
 
 class _PendingMarker:
