@@ -166,13 +166,47 @@ def preexec_die_with_parent():
     PR_SET_PDEATHSIG).  Driver-owned clusters must not orphan their head
     when the driver is SIGKILLed; CLI-started daemons do NOT use this
     (a ``ray-tpu start`` cluster outlives the CLI process).  Callers
-    must gate on :func:`safe_die_with_parent`."""
+    must gate on :func:`safe_die_with_parent`.
+
+    Prefer the env-flag + :func:`maybe_arm_pdeathsig` pair for OUR OWN
+    daemons: any preexec_fn forces subprocess down the fork path, and
+    forking a process whose sitecustomize started jax's threads is the
+    canonical latent-deadlock (and warning spam) in this stack.  This
+    preexec variant remains for spawning third-party commands that can't
+    arm themselves."""
     try:
         import ctypes
         import signal as sig
 
         libc = ctypes.CDLL(None, use_errno=True)
         libc.prctl(1, sig.SIGTERM)  # PR_SET_PDEATHSIG = 1
+    except Exception:  # non-Linux: best effort only
+        pass
+
+
+def maybe_arm_pdeathsig() -> None:
+    """Child-side PDEATHSIG: called first thing in daemon/worker mains
+    when the spawner set ``RAY_TPU_PDEATHSIG=<spawner pid>``.  Keeps the
+    Popen call preexec_fn-free so CPython can use posix_spawn(3) instead
+    of fork+exec (the spawning driver has jax threads running).  The
+    spawn→arm window is covered by re-checking getppid() against the
+    spawner's pid (NOT against 1 — a containerized driver legitimately
+    runs as PID 1, and a reparented orphan may land on a subreaper)."""
+    val = os.environ.pop("RAY_TPU_PDEATHSIG", None)
+    if not val:
+        return
+    try:
+        import ctypes
+        import signal as sig
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, sig.SIGTERM)  # PR_SET_PDEATHSIG = 1
+        try:
+            spawner = int(val)
+        except ValueError:
+            return
+        if os.getppid() != spawner:  # parent died inside the window
+            os._exit(1)
     except Exception:  # non-Linux: best effort only
         pass
 
@@ -224,11 +258,27 @@ def _spawn(cmd, session_dir: str, tag: str,
     out = open(log_base + ".out", "ab")
     err = open(log_base + ".err", "ab")
     env = dict(os.environ)
-    # node daemons never need an accelerator
+    # Node daemons never need an accelerator; dropping the axon pool var
+    # ALSO keeps sitecustomize from importing jax in the daemon, so its
+    # own worker forks stay thread-free.  The originals are STASHED so
+    # the raylet can restore them for workers that lease TPU chips
+    # (without the stash, every worker inherited the daemon's
+    # JAX_PLATFORMS=cpu and could never see the accelerator).
+    if os.environ.get("JAX_PLATFORMS"):
+        env["RAY_TPU_STASH_JAX_PLATFORMS"] = os.environ["JAX_PLATFORMS"]
     env["JAX_PLATFORMS"] = "cpu"
+    pool_ips = env.pop("PALLAS_AXON_POOL_IPS", None)
+    if pool_ips:
+        env["RAY_TPU_STASH_AXON_POOL_IPS"] = pool_ips
+    if die_with_parent:
+        # armed child-side (maybe_arm_pdeathsig); value = our pid so the
+        # child can detect a parent that died before it armed
+        env["RAY_TPU_PDEATHSIG"] = str(os.getpid())
+    # close_fds=False + no preexec_fn + no cwd → CPython uses
+    # posix_spawn(3): never forks this (jax-threaded) driver process.
+    # PEP 446 makes Python-created fds CLOEXEC, so not closing is safe.
     proc = subprocess.Popen(
-        cmd, stdout=out, stderr=err, env=env, cwd=os.getcwd(),
-        preexec_fn=preexec_die_with_parent if die_with_parent else None)
+        cmd, stdout=out, stderr=err, env=env, close_fds=False)
     proc._rtpu_err_path = log_base + ".err"  # for handshake diagnostics
     return proc
 
@@ -266,6 +316,7 @@ def _stderr_tail(proc: subprocess.Popen, limit: int = 2000) -> str:
 
 
 def main() -> None:
+    maybe_arm_pdeathsig()
     parser = argparse.ArgumentParser()
     parser.add_argument("--mode", choices=["head", "node"], required=True)
     parser.add_argument("--gcs", default=None)

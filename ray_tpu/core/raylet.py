@@ -126,20 +126,20 @@ class _ZygoteClient:
     def _ensure_started(self) -> None:
         if self._proc is not None and self._proc.poll() is None:
             return
-        from ray_tpu.core.node import (preexec_die_with_parent,
-                                       safe_die_with_parent)
+        from ray_tpu.core.node import safe_die_with_parent
 
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)  # no accelerator plugin
+        env.pop("RAY_TPU_STASH_AXON_POOL_IPS", None)
         env["RAY_TPU_WORKER"] = "1"
+        if safe_die_with_parent():
+            env["RAY_TPU_PDEATHSIG"] = str(os.getpid())  # armed in zygote main()
         log = open(os.path.join(self._session_dir, "logs",
                                 "worker_zygote.err"), "ab")
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_zygote"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=log,
-            env=env, cwd=os.getcwd(), text=True,
-            preexec_fn=preexec_die_with_parent
-            if safe_die_with_parent() else None)
+            env=env, text=True, close_fds=False)
         ready = self._proc.stdout.readline()
         if "ready" not in ready:
             raise RuntimeError(f"worker zygote failed to start: {ready!r}")
@@ -627,13 +627,25 @@ class Raylet:
         self._starting += 1
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
+        # The accelerator plugin env travels via the node daemon's stash
+        # (node.py _spawn strips it from daemons so they stay jax-free);
+        # raylets started outside node.py carry it directly.
+        pool_ips = env.pop("RAY_TPU_STASH_AXON_POOL_IPS", None) \
+            or env.pop("PALLAS_AXON_POOL_IPS", None)
+        jax_platforms = env.pop("RAY_TPU_STASH_JAX_PLATFORMS", None)
         tpu_capable = True
-        if not needs_tpu and env.get("PALLAS_AXON_POOL_IPS"):
-            # plain pool workers skip the accelerator-plugin sitecustomize
-            # (it imports jax at interpreter start, ~2s); only workers that
-            # may lease TPU chips pay that cost
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            tpu_capable = False
+        if pool_ips:
+            if needs_tpu:
+                # TPU workers pay the accelerator-plugin sitecustomize
+                # (~2s jax import) and get the original backend selection
+                env["PALLAS_AXON_POOL_IPS"] = pool_ips
+                if jax_platforms:
+                    env["JAX_PLATFORMS"] = jax_platforms
+                else:
+                    env.pop("JAX_PLATFORMS", None)
+            else:
+                # plain pool workers skip it; JAX_PLATFORMS stays cpu
+                tpu_capable = False
         log_base = os.path.join(self.session_dir, "logs",
                                 f"worker-{os.getpid()}-{self._starting}-{time.monotonic_ns()}")
         os.makedirs(os.path.dirname(log_base), exist_ok=True)
@@ -665,17 +677,19 @@ class Raylet:
                *worker_args]
         out = open(log_base + ".out", "ab")
         err = open(log_base + ".err", "ab")
-        from ray_tpu.core.node import (preexec_die_with_parent,
-                                       safe_die_with_parent)
+        from ray_tpu.core.node import safe_die_with_parent
 
         # workers die with their raylet (a worker without its raylet is
         # unreachable; reference workers exit on raylet death).  The
         # raylet loop runs on the process main thread, so the PDEATHSIG
         # thread caveat doesn't bite; gate anyway for exotic embeddings.
+        # Armed child-side (worker_main) so Popen stays preexec_fn-free
+        # and takes the posix_spawn path — a TPU-hosting raylet has jax
+        # threads running, and forking those is the latent-deadlock class.
+        if safe_die_with_parent():
+            env["RAY_TPU_PDEATHSIG"] = str(os.getpid())
         proc = subprocess.Popen(
-            cmd, env=env, stdout=out, stderr=err, cwd=os.getcwd(),
-            preexec_fn=preexec_die_with_parent
-            if safe_die_with_parent() else None)
+            cmd, env=env, stdout=out, stderr=err, close_fds=False)
         # log monitor maps these files to the worker pid for prefixes
         self._log_pids[log_base + ".out"] = proc.pid
         self._log_pids[log_base + ".err"] = proc.pid

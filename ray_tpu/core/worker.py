@@ -1191,12 +1191,9 @@ class CoreWorker:
         self._pump_lease_queue(state)
 
     def _on_worker_push(self, channel: str, data: Any) -> None:
-        if channel == "task_results":
-            items = data
-        elif channel == "task_result":  # single-result legacy channel
-            items = [(data["task_id"], data["attempt"], data["reply"])]
-        else:
+        if channel != "task_results":
             return
+        items = data
         states = {}
         for task_id_bin, attempt, reply in items:
             entry = self._streamed.pop((task_id_bin, attempt), None)

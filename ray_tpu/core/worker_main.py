@@ -13,6 +13,8 @@ import sys
 
 
 def main() -> None:
+    from ray_tpu.core.node import maybe_arm_pdeathsig
+    maybe_arm_pdeathsig()
     parser = argparse.ArgumentParser()
     parser.add_argument("--raylet", required=True)
     parser.add_argument("--gcs", required=True)

@@ -64,6 +64,8 @@ def _child(req: dict) -> None:
 
 
 def main() -> None:
+    from ray_tpu.core.node import maybe_arm_pdeathsig
+    maybe_arm_pdeathsig()
     # Pre-warm the import graph forks inherit.  Deliberately NOT jax —
     # plain pool workers never touch the accelerator.
     import ray_tpu.core.worker  # noqa: F401 — pulls rpc/serialization/ids

@@ -14,12 +14,15 @@ instead of the reference's HTTP long-poll.
 from __future__ import annotations
 
 import asyncio
+import logging
 import queue
 import threading
 import uuid
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from ray_tpu.core import rpc
 from ray_tpu.rllib.sample_batch import SampleBatch, concat_samples
@@ -158,6 +161,7 @@ class PolicyClient:
             host, port = address.rsplit(":", 1)
             address = (host, int(port))
         self._address = tuple(address)
+        self._closed = False
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever,
                                         daemon=True, name="policy-client")
@@ -165,7 +169,36 @@ class PolicyClient:
         self._conn = self._run(rpc.connect(self._address))
 
     def _run(self, coro):
-        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(30)
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        try:
+            return fut.result(30)
+        except (TimeoutError, rpc.ConnectionLost, rpc.RpcError) as e:
+            # surface a single, catchable error class to the application
+            # thread (an unbounded raw TimeoutError here used to die
+            # unhandled in daemon threads during teardown)
+            fut.cancel()
+            logger.info("policy client call failed (%s)%s",
+                        type(e).__name__,
+                        " — client closed" if self._closed else "")
+            raise ConnectionError(
+                f"policy server call failed: {type(e).__name__}: {e}"
+            ) from e
+
+    def close(self) -> None:
+        """Tear down the link; a concurrently blocked call fails fast with
+        ConnectionError instead of waiting out its timeout."""
+        if self._closed:
+            return
+        self._closed = True
+        def _shut():
+            self._conn.close()
+            # conn.close() only SCHEDULES the waiter wakeups
+            # (fut.set_exception -> call_soon); stopping in the same
+            # callback would strand a blocked caller for its full
+            # timeout — defer the stop one tick so the failures drain
+            self._loop.call_soon(self._loop.stop)
+        self._loop.call_soon_threadsafe(_shut)
+        self._thread.join(5)
 
     def _call(self, method: str, data: Dict[str, Any]) -> Dict[str, Any]:
         return self._run(self._conn.call(method, data))

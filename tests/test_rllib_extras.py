@@ -85,16 +85,19 @@ def test_policy_server_client_learns():
 
     def external_app():
         env = CartPole({"max_episode_steps": 200, "seed": 0})
-        while not stop.is_set():
-            eid = client.start_episode()
-            obs, _ = env.reset()
-            done = False
-            while not done and not stop.is_set():
-                action = client.get_action(eid, obs)
-                obs, rew, term, trunc, _ = env.step(int(action))
-                client.log_returns(eid, rew)
-                done = term or trunc
-            client.end_episode(eid, obs)
+        try:
+            while not stop.is_set():
+                eid = client.start_episode()
+                obs, _ = env.reset()
+                done = False
+                while not done and not stop.is_set():
+                    action = client.get_action(eid, obs)
+                    obs, rew, term, trunc, _ = env.step(int(action))
+                    client.log_returns(eid, rew)
+                    done = term or trunc
+                client.end_episode(eid, obs)
+        except ConnectionError:
+            return  # server went away during teardown — clean exit
 
     t = threading.Thread(target=external_app, daemon=True)
     t.start()
@@ -111,6 +114,7 @@ def test_policy_server_client_learns():
     finally:
         stop.set()
         client.close()
+        t.join(10)
         algo.stop()
 
 
