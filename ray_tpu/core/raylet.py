@@ -227,6 +227,7 @@ class Raylet:
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: List[WorkerHandle] = []
         self._starting = 0
+        self._starting_tpu = 0  # subset of _starting spawned with needs_tpu
         self._pending_leases: List[PendingLease] = []
         self._register_waiters: List[asyncio.Future] = []
         max_workers = config.max_workers_per_node
@@ -299,7 +300,8 @@ class Raylet:
                 loop.create_task(self._memory_monitor_loop()))
         n_prestart = self.config.num_prestart_workers
         if n_prestart < 0:
-            n_prestart = min(4, int(self.resources_total.get("CPU", 1)))
+            n_prestart = min(8, 2 * int(self.resources_total.get("CPU", 1)))
+        self._prestart_watermark = n_prestart
         for _ in range(n_prestart):
             self._start_worker(None)
         logger.info("raylet %s on %s resources=%s",
@@ -603,10 +605,10 @@ class Raylet:
                     self._on_worker_dead(w, f"exit code {w.proc.returncode}")
             # workers that died before registering (startup crash)
             for entry in list(self._spawned_procs):
-                proc, _ = entry
+                proc = entry[0]
                 if proc.poll() is not None:
                     self._spawned_procs.remove(entry)
-                    self._starting -= 1
+                    self._dec_starting(entry[2])
                     logger.warning("worker pid %d died before registering "
                                    "(exit %d)", proc.pid, proc.returncode)
                     self._maybe_schedule()
@@ -625,6 +627,8 @@ class Raylet:
         if pool_size >= self._max_workers:
             return
         self._starting += 1
+        if needs_tpu:
+            self._starting_tpu += 1
         env = dict(os.environ)
         env["RAY_TPU_WORKER"] = "1"
         # The accelerator plugin env travels via the node daemon's stash
@@ -667,12 +671,12 @@ class Raylet:
             # unless the host uses an import-time accelerator plugin
             # (sitecustomize only runs at real interpreter start).
             self._spawn_via_zygote(worker_args, log_base, tpu_capable,
-                                   env)
+                                   env, needs_tpu)
             return
-        self._spawn_cold(worker_args, log_base, env, tpu_capable)
+        self._spawn_cold(worker_args, log_base, env, tpu_capable, needs_tpu)
 
     def _spawn_cold(self, worker_args, log_base: str, env: Dict[str, str],
-                    tpu_capable: bool) -> None:
+                    tpu_capable: bool, needs_tpu: bool = False) -> None:
         cmd = [sys.executable, "-m", "ray_tpu.core.worker_main",
                *worker_args]
         out = open(log_base + ".out", "ab")
@@ -694,10 +698,11 @@ class Raylet:
         self._log_pids[log_base + ".out"] = proc.pid
         self._log_pids[log_base + ".err"] = proc.pid
         # handle registered later in handle_register_worker; remember proc
-        self._spawned_procs.append((proc, tpu_capable))
+        self._spawned_procs.append((proc, tpu_capable, needs_tpu))
 
     def _spawn_via_zygote(self, worker_args, log_base: str,
-                          tpu_capable: bool, env: Dict[str, str]) -> None:
+                          tpu_capable: bool, env: Dict[str, str],
+                          needs_tpu: bool = False) -> None:
         if getattr(self, "_zygote", None) is None:
             self._zygote = _ZygoteClient(self.session_dir)
         loop = asyncio.get_running_loop()
@@ -720,7 +725,8 @@ class Raylet:
                 except Exception:
                     pass
                 self._zygote = None
-                self._spawn_cold(worker_args, log_base, env, tpu_capable)
+                self._spawn_cold(worker_args, log_base, env, tpu_capable,
+                                 needs_tpu)
                 return
             handle = _ForkedProc(pid)
             self._log_pids[log_base + ".out"] = pid
@@ -731,10 +737,10 @@ class Raylet:
                 if worker.pid == pid and worker.proc is None:
                     worker.proc = handle
                     worker.tpu_capable = tpu_capable
-                    self._starting -= 1
+                    self._dec_starting(needs_tpu)
                     self._maybe_schedule()  # freed pool capacity
                     return
-            self._spawned_procs.append((handle, tpu_capable))
+            self._spawned_procs.append((handle, tpu_capable, needs_tpu))
 
         fut.add_done_callback(_done)
 
@@ -753,12 +759,12 @@ class Raylet:
         )
         # adopt the spawned process handle if this pid is one of ours
         for entry in list(self._spawned_procs):
-            proc, tpu_capable = entry
+            proc, tpu_capable, was_tpu_spawn = entry
             if proc.pid == worker.pid:
                 worker.proc = proc
                 worker.tpu_capable = tpu_capable
                 self._spawned_procs.remove(entry)
-                self._starting -= 1
+                self._dec_starting(was_tpu_spawn)
                 break
         conn.context["worker_id"] = worker.worker_id
         self.workers[worker.worker_id] = worker
@@ -1027,11 +1033,33 @@ class Raylet:
                 "worker_id": worker.worker_id.binary(),
             })
         self._pending_leases = remaining
-        # spawn exactly enough workers to cover unmet (schedulable) demand —
+        # Spawn exactly enough workers to cover unmet (schedulable) demand —
         # one per waiting lease, minus those already starting (parity:
-        # WorkerPool::PrestartWorkers demand accounting)
-        for job_id_bin, needs_tpu in want_workers[self._starting:]:
-            self._start_worker(job_id_bin, needs_tpu)
+        # WorkerPool::PrestartWorkers demand accounting).  TPU demand is
+        # sliced against the TPU-capable starting count ONLY: plain spares
+        # (refill below) can never serve a needs_tpu lease, so counting
+        # them would strand TPU leases for a full boot cycle.
+        plain_wait = [x for x in want_workers if not x[1]]
+        tpu_wait = [x for x in want_workers if x[1]]
+        starting_plain = self._starting - self._starting_tpu
+        for job_id_bin, _ in plain_wait[starting_plain:]:
+            self._start_worker(job_id_bin, False)
+        for job_id_bin, _ in tpu_wait[self._starting_tpu:]:
+            self._start_worker(job_id_bin, True)
+        # anticipatory refill: actors claim pool workers permanently, so
+        # creation storms drain the idle pool — respawn spares in the
+        # background up to the prestart watermark (bounded by the pool
+        # cap inside _start_worker) so the NEXT claims hit warm workers
+        # (~4x creation rate vs cold boot on the lease critical path)
+        refill = getattr(self, "_prestart_watermark", 0) \
+            - len(self._idle) - self._starting
+        for _ in range(refill):
+            self._start_worker(None)
+
+    def _dec_starting(self, was_tpu_spawn: bool) -> None:
+        self._starting -= 1
+        if was_tpu_spawn and self._starting_tpu > 0:
+            self._starting_tpu -= 1
 
     def _pop_idle(self, job_id_bin: Optional[bytes],
                   needs_tpu: bool = False,
@@ -1127,9 +1155,20 @@ class Raylet:
         if worker is None:
             return {"granted": False, "reason": "worker vanished"}
         worker.is_actor = True
+        payload = {"spec_blob": data["spec_blob"]}
+        # Attach node-cached function + syspath blobs: 25 actors of one
+        # class on one node then cost ONE GCS fetch instead of 25 (the
+        # per-worker fetches were the dominant GCS load in creation
+        # storms — parity motivation: gcs_actor_scheduler.cc batches the
+        # equivalent metadata on the lease path).
+        try:
+            extra = await self._actor_creation_blobs(data["spec_blob"])
+            payload.update(extra)
+        except Exception:  # cache is best-effort; workers can self-fetch
+            logger.debug("actor blob prefetch failed", exc_info=True)
         try:
             result = await worker.conn.call(
-                "create_actor", {"spec_blob": data["spec_blob"]}, timeout=120.0)
+                "create_actor", payload, timeout=120.0)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             self._on_worker_dead(worker, f"actor creation failed: {e}")
             return {"granted": False, "reason": str(e)}
@@ -1142,6 +1181,48 @@ class Raylet:
                     "creation_error": True}
         return {"granted": True, "worker_task_address": worker.task_address,
                 "worker_id": worker.worker_id.binary()}
+
+    async def _actor_creation_blobs(self, spec_blob: bytes) -> Dict[str, Any]:
+        """Node-level cache of (function blob, job syspath blob) for actor
+        creation, keyed off the pickled spec's ids.  LRU-bounded, and a
+        miss (None reply) is NOT cached — a transient GCS anomaly must not
+        permanently disable the prefetch for that key."""
+        import pickle as pickle_mod
+        spec = pickle_mod.loads(spec_blob)
+        cache = getattr(self, "_creation_blob_cache", None)
+        if cache is None:
+            from collections import OrderedDict
+            cache = self._creation_blob_cache = OrderedDict()
+
+        async def lookup(key, fetch):
+            blob = cache.get(key)
+            if blob is not None:
+                cache.move_to_end(key)
+                return blob
+            blob = await fetch()
+            if blob is not None:
+                cache[key] = blob
+                while len(cache) > 128:
+                    cache.popitem(last=False)
+            return blob
+
+        out: Dict[str, Any] = {}
+        fn_blob = await lookup(
+            ("fn", spec.function_id),
+            lambda: self.gcs_conn.call(
+                "get_function", {"function_id": spec.function_id}))
+        if fn_blob is not None:
+            out["function_blob"] = fn_blob
+        if spec.job_id is not None:
+            sp_blob = await lookup(
+                ("syspath", spec.job_id.binary()),
+                lambda: self.gcs_conn.call("kv_get", {
+                    "key": f"syspath:{spec.job_id.hex()}",
+                    "namespace": "_internal"}))
+            if sp_blob is not None:
+                out["syspath_blob"] = sp_blob
+                out["syspath_job"] = spec.job_id.binary()
+        return out
 
     # ------------------------------------------------------------------
     # state API (per-node sources; parity: raylet handlers behind

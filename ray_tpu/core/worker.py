@@ -1306,7 +1306,7 @@ class CoreWorker:
             trace_context=_trace_carrier(),
         )
         strat = spec.scheduling_strategy
-        reply = self._run(self.gcs_conn.call("register_actor", {
+        payload = {
             "actor_id": actor_id.binary(),
             "spec_blob": _spec_dumps(spec),
             "resources": resources,
@@ -1322,11 +1322,31 @@ class CoreWorker:
                 if strat.placement_group_id else None,
             "bundle_index": strat.bundle_index,
             "env_hash": spec.runtime_env_hash,
-        }))
+        }
         # pin creation args for the actor's lifetime (restarts re-run the
         # creation task and need them)
         self._actor_creation_holds = getattr(self, "_actor_creation_holds", [])
         self._actor_creation_holds.extend(holds)
+        if creation_spec.name is None and not get_if_exists:
+            # Unnamed actors register ASYNCHRONOUSLY: the id was minted
+            # here, no name conflict is possible, and the reply carries
+            # nothing the caller needs — so don't serialize creation
+            # bursts on per-actor GCS round trips (measured 12 ms/actor
+            # with a busy GCS).  Method submission awaits the ack in
+            # _resolve_actor_address before querying actor state.
+            fut = asyncio.run_coroutine_threadsafe(
+                self.gcs_conn.call("register_actor", payload), self._loop)
+            self._actor_state(actor_id).register_fut = fut
+
+            def _log_failure(f):
+                exc = f.exception() if not f.cancelled() else None
+                if exc is not None:
+                    logger.warning("async actor registration for %s "
+                                   "failed: %s", actor_id.hex()[:12], exc)
+            fut.add_done_callback(_log_failure)
+            return actor_id
+        # named / get_if_exists: the reply decides (conflict or reuse)
+        reply = self._run(self.gcs_conn.call("register_actor", payload))
         return ActorID(reply["actor_id"])
 
     def _actor_state(self, actor_id: ActorID) -> "_ActorSubmitState":
@@ -1455,10 +1475,35 @@ class CoreWorker:
 
     async def _resolve_actor_address(self, state: "_ActorSubmitState"
                                      ) -> rpc.Address:
+        if state.register_fut is not None:
+            # async registration (unnamed actors): the GCS must have
+            # acked before get_actor can answer — await, don't clear
+            # (one-shot future; concurrent resolvers all await it)
+            try:
+                await asyncio.wrap_future(state.register_fut)
+            except Exception as e:  # noqa: BLE001 — surfaced as actor death
+                raise ActorDiedError(
+                    state.actor_id.hex()[:12],
+                    f"registration failed: {e}") from e
+        if state.address is not None:
+            return state.address
+        if not state.subscribed:
+            # Event-driven resolution: subscribe BEFORE the state query so
+            # no ALIVE/DEAD transition can fall between them, then sleep
+            # on the push event (the 100 ms poll loop this replaces put
+            # ~half its period of dead latency on every actor creation).
+            # The subscription stays active afterwards — restart and
+            # death transitions keep repairing state.address for free.
+            state.subscribed = True
+            await self.gcs_conn.call(
+                "subscribe", {"channel": f"actor:{state.actor_id.hex()}"})
         deadline = time.monotonic() + 120.0
         while time.monotonic() < deadline:
             if state.address is not None:
                 return state.address
+            if state.dead_cause is not None:
+                raise ActorDiedError(state.actor_id.hex()[:12],
+                                     state.dead_cause)
             reply = await self.gcs_conn.call(
                 "get_actor", {"actor_id": state.actor_id.binary()})
             if reply is None:
@@ -1470,7 +1515,14 @@ class CoreWorker:
             if reply["state"] == "DEAD":
                 raise ActorDiedError(state.actor_id.hex()[:12],
                                      reply.get("death_cause", "dead"))
-            await asyncio.sleep(0.1)
+            if state.resolve_event is None:
+                state.resolve_event = asyncio.Event()
+            state.resolve_event.clear()
+            try:
+                # event-driven wake; 2 s re-poll covers a lost push
+                await asyncio.wait_for(state.resolve_event.wait(), 2.0)
+            except asyncio.TimeoutError:
+                pass
         raise ActorDiedError(state.actor_id.hex()[:12],
                              "timed out resolving actor address")
 
@@ -1589,8 +1641,14 @@ class CoreWorker:
             if state is not None:
                 if message["state"] == "ALIVE" and message["address"]:
                     state.address = tuple(message["address"])
-                else:
+                    state.dead_cause = None  # restart completed
+                elif message["state"] == "DEAD":
                     state.address = None
+                    state.dead_cause = message.get("death_cause") or "dead"
+                else:  # RESTARTING etc.
+                    state.address = None
+                if state.resolve_event is not None:
+                    state.resolve_event.set()
 
     # ------------------------------------------------------------------
     # task events (state API feed)
@@ -1723,6 +1781,24 @@ class CoreWorker:
 
     async def handle_create_actor(self, conn, data):
         spec: TaskSpec = pickle.loads(data["spec_blob"])
+        # Seed caches from the raylet's node-level prefetch so this worker
+        # skips its own GCS round trips.  Syspath FIRST: unpickling a
+        # driver-module class by reference needs the driver's import paths.
+        sp_blob = data.get("syspath_blob")
+        if sp_blob is not None and data.get("syspath_job") is not None:
+            try:
+                self._merge_syspath(JobID(data["syspath_job"]), sp_blob)
+            except Exception:
+                logger.debug("prefetched syspath blob unusable",
+                             exc_info=True)
+        fn_blob = data.get("function_blob")
+        if fn_blob is not None and spec.function_id not in self._function_cache:
+            try:
+                self._function_cache[spec.function_id] = \
+                    cloudpickle.loads(fn_blob)
+            except Exception:  # corrupt/incompatible — self-fetch instead
+                logger.debug("prefetched function blob unusable",
+                             exc_info=True)
         reply_fut = self._loop.create_future()
         self._exec_queue.put((spec, reply_fut))
         reply = await reply_fut
@@ -1736,13 +1812,18 @@ class CoreWorker:
         if self._max_concurrency > 1:
             self._start_extra_exec_threads(self._max_concurrency - 1)
         # register on our own GCS connection so the GCS can detect death
-        # of this actor when the connection drops
+        # of this actor when the connection drops.  Fired without awaiting:
+        # the reply carries nothing, and blocking actor creation on a GCS
+        # round trip serialized creation storms on GCS latency (liveness
+        # is already established by the scheduler's lease grant).
         try:
-            await self.gcs_conn.call("actor_started", {
+            fut = self.gcs_conn.start_call("actor_started", {
                 "actor_id": spec.actor_id.binary(),
                 "task_address": self.task_address,
             })
-        except (rpc.ConnectionLost, rpc.RpcError):
+            fut.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None)
+        except rpc.ConnectionLost:
             pass
         return {"ok": True}
 
@@ -1876,14 +1957,23 @@ class CoreWorker:
         except (rpc.ConnectionLost, rpc.RpcError):
             return  # transient — retry on the next task
         # mark applied only after a successful fetch
-        self._syspath_applied.add(job_id)
         if not blob:
+            self._syspath_applied.add(job_id)
+            return
+        self._merge_syspath(job_id, blob)
+
+    def _merge_syspath(self, job_id: JobID, blob: bytes) -> None:
+        """Merge a pickled driver path list into sys.path, once per job.
+        Single merge implementation for both the GCS-fetch path and the
+        raylet-prefetch seed in handle_create_actor."""
+        if job_id in self._syspath_applied:
             return
         import sys as _sys
 
         for p in cloudpickle.loads(blob):
             if p not in _sys.path and os.path.isdir(p):
                 _sys.path.append(p)
+        self._syspath_applied.add(job_id)
 
     def _get_function(self, function_id: str) -> Callable:
         fn = self._function_cache.get(function_id)
@@ -2011,7 +2101,8 @@ class _LeaseState:
 
 class _ActorSubmitState:
     __slots__ = ("actor_id", "address", "next_seq", "pending", "queue",
-                 "sender_task")
+                 "sender_task", "register_fut", "subscribed",
+                 "resolve_event", "dead_cause")
 
     def __init__(self, actor_id: ActorID):
         self.actor_id = actor_id
@@ -2020,6 +2111,12 @@ class _ActorSubmitState:
         self.pending: Dict[int, TaskSpec] = {}
         self.queue: deque = deque()
         self.sender_task: Optional[asyncio.Task] = None
+        # async-registration ack (unnamed actors); resolvers await it
+        self.register_fut = None
+        # actor-channel pubsub (event-driven address resolution)
+        self.subscribed = False
+        self.resolve_event: Optional[asyncio.Event] = None
+        self.dead_cause: Optional[str] = None
 
 
 def _deserialize_pinned(view: memoryview, pin: _Pin):

@@ -169,6 +169,11 @@ class PolicyClient:
         self._conn = self._run(rpc.connect(self._address))
 
     def _run(self, coro):
+        if self._closed:
+            # the loop is stopped: run_coroutine_threadsafe would enqueue
+            # a coroutine that never runs and stall the caller 30 s
+            coro.close()
+            raise ConnectionError("policy client is closed")
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         try:
             return fut.result(30)
