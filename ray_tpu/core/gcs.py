@@ -286,7 +286,11 @@ class GcsServer:
         return True
 
     async def handle_unsubscribe(self, conn, data):
-        self.subscribers.get(data["channel"], set()).discard(conn)
+        subs = self.subscribers.get(data["channel"])
+        if subs is not None:
+            subs.discard(conn)
+            if not subs:  # don't accrete empty per-actor channel keys
+                del self.subscribers[data["channel"]]
         return True
 
     async def handle_publish(self, conn, data):

@@ -222,8 +222,9 @@ class Raylet:
         os.makedirs(self._spill_dir, exist_ok=True)
         self._pull_locks: Dict[ObjectID, asyncio.Lock] = {}
 
-        # worker pool: spawned-but-unregistered procs as (proc, tpu_capable)
-        self._spawned_procs: List[Tuple[subprocess.Popen, bool]] = []
+        # worker pool: spawned-but-unregistered procs as
+        # (proc, tpu_capable, spawned_with_needs_tpu)
+        self._spawned_procs: List[Tuple[Any, bool, bool]] = []
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: List[WorkerHandle] = []
         self._starting = 0
@@ -618,14 +619,15 @@ class Raylet:
     # worker pool
     # ------------------------------------------------------------------
     def _start_worker(self, job_id_bin: Optional[bytes],
-                      needs_tpu: bool = False) -> None:
+                      needs_tpu: bool = False) -> bool:
+        """Returns False when the pool cap declines the spawn."""
         # the cap bounds the *task pool*; workers holding actors live
         # outside it (parity: reference WorkerPool — actor workers are
         # dedicated, else a few CPU:0 actors starve all task execution)
         pool_size = self._starting + sum(
             1 for w in self.workers.values() if not w.is_actor)
         if pool_size >= self._max_workers:
-            return
+            return False
         self._starting += 1
         if needs_tpu:
             self._starting_tpu += 1
@@ -672,8 +674,9 @@ class Raylet:
             # (sitecustomize only runs at real interpreter start).
             self._spawn_via_zygote(worker_args, log_base, tpu_capable,
                                    env, needs_tpu)
-            return
+            return True
         self._spawn_cold(worker_args, log_base, env, tpu_capable, needs_tpu)
+        return True
 
     def _spawn_cold(self, worker_args, log_base: str, env: Dict[str, str],
                     tpu_capable: bool, needs_tpu: bool = False) -> None:
@@ -996,7 +999,7 @@ class Raylet:
         if self._closing:
             return
         remaining: List[PendingLease] = []
-        want_workers: List[Optional[bytes]] = []
+        want_workers: List[Tuple[Optional[bytes], bool]] = []
         for lease in self._pending_leases:
             if lease.future.done():
                 continue
@@ -1045,16 +1048,39 @@ class Raylet:
         for job_id_bin, _ in plain_wait[starting_plain:]:
             self._start_worker(job_id_bin, False)
         for job_id_bin, _ in tpu_wait[self._starting_tpu:]:
-            self._start_worker(job_id_bin, True)
+            if not self._start_worker(job_id_bin, True):
+                # pool cap reached while idle PLAIN spares occupy it —
+                # those can never serve a needs_tpu lease (eligible()
+                # rejects them), so evict one to make room or the lease
+                # deadlocks behind its own refill spares
+                if self._cull_idle_spare(lambda w: not w.tpu_capable):
+                    self._start_worker(job_id_bin, True)
         # anticipatory refill: actors claim pool workers permanently, so
         # creation storms drain the idle pool — respawn spares in the
         # background up to the prestart watermark (bounded by the pool
         # cap inside _start_worker) so the NEXT claims hit warm workers
-        # (~4x creation rate vs cold boot on the lease critical path)
-        refill = getattr(self, "_prestart_watermark", 0) \
-            - len(self._idle) - self._starting
-        for _ in range(refill):
-            self._start_worker(None)
+        # (~4x creation rate vs cold boot on the lease critical path).
+        # Skipped while any lease is still waiting: demand-driven spawns
+        # own the remaining pool capacity.
+        if not remaining:
+            refill = getattr(self, "_prestart_watermark", 0) \
+                - len(self._idle) - self._starting
+            for _ in range(refill):
+                self._start_worker(None)
+
+    def _cull_idle_spare(self, predicate) -> bool:
+        """Evict one idle worker matching ``predicate`` to free pool
+        capacity; returns True if a worker was released."""
+        for i, w in enumerate(self._idle):
+            if predicate(w):
+                self._idle.pop(i)
+                self.workers.pop(w.worker_id, None)
+                try:
+                    w.conn.push("exit", {})
+                except Exception:  # already gone
+                    pass
+                return True
+        return False
 
     def _dec_starting(self, was_tpu_spawn: bool) -> None:
         self._starting -= 1

@@ -228,6 +228,8 @@ class CoreWorker:
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
         self._exec_threads: List[threading.Thread] = []
         self._function_cache: Dict[str, Any] = {}
+        # raylet-prefetched function blobs, decoded lazily on exec threads
+        self._function_blobs: Dict[str, bytes] = {}
         self._registered_functions: set = set()
         self._syspath_applied: set = set()
         self._actor_instance: Any = None
@@ -1504,6 +1506,13 @@ class CoreWorker:
             if state.dead_cause is not None:
                 raise ActorDiedError(state.actor_id.hex()[:12],
                                      state.dead_cause)
+            # Cleared BEFORE the poll: an ALIVE push racing the in-flight
+            # get_actor reply re-sets it, so the post-poll wait returns
+            # immediately instead of sleeping the 2 s fallback (clearing
+            # after the poll erased exactly that wakeup).
+            if state.resolve_event is None:
+                state.resolve_event = asyncio.Event()
+            state.resolve_event.clear()
             reply = await self.gcs_conn.call(
                 "get_actor", {"actor_id": state.actor_id.binary()})
             if reply is None:
@@ -1515,9 +1524,6 @@ class CoreWorker:
             if reply["state"] == "DEAD":
                 raise ActorDiedError(state.actor_id.hex()[:12],
                                      reply.get("death_cause", "dead"))
-            if state.resolve_event is None:
-                state.resolve_event = asyncio.Event()
-            state.resolve_event.clear()
             try:
                 # event-driven wake; 2 s re-poll covers a lost push
                 await asyncio.wait_for(state.resolve_event.wait(), 2.0)
@@ -1645,6 +1651,19 @@ class CoreWorker:
                 elif message["state"] == "DEAD":
                     state.address = None
                     state.dead_cause = message.get("death_cause") or "dead"
+                    # DEAD is terminal in the GCS — drop the subscription
+                    # so long-lived drivers creating ephemeral actors
+                    # don't accrete one GCS subscriber entry per actor
+                    if state.subscribed:
+                        state.subscribed = False
+                        try:
+                            fut = self.gcs_conn.start_call(
+                                "unsubscribe", {"channel": channel})
+                            fut.add_done_callback(lambda f: f.exception()
+                                                  if not f.cancelled()
+                                                  else None)
+                        except rpc.ConnectionLost:
+                            pass
                 else:  # RESTARTING etc.
                     state.address = None
                 if state.resolve_event is not None:
@@ -1793,12 +1812,10 @@ class CoreWorker:
                              exc_info=True)
         fn_blob = data.get("function_blob")
         if fn_blob is not None and spec.function_id not in self._function_cache:
-            try:
-                self._function_cache[spec.function_id] = \
-                    cloudpickle.loads(fn_blob)
-            except Exception:  # corrupt/incompatible — self-fetch instead
-                logger.debug("prefetched function blob unusable",
-                             exc_info=True)
+            # raw bytes only here: cloudpickle.loads of a user class can
+            # trigger seconds of module imports, which must happen on the
+            # exec thread (_get_function), never on this io loop
+            self._function_blobs[spec.function_id] = fn_blob
         reply_fut = self._loop.create_future()
         self._exec_queue.put((spec, reply_fut))
         reply = await reply_fut
@@ -1978,8 +1995,12 @@ class CoreWorker:
     def _get_function(self, function_id: str) -> Callable:
         fn = self._function_cache.get(function_id)
         if fn is None:
-            blob = self._run(self.gcs_conn.call(
-                "get_function", {"function_id": function_id}))
+            # raylet-prefetched blob (actor creation) decodes here on the
+            # exec thread; otherwise fetch from the GCS function table
+            blob = self._function_blobs.pop(function_id, None)
+            if blob is None:
+                blob = self._run(self.gcs_conn.call(
+                    "get_function", {"function_id": function_id}))
             if blob is None:
                 raise RayTpuError(f"function {function_id} not registered")
             fn = cloudpickle.loads(blob)
