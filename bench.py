@@ -138,6 +138,69 @@ def bench_long_context() -> dict:
             "long_context_tokens_per_sec": round(B * T / el, 1)}
 
 
+def bench_rllib_ppo(budget_s: float = 90.0) -> dict:
+    """RLlib north star (BASELINE.json: "RLlib PPO >=50k env-steps/s on
+    v4-8").  Measures PPO CartPole sampling+training env-steps/s two ways:
+    inline (0 rollout workers, vectorized envs) and a worker fleet (actor
+    rollout workers feeding the learner) — the harness shape of reference
+    ``rllib/evaluation/sampler.py:145`` / ``execution/rollout_ops.py``.
+
+    Runs in a jax-CPU subprocess: the learner is a tiny MLP where
+    remote-TPU dispatch latency would swamp the sampling measurement.
+    ``vs_ref_ppo_env_steps`` is scale-annotated: the 50k target is a
+    v4-8 pod figure; this row is one host (the bench box has 1 vCPU).
+    """
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = """
+import json, sys, time
+sys.path.insert(0, %r)
+import ray_tpu
+ray_tpu.init(num_cpus=4)
+from ray_tpu.rllib.algorithms.ppo import PPOConfig
+from ray_tpu.rllib.env import CartPole
+out = {}
+for label, workers, nenvs in [("inline", 0, 8), ("fleet", 2, 4)]:
+    config = (PPOConfig()
+              .environment(CartPole, env_config={"max_episode_steps": 200})
+              .rollouts(num_rollout_workers=workers,
+                        num_envs_per_worker=nenvs)
+              .training(train_batch_size=4000, sgd_minibatch_size=512,
+                        num_sgd_iter=4)
+              .debugging(seed=0))
+    algo = config.build()
+    algo.train()  # compile + warm the workers
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < 15.0:
+        r = algo.train()
+        steps += r.get("num_env_steps_sampled_this_iter", 0)
+    dt = time.perf_counter() - t0
+    out["ppo_env_steps_per_sec_" + label] = round(steps / dt, 1)
+    algo.stop()
+ray_tpu.shutdown()
+print("RESULT:" + json.dumps(out))
+""" % (repo,)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=budget_s * 3, close_fds=False)
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT:"):
+                out = json.loads(line[len("RESULT:"):])
+                best = max(out.values())
+                out["vs_ref_ppo_env_steps"] = round(best / 50000.0, 4)
+                return out
+        return {"rllib_bench_error":
+                (proc.stderr or proc.stdout or "no output")[-400:]}
+    except Exception as e:  # noqa: BLE001 — benchmark must always report
+        return {"rllib_bench_error": f"{type(e).__name__}: {e}"}
+
+
 def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
     """Runtime microbenchmarks covering every BASELINE.md row the
     reference's ``ray microbenchmark`` publishes: task throughput
@@ -356,6 +419,7 @@ def main() -> None:
     if os.environ.get("RAY_TPU_BENCH_RUNTIME", "1") != "0":
         details.update(bench_runtime_tasks())
         details.update(bench_cluster_scale())
+        details.update(bench_rllib_ppo())
     result = {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
         "value": round(model_stats["tokens_per_sec_per_chip"], 2),

@@ -69,6 +69,7 @@ from ray_tpu.rllib.algorithms.pg import (  # noqa: F401
     PGConfig,
     PGPolicy,
 )
+from ray_tpu.rllib.algorithms.ddppo import DDPPO, DDPPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig, PPOPolicy  # noqa: F401
 from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config, R2D2Policy  # noqa: F401

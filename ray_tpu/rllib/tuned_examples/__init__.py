@@ -23,7 +23,8 @@ def _algo_config(name: str):
         from ray_tpu.rllib import algorithms as algos
 
         _ALGO_BY_NAME = {
-            "PPO": algos.PPOConfig, "APPO": algos.APPOConfig,
+            "PPO": algos.PPOConfig, "DDPPO": algos.DDPPOConfig,
+            "APPO": algos.APPOConfig,
             "IMPALA": algos.ImpalaConfig, "DQN": algos.DQNConfig,
             "SimpleQ": algos.SimpleQConfig, "SAC": algos.SACConfig,
             "DDPG": algos.DDPGConfig, "TD3": algos.TD3Config,

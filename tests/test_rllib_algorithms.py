@@ -174,3 +174,43 @@ def test_sac_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(act_before, act_after, rtol=1e-5)
     algo.stop()
     algo2.stop()
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_ddppo_learns_cartpole_without_weight_broadcast():
+    """Decentralized PPO: 4 rollout workers allreduce gradients among
+    THEMSELVES (reference ddppo.py:252-327); the driver never broadcasts
+    weights during training, yet the gang reaches PPO-level CartPole
+    return because every rank applies identical averaged gradients."""
+    from ray_tpu.rllib.algorithms.ddppo import DDPPOConfig
+
+    config = (DDPPOConfig()
+              .environment(CartPole, env_config={"max_episode_steps": 200})
+              .rollouts(num_rollout_workers=4, num_envs_per_worker=2)
+              .training(train_batch_size=2000, sgd_minibatch_size=256,
+                        num_sgd_iter=6, lr=4e-3)
+              .debugging(seed=0))
+    algo = config.build()
+    broadcasts = []
+    algo.workers.sync_weights = lambda: broadcasts.append(1)
+    best = 0.0
+    try:
+        for _ in range(30):
+            r = algo.train()
+            rm = r.get("episode_reward_mean", np.nan)
+            if not np.isnan(rm):
+                best = max(best, rm)
+            if best >= 100.0:
+                break
+        assert best >= 100.0, best
+        assert not broadcasts  # decentralized: driver never syncs weights
+        # the fleet stays in parameter lockstep without any broadcast
+        import ray_tpu as rt
+        w0, w1 = rt.get([w.get_weights.remote()
+                         for w in algo.workers.remote_workers[:2]])
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(w0),
+                        jax.tree_util.tree_leaves(w1)):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    finally:
+        algo.stop()
