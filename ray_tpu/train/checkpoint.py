@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import re
 import shutil
 import tempfile
 import time
@@ -52,13 +53,26 @@ class Checkpoint:
         })
 
     # -- accessors --------------------------------------------------------
+    _MANIFEST = ".pickled_keys.json"
+
     def to_dict(self) -> Dict[str, Any]:
         if self._data is not None:
             return self._data
+        pickled: List[str] = []
+        manifest = os.path.join(self._dir, self._MANIFEST)
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                pickled = json.load(f)
         out: Dict[str, Any] = {}
         for name in os.listdir(self._dir):
+            if name == self._MANIFEST:
+                continue
             with open(os.path.join(self._dir, name), "rb") as f:
-                out[name] = f.read()
+                blob = f.read()
+            # non-bytes values were pickled on the way to disk
+            # (to_directory); un-pickle them so dict -> dir -> dict round
+            # trips preserve types across process/host boundaries
+            out[name] = pickle.loads(blob) if name in pickled else blob
         return out
 
     def to_directory(self, path: Optional[str] = None) -> str:
@@ -70,10 +84,17 @@ class Checkpoint:
             return path
         path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
         os.makedirs(path, exist_ok=True)
+        pickled: List[str] = []
         for key, value in self._data.items():
-            blob = value if isinstance(value, bytes) else pickle.dumps(value)
+            if isinstance(value, bytes):
+                blob = value
+            else:
+                blob = pickle.dumps(value)
+                pickled.append(key)
             with open(os.path.join(path, key), "wb") as f:
                 f.write(blob)
+        with open(os.path.join(path, self._MANIFEST), "w") as f:
+            json.dump(pickled, f)
         return path
 
     def as_directory(self):
@@ -118,15 +139,48 @@ class Checkpoint:
 
 
 class CheckpointManager:
-    """Keep-K checkpoint retention with optional score ordering."""
+    """Keep-K checkpoint retention with optional score ordering.
+
+    With ``storage_uri`` set, every registered checkpoint is mirrored to
+    durable storage (``ray_tpu.air.storage``) and retention prunes the
+    mirror too — a lost host loses nothing (parity: the reference's
+    checkpoint upload through ``RunConfig.storage_path``).
+    """
 
     def __init__(self, directory: str,
-                 config: Optional[CheckpointConfig] = None):
+                 config: Optional[CheckpointConfig] = None,
+                 storage_uri: Optional[str] = None):
         self.directory = directory
         self.config = config or CheckpointConfig()
+        self.storage_uri = storage_uri
         os.makedirs(directory, exist_ok=True)
         self._entries: List[Tuple[float, str, Dict[str, Any]]] = []
-        self._counter = 0
+        # Resume numbering after any checkpoints already present locally
+        # or at the mirror — a restored run that restarted at 1 would
+        # overwrite the earlier mirror files, and a later restore's
+        # max(names) would then pick a STALE checkpoint.
+        self._counter = self._existing_max_index()
+
+    _NAME_RE = re.compile(r"^checkpoint_(\d{6})$")
+
+    @classmethod
+    def checkpoint_index(cls, name: str) -> Optional[int]:
+        """Index of a well-formed checkpoint dir name (None for residue
+        like ``checkpoint_000003.old`` / ``.tmp``)."""
+        m = cls._NAME_RE.match(name)
+        return int(m.group(1)) if m else None
+
+    def _existing_max_index(self) -> int:
+        names = list(os.listdir(self.directory))
+        if self.storage_uri:
+            try:
+                from ray_tpu.air import storage
+                backend, path = storage.get_storage(self.storage_uri)
+                names += backend.listdir(path)
+            except Exception:  # noqa: BLE001 — mirror scan is best-effort
+                pass
+        return max((self.checkpoint_index(n) or 0 for n in names),
+                   default=0)
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Optional[Dict[str, Any]] = None) -> str:
@@ -137,6 +191,10 @@ class CheckpointManager:
         with open(os.path.join(path, ".metrics.json"), "w") as f:
             json.dump({k: v for k, v in metrics.items()
                        if isinstance(v, (int, float, str, bool))}, f)
+        if self.storage_uri:
+            from ray_tpu.air import storage
+            storage.upload_dir(path, storage.join(
+                self.storage_uri, os.path.basename(path)))
         score = self._score(metrics)
         self._entries.append((score, path, metrics))
         self._enforce_retention()
@@ -156,6 +214,14 @@ class CheckpointManager:
         self._entries.sort(key=lambda e: e[0], reverse=True)
         for _, path, _ in self._entries[keep:]:
             shutil.rmtree(path, ignore_errors=True)
+            if self.storage_uri:
+                from ray_tpu.air import storage
+                try:
+                    backend, spath = storage.get_storage(storage.join(
+                        self.storage_uri, os.path.basename(path)))
+                    backend.delete(spath)
+                except Exception:  # noqa: BLE001 — prune is best-effort
+                    pass
         self._entries = self._entries[:keep]
 
     def best_checkpoint(self) -> Optional[Checkpoint]:

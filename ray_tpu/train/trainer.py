@@ -67,12 +67,63 @@ class JaxTrainer:
         self._resume_checkpoint = resume_from_checkpoint
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _list_checkpoints(path: str):
+        """(backend, backend_path, well-formed checkpoint names) — residue
+        from interrupted atomic swaps (``.tmp``/``.old``) is excluded."""
+        from ray_tpu.air import storage
+        backend, spath = storage.get_storage(path)
+        names = [n for n in backend.listdir(spath)
+                 if CheckpointManager.checkpoint_index(n) is not None]
+        return backend, spath, names
+
+    @classmethod
+    def can_restore(cls, path: str) -> bool:
+        return bool(cls._list_checkpoints(path)[2])
+
+    @classmethod
+    def restore(cls, path: str,
+                train_loop_per_worker: Callable[[Dict[str, Any]], None],
+                **kwargs) -> "JaxTrainer":
+        """Resume a run from its (possibly remote) checkpoint root.
+
+        Parity: reference ``BaseTrainer.restore(path)`` — download the
+        latest synced checkpoint and construct a trainer that resumes
+        from it; new checkpoints continue landing at the same URI.
+        """
+        import dataclasses
+
+        backend, spath, names = cls._list_checkpoints(path)
+        if not names:
+            raise ValueError(f"no checkpoints found under {path!r}")
+        local = tempfile.mkdtemp(prefix="rtpu_train_restore_")
+        backend.download_dir(f"{spath.rstrip('/')}/{max(names)}", local)
+        run_config = kwargs.pop("run_config", None) or RunConfig()
+        # copy — silently rewriting a caller-shared config's storage_path
+        # would redirect their OTHER trainers' checkpoints here
+        run_config = dataclasses.replace(run_config, storage_path=path)
+        return cls(train_loop_per_worker, run_config=run_config,
+                   resume_from_checkpoint=Checkpoint.from_directory(local),
+                   **kwargs)
+
+    # ------------------------------------------------------------------
     def fit(self) -> Result:
-        ckpt_dir = self.run_config.storage_path or os.path.join(
+        storage_path = self.run_config.storage_path
+        default_dir = os.path.join(
             tempfile.gettempdir(), "ray_tpu_train",
             self.run_config.name or f"run_{int(time.time())}")
+        if storage_path and "://" in storage_path:
+            # URI-addressed durable storage: checkpoints stage locally and
+            # mirror to the URI (a plain path keeps the old local-dir
+            # behavior — it may itself be a shared filesystem)
+            storage_uri: Optional[str] = storage_path
+            ckpt_dir = default_dir
+        else:
+            storage_uri = None
+            ckpt_dir = storage_path or default_dir
         manager = CheckpointManager(ckpt_dir,
-                                    self.run_config.checkpoint_config)
+                                    self.run_config.checkpoint_config,
+                                    storage_uri=storage_uri)
         failures_allowed = self.run_config.failure_config.max_failures
         attempt = 0
         resume = self._resume_checkpoint

@@ -21,12 +21,82 @@ from ray_tpu.tune.trial import (ERROR, PAUSED, PENDING, RUNNING, TERMINATED,
 logger = logging.getLogger(__name__)
 
 
+class ExperimentSync:
+    """Durable experiment-state + per-trial checkpoint sync.
+
+    Parity: reference ``tune/syncer.py`` (SyncerCallback uploading trial
+    checkpoints to ``RunConfig.storage_path``) + the experiment-state
+    snapshots ``Tuner.restore`` reads.  A lost head node loses nothing:
+    every checkpoint and the trial table live at the storage URI.
+    """
+
+    STATE_FILE = "experiment_state.pkl"
+    #: min seconds between non-forced snapshots: the snapshot pickles the
+    #: FULL trial table (all results), so per-checkpoint snapshots would
+    #: be O(trials x results) work inside the runner's poll loop
+    SNAPSHOT_PERIOD_S = 2.0
+
+    def __init__(self, storage_path: str, name: str):
+        from ray_tpu.air import storage
+        self._storage = storage
+        self.root = storage.join(storage_path, name)
+        self._synced: Dict[str, Any] = {}  # trial_id -> last synced ckpt obj
+        self._last_snapshot = 0.0
+
+    @classmethod
+    def load(cls, experiment_uri: str) -> Dict[str, Any]:
+        """Read a synced experiment state (dumped with cloudpickle; plain
+        pickle loads it)."""
+        import pickle
+
+        from ray_tpu.air import storage
+        return pickle.loads(storage.read_bytes(
+            storage.join(experiment_uri, cls.STATE_FILE)))
+
+    def sync_trial_checkpoint(self, trial: Trial) -> None:
+        ckpt = trial.checkpoint
+        if ckpt is None or self._synced.get(trial.trial_id) is ckpt:
+            return
+        uri = self._storage.join(self.root, trial.trial_id, "checkpoint")
+        with ckpt.as_directory() as local:
+            self._storage.upload_dir(local, uri)
+        trial.checkpoint_uri = uri
+        self._synced[trial.trial_id] = ckpt
+
+    def snapshot(self, trials: List[Trial],
+                 meta: Optional[Dict[str, Any]] = None,
+                 force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_snapshot < self.SNAPSHOT_PERIOD_S:
+            return
+        self._last_snapshot = now
+        import cloudpickle
+        state = {
+            "meta": dict(meta or {}),
+            "trials": [{
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "status": t.status,
+                "last_result": t.last_result,
+                "results": t.results,
+                "error": t.error,
+                "num_failures": t.num_failures,
+                "checkpoint_uri": t.checkpoint_uri,
+            } for t in trials],
+        }
+        self._storage.write_bytes(
+            self._storage.join(self.root, self.STATE_FILE),
+            cloudpickle.dumps(state))
+
+
+
 class TrialRunner:
     def __init__(self, trainable: Callable, trials: List[Trial], *,
                  scheduler: Optional[sched_mod.TrialScheduler] = None,
                  max_concurrent: int = 0,
                  resources_per_trial: Optional[Dict[str, float]] = None,
-                 run_config: Optional[RunConfig] = None):
+                 run_config: Optional[RunConfig] = None,
+                 sync_meta: Optional[Dict[str, Any]] = None):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or sched_mod.FIFOScheduler()
@@ -34,6 +104,23 @@ class TrialRunner:
         self.run_config = run_config or RunConfig()
         self.max_concurrent = max_concurrent or len(trials)
         self._exploit_requests: Dict[str, tuple] = {}
+        self._sync: Optional[ExperimentSync] = None
+        self._sync_meta = dict(sync_meta or {})
+        if self.run_config.storage_path:
+            self._sync = ExperimentSync(
+                self.run_config.storage_path,
+                self.run_config.name or "tune_experiment")
+
+    def _sync_progress(self, trial: Optional[Trial] = None,
+                       force: bool = False) -> None:
+        if self._sync is None:
+            return
+        try:
+            if trial is not None:
+                self._sync.sync_trial_checkpoint(trial)
+            self._sync.snapshot(self.trials, self._sync_meta, force=force)
+        except Exception:  # noqa: BLE001 — sync must not kill training
+            logger.exception("experiment sync failed")
 
     def get_trial(self, trial_id: str) -> Optional[Trial]:
         for t in self.trials:
@@ -121,6 +208,7 @@ class TrialRunner:
                     if result.pop("_has_checkpoint", False):
                         trial.checkpoint = ray_tpu.get(
                             trial.actor.get_checkpoint.remote(), timeout=30)
+                        self._sync_progress(trial)
                     trial.last_result = result
                     trial.results.append(result)
                     d = self.scheduler.on_trial_result(self, trial, result)
@@ -131,6 +219,7 @@ class TrialRunner:
                     live.remove(trial)
                     self.scheduler.on_trial_complete(self, trial,
                                                      trial.last_result)
+                    self._sync_progress(trial, force=True)
                     continue
                 if trial.trial_id in self._exploit_requests:
                     new_config, ckpt = self._exploit_requests.pop(
@@ -166,6 +255,7 @@ class TrialRunner:
                         else:
                             self._stop_trial(trial, ERROR)
                             self.scheduler.on_trial_complete(self, trial, None)
+                        self._sync_progress(trial, force=True)
                     else:
                         trial.error = None  # a successful retry clears it
                         ckpt = ray_tpu.get(
@@ -175,6 +265,8 @@ class TrialRunner:
                         self._stop_trial(trial, TERMINATED)
                         self.scheduler.on_trial_complete(
                             self, trial, trial.last_result)
+                        self._sync_progress(trial, force=True)
             if not progressed:
                 time.sleep(poll_period)
+        self._sync_progress(force=True)
         return self.trials

@@ -156,6 +156,9 @@ class Trial:
     results: List[Dict[str, Any]] = field(default_factory=list)
     error: Optional[str] = None
     checkpoint: Optional[Checkpoint] = None
+    #: durable-storage location of the last synced checkpoint (set by the
+    #: runner's experiment sync; survives head loss)
+    checkpoint_uri: Optional[str] = None
     num_failures: int = 0
     actor: Any = None
 
