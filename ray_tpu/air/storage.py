@@ -49,12 +49,42 @@ class StorageBackend:
         raise NotImplementedError
 
 
+def _atomic_dir_swap(tmp: str, path: str) -> None:
+    """Install ``tmp`` at ``path`` atomically even when ``path`` exists.
+
+    ``os.replace`` refuses a non-empty directory target, so replacement
+    uses Linux ``renameat2(RENAME_EXCHANGE)`` — the destination is never
+    absent, closing the crash window a rename-aside two-step leaves
+    (where a SIGKILL between the renames loses the only copy).  Falls
+    back to the two-step on filesystems without exchange support."""
+    if not os.path.exists(path):
+        os.replace(tmp, path)
+        return
+    try:
+        import ctypes
+        libc = ctypes.CDLL(None, use_errno=True)
+        AT_FDCWD = -100
+        RENAME_EXCHANGE = 2
+        rc = libc.renameat2(AT_FDCWD, os.fsencode(tmp),
+                            AT_FDCWD, os.fsencode(path), RENAME_EXCHANGE)
+        if rc == 0:
+            shutil.rmtree(tmp, ignore_errors=True)  # now holds the old dir
+            return
+    except Exception:  # noqa: BLE001 — non-Linux/libc without renameat2
+        pass
+    old = path + ".old"
+    os.replace(path, old)
+    os.replace(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
 class FileStorage(StorageBackend):
     """file:// (or bare-path) backend: durable == a shared filesystem.
 
-    Uploads are ATOMIC at directory granularity: written to a ``.tmp``
-    sibling then os.replace'd, so a reader never sees a half-synced
-    checkpoint (the reference's syncer has the same contract)."""
+    Uploads are ATOMIC at directory granularity: staged to a ``.tmp``
+    sibling then swapped in with ``renameat2(RENAME_EXCHANGE)``, so a
+    reader never sees a half-synced (or missing) checkpoint (the
+    reference's syncer has the same contract)."""
 
     def upload_dir(self, local_dir: str, path: str) -> None:
         tmp = path + ".tmp"
@@ -64,13 +94,12 @@ class FileStorage(StorageBackend):
         shutil.rmtree(old, ignore_errors=True)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         shutil.copytree(local_dir, tmp)
-        # os.replace on dirs fails if target exists; swap via rename
-        if os.path.exists(path):
-            os.replace(path, old)
-        os.replace(tmp, path)
-        shutil.rmtree(old, ignore_errors=True)
+        _atomic_dir_swap(tmp, path)
 
     def download_dir(self, path: str, local_dir: str) -> None:
+        if not os.path.exists(path) and os.path.exists(path + ".old"):
+            # safety net for the non-exchange fallback's crash window
+            path = path + ".old"
         shutil.copytree(path, local_dir, dirs_exist_ok=True)
 
     def write_bytes(self, path: str, data: bytes) -> None:

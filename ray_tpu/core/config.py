@@ -66,6 +66,14 @@ class Config:
     max_workers_per_node: int = 0
 
     # ---- fault tolerance -------------------------------------------------
+    #: GCS table persistence backend: "" / "file" = session-dir pickle,
+    #: "memory" = ephemeral, or an air.storage URI (e.g. file:///nfs/gcs)
+    #: that survives losing the head host (parity: the reference's
+    #: gcs_table_storage over Redis / in-memory store clients)
+    gcs_table_storage: str = ""
+    #: How long drivers (and actor workers) keep retrying to reconnect
+    #: after the GCS/head dies before giving up (0 disables reconnect).
+    gcs_client_reconnect_timeout_s: float = 60.0
     default_max_task_retries: int = 3
     default_max_actor_restarts: int = 0
     #: Period of raylet -> GCS health reports.

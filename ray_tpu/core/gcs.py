@@ -129,32 +129,37 @@ class GcsServer:
         self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
         # (name, sorted-tags) -> aggregated metric record
         self._metrics: Dict[Any, Dict[str, Any]] = {}
-        # durable tables (reference: GcsTableStorage over Redis — here a
-        # session-dir pickle snapshot): kv, functions, jobs, and DETACHED
-        # actors survive a GCS/head restart; nodes re-register live
-        self._snapshot_path = snapshot_path
+        # durable tables behind the pluggable TableStorage interface
+        # (reference: GcsTableStorage over Redis/in-memory store clients):
+        # kv, functions, jobs, the FULL actor table, and placement groups
+        # survive a GCS/head restart; nodes re-register live
+        from ray_tpu.core.table_storage import make_table_storage
+        self.table_storage = make_table_storage(
+            getattr(config, "gcs_table_storage", ""), snapshot_path)
         self._persist_handle: Optional[asyncio.TimerHandle] = None
-        if snapshot_path and os.path.exists(snapshot_path):
-            self._restore_snapshot()
+        #: actors restored ALIVE from a snapshot pending a liveness probe
+        self._actors_to_revalidate: List[ActorInfo] = []
+        self._restore_snapshot()
 
     def _restore_snapshot(self) -> None:
-        import pickle
-
-        try:
-            with open(self._snapshot_path, "rb") as f:
-                snap = pickle.load(f)
-        except Exception as e:  # noqa: BLE001 — a torn snapshot loses
-            logger.warning("GCS snapshot unreadable (%s); cold start", e)
+        snap = self.table_storage.load()
+        if snap is None:
             return
         self.kv = snap.get("kv", {})
         self.functions = snap.get("functions", {})
         self.jobs = snap.get("jobs", {})
         self.job_counter = snap.get("job_counter", 0)
-        for info in snap.get("detached_actors", []):
+        # full actor runtime state (not just detached): a reconnecting
+        # driver's handles must keep resolving after a head restart
+        for info in snap.get("actors", snap.get("detached_actors", [])):
             self.actors[info.actor_id] = info
             if info.name:
                 self.named_actors[(info.namespace or "default",
                                    info.name)] = info.actor_id
+            if info.state == ACTOR_ALIVE:
+                # the worker may have died with the head (or survived on a
+                # side node) — probed once the server is up
+                self._actors_to_revalidate.append(info)
         # placement groups: bundles stay committed on surviving raylets;
         # restoring the table keeps lookup/removal working after restart
         # (parity: reference GcsTableStorage persists the PG table too)
@@ -166,42 +171,64 @@ class GcsServer:
             info.retry_backoff = 0.5
             self.placement_groups[pg_id] = info
         logger.info(
-            "GCS restored from snapshot: %d kv namespaces, %d functions, "
-            "%d jobs, %d detached actors",
-            len(self.kv), len(self.functions), len(self.jobs),
-            len([a for a in self.actors.values()]))
+            "GCS restored from %s: %d kv namespaces, %d functions, "
+            "%d jobs, %d actors",
+            self.table_storage.describe(), len(self.kv),
+            len(self.functions), len(self.jobs), len(self.actors))
 
     def _schedule_persist(self) -> None:
         """Debounced snapshot write (coalesces mutation bursts)."""
-        if not self._snapshot_path or self._persist_handle is not None:
+        if self._persist_handle is not None:
             return
         loop = asyncio.get_running_loop()
         self._persist_handle = loop.call_later(0.2, self._persist_now)
 
     def _persist_now(self) -> None:
-        import pickle
-
         self._persist_handle = None
-        if not self._snapshot_path:
-            return
-        detached = [a for a in self.actors.values()
-                    if a.detached and a.state != ACTOR_DEAD]
+        actors = [a for a in self.actors.values()
+                  if a.state != ACTOR_DEAD]
         pgs = {pid: info for pid, info in self.placement_groups.items()
                if info.state != "REMOVED"}
-        snap = {"kv": self.kv, "functions": self.functions,
-                "jobs": self.jobs, "job_counter": self.job_counter,
-                "detached_actors": detached,
-                "placement_groups": pgs}
-        tmp = self._snapshot_path + ".tmp"
-        try:
-            with open(tmp, "wb") as f:
-                pickle.dump(snap, f)
-            os.replace(tmp, self._snapshot_path)
-        except OSError as e:
-            logger.warning("GCS snapshot write failed: %s", e)
+        self.table_storage.store({
+            "kv": self.kv, "functions": self.functions,
+            "jobs": self.jobs, "job_counter": self.job_counter,
+            "actors": actors,
+            "placement_groups": pgs})
+
+    async def _revalidate_restored_actors(self) -> None:
+        """Probe actors restored ALIVE from the snapshot: a worker that
+        survived on a side node keeps serving (and will re-announce via
+        actor_started when its own GCS reconnect lands); one that died
+        with the head goes through the normal restart-or-dead path."""
+        pending, self._actors_to_revalidate = \
+            self._actors_to_revalidate, []
+        for info in pending:
+            alive = False
+            if info.address:
+                try:
+                    conn = await rpc.connect(tuple(info.address),
+                                             timeout=3.0)
+                    try:
+                        await conn.call("ping", {}, timeout=3.0)
+                        alive = True
+                    finally:
+                        conn.close()
+                except Exception:  # noqa: BLE001 — unreachable = dead
+                    alive = False
+            if not alive and info.state == ACTOR_ALIVE:
+                self._on_actor_worker_lost(
+                    info.actor_id, "worker lost in head restart")
 
     async def start(self) -> rpc.Address:
         address = await self.server.start()
+        if self._actors_to_revalidate:
+            async def _delayed_revalidate():
+                # give surviving side raylets/workers a beat to re-register
+                # before probing, so live actors aren't misjudged
+                await asyncio.sleep(2.0)
+                await self._revalidate_restored_actors()
+            t = asyncio.get_running_loop().create_task(_delayed_revalidate())
+            t.add_done_callback(lambda t: t.exception())
         self._health_task = asyncio.get_running_loop().create_task(
             self._health_check_loop()
         )
@@ -487,6 +514,21 @@ class GcsServer:
                              "alive": True}
         return {"job_id": job_id.binary()}
 
+    async def handle_reattach_job(self, conn, data):
+        """A driver reconnecting after a head restart re-announces its
+        (persisted) job instead of minting a new id."""
+        job_id = JobID(data["job_id"])
+        job = self.jobs.get(job_id)
+        if job is None:
+            # snapshot predates the job (e.g. memory storage): recreate
+            job = {"start_time": time.time()}
+            self.jobs[job_id] = job
+            self.job_counter = max(self.job_counter, job_id.int_value())
+        job["alive"] = True
+        job["driver_address"] = data.get("driver_address")
+        self._schedule_persist()
+        return {"job_id": job_id.binary()}
+
     async def handle_job_finished(self, conn, data):
         self._schedule_persist()
         job = self.jobs.get(JobID(data["job_id"]))
@@ -584,10 +626,15 @@ class GcsServer:
             env_hash=data.get("env_hash"),
         )
         self.actors[actor_id] = info
+        self._schedule_persist()
         asyncio.get_running_loop().create_task(self._schedule_actor(info))
         return {"existing": False, "actor_id": actor_id.binary()}
 
     def _publish_actor(self, info: ActorInfo) -> None:
+        # every published transition also reaches the durable table: the
+        # snapshot persists the FULL actor table, so a detached-only gate
+        # would leave non-detached actors stale across a head restart
+        self._schedule_persist()
         self.publish(f"actor:{info.actor_id.hex()}", self._actor_message(info))
 
     def _actor_message(self, info: ActorInfo) -> Dict[str, Any]:
@@ -718,8 +765,6 @@ class GcsServer:
         info.address = tuple(data["task_address"])
         info.state = ACTOR_ALIVE
         self._publish_actor(info)
-        if info.detached:
-            self._schedule_persist()
         return True
 
     async def handle_actor_creation_failed(self, conn, data):
@@ -770,8 +815,6 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None or info.state == ACTOR_DEAD:
             return
-        if info.detached:
-            self._schedule_persist()
         if allow_restart and info.num_restarts < info.max_restarts:
             info.num_restarts += 1
             info.state = ACTOR_RESTARTING

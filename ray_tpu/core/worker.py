@@ -241,6 +241,11 @@ class CoreWorker:
         # submitters
         self._lease_states: Dict[Tuple, "_LeaseState"] = {}
         self._actor_states: Dict[ActorID, "_ActorSubmitState"] = {}
+        # head fault tolerance (driver): frozen while the local raylet is
+        # unreachable; _reattach_raylet thaws it
+        self._raylet_down = False
+        self._raylet_repairing = False
+        self._reconnecting = False
 
         self._pool = rpc.ConnectionPool()
         self.gcs_conn: Optional[rpc.Connection] = None
@@ -335,6 +340,170 @@ class CoreWorker:
         if self.job_id is not None:
             self._bind_driver_context()
         self._flusher = self._loop.create_task(self._task_event_flush_loop())
+        if self.config.gcs_client_reconnect_timeout_s > 0:
+            # head fault tolerance: when the GCS (and, for drivers, the
+            # local raylet) dies, reconnect instead of wedging — parity:
+            # the reference GcsRpcClient's reconnect-with-backoff
+            self.gcs_conn._on_close = lambda _c: self._on_head_conn_lost()
+            if self.mode == "driver":
+                self.raylet_conn._on_close = \
+                    lambda _c: self._on_raylet_conn_lost()
+
+    def _on_raylet_conn_lost(self) -> None:
+        """Driver-side: the local raylet died.  Freeze the lease pipeline
+        (backlogs hold; no retry budget burns) and repair the route —
+        either here (raylet-only crash, GCS still up) or via the GCS
+        reconnect path when the whole head went down."""
+        if self._shutdown:
+            return
+        logger.warning("local raylet connection lost; pausing submission")
+        self._raylet_down = True
+
+        def _spawn():
+            if self._raylet_repairing:
+                return
+            self._raylet_repairing = True
+            task = self._loop.create_task(self._raylet_repair_loop())
+            task.add_done_callback(lambda t: t.exception())
+        try:
+            self._loop.call_soon_threadsafe(_spawn)
+        except (RuntimeError, AttributeError):
+            pass
+
+    async def _raylet_repair_loop(self) -> None:
+        """Reattach to an alive raylet whenever the GCS is reachable; on
+        timeout, thaw the pipeline so pending work fails loudly instead
+        of hanging forever (the pre-reconnect failure semantics)."""
+        deadline = time.monotonic() + \
+            self.config.gcs_client_reconnect_timeout_s
+        try:
+            while not self._shutdown and self._raylet_down and \
+                    time.monotonic() < deadline:
+                if self.gcs_conn is not None and not self.gcs_conn.closed:
+                    try:
+                        await self._reattach_raylet()
+                        return
+                    except Exception:  # noqa: BLE001 — head still coming up
+                        pass
+                await asyncio.sleep(0.5)
+        finally:
+            self._raylet_repairing = False
+            if self._raylet_down and not self._shutdown:
+                logger.error(
+                    "raylet unreachable for %.0fs; resuming pumps so "
+                    "pending tasks fail instead of hanging",
+                    self.config.gcs_client_reconnect_timeout_s)
+                self._raylet_down = False
+                for state in self._lease_states.values():
+                    self._pump_lease_queue(state)
+
+    def _on_head_conn_lost(self) -> None:
+        if self._shutdown or self._reconnecting:
+            return
+        self._reconnecting = True
+        logger.warning("GCS connection lost; reconnecting")
+
+        def _spawn():
+            task = self._loop.create_task(self._reconnect_head())
+            task.add_done_callback(lambda t: t.exception())
+        try:
+            self._loop.call_soon_threadsafe(_spawn)
+        except (RuntimeError, AttributeError):
+            pass
+
+    async def _reconnect_head(self) -> None:
+        deadline = time.monotonic() + \
+            self.config.gcs_client_reconnect_timeout_s
+        try:
+            while not self._shutdown and time.monotonic() < deadline:
+                try:
+                    conn = await rpc.connect(self.gcs_address,
+                                             handler=self.task_server)
+                except OSError:
+                    await asyncio.sleep(0.5)
+                    continue
+                try:
+                    await self._resume_head_session(conn)
+                except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+                    logger.info("head session resume failed (%s); retrying",
+                                e)
+                    conn.close()
+                    await asyncio.sleep(0.5)
+                    continue
+                logger.info("reconnected to GCS at %s", self.gcs_address)
+                return
+            if not self._shutdown:
+                logger.error("could not reconnect to the GCS within %.0fs",
+                             self.config.gcs_client_reconnect_timeout_s)
+        finally:
+            self._reconnecting = False
+
+    async def _resume_head_session(self, conn: rpc.Connection) -> None:
+        """Re-establish GCS state on a fresh connection, then (drivers)
+        re-route the lease pipeline through the restarted local raylet."""
+        conn.set_push_handler(self._on_gcs_push)
+        self.gcs_conn = conn
+        conn._on_close = lambda _c: self._on_head_conn_lost()
+        if self.mode == "driver" and self.config.log_to_driver:
+            await conn.call("subscribe", {"channel": "worker_logs"})
+        # re-arm actor-state subscriptions (address repair channel)
+        for state in self._actor_states.values():
+            if state.subscribed:
+                await conn.call("subscribe", {
+                    "channel": f"actor:{state.actor_id.hex()}"})
+        if self.mode == "driver" and self.job_id is not None:
+            await conn.call("reattach_job", {
+                "job_id": self.job_id.binary(),
+                "driver_address": self.task_address})
+        if self._actor_id is not None:
+            # actor worker: re-announce so the restarted GCS repairs its
+            # directory entry and re-arms death detection on THIS conn
+            await conn.call("actor_started", {
+                "actor_id": self._actor_id.binary(),
+                "task_address": self.task_address})
+        if self.mode == "driver" and \
+                (self._raylet_down or self.raylet_conn.closed):
+            await self._reattach_raylet()
+
+    async def _reattach_raylet(self) -> None:
+        """Find an alive raylet (prefer our host), re-register, remap the
+        object store, and thaw the lease pipeline."""
+        nodes = await self.gcs_conn.call("get_nodes", {})
+        alive = [n for n in nodes if n["alive"]]
+        if not alive:
+            raise rpc.RpcError("no alive nodes after head restart")
+        host = self.task_address[0]
+        preferred = [n for n in alive if n["address"][0] == host]
+        node = (preferred or alive)[0]
+        raylet_addr = tuple(node["address"])
+        conn = await rpc.connect(raylet_addr, handler=self.task_server)
+        reply = await conn.call("register_worker", {
+            "worker_id": self.worker_id.binary(),
+            "pid": os.getpid(),
+            "job_id": self.job_id.binary() if self.job_id else None,
+            "task_address": self.task_address,
+            "is_driver": True,
+        })
+        info = await conn.call("store_info", {})
+        old_raylet = self.raylet_address
+        self.raylet_address = raylet_addr
+        self.raylet_conn = conn
+        conn._on_close = lambda _c: self._on_raylet_conn_lost()
+        self.node_id = NodeID(reply["node_id"])
+        if info["store_path"] != self.store_client.path:
+            self.store_client = StoreClient(info["store_path"],
+                                            info["store_capacity"])
+        # leases granted by the dead raylet are gone; leases on surviving
+        # raylets (spillback grants) keep working — drop only the dead
+        # node's workers, then resume pumping frozen backlogs
+        for state in self._lease_states.values():
+            for wid, w in list(state.workers.items()):
+                if w.raylet == old_raylet:
+                    del state.workers[wid]
+        self._raylet_down = False
+        logger.info("reattached to raylet %s", raylet_addr)
+        for state in self._lease_states.values():
+            self._pump_lease_queue(state)
 
     def _bind_driver_context(self) -> None:
         self._driver_task_id = TaskID.for_driver(self.job_id)
@@ -991,6 +1160,10 @@ class CoreWorker:
         self._pump_lease_queue(self._backlog_enqueue(spec))
 
     def _pump_lease_queue(self, state: "_LeaseState") -> None:
+        if self._raylet_down:
+            # head outage: hold backlogs (no lease requests, no retry
+            # budget burned); _reattach_raylet re-pumps every state
+            return
         # Phase 1 — breadth first: one task per idle worker, so independent
         # tasks spread across workers/nodes instead of serializing into one
         # worker's pipeline.
@@ -1084,9 +1257,17 @@ class CoreWorker:
                 "retriable": spec.max_retries > 0,
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
+            if raylet_address == self.raylet_address and \
+                    self.config.gcs_client_reconnect_timeout_s > 0:
+                # the LOCAL raylet died (head loss): freeze — the backlog
+                # holds as-is, no retry budget burns, and the repair loop
+                # (or the GCS reconnect) reattaches.  Burning retries here
+                # exhausted every task's budget within ms of a head kill.
+                self._on_raylet_conn_lost()
+                return
             if raylet_address != self.raylet_address:
                 self._pool.invalidate(raylet_address)
-            # the raylet died mid-lease (e.g. its node was killed): a
+            # a REMOTE raylet died mid-lease (its node was killed): a
             # crash-class fault, so queued tasks retry against a fresh
             # lease (their retry budgets apply) instead of failing
             self._retry_backlog(state, WorkerCrashedError(

@@ -98,12 +98,16 @@ class JaxTrainer:
             raise ValueError(f"no checkpoints found under {path!r}")
         local = tempfile.mkdtemp(prefix="rtpu_train_restore_")
         backend.download_dir(f"{spath.rstrip('/')}/{max(names)}", local)
+        # dict-backed so the resume checkpoint pickles to gang workers on
+        # other hosts (a dir-backed object ships only a local path)
+        resume = Checkpoint.from_dict(
+            Checkpoint.from_directory(local).to_dict())
         run_config = kwargs.pop("run_config", None) or RunConfig()
         # copy — silently rewriting a caller-shared config's storage_path
         # would redirect their OTHER trainers' checkpoints here
         run_config = dataclasses.replace(run_config, storage_path=path)
         return cls(train_loop_per_worker, run_config=run_config,
-                   resume_from_checkpoint=Checkpoint.from_directory(local),
+                   resume_from_checkpoint=resume,
                    **kwargs)
 
     # ------------------------------------------------------------------

@@ -167,7 +167,11 @@ class Tuner:
             if t.checkpoint_uri and storage.exists(t.checkpoint_uri):
                 local = tempfile.mkdtemp(prefix=f"rtpu_restore_{t.trial_id}_")
                 storage.download_dir(t.checkpoint_uri, local)
-                t.checkpoint = Checkpoint.from_directory(local)
+                # dict-backed: the checkpoint must survive pickling to a
+                # trial actor on ANOTHER host — a directory-backed object
+                # would ship only this driver's local tempdir path
+                t.checkpoint = Checkpoint.from_dict(
+                    Checkpoint.from_directory(local).to_dict())
             status = ts.get("status")
             if status == TERMINATED:
                 t.status = TERMINATED

@@ -82,3 +82,45 @@ def test_detached_actor_survives_other_node_death(chaos_cluster):
     victim.kill()
     for i in range(2, 12):
         assert ray_tpu.get(c.bump.remote(), timeout=60) == i
+
+
+def test_head_kill9_midworkload_driver_finishes():
+    """kill -9 the head (GCS + head raylet) while a job is mid-flight:
+    the driver freezes its lease pipeline, reconnects to the restarted
+    head (same GCS port, persisted tables), reattaches to the new head
+    raylet, and FINISHES the workload (parity model: reference
+    test_gcs_fault_tolerance.py kill-head cases)."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        c.add_node(num_cpus=2, resources={"side": 1000})
+        c.connect()
+        c.wait_for_nodes()
+
+        @ray_tpu.remote(resources={"side": 1}, num_cpus=0)
+        def work(i):
+            import time as _t
+            _t.sleep(0.05)
+            return i * i
+
+        # phase 1: part of the workload completes before the fault
+        first = ray_tpu.get([work.remote(i) for i in range(20)],
+                            timeout=120)
+        assert first == [i * i for i in range(20)]
+
+        # submit the second phase, then murder the head mid-flight
+        refs = [work.remote(i) for i in range(20, 60)]
+        import time as _time
+        _time.sleep(0.3)  # some in flight, some queued
+        c.head.kill()  # SIGKILL — no snapshot flush, no goodbyes
+        c.restart_head(wait_s=60.0)
+
+        # the SAME driver session finishes the job after reconnecting
+        out = ray_tpu.get(refs, timeout=180)
+        assert out == [i * i for i in range(20, 60)]
+
+        # and the runtime keeps working for NEW submissions
+        more = ray_tpu.get([work.remote(i) for i in range(3)], timeout=120)
+        assert more == [0, 1, 4]
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

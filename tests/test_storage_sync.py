@@ -163,3 +163,34 @@ def test_jax_trainer_restore_from_uri(tmp_path):
     r2 = resumed.fit()
     assert r2.error is None
     assert r2.metrics["step"] == 6  # continued 4..6 from the synced ckpt
+
+
+def test_gcs_table_storage_backends(tmp_path):
+    """TableStorage interface (parity model: reference gcs_table_storage.h
+    over redis/in-memory store clients): memory, file, and URI backends."""
+    from ray_tpu.core.table_storage import (FileTableStorage,
+                                            InMemoryTableStorage,
+                                            URITableStorage,
+                                            make_table_storage)
+
+    snap = {"kv": {"ns": {"k": b"v"}}, "job_counter": 3}
+
+    mem = make_table_storage("memory", str(tmp_path / "x.pkl"))
+    assert isinstance(mem, InMemoryTableStorage)
+    mem.store(snap)
+    assert mem.load() is None  # explicitly ephemeral
+
+    f = make_table_storage("", str(tmp_path / "snap.pkl"))
+    assert isinstance(f, FileTableStorage)
+    assert f.load() is None
+    f.store(snap)
+    assert f.load() == snap
+
+    uri = make_table_storage(f"file://{tmp_path}/durable_gcs", None)
+    assert isinstance(uri, URITableStorage)
+    assert uri.load() is None
+    uri.store(snap)
+    assert uri.load() == snap
+    # a second instance (fresh head on another "host") sees the tables
+    again = make_table_storage(f"file://{tmp_path}/durable_gcs", None)
+    assert again.load() == snap
