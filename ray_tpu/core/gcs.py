@@ -139,6 +139,8 @@ class GcsServer:
         self._persist_handle: Optional[asyncio.TimerHandle] = None
         #: actors restored ALIVE from a snapshot pending a liveness probe
         self._actors_to_revalidate: List[ActorInfo] = []
+        #: actors restored mid-scheduling (PENDING/RESTARTING)
+        self._actors_to_reschedule: List[ActorInfo] = []
         self._restore_snapshot()
 
     def _restore_snapshot(self) -> None:
@@ -160,6 +162,11 @@ class GcsServer:
                 # the worker may have died with the head (or survived on a
                 # side node) — probed once the server is up
                 self._actors_to_revalidate.append(info)
+            elif info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                # scheduling was in flight when the head died; nothing
+                # else will resume it (no node-lost event fires for an
+                # actor with no node) — reschedule after startup
+                self._actors_to_reschedule.append(info)
         # placement groups: bundles stay committed on surviving raylets;
         # restoring the table keeps lookup/removal working after restart
         # (parity: reference GcsTableStorage persists the PG table too)
@@ -221,11 +228,17 @@ class GcsServer:
 
     async def start(self) -> rpc.Address:
         address = await self.server.start()
-        if self._actors_to_revalidate:
+        if self._actors_to_revalidate or self._actors_to_reschedule:
             async def _delayed_revalidate():
                 # give surviving side raylets/workers a beat to re-register
                 # before probing, so live actors aren't misjudged
                 await asyncio.sleep(2.0)
+                resched, self._actors_to_reschedule = \
+                    self._actors_to_reschedule, []
+                for info in resched:
+                    t = asyncio.get_running_loop().create_task(
+                        self._schedule_actor(info))
+                    t.add_done_callback(lambda t: t.exception())
                 await self._revalidate_restored_actors()
             t = asyncio.get_running_loop().create_task(_delayed_revalidate())
             t.add_done_callback(lambda t: t.exception())

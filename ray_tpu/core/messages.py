@@ -1,0 +1,105 @@
+"""Typed control-plane message schemas.
+
+Parity: reference ``src/ray/protobuf/*.proto`` — every cross-process
+message has a declared shape, and a frame from an incompatible peer is
+rejected AT THE BOUNDARY with a structured error instead of failing
+somewhere inside unpickling.  Two layers:
+
+1. **Frame versioning** (``rpc.py``): the version byte rides the frame
+   HEADER, outside the pickled payload, so a mismatched frame is refused
+   before any payload bytes are interpreted.
+2. **Schema registry** (this module): core RPC methods declare required
+   fields (+ optional type constraints); ``validate`` runs in
+   ``Server.dispatch`` and turns a malformed payload into a
+   ``SchemaError`` naming the method and field.
+
+The registry covers the control-plane surface whose corruption is
+hardest to debug (registration, leases, task/actor pushes, object
+plane); unregistered methods pass through — adding a schema is one
+line, not a migration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["SchemaError", "register_schema", "validate", "SCHEMAS"]
+
+
+class SchemaError(Exception):
+    """A message failed boundary validation (method + field in text)."""
+
+
+#: method -> {field: expected_type_or_None}; None = presence only
+SCHEMAS: Dict[str, Dict[str, Optional[type]]] = {}
+
+
+def register_schema(method: str, **fields: Optional[type]) -> None:
+    SCHEMAS[method] = fields
+
+
+def validate(method: str, data: Any) -> None:
+    """Raise SchemaError if ``data`` violates the method's schema."""
+    schema = SCHEMAS.get(method)
+    if schema is None:
+        return
+    if not isinstance(data, dict):
+        raise SchemaError(
+            f"{method}: payload must be a dict, got {type(data).__name__}")
+    for field, expected in schema.items():
+        if field not in data:
+            raise SchemaError(f"{method}: missing required field {field!r}")
+        if expected is not None and data[field] is not None \
+                and not isinstance(data[field], expected):
+            raise SchemaError(
+                f"{method}: field {field!r} must be "
+                f"{getattr(expected, '__name__', expected)}, got "
+                f"{type(data[field]).__name__}")
+
+
+# -- core control-plane schemas ------------------------------------------
+# registration / membership
+register_schema("register_node", node_id=bytes, raylet_address=None,
+                resources=dict)
+register_schema("register_worker", worker_id=bytes, pid=int,
+                task_address=None)
+register_schema("register_job", driver_address=None)
+register_schema("reattach_job", job_id=bytes)
+register_schema("health_report", node_id=bytes, resources_available=dict)
+
+# leases / scheduling
+register_schema("request_worker_lease", resources=dict)
+register_schema("return_worker", worker_id=bytes)
+register_schema("lease_worker_for_actor", actor_id=bytes, resources=dict,
+                spec_blob=bytes)
+
+# task / actor execution
+register_schema("push_task", spec_blob=bytes)
+register_schema("push_tasks", specs_blob=bytes)
+register_schema("create_actor", spec_blob=bytes)
+register_schema("push_actor_task", spec_blob=bytes)
+register_schema("register_actor", actor_id=bytes, spec_blob=bytes,
+                resources=dict, job_id=bytes)
+register_schema("actor_started", actor_id=bytes, task_address=None)
+register_schema("kill_actor", actor_id=bytes)
+
+# object plane
+register_schema("object_create", object_id=bytes, size=int)
+register_schema("object_seal", object_id=bytes)
+register_schema("object_get", object_ids=list)
+register_schema("object_release", object_ids=list)
+register_schema("object_free", object_ids=list)
+register_schema("get_small_object", object_id=bytes)
+
+# kv / functions / pubsub
+register_schema("kv_put", key=str, value=None)
+register_schema("kv_get", key=str)
+register_schema("kv_del", key=str)
+register_schema("get_function", function_id=str)
+register_schema("register_function", function_id=str, blob=bytes)
+register_schema("subscribe", channel=str)
+register_schema("unsubscribe", channel=str)
+
+# placement groups
+register_schema("create_placement_group", pg_id=bytes, bundles=list)
+register_schema("remove_placement_group", pg_id=bytes)

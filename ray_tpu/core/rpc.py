@@ -7,8 +7,14 @@ frames for subscriptions.  Payloads are pickled Python structures; large
 tensors never travel this path (they go through the shared-memory object
 plane), so pickling cost is bounded by control-message size.
 
-Frame layout: ``[8B little-endian length][payload]`` where payload is
-``pickle((msg_id, kind, method, data))``.
+Frame layout: ``[8B LE length][1B version][8B LE msg_id][1B kind]
+[payload]`` where payload is ``pickle((method, data))`` and length counts
+everything after the length field.  Version, correlation id, and kind
+ride the HEADER — outside the pickle — so a frame from an incompatible
+peer is rejected with a structured error before any payload bytes are
+interpreted (parity: the reference's versioned protobuf schemas).
+Payload shapes for the core control-plane methods are declared in
+``core/messages.py`` and validated at dispatch.
 
 Transport: a raw ``asyncio.Protocol`` (not StreamReader/Writer) — frames
 are parsed in ``data_received`` with zero coroutine overhead and all
@@ -27,14 +33,19 @@ import pickle
 import struct
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from ray_tpu.core.messages import validate as _validate_schema
+
 logger = logging.getLogger(__name__)
 
 #: Wire-protocol version (parity: the reference's versioned protobuf
-#: schemas).  Carried in node/job registration handshakes; the GCS
-#: rejects mismatched peers instead of failing obscurely mid-stream.
-PROTOCOL_VERSION = 1
+#: schemas).  Carried on EVERY frame header (plus the registration
+#: handshakes); a mismatched frame gets a structured per-message
+#: rejection at the boundary instead of an unpickle traceback.
+PROTOCOL_VERSION = 2
 
 _LEN = struct.Struct("<Q")
+#: post-length header: [1B version][8B LE msg_id][1B kind]
+_HDR = struct.Struct("<BQB")
 
 KIND_REQ = 0
 KIND_REP = 1
@@ -98,22 +109,33 @@ class _FrameProtocol(asyncio.Protocol):
             if total - offset - 8 < length:
                 break
             frame_end = offset + 8 + length
+            body = offset + 8
+            offset = frame_end
+            if length < _HDR.size:
+                logger.error("runt frame (%d bytes) from %s", length,
+                             conn.peername if conn else "?")
+                continue
+            version, msg_id, kind = _HDR.unpack_from(buf, body)
+            if version != PROTOCOL_VERSION:
+                # structured per-message rejection BEFORE any payload
+                # bytes are interpreted — a mixed-version cluster fails
+                # at the boundary with a clear error, not mid-unpickle
+                if conn is not None:
+                    conn._reject_version(msg_id, kind, version)
+                continue
             try:
-                message = pickle.loads(
-                    memoryview(buf)[offset + 8:frame_end])
+                method, payload = pickle.loads(
+                    memoryview(buf)[body + _HDR.size:frame_end])
             except Exception:
                 logger.exception("undecodable frame from %s",
                                  conn.peername if conn else "?")
-                offset = frame_end
                 continue
-            offset = frame_end
             if conn is not None:
                 try:
-                    conn._on_frame(message)
+                    conn._on_frame(msg_id, kind, method, payload)
                 except Exception:
-                    # a malformed frame (e.g. not a 4-tuple) must skip,
-                    # not fatal-error the transport and kill every
-                    # in-flight RPC on the link
+                    # a malformed frame must skip, not fatal-error the
+                    # transport and kill every in-flight RPC on the link
                     logger.exception("bad frame from %s", conn.peername)
         if offset:
             del buf[:offset]
@@ -146,8 +168,32 @@ class Connection:
         self.context: Dict[str, Any] = {}
 
     # -- receive path ----------------------------------------------------
-    def _on_frame(self, message: Any) -> None:
-        msg_id, kind, method, data = message
+    def _reject_version(self, msg_id: int, kind: int, peer_ver: int) -> None:
+        if peer_ver == 0x80:
+            # pickle protocol magic: the peer speaks the pre-header (v1)
+            # framing and cannot parse ANY reply we send — close the link
+            # so its RPCs fail fast with ConnectionLost instead of
+            # hanging on garbage replies
+            logger.error(
+                "peer %s speaks the pre-header wire framing (v1); this "
+                "process speaks v%d — closing (upgrade the older side)",
+                self.peername, PROTOCOL_VERSION)
+            self._teardown()
+            return
+        msg = (f"wire protocol mismatch: frame is v{peer_ver}, this "
+               f"process speaks v{PROTOCOL_VERSION} — upgrade the older "
+               f"side")
+        logger.error("%s (from %s)", msg, self.peername)
+        if kind == KIND_REQ and not self._closed:
+            # headers are version-stable from v2 on, so the newer peer
+            # can correlate this structured rejection to its request
+            try:
+                self._send_frame(msg_id, KIND_ERR, "_protocol", msg)
+            except Exception:
+                self._teardown()
+
+    def _on_frame(self, msg_id: int, kind: int, method: str,
+                  data: Any) -> None:
         if kind == KIND_REQ:
             self._loop.create_task(self._dispatch(msg_id, method, data))
         elif kind == KIND_REP:
@@ -172,9 +218,11 @@ class Connection:
         self._push_handler = fn
 
     # -- send path -------------------------------------------------------
-    def _send_frame(self, message: Any) -> None:
-        payload = pickle.dumps(message, protocol=5)
-        self._wbuf.append(_LEN.pack(len(payload)))
+    def _send_frame(self, msg_id: int, kind: int, method: str,
+                    data: Any) -> None:
+        payload = pickle.dumps((method, data), protocol=5)
+        self._wbuf.append(_LEN.pack(_HDR.size + len(payload)))
+        self._wbuf.append(_HDR.pack(PROTOCOL_VERSION, msg_id, kind))
         self._wbuf.append(payload)
         if not self._wflush_scheduled:
             self._wflush_scheduled = True
@@ -232,7 +280,7 @@ class Connection:
             reply = (msg_id, KIND_ERR, method, f"{type(e).__name__}: {e}")
         if not self._closed:
             try:
-                self._send_frame(reply)
+                self._send_frame(*reply)
             except Exception:
                 self._teardown()
 
@@ -249,7 +297,7 @@ class Connection:
         msg_id = next(self._msg_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        self._send_frame((msg_id, KIND_REQ, method, data))
+        self._send_frame(msg_id, KIND_REQ, method, data)
         return fut
 
     async def call(self, method: str, data: Any = None,
@@ -264,7 +312,7 @@ class Connection:
         if self._closed:
             return
         try:
-            self._send_frame((0, KIND_PUSH, channel, data))
+            self._send_frame(0, KIND_PUSH, channel, data)
         except Exception:
             self._teardown()
 
@@ -286,10 +334,15 @@ class Server:
     """Listens on a port; dispatches ``handle_<method>`` coroutines defined
     on a service object."""
 
-    def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0,
+                 validate_schemas: bool = True):
         self._service = service
         self._host = host
         self._port = port
+        #: services whose method names overlap the core control plane
+        #: with DIFFERENT payload shapes (e.g. the ray:// client proxy)
+        #: opt out — the registry keys on bare method names
+        self._validate_schemas = validate_schemas
         self._server: Optional[asyncio.AbstractServer] = None
         self.connections: set[Connection] = set()
         #: optional HandlerStats (util/event_stats.py) — when set, every
@@ -331,6 +384,11 @@ class Server:
         )
         if handler is None:
             raise RpcError(f"{type(self._service).__name__} has no method {method}")
+        # typed boundary: registered control-plane methods reject
+        # malformed payloads with a structured SchemaError naming the
+        # method and field (core/messages.py)
+        if self._validate_schemas:
+            _validate_schema(method, data)
         stats = self.handler_stats
         if stats is None:
             return await handler(conn, data)

@@ -245,6 +245,8 @@ class CoreWorker:
         # unreachable; _reattach_raylet thaws it
         self._raylet_down = False
         self._raylet_repairing = False
+        self._raylet_gave_up = False  # repair timed out; fail fast now
+        self._reattach_lock: Optional[asyncio.Lock] = None
         self._reconnecting = False
 
         self._pool = rpc.ConnectionPool()
@@ -390,12 +392,18 @@ class CoreWorker:
             self._raylet_repairing = False
             if self._raylet_down and not self._shutdown:
                 logger.error(
-                    "raylet unreachable for %.0fs; resuming pumps so "
-                    "pending tasks fail instead of hanging",
+                    "raylet unreachable for %.0fs; failing pending tasks",
                     self.config.gcs_client_reconnect_timeout_s)
+                # terminal: fail current backlogs OUTRIGHT and fail-fast
+                # any later submissions (re-pumping against the closed
+                # conn would just re-freeze in an endless repair cycle)
+                self._raylet_gave_up = True
                 self._raylet_down = False
+                err = RayTpuError(
+                    "local raylet unreachable (head lost and not "
+                    "recovered within gcs_client_reconnect_timeout_s)")
                 for state in self._lease_states.values():
-                    self._pump_lease_queue(state)
+                    self._fail_backlog(state, err)
 
     def _on_head_conn_lost(self) -> None:
         if self._shutdown or self._reconnecting:
@@ -467,7 +475,19 @@ class CoreWorker:
 
     async def _reattach_raylet(self) -> None:
         """Find an alive raylet (prefer our host), re-register, remap the
-        object store, and thaw the lease pipeline."""
+        object store, and thaw the lease pipeline.  Serialized: both the
+        raylet repair loop and the GCS reconnect path call this, and a
+        double run would register the worker twice and leave a zombie
+        connection whose close spuriously re-freezes the pipeline."""
+        if self._reattach_lock is None:
+            self._reattach_lock = asyncio.Lock()
+        async with self._reattach_lock:
+            if not self._raylet_down and self.raylet_conn is not None \
+                    and not self.raylet_conn.closed:
+                return  # the other path already repaired the route
+            await self._reattach_raylet_locked()
+
+    async def _reattach_raylet_locked(self) -> None:
         nodes = await self.gcs_conn.call("get_nodes", {})
         alive = [n for n in nodes if n["alive"]]
         if not alive:
@@ -501,6 +521,7 @@ class CoreWorker:
                 if w.raylet == old_raylet:
                     del state.workers[wid]
         self._raylet_down = False
+        self._raylet_gave_up = False  # a revived head restores service
         logger.info("reattached to raylet %s", raylet_addr)
         for state in self._lease_states.values():
             self._pump_lease_queue(state)
@@ -1258,6 +1279,7 @@ class CoreWorker:
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             if raylet_address == self.raylet_address and \
+                    not self._raylet_gave_up and \
                     self.config.gcs_client_reconnect_timeout_s > 0:
                 # the LOCAL raylet died (head loss): freeze — the backlog
                 # holds as-is, no retry budget burns, and the repair loop

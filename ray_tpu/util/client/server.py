@@ -204,7 +204,10 @@ class ClientService:
 
 
 async def _serve(host: str, port: int) -> None:
-    server = rpc.Server(ClientService(), host=host, port=port)
+    # the ray:// surface reuses core method NAMES with client-shaped
+    # payloads; core schema validation does not apply here
+    server = rpc.Server(ClientService(), host=host, port=port,
+                        validate_schemas=False)
     addr = await server.start()
     logger.info("client server listening on %s:%s", *addr)
     print(f"ray_tpu client server ready on ray://{addr[0]}:{addr[1]}",
