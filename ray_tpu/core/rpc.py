@@ -191,6 +191,13 @@ class Connection:
                 self._send_frame(msg_id, KIND_ERR, "_protocol", msg)
             except Exception:
                 self._teardown()
+        elif kind in (KIND_REP, KIND_ERR):
+            # a reply from a mismatched peer: fail OUR pending call with
+            # the structured error — dropping it would strand callers
+            # that wait without a timeout
+            fut = self._pending.pop(msg_id, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(RpcError(msg))
 
     def _on_frame(self, msg_id: int, kind: int, method: str,
                   data: Any) -> None:

@@ -1279,8 +1279,15 @@ class CoreWorker:
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             if raylet_address == self.raylet_address and \
-                    not self._raylet_gave_up and \
                     self.config.gcs_client_reconnect_timeout_s > 0:
+                if self._raylet_gave_up:
+                    # repair already timed out: fail fast with the real
+                    # cause (retrying against the closed conn would burn
+                    # the whole budget and report a bogus worker crash)
+                    self._fail_backlog(state, RayTpuError(
+                        "local raylet unreachable (head lost and not "
+                        "recovered within gcs_client_reconnect_timeout_s)"))
+                    return
                 # the LOCAL raylet died (head loss): freeze — the backlog
                 # holds as-is, no retry budget burns, and the repair loop
                 # (or the GCS reconnect) reattaches.  Burning retries here

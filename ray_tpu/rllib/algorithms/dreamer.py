@@ -59,24 +59,75 @@ class DreamerConfig(AlgorithmConfig):
         return Dreamer
 
 
+class _ConvEncoder(nn.Module):
+    """Pixel observation embed (DreamerV1's conv stack, scaled to tiny
+    grids): flat pixels -> [*, hidden].  Leading dims are arbitrary —
+    obs arrive flattened through the replay plumbing and are reshaped
+    to the image here."""
+
+    image_shape: Tuple[int, int, int]
+    hidden: int
+
+    @nn.compact
+    def __call__(self, obs_flat: jnp.ndarray) -> jnp.ndarray:
+        lead = obs_flat.shape[:-1]
+        x = obs_flat.reshape((-1,) + tuple(self.image_shape))
+        x = nn.relu(nn.Conv(16, (4, 4), strides=2, name="conv1")(x))
+        x = nn.relu(nn.Conv(32, (4, 4), strides=2, name="conv2")(x))
+        x = x.reshape((x.shape[0], -1))
+        emb = nn.elu(nn.Dense(self.hidden, name="fc")(x))
+        return emb.reshape(lead + (self.hidden,))
+
+
+class _ConvDecoder(nn.Module):
+    """Latent features -> flat pixel reconstruction (transposed convs)."""
+
+    image_shape: Tuple[int, int, int]
+    hidden: int
+
+    @nn.compact
+    def __call__(self, feat: jnp.ndarray) -> jnp.ndarray:
+        h, w, c = self.image_shape
+        lead = feat.shape[:-1]
+        x = feat.reshape((-1, feat.shape[-1]))
+        x = nn.elu(nn.Dense(h // 4 * (w // 4) * 32, name="fc")(x))
+        x = x.reshape((-1, h // 4, w // 4, 32))
+        x = nn.relu(nn.ConvTranspose(16, (4, 4), strides=(2, 2),
+                                     name="deconv1")(x))
+        x = nn.ConvTranspose(c, (4, 4), strides=(2, 2),
+                             name="deconv2")(x)
+        return x.reshape(lead + (h * w * c,))
+
+
 class _RSSM(nn.Module):
-    """Recurrent state-space model: deter (GRU) + stoch (gaussian)."""
+    """Recurrent state-space model: deter (GRU) + stoch (gaussian).
+
+    ``image_shape`` switches the observation heads to the conv
+    encoder/decoder pair (reference DreamerV1's pixel path); vector
+    envs keep the dense heads."""
 
     deter_size: int
     stoch_size: int
     hidden_size: int
     obs_dim: int
     num_actions: int
+    image_shape: Optional[Tuple[int, int, int]] = None
 
     def setup(self):
         self.gru = nn.GRUCell(features=self.deter_size)
         self.pre_gru = nn.Dense(self.hidden_size, name="pre_gru")
         self.prior_net = nn.Dense(2 * self.stoch_size, name="prior")
         self.post_net = nn.Dense(2 * self.stoch_size, name="post")
-        self.obs_embed = nn.Dense(self.hidden_size, name="obs_embed")
-        self.decoder = nn.Sequential([
-            nn.Dense(self.hidden_size), nn.elu,
-            nn.Dense(self.obs_dim)])
+        if self.image_shape is not None:
+            self.obs_embed = _ConvEncoder(self.image_shape,
+                                          self.hidden_size)
+            self.decoder = _ConvDecoder(self.image_shape,
+                                        self.hidden_size)
+        else:
+            self.obs_embed = nn.Dense(self.hidden_size, name="obs_embed")
+            self.decoder = nn.Sequential([
+                nn.Dense(self.hidden_size), nn.elu,
+                nn.Dense(self.obs_dim)])
         self.reward_head = nn.Sequential([
             nn.Dense(self.hidden_size), nn.elu, nn.Dense(1)])
         self.cont_head = nn.Sequential([
@@ -141,14 +192,25 @@ class Dreamer(Algorithm):
         if not isinstance(self.env.action_space, Discrete):
             raise ValueError("this Dreamer supports Discrete actions")
         self.num_actions = int(self.env.action_space.n)
-        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        obs_shape = tuple(self.env.observation_space.shape)
+        self.obs_dim = int(np.prod(obs_shape))
+        # rank-3 observations are images: conv encoder/decoder heads
+        # (reference DreamerV1's pixel path); H and W must tile the
+        # stride-2x2 conv stack
+        image_shape = obs_shape if len(obs_shape) == 3 else None
+        if image_shape is not None and (
+                image_shape[0] % 4 or image_shape[1] % 4):
+            raise ValueError(
+                f"image observations need H, W divisible by 4, "
+                f"got {image_shape}")
         deter = int(cfg.get("deter_size", 64))
         stoch = int(cfg.get("stoch_size", 16))
         hidden = int(cfg.get("hidden_size", 64))
 
         self.wm = _RSSM(deter_size=deter, stoch_size=stoch,
                         hidden_size=hidden, obs_dim=self.obs_dim,
-                        num_actions=self.num_actions)
+                        num_actions=self.num_actions,
+                        image_shape=image_shape)
         self.actor = _Head(self.num_actions, hidden)
         self.critic = _Head(1, hidden)
 
@@ -354,7 +416,10 @@ class Dreamer(Algorithm):
         total, steps, done = 0.0, 0, False
         while not done and steps < 1000:
             self._rng, k = jax.random.split(self._rng)
-            obs_j = jnp.asarray(np.asarray(obs, np.float32))[None]
+            # obs travel FLAT everywhere (replay, RSSM); the conv encoder
+            # reshapes to the image internally
+            obs_j = jnp.asarray(
+                np.asarray(obs, np.float32).ravel())[None]
             deter, stoch, action = self._policy_step(
                 self.wm_params, self.actor_params, deter, stoch,
                 prev_onehot, obs_j, k)
@@ -363,7 +428,7 @@ class Dreamer(Algorithm):
                     cfg.get("explore_noise", 0.3)):
                 act = int(self._np_rng.integers(self.num_actions))
             nobs, rew, term, trunc, _ = self.env.step(act)
-            o_l.append(np.asarray(obs, np.float32))
+            o_l.append(np.asarray(obs, np.float32).ravel())
             a_l.append(act)
             r_l.append(float(rew))
             d_l.append(bool(term))
@@ -374,14 +439,22 @@ class Dreamer(Algorithm):
             steps += 1
             self._timesteps_total += 1
             done = bool(term or trunc)
+        # terminal observation completes the arrival-aligned sequence
+        o_l.append(np.asarray(obs, np.float32).ravel())
         self._episodes.append({
-            "obs": np.stack(o_l),
-            "actions": np.asarray(a_l, np.int64),
-            "rewards": np.asarray(r_l, np.float32),
+            "obs": np.stack(o_l),  # [T+1, D]
+            "actions": np.asarray(a_l, np.int64),    # a_t from obs_t
+            "rewards": np.asarray(r_l, np.float32),  # r_t arrives at t+1
             "dones": np.asarray(d_l, np.float32)})
         return total, steps
 
     def _sample_sequences(self, bs: int, length: int) -> Dict[str, Any]:
+        """ARRIVAL-aligned windows (the Dreamer data convention): row t
+        holds obs_t, the action that LED to it (a_{t-1}, zero at episode
+        start), and the reward/termination that arrived WITH it
+        (r_{t-1}/done_{t-1}).  The reward head then predicts a quantity
+        its features can actually determine — training it against the
+        yet-untaken a_t's reward is unlearnable by construction."""
         obs = np.zeros((bs, length, self.obs_dim), np.float32)
         act = np.zeros((bs, length, self.num_actions), np.float32)
         rew = np.zeros((bs, length), np.float32)
@@ -390,22 +463,22 @@ class Dreamer(Algorithm):
         eye = np.eye(self.num_actions, dtype=np.float32)
         for b in range(bs):
             ep = self._episodes[self._np_rng.integers(len(self._episodes))]
-            T = len(ep["rewards"])
-            if T <= length:
-                start, n = 0, T
+            L = len(ep["obs"])  # T+1 arrival rows
+            prev_act = np.concatenate(
+                [np.zeros((1, self.num_actions), np.float32),
+                 eye[ep["actions"]]])
+            arr_rew = np.concatenate([[0.0], ep["rewards"]])
+            arr_done = np.concatenate([[0.0], ep["dones"]])
+            if L <= length:
+                start, n = 0, L
             else:
-                start = int(self._np_rng.integers(0, T - length + 1))
+                start = int(self._np_rng.integers(0, L - length + 1))
                 n = length
             seg = slice(start, start + n)
             obs[b, :n] = ep["obs"][seg]
-            # step t conditions on the PREVIOUS action (zero at episode
-            # start) — the same alignment the online filter uses
-            prev = eye[ep["actions"]]
-            act[b, 1:n] = prev[start:start + n - 1]
-            if start > 0:
-                act[b, 0] = prev[start - 1]
-            rew[b, :n] = ep["rewards"][seg]
-            done[b, :n] = ep["dones"][seg]
+            act[b, :n] = prev_act[seg]
+            rew[b, :n] = arr_rew[seg]
+            done[b, :n] = arr_done[seg]
             mask[b, :n] = 1.0
         return {"obs": jnp.asarray(obs), "actions_onehot": jnp.asarray(act),
                 "rewards": jnp.asarray(rew), "dones": jnp.asarray(done),

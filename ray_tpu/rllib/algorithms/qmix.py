@@ -8,12 +8,14 @@ TD target.  jax-native: agents + mixer + target pass are one jitted TD
 program; the hypernetwork's abs() weights keep monotonicity inside the
 same XLA graph.
 
-Scoped differences from the reference: feed-forward agent nets
-(the reference defaults to recurrent agents) and transition-level replay
-of joint steps; the cooperative envs this targets (TwoStepGame and
-friends) are fully observed per step.  Sampling drives the env inline in
-``training_step`` — cooperative team envs step as one unit, so there is
-no per-agent fleet to fan out.
+Like the reference (``qmix_policy.py`` trains RNN agents over episode
+batches), agents are RECURRENT by default: a shared GRU cell unrolled
+over whole episodes drawn from episode-level replay, with hidden states
+threaded through sampling and zero-padded sequence training.  Set
+``recurrent=False`` for the feed-forward/transition-replay variant
+(cheaper on fully-observed team envs).  Sampling drives the env inline
+in ``training_step`` — cooperative team envs step as one unit, so there
+is no per-agent fleet to fan out.
 """
 
 from __future__ import annotations
@@ -48,6 +50,10 @@ class QMixConfig(AlgorithmConfig):
         self.epsilon_timesteps = 5_000
         self.num_steps_sampled_before_learning_starts = 200
         self.rollout_episodes_per_step = 8
+        #: GRU agents over episode replay (reference default); False =
+        #: feed-forward agents over transition replay
+        self.recurrent = True
+        self.agent_gru_hidden = 64
 
     @property
     def algo_class(self):
@@ -90,6 +96,67 @@ class _Mixer(nn.Module):
         v = nn.Dense(self.hypernet_hiddens, name="hyper_b2_in")(state)
         b2 = nn.Dense(1, name="hyper_b2_out")(nn.relu(v))[:, 0]
         return jnp.einsum("be,be->b", hidden, w2) + b2
+
+
+class _RecurrentAgentQNet(nn.Module):
+    """Shared GRU agent (reference ``RNNAgent``): per step,
+    (carry, obs ⊕ id) -> (carry', Q[a])."""
+
+    num_actions: int
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, carry: jnp.ndarray,
+                 obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = nn.relu(nn.Dense(self.hidden, name="fc_in")(obs))
+        carry, y = nn.GRUCell(self.hidden, name="gru")(carry, x)
+        return carry, nn.Dense(self.num_actions, name="q_out")(y)
+
+
+class _RecurrentQMixModel(nn.Module):
+    """GRU agents unrolled over episodes + monotonic mixer.
+
+    The scanned module IS the only agent instance (acting calls it with
+    T=1), so per-step and unrolled passes share parameters."""
+
+    n_agents: int
+    num_actions: int
+    gru_hidden: int
+    embed_dim: int
+    hypernet_hiddens: int
+
+    def setup(self):
+        self.agent = nn.scan(
+            _RecurrentAgentQNet,
+            variable_broadcast="params", split_rngs={"params": False},
+            in_axes=1, out_axes=1)(self.num_actions, self.gru_hidden)
+        self.mixer = _Mixer(self.n_agents, self.embed_dim,
+                            self.hypernet_hiddens)
+
+    def init_carry(self, batch: int) -> jnp.ndarray:
+        return jnp.zeros((batch, self.n_agents, self.gru_hidden),
+                         jnp.float32)
+
+    def agent_step(self, carry: jnp.ndarray, obs: jnp.ndarray):
+        """One acting step: carry [B,n,H], obs [B,n,D] -> q [B,n,A]."""
+        carry, q = self.agent(carry, obs[:, None])
+        return carry, q[:, 0]
+
+    def unroll(self, obs_seq: jnp.ndarray) -> jnp.ndarray:
+        """[B,T,n,D] -> per-step agent Qs [B,T,n,A] from zero carries."""
+        carry = self.init_carry(obs_seq.shape[0])
+        _, q_seq = self.agent(carry, obs_seq)
+        return q_seq
+
+    def mix(self, chosen_qs: jnp.ndarray, state: jnp.ndarray):
+        """chosen_qs [B,n], state [B,S] -> Q_tot [B]."""
+        return self.mixer(chosen_qs, state)
+
+    def __call__(self, obs_seq, state):  # init entry point
+        q_seq = self.unroll(obs_seq)
+        B, T = q_seq.shape[:2]
+        return self.mix(q_seq.max(-1).reshape(B * T, self.n_agents),
+                        state.reshape(B * T, -1))
 
 
 class _QMixModel(nn.Module):
@@ -147,51 +214,112 @@ class QMix(Algorithm):
         self._state_dim = (len(state_fn()) if state_fn is not None
                            else obs_dim * n)
 
-        self.model = _QMixModel(
-            n_agents=n, num_actions=self.num_actions,
-            agent_hiddens=tuple(cfg.get("agent_hiddens", (64,))),
-            embed_dim=int(cfg.get("mixing_embed_dim", 32)),
-            hypernet_hiddens=int(cfg.get("hypernet_hiddens", 64)))
+        self.recurrent = bool(cfg.get("recurrent", True))
         rng = jax.random.PRNGKey(int(cfg.get("seed", 0) or 0))
         self._rng, init_rng = jax.random.split(rng)
-        dummy_obs = jnp.zeros((1, n, obs_dim), jnp.float32)
-        dummy_act = jnp.zeros((1, n), jnp.int32)
-        dummy_state = jnp.zeros((1, self._state_dim), jnp.float32)
-        self.params = self.model.init(init_rng, dummy_obs, dummy_act,
-                                      dummy_state)
-        self.target_params = self.params
-        self.opt = optax.adam(float(cfg.get("lr", 5e-4)))
-        self.opt_state = self.opt.init(self.params)
-
-        model = self.model
         gamma = float(cfg.get("gamma", 0.99))
+        self.opt = optax.adam(float(cfg.get("lr", 5e-4)))
 
-        @jax.jit
-        def _agent_qs(params, obs):
-            return model.apply(params, obs, method=model.agent_qs)
+        if self.recurrent:
+            self.model = _RecurrentQMixModel(
+                n_agents=n, num_actions=self.num_actions,
+                gru_hidden=int(cfg.get("agent_gru_hidden", 64)),
+                embed_dim=int(cfg.get("mixing_embed_dim", 32)),
+                hypernet_hiddens=int(cfg.get("hypernet_hiddens", 64)))
+            dummy_seq = jnp.zeros((1, 2, n, obs_dim), jnp.float32)
+            dummy_state = jnp.zeros((1, 2, self._state_dim), jnp.float32)
+            self.params = self.model.init(init_rng, dummy_seq, dummy_state)
+            model = self.model
 
-        @jax.jit
-        def _update(params, target_params, opt_state, batch):
-            def loss_fn(p):
-                q_tot = model.apply(p, batch["obs"], batch["actions"],
-                                    batch["state"])
-                q_next = model.apply(target_params, batch["next_obs"],
-                                     batch["next_state"],
-                                     method=model.q_tot_target)
-                target = batch["rewards"] + gamma \
-                    * (1.0 - batch["dones"]) * q_next
-                td = q_tot - jax.lax.stop_gradient(target)
-                return jnp.mean(td ** 2), jnp.mean(jnp.abs(td))
+            @jax.jit
+            def _agent_step(params, carry, obs):
+                return model.apply(params, carry, obs,
+                                   method=model.agent_step)
 
-            (loss, td_abs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            updates, opt_state = self.opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, \
-                loss, td_abs
+            @jax.jit
+            def _update(params, target_params, opt_state, batch):
+                def loss_fn(p):
+                    # obs_seq [B,T+1,n,D]; step t consumes obs_t, the
+                    # target consumes obs_{t+1} from the SAME unroll —
+                    # hidden states stay aligned with their episodes
+                    q_seq = model.apply(p, batch["obs_seq"],
+                                        method=model.unroll)
+                    B, tp1 = q_seq.shape[:2]
+                    T = tp1 - 1
+                    chosen = jnp.take_along_axis(
+                        q_seq[:, :-1],
+                        batch["actions"][..., None].astype(jnp.int32),
+                        axis=-1)[..., 0]  # [B,T,n]
+                    q_tot = model.apply(
+                        p, chosen.reshape(B * T, n),
+                        batch["state_seq"][:, :-1].reshape(B * T, -1),
+                        method=model.mix).reshape(B, T)
+                    tq = model.apply(target_params, batch["obs_seq"],
+                                     method=model.unroll)
+                    t_tot = model.apply(
+                        target_params,
+                        tq[:, 1:].max(-1).reshape(B * T, n),
+                        batch["state_seq"][:, 1:].reshape(B * T, -1),
+                        method=model.mix).reshape(B, T)
+                    target = batch["rewards"] + gamma \
+                        * (1.0 - batch["dones"]) * t_tot
+                    td = (q_tot - jax.lax.stop_gradient(target)) \
+                        * batch["mask"]
+                    denom = jnp.maximum(batch["mask"].sum(), 1.0)
+                    return (td ** 2).sum() / denom, \
+                        jnp.abs(td).sum() / denom
 
-        self._agent_qs = _agent_qs
-        self._update = _update
+                (loss, td_abs), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                updates, opt_state = self.opt.update(grads, opt_state,
+                                                     params)
+                return optax.apply_updates(params, updates), opt_state, \
+                    loss, td_abs
 
+            self._agent_step = _agent_step
+            self._update = _update
+        else:
+            self.model = _QMixModel(
+                n_agents=n, num_actions=self.num_actions,
+                agent_hiddens=tuple(cfg.get("agent_hiddens", (64,))),
+                embed_dim=int(cfg.get("mixing_embed_dim", 32)),
+                hypernet_hiddens=int(cfg.get("hypernet_hiddens", 64)))
+            dummy_obs = jnp.zeros((1, n, obs_dim), jnp.float32)
+            dummy_act = jnp.zeros((1, n), jnp.int32)
+            dummy_state = jnp.zeros((1, self._state_dim), jnp.float32)
+            self.params = self.model.init(init_rng, dummy_obs, dummy_act,
+                                          dummy_state)
+            model = self.model
+
+            @jax.jit
+            def _agent_qs(params, obs):
+                return model.apply(params, obs, method=model.agent_qs)
+
+            @jax.jit
+            def _update(params, target_params, opt_state, batch):
+                def loss_fn(p):
+                    q_tot = model.apply(p, batch["obs"], batch["actions"],
+                                        batch["state"])
+                    q_next = model.apply(target_params, batch["next_obs"],
+                                         batch["next_state"],
+                                         method=model.q_tot_target)
+                    target = batch["rewards"] + gamma \
+                        * (1.0 - batch["dones"]) * q_next
+                    td = q_tot - jax.lax.stop_gradient(target)
+                    return jnp.mean(td ** 2), jnp.mean(jnp.abs(td))
+
+                (loss, td_abs), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                updates, opt_state = self.opt.update(grads, opt_state,
+                                                     params)
+                return optax.apply_updates(params, updates), opt_state, \
+                    loss, td_abs
+
+            self._agent_qs = _agent_qs
+            self._update = _update
+
+        self.target_params = self.params
+        self.opt_state = self.opt.init(self.params)
         self._replay: deque = deque(
             maxlen=int(cfg.get("replay_buffer_capacity", 10_000)))
         self._np_rng = np.random.default_rng(int(cfg.get("seed", 0) or 0))
@@ -224,9 +352,7 @@ class QMix(Algorithm):
         e1 = float(cfg.get("epsilon_final", 0.05))
         return e0 + frac * (e1 - e0)
 
-    def _act(self, stacked_obs: np.ndarray, explore: bool) -> np.ndarray:
-        q = np.asarray(self._agent_qs(
-            self.params, jnp.asarray(stacked_obs[None])))[0]  # [n, A]
+    def _choose(self, q: np.ndarray, explore: bool) -> np.ndarray:
         actions = q.argmax(axis=-1)
         if explore:
             eps = self._epsilon()
@@ -239,10 +365,48 @@ class QMix(Algorithm):
     def _run_episode(self, explore: bool = True) -> Tuple[float, int]:
         obs, _ = self.env.reset()
         total, steps = 0.0, 0
+        if self.recurrent:
+            carry = jnp.zeros(
+                (1, self.n_agents, self.model.gru_hidden), jnp.float32)
+            ep_obs, ep_state, ep_act, ep_rew, ep_done = [], [], [], [], []
+            while True:
+                stacked = self._stack_obs(obs)
+                state = self._global_state(stacked)
+                carry, q = self._agent_step(self.params, carry,
+                                            jnp.asarray(stacked[None]))
+                actions = self._choose(np.asarray(q)[0], explore)
+                action_dict = {aid: int(a) for aid, a in
+                               zip(self.agent_ids, actions)}
+                obs, rews, terms, truncs, _ = self.env.step(action_dict)
+                rew = float(sum(rews.values()))
+                done = bool(terms.get("__all__") or truncs.get("__all__"))
+                ep_obs.append(stacked)
+                ep_state.append(state)
+                ep_act.append(actions.astype(np.int64))
+                ep_rew.append(rew)
+                ep_done.append(float(done))
+                total += rew
+                steps += 1
+                self._timesteps_total += 1
+                self._since_target += 1
+                if done:
+                    final = self._stack_obs(obs)
+                    ep_obs.append(final)
+                    ep_state.append(self._global_state(final))
+                    self._replay.append({
+                        "obs_seq": np.stack(ep_obs),      # [T+1, n, D]
+                        "state_seq": np.stack(ep_state),  # [T+1, S]
+                        "actions": np.stack(ep_act),      # [T, n]
+                        "rewards": np.asarray(ep_rew, np.float32),
+                        "dones": np.asarray(ep_done, np.float32),
+                    })
+                    return total, steps
         while True:
             stacked = self._stack_obs(obs)
             state = self._global_state(stacked)
-            actions = self._act(stacked, explore)
+            q = np.asarray(self._agent_qs(
+                self.params, jnp.asarray(stacked[None])))[0]  # [n, A]
+            actions = self._choose(q, explore)
             action_dict = {aid: int(a) for aid, a in
                            zip(self.agent_ids, actions)}
             obs, rews, terms, truncs, _ = self.env.step(action_dict)
@@ -271,6 +435,22 @@ class QMix(Algorithm):
         warmup = int(cfg.get("num_steps_sampled_before_learning_starts",
                              200))
         bs = int(cfg.get("train_batch_size", 32))
+        if self.recurrent:
+            if len(self._replay) >= bs and \
+                    self._timesteps_total >= warmup:
+                idx = self._np_rng.integers(0, len(self._replay), bs)
+                episodes = [self._replay[i] for i in idx]
+                batch = self._pad_episode_batch(episodes)
+                self.params, self.opt_state, loss, td_abs = self._update(
+                    self.params, self.target_params, self.opt_state,
+                    batch)
+                stats["loss"] = float(loss)
+                stats["td_error_abs"] = float(td_abs)
+                if self._since_target >= int(
+                        cfg.get("target_network_update_freq", 200)):
+                    self.target_params = self.params
+                    self._since_target = 0
+            return stats
         if len(self._replay) >= max(warmup, bs):
             idx = self._np_rng.integers(0, len(self._replay), bs)
             rows = [self._replay[i] for i in idx]
@@ -294,6 +474,37 @@ class QMix(Algorithm):
                 self.target_params = self.params
                 self._since_target = 0
         return stats
+
+    def _pad_episode_batch(self, episodes: List[Dict[str, np.ndarray]]
+                           ) -> Dict[str, jnp.ndarray]:
+        """Zero-pad variable-length episodes to a power-of-two horizon
+        (bounds jit recompiles) with a validity mask over real steps."""
+        max_t = max(ep["rewards"].shape[0] for ep in episodes)
+        pad_t = 1 << (max_t - 1).bit_length() if max_t > 1 else 1
+        B = len(episodes)
+        n = self.n_agents
+        d = episodes[0]["obs_seq"].shape[-1]
+        s = episodes[0]["state_seq"].shape[-1]
+        obs = np.zeros((B, pad_t + 1, n, d), np.float32)
+        state = np.zeros((B, pad_t + 1, s), np.float32)
+        acts = np.zeros((B, pad_t, n), np.int64)
+        rews = np.zeros((B, pad_t), np.float32)
+        dones = np.ones((B, pad_t), np.float32)  # padding counts "done"
+        mask = np.zeros((B, pad_t), np.float32)
+        for i, ep in enumerate(episodes):
+            t = ep["rewards"].shape[0]
+            obs[i, :t + 1] = ep["obs_seq"]
+            state[i, :t + 1] = ep["state_seq"]
+            acts[i, :t] = ep["actions"]
+            rews[i, :t] = ep["rewards"]
+            dones[i, :t] = ep["dones"]
+            mask[i, :t] = 1.0
+        return {"obs_seq": jnp.asarray(obs),
+                "state_seq": jnp.asarray(state),
+                "actions": jnp.asarray(acts),
+                "rewards": jnp.asarray(rews),
+                "dones": jnp.asarray(dones),
+                "mask": jnp.asarray(mask)}
 
     # -- Algorithm plumbing without a worker fleet ----------------------
     def _collect_metrics(self):

@@ -283,12 +283,61 @@ class TwoStepGame(MultiAgentEnv):
         return obs, rews, terms, truncs, {0: {}, 1: {}}
 
 
+class PixelCatch:
+    """Catch on an HxW pixel grid: a ball falls one row per step, the
+    paddle on the bottom row moves left/stay/right; +1 for catching,
+    -1 for missing.  The tiny standard pixel-control smoke benchmark
+    (bsuite catch) — observations are IMAGES [H, W, 1], exercising conv
+    encoder/decoder paths."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.h = int(config.get("height", 8))
+        self.w = int(config.get("width", 8))
+        #: dense per-step alignment reward (smoke-test mode); the
+        #: classic game keeps only the terminal +-1
+        self.shaped = bool(config.get("shaped", False))
+        self._rng = np.random.default_rng(int(config.get("seed", 0) or 0))
+        self.observation_space = Box(0.0, 1.0, (self.h, self.w, 1))
+        self.action_space = Discrete(3)  # left, stay, right
+        self._ball = (0, 0)
+        self._paddle = 0
+
+    def _obs(self) -> np.ndarray:
+        img = np.zeros((self.h, self.w, 1), np.float32)
+        img[self._ball[0], self._ball[1], 0] = 1.0
+        img[self.h - 1, self._paddle, 0] = 1.0
+        return img
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._ball = (0, int(self._rng.integers(self.w)))
+        self._paddle = self.w // 2
+        return self._obs(), {}
+
+    def step(self, action: int):
+        self._paddle = int(np.clip(self._paddle + int(action) - 1,
+                                   0, self.w - 1))
+        row, col = self._ball
+        self._ball = (row + 1, col)
+        if self._ball[0] >= self.h - 1:
+            rew = 1.0 if self._ball[1] == self._paddle else -1.0
+            self._ball = (self.h - 1, self._ball[1])
+            return self._obs(), rew, True, False, {}
+        rew = 0.0
+        if self.shaped:
+            rew = 0.1 if self._paddle == col else -0.1
+        return self._obs(), rew, False, False, {}
+
+
 _ENV_REGISTRY: Dict[str, Any] = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "RandomEnv": RandomEnv,
     "MultiAgentCartPole": MultiAgentCartPole,
     "TwoStepGame": TwoStepGame,
+    "PixelCatch": PixelCatch,
 }
 
 

@@ -246,11 +246,18 @@ def test_qmix_two_step_game():
     mixers); we assert solid progress toward it in bounded iters."""
     from ray_tpu.rllib.algorithms import QMixConfig
 
+    import jax as _jax
+
     config = QMixConfig().environment("TwoStepGame").debugging(seed=0)
     config.rollout_episodes_per_step = 16
     config.epsilon_timesteps = 1200
     config.target_network_update_freq = 100
     algo = config.build()
+    # reference parity: GRU agents over episode replay are the default
+    assert algo.recurrent
+    assert any("gru" in "/".join(map(str, path)).lower()
+               for path, _ in
+               _jax.tree_util.tree_flatten_with_path(algo.params)[0])
     best = -np.inf
     for _ in range(60):
         r = algo.train()
@@ -326,9 +333,11 @@ def test_attention_net_ppo():
     algo.stop()
 
 
+@pytest.mark.usefixtures("ray_start_regular")
 def test_tuned_examples_registry():
     """Every tuned-example yaml loads and builds (the full regression
-    run is the slow marked test below)."""
+    run is the slow marked test below).  Needs a cluster: DDPPO builds
+    a real rollout-worker gang."""
     from ray_tpu.rllib import tuned_examples
 
     paths = tuned_examples.list_examples()
