@@ -9,6 +9,8 @@ from ray_tpu.rllib.algorithms.bandit import (  # noqa: F401
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig, CQLPolicy  # noqa: F401
 from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig, CRRPolicy  # noqa: F401
 from ray_tpu.rllib.algorithms.ddpg import (  # noqa: F401
+    ApexDDPG,
+    ApexDDPGConfig,
     DDPG,
     DDPGConfig,
     DDPGPolicy,
@@ -46,6 +48,21 @@ from ray_tpu.rllib.algorithms.impala import (  # noqa: F401
     IMPALA,
     ImpalaConfig,
     ImpalaPolicy,
+)
+from ray_tpu.rllib.algorithms.alpha_star import (  # noqa: F401
+    AlphaStar,
+    AlphaStarConfig,
+    RepeatedRPS,
+)
+from ray_tpu.rllib.algorithms.maml import (  # noqa: F401
+    MAML,
+    MAMLConfig,
+    MAMLPolicy,
+)
+from ray_tpu.rllib.algorithms.mbmpo import (  # noqa: F401
+    MBMPO,
+    MBMPOConfig,
+    MBMPOPolicy,
 )
 from ray_tpu.rllib.algorithms.maddpg import (  # noqa: F401
     MADDPG,

@@ -26,7 +26,8 @@ from ray_tpu.rllib.execution import synchronous_parallel_sample
 from ray_tpu.rllib.models import TwinQNetwork
 from ray_tpu.rllib.policy import (JaxPolicy, normalize_actions,
                                   rescale_actions)
-from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
@@ -154,14 +155,21 @@ class DDPGPolicy(JaxPolicy):
             target = rew + gamma * (1 - done) * jnp.minimum(tq1, tq2)
             target = jax.lax.stop_gradient(target)
 
+            # importance weights (prioritized replay); ones otherwise
+            w = batch.get("weights", jnp.ones_like(rew))
+
             def critic_loss(p):
                 q1, q2 = critic.apply(p, obs, acts)
+                td = q1 - target
                 if twin:
-                    return jnp.mean((q1 - target) ** 2
-                                    + (q2 - target) ** 2)
-                return jnp.mean((q1 - target) ** 2)
+                    loss = jnp.mean(w * ((q1 - target) ** 2
+                                         + (q2 - target) ** 2))
+                else:
+                    loss = jnp.mean(w * (q1 - target) ** 2)
+                return loss, td
 
-            c_loss, c_grads = jax.value_and_grad(critic_loss)(critic_params)
+            (c_loss, td_error), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(critic_params)
             c_up, c_opt = critic_opt.update(c_grads, c_opt)
             critic_params = optax.apply_updates(critic_params, c_up)
 
@@ -192,7 +200,7 @@ class DDPGPolicy(JaxPolicy):
             stats = {"critic_loss": c_loss, "actor_loss": a_loss,
                      "mean_q_target": jnp.mean(target)}
             return (actor_params, critic_params, t_actor, t_critic,
-                    a_opt, c_opt, stats)
+                    a_opt, c_opt, stats, td_error)
 
         self._act_fn = _act
         self._update_fn = _update
@@ -211,11 +219,26 @@ class DDPGPolicy(JaxPolicy):
                 self._act_fn(self.actor_params,
                              jnp.asarray(obs, jnp.float32)))
         if explore:
-            sigma = float(self.config.get("exploration_noise", 0.1))
+            sigma = self._exploration_sigma()
             act = np.clip(
                 act + self._np_rng.normal(0.0, sigma, act.shape),
                 -1.0, 1.0).astype(np.float32)
         return self._rescale(act), {}
+
+    def _exploration_sigma(self) -> float:
+        """Per-worker noise scale.  With ``per_worker_exploration`` on
+        (Ape-X), worker i of N samples with sigma_i = sigma_base **
+        (1 + alpha * i / (N - 1)) — the reference's
+        ``PerWorkerEpsilonGreedy`` ladder applied to Gaussian noise."""
+        cfg = self.config
+        sigma = float(cfg.get("exploration_noise", 0.1))
+        if cfg.get("per_worker_exploration"):
+            i = int(cfg.get("worker_index", 0))
+            n = max(1, int(cfg.get("num_rollout_workers", 1)))
+            if i > 0 and n > 1:
+                alpha = float(cfg.get("per_worker_noise_alpha", 3.0))
+                sigma = sigma ** (1.0 + alpha * (i - 1) / (n - 1))
+        return sigma
 
     def postprocess_trajectory(self, batch, last_obs=None, truncated=False):
         return batch
@@ -234,13 +257,16 @@ class DDPGPolicy(JaxPolicy):
             self._rng, rng = jax.random.split(self._rng)
             (self.actor_params, self.critic_params,
              self.target_actor_params, self.target_critic_params,
-             self.actor_opt_state, self.critic_opt_state, stats) = \
+             self.actor_opt_state, self.critic_opt_state, stats,
+             td_error) = \
                 self._update_fn(
                     self.actor_params, self.critic_params,
                     self.target_actor_params, self.target_critic_params,
                     self.actor_opt_state, self.critic_opt_state,
                     self._device_batch(batch), rng, do_actor)
-        return {k: float(v) for k, v in stats.items()}
+        out = {k: float(v) for k, v in stats.items()}
+        out["_td_error_np"] = np.asarray(td_error)
+        return out
 
     # -- weights ---------------------------------------------------------
     def get_weights(self):
@@ -280,9 +306,16 @@ class DDPG(Algorithm):
     def setup(self) -> None:
         super().setup()
         cfg = self.config
-        self.replay = ReplayBuffer(
-            int(cfg.get("replay_buffer_capacity", 100_000)),
-            seed=cfg.get("seed"))
+        if cfg.get("prioritized_replay"):
+            self.replay = PrioritizedReplayBuffer(
+                int(cfg.get("replay_buffer_capacity", 100_000)),
+                alpha=float(cfg.get("prioritized_replay_alpha", 0.6)),
+                beta=float(cfg.get("prioritized_replay_beta", 0.4)),
+                seed=cfg.get("seed"))
+        else:
+            self.replay = ReplayBuffer(
+                int(cfg.get("replay_buffer_capacity", 100_000)),
+                seed=cfg.get("seed"))
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
@@ -301,10 +334,39 @@ class DDPG(Algorithm):
             updates = max(1, round(float(cfg.get("training_intensity", 1.0))
                                    * len(batch)))
             for _ in range(updates):
-                stats.update(policy.learn_on_batch(self.replay.sample(bs)))
+                mb = self.replay.sample(bs)
+                out = policy.learn_on_batch(mb)
+                td = out.pop("_td_error_np", None)
+                if td is not None and hasattr(self.replay,
+                                              "update_priorities"):
+                    self.replay.update_priorities(mb["batch_indexes"], td)
+                stats.update(out)
             self.workers.sync_weights()
         return stats
 
 
 class TD3(DDPG):
+    pass
+
+
+class ApexDDPGConfig(DDPGConfig):
+    """Ape-X DDPG (reference ``rllib/algorithms/apex_ddpg/``): DDPG with
+    a distributed sampler fleet on a per-worker exploration-noise
+    ladder feeding prioritized replay at high training intensity."""
+
+    def __init__(self):
+        super().__init__()
+        self.prioritized_replay = True
+        self.num_rollout_workers = 4
+        self.training_intensity = 4.0
+        self.per_worker_exploration = True
+        self.per_worker_noise_alpha = 3.0
+        self.exploration_noise = 0.4
+
+    @property
+    def algo_class(self):
+        return ApexDDPG
+
+
+class ApexDDPG(DDPG):
     pass

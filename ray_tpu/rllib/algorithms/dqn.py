@@ -67,6 +67,15 @@ class DQNPolicy(JaxPolicy):
     # -- exploration ----------------------------------------------------
     def _epsilon(self) -> float:
         cfg = self.config
+        if cfg.get("per_worker_exploration"):
+            # Ape-X constant per-worker ladder: worker i of N explores
+            # at eps ** (1 + alpha * i / (N-1)) (reference
+            # ``PerWorkerEpsilonGreedy``); the local worker anneals.
+            i = int(cfg.get("worker_index", 0))
+            n = max(1, int(cfg.get("num_rollout_workers", 1)))
+            if i > 0 and n > 1:
+                alpha = float(cfg.get("per_worker_eps_alpha", 7.0))
+                return 0.4 ** (1.0 + alpha * (i - 1) / (n - 1))
         frac = min(1.0, self._steps
                    / float(cfg.get("epsilon_timesteps", 10_000)))
         e0 = float(cfg.get("epsilon_initial", 1.0))
@@ -235,6 +244,8 @@ class ApexDQNConfig(DQNConfig):
         self.num_rollout_workers = 4
         self.training_intensity = 4.0
         self.target_network_update_freq = 2000
+        self.per_worker_exploration = True
+        self.per_worker_eps_alpha = 7.0
 
     @property
     def algo_class(self):

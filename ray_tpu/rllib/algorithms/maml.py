@@ -1,0 +1,197 @@
+"""MAML — Model-Agnostic Meta-Learning for RL.
+
+Parity: reference ``rllib/algorithms/maml/maml.py`` (workers each hold a
+sampled task from a ``TaskSettableEnv``; inner policy-gradient
+adaptation on pre-batches, post-adaptation sampling, and a meta-update
+that differentiates through the adaptation — ``maml.py:79-170``,
+``maml_torch_policy.py:63`` higher-order grads).
+
+tpu-native design: where the reference hand-builds higher-order autograd
+graphs in torch, here adaptation is a pure function ``adapt(theta, pre)``
+(inner SGD steps via ``jax.grad``) and the meta-gradient is ``jax.grad``
+*through* it — exact second-order MAML.  The per-task axis is ``vmap``-ed,
+so one jitted program computes every task's adaptation and the meta-loss
+in a single XLA compilation, batched onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+_META_KEYS = (SampleBatch.OBS, SampleBatch.ACTIONS,
+              SampleBatch.ACTION_LOGP, SampleBatch.ADVANTAGES,
+              SampleBatch.VALUE_TARGETS)
+
+
+class MAMLConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3                  # outer (meta) Adam lr
+        self.inner_lr = 0.1             # inner SGD step size
+        self.inner_adaptation_steps = 1
+        self.maml_optimizer_steps = 5   # outer steps per meta-batch
+        self.num_rollout_workers = 2    # == tasks per meta-batch
+        self.rollout_fragment_length = 200
+        self.clip_param = 0.3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+
+    @property
+    def algo_class(self):
+        return MAML
+
+
+class MAMLPolicy(JaxPolicy):
+    """Carries the vmapped adapt/meta-update programs; acting and GAE
+    postprocessing come from JaxPolicy."""
+
+    def __init__(self, observation_space, action_space, config):
+        super().__init__(observation_space, action_space, config)
+        model, dist = self.model, self.dist
+        cfg = config
+        inner_lr = float(cfg.get("inner_lr", 0.1))
+        inner_steps = int(cfg.get("inner_adaptation_steps", 1))
+        clip = float(cfg.get("clip_param", 0.3))
+        vf_coeff = float(cfg.get("vf_loss_coeff", 0.5))
+        ent_coeff = float(cfg.get("entropy_coeff", 0.0))
+        opt = self.opt
+
+        def pg_loss(params, batch):
+            """Inner objective: vanilla policy gradient + value error
+            (the adaptation signal; reference maml_torch_policy inner
+            loss)."""
+            dist_inputs, vf = model.apply(params, batch[SampleBatch.OBS])
+            logp = dist.logp(dist_inputs, batch[SampleBatch.ACTIONS])
+            pg = -jnp.mean(logp * batch[SampleBatch.ADVANTAGES])
+            verr = jnp.mean(
+                (vf - batch[SampleBatch.VALUE_TARGETS]) ** 2)
+            return pg + vf_coeff * verr
+
+        def adapt(theta, pre):
+            adapted = theta
+            for _ in range(inner_steps):
+                g = jax.grad(pg_loss)(adapted, pre)
+                adapted = jax.tree_util.tree_map(
+                    lambda p, gi: p - inner_lr * gi, adapted, g)
+            return adapted
+
+        def ppo_loss(params, batch):
+            """Outer objective: clipped PPO surrogate on post-adaptation
+            data."""
+            dist_inputs, vf = model.apply(params, batch[SampleBatch.OBS])
+            logp = dist.logp(dist_inputs, batch[SampleBatch.ACTIONS])
+            ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
+            adv = batch[SampleBatch.ADVANTAGES]
+            surrogate = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            verr = jnp.mean(
+                (vf - batch[SampleBatch.VALUE_TARGETS]) ** 2)
+            entropy = jnp.mean(dist.entropy(dist_inputs))
+            return (-jnp.mean(surrogate) + vf_coeff * verr
+                    - ent_coeff * entropy)
+
+        @jax.jit
+        def _adapt(theta, pre):
+            return adapt(theta, pre)
+
+        @jax.jit
+        def _meta_update(theta, opt_state, pre, post):
+            def meta_loss(theta):
+                def per_task(pre_k, post_k):
+                    return ppo_loss(adapt(theta, pre_k), post_k)
+
+                return jnp.mean(jax.vmap(per_task)(pre, post))
+
+            loss, grads = jax.value_and_grad(meta_loss)(theta)
+            updates, opt_state = opt.update(grads, opt_state, theta)
+            return optax.apply_updates(theta, updates), opt_state, loss
+
+        self._adapt_fn = _adapt
+        self._meta_update_fn = _meta_update
+
+
+class MAML(Algorithm):
+    policy_class = MAMLPolicy
+
+    def setup(self) -> None:
+        super().setup()
+        if not self.workers.remote_workers:
+            raise ValueError("MAML needs num_rollout_workers >= 1 "
+                             "(one worker per sampled task)")
+        env = self.workers.local_worker.envs[0]
+        if not hasattr(env, "sample_tasks"):
+            raise ValueError(
+                f"MAML needs a TaskSettableEnv (sample_tasks/set_task); "
+                f"got {type(env).__name__}")
+
+    @staticmethod
+    def _stack(batches: List[SampleBatch]) -> Dict[str, jnp.ndarray]:
+        n = min(len(b) for b in batches)
+        return {k: jnp.asarray(np.stack(
+            [np.asarray(b[k][:n]) for b in batches]))
+            for k in _META_KEYS}
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        cfg = self.config
+        policy: MAMLPolicy = self.workers.local_worker.policy
+        workers = self.workers.remote_workers
+
+        # 1. sample a task per worker
+        tasks = self.workers.local_worker.envs[0].sample_tasks(
+            len(workers))
+        ray_tpu.get([w.apply.remote(
+            lambda wk, t=t: [e.set_task(t) for e in wk.envs])
+            for w, t in zip(workers, tasks)], timeout=60)
+
+        # 2. pre-adaptation rollouts under theta
+        self.workers.sync_weights()
+        pre = ray_tpu.get([w.sample.remote() for w in workers],
+                          timeout=300)
+
+        # 3. per-task inner adaptation; post-adaptation rollouts under
+        #    the adapted weights
+        pre_stack = self._stack(pre)
+        with policy._on_device():
+            theta = policy.params
+            adapted = [policy._adapt_fn(
+                theta, {k: v[i] for k, v in pre_stack.items()})
+                for i in range(len(workers))]
+        ray_tpu.get([w.set_weights.remote(jax.tree_util.tree_map(
+            np.asarray, a)) for w, a in zip(workers, adapted)],
+            timeout=60)
+        post = ray_tpu.get([w.sample.remote() for w in workers],
+                           timeout=300)
+        post_stack = self._stack(post)
+
+        # 4. meta-update: differentiate through the adaptation
+        with policy._on_device():
+            loss = None
+            for _ in range(int(cfg.get("maml_optimizer_steps", 5))):
+                policy.params, policy.opt_state, loss = \
+                    policy._meta_update_fn(policy.params,
+                                           policy.opt_state,
+                                           pre_stack, post_stack)
+            loss = float(loss)
+
+        self._timesteps_total += sum(len(b) for b in pre) + sum(
+            len(b) for b in post)
+        pre_rew = float(np.mean(
+            [np.sum(np.asarray(b[SampleBatch.REWARDS])) for b in pre]))
+        post_rew = float(np.mean(
+            [np.sum(np.asarray(b[SampleBatch.REWARDS])) for b in post]))
+        return {"meta_loss": loss,
+                "pre_adaptation_reward": pre_rew,
+                "post_adaptation_reward": post_rew,
+                "adaptation_delta": post_rew - pre_rew}

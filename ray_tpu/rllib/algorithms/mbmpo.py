@@ -1,0 +1,331 @@
+"""MBMPO — Model-Based Meta-Policy Optimization.
+
+Parity: reference ``rllib/algorithms/mbmpo/mbmpo.py`` — an ensemble of
+learned dynamics models (``model_ensemble.py``), each ensemble member
+treated as one MAML task; the policy is meta-trained on imagined
+rollouts inside the models, and real env data periodically refreshes
+the ensemble (``mbmpo.py:260-330`` inner/outer loop).
+
+tpu-native design: the reference steps its learned models as python
+"model envs" on CPU workers.  Here the dynamics ensemble is one flax
+module whose parameters carry a leading ensemble axis (``vmap``-ed
+init/train), imagined rollouts are ``lax.scan`` over the horizon and
+``vmap`` over (ensemble, imagined-env) axes, and the whole meta-step —
+imagine pre-batch, per-model inner adaptation, imagine post-batch with
+adapted weights, PPO meta-update through the adaptation — is ONE jitted
+program that never leaves the device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env import Box
+from ray_tpu.rllib.execution import synchronous_parallel_sample
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class MBMPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3                  # outer (meta) Adam lr
+        self.inner_lr = 0.1
+        self.inner_adaptation_steps = 1
+        self.maml_optimizer_steps = 5
+        self.ensemble_size = 3
+        self.model_hiddens = (128, 128)
+        self.model_lr = 1e-3
+        self.model_train_iters = 40     # minibatch steps per refresh
+        self.model_batch_size = 256
+        self.horizon = 32               # imagined rollout length
+        self.num_imagined_envs = 32     # parallel imagined rollouts/model
+        self.rollout_fragment_length = 200  # real steps per iteration
+        self.replay_buffer_capacity = 20_000
+        self.clip_param = 0.3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+
+    @property
+    def algo_class(self):
+        return MBMPO
+
+
+class _DynamicsNet(nn.Module):
+    """MLP dynamics: (obs, act) -> (delta_obs, reward)."""
+
+    obs_dim: int
+    hiddens: tuple = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        for h in self.hiddens:
+            x = nn.relu(nn.Dense(h)(x))
+        delta = nn.Dense(self.obs_dim, name="delta")(x)
+        rew = nn.Dense(1, name="reward")(x)[..., 0]
+        return delta, rew
+
+
+class MBMPOPolicy(JaxPolicy):
+    """Policy + dynamics ensemble + the fused imagination/meta-update
+    programs."""
+
+    def __init__(self, observation_space, action_space, config):
+        super().__init__(observation_space, action_space, config)
+        cfg = config
+        self._continuous = isinstance(action_space, Box)
+        obs_dim = int(np.prod(observation_space.shape))
+        act_dim = (int(np.prod(action_space.shape))
+                   if self._continuous else int(action_space.n))
+        K = int(cfg.get("ensemble_size", 3))
+        self.dyn = _DynamicsNet(
+            obs_dim=obs_dim, hiddens=tuple(cfg.get("model_hiddens",
+                                                   (128, 128))))
+        with self._on_device():
+            self._rng, init_rng = jax.random.split(self._rng)
+            dummy_o = jnp.zeros((1, obs_dim), jnp.float32)
+            dummy_a = jnp.zeros((1, act_dim), jnp.float32)
+            # ensemble: params with a leading [K] axis via vmapped init
+            self.dyn_params = jax.vmap(
+                lambda r: self.dyn.init(r, dummy_o, dummy_a))(
+                    jax.random.split(init_rng, K))
+            self.dyn_opt = optax.adam(float(cfg.get("model_lr", 1e-3)))
+            self.dyn_opt_state = self.dyn_opt.init(self.dyn_params)
+
+        model, dist, dyn = self.model, self.dist, self.dyn
+        inner_lr = float(cfg.get("inner_lr", 0.1))
+        inner_steps = int(cfg.get("inner_adaptation_steps", 1))
+        clip = float(cfg.get("clip_param", 0.3))
+        vf_coeff = float(cfg.get("vf_loss_coeff", 0.5))
+        ent_coeff = float(cfg.get("entropy_coeff", 0.0))
+        gamma = float(cfg.get("gamma", 0.99))
+        lam = float(cfg.get("lambda_", 0.95))
+        horizon = int(cfg.get("horizon", 32))
+        opt = self.opt
+        continuous = self._continuous
+
+        def to_env_action(a):
+            """Action as fed to the dynamics net (one-hot discrete)."""
+            if continuous:
+                return a
+            return jax.nn.one_hot(a, act_dim)
+
+        # -- ensemble training -----------------------------------------
+        def model_loss(params_k, obs, act, nobs, rew):
+            delta, pred_rew = dyn.apply(params_k, obs, to_env_action(act))
+            return (jnp.mean((delta - (nobs - obs)) ** 2)
+                    + jnp.mean((pred_rew - rew) ** 2))
+
+        @jax.jit
+        def _train_models(dyn_params, opt_state, obs, act, nobs, rew,
+                          rng):
+            """One vmapped minibatch step for every ensemble member;
+            members see independent bootstrap minibatches."""
+            K_ = jax.tree_util.tree_leaves(dyn_params)[0].shape[0]
+            idx = jax.random.randint(
+                rng, (K_, int(cfg.get("model_batch_size", 256))),
+                0, obs.shape[0])
+
+            def per_member(params_k, idx_k):
+                loss, grads = jax.value_and_grad(model_loss)(
+                    params_k, obs[idx_k], act[idx_k], nobs[idx_k],
+                    rew[idx_k])
+                return loss, grads
+
+            losses, grads = jax.vmap(per_member)(dyn_params, idx)
+            updates, opt_state = self.dyn_opt.update(grads, opt_state)
+            return (optax.apply_updates(dyn_params, updates), opt_state,
+                    jnp.mean(losses))
+
+        # -- imagination -----------------------------------------------
+        def imagine(theta, dyn_params_k, obs0, rng):
+            """Roll the policy inside ONE model for `horizon` steps.
+            obs0: [B, obs_dim].  Returns per-step arrays [T, B, ...]."""
+
+            def step(carry, rng_t):
+                obs = carry
+                dist_inputs, vf = model.apply(theta, obs)
+                act = dist.sample(dist_inputs, rng_t)
+                logp = dist.logp(dist_inputs, act)
+                delta, rew = dyn.apply(dyn_params_k, obs,
+                                       to_env_action(act))
+                nobs = obs + delta
+                return nobs, (obs, act, logp, rew, vf)
+
+            _, (obs, act, logp, rew, vf) = jax.lax.scan(
+                step, obs0, jax.random.split(rng, horizon))
+            return obs, act, logp, rew, vf
+
+        def gae(rew, vf):
+            """[T, B] rewards/values -> advantages, value targets."""
+            def scan_fn(carry, x):
+                rew_t, vf_t, vf_t1 = x
+                delta = rew_t + gamma * vf_t1 - vf_t
+                adv = delta + gamma * lam * carry
+                return adv, adv
+
+            vf_next = jnp.concatenate([vf[1:], vf[-1:]], axis=0)
+            _, adv = jax.lax.scan(scan_fn, jnp.zeros_like(vf[0]),
+                                  (rew, vf, vf_next), reverse=True)
+            targets = adv + vf
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            return adv, targets
+
+        def rollout_batch(theta, dyn_params_k, obs0, rng):
+            obs, act, logp, rew, vf = imagine(theta, dyn_params_k, obs0,
+                                              rng)
+            adv, targets = gae(rew, jax.lax.stop_gradient(vf))
+            flat = lambda x: x.reshape((-1,) + x.shape[2:])
+            return {SampleBatch.OBS: flat(obs),
+                    SampleBatch.ACTIONS: flat(act),
+                    SampleBatch.ACTION_LOGP: flat(logp),
+                    SampleBatch.ADVANTAGES: flat(adv),
+                    SampleBatch.VALUE_TARGETS: flat(targets),
+                    SampleBatch.REWARDS: flat(rew)}
+
+        def pg_loss(params, batch):
+            dist_inputs, vf = model.apply(params, batch[SampleBatch.OBS])
+            logp = dist.logp(dist_inputs, batch[SampleBatch.ACTIONS])
+            pg = -jnp.mean(logp * batch[SampleBatch.ADVANTAGES])
+            verr = jnp.mean((vf - batch[SampleBatch.VALUE_TARGETS]) ** 2)
+            return pg + vf_coeff * verr
+
+        def adapt(theta, pre):
+            adapted = theta
+            for _ in range(inner_steps):
+                g = jax.grad(pg_loss)(adapted, pre)
+                adapted = jax.tree_util.tree_map(
+                    lambda p, gi: p - inner_lr * gi, adapted, g)
+            return adapted
+
+        def ppo_loss(params, batch):
+            dist_inputs, vf = model.apply(params, batch[SampleBatch.OBS])
+            logp = dist.logp(dist_inputs, batch[SampleBatch.ACTIONS])
+            ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
+            adv = batch[SampleBatch.ADVANTAGES]
+            surrogate = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            verr = jnp.mean((vf - batch[SampleBatch.VALUE_TARGETS]) ** 2)
+            entropy = jnp.mean(dist.entropy(dist_inputs))
+            return (-jnp.mean(surrogate) + vf_coeff * verr
+                    - ent_coeff * entropy)
+
+        @jax.jit
+        def _meta_step(theta, opt_state, dyn_params, obs0, rng):
+            """The full MAML step inside the model ensemble: each member
+            is a task; pre-imagine -> adapt -> post-imagine -> PPO
+            meta-loss, differentiated through the adaptation."""
+            K_ = jax.tree_util.tree_leaves(dyn_params)[0].shape[0]
+            rngs = jax.random.split(rng, 2 * K_).reshape(K_, 2, -1)
+
+            def meta_loss(theta):
+                def per_task(dyn_params_k, rng_k):
+                    pre = rollout_batch(theta, dyn_params_k, obs0,
+                                        rng_k[0])
+                    adapted = adapt(theta, pre)
+                    post = rollout_batch(adapted, dyn_params_k, obs0,
+                                         rng_k[1])
+                    return (ppo_loss(adapted, post),
+                            jnp.mean(post[SampleBatch.REWARDS]))
+
+                losses, rews = jax.vmap(per_task)(dyn_params, rngs)
+                return jnp.mean(losses), jnp.mean(rews)
+
+            (loss, imag_rew), grads = jax.value_and_grad(
+                meta_loss, has_aux=True)(theta)
+            updates, opt_state = opt.update(grads, opt_state, theta)
+            return (optax.apply_updates(theta, updates), opt_state, loss,
+                    imag_rew)
+
+        self._train_models_fn = _train_models
+        self._meta_step_fn = _meta_step
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)
+        state["dyn_params"] = to_np(self.dyn_params)
+        state["dyn_opt_state"] = to_np(self.dyn_opt_state)
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        with self._on_device():
+            to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+            if "dyn_params" in state:
+                self.dyn_params = to_dev(state["dyn_params"])
+                self.dyn_opt_state = to_dev(state["dyn_opt_state"])
+
+
+class MBMPO(Algorithm):
+    policy_class = MBMPOPolicy
+
+    def setup(self) -> None:
+        super().setup()
+        cfg = self.config
+        self.replay = ReplayBuffer(
+            int(cfg.get("replay_buffer_capacity", 20_000)),
+            seed=cfg.get("seed"))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        policy: MBMPOPolicy = self.workers.local_worker.policy
+
+        # 1. real-env data with the current (post-meta) policy
+        fragment = int(cfg.get("rollout_fragment_length", 200)) \
+            * max(1, int(cfg.get("num_envs_per_worker", 1)))
+        batch = synchronous_parallel_sample(self.workers,
+                                            max_env_steps=fragment)
+        self.replay.add(batch)
+        self._timesteps_total += len(batch)
+
+        # 2. refresh the dynamics ensemble on everything seen so far
+        data = self.replay.sample(len(self.replay))
+        obs = np.asarray(data[SampleBatch.OBS], np.float32)
+        nobs = np.asarray(data[SampleBatch.NEXT_OBS], np.float32)
+        act = np.asarray(data[SampleBatch.ACTIONS])
+        if policy._continuous:
+            act = act.astype(np.float32).reshape(len(obs), -1)
+        rew = np.asarray(data[SampleBatch.REWARDS], np.float32)
+        stats: Dict[str, Any] = {"replay_size": len(self.replay)}
+        with policy._on_device():
+            o, a, no, r = (jnp.asarray(obs), jnp.asarray(act),
+                           jnp.asarray(nobs), jnp.asarray(rew))
+            model_loss = None
+            for _ in range(int(cfg.get("model_train_iters", 40))):
+                policy._rng, rng = jax.random.split(policy._rng)
+                (policy.dyn_params, policy.dyn_opt_state,
+                 model_loss) = policy._train_models_fn(
+                    policy.dyn_params, policy.dyn_opt_state,
+                    o, a, no, r, rng)
+            stats["model_loss"] = float(model_loss)
+
+            # 3. MAML inside the ensemble: start imagined rollouts from
+            # real visited states
+            n_img = int(cfg.get("num_imagined_envs", 32))
+            start_idx = np.random.default_rng(
+                int(self.iteration)).integers(0, len(obs), size=n_img)
+            obs0 = jnp.asarray(obs[start_idx])
+            loss = imag_rew = None
+            for _ in range(int(cfg.get("maml_optimizer_steps", 5))):
+                policy._rng, rng = jax.random.split(policy._rng)
+                (policy.params, policy.opt_state, loss,
+                 imag_rew) = policy._meta_step_fn(
+                    policy.params, policy.opt_state, policy.dyn_params,
+                    obs0, rng)
+            stats["meta_loss"] = float(loss)
+            stats["imagined_reward_mean"] = float(imag_rew)
+
+        self.workers.sync_weights()
+        return stats

@@ -104,6 +104,7 @@ class Pendulum:
         config = config or {}
         self.max_speed = 8.0
         self.max_torque = 2.0
+        self.mass = 1.0
         self.dt = 0.05
         self.observation_space = Box(-np.inf, np.inf, (3,), np.float32)
         self.action_space = Box(-self.max_torque, self.max_torque, (1,),
@@ -128,8 +129,10 @@ class Pendulum:
                           -self.max_torque, self.max_torque))
         norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
         cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        # thdot += (3g/(2l) sin th + 3/(m l^2) u) dt with g=10, l=1
         thdot = np.clip(
-            thdot + (3 * 10.0 / 2 * np.sin(th) + 3.0 * u) * self.dt,
+            thdot + (3 * 10.0 / 2 * np.sin(th)
+                     + 3.0 / self.mass * u) * self.dt,
             -self.max_speed, self.max_speed)
         th = th + thdot * self.dt
         self._state = (th, thdot)
@@ -331,6 +334,95 @@ class PixelCatch:
         return self._obs(), rew, False, False, {}
 
 
+class TaskSettableEnv:
+    """Meta-RL task interface (reference
+    ``rllib/env/apis/task_settable_env.py``): an env family indexed by a
+    task parameter; MAML/MBMPO sample a task batch per meta-iteration."""
+
+    def sample_tasks(self, n_tasks: int):
+        raise NotImplementedError
+
+    def set_task(self, task) -> None:
+        raise NotImplementedError
+
+    def get_task(self):
+        raise NotImplementedError
+
+
+class CartPoleMass(CartPole, TaskSettableEnv):
+    """CartPole with the cart mass as the task (reference
+    ``rllib/examples/env/cartpole_mass.py``) — the standard MAML
+    adaptation benchmark: dynamics change across tasks, the reward
+    structure does not."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        super().__init__(config)
+        config = config or {}
+        self._task_rng = np.random.default_rng(
+            int(config.get("task_seed", 0) or 0))
+        self._task_low = float(config.get("mass_low", 0.5))
+        self._task_high = float(config.get("mass_high", 2.0))
+
+    def sample_tasks(self, n_tasks: int):
+        return list(self._task_rng.uniform(
+            self._task_low, self._task_high, size=n_tasks))
+
+    def set_task(self, task) -> None:
+        self.masscart = float(task)
+
+    def get_task(self):
+        return self.masscart
+
+
+class PendulumMass(Pendulum, TaskSettableEnv):
+    """Pendulum with the pole mass as the task (reference
+    ``rllib/examples/env/pendulum_mass.py``)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        super().__init__(config)
+        config = config or {}
+        self._task_rng = np.random.default_rng(
+            int(config.get("task_seed", 0) or 0))
+        self._task_low = float(config.get("mass_low", 0.5))
+        self._task_high = float(config.get("mass_high", 1.5))
+
+    def sample_tasks(self, n_tasks: int):
+        return list(self._task_rng.uniform(
+            self._task_low, self._task_high, size=n_tasks))
+
+    def set_task(self, task) -> None:
+        self.mass = float(task)
+
+    def get_task(self):
+        return self.mass
+
+
+class ContextBandit:
+    """Contextual bandit: reward 1 when the chosen arm matches the
+    argmax context feature; every step is its own (truncated) episode.
+    The standard smoke env for the bandit algorithms (reference
+    ``rllib/examples/env/bandit_envs_discrete.py``)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.k = int(config.get("arms", 3))
+        self.observation_space = Box(0.0, 1.0, (self.k,), np.float32)
+        self.action_space = Discrete(self.k)
+        self._rng = np.random.default_rng(int(config.get("seed", 0) or 0))
+        self._ctx: Optional[np.ndarray] = None
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._ctx = self._rng.random(self.k).astype(np.float32)
+        return self._ctx, {}
+
+    def step(self, action: int):
+        rew = 1.0 if int(action) == int(self._ctx.argmax()) else 0.0
+        self._ctx = self._rng.random(self.k).astype(np.float32)
+        return self._ctx, rew, False, True, {}
+
+
 _ENV_REGISTRY: Dict[str, Any] = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
@@ -338,6 +430,9 @@ _ENV_REGISTRY: Dict[str, Any] = {
     "MultiAgentCartPole": MultiAgentCartPole,
     "TwoStepGame": TwoStepGame,
     "PixelCatch": PixelCatch,
+    "ContextBandit": ContextBandit,
+    "CartPoleMass": CartPoleMass,
+    "PendulumMass": PendulumMass,
 }
 
 
@@ -346,6 +441,16 @@ def _register_extra_envs():
     try:
         from ray_tpu.rllib.algorithms.maddpg import SimpleTargetChase
         _ENV_REGISTRY.setdefault("SimpleTargetChase", SimpleTargetChase)
+    except ImportError:
+        pass
+    try:
+        from ray_tpu.rllib.algorithms.alpha_star import RepeatedRPS
+        _ENV_REGISTRY.setdefault("RepeatedRPS", RepeatedRPS)
+    except ImportError:
+        pass
+    try:
+        from ray_tpu.rllib.algorithms.slateq import SimpleRecEnv
+        _ENV_REGISTRY.setdefault("SimpleRecEnv", SimpleRecEnv)
     except ImportError:
         pass
 

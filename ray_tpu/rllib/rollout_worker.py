@@ -27,6 +27,8 @@ class RolloutWorker:
                  config: Dict[str, Any], worker_index: int = 0):
         self.config = dict(config)
         self.worker_index = worker_index
+        # policies read this for per-worker exploration ladders (Ape-X)
+        self.config["worker_index"] = worker_index
         seed = config.get("seed")
         if seed is not None:
             seed = int(seed) + worker_index
