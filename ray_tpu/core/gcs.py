@@ -73,6 +73,7 @@ class ActorInfo:
     pg_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     env_hash: Optional[str] = None
+    env_spawn: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -637,6 +638,7 @@ class GcsServer:
             if data.get("placement_group_id") else None,
             bundle_index=data.get("bundle_index", -1),
             env_hash=data.get("env_hash"),
+            env_spawn=data.get("env_spawn"),
         )
         self.actors[actor_id] = info
         self._schedule_persist()
@@ -716,7 +718,8 @@ class GcsServer:
                          "placement_group_id":
                              info.pg_id.binary() if info.pg_id else None,
                          "bundle_index": info.bundle_index,
-                         "env_hash": info.env_hash},
+                         "env_hash": info.env_hash,
+                         "env_spawn": info.env_spawn},
                         timeout=60.0,
                     )
                 except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError) as e:

@@ -175,6 +175,7 @@ class PendingLease:
     resources: Dict[str, float]
     bundle: Optional[Tuple[bytes, int]]
     env_hash: Optional[str] = None
+    env_spawn: Optional[Dict[str, Any]] = None
     retriable: bool = True
     enqueued_at: float = field(default_factory=time.monotonic)
 
@@ -223,12 +224,19 @@ class Raylet:
         self._pull_locks: Dict[ObjectID, asyncio.Lock] = {}
 
         # worker pool: spawned-but-unregistered procs as
-        # (proc, tpu_capable, spawned_with_needs_tpu)
-        self._spawned_procs: List[Tuple[Any, bool, bool]] = []
+        # (proc, tpu_capable, spawned_with_needs_tpu, spawn_token)
+        self._spawned_procs: List[Tuple[Any, bool, bool, Any]] = []
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: List[WorkerHandle] = []
         self._starting = 0
         self._starting_tpu = 0  # subset of _starting spawned with needs_tpu
+        # isolated-runtime-env worker spawns (venv/conda/container):
+        # env_hash -> in-flight count, spawn token -> env_hash (tokens,
+        # not pids: container workers see a private pid namespace),
+        # env_hash -> build error
+        self._starting_env: Dict[str, int] = {}
+        self._env_spawn_hash: Dict[str, str] = {}
+        self._env_broken: Dict[str, str] = {}
         self._pending_leases: List[PendingLease] = []
         self._register_waiters: List[asyncio.Future] = []
         max_workers = config.max_workers_per_node
@@ -606,10 +614,26 @@ class Raylet:
                     self._on_worker_dead(w, f"exit code {w.proc.returncode}")
             # workers that died before registering (startup crash)
             for entry in list(self._spawned_procs):
-                proc = entry[0]
+                proc, token = entry[0], entry[3]
                 if proc.poll() is not None:
                     self._spawned_procs.remove(entry)
                     self._dec_starting(entry[2])
+                    env_hash = self._env_spawn_hash.get(token) \
+                        if token else None
+                    self._dec_starting_env(token)
+                    if env_hash is not None:
+                        # an isolated-env worker that dies at boot will
+                        # keep dying — break the env instead of hot-
+                        # looping spawns; leases fail with this message
+                        msg = (f"isolated runtime env worker exited "
+                               f"{proc.returncode} at startup (see "
+                               f"worker logs in {self.session_dir}"
+                               f"/logs)")
+                        self._env_broken[env_hash] = msg
+                        asyncio.get_running_loop().call_later(
+                            30.0,
+                            lambda h=env_hash:
+                            self._env_broken.pop(h, None))
                     logger.warning("worker pid %d died before registering "
                                    "(exit %d)", proc.pid, proc.returncode)
                     self._maybe_schedule()
@@ -701,7 +725,104 @@ class Raylet:
         self._log_pids[log_base + ".out"] = proc.pid
         self._log_pids[log_base + ".err"] = proc.pid
         # handle registered later in handle_register_worker; remember proc
-        self._spawned_procs.append((proc, tpu_capable, needs_tpu))
+        self._spawned_procs.append((proc, tpu_capable, needs_tpu, None))
+
+    def _start_env_worker(self, lease: "PendingLease") -> None:
+        """Spawn a worker under an isolated runtime env (venv / conda /
+        container / py_executable).  The env build (pip install, conda
+        create, image pull) can take seconds-to-minutes, so it runs in
+        the default executor; the io loop only does bookkeeping.
+        Isolated workers register pre-bound to their env_hash and never
+        serve other envs."""
+        env_hash, env_spawn = lease.env_hash, dict(lease.env_spawn)
+        # same cap formula as _start_worker (idle workers are already in
+        # self.workers — counting them twice would stall at half cap)
+        pool_size = self._starting + sum(
+            1 for w in self.workers.values() if not w.is_actor)
+        if pool_size >= self._max_workers:
+            # make room, else the lease waits for pool churn
+            if not self._cull_idle_spare(lambda w: w.env_hash is None):
+                return
+        token = f"env-{env_hash}-{time.monotonic_ns()}"
+        self._starting += 1
+        self._starting_env[env_hash] = \
+            self._starting_env.get(env_hash, 0) + 1
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER"] = "1"
+        env["RAY_TPU_WORKER_ENV_HASH"] = env_hash
+        env["RAY_TPU_WORKER_SPAWN_TOKEN"] = token
+        # isolated interpreters may not have ray_tpu on their default
+        # path (venv --system-site-packages does; conda/container need
+        # the package root)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        env.pop("RAY_TPU_STASH_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("RAY_TPU_STASH_JAX_PLATFORMS", None)
+        log_base = os.path.join(
+            self.session_dir, "logs",
+            f"worker-{os.getpid()}-{self._starting}-{time.monotonic_ns()}")
+        os.makedirs(os.path.dirname(log_base), exist_ok=True)
+        worker_args = [
+            "--raylet",
+            f"{self.server.address[0]}:{self.server.address[1]}",
+            "--gcs", f"{self.gcs_address[0]}:{self.gcs_address[1]}",
+            "--node-id", self.node_id.hex(),
+            "--store-path", self.store.path,
+            "--store-capacity", str(self.store_capacity),
+            "--session-dir", self.session_dir,
+        ]
+        if lease.job_id_bin is not None:
+            worker_args += ["--job-id", lease.job_id_bin.hex()]
+        from ray_tpu.core.node import safe_die_with_parent
+
+        if safe_die_with_parent():
+            env["RAY_TPU_PDEATHSIG"] = str(os.getpid())
+        loop = asyncio.get_running_loop()
+
+        def build_and_spawn():
+            from ray_tpu import runtime_env as renv
+
+            cmd = renv.resolve_worker_command(
+                env_spawn,
+                [sys.executable, "-m", "ray_tpu.core.worker_main",
+                 *worker_args],
+                mounts=[self.session_dir],
+                passthrough_env={
+                    "RAY_TPU_WORKER": "1",
+                    "RAY_TPU_WORKER_ENV_HASH": env_hash,
+                    "RAY_TPU_WORKER_SPAWN_TOKEN": token,
+                })
+            out = open(log_base + ".out", "ab")
+            err = open(log_base + ".err", "ab")
+            return subprocess.Popen(cmd, env=env, stdout=out,
+                                    stderr=err, close_fds=False)
+
+        fut = loop.run_in_executor(None, build_and_spawn)
+
+        def _done(f):
+            try:
+                proc = f.result()
+            except Exception as e:  # noqa: BLE001 — report to leases
+                logger.exception("isolated runtime env %s build/spawn "
+                                 "failed", env_hash)
+                msg = f"runtime env build failed: {e}"
+                self._env_broken[env_hash] = msg
+                # transient causes (network, registry) deserve a retry
+                loop.call_later(
+                    30.0, lambda: self._env_broken.pop(env_hash, None))
+                self._starting -= 1
+                self._starting_env[env_hash] -= 1
+                self._maybe_schedule()  # fails the waiting leases
+                return
+            self._log_pids[log_base + ".out"] = proc.pid
+            self._log_pids[log_base + ".err"] = proc.pid
+            self._env_spawn_hash[token] = env_hash
+            self._spawned_procs.append((proc, False, False, token))
+
+        fut.add_done_callback(_done)
 
     def _spawn_via_zygote(self, worker_args, log_base: str,
                           tpu_capable: bool, env: Dict[str, str],
@@ -743,7 +864,7 @@ class Raylet:
                     self._dec_starting(needs_tpu)
                     self._maybe_schedule()  # freed pool capacity
                     return
-            self._spawned_procs.append((handle, tpu_capable, needs_tpu))
+            self._spawned_procs.append((handle, tpu_capable, needs_tpu, None))
 
         fut.add_done_callback(_done)
 
@@ -760,15 +881,25 @@ class Raylet:
             conn=conn,
             task_address=tuple(data["task_address"]),
         )
-        # adopt the spawned process handle if this pid is one of ours
+        # adopt the spawned process handle: spawn token first (container
+        # workers register with a namespaced pid), host pid otherwise
+        reg_token = data.get("spawn_token")
         for entry in list(self._spawned_procs):
-            proc, tpu_capable, was_tpu_spawn = entry
-            if proc.pid == worker.pid:
+            proc, tpu_capable, was_tpu_spawn, token = entry
+            if (reg_token is not None and token == reg_token) \
+                    or proc.pid == worker.pid:
                 worker.proc = proc
                 worker.tpu_capable = tpu_capable
                 self._spawned_procs.remove(entry)
                 self._dec_starting(was_tpu_spawn)
                 break
+        # isolated-env workers are born bound to their env
+        env_hash = data.get("env_hash") \
+            or (self._env_spawn_hash.get(reg_token) if reg_token else None)
+        if env_hash is not None:
+            worker.env_hash = env_hash
+            worker.tpu_capable = False
+        self._dec_starting_env(reg_token)
         conn.context["worker_id"] = worker.worker_id
         self.workers[worker.worker_id] = worker
         self._idle.append(worker)
@@ -880,6 +1011,7 @@ class Raylet:
             request=data, future=fut, job_id_bin=job_id_bin,
             resources=resources, bundle=bundle,
             env_hash=data.get("env_hash"),
+            env_spawn=data.get("env_spawn"),
             retriable=bool(data.get("retriable", True))))
         self._maybe_schedule()
         return await fut
@@ -1015,9 +1147,33 @@ class Raylet:
                 remaining.append(lease)
                 continue
             needs_tpu = lease.resources.get("TPU", 0) > 0
+            # isolated envs live in the worker's interpreter itself, so
+            # only a worker born under that env can serve the lease —
+            # pristine pool workers are no substitute
             worker = self._pop_idle(lease.job_id_bin, needs_tpu,
-                                    lease.env_hash)
+                                    lease.env_hash,
+                                    exact_env_only=lease.env_spawn
+                                    is not None)
             if worker is None:
+                if lease.env_spawn is not None \
+                        and lease.env_hash is not None:
+                    # isolated env: the worker must be BORN under the
+                    # env's interpreter/container — spawn dedicated
+                    if needs_tpu:
+                        lease.future.set_result({"error":
+                            "isolated runtime envs (venv/conda/"
+                            "container/py_executable) cannot lease "
+                            "TPUs; use the in-process pip env for "
+                            "TPU tasks"})
+                        continue
+                    err = self._env_broken.get(lease.env_hash)
+                    if err is not None:
+                        lease.future.set_result({"error": err})
+                        continue
+                    remaining.append(lease)
+                    if self._starting_env.get(lease.env_hash, 0) == 0:
+                        self._start_env_worker(lease)
+                    continue
                 remaining.append(lease)
                 want_workers.append((lease.job_id_bin, needs_tpu))
                 continue
@@ -1082,6 +1238,13 @@ class Raylet:
                 return True
         return False
 
+    def _dec_starting_env(self, token: Any) -> None:
+        if token is None:
+            return
+        env_hash = self._env_spawn_hash.pop(token, None)
+        if env_hash is not None and self._starting_env.get(env_hash):
+            self._starting_env[env_hash] -= 1
+
     def _dec_starting(self, was_tpu_spawn: bool) -> None:
         self._starting -= 1
         if was_tpu_spawn and self._starting_tpu > 0:
@@ -1089,7 +1252,8 @@ class Raylet:
 
     def _pop_idle(self, job_id_bin: Optional[bytes],
                   needs_tpu: bool = False,
-                  env_hash: Optional[str] = None
+                  env_hash: Optional[str] = None,
+                  exact_env_only: bool = False
                   ) -> Optional[WorkerHandle]:
         # job-dedicated workers: a worker that has loaded job code serves
         # only that job (parity: WorkerPool per-job isolation); likewise a
@@ -1108,6 +1272,9 @@ class Raylet:
             for i, w in enumerate(self._idle):
                 if eligible(w, env_hash):
                     return self._idle.pop(i)
+        if exact_env_only:
+            # isolated env: a pristine worker can't be converted post-hoc
+            return None
         for i, w in enumerate(self._idle):
             if eligible(w, None):
                 return self._idle.pop(i)
@@ -1174,6 +1341,7 @@ class Raylet:
             "bundle_index": data.get("bundle_index", -1),
             "strategy": "DEFAULT",
             "env_hash": data.get("env_hash"),
+            "env_spawn": data.get("env_spawn"),
         })
         if not reply.get("granted"):
             return {"granted": False, "reason": str(reply)}

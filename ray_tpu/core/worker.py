@@ -100,6 +100,16 @@ def _renv_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
     return env_hash(runtime_env)
 
 
+def _renv_spawn(runtime_env: Optional[Dict[str, Any]]
+                ) -> Optional[Dict[str, Any]]:
+    """Spawn-time requirements (isolated interpreter / container) the
+    raylet needs alongside the env hash; None for in-process envs."""
+    if not runtime_env:
+        return None
+    from ray_tpu.runtime_env import spawn_spec
+    return spawn_spec(runtime_env)
+
+
 _tracing_fns: Optional[tuple] = None
 
 
@@ -336,6 +346,12 @@ class CoreWorker:
             "job_id": self.job_id.binary() if self.job_id else None,
             "task_address": self.task_address,
             "is_driver": self.mode == "driver",
+            # isolated-env workers are born bound to their env (the
+            # interpreter itself is the env); pool workers send None.
+            # The spawn token lets the raylet adopt container workers
+            # whose in-namespace pid differs from the host Popen pid.
+            "env_hash": os.environ.get("RAY_TPU_WORKER_ENV_HASH"),
+            "spawn_token": os.environ.get("RAY_TPU_WORKER_SPAWN_TOKEN"),
         })
         set_config(Config.from_json(reply["config"]))
         self.config = get_config()
@@ -1275,6 +1291,7 @@ class CoreWorker:
                 "bundle_index": strat.bundle_index,
                 "backlog": len(state.backlog),
                 "env_hash": spec.runtime_env_hash,
+                "env_spawn": _renv_spawn(spec.runtime_env),
                 "retriable": spec.max_retries > 0,
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
@@ -1534,6 +1551,7 @@ class CoreWorker:
                 if strat.placement_group_id else None,
             "bundle_index": strat.bundle_index,
             "env_hash": spec.runtime_env_hash,
+            "env_spawn": _renv_spawn(spec.runtime_env),
         }
         # pin creation args for the actor's lifetime (restarts re-run the
         # creation task and need them)

@@ -17,8 +17,19 @@ interpreter: same isolation contract, no interpreter respawn.  By
 default installs consult the configured index; air-gapped deployments
 pass ``pip_install_options`` (e.g. ``--no-index --find-links …``).
 
-``conda``/``container`` remain unsupported (no conda binary / container
-runtime in this deployment) and raise immediately.
+Isolated-interpreter envs (reference ``runtime_env/{conda,container}.py``
+and the ``py_executable`` field): ``pip`` with ``isolation: "venv"``
+builds a content-addressed virtualenv and the raylet launches the
+dedicated worker from the venv's interpreter — full interpreter
+isolation, so package versions that conflict with the base image work;
+``conda`` activates/creates a conda env (gated on a conda binary —
+``RAY_TPU_CONDA_BIN`` overrides discovery); ``container`` wraps the
+worker launch in a container runtime (podman/docker, host network + IPC
+so the worker reaches the raylet and the shm object store;
+``RAY_TPU_CONTAINER_BIN`` overrides discovery); ``py_executable`` uses
+an explicit interpreter as-is.  The raylet resolves these at spawn time
+(``spawn_spec`` travels with the lease request) and builds envs off the
+io loop.
 """
 
 from __future__ import annotations
@@ -36,19 +47,30 @@ from typing import Any, Dict, List, Optional
 _CACHE_ROOT = os.path.join(os.environ.get("TMPDIR", "/tmp"),
                            "ray_tpu_runtime_env_cache")
 
-SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip"}
-UNSUPPORTED = {"conda", "container"}
+SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+             "container", "py_executable"}
+
+
+def conda_binary() -> Optional[str]:
+    """The conda executable, or None when this deployment has none."""
+    override = os.environ.get("RAY_TPU_CONDA_BIN")
+    if override:
+        return override
+    return shutil.which("conda") or shutil.which("mamba") \
+        or shutil.which("micromamba")
+
+
+def container_binary() -> Optional[str]:
+    """The container runtime, or None when this deployment has none."""
+    override = os.environ.get("RAY_TPU_CONTAINER_BIN")
+    if override:
+        return override
+    return shutil.which("podman") or shutil.which("docker")
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if not runtime_env:
         return {}
-    bad = set(runtime_env) & UNSUPPORTED
-    if bad:
-        raise ValueError(
-            f"runtime_env keys {sorted(bad)} are unsupported here: no "
-            f"conda binary / container runtime in this deployment (bake "
-            f"those dependencies into the image)")
     unknown = set(runtime_env) - SUPPORTED
     if unknown:
         raise ValueError(f"unknown runtime_env keys {sorted(unknown)} "
@@ -56,21 +78,62 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     out = dict(runtime_env)
     if "pip" in out:
         out["pip"] = _normalize_pip(out["pip"])
+    if "conda" in out:
+        # only shape-check here: the conda binary is needed on the
+        # WORKER host at spawn time, which may not be this driver host
+        if not isinstance(out["conda"], (str, dict)):
+            raise ValueError("runtime_env['conda'] must be an env name "
+                             "or an environment.yml-style dict")
+    if "container" in out:
+        spec = out["container"]
+        if not isinstance(spec, dict) or not spec.get("image"):
+            raise ValueError("runtime_env['container'] must be a dict "
+                             "with an 'image'")
+    if "py_executable" in out and not isinstance(out["py_executable"],
+                                                 str):
+        raise ValueError("runtime_env['py_executable'] must be a path")
     return out
 
 
 def _normalize_pip(spec: Any) -> Dict[str, Any]:
     """Accept ``["six"]`` or ``{"packages": [...],
-    "pip_install_options": [...]}`` (reference pip field shapes)."""
+    "pip_install_options": [...], "isolation": "venv"|"path"}``
+    (reference pip field shapes; ``isolation`` picks sys.path injection
+    — the default, no interpreter respawn — or a dedicated venv
+    interpreter)."""
     if isinstance(spec, (list, tuple)):
         return {"packages": [str(p) for p in spec],
-                "pip_install_options": []}
+                "pip_install_options": [], "isolation": "path"}
     if isinstance(spec, dict):
+        isolation = str(spec.get("isolation", "path"))
+        if isolation not in ("path", "venv"):
+            raise ValueError("pip isolation must be 'path' or 'venv'")
         return {"packages": [str(p) for p in spec.get("packages", [])],
                 "pip_install_options": [
-                    str(o) for o in spec.get("pip_install_options", [])]}
+                    str(o) for o in spec.get("pip_install_options", [])],
+                "isolation": isolation}
     raise ValueError(f"runtime_env['pip'] must be a list or dict, got "
                      f"{type(spec).__name__}")
+
+
+def spawn_spec(runtime_env: Optional[Dict[str, Any]]
+               ) -> Optional[Dict[str, Any]]:
+    """The part of an env the *raylet* must resolve before spawning the
+    dedicated worker (an interpreter/command substitution).  None means
+    the env applies in-process on any pool worker."""
+    if not runtime_env:
+        return None
+    out: Dict[str, Any] = {}
+    if runtime_env.get("py_executable"):
+        out["py_executable"] = str(runtime_env["py_executable"])
+    if runtime_env.get("conda"):
+        out["conda"] = runtime_env["conda"]
+    if runtime_env.get("container"):
+        out["container"] = runtime_env["container"]
+    pip = runtime_env.get("pip")
+    if pip and pip.get("isolation") == "venv":
+        out["pip_venv"] = pip
+    return out or None
 
 
 def env_hash(runtime_env: Dict[str, Any]) -> str:
@@ -218,6 +281,170 @@ def _ensure_pip_env(pip_spec: Dict[str, Any]) -> str:
     return dest
 
 
+def _build_locked(root: str, digest: str, build_fn) -> str:
+    """Content-addressed build under an exclusive flock with atomic
+    rename into place (same discipline as :func:`_ensure_pip_env`)."""
+    import fcntl
+
+    dest = os.path.join(root, digest)
+    if os.path.isdir(dest):
+        return dest
+    os.makedirs(root, exist_ok=True)
+    lock_path = os.path.join(root, f".{digest}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.isdir(dest):
+            return dest
+        tmp = tempfile.mkdtemp(prefix=f".{digest}-", dir=root)
+        try:
+            build_fn(tmp)
+            os.rename(tmp, dest)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def _ensure_venv(pip_spec: Dict[str, Any]) -> str:
+    """Build (once, content-addressed) a virtualenv with the requested
+    packages; returns its python executable (reference ``pip.py``'s
+    ``_PathHelper.get_virtualenv_python``).  ``--system-site-packages``
+    keeps the baked-in deps (jax et al) visible; installed packages
+    shadow them, which is exactly the version-conflict isolation the
+    sys.path mode cannot give."""
+    import subprocess
+
+    packages = pip_spec.get("packages", [])
+    opts = pip_spec.get("pip_install_options", [])
+    digest = hashlib.sha256(
+        json.dumps(["venv", packages, opts, sys.version_info[:2],
+                    sys.executable],
+                   sort_keys=True).encode()).hexdigest()[:16]
+
+    def build(tmp: str) -> None:
+        import glob
+        import venv
+
+        venv.create(tmp, with_pip=True, system_site_packages=True)
+        # when THIS interpreter is itself a venv (common container
+        # layout), system-site-packages exposes the real system python's
+        # site dir, not ours — link our site dirs in via a .pth so the
+        # baked-in deps stay importable (venv installs still shadow
+        # them: the venv's own site dir sorts first)
+        parent_sites = [p for p in sys.path
+                        if p.rstrip("/").endswith(("site-packages",
+                                                   "dist-packages"))]
+        vsites = glob.glob(os.path.join(tmp, "lib", "python*",
+                                        "site-packages"))
+        if parent_sites and vsites:
+            with open(os.path.join(vsites[0], "_parent_site.pth"),
+                      "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+        py = os.path.join(tmp, "bin", "python")
+        if packages:
+            proc = subprocess.run(
+                [py, "-m", "pip", "install", "--quiet", *opts,
+                 *packages],
+                capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"venv runtime env build failed:\n"
+                    f"{proc.stderr[-4000:]}")
+
+    dest = _build_locked(os.path.join(_CACHE_ROOT, "venv"), digest, build)
+    return os.path.join(dest, "bin", "python")
+
+
+def _ensure_conda_env(spec: Any) -> str:
+    """Resolve a conda env to its python executable; a dict spec is
+    created once (content-addressed prefix), a string names an existing
+    env (reference ``conda.py`` ``get_conda_env_executable``)."""
+    import subprocess
+
+    conda = conda_binary()
+    if conda is None:
+        raise RuntimeError(
+            "runtime_env['conda'] needs a conda binary on the worker "
+            "host (set RAY_TPU_CONDA_BIN or install conda)")
+    if isinstance(spec, str):
+        # named env: ask conda where it lives
+        proc = subprocess.run([conda, "run", "-n", spec, "python", "-c",
+                               "import sys; print(sys.executable)"],
+                              capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(f"conda env {spec!r} not usable:\n"
+                               f"{proc.stderr[-2000:]}")
+        return proc.stdout.strip().splitlines()[-1]
+    digest = hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+    def build(tmp: str) -> None:
+        env_yml = os.path.join(tmp, "environment.yml")
+        os.makedirs(tmp, exist_ok=True)
+        with open(env_yml, "w") as f:
+            json.dump(spec, f)  # yaml parsers accept the JSON subset
+        prefix = os.path.join(tmp, "env")
+        proc = subprocess.run(
+            [conda, "env", "create", "--prefix", prefix, "--file",
+             env_yml, "--quiet"],
+            capture_output=True, text=True, timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(f"conda env create failed:\n"
+                               f"{proc.stderr[-4000:]}")
+
+    dest = _build_locked(os.path.join(_CACHE_ROOT, "conda"), digest,
+                         build)
+    return os.path.join(dest, "env", "bin", "python")
+
+
+def resolve_worker_command(env_spawn: Dict[str, Any],
+                           base_cmd: List[str],
+                           mounts: Optional[List[str]] = None,
+                           passthrough_env: Optional[Dict[str, str]]
+                           = None) -> List[str]:
+    """Raylet side: rewrite the worker launch argv for an isolated env.
+    ``base_cmd`` is ``[python, -m, ray_tpu.core.worker_main, ...]``;
+    the interpreter is substituted (venv/conda/py_executable) or the
+    whole command is wrapped in a container runtime.  May block on an
+    env build — call off the io loop."""
+    cmd = list(base_cmd)
+    if env_spawn.get("py_executable"):
+        cmd[0] = env_spawn["py_executable"]
+    elif env_spawn.get("pip_venv"):
+        cmd[0] = _ensure_venv(env_spawn["pip_venv"])
+    elif env_spawn.get("conda"):
+        cmd[0] = _ensure_conda_env(env_spawn["conda"])
+    container = env_spawn.get("container")
+    if container:
+        runtime = container_binary()
+        if runtime is None:
+            raise RuntimeError(
+                "runtime_env['container'] needs a container runtime on "
+                "the worker host (set RAY_TPU_CONTAINER_BIN)")
+        # host network+IPC: the worker must reach the raylet's TCP port
+        # and map the /dev/shm object store; the session dir carries
+        # logs and sockets.  The image must have ray_tpu importable
+        # (reference ``container.py`` has the same contract) — the
+        # package dir is bind-mounted for same-host images.
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        run = [runtime, "run", "--rm", "--network=host", "--ipc=host",
+               "-v", "/dev/shm:/dev/shm", "-v", f"{pkg_root}:{pkg_root}",
+               "--env", f"PYTHONPATH={pkg_root}"]
+        # the worker's identity env (env hash, spawn token) must cross
+        # the container boundary — Popen's env stops at the client
+        for k, v in (passthrough_env or {}).items():
+            run += ["--env", f"{k}={v}"]
+        for m in (mounts or []):
+            run += ["-v", f"{m}:{m}"]
+        for opt in container.get("run_options", []):
+            run.append(str(opt))
+        image = container["image"]
+        inner_py = container.get("py_executable", "python3")
+        run += [image, inner_py, *cmd[1:]]
+        return run
+    return cmd
+
+
 class RuntimeEnvManager:
     """Worker side: apply envs once per (env, process).
 
@@ -237,10 +464,15 @@ class RuntimeEnvManager:
             return
         for k, v in runtime_env.get("env_vars", {}).items():
             os.environ[str(k)] = str(v)
-        if runtime_env.get("pip"):
-            pip_dir = _ensure_pip_env(_normalize_pip(runtime_env["pip"]))
-            if pip_dir not in sys.path:
-                sys.path.insert(0, pip_dir)
+        pip = runtime_env.get("pip")
+        if pip:
+            pip = _normalize_pip(pip)
+            # venv isolation applied at spawn (this worker already runs
+            # under the venv interpreter); path mode injects here
+            if pip.get("isolation") != "venv":
+                pip_dir = _ensure_pip_env(pip)
+                if pip_dir not in sys.path:
+                    sys.path.insert(0, pip_dir)
         for uri in runtime_env.get("py_modules", []):
             root = _extract(uri, self._kv_get)
             if root not in sys.path:

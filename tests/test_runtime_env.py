@@ -69,13 +69,30 @@ def test_actor_runtime_env():
     assert ray_tpu.get(a.read.remote(), timeout=60) == "actor-env"
 
 
-def test_unsupported_keys_rejected():
-    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["requests"]}})
+def test_unknown_keys_rejected():
+    @ray_tpu.remote(runtime_env={"bogus_key": 1})
     def f():
         return 1
 
-    with pytest.raises(ValueError, match="unsupported"):
+    with pytest.raises(ValueError, match="unknown"):
         f.remote()
+
+
+def test_conda_validation_is_shape_only(monkeypatch):
+    """validate() shape-checks conda but defers binary discovery to the
+    worker host at spawn time (the driver may not have conda while the
+    raylet hosts do); resolution without a binary raises there."""
+    monkeypatch.delenv("RAY_TPU_CONDA_BIN", raising=False)
+    monkeypatch.setattr("shutil.which", lambda _name: None)
+    from ray_tpu import runtime_env as renv
+
+    spec = renv.validate({"conda": {"dependencies": ["requests"]}})
+    assert spec["conda"] == {"dependencies": ["requests"]}
+    assert renv.validate({"conda": "someenv"})["conda"] == "someenv"
+    with pytest.raises(ValueError, match="conda"):
+        renv.validate({"conda": 42})
+    with pytest.raises(RuntimeError, match="conda binary"):
+        renv._ensure_conda_env({"dependencies": ["requests"]})
 
 
 def test_pip_runtime_env(tmp_path):
@@ -106,3 +123,110 @@ def test_pip_runtime_env(tmp_path):
         return rtpu_pip_probe.VALUE
 
     assert ray_tpu.get(probe.remote(), timeout=180) == 1234
+
+
+def test_venv_isolation(tmp_path):
+    """pip isolation='venv' runs the task under a dedicated venv
+    interpreter (reference runtime_env/pip.py virtualenv semantics):
+    the worker's prefix is the content-addressed cache venv, and the
+    baked-in deps stay importable through the parent-site .pth."""
+    env = {"pip": {"packages": [], "isolation": "venv"}}
+
+    @ray_tpu.remote(num_cpus=0, runtime_env=env)
+    def probe():
+        import sys
+
+        import cloudpickle  # noqa: F401 — parent site must be visible
+        return sys.prefix
+
+    prefix = ray_tpu.get(probe.remote(), timeout=180)
+    assert "ray_tpu_runtime_env_cache" in prefix
+
+
+def test_py_executable_dedicated_worker():
+    """runtime_env['py_executable'] spawns a dedicated worker under that
+    interpreter, and plain tasks never land on it."""
+    import sys
+
+    env = {"py_executable": sys.executable,
+           "env_vars": {"ISO_MARK": "yes"}}
+
+    @ray_tpu.remote(num_cpus=0, runtime_env=env)
+    def iso():
+        import os
+        return (os.environ.get("ISO_MARK"),
+                os.environ.get("RAY_TPU_WORKER_ENV_HASH"))
+
+    @ray_tpu.remote(num_cpus=0)
+    def plain():
+        import os
+        return os.environ.get("RAY_TPU_WORKER_ENV_HASH")
+
+    mark, env_hash = ray_tpu.get(iso.remote(), timeout=60)
+    assert mark == "yes" and env_hash
+    assert ray_tpu.get(plain.remote(), timeout=30) is None
+
+
+def test_conda_named_env_fake_binary(tmp_path, monkeypatch):
+    """conda env-by-name resolution through the binary protocol
+    (RAY_TPU_CONDA_BIN override lets deployments without conda test the
+    path; the fake resolves every env to the current interpreter)."""
+    import sys
+
+    fake = tmp_path / "conda"
+    fake.write_text(
+        "#!/bin/sh\n"
+        '# fake `conda run -n NAME python -c CODE`\n'
+        'shift 3\nexec "$@"\n')
+    fake.chmod(0o755)
+    monkeypatch.setenv("RAY_TPU_CONDA_BIN", str(fake))
+
+    from ray_tpu import runtime_env as renv
+
+    py = renv._ensure_conda_env("myenv")
+    assert py == sys.executable
+
+
+def test_container_command_wrapping(tmp_path, monkeypatch):
+    """The container launch argv carries host networking, shm + session
+    mounts, and the image's interpreter (reference runtime_env/
+    container.py contract)."""
+    fake = tmp_path / "podman"
+    fake.write_text("#!/bin/sh\nexec true\n")
+    fake.chmod(0o755)
+    monkeypatch.setenv("RAY_TPU_CONTAINER_BIN", str(fake))
+
+    from ray_tpu import runtime_env as renv
+
+    spec = renv.validate({"container": {
+        "image": "myimage:latest", "run_options": ["--cpus=2"]}})
+    cmd = renv.resolve_worker_command(
+        renv.spawn_spec(spec),
+        ["python", "-m", "ray_tpu.core.worker_main", "--raylet", "x"],
+        mounts=["/tmp/sess"],
+        passthrough_env={"RAY_TPU_WORKER_ENV_HASH": "abc123",
+                         "RAY_TPU_WORKER_SPAWN_TOKEN": "tok-1"})
+    assert cmd[0] == str(fake)
+    assert "--network=host" in cmd and "--ipc=host" in cmd
+    assert "-v" in cmd and "/dev/shm:/dev/shm" in cmd
+    assert "/tmp/sess:/tmp/sess" in cmd
+    assert "--cpus=2" in cmd
+    # worker identity must cross the container boundary (the pid inside
+    # is namespaced, so registration matches on the spawn token)
+    assert "RAY_TPU_WORKER_ENV_HASH=abc123" in cmd
+    assert "RAY_TPU_WORKER_SPAWN_TOKEN=tok-1" in cmd
+    i = cmd.index("myimage:latest")
+    assert cmd[i + 1:i + 3] == ["python3", "-m"]
+
+
+def test_broken_isolated_env_fails_lease():
+    """A py_executable that cannot run fails the task with a clear
+    error instead of hot-looping worker spawns."""
+    env = {"py_executable": "/nonexistent/python"}
+
+    @ray_tpu.remote(num_cpus=0, runtime_env=env, max_retries=0)
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="runtime env|exited"):
+        ray_tpu.get(f.remote(), timeout=90)
