@@ -105,6 +105,8 @@ async def run_head(config: Config, session_dir: str,
     raylet = Raylet(config, gcs_address, session_dir, resources=merged,
                     topology=detect_topology(), host=host)
     raylet_address = await raylet.start()
+    _spawn_dashboard_agent(session_dir, raylet.node_id.hex(),
+                           gcs_address, config, host=host)
     with open(handshake_path + ".tmp", "w") as f:
         json.dump({
             "gcs_address": list(gcs_address),
@@ -134,6 +136,8 @@ async def run_node(config: Config, gcs_address: Tuple[str, int],
     raylet = Raylet(config, gcs_address, session_dir, resources=merged,
                     topology=detect_topology(), host=host)
     raylet_address = await raylet.start()
+    _spawn_dashboard_agent(session_dir, raylet.node_id.hex(),
+                           gcs_address, config, host=host)
     with open(handshake_path + ".tmp", "w") as f:
         json.dump({
             "gcs_address": list(gcs_address),
@@ -149,6 +153,33 @@ async def run_node(config: Config, gcs_address: Tuple[str, int],
         asyncio.get_running_loop().add_signal_handler(sig, stop.set)
     await stop.wait()
     await raylet.stop()
+
+
+
+
+def _spawn_dashboard_agent(session_dir: str, node_id_hex: str,
+                           gcs_address, config: Config,
+                           host: str = "127.0.0.1"):
+    """Per-node dashboard agent (reference dashboard/agent.py): serves
+    node-local stats/logs over HTTP on the node's host address and
+    registers itself in the GCS KV.  Spawned through _spawn so it gets
+    the same env-stash/PDEATHSIG/posix_spawn discipline as the other
+    daemons (it dies with this node process)."""
+    if not getattr(config, "dashboard_agent", True):
+        return None
+    cmd = [sys.executable, "-m", "ray_tpu.dashboard_agent",
+           "--session-dir", session_dir,
+           "--node-id", node_id_hex,
+           "--host", host,
+           "--gcs", f"{gcs_address[0]}:{gcs_address[1]}"]
+    try:
+        return _spawn(cmd, session_dir, f"dashboard-agent-{node_id_hex[:8]}",
+                      die_with_parent=safe_die_with_parent())
+    except Exception:  # noqa: BLE001 — observability must not block boot
+        logging.getLogger(__name__).exception(
+            "dashboard agent failed to start")
+        return None
+
 
 
 def safe_die_with_parent() -> bool:

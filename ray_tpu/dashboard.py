@@ -121,8 +121,81 @@ class Dashboard:
         return self._json(await self._state(list_cluster_events))
 
     async def handle_node_stats(self, request):
+        """Fan out to per-node dashboard agents (reference
+        dashboard/agent.py pull model: the head queries agents on
+        demand, so stats never ride the GCS hot path at fleet scale);
+        nodes whose agent is unreachable fall back to the last
+        health-beat snapshot."""
         from ray_tpu.experimental.state.api import node_stats
-        return self._json(await self._state(node_stats))
+
+        beat_rows = await self._state(node_stats)
+        agents = await self._agent_addresses()
+        if not agents:
+            return self._json(beat_rows)
+
+        import aiohttp
+
+        async def fetch(sess, node_hex: str, addr: str):
+            try:
+                async with sess.get(
+                        f"http://{addr}/api/local/stats") as resp:
+                    return node_hex, await resp.json()
+            except Exception:  # noqa: BLE001 — agent may be down
+                return node_hex, None
+
+        timeout = aiohttp.ClientTimeout(total=3.0)
+        async with aiohttp.ClientSession(timeout=timeout) as sess:
+            live = dict(await asyncio.gather(
+                *(fetch(sess, n, a) for n, a in agents.items())))
+        out = []
+        for row in beat_rows:
+            node_hex = row["node_id"].hex() \
+                if isinstance(row["node_id"], bytes) else str(
+                    row["node_id"])
+            fresh = live.get(node_hex)
+            if fresh:
+                fresh["node_id"] = row["node_id"]
+                fresh["state"] = row.get("state")
+                fresh["source"] = "agent"
+                out.append(fresh)
+            else:
+                row = dict(row)
+                row["source"] = "health_beat"
+                out.append(row)
+        return self._json(out)
+
+    async def _agent_addresses(self) -> Dict[str, str]:
+        """Live agents only: each agent re-registers every 30s with a
+        timestamp; entries older than 3 beats belong to dead nodes and
+        would stall the fan-out on their connect timeout."""
+        import time
+
+        from ray_tpu.core import worker as worker_mod
+
+        def fetch():
+            w = worker_mod.global_worker()
+            keys = w.gcs_call("kv_keys", {
+                "namespace": "_internal", "prefix": "dashboard_agent:"})
+            out = {}
+            now = time.time()
+            for key in keys:
+                val = w.gcs_call("kv_get", {
+                    "namespace": "_internal", "key": key})
+                if not val:
+                    continue
+                try:
+                    entry = json.loads(val.decode())
+                    if now - float(entry.get("ts", 0)) > 95.0:
+                        continue  # stale: agent (or its node) is gone
+                    out[key.split(":", 1)[1]] = entry["address"]
+                except (ValueError, KeyError):
+                    continue
+            return out
+
+        try:
+            return await self._state(fetch)
+        except Exception:  # noqa: BLE001 — agents are optional
+            return {}
 
     async def handle_metrics(self, request):
         from ray_tpu.core import worker as worker_mod

@@ -88,6 +88,14 @@ class ServeReplica:
     def ready(self) -> bool:
         return True
 
+    def node_id(self) -> Optional[str]:
+        """Hex node id this replica runs on (locality routing)."""
+        try:
+            import ray_tpu as _rt
+            return _rt.get_runtime_context().get_node_id()
+        except Exception:  # noqa: BLE001 — locality is best-effort
+            return None
+
 
 @ray_tpu.remote
 class ServeController:
@@ -103,6 +111,9 @@ class ServeController:
         self._stop = False
         # replicas removed from routing, awaiting drain: (handle, deadline)
         self._draining: List[Tuple[Any, float]] = []
+        # actor_id -> node hex, for locality-aware routing (reference
+        # replica_scheduler's node-locality ranking)
+        self._replica_nodes: Dict[bytes, Optional[str]] = {}
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
         self._thread.start()
 
@@ -147,6 +158,9 @@ class ServeController:
         with self._lock:
             table = {
                 name: {"replicas": list(replicas),
+                       "replica_nodes": [
+                           self._replica_nodes.get(r.actor_id.binary())
+                           for r in replicas],
                        "max_concurrent_queries":
                            self._configs[name].max_concurrent_queries
                            if name in self._configs else 100}
@@ -196,6 +210,16 @@ class ServeController:
                              for name, dep in self._deployments.items()}
             self._configs = {name: dep["config"]
                              for name, dep in self._deployments.items()}
+            # drop node mappings for replicas no longer routed or
+            # draining (the map would otherwise grow per redeploy)
+            live = {r.actor_id.binary()
+                    for replicas in self._routing.values()
+                    for r in replicas}
+            live |= {entry[0].actor_id.binary()
+                     for entry in self._draining}
+            self._replica_nodes = {
+                k: v for k, v in self._replica_nodes.items()
+                if k in live}
             self._routing_version += 1
 
     def _control_loop(self) -> None:
@@ -330,6 +354,11 @@ class ServeController:
                 **opts).remote(dep["blob"], init_args, init_kwargs,
                                config.user_config)
             ray_tpu.get(replica.ready.remote(), timeout=120)
+            try:
+                self._replica_nodes[replica.actor_id.binary()] = \
+                    ray_tpu.get(replica.node_id.remote(), timeout=10)
+            except Exception:  # noqa: BLE001 — locality is best-effort
+                pass
             return replica
         except Exception:  # noqa: BLE001
             logger.exception("failed to start replica")
@@ -348,6 +377,13 @@ class Router:
         self._inflight: Dict[Tuple[str, bytes], int] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # this process's node, for same-node-first replica ranking
+        # (reference replica_scheduler prefers node-local replicas)
+        try:
+            self._local_node: Optional[str] = \
+                ray_tpu.get_runtime_context().get_node_id()
+        except Exception:  # noqa: BLE001
+            self._local_node = None
         self._refresh(block=True)
         self._thread = threading.Thread(target=self._poll_loop, daemon=True)
         self._thread.start()
@@ -391,17 +427,33 @@ class Router:
                 entry = self._table.get(deployment)
                 if entry and entry["replicas"]:
                     replicas = entry["replicas"]
+                    nodes = entry.get("replica_nodes") \
+                        or [None] * len(replicas)
                     cap = entry["max_concurrent_queries"]
                     start = self._rr.get(deployment, 0)
-                    for i in range(len(replicas)):
-                        idx = (start + i) % len(replicas)
-                        r = replicas[idx]
-                        key = (deployment, r.actor_id.binary())
-                        if self._inflight.get(key, 0) < cap:
-                            self._rr[deployment] = idx + 1
-                            self._inflight[key] = \
-                                self._inflight.get(key, 0) + 1
-                            return r, key
+                    # strict locality: exhaust same-node replicas before
+                    # crossing nodes; round-robin within each group
+                    local = [i for i in range(len(replicas))
+                             if self._local_node is not None
+                             and nodes[i] == self._local_node]
+                    rest = [i for i in range(len(replicas))
+                            if i not in set(local)]
+                    picked = None
+                    for group in (local, rest):
+                        for i in range(len(group)):
+                            idx = group[(start + i) % len(group)]
+                            r = replicas[idx]
+                            key = (deployment, r.actor_id.binary())
+                            if self._inflight.get(key, 0) < cap:
+                                picked = (r, key)
+                                break
+                        if picked:
+                            break
+                    if picked:
+                        self._rr[deployment] = start + 1
+                        self._inflight[picked[1]] = \
+                            self._inflight.get(picked[1], 0) + 1
+                        return picked
             time.sleep(0.05)
         raise RuntimeError(
             f"no available replica for deployment {deployment!r}")

@@ -32,6 +32,9 @@ class HTTPProxy:
     def ready(self) -> bool:
         return self._started.is_set()
 
+    def node_id(self) -> str:
+        return ray_tpu.get_runtime_context().get_node_id()
+
     def _serve_forever(self) -> None:
         asyncio.run(self._main())
 
@@ -113,3 +116,37 @@ def start_proxy(port: int = 0) -> tuple:
             max_concurrency=32).remote(port=port)
     ray_tpu.get(_proxy_handle.ready.remote(), timeout=60)
     return tuple(ray_tpu.get(_proxy_handle.address.remote(), timeout=30))
+
+
+def start_proxies_every_node(port: int = 0) -> Dict[str, tuple]:
+    """Proxy-per-node deployment (reference ``http_state.py``
+    ``HTTPProxyStateManager`` with ``ProxyLocation.EveryNode``): one
+    pinned proxy actor per alive node, each routing with node-locality
+    preference (its Router ranks same-node replicas first).  Returns
+    {node_id_hex: (host, port)}.  Idempotent — existing proxies are
+    reused; call again after adding nodes to cover them."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    out: Dict[str, tuple] = {}
+    handles: Dict[str, Any] = {}
+    for node in ray_tpu.nodes():
+        if not node.get("alive", True):
+            continue
+        node_hex = node["node_id"].hex() \
+            if isinstance(node["node_id"], bytes) else str(node["node_id"])
+        name = f"SERVE_HTTP_PROXY-{node_hex[:12]}"
+        try:
+            handle = ray_tpu.get_actor(name)
+        except ValueError:
+            handle = HTTPProxy.options(
+                name=name, lifetime="detached", max_concurrency=32,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_hex, soft=False),
+            ).remote(port=port)
+        handles[node_hex] = handle
+    for node_hex, handle in handles.items():
+        ray_tpu.get(handle.ready.remote(), timeout=60)
+        out[node_hex] = tuple(
+            ray_tpu.get(handle.address.remote(), timeout=30))
+    return out

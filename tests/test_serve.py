@@ -235,3 +235,35 @@ def test_serve_config_deploy(tmp_path, ray_start_regular):
         serve.shutdown()
     finally:
         sys.path.remove(str(tmp_path))
+
+
+def test_per_node_proxies_and_locality(monkeypatch):
+    """One proxy per alive node, each preferring same-node replicas
+    (reference http_state.py ProxyLocation.EveryNode + the replica
+    scheduler's locality ranking)."""
+    import urllib.request
+
+    from ray_tpu.serve.http_proxy import start_proxies_every_node
+
+    @serve.deployment(num_replicas=2)
+    def where(_payload=None):
+        return {"node": ray_tpu.get_runtime_context().get_node_id()}
+
+    serve.run(where.bind())
+    proxies = start_proxies_every_node()
+    assert len(proxies) >= 1
+    # every proxy answers, and the routing table carries replica nodes
+    for node_hex, (host, port) in proxies.items():
+        req = urllib.request.Request(
+            f"http://{host}:{port}/where", data=b"{}",
+            headers={"content-type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert "node" in body["result"]
+    from ray_tpu.serve._internal import CONTROLLER_NAME
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    table = ray_tpu.get(
+        controller.get_routing_table.remote(-1, 1.0), timeout=30)
+    entry = table["table"]["where"]
+    assert len(entry["replica_nodes"]) == len(entry["replicas"])
+    assert any(n is not None for n in entry["replica_nodes"])

@@ -206,3 +206,48 @@ def test_debug_state_handler_stats(ray_start_regular):
     raylet = core.raylet_call(tuple(core.raylet_address),
                               "debug_state", {})
     assert str(raylet.get("loop", "")).startswith("raylet-")
+
+
+def test_per_node_dashboard_agent():
+    """The per-node agent (reference dashboard/agent.py) registers in
+    the GCS KV, serves node-local stats + log tails over HTTP, and the
+    head dashboard's /api/node_stats prefers agent data over the
+    health-beat fallback."""
+    import time as _time
+
+    from ray_tpu.core import worker as worker_mod
+    from ray_tpu.dashboard import Dashboard
+
+    w = worker_mod.global_worker()
+    deadline = _time.monotonic() + 30
+    keys = []
+    while _time.monotonic() < deadline and not keys:
+        keys = w.gcs_call("kv_keys", {"namespace": "_internal",
+                                      "prefix": "dashboard_agent:"})
+        if not keys:
+            _time.sleep(0.5)
+    assert keys, "dashboard agent never registered"
+    entry = json.loads(w.gcs_call("kv_get", {"namespace": "_internal",
+                                             "key": keys[0]}).decode())
+    addr = entry["address"]
+    assert entry["ts"] > 0  # liveness beat timestamp
+
+    with urllib.request.urlopen(f"http://{addr}/api/local/stats",
+                                timeout=15) as r:
+        stats = json.loads(r.read())
+    assert "cpu_percent" in stats and isinstance(stats["workers"], list)
+
+    with urllib.request.urlopen(f"http://{addr}/api/local/logs",
+                                timeout=15) as r:
+        logs = json.loads(r.read())["logs"]
+    assert logs  # session log dir is populated by this cluster
+
+    dash = Dashboard(port=0)
+    url = dash.start()
+    try:
+        with urllib.request.urlopen(url + "/api/node_stats",
+                                    timeout=30) as r:
+            rows = json.loads(r.read())
+        assert any(row.get("source") == "agent" for row in rows), rows
+    finally:
+        dash.stop()
