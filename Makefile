@@ -1,10 +1,42 @@
 # Native runtime components (C++). `make` builds build/librtpu.so; the
 # Python side also builds it on demand (ray_tpu/core/native.py).
-.PHONY: all native test clean
+#
+# Sanitizer targets (the race-detection story for the native plane —
+# parity with the reference's tsan/asan CI configs):
+#   make tsan   — ThreadSanitizer build of the concurrency stress
+#                 harness (src/store_stress.cc) + run
+#   make asan   — AddressSanitizer+UBSan build + run
+.PHONY: all native test tsan asan sanitize clean
+
+CXX ?= g++
+CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
+SAN_SRCS = src/object_store.cc src/sched_core.cc src/store_stress.cc
+
 all: native
+
 native:
 	python -m ray_tpu.core.native
+
 test: native
 	python -m pytest tests/ -q
+
+build/store_stress_tsan: $(SAN_SRCS)
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -fsanitize=thread $(SAN_SRCS) -o $@ -pthread
+
+build/store_stress_asan: $(SAN_SRCS)
+	@mkdir -p build
+	$(CXX) $(CXXFLAGS) -fsanitize=address,undefined \
+	  -fno-sanitize-recover=all $(SAN_SRCS) -o $@ -pthread
+
+tsan: build/store_stress_tsan
+	TSAN_OPTIONS="halt_on_error=1" ./build/store_stress_tsan
+
+asan: build/store_stress_asan
+	ASAN_OPTIONS="detect_leaks=1" UBSAN_OPTIONS="halt_on_error=1" \
+	  ./build/store_stress_asan
+
+sanitize: tsan asan
+
 clean:
 	rm -rf build

@@ -1,0 +1,146 @@
+// Concurrency stress harness for the native object store + scheduling
+// core, built to run under ThreadSanitizer / AddressSanitizer
+// (`make tsan` / `make asan`).
+//
+// Parity note: the reference runs its C++ runtime under sanitizer CI
+// jobs (bazel --config=tsan / asan); this is the same race-detection
+// story for the two native components here.  The store's shared state
+// (allocation map, free list, LRU queue, pin counts) is exercised by
+// racing creators / getters / releasers / deleters / evictors across
+// threads; the scheduler core is pure (no shared mutable state) so a
+// read-only concurrent sweep suffices.
+//
+// Exit code 0 = clean; sanitizer reports abort the process (TSan exits
+// non-zero via halt_on_error in the Makefile env).
+
+#include <stdlib.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+// C ABI of the components under test (object_store.cc / sched_core.cc)
+extern "C" {
+void* rtpu_store_create(const char* path, uint64_t capacity);
+void rtpu_store_destroy(void* handle);
+int64_t rtpu_store_put(void* handle, const unsigned char* id, uint64_t size);
+int rtpu_store_seal(void* handle, const unsigned char* id);
+int rtpu_store_get(void* handle, const unsigned char* id, uint64_t* offset,
+                   uint64_t* size);
+int rtpu_store_release(void* handle, const unsigned char* id);
+int rtpu_store_contains(void* handle, const unsigned char* id);
+int rtpu_store_delete(void* handle, const unsigned char* id);
+uint64_t rtpu_store_evict(void* handle, uint64_t bytes_needed);
+void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
+                      uint64_t* num_objects);
+
+int rtpu_sched_pick_node(const double* node_avail, const int64_t* node_load,
+                         int n_nodes, int n_res, const double* demand,
+                         int strategy, double local_utilization,
+                         double spread_threshold, int local_feasible);
+}
+
+namespace {
+
+constexpr int kIdSize = 28;
+constexpr int kThreads = 8;
+constexpr int kOpsPerThread = 20000;
+constexpr int kKeySpace = 64;  // deliberately small: maximize collisions
+
+void FillId(unsigned char* id, int key) {
+  std::memset(id, 0, kIdSize);
+  std::memcpy(id, &key, sizeof(key));
+}
+
+void StoreWorker(void* store, int seed, std::atomic<long>* ops_done) {
+  std::mt19937 rng(seed);
+  unsigned char id[kIdSize];
+  for (int i = 0; i < kOpsPerThread; i++) {
+    FillId(id, static_cast<int>(rng() % kKeySpace));
+    switch (rng() % 6) {
+      case 0: {  // create + seal
+        int64_t off = rtpu_store_put(store, id, 1024 + rng() % 4096);
+        if (off >= 0) rtpu_store_seal(store, id);
+        break;
+      }
+      case 1: {  // get (pin) + release
+        uint64_t offset = 0, size = 0;
+        if (rtpu_store_get(store, id, &offset, &size)) {
+          rtpu_store_release(store, id);
+        }
+        break;
+      }
+      case 2:
+        rtpu_store_contains(store, id);
+        break;
+      case 3:
+        rtpu_store_delete(store, id);
+        break;
+      case 4:
+        rtpu_store_evict(store, 8192);
+        break;
+      default: {
+        uint64_t used, cap, n;
+        rtpu_store_stats(store, &used, &cap, &n);
+        break;
+      }
+    }
+    ops_done->fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+void SchedWorker(int seed, std::atomic<long>* ops_done) {
+  std::mt19937 rng(seed);
+  constexpr int kNodes = 16, kRes = 3;
+  double avail[kNodes * kRes];
+  for (int i = 0; i < kNodes * kRes; i++) {
+    avail[i] = static_cast<double>(rng() % 8);
+  }
+  int64_t load[kNodes];
+  for (int i = 0; i < kNodes; i++) load[i] = rng() % 10;
+  for (int i = 0; i < kOpsPerThread; i++) {
+    double demand[kRes] = {static_cast<double>(rng() % 4), 0.0,
+                           static_cast<double>(rng() % 2)};
+    rtpu_sched_pick_node(avail, load, kNodes, kRes, demand,
+                         static_cast<int>(rng() % 2),
+                         0.01 * (rng() % 100), 0.5,
+                         static_cast<int>(rng() % 2));
+    ops_done->fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+}  // namespace
+
+int main() {
+  char path[] = "/dev/shm/rtpu_stress_XXXXXX";
+  int fd = mkstemp(path);
+  if (fd >= 0) close(fd);
+  void* store = rtpu_store_create(path, 16ull << 20);
+  if (store == nullptr) {
+    std::fprintf(stderr, "store create failed\n");
+    return 2;
+  }
+  std::atomic<long> ops{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; t++) {
+    threads.emplace_back(StoreWorker, store, 1000 + t, &ops);
+  }
+  for (int t = 0; t < 2; t++) {
+    threads.emplace_back(SchedWorker, 2000 + t, &ops);
+  }
+  for (auto& th : threads) th.join();
+
+  uint64_t used = 0, cap = 0, n = 0;
+  rtpu_store_stats(store, &used, &cap, &n);
+  std::printf("ops=%ld objects=%llu used=%llu/%llu\n", ops.load(),
+              (unsigned long long)n, (unsigned long long)used,
+              (unsigned long long)cap);
+  rtpu_store_destroy(store);
+  std::remove(path);
+  return 0;
+}

@@ -2,6 +2,8 @@
 reference cluster_task_manager_test.cc / bundle scheduling policy
 tests — randomized agreement + strategy semantics)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -128,3 +130,37 @@ def test_strategy_semantics():
     many = [{"CPU": 1.0}] * 4
     assert native.sched_place_bundles([{"CPU": 4.0}, {"CPU": 1.0}],
                                       many, "SPREAD") == [0, 1, 0, 0]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("target", ["tsan", "asan"])
+def test_native_sanitizers(target):
+    """Race/memory detection for the native plane (reference: bazel
+    --config=tsan/asan CI): builds src/store_stress.cc under the
+    sanitizer and runs 200k racing store ops + scheduler sweeps.
+    Any data race / UB / leak fails the run."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    cxx = shutil.which(os.environ.get("CXX", "g++"))
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    # probe the sanitizer runtime: minimal hosts lack libtsan/libasan
+    flag = {"tsan": "-fsanitize=thread",
+            "asan": "-fsanitize=address"}[target]
+    with tempfile.TemporaryDirectory() as td:
+        probe = os.path.join(td, "probe.cc")
+        with open(probe, "w") as f:
+            f.write("int main() { return 0; }\n")
+        ok = subprocess.run(
+            [cxx, flag, probe, "-o", os.path.join(td, "probe")],
+            capture_output=True).returncode == 0
+    if not ok:
+        pytest.skip(f"{flag} runtime unavailable")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(["make", target], cwd=repo, capture_output=True,
+                          text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "ops=" in proc.stdout
