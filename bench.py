@@ -257,11 +257,24 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
                                 timeout=budget_s), 1000, reps=3)
         out["vs_ref_single_client_async"] = \
             out["tasks_per_sec_async"] / 10905.0
-        callers = [Caller.remote() for _ in range(4)]
+        callers = [Caller.remote() for _ in range(8)]
         ray_tpu.get([c.do_tasks.remote(10) for c in callers], timeout=60)
         out["multi_client_tasks_per_sec_async"] = rate(
-            lambda: ray_tpu.get([c.do_tasks.remote(250) for c in callers],
-                                timeout=budget_s), 1000, reps=3)
+            lambda: ray_tpu.get(
+                [c.do_tasks.remote(250) for c in callers[:4]],
+                timeout=budget_s), 1000, reps=3)
+        # clients-vs-throughput scaling curve: how task throughput moves
+        # as concurrent submitting clients grow (the reference's
+        # multi-client rows come from a 64-core box; this curve shows
+        # whether the architecture scales with the cores it has)
+        curve = {}
+        for n in (1, 2, 4, 8):
+            per = max(1, 1000 // n)
+            curve[str(n)] = round(rate(
+                lambda: ray_tpu.get(
+                    [c.do_tasks.remote(per) for c in callers[:n]],
+                    timeout=budget_s), per * n, reps=2), 1)
+        out["task_scaling_curve_clients_to_per_sec"] = curve
 
         # -- actor calls ----------------------------------------------
         counter = Counter.remote()

@@ -59,3 +59,15 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    #: tune callbacks (loggers etc. — reference ``tune/callback.py``);
+    #: None means the default CSV+JSON loggers when a local_dir exists
+    callbacks: Optional[list] = None
+    #: a Stopper / callable / dict of metric thresholds (reference
+    #: ``tune/stopper/``) applied to every trial result
+    stop: Optional[object] = None
+    #: where logger callbacks write per-trial files (defaults to
+    #: ~/ray_tpu_results/<name>)
+    local_dir: Optional[str] = None
+    #: console progress reporting period (0 disables; reference
+    #: ``tune/progress_reporter.py``)
+    progress_report_s: float = 0.0

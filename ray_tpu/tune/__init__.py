@@ -13,7 +13,15 @@ from typing import Any, Callable, Dict, List, Optional
 from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig
 from ray_tpu.tune import schedulers  # noqa: F401
 from ray_tpu.tune.bohb import BOHBSearcher, HyperBandForBOHB  # noqa: F401
+from ray_tpu.tune.callback import (Callback, CSVLoggerCallback,  # noqa: F401
+                                   JsonLoggerCallback, TBXLoggerCallback)
 from ray_tpu.tune.execution import TrialRunner
+from ray_tpu.tune.progress_reporter import CLIReporter  # noqa: F401
+from ray_tpu.tune.stopper import (CombinedStopper,  # noqa: F401
+                                  ExperimentPlateauStopper,
+                                  FunctionStopper,
+                                  MaximumIterationStopper, Stopper,
+                                  TimeoutStopper, TrialPlateauStopper)
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler,  # noqa: F401
                                      FIFOScheduler, HyperBandScheduler,
                                      MedianStoppingRule,

@@ -110,6 +110,34 @@ class TrialRunner:
             self._sync = ExperimentSync(
                 self.run_config.storage_path,
                 self.run_config.name or "tune_experiment")
+        from ray_tpu.tune.callback import default_callbacks
+        from ray_tpu.tune.stopper import resolve_stopper
+        callbacks = getattr(self.run_config, "callbacks", None)
+        if callbacks is None:
+            import os
+            local_dir = getattr(self.run_config, "local_dir", None) \
+                or os.path.expanduser(os.path.join(
+                    "~", "ray_tpu_results",
+                    self.run_config.name or "tune_experiment"))
+            callbacks = default_callbacks(local_dir)
+        self.callbacks = list(callbacks)
+        self._stopper = resolve_stopper(
+            getattr(self.run_config, "stop", None))
+        self._reporter = None
+        period = float(getattr(self.run_config, "progress_report_s", 0.0)
+                       or 0.0)
+        if period > 0:
+            from ray_tpu.tune.progress_reporter import CLIReporter
+            self._reporter = CLIReporter(max_report_frequency=period)
+        self._iteration = 0
+
+    def _fire(self, hook: str, *args) -> None:
+        for cb in self.callbacks:
+            try:
+                getattr(cb, hook)(*args)
+            except Exception:  # noqa: BLE001 — callbacks must not kill
+                logger.exception("callback %s.%s failed",
+                                 type(cb).__name__, hook)
 
     def _sync_progress(self, trial: Optional[Trial] = None,
                        force: bool = False) -> None:
@@ -190,16 +218,26 @@ class TrialRunner:
                                    if t.status == PENDING]
         live: List[Trial] = []
         max_concurrent = self._effective_max_concurrent()
+        self._fire("setup", self.trials)
+        stop_all = False
         while pending or live:
-            while pending and len(live) < max_concurrent:
+            while pending and len(live) < max_concurrent and not stop_all:
                 trial = pending.pop(0)
                 try:
                     self._start_trial(trial)
                     live.append(trial)
+                    self._fire("on_trial_start", self._iteration,
+                               self.trials, trial)
                 except Exception as e:  # noqa: BLE001
                     trial.status = ERROR
                     trial.error = str(e)
+                    self.scheduler.on_trial_complete(self, trial, None)
+                    self._fire("on_trial_error", self._iteration,
+                               self.trials, trial)
+            if stop_all and not live:
+                break
             progressed = False
+            self._iteration += 1
             for trial in list(live):
                 polls = ray_tpu.get(trial.actor.poll.remote(), timeout=60)
                 decision = sched_mod.CONTINUE
@@ -211,6 +249,11 @@ class TrialRunner:
                         self._sync_progress(trial)
                     trial.last_result = result
                     trial.results.append(result)
+                    self._fire("on_trial_result", self._iteration,
+                               self.trials, trial, result)
+                    if self._stopper is not None and \
+                            self._stopper(trial.trial_id, result):
+                        decision = sched_mod.STOP
                     d = self.scheduler.on_trial_result(self, trial, result)
                     if d != sched_mod.CONTINUE:
                         decision = d
@@ -219,6 +262,8 @@ class TrialRunner:
                     live.remove(trial)
                     self.scheduler.on_trial_complete(self, trial,
                                                      trial.last_result)
+                    self._fire("on_trial_complete", self._iteration,
+                               self.trials, trial)
                     self._sync_progress(trial, force=True)
                     continue
                 if trial.trial_id in self._exploit_requests:
@@ -255,6 +300,8 @@ class TrialRunner:
                         else:
                             self._stop_trial(trial, ERROR)
                             self.scheduler.on_trial_complete(self, trial, None)
+                            self._fire("on_trial_error", self._iteration,
+                                       self.trials, trial)
                         self._sync_progress(trial, force=True)
                     else:
                         trial.error = None  # a successful retry clears it
@@ -265,8 +312,28 @@ class TrialRunner:
                         self._stop_trial(trial, TERMINATED)
                         self.scheduler.on_trial_complete(
                             self, trial, trial.last_result)
+                        self._fire("on_trial_complete", self._iteration,
+                                   self.trials, trial)
                         self._sync_progress(trial, force=True)
+            if self._stopper is not None and self._stopper.stop_all() \
+                    and not stop_all:
+                # experiment-level stop: drain live trials, start no more
+                stop_all = True
+                pending.clear()
+                for trial in list(live):
+                    self._stop_trial(trial, TERMINATED)
+                    live.remove(trial)
+                    self.scheduler.on_trial_complete(self, trial,
+                                                     trial.last_result)
+                    self._fire("on_trial_complete", self._iteration,
+                               self.trials, trial)
+                    self._sync_progress(trial, force=True)
+            if self._reporter is not None and self._reporter.should_report():
+                self._reporter.report(self.trials)
             if not progressed:
                 time.sleep(poll_period)
+        if self._reporter is not None:
+            self._reporter.report(self.trials, done=True)
+        self._fire("on_experiment_end", self.trials)
         self._sync_progress(force=True)
         return self.trials

@@ -370,7 +370,13 @@ def test_tuned_examples_rotating_subset():
 
     from ray_tpu.rllib import tuned_examples
 
-    paths = tuned_examples.list_examples()
+    import yaml as _yaml
+
+    paths = []
+    for p in tuned_examples.list_examples():
+        with open(p) as f:
+            if _yaml.safe_load(f).get("rotation", True):
+                paths.append(p)
     start = int(os.environ.get("RAY_TPU_TUNED_ROTATION",
                                time.time() // 86400)) % len(paths)
     picks = [paths[start], paths[(start + len(paths) // 2) % len(paths)]]

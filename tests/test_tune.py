@@ -1,5 +1,7 @@
 """ray_tpu.tune tests (parity model: reference python/ray/tune/tests/)."""
 
+import json
+
 import pytest
 
 import ray_tpu
@@ -250,3 +252,69 @@ def test_orbax_checkpoint_bridge(tmp_path):
     tree2 = from_air_checkpoint(
         ckpt, target={"params": {"w": jnp.zeros((2, 3))}, "step": 0})
     assert int(np.asarray(tree2["step"])) == 7
+
+
+def test_logger_callbacks_and_stopper(tmp_path):
+    """CSV/JSON loggers write per-trial files; a dict stop spec ends
+    trials at the metric threshold; TimeoutStopper ends the experiment
+    (parity: reference tune/logger + tune/stopper)."""
+    from ray_tpu import tune
+    from ray_tpu.tune import RunConfig, TuneConfig, Tuner
+
+    def trainable(config):
+        for i in range(50):
+            tune.report(score=i * config["lr"], training_iteration=i + 1)
+
+    run_config = RunConfig(local_dir=str(tmp_path),
+                           stop={"score": 4.0})
+    tuner = Tuner(trainable,
+                  param_space={"lr": tune.grid_search([1.0, 2.0])},
+                  tune_config=TuneConfig(metric="score", mode="max"),
+                  run_config=run_config)
+    grid = tuner.fit()
+    assert len(grid) == 2
+    for result in grid:
+        # dict stopper: halted at/above the threshold, well short of 50
+        assert result.metrics["score"] >= 4.0
+        assert result.metrics["training_iteration"] <= 10
+    trial_dirs = [d for d in tmp_path.iterdir() if d.is_dir()]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        assert (d / "progress.csv").read_text().count("\n") >= 2
+        lines = (d / "result.json").read_text().strip().splitlines()
+        assert json.loads(lines[-1])["score"] >= 4.0
+        assert "lr" in json.loads((d / "params.json").read_text())
+
+
+def test_plateau_and_custom_stoppers():
+    from ray_tpu import tune
+    from ray_tpu.tune import (RunConfig, TrialPlateauStopper, TuneConfig,
+                              Tuner)
+
+    def flat(config):
+        for i in range(60):
+            tune.report(loss=1.0 if i > 3 else 10.0 - i,
+                        training_iteration=i + 1)
+
+    stopper = TrialPlateauStopper("loss", std=0.001, num_results=3,
+                                  grace_period=3)
+    grid = Tuner(flat, param_space={},
+                 tune_config=TuneConfig(metric="loss", mode="min"),
+                 run_config=RunConfig(stop=stopper)).fit()
+    assert grid[0].metrics["training_iteration"] < 20
+
+
+def test_cli_reporter_output():
+    import io
+
+    from ray_tpu.tune.progress_reporter import CLIReporter
+    from ray_tpu.tune.trial import Trial
+
+    out = io.StringIO()
+    reporter = CLIReporter(max_report_frequency=0.0, out=out)
+    trials = [Trial({"lr": 0.1}, "t1"), Trial({"lr": 0.2}, "t2")]
+    trials[0].status = "RUNNING"
+    trials[0].last_result = {"training_iteration": 3, "score": 1.5}
+    reporter.report(trials)
+    text = out.getvalue()
+    assert "RUNNING" in text and "t1" in text and "1.5" in text
