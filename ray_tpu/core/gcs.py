@@ -127,6 +127,8 @@ class GcsServer:
         self._health_task: Optional[asyncio.Task] = None
         self._pg_retry_task: Optional[asyncio.Task] = None
         self._actor_creation_locks: Dict[ActorID, asyncio.Lock] = {}
+        # node -> unresolved lease_worker_for_actor calls (burst spread)
+        self._actor_lease_inflight: Dict[NodeID, int] = {}
         self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
         # (name, sorted-tags) -> aggregated metric record
         self._metrics: Dict[Any, Dict[str, Any]] = {}
@@ -708,6 +710,14 @@ class GcsServer:
                     if node is None:
                         await asyncio.sleep(0.2)  # wait for resources/nodes
                         continue
+                # in-flight lease accounting: health-beat load is ~1s
+                # stale, so a creation burst would pile onto whichever
+                # node looked least loaded at the last beat; counting
+                # our own unresolved leases spreads the burst across
+                # raylets (parity: GcsActorScheduler's inflight
+                # bookkeeping, gcs_actor_scheduler.cc:49)
+                self._actor_lease_inflight[node.node_id] = \
+                    self._actor_lease_inflight.get(node.node_id, 0) + 1
                 try:
                     conn = await self.pool.get(node.raylet_address)
                     reply = await conn.call(
@@ -727,6 +737,12 @@ class GcsServer:
                                    node.node_id.hex()[:12], e)
                     await asyncio.sleep(0.2)
                     continue
+                finally:
+                    n_in = self._actor_lease_inflight.get(node.node_id, 1)
+                    if n_in <= 1:
+                        self._actor_lease_inflight.pop(node.node_id, None)
+                    else:
+                        self._actor_lease_inflight[node.node_id] = n_in - 1
                 if not reply.get("granted"):
                     await asyncio.sleep(0.1)
                     continue
@@ -755,7 +771,9 @@ class GcsServer:
 
     def _pick_node(self, resources: Dict[str, float],
                    required_node: Optional[NodeID] = None) -> Optional[NodeInfo]:
-        """Least-loaded feasible node (actors spread by default)."""
+        """Least-loaded feasible node (actors spread by default); load
+        counts this GCS's own unresolved actor leases on top of the
+        beat-reported queue so creation bursts fan out immediately."""
         candidates = []
         for node in self.nodes.values():
             if not node.alive:
@@ -767,7 +785,9 @@ class GcsServer:
                 candidates.append(node)
         if not candidates:
             return None
-        return min(candidates, key=lambda n: n.load)
+        return min(candidates,
+                   key=lambda n: n.load + self._actor_lease_inflight.get(
+                       n.node_id, 0))
 
     async def handle_actor_started(self, conn, data):
         """The actor worker reports in after executing its creation task."""

@@ -289,6 +289,11 @@ def _spawn(cmd, session_dir: str, tag: str,
     out = open(log_base + ".out", "ab")
     err = open(log_base + ".err", "ab")
     env = dict(os.environ)
+    # daemons must import ray_tpu regardless of the driver's cwd
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     # Node daemons never need an accelerator; dropping the axon pool var
     # ALSO keeps sitecustomize from importing jax in the daemon, so its
     # own worker forks stay thread-free.  The originals are STASHED so

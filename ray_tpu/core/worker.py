@@ -1876,6 +1876,18 @@ class CoreWorker:
                 if message["state"] == "ALIVE" and message["address"]:
                     state.address = tuple(message["address"])
                     state.dead_cause = None  # restart completed
+                    # pre-warm the submit connection: in a creation
+                    # burst the first-call storm otherwise pays one
+                    # serial TCP connect per actor right when every
+                    # process is busiest
+                    try:
+                        t = self._loop.create_task(
+                            self._pool.get(state.address))
+                        t.add_done_callback(
+                            lambda f: f.exception()
+                            if not f.cancelled() else None)
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
                 elif message["state"] == "DEAD":
                     state.address = None
                     state.dead_cause = message.get("death_cause") or "dead"

@@ -138,8 +138,9 @@ class AlphaZero(Algorithm):
 
     # -- MCTS -----------------------------------------------------------
     def _evaluate(self, obs: np.ndarray) -> Tuple[np.ndarray, float]:
+        flat = np.asarray(obs, np.float32).reshape(-1)  # image envs too
         priors, value = self._infer(
-            self.params, jnp.asarray(obs[None], jnp.float32))
+            self.params, jnp.asarray(flat[None]))
         return np.asarray(priors)[0], float(np.asarray(value)[0])
 
     def _mcts(self, env, obs: np.ndarray, explore: bool) -> np.ndarray:
@@ -194,12 +195,17 @@ class AlphaZero(Algorithm):
                 if not node.children:
                     for a in range(self.num_actions):
                         node.children[a] = _Node(float(priors[a]))
-            # backup (discounted through the path's rewards)
+            # backup (discounted through the path's rewards).  A node's
+            # value INCLUDES its entering reward: Q(parent, a) ==
+            # child.value, so selection sees immediate rewards —
+            # crediting the reward one level up would make terminal
+            # moves (the catch/miss in terminal-reward games)
+            # indistinguishable at selection time
             value = leaf_value
             for n in reversed(path):
+                value = n.reward + gamma * value
                 n.visits += 1
                 n.value_sum += value
-                value = n.reward + gamma * value
         counts = np.asarray(
             [root.children[a].visits for a in range(self.num_actions)],
             np.float64)
@@ -234,8 +240,9 @@ class AlphaZero(Algorithm):
         z = 0.0
         for obs_t, pi_t, rew_t in reversed(history):
             z = rew_t + gamma * z
-            self._replay.append((obs_t, pi_t.astype(np.float32),
-                                 float(z)))
+            self._replay.append((np.asarray(obs_t,
+                                            np.float32).reshape(-1),
+                                 pi_t.astype(np.float32), float(z)))
         return total, steps
 
     # -- training -------------------------------------------------------

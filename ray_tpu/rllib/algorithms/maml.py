@@ -66,13 +66,19 @@ class MAMLPolicy(JaxPolicy):
         ent_coeff = float(cfg.get("entropy_coeff", 0.0))
         opt = self.opt
 
+        def norm_adv(adv):
+            # per-task standardization (reference maml postprocessing):
+            # raw GAE advantages on dense-reward envs reach the tens,
+            # and one inner SGD step at that scale destroys the policy
+            return (adv - adv.mean()) / (adv.std() + 1e-8)
+
         def pg_loss(params, batch):
             """Inner objective: vanilla policy gradient + value error
             (the adaptation signal; reference maml_torch_policy inner
             loss)."""
             dist_inputs, vf = model.apply(params, batch[SampleBatch.OBS])
             logp = dist.logp(dist_inputs, batch[SampleBatch.ACTIONS])
-            pg = -jnp.mean(logp * batch[SampleBatch.ADVANTAGES])
+            pg = -jnp.mean(logp * norm_adv(batch[SampleBatch.ADVANTAGES]))
             verr = jnp.mean(
                 (vf - batch[SampleBatch.VALUE_TARGETS]) ** 2)
             return pg + vf_coeff * verr
@@ -91,7 +97,7 @@ class MAMLPolicy(JaxPolicy):
             dist_inputs, vf = model.apply(params, batch[SampleBatch.OBS])
             logp = dist.logp(dist_inputs, batch[SampleBatch.ACTIONS])
             ratio = jnp.exp(logp - batch[SampleBatch.ACTION_LOGP])
-            adv = batch[SampleBatch.ADVANTAGES]
+            adv = norm_adv(batch[SampleBatch.ADVANTAGES])
             surrogate = jnp.minimum(
                 ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
             verr = jnp.mean(
@@ -187,10 +193,27 @@ class MAML(Algorithm):
 
         self._timesteps_total += sum(len(b) for b in pre) + sum(
             len(b) for b in post)
-        pre_rew = float(np.mean(
-            [np.sum(np.asarray(b[SampleBatch.REWARDS])) for b in pre]))
-        post_rew = float(np.mean(
-            [np.sum(np.asarray(b[SampleBatch.REWARDS])) for b in post]))
+        def mean_episode_return(batches):
+            """Mean return over COMPLETED episodes only — fragment-
+            boundary truncations would deflate the metric (and skew
+            adaptation_delta when adaptation changes episode length)."""
+            returns = []
+            for b in batches:
+                rew = np.asarray(b[SampleBatch.REWARDS])
+                done = (np.asarray(b[SampleBatch.TERMINATEDS])
+                        | np.asarray(b[SampleBatch.TRUNCATEDS]))
+                start = 0
+                for i in np.flatnonzero(done):
+                    returns.append(float(rew[start:i + 1].sum()))
+                    start = i + 1
+            if not returns:  # no episode completed within the fragment
+                return float(np.mean(
+                    [np.asarray(b[SampleBatch.REWARDS]).sum()
+                     for b in batches]))
+            return float(np.mean(returns))
+
+        pre_rew = mean_episode_return(pre)
+        post_rew = mean_episode_return(post)
         return {"meta_loss": loss,
                 "pre_adaptation_reward": pre_rew,
                 "post_adaptation_reward": post_rew,

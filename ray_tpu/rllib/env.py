@@ -334,6 +334,40 @@ class PixelCatch:
         return self._obs(), rew, False, False, {}
 
 
+class RepeatPrevEnv:
+    """Reward for repeating the PREVIOUS observation's bit — unsolvable
+    without memory; the standard recurrent-policy benchmark (reference
+    ``rllib/examples/env/repeat_after_me_env.py``)."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.observation_space = Box(0.0, 1.0, (2,), np.float32)
+        self.action_space = Discrete(2)
+        self._rng = np.random.default_rng(int(config.get("seed", 0) or 0))
+        self.episode_len = int(config.get("episode_len", 20))
+
+    def _obs(self):
+        onehot = np.zeros(2, np.float32)
+        onehot[self._bit] = 1.0
+        return onehot
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._bit = int(self._rng.integers(2))
+        self._prev = None
+        self._steps = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        rew = 1.0 if self._prev is not None and int(action) == self._prev \
+            else 0.0
+        self._prev = self._bit
+        self._bit = int(self._rng.integers(2))
+        self._steps += 1
+        return self._obs(), rew, False, self._steps >= self.episode_len, {}
+
+
 class TaskSettableEnv:
     """Meta-RL task interface (reference
     ``rllib/env/apis/task_settable_env.py``): an env family indexed by a
@@ -433,6 +467,7 @@ _ENV_REGISTRY: Dict[str, Any] = {
     "ContextBandit": ContextBandit,
     "CartPoleMass": CartPoleMass,
     "PendulumMass": PendulumMass,
+    "RepeatPrevEnv": RepeatPrevEnv,
 }
 
 
