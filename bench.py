@@ -310,20 +310,27 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
 
         @ray_tpu.remote(num_cpus=0)
         class Putter:
-            def put_big(self, mb):
+            """The reference's multi-client put bench allocates each
+            client's array ONCE outside the timed loop; timing a fresh
+            64 MiB np.zeros per put would measure page faults, not the
+            store."""
+
+            def __init__(self, mb):
                 import numpy as _np
+                self.data = _np.ones(mb * 1024 * 1024, dtype=_np.uint8)
 
+            def put_big(self, reps):
                 import ray_tpu as _rt
-                data = _np.zeros(mb * 1024 * 1024, dtype=_np.uint8)
-                _rt.put(data)
-                return mb
+                for _ in range(reps):
+                    _rt.put(self.data)
+                return reps
 
-        putters = [Putter.remote() for _ in range(4)]
-        ray_tpu.get([p.put_big.remote(1) for p in putters], timeout=60)
+        putters = [Putter.remote(64) for _ in range(4)]
+        ray_tpu.get([p.put_big.remote(1) for p in putters], timeout=120)
         t0 = time.perf_counter()
-        ray_tpu.get([p.put_big.remote(64) for p in putters],
+        ray_tpu.get([p.put_big.remote(2) for p in putters],
                     timeout=budget_s)
-        out["put_gbps_multi_client"] = 4 * gbits / (
+        out["put_gbps_multi_client"] = 4 * 2 * gbits / (
             time.perf_counter() - t0)
 
         # -- placement groups -----------------------------------------
@@ -422,6 +429,71 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
     return out
 
 
+#: every BASELINE.md row this harness measures -> the reference number
+#: (all rows get a ``vs_ref_<row>`` ratio so LOSING rows are visible in
+#: the artifact itself, not only by cross-reading BASELINE.md)
+REFERENCE_ROWS = {
+    "tasks_per_sec_sync": 1294.0,
+    "tasks_per_sec_async": 10905.0,
+    "multi_client_tasks_per_sec_async": 32133.0,
+    "actor_calls_per_sec_sync": 2182.0,
+    "actor_calls_per_sec_async": 5770.0,
+    "n_n_actor_calls_per_sec_async": 35152.0,
+    "put_small_per_sec": 5893.0,
+    "get_small_per_sec": 5877.0,
+    "put_gbps_single_client": 19.2,
+    "put_gbps_multi_client": 38.4,
+    "pg_create_remove_per_sec": 1016.0,
+    "many_tasks_per_sec_4node": 27.1,
+    "many_actors_per_sec_4node": 600.4,
+    "many_pgs_per_sec_4node": 16.8,
+}
+
+
+def annotate_vs_ref(details: dict) -> None:
+    for key, ref in REFERENCE_ROWS.items():
+        value = details.get(key)
+        if isinstance(value, (int, float)):
+            details[f"vs_ref_{key}"] = round(value / ref, 4)
+
+
+def annotate_vs_prev(details: dict) -> None:
+    """Round-over-round regression guard: ``vs_prev_<row>`` ratios against
+    the newest ``BENCH_r*.json`` artifact, plus a ``regressions_vs_prev``
+    list naming every row that lost >20% (the many_pgs 35% regression in
+    r03 went unnoticed because nothing watched the deltas)."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    arts = sorted(
+        glob.glob(os.path.join(here, "BENCH_r*.json")),
+        key=lambda p: int(
+            re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    if not arts:
+        return
+    try:
+        with open(arts[-1]) as f:
+            prev = json.load(f).get("parsed", {}).get("details", {})
+    except Exception:  # noqa: BLE001 — guard must not break the bench
+        return
+    regressions = []
+    for key, value in list(details.items()):
+        if key.startswith("vs_") or not isinstance(value, (int, float)):
+            continue
+        prev_val = prev.get(key)
+        if not isinstance(prev_val, (int, float)) or prev_val <= 0:
+            continue
+        ratio = value / prev_val
+        details[f"vs_prev_{key}"] = round(ratio, 4)
+        # only throughput-style rows count as regressions (higher=better)
+        if ratio < 0.8 and ("per_sec" in key or "gbps" in key
+                            or "per_chip" in key or key == "mfu"):
+            regressions.append(key)
+    if regressions:
+        details["regressions_vs_prev"] = regressions
+
+
 def main() -> None:
     model_stats = bench_gpt2()
     details = dict(model_stats)
@@ -433,6 +505,8 @@ def main() -> None:
         details.update(bench_runtime_tasks())
         details.update(bench_cluster_scale())
         details.update(bench_rllib_ppo())
+    annotate_vs_ref(details)
+    annotate_vs_prev(details)
     result = {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
         "value": round(model_stats["tokens_per_sec_per_chip"], 2),

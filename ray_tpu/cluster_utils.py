@@ -146,11 +146,19 @@ class Cluster:
             config=self.config,
         )
 
-    def wait_for_nodes(self, timeout: float = 30.0) -> None:
-        """Block until every spawned node is alive in the GCS view."""
+    def wait_for_nodes(self, timeout: Optional[float] = None) -> None:
+        """Block until every spawned node is alive in the GCS view.
+
+        The default timeout scales with cluster size: each "node" is a
+        full python process tree (raylet + zygote + prestart workers),
+        and on a loaded 1-core host a fixed 30 s flaked for 4-node
+        chaos clusters (the reference's fixtures wait far longer,
+        ``cluster_utils.py:165``)."""
         import ray_tpu
 
         expected = 1 + len(self.worker_nodes)
+        if timeout is None:
+            timeout = 30.0 + 30.0 * expected
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             alive = [n for n in ray_tpu.nodes() if n["alive"]]

@@ -129,6 +129,9 @@ class GcsServer:
         self._actor_creation_locks: Dict[ActorID, asyncio.Lock] = {}
         # node -> unresolved lease_worker_for_actor calls (burst spread)
         self._actor_lease_inflight: Dict[NodeID, int] = {}
+        # actor_id -> NodeID charged above (held until actor_started /
+        # creation_failed so still-initializing actors keep counting)
+        self._actor_lease_charges: Dict[ActorID, NodeID] = {}
         self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
         # (name, sorted-tags) -> aggregated metric record
         self._metrics: Dict[Any, Dict[str, Any]] = {}
@@ -715,9 +718,14 @@ class GcsServer:
                 # node looked least loaded at the last beat; counting
                 # our own unresolved leases spreads the burst across
                 # raylets (parity: GcsActorScheduler's inflight
-                # bookkeeping, gcs_actor_scheduler.cc:49)
-                self._actor_lease_inflight[node.node_id] = \
-                    self._actor_lease_inflight.get(node.node_id, 0) + 1
+                # bookkeeping, gcs_actor_scheduler.cc:49).  The charge is
+                # held until the actor actually STARTS (actor_started /
+                # creation_failed), not merely until the lease RPC
+                # returns — a granted-but-still-initializing actor
+                # occupies no beat-reported load, so releasing at RPC
+                # return erased the spread benefit for bursts larger
+                # than the grant-latency window.
+                self._charge_actor_lease(info.actor_id, node.node_id)
                 try:
                     conn = await self.pool.get(node.raylet_address)
                     reply = await conn.call(
@@ -735,15 +743,11 @@ class GcsServer:
                 except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError) as e:
                     logger.warning("actor lease on %s failed: %s",
                                    node.node_id.hex()[:12], e)
+                    self._release_actor_lease_charge(info.actor_id)
                     await asyncio.sleep(0.2)
                     continue
-                finally:
-                    n_in = self._actor_lease_inflight.get(node.node_id, 1)
-                    if n_in <= 1:
-                        self._actor_lease_inflight.pop(node.node_id, None)
-                    else:
-                        self._actor_lease_inflight[node.node_id] = n_in - 1
                 if not reply.get("granted"):
+                    self._release_actor_lease_charge(info.actor_id)
                     await asyncio.sleep(0.1)
                     continue
                 if info.state == ACTOR_DEAD:
@@ -751,6 +755,7 @@ class GcsServer:
                     # resurrect.  pg-bound workers are reaped by bundle
                     # revocation; plain actors need an explicit kill or
                     # the leased worker (and its resources) leak
+                    self._release_actor_lease_charge(info.actor_id)
                     try:
                         worker_conn = await self.pool.get(
                             tuple(reply["worker_task_address"]))
@@ -765,9 +770,27 @@ class GcsServer:
                 info.state = ACTOR_ALIVE
                 self._publish_actor(info)
                 return
+            self._release_actor_lease_charge(info.actor_id)
             info.state = ACTOR_DEAD
             info.death_cause = "creation timed out: no feasible node"
             self._publish_actor(info)
+
+    def _charge_actor_lease(self, actor_id: ActorID,
+                            node_id: NodeID) -> None:
+        self._release_actor_lease_charge(actor_id)  # re-schedule safety
+        self._actor_lease_charges[actor_id] = node_id
+        self._actor_lease_inflight[node_id] = \
+            self._actor_lease_inflight.get(node_id, 0) + 1
+
+    def _release_actor_lease_charge(self, actor_id: ActorID) -> None:
+        node_id = self._actor_lease_charges.pop(actor_id, None)
+        if node_id is None:
+            return
+        n_in = self._actor_lease_inflight.get(node_id, 1)
+        if n_in <= 1:
+            self._actor_lease_inflight.pop(node_id, None)
+        else:
+            self._actor_lease_inflight[node_id] = n_in - 1
 
     def _pick_node(self, resources: Dict[str, float],
                    required_node: Optional[NodeID] = None) -> Optional[NodeInfo]:
@@ -793,6 +816,7 @@ class GcsServer:
         """The actor worker reports in after executing its creation task."""
         actor_id = ActorID(data["actor_id"])
         conn.context["actor_id"] = actor_id
+        self._release_actor_lease_charge(actor_id)
         info = self.actors.get(actor_id)
         if info is None:
             return False
@@ -848,6 +872,7 @@ class GcsServer:
 
     def _on_actor_worker_lost(self, actor_id: ActorID, reason: str,
                               allow_restart: bool = True) -> None:
+        self._release_actor_lease_charge(actor_id)
         info = self.actors.get(actor_id)
         if info is None or info.state == ACTOR_DEAD:
             return

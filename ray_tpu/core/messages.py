@@ -69,6 +69,7 @@ register_schema("health_report", node_id=bytes, resources_available=dict)
 
 # leases / scheduling
 register_schema("request_worker_lease", resources=dict)
+register_schema("cancel_lease", token=str)
 register_schema("return_worker", worker_id=bytes)
 register_schema("lease_worker_for_actor", actor_id=bytes, resources=dict,
                 spec_blob=bytes)
@@ -78,6 +79,7 @@ register_schema("push_task", spec_blob=bytes)
 register_schema("push_tasks", specs_blob=bytes)
 register_schema("create_actor", spec_blob=bytes)
 register_schema("push_actor_task", spec_blob=bytes)
+register_schema("push_actor_tasks", specs_blob=bytes)
 register_schema("register_actor", actor_id=bytes, spec_blob=bytes,
                 resources=dict, job_id=bytes)
 register_schema("actor_started", actor_id=bytes, task_address=None)

@@ -37,6 +37,67 @@ class SharedMemoryStore:
         self.capacity = capacity
         self._mm = _map_file(path, capacity)
         self._view = memoryview(self._mm)
+        # Pre-fault the arena in the background: tmpfs pages materialize
+        # on FIRST touch, which otherwise lands in some client's timed
+        # copy (first-touch faults halved large-put bandwidth).  Faulted
+        # once here, every process mapping the file takes only cheap
+        # minor faults (parity motivation: plasma pre-allocates its shm
+        # pool via dlmalloc at store boot).
+        self._closed = False
+        self._prefault_thread = threading.Thread(
+            target=self._prefault, name="rtpu-prefault", daemon=True)
+        self._prefault_thread.start()
+
+    #: prefault at most this much (first-fit allocation reuses the low
+    #: arena, so the head of the file is where puts land), in small
+    #: chunks at a <=20% duty cycle, starting only after the boot
+    #: window: populating a multi-GB arena flat-out starved a 1-core
+    #: host long enough to trip cluster health checks
+    _PREFAULT_CAP = 2 * 1024 ** 3
+    _PREFAULT_CHUNK = 64 * 1024 * 1024
+    _PREFAULT_DELAY_S = 10.0
+
+    def _prefault(self) -> None:
+        import time as time_mod
+
+        # sleep through node bring-up (the CPU-contended window), in
+        # small slices so close() never waits long on the join
+        deadline = time_mod.monotonic() + self._PREFAULT_DELAY_S
+        while time_mod.monotonic() < deadline:
+            if self._closed:
+                return
+            time_mod.sleep(0.2)
+        try:
+            # MADV_POPULATE_WRITE (=23, Linux 5.14+; the mmap module
+            # doesn't expose the constant yet, so call madvise
+            # directly).  It only materializes pages — never alters
+            # content — so it is safe alongside live allocations.
+            arr = ctypes.c_char.from_buffer(self._mm)
+            try:
+                libc = ctypes.CDLL(None, use_errno=True)
+                base = ctypes.addressof(arr)
+                # populated pages are COMMITTED tmpfs RAM whether or not
+                # the arena is ever used — bound by what the host can
+                # spare (multi-node test clusters run many stores on one
+                # box), not just the flat cap
+                total = min(self.capacity, self._PREFAULT_CAP,
+                            _mem_available() // 8)
+                for off in range(0, total, self._PREFAULT_CHUNK):
+                    if self._closed:
+                        return
+                    n = min(self._PREFAULT_CHUNK, total - off)
+                    t0 = time_mod.monotonic()
+                    if libc.madvise(ctypes.c_void_p(base + off),
+                                    ctypes.c_size_t(n), 23) != 0:
+                        return  # unsupported kernel: stay lazy
+                    # <=20% duty cycle: page population is kernel-side
+                    # CPU burn that would otherwise starve event loops
+                    # on small hosts
+                    time_mod.sleep(4 * (time_mod.monotonic() - t0) + 0.01)
+            finally:
+                del arr  # release the buffer export before any close()
+        except (IndexError, ValueError, OSError):
+            pass  # store closed mid-prefault (or madvise unsupported)
 
     # -- producer side ----------------------------------------------------
     def alloc(self, object_id: ObjectID, size: int) -> Tuple[int, memoryview]:
@@ -121,8 +182,15 @@ class SharedMemoryStore:
 
     def close(self) -> None:
         if self._handle:
+            self._closed = True
+            # the prefault thread holds a buffer export on the mmap; let
+            # it notice _closed and drop it (chunks are sub-second)
+            self._prefault_thread.join(timeout=2.0)
             self._view.release()
-            self._mm.close()
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # prefault export still live; process teardown
             self._lib.rtpu_store_destroy(self._handle)
             self._handle = None
             try:
@@ -155,6 +223,17 @@ class StoreClient:
             # user code still holds zero-copy arrays over the mapping; the
             # mapping lives until those buffers are garbage collected
             pass
+
+
+def _mem_available() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return 2 * 1024 ** 3  # unknown: assume a small host
 
 
 def _map_file(path: str, capacity: int) -> mmap.mmap:

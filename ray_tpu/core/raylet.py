@@ -69,6 +69,10 @@ class WorkerHandle:
     lease_tpu_ids: List[int] = field(default_factory=list)
     lease_tpu_share: float = 0.0
     is_actor: bool = False
+    #: connection of the client holding the current lease (reclaim pushes)
+    owner_conn: Optional[rpc.Connection] = None
+    #: monotonic time this worker joined the idle pool (pool trimming)
+    idle_since: float = 0.0
 
 
 class _ForkedProc:
@@ -178,6 +182,11 @@ class PendingLease:
     env_spawn: Optional[Dict[str, Any]] = None
     retriable: bool = True
     enqueued_at: float = field(default_factory=time.monotonic)
+    #: client-generated id so the owner can cancel a request whose
+    #: backlog drained before the grant (stale grants churned workers
+    #: through grant->instant-return cycles, delaying real demand)
+    token: Optional[str] = None
+    conn: Optional[rpc.Connection] = None
 
 
 class Raylet:
@@ -257,6 +266,8 @@ class Raylet:
         # total demand <= chip count)
         self._tpu_load: Dict[int, float] = {
             i: 0.0 for i in range(int(self.resources_total.get("TPU", 0)))}
+        # rate limiter for reclaim_idle nudges under pool-cap contention
+        self._last_reclaim_push = 0.0
         # log monitor state: file path -> (offset, pid)
         self._log_pids: Dict[str, int] = {}
         self._log_offsets: Dict[str, int] = {}
@@ -637,20 +648,36 @@ class Raylet:
                     logger.warning("worker pid %d died before registering "
                                    "(exit %d)", proc.pid, proc.returncode)
                     self._maybe_schedule()
+            # trim the idle pool back to the prestart watermark: demand
+            # from many distinct clients can grow it past the per-core
+            # cap (see cap_bonus in _maybe_schedule); workers idle >10 s
+            # are surplus
+            watermark = getattr(self, "_prestart_watermark", 0)
+            now = time.monotonic()
+            while len(self._idle) > watermark and self._cull_idle_spare(
+                    lambda w: now - w.idle_since > 10.0):
+                pass
             await asyncio.sleep(0.2)
 
     # ------------------------------------------------------------------
     # worker pool
     # ------------------------------------------------------------------
     def _start_worker(self, job_id_bin: Optional[bytes],
-                      needs_tpu: bool = False) -> bool:
-        """Returns False when the pool cap declines the spawn."""
+                      needs_tpu: bool = False, cap_bonus: int = 0) -> bool:
+        """Returns False when the pool cap declines the spawn.
+
+        ``cap_bonus`` lets demand from DISTINCT clients grow the pool past
+        the per-core cap: leases are exclusive per client, so on a
+        low-core host N concurrent clients would otherwise serialize
+        behind worker handoffs even for CPU:0 work (the 1->8-client
+        scaling collapse).  Bounded in _maybe_schedule.
+        """
         # the cap bounds the *task pool*; workers holding actors live
         # outside it (parity: reference WorkerPool — actor workers are
         # dedicated, else a few CPU:0 actors starve all task execution)
         pool_size = self._starting + sum(
             1 for w in self.workers.values() if not w.is_actor)
-        if pool_size >= self._max_workers:
+        if pool_size >= self._max_workers + cap_bonus:
             return False
         self._starting += 1
         if needs_tpu:
@@ -886,8 +913,12 @@ class Raylet:
         reg_token = data.get("spawn_token")
         for entry in list(self._spawned_procs):
             proc, tpu_capable, was_tpu_spawn, token = entry
-            if (reg_token is not None and token == reg_token) \
-                    or proc.pid == worker.pid:
+            # with a spawn token, match on it EXCLUSIVELY: a container
+            # worker's namespaced pid can collide with an unrelated
+            # pending proc entry, mis-adopting the handle and corrupting
+            # the _starting accounting
+            if (token == reg_token) if reg_token is not None \
+                    else (proc.pid == worker.pid):
                 worker.proc = proc
                 worker.tpu_capable = tpu_capable
                 self._spawned_procs.remove(entry)
@@ -902,6 +933,7 @@ class Raylet:
         self._dec_starting_env(reg_token)
         conn.context["worker_id"] = worker.worker_id
         self.workers[worker.worker_id] = worker
+        worker.idle_since = time.monotonic()
         self._idle.append(worker)
         self._maybe_schedule()
         return {"node_id": self.node_id.binary(),
@@ -1012,9 +1044,23 @@ class Raylet:
             resources=resources, bundle=bundle,
             env_hash=data.get("env_hash"),
             env_spawn=data.get("env_spawn"),
-            retriable=bool(data.get("retriable", True))))
+            retriable=bool(data.get("retriable", True)),
+            token=data.get("token"), conn=conn))
         self._maybe_schedule()
         return await fut
+
+    async def handle_cancel_lease(self, conn, data):
+        """The owner's backlog drained before the grant: drop the queued
+        request so a later grant doesn't churn a worker through a
+        grant->instant-return cycle while real demand waits."""
+        token = data.get("token")
+        for i, lease in enumerate(self._pending_leases):
+            if lease.token == token and lease.token is not None:
+                del self._pending_leases[i]
+                if not lease.future.done():
+                    lease.future.set_result({"canceled": True})
+                return True
+        return False
 
     def _resolve_bundle(self, bundle: Tuple[bytes, int],
                         resources: Dict[str, float]
@@ -1132,6 +1178,7 @@ class Raylet:
             return
         remaining: List[PendingLease] = []
         want_workers: List[Tuple[Optional[bytes], bool]] = []
+        grants: List[Tuple[PendingLease, WorkerHandle]] = []
         for lease in self._pending_leases:
             if lease.future.done():
                 continue
@@ -1175,7 +1222,8 @@ class Raylet:
                         self._start_env_worker(lease)
                     continue
                 remaining.append(lease)
-                want_workers.append((lease.job_id_bin, needs_tpu))
+                want_workers.append((lease.job_id_bin, needs_tpu,
+                                     id(lease.conn)))
                 continue
             self._take(lease.resources, lease.bundle)
             worker.leased = True
@@ -1183,15 +1231,27 @@ class Raylet:
             worker.lease_bundle = lease.bundle
             worker.lease_retriable = lease.retriable
             worker.lease_granted_at = time.monotonic()
+            worker.owner_conn = lease.conn
             if lease.env_hash is not None:
                 worker.env_hash = lease.env_hash
             self._assign_tpu_ids(worker, lease.resources.get("TPU", 0.0))
+            grants.append((lease, worker))
+        self._pending_leases = remaining
+        # Grants resolve AFTER the pass so each reply can carry an exact
+        # contention signal: demand is still queued, so the owner should
+        # hand the worker back the moment it idles instead of holding it
+        # through the idle-lease grace (the grace exists for lease reuse
+        # on sync-style submit patterns; under contention it serialized
+        # every worker handoff behind a 250 ms timer — the 1->8-client
+        # scaling collapse).
+        contended = bool(remaining)
+        for lease, worker in grants:
             lease.future.set_result({
                 "granted": True,
                 "worker_address": worker.task_address,
                 "worker_id": worker.worker_id.binary(),
+                "contended": contended,
             })
-        self._pending_leases = remaining
         # Spawn exactly enough workers to cover unmet (schedulable) demand —
         # one per waiting lease, minus those already starting (parity:
         # WorkerPool::PrestartWorkers demand accounting).  TPU demand is
@@ -1201,16 +1261,28 @@ class Raylet:
         plain_wait = [x for x in want_workers if not x[1]]
         tpu_wait = [x for x in want_workers if x[1]]
         starting_plain = self._starting - self._starting_tpu
-        for job_id_bin, _ in plain_wait[starting_plain:]:
-            self._start_worker(job_id_bin, False)
-        for job_id_bin, _ in tpu_wait[self._starting_tpu:]:
-            if not self._start_worker(job_id_bin, True):
+        # Leases are exclusive per client: grow the pool past the
+        # per-core cap by one worker per DISTINCT waiting client (total
+        # pool hard-bounded at 4x the cap), else N clients on a low-core
+        # host serialize behind worker handoffs even at constant total
+        # work.  Idle trimming in _reap_loop shrinks the pool back.
+        cap_bonus = min(len({x[2] for x in want_workers}),
+                        3 * self._max_workers)
+        spawn_declined = False
+        for job_id_bin, _, _conn in plain_wait[starting_plain:]:
+            if not self._start_worker(job_id_bin, False,
+                                      cap_bonus=cap_bonus):
+                spawn_declined = True
+        for job_id_bin, _, _conn in tpu_wait[self._starting_tpu:]:
+            if not self._start_worker(job_id_bin, True,
+                                      cap_bonus=cap_bonus):
                 # pool cap reached while idle PLAIN spares occupy it —
                 # those can never serve a needs_tpu lease (eligible()
                 # rejects them), so evict one to make room or the lease
                 # deadlocks behind its own refill spares
                 if self._cull_idle_spare(lambda w: not w.tpu_capable):
-                    self._start_worker(job_id_bin, True)
+                    self._start_worker(job_id_bin, True,
+                                       cap_bonus=cap_bonus)
         # anticipatory refill: actors claim pool workers permanently, so
         # creation storms drain the idle pool — respawn spares in the
         # background up to the prestart watermark (bounded by the pool
@@ -1223,6 +1295,23 @@ class Raylet:
                 - len(self._idle) - self._starting
             for _ in range(refill):
                 self._start_worker(None)
+        elif spawn_declined and not self._idle:
+            # Demand is queued, the pool is at cap, and nothing is idle:
+            # every grantable worker is leased to some owner.  Ask the
+            # owners to hand back workers that are merely lingering in
+            # their idle-lease grace (covers leases granted BEFORE the
+            # contention arose, which the per-grant contended flag can't
+            # reach).  Rate-limited: one nudge per grace-ish window.
+            now = time.monotonic()
+            if now - self._last_reclaim_push >= 0.02:
+                self._last_reclaim_push = now
+                nudged = set()
+                for w in self.workers.values():
+                    conn = w.owner_conn
+                    if (w.leased and not w.is_actor and conn is not None
+                            and not conn.closed and id(conn) not in nudged):
+                        nudged.add(id(conn))
+                        conn.push("reclaim_idle", {})
 
     def _cull_idle_spare(self, predicate) -> bool:
         """Evict one idle worker matching ``predicate`` to free pool
@@ -1288,6 +1377,7 @@ class Raylet:
             worker.job_id_bin = data["job_id"]
         self._release_lease_resources(worker)
         if not data.get("disconnect", False):
+            worker.idle_since = time.monotonic()
             self._idle.append(worker)
         self._maybe_schedule()
         return True
@@ -1314,6 +1404,7 @@ class Raylet:
         if worker.leased:
             self._give(worker.lease_resources, worker.lease_bundle)
             worker.leased = False
+            worker.owner_conn = None
             worker.lease_resources = {}
             worker.lease_bundle = None
             if worker.lease_tpu_ids:
@@ -1369,6 +1460,7 @@ class Raylet:
         if not result.get("ok"):
             # creation raised in user code: actor is dead on arrival
             self._release_lease_resources(worker)
+            worker.idle_since = time.monotonic()
             self._idle.append(worker)
             worker.is_actor = False
             return {"granted": False, "reason": result.get("error", "unknown"),

@@ -289,14 +289,19 @@ class TaskManager:
                 yield arg.object_id
             yield from arg.contained_ids
 
-    def register(self, spec: TaskSpec) -> None:
-        for ret in spec.return_ids():
+    def register(self, spec: TaskSpec) -> List[ObjectID]:
+        """Registers the flight; returns the return ids so the submitter
+        can build its ObjectRefs without recomputing them (they cost one
+        hash construction each on the hot path)."""
+        rets = spec.return_ids()
+        for ret in rets:
             self._rc.add_owned(ret, producing_task=spec.task_id)
         for oid in self._arg_ids(spec):
             self._rc.add_submitted_ref(oid)
         with self._lock:
             self._pending[spec.task_id] = PendingTask(
                 spec=spec, retries_left=spec.max_retries)
+        return rets
 
     def is_pending(self, task_id: TaskID) -> bool:
         with self._lock:

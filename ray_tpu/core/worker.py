@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import hashlib
+import itertools
 import logging
 import os
 import pickle
@@ -229,6 +230,7 @@ class CoreWorker:
         self._loop_thread.start()
 
         self._ctx = _TaskContext()
+        self._address_cache: Optional[OwnerAddress] = None
         self.job_id = job_id
         self._driver_task_id: Optional[TaskID] = None
         self._object_events: Dict[ObjectID, asyncio.Event] = {}
@@ -251,6 +253,7 @@ class CoreWorker:
         # submitters
         self._lease_states: Dict[Tuple, "_LeaseState"] = {}
         self._actor_states: Dict[ActorID, "_ActorSubmitState"] = {}
+        self._lease_tokens = itertools.count(1)
         # head fault tolerance (driver): frozen while the local raylet is
         # unreachable; _reattach_raylet thaws it
         self._raylet_down = False
@@ -285,6 +288,8 @@ class CoreWorker:
         # batched pushes stream per-task results back; this maps
         # task_id -> (spec, lease state, worker) until settled
         self._streamed: Dict[bytes, tuple] = {}
+        # same for batched actor pushes: (task_id, attempt) -> (spec, state)
+        self._actor_streamed: Dict[tuple, tuple] = {}
 
         self._run(self._async_init())
         set_global_worker(self)
@@ -308,6 +313,10 @@ class CoreWorker:
     async def _async_init(self) -> None:
         self.task_server = rpc.Server(self, host="127.0.0.1", port=0)
         self.task_address = await self.task_server.start()
+        # pooled conns (remote raylets, peer workers) carry our handler so
+        # those peers can push back (e.g. reclaim_idle from a spillback
+        # raylet, task_results from leased workers)
+        self._pool._handler = self.task_server
         # outbound connections carry our handler too, so the raylet/GCS can
         # call back into this worker over the registration link (e.g.
         # create_actor pushes)
@@ -550,8 +559,14 @@ class CoreWorker:
 
     @property
     def address(self) -> OwnerAddress:
-        return (self.node_id.hex(), self.task_address[0], self.task_address[1],
-                self.worker_id.hex())
+        # cached: read 2+ times per submitted task, invariant after init
+        addr = self._address_cache
+        if addr is None or addr[1] != self.task_address[0] \
+                or addr[2] != self.task_address[1]:
+            addr = (self.node_id.hex(), self.task_address[0],
+                    self.task_address[1], self.worker_id.hex())
+            self._address_cache = addr
+        return addr
 
     def shutdown(self) -> None:
         if self._shutdown:
@@ -1113,9 +1128,9 @@ class CoreWorker:
             runtime_env_hash=_renv_hash(runtime_env),
             trace_context=_trace_carrier(),
         )
-        self.task_manager.register(spec)
+        rets = self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
-        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        refs = [ObjectRef(oid, self.address) for oid in rets]
         self._submit_to_lease_queue(spec)
         return refs
 
@@ -1247,14 +1262,41 @@ class CoreWorker:
                 task.add_done_callback(lambda t: t.exception())
         # Phase 4 — arm a return timer on every lease left idle, so leased
         # resources flow back to the raylet for other scheduling keys
-        # (leaked leases deadlock the node once CPUs are exhausted)
+        # (leaked leases deadlock the node once CPUs are exhausted).
+        # Contended leases (other demand queued at the raylet when they
+        # were granted) skip the grace and return the instant they idle —
+        # the grace serialized every cross-client handoff behind a 250 ms
+        # timer, collapsing multi-client throughput 25x.
         if not state.backlog:
             for worker in list(state.workers.values()):
-                if worker.inflight == 0 and worker.return_handle is None:
+                if worker.inflight != 0:
+                    continue
+                if worker.contended:
+                    self._return_lease_now(state, worker)
+                elif worker.return_handle is None:
                     worker.return_handle = self._loop.call_later(
                         self.config.idle_worker_lease_timeout_s,
                         lambda w=worker, s=state: self._loop.create_task(
                             self._return_lease(s, w)))
+            # outstanding lease requests serve no one now: cancel them so
+            # the raylet doesn't churn workers through stale grants while
+            # other clients' demand waits.  Popped here so repeated pumps
+            # with an empty backlog don't re-fire the same cancels (the
+            # request chain's ``finally`` tolerates the early pop).
+            while state.inflight_requests:
+                token, address = state.inflight_requests.popitem()
+                task = self._loop.create_task(
+                    self._cancel_lease_request(token, address))
+                task.add_done_callback(lambda t: t.exception())
+
+    async def _cancel_lease_request(self, token: str,
+                                    address: rpc.Address) -> None:
+        try:
+            conn = self.raylet_conn if address == self.raylet_address \
+                else await self._pool.get(address)
+            await conn.call("cancel_lease", {"token": token})
+        except (rpc.ConnectionLost, rpc.RpcError, OSError):
+            pass  # best-effort; the request chain handles its own errors
 
     def _dispatch_to_worker(self, state: "_LeaseState",
                             worker: "_LeasedWorker") -> None:
@@ -1266,17 +1308,22 @@ class CoreWorker:
     async def _request_lease(self, state: "_LeaseState") -> None:
         """One lease acquisition (follows spillback redirects); holds one
         ``state.requesting`` slot for its whole lifetime."""
+        token = f"{self.worker_id.hex()[:12]}:{next(self._lease_tokens)}"
         try:
-            await self._request_lease_chain(state, self.raylet_address)
+            await self._request_lease_chain(state, self.raylet_address,
+                                            token)
         finally:
             state.requesting -= 1
+            state.inflight_requests.pop(token, None)
             self._pump_lease_queue(state)
 
     async def _request_lease_chain(self, state: "_LeaseState",
-                                   raylet_address: rpc.Address) -> None:
+                                   raylet_address: rpc.Address,
+                                   token: str) -> None:
         spec = state.backlog[0] if state.backlog else None
         if spec is None:
             return
+        state.inflight_requests[token] = raylet_address
         try:
             conn = self.raylet_conn if raylet_address == self.raylet_address \
                 else await self._pool.get(raylet_address)
@@ -1293,6 +1340,7 @@ class CoreWorker:
                 "env_hash": spec.runtime_env_hash,
                 "env_spawn": _renv_spawn(spec.runtime_env),
                 "retriable": spec.max_retries > 0,
+                "token": token,
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             if raylet_address == self.raylet_address and \
@@ -1320,8 +1368,11 @@ class CoreWorker:
                 f"lease request failed: {e}"))
             return
         if reply.get("spillback"):
-            await self._request_lease_chain(state, tuple(reply["spillback"]))
+            await self._request_lease_chain(state, tuple(reply["spillback"]),
+                                            token)
             return
+        if reply.get("canceled"):
+            return  # our own cancel_lease (backlog drained first)
         if reply.get("error"):
             self._fail_backlog(state, RayTpuError(reply["error"]))
             return
@@ -1330,6 +1381,7 @@ class CoreWorker:
                 worker_id=WorkerID(reply["worker_id"]),
                 address=tuple(reply["worker_address"]),
                 raylet=raylet_address,
+                contended=bool(reply.get("contended")),
             )
             state.workers[worker.worker_id] = worker
 
@@ -1420,6 +1472,20 @@ class CoreWorker:
         self._pump_lease_queue(state)
 
     def _on_worker_push(self, channel: str, data: Any) -> None:
+        if channel == "actor_task_results":
+            for task_id_bin, attempt, reply in data:
+                entry = self._actor_streamed.pop((task_id_bin, attempt),
+                                                 None)
+                if entry is None:
+                    continue  # a stale attempt's late push
+                spec, state = entry
+                state.pending.pop(spec.sequence_number, None)
+                if reply.get("actor_dead"):
+                    self._fail_task(spec, ActorDiedError(
+                        spec.actor_id.hex()[:12], reply.get("reason", "")))
+                else:
+                    self._handle_task_reply(spec, reply)
+            return
         if channel != "task_results":
             return
         items = data
@@ -1440,7 +1506,23 @@ class CoreWorker:
         if worker.inflight > 0 or state.backlog:
             worker.return_handle = None
             return
-        state.workers.pop(worker.worker_id, None)
+        if state.workers.pop(worker.worker_id, None) is None:
+            return  # already returned (reclaim/contended path)
+        await self._send_return_worker(worker)
+
+    def _return_lease_now(self, state: "_LeaseState",
+                          worker: "_LeasedWorker") -> None:
+        """Synchronously detach the lease and return it (no idle grace);
+        the pop-before-RPC makes double-scheduling harmless."""
+        if worker.return_handle is not None:
+            worker.return_handle.cancel()
+            worker.return_handle = None
+        if state.workers.pop(worker.worker_id, None) is None:
+            return
+        task = self._loop.create_task(self._send_return_worker(worker))
+        task.add_done_callback(lambda t: t.exception())
+
+    async def _send_return_worker(self, worker: "_LeasedWorker") -> None:
         try:
             conn = self.raylet_conn if worker.raylet == self.raylet_address \
                 else await self._pool.get(worker.raylet)
@@ -1450,6 +1532,16 @@ class CoreWorker:
             })
         except (rpc.ConnectionLost, rpc.RpcError):
             pass
+
+    def push_reclaim_idle(self, conn, data) -> None:
+        """Raylet nudge: demand is queued there and the pool is at cap —
+        hand back any lease this client is merely keeping warm."""
+        for state in self._lease_states.values():
+            if state.backlog:
+                continue
+            for worker in list(state.workers.values()):
+                if worker.inflight == 0:
+                    self._return_lease_now(state, worker)
 
     def _handle_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
         if reply.get("system_error"):
@@ -1604,9 +1696,9 @@ class CoreWorker:
             actor_id=actor_id,
             trace_context=_trace_carrier(),
         )
-        self.task_manager.register(spec)
+        rets = self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
-        refs = [ObjectRef(oid, self.address) for oid in spec.return_ids()]
+        refs = [ObjectRef(oid, self.address) for oid in rets]
         # same batched loop-wakeup path as normal tasks; FIFO drain keeps
         # per-actor sequence-number order equal to submission order
         self._submit_to_lease_queue(spec)
@@ -1630,8 +1722,16 @@ class CoreWorker:
         """Drain the per-actor submit queue, initiating the RPC writes in
         sequence-number order (parity: ``SequentialActorSubmitQueue``).  The
         write happens synchronously via ``start_call`` so frames hit the TCP
-        stream in order; replies resolve concurrently (pipelined)."""
+        stream in order; replies resolve concurrently (pipelined).
+
+        Queued runs ship as ONE batched frame (``push_actor_tasks``) whose
+        results stream back per task — framing + dispatch dominated
+        per-call cost on n:n call storms.  A lone call keeps the
+        single-frame path (no streaming machinery on the latency path)."""
         while state.queue:
+            # pop BEFORE any await: a retry re-sort during the await can
+            # put a different spec at queue[0], and a peek-then-pop
+            # would settle one spec twice while dropping the other
             spec = state.queue.popleft()
             try:
                 address = await self._resolve_actor_address(state)
@@ -1644,6 +1744,12 @@ class CoreWorker:
                 state.address = None
                 await self._retry_or_fail_actor_task(state, spec,
                                                      "connect failed")
+                continue
+            if state.queue:
+                batch: List[TaskSpec] = [spec]
+                while state.queue and len(batch) < 64:
+                    batch.append(state.queue.popleft())
+                self._send_actor_batch(state, batch, address, conn)
                 continue
             self._record_task_event(spec, "RUNNING")
             try:
@@ -1658,6 +1764,58 @@ class CoreWorker:
             waiter = self._loop.create_task(
                 self._await_actor_reply(state, spec, address, reply_fut))
             waiter.add_done_callback(lambda t: t.exception())
+
+    def _send_actor_batch(self, state: "_ActorSubmitState",
+                          batch: List[TaskSpec], address: rpc.Address,
+                          conn: rpc.Connection) -> None:
+        keys = [(spec.task_id.binary(), spec.attempt_number)
+                for spec in batch]
+        for spec, key in zip(batch, keys):
+            self._actor_streamed[key] = (spec, state)
+            self._record_task_event(spec, "RUNNING")
+        conn.set_push_handler(self._on_worker_push)
+        try:
+            reply_fut = conn.start_call(
+                "push_actor_tasks", {"specs_blob": _spec_dumps(batch)})
+        except rpc.ConnectionLost:
+            self._pool.invalidate(address)
+            state.address = None
+            for spec, key in zip(batch, keys):
+                if self._actor_streamed.pop(key, None) is not None:
+                    self._post(self._retry_or_fail_actor_task(
+                        state, spec, "connection lost"))
+            return
+        waiter = self._loop.create_task(self._await_actor_batch(
+            state, batch, keys, address, reply_fut))
+        waiter.add_done_callback(lambda t: t.exception())
+
+    async def _await_actor_batch(self, state: "_ActorSubmitState",
+                                 batch: List[TaskSpec], keys: List[tuple],
+                                 address: rpc.Address, reply_fut) -> None:
+        try:
+            reply = await reply_fut
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            self._pool.invalidate(address)
+            state.address = None
+            for spec, key in zip(batch, keys):
+                if self._actor_streamed.pop(key, None) is not None:
+                    await self._retry_or_fail_actor_task(
+                        state, spec, f"connection lost: {e}")
+            return
+        dead = reply.get("actor_dead")
+        # results stream on the same FIFO connection BEFORE the final
+        # ack, so leftovers mean the push was lost (or the actor died
+        # before executing them)
+        for spec, key in zip(batch, keys):
+            if self._actor_streamed.pop(key, None) is None:
+                continue
+            if dead:
+                state.pending.pop(spec.sequence_number, None)
+                self._fail_task(spec, ActorDiedError(
+                    spec.actor_id.hex()[:12], reply.get("reason", "")))
+            else:
+                await self._retry_or_fail_actor_task(
+                    state, spec, "streamed result missing")
 
     async def _await_actor_reply(self, state: "_ActorSubmitState",
                                  spec: TaskSpec, address: rpc.Address,
@@ -2033,10 +2191,64 @@ class CoreWorker:
         reply_fut = self._loop.create_future()
         self._exec_queue.put((spec, reply_fut))
         reply = await reply_fut
+        self._cache_actor_reply(cache_key, reply)
+        return reply
+
+    def _cache_actor_reply(self, cache_key: tuple, reply) -> None:
         self._actor_reply_cache[cache_key] = reply
         if len(self._actor_reply_cache) > 1024:
             self._actor_reply_cache.pop(next(iter(self._actor_reply_cache)))
-        return reply
+
+    async def handle_push_actor_tasks(self, conn, data):
+        """Batched actor-call frame: each task's result is PUSHED back as
+        it completes (``actor_task_results``); the final reply only acks.
+        Specs enqueue per-task (not as one exec unit) so concurrency
+        groups (max_concurrency > 1) still execute them in parallel."""
+        if self._actor_instance is None:
+            return {"actor_dead": True, "reason": "no actor in this worker"}
+        specs: List[TaskSpec] = pickle.loads(data["specs_blob"])
+        out_batch: list = []
+
+        def _ship():
+            if out_batch:
+                conn.push("actor_task_results", out_batch[:])
+                out_batch.clear()
+
+        ready = _BurstQueue(self._loop, out_batch.append, _ship)
+        waiters = []
+        cached_out = []
+        for spec in specs:
+            caller = spec.owner_address[3] if spec.owner_address else ""
+            cache_key = (caller, spec.sequence_number,
+                         spec.task_id.binary())
+            cached = self._actor_reply_cache.get(cache_key)
+            if cached is not None:
+                # duplicate delivery after a retry: pushed directly (not
+                # via the burst queue) so an ALL-cached batch still puts
+                # its results on the wire BEFORE the ack below — the
+                # sender treats results-after-ack as a lost push and
+                # would retry successfully-executed tasks forever
+                cached_out.append((spec.task_id.binary(),
+                                   spec.attempt_number, cached))
+                continue
+            reply_fut = self._loop.create_future()
+
+            def _done(f, spec=spec, key=cache_key):
+                if f.cancelled():
+                    return
+                reply = f.result()
+                self._cache_actor_reply(key, reply)
+                ready.push((spec.task_id.binary(), spec.attempt_number,
+                            reply))
+
+            reply_fut.add_done_callback(_done)
+            waiters.append(reply_fut)
+            self._exec_queue.put((spec, reply_fut))
+        if cached_out:
+            conn.push("actor_task_results", cached_out)
+        if waiters:
+            await asyncio.gather(*waiters)
+        return {"acked": len(specs)}
 
     async def handle_create_actor(self, conn, data):
         spec: TaskSpec = pickle.loads(data["spec_blob"])
@@ -2339,25 +2551,32 @@ class _PendingMarker:
 
 
 class _LeasedWorker:
-    __slots__ = ("worker_id", "address", "raylet", "inflight", "return_handle")
+    __slots__ = ("worker_id", "address", "raylet", "inflight",
+                 "return_handle", "contended")
 
     def __init__(self, worker_id: WorkerID, address: rpc.Address,
-                 raylet: rpc.Address):
+                 raylet: rpc.Address, contended: bool = False):
         self.worker_id = worker_id
         self.address = address
         self.raylet = raylet
         self.inflight = 0
         self.return_handle = None
+        # granted while other demand queued at the raylet: hand the
+        # worker back the moment it idles (skip the idle-lease grace)
+        self.contended = contended
 
 
 class _LeaseState:
-    __slots__ = ("key", "backlog", "workers", "requesting")
+    __slots__ = ("key", "backlog", "workers", "requesting",
+                 "inflight_requests")
 
     def __init__(self, key):
         self.key = key
         self.backlog: deque = deque()
         self.workers: Dict[WorkerID, _LeasedWorker] = {}
         self.requesting = 0  # outstanding lease-request chains
+        # token -> raylet address currently asked (for cancel_lease)
+        self.inflight_requests: Dict[str, rpc.Address] = {}
 
 
 class _ActorSubmitState:

@@ -373,7 +373,13 @@ def _ensure_conda_env(spec: Any) -> str:
         if proc.returncode != 0:
             raise RuntimeError(f"conda env {spec!r} not usable:\n"
                                f"{proc.stderr[-2000:]}")
-        return proc.stdout.strip().splitlines()[-1]
+        lines = proc.stdout.strip().splitlines()
+        interpreter = lines[-1].strip() if lines else ""
+        if not interpreter or not os.path.exists(interpreter):
+            raise RuntimeError(
+                f"conda env {spec!r} resolved no usable interpreter "
+                f"(conda stdout: {proc.stdout[-500:]!r})")
+        return interpreter
     digest = hashlib.sha256(
         json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
 

@@ -272,6 +272,16 @@ def cmd_microbenchmark(args) -> None:
     perf_main()
 
 
+def cmd_up(args) -> None:
+    from ray_tpu.autoscaler import launcher
+    launcher.up(args.cluster_config)
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler import launcher
+    launcher.down(args.cluster_config)
+
+
 # ----------------------------------------------------------------------
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -290,6 +300,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop the recorded head node")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser(
+        "up", help="bring up a cluster from a YAML cluster config")
+    sp.add_argument("cluster_config")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser(
+        "down", help="tear down a cluster started with `ray-tpu up`")
+    sp.add_argument("cluster_config")
+    sp.set_defaults(fn=cmd_down)
 
     sp = sub.add_parser("status", help="cluster resource summary")
     sp.add_argument("--address")

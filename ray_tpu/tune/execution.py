@@ -319,6 +319,12 @@ class TrialRunner:
                     and not stop_all:
                 # experiment-level stop: drain live trials, start no more
                 stop_all = True
+                for trial in pending:
+                    # never-started trials end TERMINATED, not stuck
+                    # PENDING in the returned ResultGrid
+                    trial.status = TERMINATED
+                    self._fire("on_trial_complete", self._iteration,
+                               self.trials, trial)
                 pending.clear()
                 for trial in list(live):
                     self._stop_trial(trial, TERMINATED)

@@ -107,7 +107,7 @@ class ExperimentPlateauStopper(Stopper):
     semantics — tolerance-based, so metric noise below ``std`` cannot
     keep the experiment alive forever)."""
 
-    def __init__(self, metric: str, *, mode: str = "max",
+    def __init__(self, metric: str, *, mode: str = "min",
                  patience: int = 0, top: int = 10, std: float = 0.001):
         self._metric = metric
         self._mode = mode
@@ -116,6 +116,7 @@ class ExperimentPlateauStopper(Stopper):
         self._std = float(std)
         self._values: list = []
         self._stale = 0
+        self._plateaued = False
 
     def __call__(self, trial_id, result) -> bool:
         val = result.get(self._metric)
@@ -126,17 +127,21 @@ class ExperimentPlateauStopper(Stopper):
         top = best[:self._top]
         if len(top) < self._top:
             self._stale = 0
+            self._plateaued = False
             return False
         mean = sum(top) / len(top)
         var = sum((x - mean) ** 2 for x in top) / len(top)
-        if var ** 0.5 <= self._std:
+        self._plateaued = var ** 0.5 <= self._std
+        if self._plateaued:
             self._stale += 1
         else:
             self._stale = 0
         return False
 
     def stop_all(self) -> bool:
-        return self._patience > 0 and self._stale >= self._patience
+        # patience=0 stops on the FIRST plateau (reference semantics);
+        # patience=k demands k consecutive plateaued results
+        return self._plateaued and self._stale >= self._patience
 
 
 class CombinedStopper(Stopper):

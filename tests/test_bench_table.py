@@ -1,0 +1,38 @@
+"""The README benchmark table must match the newest BENCH_r*.json.
+
+VERDICT r01-r03 all flagged a hand-edited table publishing stale numbers;
+the table is now generated (scripts/gen_bench_table.py) and this test
+fails the suite whenever README.md and the newest committed artifact
+diverge."""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_readme_bench_table_matches_newest_artifact():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import gen_bench_table
+    finally:
+        sys.path.pop(0)
+    expected = gen_bench_table.generate()
+    with open(os.path.join(REPO, "README.md")) as f:
+        text = f.read()
+    m = re.search(re.escape(gen_bench_table.START) + ".*?"
+                  + re.escape(gen_bench_table.END), text, re.S)
+    assert m, "README.md lost its BENCH_TABLE markers"
+    assert m.group(0) == expected, (
+        "README benchmark table is stale — regenerate with "
+        "`python scripts/gen_bench_table.py --write`")
+
+
+def test_generator_cli_runs():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "gen_bench_table.py")],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "| Row | ray_tpu |" in out.stdout
