@@ -161,11 +161,15 @@ ray_tpu.init(num_cpus=4)
 from ray_tpu.rllib.algorithms.ppo import PPOConfig
 from ray_tpu.rllib.env import CartPole
 out = {}
-for label, workers, nenvs in [("inline", 0, 8), ("fleet", 2, 4)]:
+# fleet: overlapped sampling (sample_async) + harder env vectorization
+# per worker — the round-3 fleet (sync, 2x4 envs) ran at HALF inline
+for label, workers, nenvs, overlap in [
+        ("inline", 0, 8, False), ("fleet", 2, 16, True)]:
     config = (PPOConfig()
               .environment(CartPole, env_config={"max_episode_steps": 200})
               .rollouts(num_rollout_workers=workers,
-                        num_envs_per_worker=nenvs)
+                        num_envs_per_worker=nenvs,
+                        sample_async=overlap)
               .training(train_batch_size=4000, sgd_minibatch_size=512,
                         num_sgd_iter=4)
               .debugging(seed=0))
@@ -178,6 +182,31 @@ for label, workers, nenvs in [("inline", 0, 8), ("fleet", 2, 4)]:
         steps += r.get("num_env_steps_sampled_this_iter", 0)
     dt = time.perf_counter() - t0
     out["ppo_env_steps_per_sec_" + label] = round(steps / dt, 1)
+    out["vs_ref_ppo_env_steps_" + label] = round(steps / dt / 50000.0, 4)
+    if label == "fleet":
+        # scale annotation for the 50k v4-8 north star: per-call
+        # overhead + the learner-bound ceiling on THIS host
+        w = algo.workers.remote_workers[0]
+        t1 = time.perf_counter()
+        for _ in range(20):
+            ray_tpu.get(w.metrics.remote())
+        call_ms = (time.perf_counter() - t1) / 20 * 1000
+        lw = algo.workers.local_worker
+        b = lw.sample()
+        t1 = time.perf_counter()
+        lw.policy.learn_on_batch(b)
+        learn_ms = (time.perf_counter() - t1) * 1000
+        out["ppo_scale_annotation"] = {
+            "bench_host_vcpus": 1,
+            "fleet_shape": "2 workers x 16 envs, sample_async",
+            "actor_call_overhead_ms": round(call_ms, 2),
+            "learner_ms_per_fragment": round(learn_ms, 1),
+            "note": ("on 1 vCPU the fleet and learner timeshare one "
+                     "core, so fleet ~ inline is the physical ceiling; "
+                     "the 50k north star needs a multi-core v4-8 host "
+                     "where N workers sample concurrently under the "
+                     "same overlap pipeline"),
+        }
     algo.stop()
 ray_tpu.shutdown()
 print("RESULT:" + json.dumps(out))
@@ -192,7 +221,8 @@ print("RESULT:" + json.dumps(out))
         for line in proc.stdout.splitlines():
             if line.startswith("RESULT:"):
                 out = json.loads(line[len("RESULT:"):])
-                best = max(out.values())
+                best = max(v for k, v in out.items()
+                           if isinstance(v, (int, float)))
                 out["vs_ref_ppo_env_steps"] = round(best / 50000.0, 4)
                 return out
         return {"rllib_bench_error":

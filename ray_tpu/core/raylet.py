@@ -654,8 +654,11 @@ class Raylet:
             # are surplus
             watermark = getattr(self, "_prestart_watermark", 0)
             now = time.monotonic()
+            # never trim env-bound workers: their interpreter IS the
+            # runtime env and a respawn replays the whole env build
             while len(self._idle) > watermark and self._cull_idle_spare(
-                    lambda w: now - w.idle_since > 10.0):
+                    lambda w: w.env_hash is None
+                    and now - w.idle_since > 10.0):
                 pass
             await asyncio.sleep(0.2)
 

@@ -22,6 +22,7 @@ class AlgorithmConfig:
         # rollouts
         self.num_rollout_workers = 0
         self.num_envs_per_worker = 1
+        self.sample_async = False
         self.rollout_fragment_length = 200
         # training
         self.lr = 5e-4
@@ -66,7 +67,8 @@ class AlgorithmConfig:
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
                  num_envs_per_worker: Optional[int] = None,
-                 rollout_fragment_length: Optional[int] = None
+                 rollout_fragment_length: Optional[int] = None,
+                 sample_async: Optional[bool] = None
                  ) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = int(num_rollout_workers)
@@ -74,6 +76,12 @@ class AlgorithmConfig:
             self.num_envs_per_worker = int(num_envs_per_worker)
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = int(rollout_fragment_length)
+        if sample_async is not None:
+            # overlap sampling with the learner update (reference
+            # ``sample_async`` / the LearnerThread shape): workers keep
+            # one fragment in flight through learn_on_batch, at the cost
+            # of <=1-update-stale weights per fragment
+            self.sample_async = bool(sample_async)
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

@@ -149,9 +149,100 @@ class PPO(Algorithm):
     policy_class = PPOPolicy
     supports_multi_agent = True
 
+    def setup(self) -> None:
+        super().setup()
+        # overlapped-sampling pipeline (config.rollouts(sample_async=True)
+        # — the reference LearnerThread shape brought to PPO): one
+        # fragment stays in flight per worker THROUGH learn_on_batch, so
+        # the fleet samples while the learner updates instead of idling.
+        # Cost: fragments are at most one update stale — the clipped
+        # surrogate is exactly the guard for that.
+        self._inflight: Dict[Any, Any] = {}
+        self._pending_metrics: list = []
+        if self._sample_async():
+            for w in self.workers.remote_workers:
+                self._inflight[w.sample_with_metrics.remote()] = w
+
+    def _sample_async(self) -> bool:
+        # multi-agent batches need the per-policy concat/learn of the
+        # sync path; the overlap pipeline is single-policy only
+        return bool(self.config.get("sample_async")) \
+            and bool(self.workers.remote_workers) \
+            and not self.config.get("policies")
+
+    def _async_sample(self, target_steps: int):
+        import ray_tpu
+        from ray_tpu.rllib.sample_batch import concat_samples
+
+        # reconcile with the live fleet (probe_and_recreate replacements)
+        live = {id(w) for w in self.workers.remote_workers}
+        self._inflight = {ref: w for ref, w in self._inflight.items()
+                          if id(w) in live}
+        have = {id(w) for w in self._inflight.values()}
+        for w in self.workers.remote_workers:
+            if id(w) not in have:
+                self._inflight[w.sample_with_metrics.remote()] = w
+        batches = []
+        steps = 0
+        while steps < target_steps and self._inflight:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=300)
+            for ref in ready:
+                worker = self._inflight.pop(ref)
+                try:
+                    fragment, metrics = ray_tpu.get(ref)
+                except Exception:  # noqa: BLE001 — dead worker: drop its
+                    continue       # ref; probe_and_recreate restores it
+                # re-dispatch FIRST: the worker samples its next fragment
+                # while this one is learned on
+                self._inflight[worker.sample_with_metrics.remote()] = \
+                    worker
+                batches.append(fragment)
+                self._pending_metrics.append(metrics)
+                steps += len(fragment)
+        if not batches:
+            # whole fleet died mid-iteration: sample locally so the
+            # learner sees a real batch while the next train()'s probe
+            # rebuilds the workers
+            batches = [self.workers.local_worker.sample()]
+        return concat_samples(batches)
+
+    def _broadcast_weights_async(self) -> None:
+        """Non-blocking weight push: set_weights queues behind each
+        worker's in-flight sample (ordered actor queue), so waiting on it
+        would re-serialize the pipeline."""
+        import ray_tpu
+        ref = ray_tpu.put(self.workers.local_worker.get_weights())
+        for w in self.workers.remote_workers:
+            w.set_weights.remote(ref)
+
+    def _collect_metrics(self):
+        out = [self.workers.local_worker.metrics()]
+        if self._sample_async():
+            out.extend(self._pending_metrics)
+            self._pending_metrics = []
+        elif self.workers.remote_workers:
+            import ray_tpu
+            out.extend(ray_tpu.get(
+                [w.metrics.remote() for w in self.workers.remote_workers]))
+        return out
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()
+
     def training_step(self) -> Dict[str, Any]:
         from ray_tpu.rllib.sample_batch import MultiAgentBatch
 
+        target = int(self.config.get("train_batch_size", 4000))
+        if self._sample_async():
+            batch = self._async_sample(target)
+            batch = standardize_advantages(batch)
+            self._timesteps_total += len(batch)
+            stats = self.workers.local_worker.policy.learn_on_batch(batch)
+            self._broadcast_weights_async()
+            stats["num_env_steps_sampled_this_iter"] = len(batch)
+            return stats
         batch = synchronous_parallel_sample(
             self.workers,
             max_env_steps=int(self.config.get("train_batch_size", 4000)))

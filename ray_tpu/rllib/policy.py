@@ -122,12 +122,17 @@ class JaxPolicy:
         if self.recurrent:
             @jax.jit
             def _act_rnn(params, obs, c, h, rng):
+                # key split lives INSIDE the jit (a separate host-side
+                # threefry call per env tick dominated tiny-model
+                # sampling); the next key returns as a device array
+                rng, next_rng = jax.random.split(rng)
                 logits, vf, (c2, h2) = model.apply(params, obs[:, None],
                                                    (c, h))
                 dist_inputs = logits[:, 0]
                 actions = dist.sample(dist_inputs, rng)
                 logp = dist.logp(dist_inputs, actions)
-                return actions, logp, vf[:, 0], dist_inputs, c2, h2
+                return actions, logp, vf[:, 0], dist_inputs, c2, h2, \
+                    next_rng
 
             @jax.jit
             def _act_rnn_greedy(params, obs, c, h):
@@ -154,10 +159,12 @@ class JaxPolicy:
         else:
             @jax.jit
             def _act(params, obs, rng):
+                # split inside the jit; next key stays on device
+                rng, next_rng = jax.random.split(rng)
                 dist_inputs, vf = model.apply(params, obs)
                 actions = dist.sample(dist_inputs, rng)
                 logp = dist.logp(dist_inputs, actions)
-                return actions, logp, vf, dist_inputs
+                return actions, logp, vf, dist_inputs, next_rng
 
             @jax.jit
             def _act_greedy(params, obs):
@@ -214,9 +221,8 @@ class JaxPolicy:
             obs_j = jnp.asarray(obs, jnp.float32)
             c, h = (jnp.asarray(state[0]), jnp.asarray(state[1]))
             if explore:
-                self._rng, rng = jax.random.split(self._rng)
-                actions, logp, vf, _, c2, h2 = self._act_rnn(
-                    self.params, obs_j, c, h, rng)
+                actions, logp, vf, _, c2, h2, self._rng = self._act_rnn(
+                    self.params, obs_j, c, h, self._rng)
                 extras = {SampleBatch.ACTION_LOGP: np.asarray(logp),
                           SampleBatch.VF_PREDS: np.asarray(vf),
                           "state_in_c": np.asarray(state[0]),
@@ -237,9 +243,8 @@ class JaxPolicy:
         with self._on_device():
             obs = jnp.asarray(obs, jnp.float32)
             if explore:
-                self._rng, rng = jax.random.split(self._rng)
-                actions, logp, vf, dist_inputs = self._act(self.params, obs,
-                                                           rng)
+                actions, logp, vf, dist_inputs, self._rng = self._act(
+                    self.params, obs, self._rng)
                 extras = {SampleBatch.ACTION_LOGP: np.asarray(logp),
                           SampleBatch.VF_PREDS: np.asarray(vf)}
             else:
