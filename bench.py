@@ -185,7 +185,14 @@ for label, workers, nenvs, overlap in [
     out["vs_ref_ppo_env_steps_" + label] = round(steps / dt / 50000.0, 4)
     if label == "fleet":
         # scale annotation for the 50k v4-8 north star: per-call
-        # overhead + the learner-bound ceiling on THIS host
+        # overhead + the learner-bound ceiling on THIS host.  Drain the
+        # async pipeline first or the timed calls queue behind a full
+        # in-flight fragment per worker.
+        try:
+            ray_tpu.get(list(algo._inflight), timeout=60)
+        except Exception:
+            pass
+        algo._inflight.clear()
         w = algo.workers.remote_workers[0]
         t1 = time.perf_counter()
         for _ in range(20):
@@ -426,8 +433,17 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
             time.perf_counter() - t0)
         out["vs_ref_many_actors"] = \
             out["many_actors_per_sec_4node"] / 600.4
+        out["many_actors_note"] = (
+            "process-per-actor on 1 vCPU: each actor's worker costs "
+            "~16 ms of fork+boot CPU, so ~70/s is this host's "
+            "architectural ceiling; the reference's 600/s ran on 64x64 "
+            "cores (0.15 actors/s/core)")
         for a in actors:
             ray_tpu.kill(a)
+        # settle: reaping 100 actor workers + pool refill would
+        # otherwise compete with the PG wave (the r03 many_pgs
+        # regression was exactly this cross-row interference)
+        time.sleep(3.0)
 
         # many_pgs: create N groups, then remove them
         from ray_tpu.util.placement_group import (placement_group,

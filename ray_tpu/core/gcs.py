@@ -344,8 +344,14 @@ class GcsServer:
         return True
 
     def on_disconnection(self, conn) -> None:
-        for subs in self.subscribers.values():
+        for channel in list(self.subscribers):
+            subs = self.subscribers[channel]
             subs.discard(conn)
+            if not subs:
+                # drop emptied keys: auto-subscribed per-actor channels
+                # would otherwise accrete one entry per actor per
+                # departed driver
+                del self.subscribers[channel]
         node_id = conn.context.get("node_id")
         if node_id is not None and node_id in self.nodes:
             self._mark_node_dead(node_id, "raylet connection lost")
@@ -647,8 +653,15 @@ class GcsServer:
         )
         self.actors[actor_id] = info
         self._schedule_persist()
+        # auto-subscribe the registering owner to the actor's channel:
+        # its submitter needs the ALIVE address anyway, and the explicit
+        # subscribe + get_actor round trips cost two driver-side RTTs
+        # PER ACTOR during creation storms
+        self.subscribers.setdefault(
+            f"actor:{actor_id.hex()}", set()).add(conn)
         asyncio.get_running_loop().create_task(self._schedule_actor(info))
-        return {"existing": False, "actor_id": actor_id.binary()}
+        return {"existing": False, "actor_id": actor_id.binary(),
+                "subscribed": True}
 
     def _publish_actor(self, info: ActorInfo) -> None:
         # every published transition also reaches the durable table: the

@@ -268,6 +268,13 @@ class Raylet:
             i: 0.0 for i in range(int(self.resources_total.get("TPU", 0)))}
         # rate limiter for reclaim_idle nudges under pool-cap contention
         self._last_reclaim_push = 0.0
+        # decaying count of workers claimed by actors recently: actor
+        # waves permanently consume pool workers, so the refill target
+        # tracks recent claim volume (parity: GcsActorScheduler keeps
+        # nodes stocked for the wave it is placing) and decays back to
+        # the boot watermark when the storms stop
+        self._actor_claims = 0.0
+        self._actor_claims_ts = time.monotonic()
         # log monitor state: file path -> (offset, pid)
         self._log_pids: Dict[str, int] = {}
         self._log_offsets: Dict[str, int] = {}
@@ -652,14 +659,31 @@ class Raylet:
             # from many distinct clients can grow it past the per-core
             # cap (see cap_bonus in _maybe_schedule); workers idle >10 s
             # are surplus
-            watermark = getattr(self, "_prestart_watermark", 0)
+            target = self._pool_target()
             now = time.monotonic()
-            # never trim env-bound workers: their interpreter IS the
-            # runtime env and a respawn replays the whole env build
-            while len(self._idle) > watermark and self._cull_idle_spare(
-                    lambda w: w.env_hash is None
-                    and now - w.idle_since > 10.0):
+            # env-bound workers get a much longer grace (their
+            # interpreter IS the runtime env; a respawn replays the
+            # whole env build) but are not exempt — exemption leaked
+            # one interpreter per distinct env forever
+            while len(self._idle) > target and self._cull_idle_spare(
+                    lambda w: now - w.idle_since >
+                    (300.0 if w.env_hash is not None else 10.0)):
                 pass
+            # claims-driven pool rebuild, only while the lease plane is
+            # QUIET (spawn storms during an active wave steal the CPU
+            # the wave itself needs) and gently (<=2 spawns per tick):
+            # the next actor wave then lands on warm forks.  Counted
+            # against PLAIN idle workers — idle env workers can't serve
+            # ordinary leases and must not suppress the rebuild.
+            if not self._pending_leases and not self._closing and \
+                    now - getattr(self, "_last_lease_ts", 0.0) > 1.5:
+                idle_plain = sum(1 for w in self._idle
+                                 if w.env_hash is None)
+                deficit = target - idle_plain - self._starting
+                bonus = max(0, target - self._max_workers)
+                for _ in range(min(2, deficit)):
+                    if not self._start_worker(None, cap_bonus=bonus):
+                        break
             await asyncio.sleep(0.2)
 
     # ------------------------------------------------------------------
@@ -1041,6 +1065,7 @@ class Raylet:
                 logger.warning(
                     "lease demand %s infeasible cluster-wide; queueing "
                     "(waiting for new nodes)", resources)
+        self._last_lease_ts = time.monotonic()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending_leases.append(PendingLease(
             request=data, future=fut, job_id_bin=job_id_bin,
@@ -1316,6 +1341,25 @@ class Raylet:
                         nudged.add(id(conn))
                         conn.push("reclaim_idle", {})
 
+    def _note_actor_claim(self) -> None:
+        self._actor_claims = self._decayed_actor_claims() + 1.0
+        self._actor_claims_ts = time.monotonic()
+
+    def _decayed_actor_claims(self) -> float:
+        # half-life 60 s: long enough to keep the pool stocked through a
+        # benchmark-style burst sequence, short enough that a one-off
+        # storm doesn't pin memory for minutes
+        dt = time.monotonic() - self._actor_claims_ts
+        return self._actor_claims * 0.5 ** (dt / 60.0)
+
+    def _pool_target(self) -> int:
+        """Idle-pool size to maintain: boot watermark plus the recent
+        actor-claim volume (claimed workers leave the pool for good, so
+        the NEXT wave should land on warm forks, not cold spawns)."""
+        watermark = getattr(self, "_prestart_watermark", 0)
+        return watermark + min(int(self._decayed_actor_claims()),
+                               3 * self._max_workers)
+
     def _cull_idle_spare(self, predicate) -> bool:
         """Evict one idle worker matching ``predicate`` to free pool
         capacity; returns True if a worker was released."""
@@ -1443,6 +1487,7 @@ class Raylet:
         if worker is None:
             return {"granted": False, "reason": "worker vanished"}
         worker.is_actor = True
+        self._note_actor_claim()
         payload = {"spec_blob": data["spec_blob"]}
         # Attach node-cached function + syspath blobs: 25 actors of one
         # class on one node then cost ONE GCS fetch instead of 25 (the
@@ -1547,6 +1592,9 @@ class Raylet:
     # placement-group bundles (PlacementGroupResourceManager)
     # ------------------------------------------------------------------
     async def handle_prepare_bundle(self, conn, data):
+        # bundle waves are control-plane bursts too: pause the
+        # background pool rebuild while one is in flight
+        self._last_lease_ts = time.monotonic()
         resources = dict(data["resources"])
         key = (data["pg_id"], data["bundle_index"])
         if key in self._bundle_totals:

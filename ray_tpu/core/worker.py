@@ -206,6 +206,16 @@ class CoreWorker:
                  job_id: Optional[JobID] = None,
                  config: Optional[Config] = None):
         assert mode in ("driver", "worker")
+        _trace = os.environ.get("RAY_TPU_BOOT_TRACE")
+        _t0 = time.perf_counter()
+
+        def _mark(label):
+            if _trace:
+                import sys as _sys
+                _sys.stderr.write(f"BOOT cw.{label} "
+                                  f"{1000 * (time.perf_counter() - _t0):.1f}"
+                                  f"ms\n")
+                _sys.stderr.flush()
         self.mode = mode
         self.gcs_address = gcs_address
         self.raylet_address = raylet_address
@@ -215,7 +225,9 @@ class CoreWorker:
         self.config = config or get_config()
 
         self.memory_store = MemoryStore()
+        _mark("pre_store")
         self.store_client = StoreClient(store_path, store_capacity)
+        _mark("store")
         self.reference_counter = ReferenceCounter(
             on_free=self._on_object_freed,
             on_borrow_added=self._on_borrow_added,
@@ -228,6 +240,7 @@ class CoreWorker:
         self._loop_thread = threading.Thread(
             target=self._loop.run_forever, name="rtpu-io", daemon=True)
         self._loop_thread.start()
+        _mark("loop_thread")
 
         self._ctx = _TaskContext()
         self._address_cache: Optional[OwnerAddress] = None
@@ -291,7 +304,9 @@ class CoreWorker:
         # same for batched actor pushes: (task_id, attempt) -> (spec, state)
         self._actor_streamed: Dict[tuple, tuple] = {}
 
+        _mark("pre_async_init")
         self._run(self._async_init())
+        _mark("async_init")
         set_global_worker(self)
 
     # ------------------------------------------------------------------
@@ -1656,20 +1671,30 @@ class CoreWorker:
             # bursts on per-actor GCS round trips (measured 12 ms/actor
             # with a busy GCS).  Method submission awaits the ack in
             # _resolve_actor_address before querying actor state.
+            state = self._actor_state(actor_id)
             fut = asyncio.run_coroutine_threadsafe(
                 self.gcs_conn.call("register_actor", payload), self._loop)
-            self._actor_state(actor_id).register_fut = fut
+            state.register_fut = fut
 
-            def _log_failure(f):
+            def _log_failure(f, state=state):
                 exc = f.exception() if not f.cancelled() else None
                 if exc is not None:
                     logger.warning("async actor registration for %s "
                                    "failed: %s", actor_id.hex()[:12], exc)
+                elif f.result().get("subscribed"):
+                    # the GCS auto-subscribed this conn to the actor's
+                    # channel at registration: address resolution can
+                    # wait for the ALIVE push instead of paying
+                    # subscribe + get_actor round trips per actor
+                    state.subscribed = True
             fut.add_done_callback(_log_failure)
             return actor_id
         # named / get_if_exists: the reply decides (conflict or reuse)
         reply = self._run(self.gcs_conn.call("register_actor", payload))
-        return ActorID(reply["actor_id"])
+        out_id = ActorID(reply["actor_id"])
+        if reply.get("subscribed") and not reply.get("existing"):
+            self._actor_state(out_id).subscribed = True
+        return out_id
 
     def _actor_state(self, actor_id: ActorID) -> "_ActorSubmitState":
         state = self._actor_states.get(actor_id)
@@ -1875,6 +1900,11 @@ class CoreWorker:
                     f"registration failed: {e}") from e
         if state.address is not None:
             return state.address
+        # auto-subscribed at registration: an ALIVE push is already on
+        # its way — give it a head start before paying a get_actor poll
+        # (two RTTs per actor dominated the driver side of creation
+        # storms)
+        push_first = state.subscribed
         if not state.subscribed:
             # Event-driven resolution: subscribe BEFORE the state query so
             # no ALIVE/DEAD transition can fall between them, then sleep
@@ -1899,6 +1929,13 @@ class CoreWorker:
             if state.resolve_event is None:
                 state.resolve_event = asyncio.Event()
             state.resolve_event.clear()
+            if push_first:
+                push_first = False
+                try:
+                    await asyncio.wait_for(state.resolve_event.wait(), 2.0)
+                except asyncio.TimeoutError:
+                    pass  # lost push: fall through to the poll
+                continue
             reply = await self.gcs_conn.call(
                 "get_actor", {"actor_id": state.actor_id.binary()})
             if reply is None:

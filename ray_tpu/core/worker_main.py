@@ -13,8 +13,19 @@ import sys
 
 
 def main() -> None:
+    import time
+    t0 = time.perf_counter()
+    trace = os.environ.get("RAY_TPU_BOOT_TRACE")
+
+    def mark(label):
+        if trace:
+            sys.stderr.write(
+                f"BOOT {label} {1000 * (time.perf_counter() - t0):.1f}ms\n")
+            sys.stderr.flush()
+
     from ray_tpu.core.node import maybe_arm_pdeathsig
     maybe_arm_pdeathsig()
+    mark("pdeathsig")
     parser = argparse.ArgumentParser()
     parser.add_argument("--raylet", required=True)
     parser.add_argument("--gcs", required=True)
@@ -45,6 +56,7 @@ def main() -> None:
 
     from ray_tpu.core.ids import JobID, NodeID
     from ray_tpu.core.worker import CoreWorker
+    mark("imports")
 
     def parse_addr(s: str):
         host, port = s.rsplit(":", 1)
@@ -60,6 +72,7 @@ def main() -> None:
         session_dir=args.session_dir,
         job_id=JobID.from_hex(args.job_id) if args.job_id else None,
     )
+    mark("core_worker_ready")
     try:
         worker.run_exec_loop()
     finally:

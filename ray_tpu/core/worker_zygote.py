@@ -72,6 +72,14 @@ def main() -> None:
     import ray_tpu.actor  # noqa: F401
     import ray_tpu.remote_function  # noqa: F401
 
+    # Freeze the template heap (the fork-server trick): a child's first
+    # gc pass otherwise writes mark bits into EVERY inherited object's
+    # header, copy-on-write-faulting the whole template heap per worker
+    # — a large slice of per-fork boot cost during actor creation storms.
+    import gc
+    gc.collect()
+    gc.freeze()
+
     # reap forked children so they don't accumulate as zombies
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)
 

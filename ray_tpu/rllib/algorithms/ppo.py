@@ -10,6 +10,7 @@ that program, so the TPU sees a stream of identical compiled steps.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict
 
 import jax.numpy as jnp
@@ -159,6 +160,7 @@ class PPO(Algorithm):
         # surrogate is exactly the guard for that.
         self._inflight: Dict[Any, Any] = {}
         self._pending_metrics: list = []
+        self._suspect_workers: set = set()
         if self._sample_async():
             for w in self.workers.remote_workers:
                 self._inflight[w.sample_with_metrics.remote()] = w
@@ -174,24 +176,34 @@ class PPO(Algorithm):
         import ray_tpu
         from ray_tpu.rllib.sample_batch import concat_samples
 
-        # reconcile with the live fleet (probe_and_recreate replacements)
+        # reconcile with the live fleet: drop refs from removed workers,
+        # dispatch to new ones, and skip handles already seen failing
+        # (re-dispatching to a dead handle burns a submit+error round
+        # trip per train() until probe_and_recreate replaces it — the
+        # replacement is a NEW handle object, clearing the suspicion)
         live = {id(w) for w in self.workers.remote_workers}
+        self._suspect_workers &= live
         self._inflight = {ref: w for ref, w in self._inflight.items()
                           if id(w) in live}
         have = {id(w) for w in self._inflight.values()}
         for w in self.workers.remote_workers:
-            if id(w) not in have:
+            if id(w) not in have and id(w) not in self._suspect_workers:
                 self._inflight[w.sample_with_metrics.remote()] = w
         batches = []
         steps = 0
-        while steps < target_steps and self._inflight:
+        deadline = time.monotonic() + 300.0
+        while steps < target_steps and self._inflight \
+                and time.monotonic() < deadline:
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
-                                    timeout=300)
+                                    timeout=30)
+            if not ready:
+                continue  # wedged fleet: bounded by the deadline above
             for ref in ready:
                 worker = self._inflight.pop(ref)
                 try:
                     fragment, metrics = ray_tpu.get(ref)
                 except Exception:  # noqa: BLE001 — dead worker: drop its
+                    self._suspect_workers.add(id(worker))
                     continue       # ref; probe_and_recreate restores it
                 # re-dispatch FIRST: the worker samples its next fragment
                 # while this one is learned on

@@ -127,6 +127,7 @@ def test_ppo_sample_async_overlap():
     # episode stats arrived through the piggyback (no metrics() RPCs
     # queued behind in-flight samples)
     assert result["episodes_this_iter"] >= 0
+    assert np.isfinite(result["episode_reward_mean"])
     assert result["episode_reward_mean"] != 0.0
     # the non-blocking broadcast still converges the fleet's weights:
     # after stop-the-pipeline, workers hold the last pushed weights
