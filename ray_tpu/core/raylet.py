@@ -268,6 +268,8 @@ class Raylet:
             i: 0.0 for i in range(int(self.resources_total.get("TPU", 0)))}
         # rate limiter for reclaim_idle nudges under pool-cap contention
         self._last_reclaim_push = 0.0
+        self._reclaim_timer_armed = False
+        self._reclaim_retry_delay = 0.03
         # decaying count of workers claimed by actors recently: actor
         # waves permanently consume pool workers, so the refill target
         # tracks recent claim volume (parity: GcsActorScheduler keeps
@@ -669,6 +671,12 @@ class Raylet:
                     lambda w: now - w.idle_since >
                     (300.0 if w.env_hash is not None else 10.0)):
                 pass
+            # safety re-kick: if demand is queued with nothing idle and
+            # no retry timer armed (e.g. _maybe_schedule ran without a
+            # loop), rescan so waiting leases can't stall indefinitely
+            if self._pending_leases and not self._idle \
+                    and not self._reclaim_timer_armed:
+                self._maybe_schedule()
             # claims-driven pool rebuild, only while the lease plane is
             # QUIET (spawn storms during an active wave steal the CPU
             # the wave itself needs) and gently (<=2 spawns per tick):
@@ -1200,14 +1208,28 @@ class Raylet:
         return tuple(best["address"])
 
     def _maybe_schedule(self) -> None:
-        """Grant queued leases FIFO while resources and workers allow;
-        spill queued leases to other nodes as the cluster view evolves."""
+        """Grant queued leases — round-robin across clients, FIFO within
+        each — while resources and workers allow; spill queued leases to
+        other nodes as the cluster view evolves."""
         if self._closing:
             return
         remaining: List[PendingLease] = []
         want_workers: List[Tuple[Optional[bytes], bool]] = []
         grants: List[Tuple[PendingLease, WorkerHandle]] = []
-        for lease in self._pending_leases:
+        # Round-robin the queue across CLIENTS (FIFO within each): pure
+        # FIFO handed every free worker to whichever client enqueued
+        # first, serializing whole clients behind each other — the
+        # middle of the clients-vs-throughput curve collapsed because
+        # client B's burst only started when client A's fully drained.
+        pending = self._pending_leases
+        if len({id(lease.conn) for lease in pending}) > 1:
+            from itertools import chain, zip_longest
+            by_conn: Dict[int, List[PendingLease]] = {}
+            for lease in pending:  # dicts preserve insertion order
+                by_conn.setdefault(id(lease.conn), []).append(lease)
+            pending = [lease for lease in chain.from_iterable(
+                zip_longest(*by_conn.values())) if lease is not None]
+        for lease in pending:
             if lease.future.done():
                 continue
             if not self._fits(lease.resources, lease.bundle):
@@ -1340,6 +1362,31 @@ class Raylet:
                             and not conn.closed and id(conn) not in nudged):
                         nudged.add(id(conn))
                         conn.push("reclaim_idle", {})
+            # a holder whose worker is merely BUSY right now generates
+            # no event when it later idles into its grace — re-nudge on
+            # a short timer until the queued demand is served (without
+            # this, a waiting client stalled for the full 250 ms grace
+            # of whoever got the workers first).  Exponential backoff to
+            # 0.5 s: when every worker runs minutes-long tasks there is
+            # nothing to reclaim and a 30 ms rescan would just burn CPU
+            # for the whole saturation window.
+            if not self._reclaim_timer_armed:
+                delay = self._reclaim_retry_delay
+
+                def _retry():
+                    self._reclaim_timer_armed = False
+                    self._reclaim_retry_delay = min(
+                        0.5, self._reclaim_retry_delay * 1.6)
+                    if not self._closing and self._pending_leases:
+                        self._maybe_schedule()
+                try:
+                    asyncio.get_running_loop().call_later(delay, _retry)
+                    self._reclaim_timer_armed = True
+                except RuntimeError:
+                    pass  # no loop (sync caller); the reap loop re-kicks
+        if grants or not remaining:
+            # demand moved: future contention starts its backoff fresh
+            self._reclaim_retry_delay = 0.03
 
     def _note_actor_claim(self) -> None:
         self._actor_claims = self._decayed_actor_claims() + 1.0
