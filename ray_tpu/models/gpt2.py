@@ -196,7 +196,8 @@ class GPT2(nn.Module):
 
 
 def loss_fn(model: GPT2, params, tokens: jax.Array,
-            head_chunk: int = 8192) -> jax.Array:
+            head_chunk: int = 8192,
+            head_logits_dtype: Any = None) -> jax.Array:
     """Next-token cross entropy (labels = tokens shifted left).
 
     The LM head + softmax run in token chunks (``chunked_lm_loss``):
@@ -206,8 +207,11 @@ def loss_fn(model: GPT2, params, tokens: jax.Array,
     from ray_tpu.ops.fused import chunked_lm_loss
 
     x, wte = model.apply({"params": params}, tokens, method=GPT2.hidden)
-    # bf16-activation models run the head matmuls on the MXU in bf16
-    # (f32 accumulation inside chunked_lm_loss); f32 models stay f32
+    # bf16-activation models run the head matmuls on the MXU in bf16;
+    # logits accumulate/store f32 unless the caller opts into
+    # ``head_logits_dtype=bf16`` (bench throughput mode — see the
+    # precision caveat in ops/fused.py)
     compute = jnp.bfloat16 if model.config.dtype == jnp.bfloat16 else None
     return chunked_lm_loss(x[:, :-1], wte, tokens[:, 1:],
-                           chunk=head_chunk, compute_dtype=compute)
+                           chunk=head_chunk, compute_dtype=compute,
+                           logits_dtype=head_logits_dtype)
