@@ -1614,6 +1614,21 @@ class Raylet:
         mon = getattr(self, "_loop_monitor", None)
         return mon.snapshot() if mon is not None else {}
 
+    async def handle_stack_traces(self, conn, data):
+        """All-thread stack dumps from every worker on this node
+        (parity: the dashboard reporter's py-spy fan-out)."""
+        async def one(worker):
+            try:
+                return await asyncio.wait_for(
+                    worker.conn.call("stack_trace", {}), 10.0)
+            except Exception as e:  # noqa: BLE001 — wedged workers are
+                return {"pid": worker.pid,  # exactly what you're hunting
+                        "error": f"{type(e).__name__}: {e}"}
+
+        dumps = await asyncio.gather(
+            *(one(w) for w in list(self.workers.values())))
+        return {"node_id": self.node_id.hex(), "workers": dumps}
+
     async def handle_list_workers(self, conn, data):
         return [{"worker_id": w.worker_id.hex(), "pid": w.pid,
                  "leased": w.leased, "is_actor": w.is_actor,

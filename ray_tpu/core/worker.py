@@ -31,6 +31,7 @@ import logging
 import os
 import pickle
 import queue as queue_mod
+import sys
 import threading
 import time
 from collections import deque
@@ -1095,6 +1096,25 @@ class CoreWorker:
         self.reference_counter.remove_borrower(
             ObjectID(data["object_id"]), tuple(data["borrower"]))
         return True
+
+    async def handle_stack_trace(self, conn, data):
+        """All-thread stack dump of this worker (parity: the reference's
+        py-spy-backed ``ray stack`` / dashboard reporter — here
+        python-native via sys._current_frames, which needs no external
+        profiler binary and works inside containers)."""
+        import traceback
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in frames.items():
+            stack = "".join(traceback.format_stack(frame))
+            out.append({"thread": names.get(ident, str(ident)),
+                        "stack": stack})
+        return {"pid": os.getpid(),
+                "actor_id": self._actor_id.hex() if self._actor_id
+                else None,
+                "threads": out}
 
     async def handle_ping(self, conn, data):
         return {"worker_id": self.worker_id.hex(), "mode": self.mode,

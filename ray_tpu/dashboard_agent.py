@@ -131,12 +131,34 @@ class DashboardAgent:
         out = await asyncio.get_running_loop().run_in_executor(None, tail)
         return web.json_response({"lines": out})
 
+    async def handle_stacks(self, request):
+        """All-thread stack dumps from this node's workers (parity:
+        the reference reporter module's py-spy stack dumps)."""
+        from ray_tpu.core import rpc
+
+        if self._gcs_conn is None or self._gcs_conn.closed:
+            self._gcs_conn = await rpc.connect(tuple(self.gcs_address))
+        nodes = await self._gcs_conn.call("get_nodes", {})
+        me = bytes.fromhex(self.node_id_hex)
+        mine = next((n for n in nodes
+                     if bytes(n["node_id"]) == me), None)
+        if mine is None:
+            return web.json_response({"error": "node not in GCS view"},
+                                     status=404)
+        conn = await rpc.connect(tuple(mine["address"]))
+        try:
+            dumps = await conn.call("stack_traces", {}, timeout=30)
+        finally:
+            conn.close()
+        return web.json_response(dumps)
+
     # -- lifecycle -----------------------------------------------------
     async def start(self) -> tuple:
         app = web.Application()
         app.router.add_get("/healthz", self.handle_healthz)
         app.router.add_get("/api/local/stats", self.handle_stats)
         app.router.add_get("/api/local/logs", self.handle_logs)
+        app.router.add_get("/api/local/stacks", self.handle_stacks)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, self.host, self.port)

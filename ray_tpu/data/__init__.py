@@ -20,9 +20,12 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_csv,
     read_images,
     read_json,
+    read_mongo,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
 )
 from ray_tpu.data import preprocessors  # noqa: F401

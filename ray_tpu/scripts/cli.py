@@ -272,6 +272,47 @@ def cmd_microbenchmark(args) -> None:
     perf_main()
 
 
+def cmd_stack(args) -> None:
+    """All-thread stack dumps from every worker in the cluster
+    (parity: `ray stack`, without needing py-spy)."""
+    _connect(args)
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.experimental.state import api as state
+
+    w = global_worker()
+    for n in state.list_nodes():
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            dump = w.raylet_call(tuple(n["address"]),
+                                 "stack_traces", {})
+        except Exception as e:  # noqa: BLE001
+            print(f"node {n['node_id'][:12]}: unreachable ({e})")
+            continue
+        print(f"=== node {dump['node_id'][:12]} "
+              f"({len(dump['workers'])} workers) ===")
+        for wk in dump["workers"]:
+            head = f"--- pid {wk.get('pid')}"
+            if wk.get("actor_id"):
+                head += f" actor {wk['actor_id'][:12]}"
+            print(head + " ---")
+            if wk.get("error"):
+                print(f"  <{wk['error']}>")
+                continue
+            for t in wk.get("threads", []):
+                print(f"  thread {t['thread']}:")
+                for line in t["stack"].rstrip().splitlines():
+                    print(f"    {line}")
+
+
+def cmd_metrics_export_config(args) -> None:
+    from ray_tpu.util.metrics_config import write_configs
+    out = write_configs(args.output_dir,
+                        dashboard_address=args.dashboard_address)
+    for path in out:
+        print(path)
+
+
 def cmd_up(args) -> None:
     from ray_tpu.autoscaler import launcher
     launcher.up(args.cluster_config)
@@ -300,6 +341,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop the recorded head node")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser(
+        "stack", help="all-thread stack dumps from every worker")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser(
+        "metrics", help="metrics tooling")
+    msub = sp.add_subparsers(dest="metrics_cmd", required=True)
+    m = msub.add_parser("export-config",
+                        help="write prometheus.yml + grafana "
+                             "provisioning configs")
+    m.add_argument("--output-dir", default="./ray_tpu_metrics")
+    m.add_argument("--dashboard-address", default=None)
+    m.set_defaults(fn=cmd_metrics_export_config)
 
     sp = sub.add_parser(
         "up", help="bring up a cluster from a YAML cluster config")

@@ -95,15 +95,41 @@ class ClientWorker:
         return ObjectRef(ObjectID(reply["id"]), reply.get("owner"),
                          _register=False)
 
+    #: keep in sync with server.CHUNK_SIZE (4 MiB): larger payloads go
+    #: over the wire in pieces so one big put/get can't head-of-line
+    #: block every other call on the connection
+    CHUNK_SIZE = 4 * 1024 * 1024
+
     def put(self, value: Any) -> ObjectRef:
-        return self._make_ref(self._call(
-            "put", {"value": cloudpickle.dumps(value)}))
+        blob = cloudpickle.dumps(value)
+        if len(blob) <= self.CHUNK_SIZE:
+            return self._make_ref(self._call("put", {"value": blob}))
+        import uuid
+        token = uuid.uuid4().hex
+        for i in range(0, len(blob), self.CHUNK_SIZE):
+            self._call("put_chunk", {
+                "token": token, "seq": i // self.CHUNK_SIZE,
+                "data": blob[i:i + self.CHUNK_SIZE]})
+        return self._make_ref(self._call("put", {"token": token}))
 
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
         reply = self._call("get", {"ids": [r.binary() for r in refs],
                                    "timeout": timeout})
-        return [cloudpickle.loads(v) for v in reply["values"]]
+        out = []
+        for entry in reply["values"]:
+            if entry.get("token") is not None:
+                n = entry["chunks"]
+                pieces = []
+                for i in range(n):
+                    piece = self._call("get_chunk", {
+                        "token": entry["token"], "i": i,
+                        "last": i == n - 1})
+                    pieces.append(piece["data"])
+                out.append(cloudpickle.loads(b"".join(pieces)))
+            else:
+                out.append(cloudpickle.loads(entry["value"]))
+        return out
 
     def wait(self, refs: Sequence[ObjectRef], *, num_returns: int = 1,
              timeout: Optional[float] = None):

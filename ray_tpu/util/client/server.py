@@ -50,11 +50,18 @@ def _unpickle_with_refs(payload: bytes, refs: Dict[bytes, ObjectRef]):
     return swap(value)
 
 
+#: payloads above this ride the wire in pieces instead of one frame
+#: (parity: the reference dataservicer's 64 MiB chunking —
+#: ``util/client/server/dataservicer.py``; one giant frame head-of-line
+#: blocks every other call on the connection while it serializes)
+CHUNK_SIZE = 4 * 1024 * 1024
+
+
 class ClientService:
     """One service for all client connections; per-connection ref/actor
     tables keyed by the Connection object."""
 
-    def __init__(self):
+    def __init__(self, single_client: bool = False):
         self._refs: Dict[Any, Dict[bytes, ObjectRef]] = {}
         self._actors: Dict[Any, Dict[bytes, Any]] = {}
         # per-connection, like _refs/_actors: client-supplied ids must not
@@ -62,36 +69,104 @@ class ClientService:
         # another client's function)
         self._functions: Dict[Any, Dict[str, Any]] = {}
         self._actor_classes: Dict[Any, Dict[str, Any]] = {}
+        # chunked-transfer staging, also per-connection; entries carry a
+        # timestamp and stale ones are purged on the next staging op
+        # (an interrupted large get/put must not pin its blob for the
+        # life of the connection)
+        self._upload: Dict[Any, Dict[str, tuple]] = {}
+        self._download: Dict[Any, Dict[str, tuple]] = {}
+        #: proxied (isolated) mode: this process serves ONE client and
+        #: exits when it disconnects
+        self.single_client = single_client
+        self.closed = asyncio.Event() if single_client else None
+        self._served_one = False
 
     # -- connection lifecycle -------------------------------------------
     def on_connection(self, conn) -> None:
+        if self.single_client and self._served_one:
+            conn.close()  # this process belongs to another client
+            return
+        self._served_one = True
         self._refs[conn] = {}
         self._actors[conn] = {}
         self._functions[conn] = {}
         self._actor_classes[conn] = {}
+        self._upload[conn] = {}
+        self._download[conn] = {}
 
     def on_disconnection(self, conn) -> None:
         # dropping the table drops the server-side refs -> distributed GC
-        self._refs.pop(conn, None)
+        dropped = self._refs.pop(conn, None)
         self._actors.pop(conn, None)
         self._functions.pop(conn, None)
         self._actor_classes.pop(conn, None)
+        self._upload.pop(conn, None)
+        self._download.pop(conn, None)
+        if self.single_client and dropped is not None:
+            self.closed.set()
 
     def _track(self, conn, ref: ObjectRef) -> Dict[str, Any]:
         self._refs[conn][ref.binary()] = ref
         return {"id": ref.binary(), "owner": ref.owner_address()}
 
     # -- data plane ------------------------------------------------------
+    _STAGING_TTL_S = 600.0
+
+    @staticmethod
+    def _purge_stale(table: Dict[str, tuple]) -> None:
+        import time
+        cutoff = time.monotonic() - ClientService._STAGING_TTL_S
+        for token in [t for t, (_, ts) in table.items() if ts < cutoff]:
+            del table[token]
+
+    async def handle_put_chunk(self, conn, data) -> None:
+        """Stage one piece of a large upload (client assembles via a
+        token; ``put`` with that token commits)."""
+        import time
+        self._purge_stale(self._upload[conn])
+        entry = self._upload[conn].get(data["token"])
+        if entry is None:
+            entry = self._upload[conn][data["token"]] = \
+                ([], time.monotonic())
+        entry[0].append(data["data"])
+
     async def handle_put(self, conn, data) -> Dict[str, Any]:
-        value = _unpickle_with_refs(data["value"], self._refs[conn])
+        if data.get("token") is not None:
+            payload = b"".join(
+                self._upload[conn].pop(data["token"])[0])
+        else:
+            payload = data["value"]
+        value = _unpickle_with_refs(payload, self._refs[conn])
         ref = await asyncio.to_thread(ray_tpu.put, value)
         return self._track(conn, ref)
 
     async def handle_get(self, conn, data) -> Dict[str, Any]:
+        import time
+        import uuid
+
         refs = [self._resolve(conn, b) for b in data["ids"]]
         values = await asyncio.to_thread(
             ray_tpu.get, refs, timeout=data.get("timeout"))
-        return {"values": [cloudpickle.dumps(v) for v in values]}
+        self._purge_stale(self._download[conn])
+        out = []
+        for v in values:
+            blob = cloudpickle.dumps(v)
+            if len(blob) <= CHUNK_SIZE:
+                out.append({"value": blob})
+            else:
+                token = uuid.uuid4().hex
+                self._download[conn][token] = (blob, time.monotonic())
+                out.append({"token": token, "size": len(blob),
+                            "chunks": -(-len(blob) // CHUNK_SIZE)})
+        return {"values": out}
+
+    async def handle_get_chunk(self, conn, data) -> Dict[str, Any]:
+        blob, _ts = self._download[conn][data["token"]]
+        i = data["i"]
+        piece = blob[i * CHUNK_SIZE:(i + 1) * CHUNK_SIZE]
+        if data.get("last"):
+            del self._download[conn][data["token"]]
+        return {"data": piece}
 
     async def handle_wait(self, conn, data) -> Dict[str, Any]:
         refs = [self._resolve(conn, b) for b in data["ids"]]
@@ -200,22 +275,112 @@ class ClientService:
                 ray_tpu.available_resources)}
         if kind == "ping":
             return {"value": "pong"}
+        if kind == "server_pid":
+            import os
+            return {"value": os.getpid()}
         raise rpc.RpcError(f"unknown cluster_info kind {kind!r}")
 
 
-async def _serve(host: str, port: int) -> None:
+async def _serve(host: str, port: int, single_client: bool = False
+                 ) -> None:
     # the ray:// surface reuses core method NAMES with client-shaped
     # payloads; core schema validation does not apply here
-    server = rpc.Server(ClientService(), host=host, port=port,
+    service = ClientService(single_client=single_client)
+    server = rpc.Server(service, host=host, port=port,
                         validate_schemas=False)
     addr = await server.start()
     logger.info("client server listening on %s:%s", *addr)
     print(f"ray_tpu client server ready on ray://{addr[0]}:{addr[1]}",
           flush=True)
     try:
-        await asyncio.Event().wait()
+        if single_client:
+            await service.closed.wait()  # exit with our one client
+        else:
+            await asyncio.Event().wait()
     finally:
         await server.stop()
+
+
+async def _serve_isolated(gcs_address: str, host: str, port: int) -> None:
+    """Per-client isolation (parity: reference ``proxier.py``): a mux
+    accepts on the public port and, for EVERY client connection, spawns
+    a dedicated server process with its own driver (own job id, logs,
+    and ref/actor lifetime), splicing bytes between the two sockets.
+    The child exits — and its driver's refs/actors are GC'd — when its
+    client disconnects."""
+    import sys
+
+    async def splice(reader, writer):
+        try:
+            while True:
+                data = await reader.read(256 * 1024)
+                if not data:
+                    break
+                writer.write(data)
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def on_client(creader, cwriter):
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "ray_tpu.util.client.server",
+            "--address", gcs_address, "--host", "127.0.0.1",
+            "--port", "0", "--single-client",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL)
+        child_port = None
+        try:
+            while True:
+                line = await asyncio.wait_for(proc.stdout.readline(), 120)
+                if not line:
+                    break
+                text = line.decode(errors="replace")
+                if "ready on ray://" in text:
+                    child_port = int(text.rsplit(":", 1)[1])
+                    break
+            if child_port is None:
+                raise RuntimeError("per-client server died at startup")
+
+            async def drain_stdout():
+                # keep the pipe flowing: a child that later prints past
+                # the OS pipe buffer would block inside its own writes
+                try:
+                    while await proc.stdout.read(64 * 1024):
+                        pass
+                except Exception:  # noqa: BLE001
+                    pass
+
+            asyncio.ensure_future(drain_stdout())
+            sreader, swriter = await asyncio.open_connection(
+                "127.0.0.1", child_port)
+        except Exception:  # noqa: BLE001
+            logger.exception("per-client server bring-up failed")
+            cwriter.close()
+            proc.terminate()
+            return
+        logger.info("client %s -> dedicated server pid %d (port %d)",
+                    cwriter.get_extra_info("peername"), proc.pid,
+                    child_port)
+        await asyncio.gather(splice(creader, swriter),
+                             splice(sreader, cwriter))
+        # client gone: the child notices its socket close and exits;
+        # terminate as a backstop
+        try:
+            await asyncio.wait_for(proc.wait(), 15)
+        except asyncio.TimeoutError:
+            proc.terminate()
+
+    server = await asyncio.start_server(on_client, host, port)
+    addr = server.sockets[0].getsockname()
+    print(f"ray_tpu client server (isolated) ready on "
+          f"ray://{addr[0]}:{addr[1]}", flush=True)
+    async with server:
+        await server.serve_forever()
 
 
 def main(argv=None) -> None:
@@ -225,11 +390,21 @@ def main(argv=None) -> None:
                         help="GCS address host:port of the cluster")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=10001)
+    parser.add_argument("--isolate", action="store_true",
+                        help="one dedicated server process (own driver/"
+                             "job) per client connection")
+    parser.add_argument("--single-client", action="store_true",
+                        help=argparse.SUPPRESS)  # spawned by --isolate
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if args.isolate:
+        # the mux holds no driver at all; children own theirs
+        asyncio.run(_serve_isolated(args.address, args.host, args.port))
+        return
     # init outside the event loop (driver connection is synchronous)
     ray_tpu.init(address=args.address)
-    asyncio.run(_serve(args.host, args.port))
+    asyncio.run(_serve(args.host, args.port,
+                       single_client=args.single_client))
 
 
 if __name__ == "__main__":

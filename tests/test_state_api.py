@@ -107,7 +107,12 @@ def test_dashboard_and_job_submission(tmp_path):
         assert status["cluster_resources"].get("CPU", 0) > 0
         with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
             text = r.read().decode()
-        assert "# TYPE" in text or text.strip() == ""
+        # core cluster gauges are always exported (the generated
+        # Grafana dashboard's panels query exactly these names)
+        assert "ray_tpu_alive_nodes" in text
+        assert "ray_tpu_object_store_used_bytes" in text
+        assert "ray_tpu_actors_alive" in text
+        assert "ray_tpu_tasks_finished_total" in text
 
         client = JobSubmissionClient(url)
         script = tmp_path / "job.py"
@@ -251,3 +256,46 @@ def test_per_node_dashboard_agent():
         assert any(row.get("source") == "agent" for row in rows), rows
     finally:
         dash.stop()
+
+
+def test_stack_traces():
+    """`ray-tpu stack` plumbing: every worker returns all-thread stacks
+    through the raylet fan-out (parity: reference reporter/py-spy)."""
+    from ray_tpu.core.worker import global_worker
+
+    @ray_tpu.remote
+    class Sleeper:
+        def marker_method_for_stack(self):
+            time.sleep(3.0)
+            return 1
+
+    s = Sleeper.remote()
+    ref = s.marker_method_for_stack.remote()
+    time.sleep(0.5)  # let the actor enter the sleep
+    w = global_worker()
+    dump = w.raylet_call(w.raylet_address, "stack_traces", {})
+    assert dump["workers"], "no workers dumped"
+    text = json.dumps(dump)
+    assert "marker_method_for_stack" in text
+    threads = [t for wk in dump["workers"]
+               for t in wk.get("threads", [])]
+    assert any("rtpu-io" in t["thread"] for t in threads)
+    ray_tpu.get(ref, timeout=30)
+
+
+def test_metrics_export_config(tmp_path):
+    """Prometheus/Grafana bootstrap (parity: dashboard/modules/metrics
+    config generation)."""
+    from ray_tpu.util.metrics_config import write_configs
+
+    out = write_configs(str(tmp_path / "m"),
+                        dashboard_address="127.0.0.1:9999")
+    names = {p.split("/")[-1] for p in out}
+    assert {"prometheus.yml", "grafana.ini", "default.yml",
+            "ray_tpu_default.json"} <= names
+    prom = (tmp_path / "m" / "prometheus.yml").read_text()
+    assert "127.0.0.1:9999" in prom and "/metrics" in prom
+    dash = json.loads(
+        (tmp_path / "m" / "grafana" / "dashboards" /
+         "ray_tpu_default.json").read_text())
+    assert dash["panels"]
