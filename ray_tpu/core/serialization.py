@@ -37,6 +37,11 @@ _ALIGN = 64
 META_EXCEPTION = b"__rtpu_exc__"
 
 
+#: buffers below this stay in-band (pickle stream); also the fast-
+#: path bound for small str/bytes in serialize() — keep in sync
+_INBAND_LIMIT = 512
+
+
 def _pad(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
@@ -106,7 +111,7 @@ class _RefAwarePickler(cloudpickle.CloudPickler):
 
     def _buffer_callback(self, buf: pickle.PickleBuffer) -> bool:
         view = buf.raw()
-        if view.nbytes >= 512:  # tiny buffers travel in-band
+        if view.nbytes >= _INBAND_LIMIT:  # tiny buffers travel in-band
             self._oob_buffers.append(view)
             return False  # out-of-band
         return True
@@ -143,6 +148,14 @@ def serialize(value: Any) -> SerializedObject:
             _RefAwarePickler(sink, [], []).dump({})
             _EMPTY_DICT_WIRE = sink.getvalue()
         return SerializedObject(_EMPTY_DICT_WIRE, [], [])
+    vt = type(value)
+    if vt in (int, float, bool) or (
+            vt in (str, bytes) and len(value) < _INBAND_LIMIT):
+        # primitives can contain neither ObjectRefs nor out-of-band
+        # buffers: plain C pickle, skipping the CloudPickler object +
+        # persistent_id traversal (~half the per-call serialize cost on
+        # small-result actor storms)
+        return SerializedObject(pickle.dumps(value, protocol=5), [], [])
     buffers: List = []
     contained: List = []
     sink = io.BytesIO()

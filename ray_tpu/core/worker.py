@@ -299,6 +299,11 @@ class CoreWorker:
         self._touched_states: Dict[Tuple, "_LeaseState"] = {}
         self._submit_queue = _BurstQueue(
             self._loop, self._route_submit, self._flush_submits)
+        # Exec-thread completions batch the same way: one self-pipe
+        # wakeup per burst of finished tasks instead of one per task
+        # (measured ~100us of loop work per wakeup on actor-call storms)
+        self._result_queue = _BurstQueue(
+            self._loop, lambda item: _set_future(item[0], item[1]))
         # batched pushes stream per-task results back; this maps
         # task_id -> (spec, lease state, worker) until settled
         self._streamed: Dict[bytes, tuple] = {}
@@ -2200,7 +2205,7 @@ class CoreWorker:
                 continue
             spec, reply_fut = item
             reply = self._execute_task(spec)
-            self._loop.call_soon_threadsafe(_set_future, reply_fut, reply)
+            self._result_queue.push((reply_fut, reply))
 
     def _start_extra_exec_threads(self, n: int) -> None:
         for _ in range(n):
