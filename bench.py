@@ -385,6 +385,46 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
             pg.wait(30)
             remove_placement_group(pg)
         out["pg_create_remove_per_sec"] = rate(pg_cycle, 1, reps=100)
+
+        # -- scalability envelope (BASELINE.md single-node rows) ------
+        # 10k ref args to one task (reference: 17.1 s on m4.16xlarge)
+        refs = [ray_tpu.put(i) for i in range(10_000)]
+
+        @ray_tpu.remote(num_cpus=0)
+        def arg_count(*args):
+            return len(args)
+
+        t0 = time.perf_counter()
+        n_args = ray_tpu.get(arg_count.remote(*refs), timeout=300)
+        out["args_10k_to_one_task_s"] = round(
+            time.perf_counter() - t0, 2)
+        assert n_args == 10_000
+        out["vs_ref_args_10k_to_one_task_s"] = round(
+            17.1 / out["args_10k_to_one_task_s"], 2)
+        del refs
+
+        # 3k returns from one task (reference: 6.1 s)
+        @ray_tpu.remote(num_cpus=0, num_returns=3000)
+        def many_returns():
+            return list(range(3000))
+
+        t0 = time.perf_counter()
+        ray_tpu.get(many_returns.remote(), timeout=300)
+        out["returns_3k_from_one_task_s"] = round(
+            max(time.perf_counter() - t0, 1e-3), 2)
+        out["vs_ref_returns_3k_from_one_task_s"] = round(
+            6.1 / out["returns_3k_from_one_task_s"], 2)
+
+        # queued-task capacity, reduced scale (reference: 1M in 186.9 s
+        # = 5,350/s; this row reports the same tasks/s figure at 20k)
+        n_q = 20_000
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n_q)],
+                    timeout=budget_s * 4)
+        out["queued_tasks_drain_per_sec"] = round(
+            n_q / (time.perf_counter() - t0), 1)
+        out["vs_ref_queued_tasks_drain_per_sec"] = round(
+            out["queued_tasks_drain_per_sec"] / (1_000_000 / 186.9), 3)
     except Exception as e:  # noqa: BLE001 — benchmark must always report
         out["runtime_bench_error"] = f"{type(e).__name__}: {e}"
     finally:
@@ -464,6 +504,26 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
         out["vs_ref_many_pgs"] = out["many_pgs_per_sec_4node"] / 16.8
         for pg in pgs:
             remove_placement_group(pg)
+
+        # broadcast: every node pulls one large object (reference
+        # envelope row: 1 GiB to 50 nodes in 91.3 s; reduced scale —
+        # 6 SPREAD consumers across all 4 nodes, so ~3 nodes pull
+        # through the object plane while head-placed readers are local)
+        import numpy as np
+        blob_ref = ray_tpu.put(np.ones(256 * 1024 * 1024, np.uint8))
+
+        @ray_tpu.remote(num_cpus=0.01, scheduling_strategy="SPREAD")
+        def fetch_size(refs):
+            # nested ref (not auto-resolved): the task pulls the object
+            # through its node's object plane, like a real consumer
+            return ray_tpu.get(refs[0]).nbytes
+
+        t0 = time.perf_counter()
+        sizes = ray_tpu.get([fetch_size.remote([blob_ref])
+                             for _ in range(6)], timeout=budget_s)
+        assert all(s == 256 * 1024 * 1024 for s in sizes)
+        out["broadcast_256mb_4node_s"] = round(
+            time.perf_counter() - t0, 2)
     except Exception as e:  # noqa: BLE001
         out["cluster_scale_error"] = f"{type(e).__name__}: {e}"
     finally:
@@ -538,9 +598,12 @@ def annotate_vs_prev(details: dict) -> None:
             continue
         ratio = value / prev_val
         details[f"vs_prev_{key}"] = round(ratio, 4)
-        # only throughput-style rows count as regressions (higher=better)
+        # throughput rows regress when they DROP, time rows when
+        # they GROW (higher=better vs lower=better)
         if ratio < 0.8 and ("per_sec" in key or "gbps" in key
                             or "per_chip" in key or key == "mfu"):
+            regressions.append(key)
+        elif ratio > 1.25 and key.endswith("_s"):
             regressions.append(key)
     if regressions:
         details["regressions_vs_prev"] = regressions
