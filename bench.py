@@ -292,6 +292,16 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
                 fn()
             return n * reps / (time.perf_counter() - t0)
 
+        def settle(seconds=2.0):
+            """Let the previous row's churn finish (pool refill, worker
+            reaping, deferred ref GC): on a 1-vCPU host it otherwise
+            bleeds into the next row's measurement."""
+            import gc
+            gc.collect()
+            time.sleep(seconds)
+
+        settle()  # prestart spawns from init/warmup finish first
+
         # -- tasks ----------------------------------------------------
         out["tasks_per_sec_sync"] = rate(
             lambda: ray_tpu.get(nop.remote(), timeout=30), 1, reps=300)
@@ -302,6 +312,7 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
             out["tasks_per_sec_async"] / 10905.0
         callers = [Caller.remote() for _ in range(8)]
         ray_tpu.get([c.do_tasks.remote(10) for c in callers], timeout=60)
+        settle()  # 8 caller-actor creations churned the pool
         out["multi_client_tasks_per_sec_async"] = rate(
             lambda: ray_tpu.get(
                 [c.do_tasks.remote(250) for c in callers[:4]],
@@ -320,6 +331,7 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
         out["task_scaling_curve_clients_to_per_sec"] = curve
 
         # -- actor calls ----------------------------------------------
+        settle()
         counter = Counter.remote()
         ray_tpu.get(counter.incr.remote(), timeout=30)
         out["actor_calls_per_sec_sync"] = rate(
@@ -340,6 +352,7 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
             1000, reps=3)
 
         # -- object store ---------------------------------------------
+        settle()  # drain the n:n storm's deferred ref releases
         small = b"x" * 1024
         out["put_small_per_sec"] = rate(
             lambda: ray_tpu.put(small), 1, reps=1000)
@@ -370,6 +383,10 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
 
         putters = [Putter.remote(64) for _ in range(4)]
         ray_tpu.get([p.put_big.remote(1) for p in putters], timeout=120)
+        # single-client garbage (8 x 64 MiB) must FREE before concurrent
+        # putters contend for arena space, else this row measures
+        # eviction, not the store
+        settle(3.0)
         t0 = time.perf_counter()
         ray_tpu.get([p.put_big.remote(2) for p in putters],
                     timeout=budget_s)
@@ -377,6 +394,7 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
             time.perf_counter() - t0)
 
         # -- placement groups -----------------------------------------
+        settle()
         from ray_tpu.util.placement_group import (placement_group,
                                                   remove_placement_group)
 

@@ -44,6 +44,21 @@ def ray_start_regular():
     ray_tpu.shutdown()
 
 
+@pytest.fixture
+def chaos_cluster():
+    """4 real raylets on this machine for kill-injection suites
+    (parity: reference ``ray_start_cluster`` + NodeKillerActor)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    for _ in range(3):
+        c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes()
+    yield c
+    c.shutdown()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long regression runs (deselect with -m 'not slow')")

@@ -3,22 +3,11 @@ model: reference python/ray/tests/test_chaos.py set_kill_interval +
 NodeKillerActor)."""
 
 import numpy as np
-import pytest
+import pytest  # noqa: F401 — chaos_cluster fixture from conftest
 
 import ray_tpu
 from ray_tpu._test_utils import NodeKiller, wait_for_condition
 from ray_tpu.cluster_utils import Cluster
-
-
-@pytest.fixture
-def chaos_cluster():
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
-    for _ in range(3):
-        c.add_node(num_cpus=2)
-    c.connect()
-    c.wait_for_nodes()
-    yield c
-    c.shutdown()
 
 
 @ray_tpu.remote(max_retries=5)

@@ -3,33 +3,26 @@
 — deep chains and wide fan-ins of LARGE (plasma-resident) objects whose
 copies die with killed nodes and must be recomputed from lineage)."""
 
+import time
+
 import numpy as np
-import pytest
+import pytest  # noqa: F401 — chaos_cluster fixture from conftest
 
 import ray_tpu
 from ray_tpu._test_utils import NodeKiller
-from ray_tpu.cluster_utils import Cluster
-
-
-@pytest.fixture
-def stress_cluster():
-    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
-    for _ in range(3):
-        c.add_node(num_cpus=2)
-    c.connect()
-    c.wait_for_nodes()
-    yield c
-    c.shutdown()
 
 
 @ray_tpu.remote(max_retries=8, num_cpus=0.1)
 def seed_block(seed):
-    # large enough to live in plasma, not inline replies
+    # large enough to live in plasma, not inline replies; slow enough
+    # that the workload ALWAYS overlaps the killer's first interval
+    time.sleep(0.2)
     return np.full(300_000, seed, dtype=np.int64)
 
 
 @ray_tpu.remote(max_retries=8, num_cpus=0.1)
 def fold(block, inc):
+    time.sleep(0.2)
     return block + inc
 
 
@@ -38,10 +31,10 @@ def reduce_sum(*blocks):
     return int(sum(int(b.sum()) for b in blocks))
 
 
-def test_deep_chain_reconstruction_under_kills(stress_cluster):
+def test_deep_chain_reconstruction_under_kills(chaos_cluster):
     """A 12-deep chain of plasma objects survives node kills: losing an
     intermediate forces recursive lineage replay back to the seed."""
-    killer = NodeKiller(stress_cluster, kill_interval_s=1.0,
+    killer = NodeKiller(chaos_cluster, kill_interval_s=0.6,
                        max_kills=2, seed=3).start()
     try:
         ref = seed_block.remote(1)
@@ -55,11 +48,11 @@ def test_deep_chain_reconstruction_under_kills(stress_cluster):
     assert len(killed) >= 1, "chaos did not actually kill any node"
 
 
-def test_wide_fanin_reconstruction_under_kills(stress_cluster):
+def test_wide_fanin_reconstruction_under_kills(chaos_cluster):
     """A 16-wide fan-in of plasma blocks: any subset of producers'
     outputs may be lost; the consumer's arg pull triggers per-object
     reconstruction rather than failing the reduce."""
-    killer = NodeKiller(stress_cluster, kill_interval_s=1.0,
+    killer = NodeKiller(chaos_cluster, kill_interval_s=0.6,
                        max_kills=2, seed=11).start()
     try:
         blocks = [fold.remote(seed_block.remote(s), 1)
