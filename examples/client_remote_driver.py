@@ -1,0 +1,54 @@
+"""Remote-driver (ray://) example.
+
+Start a cluster with a client server:
+
+    ray-tpu start --head --ray-client-server-port 10001
+    # or, for per-client driver isolation (one server process per
+    # connected client — the reference proxier behavior):
+    python -m ray_tpu.util.client.server --address <gcs> --isolate
+
+then run this from ANY machine that can reach it:
+
+    python examples/client_remote_driver.py ray://127.0.0.1:10001
+"""
+
+import sys
+
+import numpy as np
+
+import ray_tpu
+
+address = sys.argv[1] if len(sys.argv) > 1 else "ray://127.0.0.1:10001"
+ray_tpu.init(address=address)
+
+
+@ray_tpu.remote
+def simulate(seed: int) -> float:
+    rng = np.random.default_rng(seed)
+    return float(rng.normal(size=10_000).mean())
+
+
+@ray_tpu.remote
+class Accumulator:
+    def __init__(self):
+        self.values = []
+
+    def add(self, v):
+        self.values.append(v)
+        return len(self.values)
+
+    def summary(self):
+        return {"n": len(self.values),
+                "mean": float(np.mean(self.values))}
+
+
+acc = Accumulator.remote()
+results = ray_tpu.get([simulate.remote(s) for s in range(16)])
+for r in results:
+    acc.add.remote(r)
+print("summary:", ray_tpu.get(acc.summary.remote()))
+
+# large objects travel chunked through the proxy automatically
+big = ray_tpu.put(np.ones((2048, 2048)))
+print("roundtrip big object:", ray_tpu.get(big).shape)
+ray_tpu.shutdown()
