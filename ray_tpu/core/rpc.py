@@ -446,6 +446,12 @@ class ConnectionPool:
         self._conns: Dict[Address, Connection] = {}
         self._locks: Dict[Address, asyncio.Lock] = {}
 
+    def get_if_connected(self, address: Address) -> Optional[Connection]:
+        """Synchronous: the cached live connection, or None (for loop-
+        thread fast paths that must not await)."""
+        conn = self._conns.get(address)
+        return conn if conn is not None and not conn.closed else None
+
     async def get(self, address: Address) -> Connection:
         conn = self._conns.get(address)
         if conn is not None and not conn.closed:

@@ -1759,8 +1759,47 @@ class CoreWorker:
         spec.sequence_number = state.next_seq
         state.next_seq += 1
         state.pending[spec.sequence_number] = spec
+        # Fast path for the latency case (sync call loops): idle sender,
+        # resolved address, live pooled conn — start the RPC on THIS
+        # loop tick instead of spinning up a sender-loop coroutine.
+        # Ordering holds: the queue is empty and sends are synchronous
+        # start_calls in submission order on this thread, so this frame
+        # is the next in sequence (a backoff-delayed retry can be
+        # leapfrogged, exactly as with an idle sender loop today).
+        # len(pending)==1 gates it to the pure-latency shape: with other
+        # calls in flight (an async burst), frames must keep flowing
+        # through the sender loop so they BATCH (push_actor_tasks) —
+        # per-call frames were exactly the n:n cost this trades against
+        if len(state.pending) == 1 and not state.queue \
+                and state.address is not None \
+                and state.dead_cause is None \
+                and (state.sender_task is None
+                     or state.sender_task.done()):
+            conn = self._pool.get_if_connected(state.address)
+            if conn is not None and self._start_single_push(
+                    state, spec, state.address, conn):
+                return
         state.queue.append(spec)
         self._kick_actor_sender(state)
+
+    def _start_single_push(self, state: "_ActorSubmitState",
+                           spec: TaskSpec, address: rpc.Address,
+                           conn: rpc.Connection) -> bool:
+        """Initiate one un-batched actor-task RPC (shared by the
+        enqueue fast path and the sender loop); False means the conn
+        died before any bytes were written — requeue/resend is safe."""
+        self._record_task_event(spec, "RUNNING")
+        try:
+            reply_fut = conn.start_call(
+                "push_actor_task", {"spec_blob": _spec_dumps(spec)})
+        except rpc.ConnectionLost:
+            self._pool.invalidate(address)
+            state.address = None
+            return False
+        waiter = self._loop.create_task(
+            self._await_actor_reply(state, spec, address, reply_fut))
+        waiter.add_done_callback(lambda t: t.exception())
+        return True
 
     def _kick_actor_sender(self, state: "_ActorSubmitState") -> None:
         if state.sender_task is None or state.sender_task.done():
@@ -1801,19 +1840,11 @@ class CoreWorker:
                     batch.append(state.queue.popleft())
                 self._send_actor_batch(state, batch, address, conn)
                 continue
-            self._record_task_event(spec, "RUNNING")
-            try:
-                reply_fut = conn.start_call(
-                    "push_actor_task", {"spec_blob": _spec_dumps(spec)})
-            except rpc.ConnectionLost:
-                self._pool.invalidate(address)
-                state.address = None
-                await self._retry_or_fail_actor_task(state, spec,
-                                                     "connection lost")
+            if not self._start_single_push(state, spec, address, conn):
+                # conn died before any bytes were written: resend on a
+                # fresh connection without burning the retry budget
+                state.queue.appendleft(spec)
                 continue
-            waiter = self._loop.create_task(
-                self._await_actor_reply(state, spec, address, reply_fut))
-            waiter.add_done_callback(lambda t: t.exception())
 
     def _send_actor_batch(self, state: "_ActorSubmitState",
                           batch: List[TaskSpec], address: rpc.Address,
