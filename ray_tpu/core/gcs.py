@@ -133,6 +133,7 @@ class GcsServer:
         # creation_failed so still-initializing actors keep counting)
         self._actor_lease_charges: Dict[ActorID, NodeID] = {}
         self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
+        self._tasks_finished_total = 0  # monotonic (metrics counter)
         # (name, sorted-tags) -> aggregated metric record
         self._metrics: Dict[Any, Dict[str, Any]] = {}
         # durable tables behind the pluggable TableStorage interface
@@ -567,6 +568,10 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_report_task_events(self, conn, data):
         self._task_events.extend(data["events"])
+        # monotonic counter for the metrics surface: the ring buffer
+        # rotates, so counting FINISHED entries in it is not a counter
+        self._tasks_finished_total += sum(
+            1 for e in data["events"] if e.get("state") == "FINISHED")
         overflow = len(self._task_events) - self.config.task_events_buffer_size
         if overflow > 0:
             del self._task_events[:overflow]
@@ -610,6 +615,16 @@ class GcsServer:
     async def handle_get_task_events(self, conn, data):
         limit = data.get("limit", 1000)
         return self._task_events[-limit:]
+
+    async def handle_get_cluster_stats(self, conn, data):
+        """Cheap scalar gauges for the metrics surface (one dict, not a
+        thousand event rows per scrape)."""
+        return {
+            "tasks_finished_total": self._tasks_finished_total,
+            "alive_nodes": sum(1 for n in self.nodes.values() if n.alive),
+            "actors_alive": sum(1 for a in self.actors.values()
+                                if a.state == ACTOR_ALIVE),
+        }
 
     # ------------------------------------------------------------------
     # actor manager (GcsActorManager + GcsActorScheduler)

@@ -1294,8 +1294,12 @@ class Raylet:
         # on sync-style submit patterns; under contention it serialized
         # every worker handoff behind a 250 ms timer — the 1->8-client
         # scaling collapse).
-        contended = bool(remaining)
+        # "contended" means OTHER clients' demand is queued: a client's
+        # own phase-2 fan-out (several lease requests for one burst)
+        # must not defeat its own idle-lease grace
         for lease, worker in grants:
+            contended = any(other.conn is not lease.conn
+                            for other in remaining)
             lease.future.set_result({
                 "granted": True,
                 "worker_address": worker.task_address,
@@ -1318,11 +1322,8 @@ class Raylet:
         # work.  Idle trimming in _reap_loop shrinks the pool back.
         cap_bonus = min(len({x[2] for x in want_workers}),
                         3 * self._max_workers)
-        spawn_declined = False
         for job_id_bin, _, _conn in plain_wait[starting_plain:]:
-            if not self._start_worker(job_id_bin, False,
-                                      cap_bonus=cap_bonus):
-                spawn_declined = True
+            self._start_worker(job_id_bin, False, cap_bonus=cap_bonus)
         for job_id_bin, _, _conn in tpu_wait[self._starting_tpu:]:
             if not self._start_worker(job_id_bin, True,
                                       cap_bonus=cap_bonus):
@@ -1345,13 +1346,15 @@ class Raylet:
                 - len(self._idle) - self._starting
             for _ in range(refill):
                 self._start_worker(None)
-        elif spawn_declined and not self._idle:
-            # Demand is queued, the pool is at cap, and nothing is idle:
-            # every grantable worker is leased to some owner.  Ask the
-            # owners to hand back workers that are merely lingering in
-            # their idle-lease grace (covers leases granted BEFORE the
-            # contention arose, which the per-grant contended flag can't
-            # reach).  Rate-limited: one nudge per grace-ish window.
+        elif not self._idle:
+            # Demand is queued and nothing is idle — either the pool is
+            # at its cap or the leases failed _fits because
+            # RESOURCES are held by leased workers (including ones
+            # merely lingering in their idle grace, which generate no
+            # event on their own).  Both cases: ask the owners to hand
+            # back idle leases (covers grants made BEFORE the contention
+            # arose, which the per-grant contended flag can't reach).
+            # Rate-limited: one nudge per grace-ish window.
             now = time.monotonic()
             if now - self._last_reclaim_push >= 0.02:
                 self._last_reclaim_push = now

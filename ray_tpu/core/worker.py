@@ -1408,6 +1408,12 @@ class CoreWorker:
                 f"lease request failed: {e}"))
             return
         if reply.get("spillback"):
+            if token not in state.inflight_requests:
+                # canceled while this hop was in flight (backlog
+                # drained): following the redirect would re-register the
+                # token and park a stale request at the spillback raylet
+                # that the already-fired cancel can never reach
+                return
             await self._request_lease_chain(state, tuple(reply["spillback"]),
                                             token)
             return
@@ -1714,11 +1720,26 @@ class CoreWorker:
                     state.subscribed = True
             fut.add_done_callback(_log_failure)
             return actor_id
-        # named / get_if_exists: the reply decides (conflict or reuse)
-        reply = self._run(self.gcs_conn.call("register_actor", payload))
+        # named / get_if_exists: the reply decides (conflict or reuse).
+        # The submit state exists BEFORE the blocking call: a fast
+        # creation can deliver the auto-subscribed ALIVE push to
+        # _on_gcs_push while this thread still waits on the reply — with
+        # no state entry the address would be dropped and the first
+        # method call would sleep out the push-first grace.
+        state = self._actor_state(actor_id)
+        try:
+            reply = self._run(self.gcs_conn.call("register_actor",
+                                                 payload))
+        except Exception:
+            self._actor_states.pop(actor_id, None)
+            raise
         out_id = ActorID(reply["actor_id"])
-        if reply.get("subscribed") and not reply.get("existing"):
-            self._actor_state(out_id).subscribed = True
+        if reply.get("existing"):
+            # reusing another registration's actor: our minted id (and
+            # its pre-made state) never materialized
+            self._actor_states.pop(actor_id, None)
+        elif reply.get("subscribed"):
+            state.subscribed = True
         return out_id
 
     def _actor_state(self, actor_id: ActorID) -> "_ActorSubmitState":

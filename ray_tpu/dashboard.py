@@ -207,32 +207,27 @@ class Dashboard:
             # (parity: the reference exports ray_* system metrics;
             # the generated Grafana dashboard panels query these)
             try:
-                nodes = core.gcs_call("get_nodes", {})
+                stats = core.gcs_call("get_cluster_stats", {})
                 records.append({
                     "name": "ray_tpu_alive_nodes", "type": "gauge",
                     "description": "nodes alive in the GCS view",
-                    "value": sum(1 for n in nodes if n.get("alive"))})
-                actors = core.gcs_call("list_actors", {})
+                    "value": stats["alive_nodes"]})
                 records.append({
                     "name": "ray_tpu_actors_alive", "type": "gauge",
                     "description": "actors in state ALIVE",
-                    "value": sum(1 for a in actors
-                                 if a.get("state") == "ALIVE")})
-                stats = core.raylet_call(core.raylet_address,
+                    "value": stats["actors_alive"]})
+                records.append({
+                    "name": "ray_tpu_tasks_finished_total",
+                    "type": "counter",
+                    "description": "tasks finished (monotonic)",
+                    "value": stats["tasks_finished_total"]})
+                store = core.raylet_call(core.raylet_address,
                                          "store_stats", {})
                 records.append({
                     "name": "ray_tpu_object_store_used_bytes",
                     "type": "gauge",
                     "description": "head-node object store bytes used",
-                    "value": stats.get("used", 0)})
-                events = core.gcs_call("get_task_events", {})
-                finished = sum(1 for e in events
-                               if e.get("state") == "FINISHED")
-                records.append({
-                    "name": "ray_tpu_tasks_finished_total",
-                    "type": "counter",
-                    "description": "tasks finished (state API feed)",
-                    "value": finished})
+                    "value": store.get("used", 0)})
             except Exception:  # noqa: BLE001 — user metrics still serve
                 logger.debug("core metric collection failed",
                              exc_info=True)

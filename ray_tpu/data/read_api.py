@@ -337,6 +337,8 @@ def read_sql(sql: str, connection_factory, *,
     ``parallelism`` only controls how many blocks the result set is
     split into for downstream parallel stages.
     """
+    parallelism = max(1, int(parallelism))
+
     @ray_tpu.remote
     def _read_all() -> List[Block]:
         conn = connection_factory()
@@ -366,6 +368,7 @@ def read_mongo(uri: str, database: str, collection: str, *,
                parallelism: int = 1) -> Dataset:
     """MongoDB collection → Dataset (parity: ``mongo_datasource.py``).
     Soft-dep gated on ``pymongo`` like the reference."""
+    parallelism = max(1, int(parallelism))
     try:
         import pymongo  # noqa: F401
     except ImportError as e:

@@ -126,8 +126,10 @@ class ClientService:
         self._purge_stale(self._upload[conn])
         entry = self._upload[conn].get(data["token"])
         if entry is None:
-            entry = self._upload[conn][data["token"]] = \
-                ([], time.monotonic())
+            entry = ([], time.monotonic())
+        # refresh last-touched on EVERY chunk: a slow multi-minute
+        # upload must not be purged (and silently truncated) mid-stream
+        self._upload[conn][data["token"]] = (entry[0], time.monotonic())
         entry[0].append(data["data"])
 
     async def handle_put(self, conn, data) -> Dict[str, Any]:
