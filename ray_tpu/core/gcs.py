@@ -4,9 +4,11 @@ Parity: reference ``src/ray/gcs/gcs_server/`` — node membership
 (GcsNodeManager), actor directory + lifecycle (GcsActorManager /
 GcsActorScheduler), placement groups (GcsPlacementGroupManager, two-phase
 prepare/commit), job table, internal KV, function table, health checking
-(GcsHealthCheckManager), and the pubsub hub.  Storage is in-memory (the
-reference's default store client); the storage interface is a plain dict
-per table so a persistent backend can be slotted in later.
+(GcsHealthCheckManager), and the pubsub hub.  Table storage is pluggable
+(``core/table_storage.py``): in-memory by default (the reference's default
+store client), with a durable file-backed store that lets a restarted head
+rehydrate nodes/actors/PGs/jobs/KV — exercised by ``tests/test_chaos.py``
+(head SIGKILL mid-workload, same driver finishes).
 
 TPU twist (SURVEY.md §7.2): node registration carries topology metadata —
 slice name, chip coordinates, ICI neighbor hints — alongside resources, so
@@ -683,7 +685,14 @@ class GcsServer:
         # snapshot persists the FULL actor table, so a detached-only gate
         # would leave non-detached actors stale across a head restart
         self._schedule_persist()
-        self.publish(f"actor:{info.actor_id.hex()}", self._actor_message(info))
+        channel = f"actor:{info.actor_id.hex()}"
+        self.publish(channel, self._actor_message(info))
+        if info.state == ACTOR_DEAD:
+            # DEAD is terminal — nothing will be published here again.
+            # Dropping the channel now (not at subscriber disconnect)
+            # keeps a long-lived driver churning short-lived actors from
+            # accreting one auto-subscribed entry per dead actor
+            self.subscribers.pop(channel, None)
 
     def _actor_message(self, info: ActorInfo) -> Dict[str, Any]:
         return {

@@ -92,8 +92,16 @@ class SharedMemoryStore:
                         return  # unsupported kernel: stay lazy
                     # <=20% duty cycle: page population is kernel-side
                     # CPU burn that would otherwise starve event loops
-                    # on small hosts
-                    time_mod.sleep(4 * (time_mod.monotonic() - t0) + 0.01)
+                    # on small hosts.  Sleep in small slices re-checking
+                    # _closed: one long sleep after a slow madvise could
+                    # exceed close()'s 2 s join timeout, leaving this
+                    # thread madvising a mapping close() is tearing down
+                    pause = 4 * (time_mod.monotonic() - t0) + 0.01
+                    end = time_mod.monotonic() + pause
+                    while time_mod.monotonic() < end:
+                        if self._closed:
+                            return
+                        time_mod.sleep(0.05)
             finally:
                 del arr  # release the buffer export before any close()
         except (IndexError, ValueError, OSError):

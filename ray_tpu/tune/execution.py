@@ -321,10 +321,11 @@ class TrialRunner:
                 stop_all = True
                 for trial in pending:
                     # never-started trials end TERMINATED, not stuck
-                    # PENDING in the returned ResultGrid
+                    # PENDING in the returned ResultGrid — but they get
+                    # no on_trial_complete: callbacks that pair
+                    # start/complete or read last_result never saw an
+                    # on_trial_start for these
                     trial.status = TERMINATED
-                    self._fire("on_trial_complete", self._iteration,
-                               self.trials, trial)
                 pending.clear()
                 for trial in list(live):
                     self._stop_trial(trial, TERMINATED)

@@ -134,8 +134,13 @@ class ClientService:
 
     async def handle_put(self, conn, data) -> Dict[str, Any]:
         if data.get("token") is not None:
-            payload = b"".join(
-                self._upload[conn].pop(data["token"])[0])
+            entry = self._upload[conn].pop(data["token"], None)
+            if entry is None:
+                raise rpc.RpcError(
+                    f"upload token {data['token']!r} is unknown or was "
+                    f"purged after {self._STAGING_TTL_S:.0f}s idle — "
+                    "restart the chunked put")
+            payload = b"".join(entry[0])
         else:
             payload = data["value"]
         value = _unpickle_with_refs(payload, self._refs[conn])
@@ -163,11 +168,22 @@ class ClientService:
         return {"values": out}
 
     async def handle_get_chunk(self, conn, data) -> Dict[str, Any]:
-        blob, _ts = self._download[conn][data["token"]]
+        import time
+        entry = self._download[conn].get(data["token"])
+        if entry is None:
+            raise rpc.RpcError(
+                f"download token {data['token']!r} is unknown or was "
+                f"purged after {self._STAGING_TTL_S:.0f}s idle — "
+                "re-issue the get")
+        blob, _ts = entry
         i = data["i"]
         piece = blob[i * CHUNK_SIZE:(i + 1) * CHUNK_SIZE]
         if data.get("last"):
             del self._download[conn][data["token"]]
+        else:
+            # refresh last-touched so a slow multi-minute download is
+            # not purged (and broken) between chunk reads
+            self._download[conn][data["token"]] = (blob, time.monotonic())
         return {"data": piece}
 
     async def handle_wait(self, conn, data) -> Dict[str, Any]:
@@ -181,6 +197,16 @@ class ClientService:
     async def handle_release(self, conn, data) -> None:
         for b in data["ids"]:
             self._refs[conn].pop(b, None)
+
+    async def handle_cancel(self, conn, data) -> None:
+        ref = self._resolve(conn, data["id"])
+        await asyncio.to_thread(
+            ray_tpu.cancel, ref, force=bool(data.get("force")),
+            recursive=bool(data.get("recursive")))
+
+    async def handle_free(self, conn, data) -> None:
+        refs = [self._resolve(conn, b) for b in data["ids"]]
+        await asyncio.to_thread(ray_tpu.free, refs)
 
     def _resolve(self, conn, id_bin: bytes) -> ObjectRef:
         ref = self._refs[conn].get(id_bin)
