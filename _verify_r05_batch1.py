@@ -1,0 +1,109 @@
+"""Round-5 batch-1 verification driver: cancel + dynamic returns +
+core API sanity over a real cluster (user-style, per verify recipe)."""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import time
+import ray_tpu
+from ray_tpu import TaskCancelledError, ObjectRefGenerator
+
+t0 = time.perf_counter()
+ray_tpu.init(num_cpus=4)
+print(f"init {time.perf_counter()-t0:.2f}s")
+
+
+@ray_tpu.remote(num_cpus=0)
+def square(x):
+    return x * x
+
+
+@ray_tpu.remote(num_cpus=0)
+def add(a, b):
+    return a + b
+
+
+t0 = time.perf_counter()
+out = ray_tpu.get(add.remote(square.remote(3), square.remote(4)), timeout=30)
+assert out == 25, out
+print(f"first chained task {time.perf_counter()-t0:.2f}s")
+t0 = time.perf_counter()
+for _ in range(20):
+    ray_tpu.get(square.remote(2), timeout=30)
+print(f"20 warm tasks {time.perf_counter()-t0:.3f}s")
+
+# actors
+@ray_tpu.remote(num_cpus=0)
+class Acc:
+    def __init__(self):
+        self.v = 0
+    def bump(self, d):
+        self.v += d
+        return self.v
+
+accs = [Acc.remote() for _ in range(6)]
+t0 = time.perf_counter()
+assert ray_tpu.get([a.bump.remote(1) for a in accs], timeout=60) == [1] * 6
+print(f"6 actors ready {time.perf_counter()-t0:.2f}s")
+assert ray_tpu.get(accs[0].bump.remote(4), timeout=30) == 5  # ordered
+
+# cancel: sleeping task interrupted
+@ray_tpu.remote(num_cpus=0)
+def sleeper():
+    time.sleep(60)
+    return "done"
+
+ref = sleeper.remote()
+time.sleep(1.0)
+ray_tpu.cancel(ref)
+t0 = time.perf_counter()
+try:
+    ray_tpu.get(ref, timeout=20)
+    raise SystemExit("FAIL: cancelled task returned")
+except TaskCancelledError:
+    print(f"cancel interrupted sleeper in {time.perf_counter()-t0:.2f}s")
+
+# cancel force: tight loop, then cluster still healthy
+@ray_tpu.remote(num_cpus=0, max_retries=2)
+def spin():
+    x = 0
+    while True:
+        x += 1
+
+ref = spin.remote()
+time.sleep(1.0)
+ray_tpu.cancel(ref, force=True)
+try:
+    ray_tpu.get(ref, timeout=30)
+    raise SystemExit("FAIL: force-cancelled task returned")
+except TaskCancelledError:
+    print("force cancel ok")
+assert ray_tpu.get(square.remote(6), timeout=30) == 36  # healthy after kill
+
+# dynamic returns end-to-end, refs into downstream tasks
+@ray_tpu.remote(num_cpus=0, num_returns="dynamic")
+def chunks(n):
+    for i in range(n):
+        yield list(range(i + 1))
+
+gen = ray_tpu.get(chunks.remote(4), timeout=30)
+assert isinstance(gen, ObjectRefGenerator) and len(gen) == 4
+sums = ray_tpu.get([add.remote(sum(ray_tpu.get(r, timeout=30)), 0)
+                    for r in gen], timeout=30)
+assert sums == [0, 1, 3, 6], sums  # sum(range(i+1)) for i in 0..3
+print("dynamic returns ok")
+
+# data pipeline with shuffle (object plane all-to-all)
+import ray_tpu.data as rdata
+ds = rdata.range(200).map(
+    lambda row: {"id": row["id"] * 2}).random_shuffle()
+vals = sorted(int(r["id"]) for r in ds.take_all())
+assert vals == sorted(range(0, 400, 2)), vals[:5]
+print("data shuffle ok")
+
+t0 = time.perf_counter()
+ray_tpu.shutdown()
+print(f"shutdown {time.perf_counter()-t0:.2f}s")
+print("VERIFY BATCH1 PASS")

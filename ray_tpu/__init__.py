@@ -25,11 +25,15 @@ from ray_tpu.core.exceptions import (  # noqa: F401 — public API
     ObjectLostError,
     ObjectStoreFullError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
 from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID  # noqa: F401
-from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.object_ref import (  # noqa: F401
+    ObjectRef,
+    ObjectRefGenerator,
+)
 from ray_tpu.core import worker as _worker_mod
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction
@@ -276,14 +280,28 @@ def kill(actor: "ActorHandle", *, no_restart: bool = True) -> None:
                                            no_restart=no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    # Cooperative cancellation: drop owner-side interest. In-flight
-    # execution is not interrupted (documented limitation this round).
-    core = _worker_mod.global_worker()
-    core.task_manager.fail(ref.task_id())
+def cancel(ref: ObjectRef, *, force: bool = False,
+           recursive: bool = False) -> None:
+    """Cancel the task that produces ``ref`` (parity: reference
+    ``python/ray/_private/worker.py:2582``).  A queued task never runs;
+    a running task gets ``KeyboardInterrupt`` raised inside it;
+    ``force=True`` kills the executing worker process outright (not
+    supported for actor tasks); ``recursive=True`` also cancels the
+    task's children.  ``get`` on the ref then raises
+    :class:`TaskCancelledError` — unless the task finished first."""
+    client = _client_or_none()
+    if client is not None:
+        client.cancel(ref, force=force, recursive=recursive)
+        return
+    _worker_mod.global_worker().cancel_task(
+        ref.task_id(), force=force, recursive=recursive)
 
 
 def free(refs: Sequence[ObjectRef]) -> None:
+    client = _client_or_none()
+    if client is not None:
+        client.free(list(refs))
+        return
     _worker_mod.global_worker().free(list(refs))
 
 

@@ -40,6 +40,20 @@ class TaskError(RayTpuError):
         return cls(exc, tb, task_desc)
 
 
+class TaskCancelledError(TaskError):
+    """The task was cancelled via ``ray_tpu.cancel`` (parity: reference
+    ``python/ray/exceptions.py`` TaskCancelledError).  Raised by ``get``
+    on any of the task's return refs.  Subclasses TaskError so the
+    owner-side failure plumbing publishes it verbatim and ``get``
+    re-raises this exact type."""
+
+    def __init__(self, task_desc: str = ""):
+        super().__init__(None, "", task_desc)
+
+    def __str__(self) -> str:
+        return f"Task {self.task_desc or '<unknown>'} was cancelled"
+
+
 class ActorError(TaskError):
     """An actor task failed or the actor died before/while executing it."""
 

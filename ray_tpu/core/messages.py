@@ -77,6 +77,7 @@ register_schema("lease_worker_for_actor", actor_id=bytes, resources=dict,
 # task / actor execution
 register_schema("push_task", spec_blob=bytes)
 register_schema("push_tasks", specs_blob=bytes)
+register_schema("cancel_task", task_id=bytes)
 register_schema("create_actor", spec_blob=bytes)
 register_schema("push_actor_task", spec_blob=bytes)
 register_schema("push_actor_tasks", specs_blob=bytes)

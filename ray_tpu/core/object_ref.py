@@ -109,3 +109,32 @@ class ObjectRef:
             core.reference_counter.add_borrowed_ref(ref._id, owner_address)
             ref._registered = True
         return ref
+
+
+class ObjectRefGenerator:
+    """The value a ``num_returns="dynamic"`` task resolves to: an
+    iterable of the ObjectRefs the task yielded (parity: reference
+    ``python/ray/_raylet.pyx:603-622`` ObjectRefGenerator — the static
+    form, where the refs are known once the task finished).
+
+    ``get`` on the task's return ref produces one of these; iterating
+    yields ObjectRefs that can be ``get``-ed lazily or passed to
+    downstream tasks.  The refs travel through the normal serialization
+    path, so borrow/ownership tracking applies wherever the generator
+    object lands.
+    """
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self) -> int:
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self) -> str:
+        return f"ObjectRefGenerator({len(self._refs)} refs)"

@@ -307,6 +307,11 @@ class TaskManager:
         with self._lock:
             return task_id in self._pending
 
+    def pending_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            entry = self._pending.get(task_id)
+            return entry.spec if entry is not None else None
+
     def num_pending(self) -> int:
         with self._lock:
             return len(self._pending)

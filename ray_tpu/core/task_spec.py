@@ -90,12 +90,24 @@ class TaskSpec:
     attempt_number: int = 0
     # Depth in the lineage tree (driver = 0), bounds reconstruction.
     depth: int = 0
+    # num_returns="dynamic" (parity: _raylet.pyx:603-622): the task
+    # yields a variable number of objects; its single declared return
+    # resolves to an ObjectRefGenerator over them.
+    dynamic_returns: bool = False
 
     def return_ids(self) -> List[ObjectID]:
         return [
             ObjectID.for_task_return(self.task_id, i + 1)
             for i in range(self.num_returns)
         ]
+
+    def dynamic_return_id(self, i: int) -> ObjectID:
+        """ID of the i-th yielded object of a dynamic-returns task.
+        Index space starts after the declared returns (index 1 is the
+        generator handle), and is attempt-independent so lineage
+        reconstruction regenerates the same IDs."""
+        return ObjectID.for_task_return(self.task_id,
+                                        self.num_returns + 1 + i)
 
     def scheduling_key(self) -> Tuple:
         """Tasks with the same key can share leased workers (parity:

@@ -61,6 +61,7 @@ from ray_tpu.core.exceptions import (
     GetTimeoutError,
     ObjectLostError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -93,6 +94,13 @@ from ray_tpu.core.task_spec import (
 logger = logging.getLogger(__name__)
 
 PLASMA_MARKER = b"__RTPU_IN_PLASMA__"
+
+#: Cancel-interrupt window (per thread): True only while the exec
+#: thread is inside a task BODY (arg resolution + user function).  The
+#: worker's SIGINT handler (worker_main._install_cancel_sigint_handler)
+#: consults it so a cancel signal that lands after the body returned —
+#: during reply commit — is swallowed instead of killing the exec loop.
+INTERRUPT_WINDOW = threading.local()
 
 
 def _renv_hash(runtime_env: Optional[Dict[str, Any]]) -> Optional[str]:
@@ -309,6 +317,24 @@ class CoreWorker:
         self._streamed: Dict[bytes, tuple] = {}
         # same for batched actor pushes: (task_id, attempt) -> (spec, state)
         self._actor_streamed: Dict[tuple, tuple] = {}
+
+        # -- cancellation (parity: reference worker.py:2582 cancel path) --
+        # owner side: task_id bins with a cancel requested (suppresses
+        # retries so a killed/interrupted attempt fails as CANCELLED,
+        # never resubmits) and task_id bin -> executing worker address
+        self._cancel_requested: set = set()
+        self._task_locations: Dict[bytes, rpc.Address] = {}
+        # executor side: queued-task cancels (checked at exec start),
+        # currently-executing task per exec thread, and tasks whose exec
+        # thread got an async KeyboardInterrupt (so the catch block can
+        # tell a cancel interrupt from a user-raised KeyboardInterrupt)
+        self._cancelled_exec: set = set()
+        self._exec_track_lock = threading.Lock()
+        self._executing_by_thread: Dict[int, bytes] = {}
+        self._interrupted_tasks: set = set()
+        # owner side, recursive cancel: parent task -> child TaskIDs
+        # submitted from inside its execution on this worker
+        self._children: Dict[bytes, List[TaskID]] = {}
 
         _mark("pre_async_init")
         self._run(self._async_init())
@@ -1146,6 +1172,7 @@ class CoreWorker:
                     retry_exceptions: bool = False,
                     scheduling_strategy: Optional[SchedulingStrategy] = None,
                     runtime_env: Optional[Dict[str, Any]] = None,
+                    dynamic_returns: bool = False,
                     ) -> List[ObjectRef]:
         task_id = TaskID.for_normal_task(self.job_id)
         task_args, holds = self._build_args(args, kwargs)
@@ -1167,12 +1194,31 @@ class CoreWorker:
             runtime_env=runtime_env,
             runtime_env_hash=_renv_hash(runtime_env),
             trace_context=_trace_carrier(),
+            dynamic_returns=dynamic_returns,
         )
         rets = self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
         refs = [ObjectRef(oid, self.address) for oid in rets]
+        self._track_child(task_id)
         self._submit_to_lease_queue(spec)
         return refs
+
+    def _track_child(self, task_id: TaskID) -> None:
+        """Record parent->child lineage for recursive cancellation: a
+        task submitted while this worker executes a parent task is the
+        parent's child (this worker owns it)."""
+        if self.mode != "worker":
+            return
+        parent = self._ctx.task_id
+        if parent is None:
+            return
+        self._children.setdefault(parent.binary(), []).append(task_id)
+        if len(self._children) > 256:
+            # prune parents whose children have all settled
+            for key in list(self._children):
+                kids = self._children.get(key, [])
+                if not any(self.task_manager.is_pending(k) for k in kids):
+                    self._children.pop(key, None)
 
     def _build_args(self, args: tuple, kwargs: dict
                     ) -> Tuple[List[TaskArg], List[ObjectRef]]:
@@ -1447,6 +1493,14 @@ class CoreWorker:
         if worker.return_handle is not None:
             worker.return_handle.cancel()
             worker.return_handle = None
+        tid_bin = spec.task_id.binary()
+        if tid_bin in self._cancel_requested:
+            # cancelled between backlog pop and dispatch: never send
+            worker.inflight -= 1
+            self._fail_cancelled(spec)
+            self._pump_lease_queue(state)
+            return
+        self._task_locations[tid_bin] = worker.address
         try:
             conn = await self._pool.get(worker.address)
             self._record_task_event(spec, "RUNNING")
@@ -1478,6 +1532,16 @@ class CoreWorker:
         if worker.return_handle is not None:
             worker.return_handle.cancel()
             worker.return_handle = None
+        cancelled = [s for s in specs
+                     if s.task_id.binary() in self._cancel_requested]
+        if cancelled:
+            for spec in cancelled:
+                worker.inflight -= 1
+                self._fail_cancelled(spec)
+            specs = [s for s in specs if s not in cancelled]
+            if not specs:
+                self._pump_lease_queue(state)
+                return
         # key by (task_id, attempt): a retried task re-registers under
         # its new attempt, so a stale batch's final reply cannot steal
         # (and double-settle) the retry's entry
@@ -1485,6 +1549,7 @@ class CoreWorker:
                 for spec in specs]
         for spec, key in zip(specs, keys):
             self._streamed[key] = (spec, state, worker)
+            self._task_locations[key[0]] = worker.address
         try:
             conn = await self._pool.get(worker.address)
             conn.set_push_handler(self._on_worker_push)
@@ -1593,16 +1658,24 @@ class CoreWorker:
         if reply.get("system_error"):
             self._retry_or_fail(spec, WorkerCrashedError(reply["system_error"]))
             return
-        retryable_app_error = reply.get("app_error") and spec.retry_exceptions
+        retryable_app_error = (reply.get("app_error")
+                               and spec.retry_exceptions
+                               and not reply.get("cancelled"))
         if retryable_app_error:
             retry_spec = self.task_manager.take_for_retry(spec.task_id)
             if retry_spec is not None:
                 self._loop.call_soon_threadsafe(
                     self._enqueue_for_lease, retry_spec)
                 return
-        self._complete_task(spec, reply["results"])
+        self._complete_task(spec, reply["results"],
+                            reply.get("dynamic_return_ids"))
 
     def _retry_or_fail(self, spec: TaskSpec, error: Exception) -> None:
+        if spec.task_id.binary() in self._cancel_requested:
+            # a force-killed worker surfaces as WorkerCrashedError here;
+            # a cancel-requested task must settle CANCELLED, not retry
+            self._fail_cancelled(spec)
+            return
         retry_spec = self.task_manager.take_for_retry(spec.task_id)
         if retry_spec is not None:
             logger.info("retrying %s (attempt %d): %s",
@@ -1621,6 +1694,8 @@ class CoreWorker:
             self._loop.call_soon_threadsafe(fn, *args)
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
+        self._task_locations.pop(spec.task_id.binary(), None)
+        self._cancel_requested.discard(spec.task_id.binary())
         self.task_manager.fail(spec.task_id)
         blob = serialize_exception(
             error if isinstance(error, TaskError)
@@ -1631,9 +1706,20 @@ class CoreWorker:
         self._record_task_event(spec, "FAILED")
         self._call_on_loop(self._signal_task_done, spec.task_id)
 
-    def _complete_task(self, spec: TaskSpec, results: List[Tuple]) -> None:
+    def _complete_task(self, spec: TaskSpec, results: List[Tuple],
+                       dynamic_return_ids: Optional[List[bytes]] = None
+                       ) -> None:
         """Store task results as owner (parity: TaskManager::CompletePendingTask)."""
+        self._task_locations.pop(spec.task_id.binary(), None)
+        self._cancel_requested.discard(spec.task_id.binary())
         self.task_manager.complete(spec.task_id)
+        if dynamic_return_ids:
+            # own the yielded objects BEFORE publishing anything (the
+            # generator handle contains their refs): ownership links
+            # them to the producing task for lineage reconstruction
+            for oid_bin in dynamic_return_ids:
+                self.reference_counter.add_owned(
+                    ObjectID(oid_bin), producing_task=spec.task_id)
         for object_id_bin, kind, payload in results:
             object_id = ObjectID(object_id_bin)
             if kind == "inline":
@@ -1770,6 +1856,7 @@ class CoreWorker:
         rets = self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
         refs = [ObjectRef(oid, self.address) for oid in rets]
+        self._track_child(task_id)
         # same batched loop-wakeup path as normal tasks; FIFO drain keeps
         # per-actor sequence-number order equal to submission order
         self._submit_to_lease_queue(spec)
@@ -1809,6 +1896,12 @@ class CoreWorker:
         """Initiate one un-batched actor-task RPC (shared by the
         enqueue fast path and the sender loop); False means the conn
         died before any bytes were written — requeue/resend is safe."""
+        tid_bin = spec.task_id.binary()
+        if tid_bin in self._cancel_requested:
+            state.pending.pop(spec.sequence_number, None)
+            self._fail_cancelled(spec)
+            return True  # settled (as cancelled) — nothing to resend
+        self._task_locations[tid_bin] = address
         self._record_task_event(spec, "RUNNING")
         try:
             reply_fut = conn.start_call(
@@ -1870,10 +1963,20 @@ class CoreWorker:
     def _send_actor_batch(self, state: "_ActorSubmitState",
                           batch: List[TaskSpec], address: rpc.Address,
                           conn: rpc.Connection) -> None:
+        dropped = [s for s in batch
+                   if s.task_id.binary() in self._cancel_requested]
+        if dropped:
+            for spec in dropped:
+                state.pending.pop(spec.sequence_number, None)
+                self._fail_cancelled(spec)
+            batch = [s for s in batch if s not in dropped]
+            if not batch:
+                return
         keys = [(spec.task_id.binary(), spec.attempt_number)
                 for spec in batch]
         for spec, key in zip(batch, keys):
             self._actor_streamed[key] = (spec, state)
+            self._task_locations[key[0]] = address
             self._record_task_event(spec, "RUNNING")
         conn.set_push_handler(self._on_worker_push)
         try:
@@ -1939,6 +2042,10 @@ class CoreWorker:
 
     async def _retry_or_fail_actor_task(self, state: "_ActorSubmitState",
                                         spec: TaskSpec, reason: str) -> None:
+        if spec.task_id.binary() in self._cancel_requested:
+            state.pending.pop(spec.sequence_number, None)
+            self._fail_cancelled(spec)
+            return
         # the actor may be restarting; re-resolve and retry if allowed
         if spec.max_retries > 0:
             retry_spec = self.task_manager.take_for_retry(spec.task_id)
@@ -2084,6 +2191,72 @@ class CoreWorker:
         except Exception:  # noqa: BLE001
             pass
 
+    # ------------------------------------------------------------------
+    # task cancellation (parity: reference worker.py:2582 ray.cancel ->
+    # CoreWorker::CancelTask; the cancel RPC reaches the EXECUTING
+    # worker and interrupts the running task)
+    # ------------------------------------------------------------------
+    def cancel_task(self, task_id: TaskID, *, force: bool = False,
+                    recursive: bool = False) -> None:
+        """Cancel a submitted task: unqueue it if it has not started,
+        interrupt it (KeyboardInterrupt) if it is running, kill the
+        executing worker on ``force=True``.  ``get`` on its returns
+        raises :class:`TaskCancelledError`.  Best-effort: a task that
+        completes before the cancel lands keeps its result."""
+        spec = self.task_manager.pending_spec(task_id)
+        if spec is None:
+            return  # already finished / unknown: nothing to cancel
+        if force and spec.task_type == TaskType.ACTOR_TASK:
+            raise ValueError(
+                "force=True is not supported for actor tasks (kill the "
+                "actor with ray_tpu.kill to interrupt it hard)")
+        self._call_on_loop(self._cancel_on_loop, task_id, force, recursive)
+
+    def _cancel_on_loop(self, task_id: TaskID, force: bool,
+                        recursive: bool) -> None:
+        tid_bin = task_id.binary()
+        if not self.task_manager.is_pending(task_id):
+            return
+        self._cancel_requested.add(tid_bin)
+        # (1) still queued owner-side: unqueue + fail without any RPC
+        for state in self._lease_states.values():
+            for spec in state.backlog:
+                if spec.task_id == task_id:
+                    state.backlog.remove(spec)
+                    self._fail_cancelled(spec)
+                    return
+        for astate in self._actor_states.values():
+            for spec in list(astate.queue):
+                if spec.task_id == task_id:
+                    astate.queue.remove(spec)
+                    astate.pending.pop(spec.sequence_number, None)
+                    self._fail_cancelled(spec)
+                    return
+        # (2) dispatched: route the cancel to the worker executing it
+        address = self._task_locations.get(tid_bin)
+        if address is not None:
+            task = self._loop.create_task(
+                self._send_cancel(tid_bin, address, force, recursive))
+            task.add_done_callback(lambda t: t.exception())
+        # (3) in neither place (dispatch in flight): _cancel_requested is
+        # checked at push time and at reply time, so it still dies
+
+    async def _send_cancel(self, tid_bin: bytes, address: rpc.Address,
+                           force: bool, recursive: bool) -> None:
+        try:
+            conn = await self._pool.get(address)
+            await conn.call("cancel_task",
+                            {"task_id": tid_bin, "force": force,
+                             "recursive": recursive}, timeout=10.0)
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
+                OSError):
+            # force kills the worker mid-call: the push_task reply path
+            # sees the connection drop and settles the task as cancelled
+            pass
+
+    def _fail_cancelled(self, spec: TaskSpec) -> None:
+        self._fail_task(spec, TaskCancelledError(spec.debug_name()))
+
     def get_actor_info(self, *, actor_id: Optional[ActorID] = None,
                        name: Optional[str] = None,
                        namespace: str = "default") -> Optional[Dict[str, Any]]:
@@ -2225,9 +2398,23 @@ class CoreWorker:
         shutdown (parity: worker.main_loop / RunTaskExecutionLoop)."""
         self._consume_exec_queue()
 
+    def _exec_one(self, spec: TaskSpec) -> Dict[str, Any]:
+        """_execute_task plus a late-interrupt backstop: a cancel's
+        PyThreadState_SetAsyncExc can be delivered after the task body
+        returned (in _execute_task's finally, while it waits on the
+        tracking lock) — without this catch it would kill the exec loop
+        and drop the computed reply."""
+        try:
+            return self._execute_task(spec)
+        except KeyboardInterrupt:
+            return self._cancelled_reply(spec)
+
     def _consume_exec_queue(self) -> None:
         while not self._shutdown:
-            item = self._exec_queue.get()
+            try:
+                item = self._exec_queue.get()
+            except KeyboardInterrupt:
+                continue  # stray cancel interrupt between tasks
             if item is None:
                 break
             if len(item) == 3:  # batched push with per-task streaming
@@ -2249,15 +2436,24 @@ class CoreWorker:
                         out_batch.clear()
                 ready = _BurstQueue(self._loop, out_batch.append, _ship)
                 for s in specs:
-                    r = self._execute_task(s)
+                    r = self._exec_one(s)
                     replies.append(r)
                     ready.push((s, r))
                 self._loop.call_soon_threadsafe(_set_future, reply_fut,
                                                 replies)
                 continue
             spec, reply_fut = item
-            reply = self._execute_task(spec)
-            self._result_queue.push((reply_fut, reply))
+            reply = self._exec_one(spec)
+            while True:
+                # commit must survive a late SetAsyncExc interrupt (the
+                # extra-exec-thread cancel path has no signal-handler
+                # gate): a duplicate push is tolerated downstream, a
+                # dropped reply would hang the owner forever
+                try:
+                    self._result_queue.push((reply_fut, reply))
+                    break
+                except KeyboardInterrupt:
+                    continue
 
     def _start_extra_exec_threads(self, n: int) -> None:
         for _ in range(n):
@@ -2265,6 +2461,72 @@ class CoreWorker:
                                  name="rtpu-exec", daemon=True)
             t.start()
             self._exec_threads.append(t)
+
+    async def handle_cancel_task(self, conn, data):
+        """Owner -> executing-worker cancel RPC (parity: reference
+        CoreWorker::HandleCancelTask / _raylet.pyx:713).
+
+        Running task: raise KeyboardInterrupt inside its exec thread
+        (PyThreadState_SetAsyncExc — the CPython equivalent of the
+        reference's Cython-level interrupt).  Queued task: marked so it
+        returns a cancelled reply instead of starting.  ``force``: the
+        whole worker process exits — the owner observes the connection
+        drop and settles the task as cancelled; the raylet's worker
+        death handling reclaims the lease.  ``recursive``: cancel the
+        children this worker owns (tasks submitted from inside the
+        cancelled task) first."""
+        import ctypes
+
+        tid_bin = data["task_id"]
+        if data.get("recursive"):
+            for child in self._children.pop(tid_bin, []):
+                try:
+                    self.cancel_task(child, force=bool(data.get("force")),
+                                     recursive=True)
+                except ValueError:
+                    # force on an actor-task child: soft-cancel instead
+                    self.cancel_task(child, recursive=True)
+        running = False
+        with self._exec_track_lock:
+            for thread_id, executing in self._executing_by_thread.items():
+                if executing == tid_bin:
+                    running = True
+                    self._interrupted_tasks.add(tid_bin)
+                    if thread_id == threading.main_thread().ident:
+                        # the primary exec loop IS the worker's main
+                        # thread (worker_main.py): a REAL signal (not
+                        # PyThreadState_SetAsyncExc) is required to
+                        # interrupt a blocking C call like time.sleep —
+                        # pthread_kill gives the thread EINTR and
+                        # Python's default SIGINT handler then raises
+                        # KeyboardInterrupt in the main thread (PEP 475
+                        # re-raise instead of retry).  This matches the
+                        # reference's cancel semantics (_raylet.pyx:713)
+                        import signal as signal_mod
+                        try:
+                            signal_mod.pthread_kill(
+                                thread_id, signal_mod.SIGINT)
+                        except (OSError, RuntimeError, ValueError):
+                            ctypes.pythonapi.PyErr_SetInterrupt()
+                    else:
+                        # extra exec threads (max_concurrency > 1):
+                        # async exc lands at the next bytecode boundary
+                        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                            ctypes.c_ulong(thread_id),
+                            ctypes.py_object(KeyboardInterrupt))
+                    break
+            else:
+                self._cancelled_exec.add(tid_bin)
+                if len(self._cancelled_exec) > 4096:
+                    self._cancelled_exec.pop()
+        if data.get("force") and running:
+            # kill only when the task is actually EXECUTING here: a
+            # queued (or already-finished) target is handled by the
+            # soft mark above, and unrelated tasks sharing this worker
+            # must not die for it.  Brief delay lets this reply (and
+            # any streamed results) flush before the process dies.
+            self._loop.call_later(0.05, os._exit, 1)
+        return {"running": running}
 
     async def handle_push_task(self, conn, data):
         spec: TaskSpec = pickle.loads(data["spec_blob"])
@@ -2410,8 +2672,22 @@ class CoreWorker:
             pass
         return {"ok": True}
 
+    def _cancelled_reply(self, spec: TaskSpec) -> Dict[str, Any]:
+        blob = serialize_exception(
+            TaskCancelledError(spec.debug_name())).to_bytes()
+        return {"results": [(rid.binary(), "inline", blob)
+                            for rid in spec.return_ids()],
+                "app_error": True, "cancelled": True}
+
     def _execute_task(self, spec: TaskSpec) -> Dict[str, Any]:
         """Run one task on this thread; returns the wire reply."""
+        tid_bin = spec.task_id.binary()
+        with self._exec_track_lock:
+            if tid_bin in self._cancelled_exec:
+                # cancelled while queued: never starts
+                self._cancelled_exec.discard(tid_bin)
+                return self._cancelled_reply(spec)
+            self._executing_by_thread[threading.get_ident()] = tid_bin
         prev = (self._ctx.task_id, self._ctx.put_counter,
                 self._ctx.attempt_number, self._ctx.current_resources)
         self._ctx.task_id = spec.task_id
@@ -2421,6 +2697,7 @@ class CoreWorker:
             self.job_id = spec.job_id
         self._ctx.current_resources = dict(spec.resources)
         try:
+            INTERRUPT_WINDOW.open = True
             self._apply_job_syspath(spec.job_id)
             self._ensure_runtime_env(spec)
             args, kwargs = self._resolve_args(spec)
@@ -2435,10 +2712,15 @@ class CoreWorker:
                 value = fn(*args, **kwargs)
             if asyncio.iscoroutine(value):
                 value = asyncio.run(value)
+            # body done: results are being committed from here on — a
+            # cancel interrupt landing now must not drop them
+            INTERRUPT_WINDOW.open = False
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 results = [(rid.binary(), "inline", serialize(None).to_bytes())
                            for rid in spec.return_ids()]
                 return {"results": results}
+            if spec.dynamic_returns:
+                return self._post_dynamic_returns(spec, value)
             if spec.num_returns == 1:
                 values = [value]
             else:
@@ -2452,6 +2734,12 @@ class CoreWorker:
                 results.append(self._post_return(rid, v, spec))
             return {"results": results}
         except BaseException as e:  # noqa: BLE001 — errors travel to caller
+            if (isinstance(e, KeyboardInterrupt)
+                    and tid_bin in self._interrupted_tasks):
+                # cancel-driven interrupt (handle_cancel_task raised it
+                # into this thread), not a user Ctrl-C
+                self._interrupted_tasks.discard(tid_bin)
+                return self._cancelled_reply(spec)
             logger.debug("task %s raised", spec.debug_name(), exc_info=True)
             blob = serialize_exception(
                 TaskError.from_exception(e, spec.debug_name())).to_bytes()
@@ -2459,8 +2747,36 @@ class CoreWorker:
                        for rid in spec.return_ids()]
             return {"results": results, "app_error": True}
         finally:
+            INTERRUPT_WINDOW.open = False
             (self._ctx.task_id, self._ctx.put_counter,
              self._ctx.attempt_number, self._ctx.current_resources) = prev
+            with self._exec_track_lock:
+                self._executing_by_thread.pop(threading.get_ident(), None)
+                self._interrupted_tasks.discard(tid_bin)
+
+    def _post_dynamic_returns(self, spec: TaskSpec, value: Any
+                              ) -> Dict[str, Any]:
+        """num_returns="dynamic" (parity: _raylet.pyx:603-622,946): the
+        task body is a generator; each yielded value becomes its own
+        object (stored as the owner's, with a deterministic id so
+        lineage reconstruction regenerates it), and the task's single
+        declared return resolves to an ObjectRefGenerator over them."""
+        from ray_tpu.core.object_ref import ObjectRefGenerator
+
+        results = []
+        refs = []
+        for i, item in enumerate(value):
+            rid = spec.dynamic_return_id(i)
+            results.append(self._post_return(rid, item, spec))
+            refs.append(ObjectRef(rid, spec.owner_address,
+                                  _register=False))
+        gen_id = spec.return_ids()[0]
+        gen = ObjectRefGenerator(refs)
+        # the generator handle is listed LAST: the owner registers the
+        # dynamic ids as owned before any consumer can see their refs
+        results.append(self._post_return(gen_id, gen, spec))
+        return {"results": results,
+                "dynamic_return_ids": [r.id().binary() for r in refs]}
 
     def _post_return(self, object_id: ObjectID, value: Any,
                      spec: TaskSpec) -> Tuple[bytes, str, Any]:

@@ -12,6 +12,26 @@ import os
 import sys
 
 
+def _install_cancel_sigint_handler() -> None:
+    """Task cancellation delivers a real SIGINT to this process's main
+    thread (worker.py handle_cancel_task -> pthread_kill).  Gate it on
+    the per-thread interrupt window: inside a task body it raises
+    KeyboardInterrupt (the reference's cancel semantics); landing in
+    the commit phase — after the body returned, while the reply is
+    being shipped — it is swallowed so the exec loop (and the computed
+    reply) survive the race."""
+    import signal
+
+    def handler(signum, frame):
+        from ray_tpu.core.worker import INTERRUPT_WINDOW
+        if getattr(INTERRUPT_WINDOW, "open", False):
+            raise KeyboardInterrupt
+        # cancel raced task completion: ignore — the cancel reply path
+        # already settles the task owner-side
+
+    signal.signal(signal.SIGINT, handler)
+
+
 def main() -> None:
     import time
     t0 = time.perf_counter()
@@ -56,6 +76,7 @@ def main() -> None:
 
     from ray_tpu.core.ids import JobID, NodeID
     from ray_tpu.core.worker import CoreWorker
+    _install_cancel_sigint_handler()
     mark("imports")
 
     def parse_addr(s: str):

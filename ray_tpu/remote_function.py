@@ -116,12 +116,16 @@ class RemoteFunction:
             if opts.get("memory"):
                 resources["memory"] = float(opts["memory"])
             strat_opt = opts.get("scheduling_strategy")
+            nret = opts.get("num_returns", 1)
+            # num_returns="dynamic" (parity: _raylet.pyx:603): one
+            # declared return that resolves to an ObjectRefGenerator
             resolved = (
                 resources,
-                int(opts.get("num_returns", 1)),
+                1 if nret == "dynamic" else int(nret),
                 opts.get("max_retries"),
                 bool(opts.get("retry_exceptions", False)),
                 _resolve_strategy(strat_opt),
+                nret == "dynamic",
             )
             # a duck-typed strategy object (or a user-held resources dict)
             # may be mutated between calls — only cache when everything
@@ -130,7 +134,8 @@ class RemoteFunction:
                     strat_opt, (str, SchedulingStrategy))) \
                     and opts.get("resources") is None:
                 self._resolved = resolved
-        resources, num_returns, max_retries, retry_exc, strategy = resolved
+        (resources, num_returns, max_retries, retry_exc, strategy,
+         dynamic) = resolved
         refs = core.submit_task(
             function_id,
             self._descriptor,
@@ -142,6 +147,7 @@ class RemoteFunction:
             retry_exceptions=retry_exc,
             scheduling_strategy=strategy,
             runtime_env=self._packaged_runtime_env(core),
+            dynamic_returns=dynamic,
         )
         return refs[0] if num_returns == 1 else refs
 

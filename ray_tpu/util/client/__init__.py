@@ -190,6 +190,14 @@ class ClientWorker:
         self._call("kill_actor", {"actor_id": actor_id.binary(),
                                   "no_restart": no_restart})
 
+    def cancel(self, ref: ObjectRef, *, force: bool = False,
+               recursive: bool = False) -> None:
+        self._call("cancel", {"id": ref.binary(), "force": force,
+                              "recursive": recursive})
+
+    def free(self, refs: List[ObjectRef]) -> None:
+        self._call("free", {"ids": [r.binary() for r in refs]})
+
     # -- introspection ---------------------------------------------------
     def cluster_info(self, kind: str) -> Any:
         return self._call("cluster_info", {"kind": kind})["value"]
