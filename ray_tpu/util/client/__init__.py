@@ -190,6 +190,34 @@ class ClientWorker:
         self._call("kill_actor", {"actor_id": actor_id.binary(),
                                   "no_restart": no_restart})
 
+    # -- placement groups ------------------------------------------------
+    def pg_create(self, bundles, strategy, name):
+        from ray_tpu.core.ids import PlacementGroupID
+        from ray_tpu.util.placement_group import PlacementGroup
+        reply = self._call("pg_create", {
+            "bundles": bundles, "strategy": strategy, "name": name})
+        return PlacementGroup(PlacementGroupID(reply["pg_id"]),
+                              bundles, strategy)
+
+    def pg_remove(self, pg_id) -> None:
+        self._call("pg_remove", {"pg_id": pg_id.binary()})
+
+    def pg_wait(self, pg_id, timeout_seconds: float) -> bool:
+        return self._call("pg_wait", {
+            "pg_id": pg_id.binary(),
+            "timeout": timeout_seconds})["ready"]
+
+    def pg_ready(self, pg_id) -> ObjectRef:
+        return self._make_ref(self._call("pg_ready",
+                                         {"pg_id": pg_id.binary()}))
+
+    def pg_bundle_nodes(self, pg_id):
+        return self._call("pg_bundle_nodes",
+                          {"pg_id": pg_id.binary()})["bundle_nodes"]
+
+    def pg_table(self):
+        return self._call("pg_table", {})["table"]
+
     def cancel(self, ref: ObjectRef, *, force: bool = False,
                recursive: bool = False) -> None:
         self._call("cancel", {"id": ref.binary(), "force": force,

@@ -64,6 +64,9 @@ class ClientService:
     def __init__(self, single_client: bool = False):
         self._refs: Dict[Any, Dict[bytes, ObjectRef]] = {}
         self._actors: Dict[Any, Dict[bytes, Any]] = {}
+        # placement groups created by each client; removed at disconnect
+        # (a remote driver's gangs die with it, like local-driver PGs)
+        self._pgs: Dict[Any, Dict[bytes, Any]] = {}
         # per-connection, like _refs/_actors: client-supplied ids must not
         # collide across clients (an id collision would silently run
         # another client's function)
@@ -93,6 +96,7 @@ class ClientService:
         self._actor_classes[conn] = {}
         self._upload[conn] = {}
         self._download[conn] = {}
+        self._pgs[conn] = {}
 
     def on_disconnection(self, conn) -> None:
         # dropping the table drops the server-side refs -> distributed GC
@@ -102,6 +106,13 @@ class ClientService:
         self._actor_classes.pop(conn, None)
         self._upload.pop(conn, None)
         self._download.pop(conn, None)
+        for pg in (self._pgs.pop(conn, None) or {}).values():
+            try:
+                from ray_tpu.util.placement_group import \
+                    remove_placement_group
+                remove_placement_group(pg)
+            except Exception:  # noqa: BLE001 — best-effort reap
+                logger.debug("client PG cleanup failed", exc_info=True)
         if self.single_client and dropped is not None:
             self.closed.set()
 
@@ -197,6 +208,49 @@ class ClientService:
     async def handle_release(self, conn, data) -> None:
         for b in data["ids"]:
             self._refs[conn].pop(b, None)
+
+    # -- placement groups (reference ray_client.proto: the client proxy
+    # carries the full PG surface, not just tasks/actors) ---------------
+    async def handle_pg_create(self, conn, data) -> Dict[str, Any]:
+        from ray_tpu.util.placement_group import placement_group
+        pg = await asyncio.to_thread(
+            placement_group, data["bundles"],
+            strategy=data.get("strategy", "PACK"),
+            name=data.get("name"))
+        self._pgs[conn][pg.id.binary()] = pg
+        return {"pg_id": pg.id.binary(), "strategy": pg.strategy}
+
+    def _resolve_pg(self, conn, pg_id_bin: bytes):
+        pg = self._pgs[conn].get(pg_id_bin)
+        if pg is None:
+            raise rpc.RpcError(
+                f"placement group {pg_id_bin.hex()} unknown on this "
+                "connection (removed or from another session)")
+        return pg
+
+    async def handle_pg_remove(self, conn, data) -> None:
+        from ray_tpu.util.placement_group import remove_placement_group
+        pg = self._resolve_pg(conn, data["pg_id"])
+        await asyncio.to_thread(remove_placement_group, pg)
+        self._pgs[conn].pop(data["pg_id"], None)
+
+    async def handle_pg_wait(self, conn, data) -> Dict[str, Any]:
+        pg = self._resolve_pg(conn, data["pg_id"])
+        ready = await asyncio.to_thread(
+            pg.wait, data.get("timeout", 30.0))
+        return {"ready": ready}
+
+    async def handle_pg_ready(self, conn, data) -> Dict[str, Any]:
+        pg = self._resolve_pg(conn, data["pg_id"])
+        return self._track(conn, pg.ready())
+
+    async def handle_pg_bundle_nodes(self, conn, data) -> Dict[str, Any]:
+        pg = self._resolve_pg(conn, data["pg_id"])
+        return {"bundle_nodes": await asyncio.to_thread(pg.bundle_nodes)}
+
+    async def handle_pg_table(self, conn, data) -> Dict[str, Any]:
+        from ray_tpu.util.placement_group import placement_group_table
+        return {"table": await asyncio.to_thread(placement_group_table)}
 
     async def handle_cancel(self, conn, data) -> None:
         ref = self._resolve(conn, data["id"])
