@@ -336,7 +336,11 @@ def get_actor(name: str, namespace: str = "default"):
 
 
 def method(**options):
-    """Decorator for actor methods (``num_returns`` option)."""
+    """Decorator for actor methods (parity: ``ray.method`` — reference
+    ``python/ray/actor.py:65-83``).  ``num_returns`` and
+    ``concurrency_group`` options; the latter routes the method into
+    the named executor pool declared via
+    ``@remote(concurrency_groups={...})``."""
     def decorate(m):
         m.__rtpu_method_options__ = options
         return m

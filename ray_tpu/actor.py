@@ -22,15 +22,18 @@ from ray_tpu.remote_function import _resolve_strategy
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, concurrency_group: str = ""):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **opts) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name,
-                           num_returns=int(opts.get("num_returns",
-                                                    self._num_returns)))
+        return ActorMethod(
+            self._handle, self._method_name,
+            num_returns=int(opts.get("num_returns", self._num_returns)),
+            concurrency_group=opts.get("concurrency_group",
+                                       self._concurrency_group))
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         core = worker_mod.global_worker()
@@ -41,6 +44,7 @@ class ActorMethod:
             kwargs,
             num_returns=self._num_returns,
             max_task_retries=self._handle._max_task_retries,
+            concurrency_group=self._concurrency_group,
         )
         return refs[0] if self._num_returns == 1 else refs
 
@@ -183,6 +187,9 @@ class ActorClass:
             namespace=opts.get("namespace", "default"),
             lifetime_detached=opts.get("lifetime") == "detached",
             max_concurrency=int(opts.get("max_concurrency", 1)),
+            concurrency_groups={
+                str(k): int(v) for k, v in
+                (opts.get("concurrency_groups") or {}).items()},
         )
         renv = opts.get("runtime_env")
         if renv:

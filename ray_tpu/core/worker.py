@@ -270,6 +270,8 @@ class CoreWorker:
         self._actor_id: Optional[ActorID] = None
         self._actor_creation_spec: Optional[ActorCreationSpec] = None
         self._max_concurrency = 1
+        # named concurrency groups: group -> dedicated exec queue
+        self._group_queues: Dict[str, "queue_mod.Queue"] = {}
         self._actor_reply_cache: Dict[Tuple, Dict[str, Any]] = {}
 
         # submitters
@@ -1837,7 +1839,8 @@ class CoreWorker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict, *, num_returns: int = 1,
-                          max_task_retries: int = 0) -> List[ObjectRef]:
+                          max_task_retries: int = 0,
+                          concurrency_group: str = "") -> List[ObjectRef]:
         task_id = TaskID.for_actor_task(actor_id)
         task_args, holds = self._build_args(args, kwargs)
         spec = TaskSpec(
@@ -1851,6 +1854,7 @@ class CoreWorker:
             max_retries=max_task_retries,
             owner_address=self.address,
             actor_id=actor_id,
+            concurrency_group=concurrency_group,
             trace_context=_trace_carrier(),
         )
         rets = self.task_manager.register(spec)
@@ -2409,10 +2413,28 @@ class CoreWorker:
         except KeyboardInterrupt:
             return self._cancelled_reply(spec)
 
-    def _consume_exec_queue(self) -> None:
+    def _exec_queue_for(self, spec: TaskSpec) -> "queue_mod.Queue":
+        """Concurrency-group routing (parity: reference actor.py:65-83):
+        an actor task runs in its named group's executor pool when the
+        call (or the method's @method declaration) names one; everything
+        else shares the default pool.  A saturated default pool can then
+        never starve control-plane methods in their own group."""
+        if not self._group_queues:
+            return self._exec_queue
+        group = spec.concurrency_group
+        if not group and self._actor_instance is not None:
+            meth = getattr(type(self._actor_instance),
+                           spec.function_descriptor, None)
+            group = (getattr(meth, "__rtpu_method_options__", None)
+                     or {}).get("concurrency_group", "")
+        return self._group_queues.get(group, self._exec_queue)
+
+    def _consume_exec_queue(self, q: Optional["queue_mod.Queue"] = None
+                            ) -> None:
+        q = q if q is not None else self._exec_queue
         while not self._shutdown:
             try:
-                item = self._exec_queue.get()
+                item = q.get()
             except KeyboardInterrupt:
                 continue  # stray cancel interrupt between tasks
             if item is None:
@@ -2461,6 +2483,18 @@ class CoreWorker:
                                  name="rtpu-exec", daemon=True)
             t.start()
             self._exec_threads.append(t)
+
+    def _start_concurrency_groups(self, groups: Dict[str, int]) -> None:
+        """One dedicated queue + thread pool per named group."""
+        for name, n_threads in groups.items():
+            gq: "queue_mod.Queue" = queue_mod.Queue()
+            self._group_queues[name] = gq
+            for _ in range(max(1, int(n_threads))):
+                t = threading.Thread(
+                    target=self._consume_exec_queue, args=(gq,),
+                    name=f"rtpu-exec-{name}", daemon=True)
+                t.start()
+                self._exec_threads.append(t)
 
     async def handle_cancel_task(self, conn, data):
         """Owner -> executing-worker cancel RPC (parity: reference
@@ -2565,7 +2599,7 @@ class CoreWorker:
         if cached is not None:  # duplicate delivery after a retry
             return cached
         reply_fut = self._loop.create_future()
-        self._exec_queue.put((spec, reply_fut))
+        self._exec_queue_for(spec).put((spec, reply_fut))
         reply = await reply_fut
         self._cache_actor_reply(cache_key, reply)
         return reply
@@ -2619,7 +2653,7 @@ class CoreWorker:
 
             reply_fut.add_done_callback(_done)
             waiters.append(reply_fut)
-            self._exec_queue.put((spec, reply_fut))
+            self._exec_queue_for(spec).put((spec, reply_fut))
         if cached_out:
             conn.push("actor_task_results", cached_out)
         if waiters:
@@ -2656,6 +2690,8 @@ class CoreWorker:
         self._max_concurrency = max(1, creation.max_concurrency)
         if self._max_concurrency > 1:
             self._start_extra_exec_threads(self._max_concurrency - 1)
+        if creation.concurrency_groups:
+            self._start_concurrency_groups(creation.concurrency_groups)
         # register on our own GCS connection so the GCS can detect death
         # of this actor when the connection drops.  Fired without awaiting:
         # the reply carries nothing, and blocking actor creation on a GCS

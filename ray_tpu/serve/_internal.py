@@ -62,6 +62,7 @@ class ServeReplica:
         if user_config is not None:
             self.reconfigure(user_config)
 
+    @ray_tpu.method(concurrency_group="control")
     def reconfigure(self, user_config: Any) -> bool:
         fn = getattr(self._callable, "reconfigure", None)
         if fn is not None:
@@ -81,13 +82,16 @@ class ServeReplica:
             with self._lock:
                 self._inflight -= 1
 
+    @ray_tpu.method(concurrency_group="control")
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
             return {"inflight": self._inflight, "total": self._total}
 
+    @ray_tpu.method(concurrency_group="control")
     def ready(self) -> bool:
         return True
 
+    @ray_tpu.method(concurrency_group="control")
     def node_id(self) -> Optional[str]:
         """Hex node id this replica runs on (locality routing)."""
         try:
@@ -349,8 +353,13 @@ class ServeController:
         try:
             opts = dict(config.ray_actor_options or {})
             init_args, init_kwargs = dep["init"]
+            # control methods (health/metrics/reconfigure) run in their
+            # own concurrency group so a saturated handle_request pool
+            # cannot starve them (reference: replicas use a dedicated
+            # control concurrency group — actor.py:65-83)
             replica = ServeReplica.options(
                 max_concurrency=max(4, config.max_concurrent_queries),
+                concurrency_groups={"control": 2},
                 **opts).remote(dep["blob"], init_args, init_kwargs,
                                config.user_config)
             ray_tpu.get(replica.ready.remote(), timeout=120)
