@@ -181,3 +181,8 @@ def read_bytes(uri: str) -> bytes:
 def exists(uri: str) -> bool:
     backend, path = get_storage(uri)
     return backend.exists(path)
+
+
+def delete(uri: str) -> None:
+    backend, path = get_storage(uri)
+    backend.delete(path)

@@ -41,6 +41,13 @@ class Config:
     object_store_eviction_fraction: float = 1.0
     #: Directory for spilled objects ("" = <session_dir>/spill).
     object_spilling_directory: str = ""
+    #: External spill tier as a URI (e.g. ``file:///mnt/shared/spill``;
+    #: scheme-pluggable via ``ray_tpu.air.storage.register_storage`` —
+    #: parity: reference ``_private/external_storage.py`` smart_open
+    #: URIs).  When set, spilled primaries go to the URI and the OWNER
+    #: records it, so the object survives the spilling node's death and
+    #: restores on any node.  "" = local-directory spill only.
+    object_spilling_uri: str = ""
     #: Start spilling primary copies when the store is this full.
     object_spilling_threshold: float = 0.8
 

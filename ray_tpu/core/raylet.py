@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core import rpc
 from ray_tpu.core.config import Config
+from ray_tpu.core.exceptions import ObjectStoreFullError
 from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
 
@@ -1707,11 +1708,31 @@ class Raylet:
     # object plane: local store service
     # ------------------------------------------------------------------
     async def handle_object_create(self, conn, data):
+        """Allocate store space, spilling/evicting to make room.
+
+        Retry loop parity: plasma's CreateRequestQueue — under a burst
+        of concurrent creates the primaries that COULD be spilled may
+        not be sealed yet (create happens before seal), so a single
+        spill-then-alloc pass fails spuriously; retrying lets in-flight
+        writers seal and become spillable."""
         object_id = ObjectID(data["object_id"])
         size = data["size"]
-        self._maybe_spill(size)
-        offset, _ = self.store.alloc(object_id, size)  # raises if full
-        return {"offset": offset, "size": size}
+        if size > self.store_capacity:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds the store capacity "
+                f"({self.store_capacity}) — no amount of spilling fits it")
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._maybe_spill(size)
+            try:
+                offset, _ = self.store.alloc(object_id, size)
+                return {"offset": offset, "size": size}
+            except ValueError:
+                raise  # already exists — caller bug, don't retry
+            except ObjectStoreFullError:
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.05)
 
     async def handle_object_seal(self, conn, data):
         object_id = ObjectID(data["object_id"])
@@ -1776,7 +1797,12 @@ class Raylet:
                         continue
                     if await self._pull_from(tuple(node_addr), oid):
                         return True
-                if locs.get("spilled_on") :
+                if locs.get("spilled_uri"):
+                    # external tier: restore directly, no matter which
+                    # node spilled it (it may be dead — that's the point)
+                    if self._restore_from_uri(oid, locs["spilled_uri"]):
+                        return True
+                if locs.get("spilled_on"):
                     node_addr = tuple(locs["spilled_on"])
                     if node_addr == self.server.address:
                         return self._restore_from_spill(oid)
@@ -1875,11 +1901,15 @@ class Raylet:
             if oid in self._primary:
                 self._primary.discard(oid)
                 self.store.release(oid)
-            path = self._spilled.pop(oid, None)
-            if path:
+            target = self._spilled.pop(oid, None)
+            if target:
                 try:
-                    os.unlink(path)
-                except OSError:
+                    if "://" in target:
+                        from ray_tpu.air import storage as air_storage
+                        air_storage.delete(target)
+                    else:
+                        os.unlink(target)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
             self.store.delete(oid)
             self._owner_of.pop(oid, None)
@@ -1908,6 +1938,7 @@ class Raylet:
             return
         need = stats["used"] + incoming - int(threshold)
         # spill pinned primaries LRU-first; unpinned copies just evict
+        spill_uri = self.config.object_spilling_uri
         spilled = 0
         for oid in list(self._primary):
             if spilled >= need:
@@ -1917,28 +1948,84 @@ class Raylet:
                 self._primary.discard(oid)
                 continue
             offset, size = lease
-            path = os.path.join(self._spill_dir, oid.hex())
             try:
-                with open(path, "wb") as f:
-                    f.write(self.store.view(offset, size))
+                if spill_uri:
+                    # external tier: the blob outlives this node, and
+                    # the owner learns the URI so ANY node can restore
+                    # (parity: reference external_storage.py)
+                    from ray_tpu.air import storage as air_storage
+                    uri = air_storage.join(spill_uri, oid.hex())
+                    air_storage.write_bytes(
+                        uri, bytes(self.store.view(offset, size)))
+                    self._spilled[oid] = uri
+                    self._notify_owner_spilled(oid, uri)
+                else:
+                    path = os.path.join(self._spill_dir, oid.hex())
+                    with open(path, "wb") as f:
+                        f.write(self.store.view(offset, size))
+                    self._spilled[oid] = path
+            except Exception:  # noqa: BLE001 — spill tier down: keep the
+                # in-store copy (primary pin stays; finally drops only
+                # the lease taken above)
+                logger.exception("spill of %s failed; keeping in-store",
+                                 oid.hex()[:12])
+                continue
             finally:
                 self.store.release(oid)
-            self._spilled[oid] = path
             self._primary.discard(oid)
             self.store.release(oid)  # drop the primary pin
             self.store.delete(oid)
             spilled += size
 
+    def _notify_owner_spilled(self, oid: ObjectID, uri: str) -> None:
+        """Fire-and-forget: tell the owner where the blob lives so the
+        object survives this node (restores anywhere)."""
+        owner = self._owner_of.get(oid)
+        if owner is None:
+            return
+
+        async def _tell():
+            try:
+                conn = await self.pool.get((owner[1], owner[2]))
+                await conn.call("object_spilled",
+                                {"object_id": oid.binary(), "uri": uri},
+                                timeout=10.0)
+            except Exception:  # noqa: BLE001 — best-effort; local
+                pass           # restore still works via self._spilled
+
+        task = asyncio.get_running_loop().create_task(_tell())
+        task.add_done_callback(lambda t: t.exception())
+
     def _restore_from_spill(self, oid: ObjectID) -> bool:
-        path = self._spilled.get(oid)
-        if path is None or not os.path.exists(path):
+        target = self._spilled.get(oid)
+        if target is None:
             return False
-        size = os.path.getsize(path)
+        if "://" in target:
+            return self._restore_from_uri(oid, target)
+        if not os.path.exists(target):
+            return False
+        size = os.path.getsize(target)
         try:
             view = self.store.create(oid, size)
         except Exception:
             return False
-        with open(path, "rb") as f:
+        with open(target, "rb") as f:
             f.readinto(view)
+        self.store.seal(oid)
+        return True
+
+    def _restore_from_uri(self, oid: ObjectID, uri: str) -> bool:
+        """Restore a URI-spilled blob — works on ANY node, including
+        ones that never held the object (the spiller may be dead)."""
+        try:
+            from ray_tpu.air import storage as air_storage
+            data = air_storage.read_bytes(uri)
+        except Exception:  # noqa: BLE001 — missing/unreachable tier
+            return False
+        try:
+            view = self.store.create(oid, len(data))
+        except Exception:  # noqa: BLE001 — store full/exists
+            return self.store.contains(oid)
+        view[:] = data
         self.store.seal(oid)
         return True

@@ -43,7 +43,8 @@ class Reference:
     ×3 per task).  Mutating sites replace the singleton first."""
 
     __slots__ = ("local_refs", "submitted_refs", "borrowers", "owned",
-                 "owner_address", "locations", "spilled_on", "in_plasma",
+                 "owner_address", "locations", "spilled_on", "spilled_uri",
+                 "in_plasma",
                  "producing_task", "contained_ids", "freed")
 
     _EMPTY_SET: frozenset = frozenset()
@@ -57,6 +58,7 @@ class Reference:
         # nodes (raylet addresses) known to hold a shm copy; owner-side only
         self.locations: Set[tuple] = self._EMPTY_SET
         self.spilled_on: Optional[tuple] = None
+        self.spilled_uri: Optional[str] = None
         self.in_plasma = False
         # lineage: the task that produces this object (owner-side)
         self.producing_task: Optional[TaskID] = None
@@ -189,6 +191,17 @@ class ReferenceCounter:
     def set_spilled(self, object_id: ObjectID, node_address: tuple) -> None:
         with self._lock:
             self._get(object_id).spilled_on = node_address
+
+    def set_spilled_uri(self, object_id: ObjectID, uri: str) -> None:
+        """External spill tier: the blob survives the spilling node, so
+        the owner records the URI (any node can restore from it)."""
+        with self._lock:
+            self._get(object_id).spilled_uri = uri
+
+    def get_spilled_uri(self, object_id: ObjectID) -> Optional[str]:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref.spilled_uri if ref is not None else None
 
     def remove_location(self, object_id: ObjectID, node_address: tuple) -> None:
         with self._lock:

@@ -1040,6 +1040,7 @@ class CoreWorker:
         self.memory_store.delete(object_id)
         if ref_info.in_plasma and not self._shutdown:
             locations = set(ref_info.locations)
+            spilled_uri = getattr(ref_info, "spilled_uri", None)
             async def _free():
                 for node_addr in locations:
                     try:
@@ -1047,6 +1048,15 @@ class CoreWorker:
                         await conn.call("object_free",
                                         {"object_ids": [object_id.binary()]})
                     except Exception:
+                        pass
+                if spilled_uri:
+                    # the spilling node may be dead — the owner deletes
+                    # the external blob so the URI tier doesn't leak
+                    try:
+                        from ray_tpu.air import storage as air_storage
+                        await asyncio.to_thread(air_storage.delete,
+                                                spilled_uri)
+                    except Exception:  # noqa: BLE001 — best-effort
                         pass
             try:
                 self._post(_free())
@@ -1118,7 +1128,16 @@ class CoreWorker:
         pending = self.task_manager.is_pending(object_id.task_id())
         return {"nodes": [list(a) for a in locations],
                 "spilled_on": list(spilled) if spilled else None,
+                "spilled_uri":
+                    self.reference_counter.get_spilled_uri(object_id),
                 "pending": pending}
+
+    async def handle_object_spilled(self, conn, data):
+        """A raylet spilled one of our objects to the external URI tier;
+        record it so restores survive that node's death."""
+        self.reference_counter.set_spilled_uri(
+            ObjectID(data["object_id"]), data["uri"])
+        return True
 
     async def handle_add_borrow(self, conn, data):
         self.reference_counter.add_borrower(

@@ -33,6 +33,12 @@ import ray_tpu
 from ray_tpu.core.exceptions import RayTpuError
 
 
+class CollectiveError(RayTpuError):
+    """A collective op failed group-wide (member death or timeout) —
+    the NCCL-communicator-abort equivalent.  The group is broken; every
+    subsequent op on it raises too."""
+
+
 class ReduceOp:
     SUM = "sum"
     PRODUCT = "product"
@@ -57,6 +63,13 @@ class _Rendezvous:
 
     One per group, named + detached so every member can look it up.  Holds
     only refs and tiny metadata — tensor bytes ride the object plane.
+
+    Failure semantics (parity: a NCCL rank death aborts the communicator
+    on every member): members register their actor ids at join; while an
+    op is outstanding the rendezvous health-checks them (rate-limited)
+    against the GCS actor table, and once any member is DEAD every
+    ``collect`` returns a ``__broken__`` marker so the remaining ranks
+    raise instead of spinning forever.
     """
 
     def __init__(self, world_size: int):
@@ -66,9 +79,14 @@ class _Rendezvous:
         # (kind, seq) -> set of ranks that already collected (for cleanup)
         self._taken: Dict[Any, set] = {}
         self._joined: set = set()
+        self._members: Dict[int, str] = {}  # rank -> actor id hex
+        self._broken: Optional[str] = None
+        self._last_health_check = 0.0
 
-    def join(self, rank: int) -> int:
+    def join(self, rank: int, actor_id_hex: Optional[str] = None) -> int:
         self._joined.add(int(rank))
+        if actor_id_hex:
+            self._members[int(rank)] = actor_id_hex
         return self._world
 
     def ready(self) -> bool:
@@ -80,10 +98,39 @@ class _Rendezvous:
     def post(self, key, rank: int, payload) -> None:
         self._boxes.setdefault(key, {})[int(rank)] = payload
 
+    def _check_members(self) -> None:
+        """Rate-limited member liveness sweep against the GCS actor
+        table; a dead member breaks the group permanently."""
+        now = time.monotonic()
+        if self._broken is not None \
+                or now - self._last_health_check < 0.5:
+            return
+        self._last_health_check = now
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.core.ids import ActorID
+        core = worker_mod.global_worker_or_none()
+        if core is None:
+            return
+        for rank, hex_id in self._members.items():
+            try:
+                info = core.get_actor_info(
+                    actor_id=ActorID.from_hex(hex_id))
+            except Exception:  # noqa: BLE001 — GCS hiccup: check later
+                return
+            if info is not None and info.get("state") == "DEAD":
+                self._broken = (
+                    f"rank {rank} (actor {hex_id[:12]}) died: "
+                    f"{info.get('death_cause') or 'unknown cause'}")
+                return
+
     def collect(self, key, expected: int, rank: int):
-        """Return the box once `expected` ranks have posted, else None."""
+        """Return the box once `expected` ranks have posted, else None.
+        A broken group returns {"__broken__": reason} to every rank."""
         box = self._boxes.get(key)
         if box is None or len(box) < expected:
+            self._check_members()
+            if self._broken is not None:
+                return {"__broken__": self._broken}
             return None
         out = dict(box)
         taken = self._taken.setdefault(key, set())
@@ -97,6 +144,9 @@ class _Rendezvous:
         """Single-consumer mailbox read for send/recv."""
         box = self._boxes.get(key)
         if not box:
+            self._check_members()
+            if self._broken is not None:
+                return ("__broken__", self._broken)
             return None
         src, payload = next(iter(box.items()))
         self._boxes.pop(key, None)
@@ -191,7 +241,12 @@ def init_collective_group(world_size: int, rank: int,
             time.sleep(0.02)
     if actor is None:
         raise RayTpuError(f"collective rendezvous {name!r} did not appear")
-    ws = ray_tpu.get(actor.join.remote(rank))
+    my_actor_id = None
+    try:
+        my_actor_id = ray_tpu.get_runtime_context().get_actor_id()
+    except Exception:  # noqa: BLE001 — driver-side member: no actor id
+        pass
+    ws = ray_tpu.get(actor.join.remote(rank, my_actor_id))
     if ws != world_size:
         raise RayTpuError(f"world_size mismatch: group has {ws}, got {world_size}")
     g = _group_mgr.create_group(group_name, world_size, rank, backend)
@@ -267,27 +322,56 @@ def _return_like(tensor, result: np.ndarray):
     return result
 
 
+#: group-wide op deadline (seconds); aligned with NCCL's communicator
+#: watchdog role — a rank that never shows up must fail the op
+#: everywhere, not hang it
+DEFAULT_COLLECTIVE_TIMEOUT_S = 300.0
+
+
 def _exchange(g: _GroupHandle, kind: str, payload_ref,
-              poll_s: float = 0.002) -> Dict[int, Any]:
+              poll_s: float = 0.002,
+              timeout_s: Optional[float] = None) -> Dict[int, Any]:
     """Post this rank's ref and spin until every rank's ref arrived.
 
     Refs are nested one level deep (in a list) so the runtime passes them
     by reference instead of resolving them to values at the rendezvous
     (top-level ObjectRef args are resolved before execution — reference
-    semantics)."""
+    semantics).
+
+    Raises :class:`CollectiveError` when the rendezvous reports the
+    group broken (a member died) or the op deadline passes."""
     seq = g.next_seq()
     key = (kind, seq)
+    deadline = time.monotonic() + (
+        timeout_s if timeout_s is not None else DEFAULT_COLLECTIVE_TIMEOUT_S)
     wrapped = [payload_ref] if payload_ref is not None else []
     ray_tpu.get(g.rendezvous.post.remote(key, g.rank, wrapped))
     while True:
-        box = ray_tpu.get(
-            g.rendezvous.collect.remote(key, g.world_size, g.rank))
+        try:
+            box = ray_tpu.get(
+                g.rendezvous.collect.remote(key, g.world_size, g.rank),
+                timeout=30)
+        except RayTpuError as e:
+            # the rendezvous actor itself died (e.g. its node was lost)
+            raise CollectiveError(
+                f"{kind} on group {g.group_name!r} failed: rendezvous "
+                f"unreachable ({type(e).__name__})") from e
         if box is not None:
+            broken = box.get("__broken__")
+            if broken:
+                raise CollectiveError(
+                    f"{kind} on group {g.group_name!r} aborted: {broken}")
             return box
+        if time.monotonic() > deadline:
+            raise CollectiveError(
+                f"{kind} on group {g.group_name!r} timed out after "
+                f"{timeout_s or DEFAULT_COLLECTIVE_TIMEOUT_S:.0f}s "
+                f"waiting for all {g.world_size} ranks")
         time.sleep(poll_s)
 
 
-def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
+def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM,
+              timeout_s: Optional[float] = None):
     """All-gather refs then reduce locally (reference :258).
 
     Data path: N-1 object-plane fetches per rank; the rendezvous actor
@@ -295,38 +379,40 @@ def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
     """
     g = _check_and_get_group(group_name)
     ref = ray_tpu.put(_to_numpy(tensor))
-    box = _exchange(g, "allreduce", ref)
+    box = _exchange(g, "allreduce", ref, timeout_s=timeout_s)
     arrs = [ray_tpu.get(box[r][0]) for r in range(g.world_size)]
     return _return_like(tensor, _REDUCERS[op](arrs))
 
 
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
-           op: str = ReduceOp.SUM):
+           op: str = ReduceOp.SUM, timeout_s: Optional[float] = None):
     """Reduce to one rank (reference :311). Non-destination ranks return
     their input unchanged."""
     g = _check_and_get_group(group_name)
     ref = ray_tpu.put(_to_numpy(tensor))
-    box = _exchange(g, "reduce", ref)
+    box = _exchange(g, "reduce", ref, timeout_s=timeout_s)
     if g.rank != dst_rank:
         return tensor
     arrs = [ray_tpu.get(box[r][0]) for r in range(g.world_size)]
     return _return_like(tensor, _REDUCERS[op](arrs))
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout_s: Optional[float] = None):
     """Broadcast src's tensor to all ranks (reference :373)."""
     g = _check_and_get_group(group_name)
     ref = ray_tpu.put(_to_numpy(tensor)) if g.rank == src_rank else None
-    box = _exchange(g, "broadcast", ref)
+    box = _exchange(g, "broadcast", ref, timeout_s=timeout_s)
     src_ref = box[src_rank][0]
     return _return_like(tensor, ray_tpu.get(src_ref))
 
 
-def allgather(tensor_list: List, tensor, group_name: str = "default"):
+def allgather(tensor_list: List, tensor, group_name: str = "default",
+              timeout_s: Optional[float] = None):
     """Gather every rank's tensor into tensor_list on all ranks (:423)."""
     g = _check_and_get_group(group_name)
     ref = ray_tpu.put(_to_numpy(tensor))
-    box = _exchange(g, "allgather", ref)
+    box = _exchange(g, "allgather", ref, timeout_s=timeout_s)
     out = [ray_tpu.get(box[r][0]) for r in range(g.world_size)]
     if tensor_list is not None:
         del tensor_list[:]
@@ -335,7 +421,8 @@ def allgather(tensor_list: List, tensor, group_name: str = "default"):
 
 
 def reducescatter(tensor, tensor_list: List, group_name: str = "default",
-                  op: str = ReduceOp.SUM):
+                  op: str = ReduceOp.SUM,
+                  timeout_s: Optional[float] = None):
     """Each rank ends with the reduction of stripe ``rank`` (:472).
 
     Bandwidth-optimal striping: every rank posts per-stripe chunks as
@@ -345,15 +432,16 @@ def reducescatter(tensor, tensor_list: List, group_name: str = "default",
     if len(tensor_list) != g.world_size:
         raise ValueError("tensor_list must have world_size input shards")
     chunk_refs = [ray_tpu.put(_to_numpy(t)) for t in tensor_list]
-    box = _exchange(g, "reducescatter", chunk_refs)
+    box = _exchange(g, "reducescatter", chunk_refs, timeout_s=timeout_s)
     mine = [ray_tpu.get(box[r][0][g.rank]) for r in range(g.world_size)]
     return _return_like(tensor, _REDUCERS[op](mine))
 
 
-def barrier(group_name: str = "default") -> None:
+def barrier(group_name: str = "default",
+            timeout_s: Optional[float] = None) -> None:
     """Block until every rank reaches the barrier (reference :298)."""
     g = _check_and_get_group(group_name)
-    _exchange(g, "barrier", None)
+    _exchange(g, "barrier", None, timeout_s=timeout_s)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
@@ -367,16 +455,27 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     ray_tpu.get(g.rendezvous.post.remote(key, g.rank, [ref]))
 
 
-def recv(tensor, src_rank: int, group_name: str = "default"):
+def recv(tensor, src_rank: int, group_name: str = "default",
+         timeout_s: Optional[float] = None):
     """Point-to-point receive matching :func:`send` (reference :594)."""
     g = _check_and_get_group(group_name)
     if src_rank == g.rank:
         raise ValueError("cannot recv from self")
     seq = g.next_p2p_seq(src_rank, g.rank)
     key = ("p2p", src_rank, g.rank, seq)
+    deadline = time.monotonic() + (
+        timeout_s if timeout_s is not None
+        else DEFAULT_COLLECTIVE_TIMEOUT_S)
     while True:
         got = ray_tpu.get(g.rendezvous.take_p2p.remote(key, g.rank))
         if got is not None:
-            _, wrapped = got
+            src, wrapped = got
+            if src == "__broken__":
+                raise CollectiveError(
+                    f"recv on group {g.group_name!r} aborted: {wrapped}")
             return _return_like(tensor, ray_tpu.get(wrapped[0]))
+        if time.monotonic() > deadline:
+            raise CollectiveError(
+                f"recv(src={src_rank}) on group {g.group_name!r} "
+                f"timed out")
         time.sleep(0.002)

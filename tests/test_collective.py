@@ -100,3 +100,30 @@ def test_barrier_and_sendrecv():
         [a.barrier_then_rank.remote() for a in actors], timeout=60)) == [0, 1]
     outs = ray_tpu.get([a.sendrecv.remote() for a in actors], timeout=60)
     np.testing.assert_allclose(outs[1], np.arange(5.0))
+
+
+def test_rank_death_fails_allreduce_on_survivors():
+    """VERDICT r04 weak #9 / next #10: a rank dying mid-collective must
+    fail the op on every member within the deadline (NCCL communicator-
+    abort semantics), not leave survivors spinning on the rendezvous."""
+    import time as _t
+
+    actors = _make_group(3, "g_death")
+    # warm one full round so the group is definitely formed
+    outs = ray_tpu.get([a.allreduce.remote(1) for a in actors], timeout=60)
+    np.testing.assert_allclose(outs[0], np.full((4,), 3.0))
+
+    # ranks 0 and 1 enter the next allreduce; rank 2 never will
+    survivors = [actors[0].allreduce.remote(2), actors[1].allreduce.remote(2)]
+    _t.sleep(0.5)
+    ray_tpu.kill(actors[2])  # SIGKILL semantics: no graceful exit
+
+    t0 = _t.monotonic()
+    for ref in survivors:
+        with pytest.raises(Exception) as exc_info:
+            ray_tpu.get(ref, timeout=120)
+        msg = str(exc_info.value).lower()
+        assert "died" in msg or "aborted" in msg or "collective" in msg, (
+            f"wrong failure: {exc_info.value}")
+    elapsed = _t.monotonic() - t0
+    assert elapsed < 60, f"survivors hung {elapsed:.0f}s after rank death"
