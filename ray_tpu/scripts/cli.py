@@ -272,6 +272,35 @@ def cmd_microbenchmark(args) -> None:
     perf_main()
 
 
+def cmd_debug(args) -> None:
+    """Attach to a task paused at ray_tpu.util.rpdb.set_trace()
+    (parity: `ray debug` / reference util/rpdb.py)."""
+    _connect(args)
+    from ray_tpu.util import rpdb
+
+    bps = rpdb.list_breakpoints()
+    if not bps:
+        print("no active breakpoints (tasks call "
+              "ray_tpu.util.rpdb.set_trace() to create one)")
+        return
+    if getattr(args, "id", None):
+        pick = next((b for b in bps if b["id"] == args.id), None)
+        if pick is None:
+            sys.exit(f"breakpoint {args.id!r} not found")
+    elif len(bps) == 1 or not sys.stdin.isatty():
+        pick = bps[0]
+    else:
+        for i, b in enumerate(bps):
+            age = time.time() - b.get("timestamp", time.time())
+            print(f"  [{i}] {b['id']}  {b['task']}  pid {b['pid']}  "
+                  f"{b['host']}:{b['port']}  ({age:.0f}s old)")
+        idx = int(input("attach to which breakpoint? ") or "0")
+        pick = bps[idx]
+    print(f"attaching to {pick['task']} at {pick['host']}:{pick['port']} "
+          f"(continue with 'c', quit with 'q')")
+    rpdb.connect(pick["host"], pick["port"])
+
+
 def cmd_stack(args) -> None:
     """All-thread stack dumps from every worker in the cluster
     (parity: `ray stack`, without needing py-spy)."""
@@ -434,6 +463,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("microbenchmark", help="core perf suite")
     sp.set_defaults(fn=cmd_microbenchmark)
+
+    sp = sub.add_parser("debug",
+                        help="attach to a remote pdb breakpoint")
+    sp.add_argument("--address")
+    sp.add_argument("--id", help="breakpoint id (default: newest)")
+    sp.set_defaults(fn=cmd_debug)
     return p
 
 
