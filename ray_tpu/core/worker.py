@@ -256,6 +256,10 @@ class CoreWorker:
         self.job_id = job_id
         self._driver_task_id: Optional[TaskID] = None
         self._object_events: Dict[ObjectID, asyncio.Event] = {}
+        # sync-get fast path: calling threads park on a threading.Event
+        # that _publish sets DIRECTLY (no io-loop hop) — the loop-based
+        # _object_events above serve the coroutine paths
+        self._sync_object_waiters: Dict[ObjectID, list] = {}
         self._task_done_events: Dict[TaskID, asyncio.Event] = {}
 
         # execution (worker mode)
@@ -674,6 +678,13 @@ class CoreWorker:
     # ------------------------------------------------------------------
     def _publish(self, object_id: ObjectID, data: bytes) -> None:
         self.memory_store.put(object_id, data)
+        # wake sync getters inline: store.put above happens-before this
+        # pop, so a waiter that registers after the pop re-checks the
+        # store and finds the value
+        waiters = self._sync_object_waiters.pop(object_id, None)
+        if waiters:
+            for ev in waiters:
+                ev.set()
         self._call_on_loop(self._wake_object_waiters, object_id)
 
     def _wake_object_waiters(self, object_id: ObjectID) -> None:
@@ -732,8 +743,61 @@ class CoreWorker:
         self.reference_counter.add_location(
             object_id, tuple(self.raylet_address))
 
+    #: sentinel: the sync fast path cannot serve this get — use the
+    #: coroutine machinery
+    _SYNC_FALLBACK = object()
+
+    def _get_one_sync(self, ref: ObjectRef, timeout: Optional[float]):
+        """Lock-free single-ref get for the sync hot path: owner-local
+        inline values resolve (and block) entirely on the CALLING
+        thread — no run_coroutine_threadsafe, no coroutine, no io-loop
+        wakeups (~90 us/call of machinery on this host).  Borrowed refs
+        and plasma values return _SYNC_FALLBACK (their fetch must be
+        DRIVEN by a coroutine)."""
+        owner = ref.owner_address()
+        if owner is not None and owner[3] != self.worker_id.hex():
+            return self._SYNC_FALLBACK
+        object_id = ref.id()
+        data = self.memory_store.get(object_id)
+        if data is None:
+            if threading.current_thread() is self._loop_thread:
+                return self._SYNC_FALLBACK  # never block the io loop
+            ev = threading.Event()
+            self._sync_object_waiters.setdefault(object_id, []).append(ev)
+            # re-check AFTER registering: _publish pops waiters after
+            # its store.put, so either we see the data or the publisher
+            # sees (and sets) our event
+            data = self.memory_store.get(object_id)
+            if data is None:
+                if not ev.wait(timeout):
+                    waiters = self._sync_object_waiters.get(object_id)
+                    if waiters is not None:
+                        try:
+                            waiters.remove(ev)
+                        except ValueError:
+                            pass
+                    return _PendingMarker()
+                data = self.memory_store.get(object_id)
+                if data is None:  # woken but value migrated (shutdown)
+                    return self._SYNC_FALLBACK
+        if data == PLASMA_MARKER:
+            return self._SYNC_FALLBACK
+        value, _is_exc = deserialize(data)
+        return value
+
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None
             ) -> List[Any]:
+        if len(refs) == 1:
+            v = self._get_one_sync(refs[0], timeout)
+            if v is not self._SYNC_FALLBACK:
+                if isinstance(v, _PendingMarker):
+                    raise GetTimeoutError(
+                        f"get() timed out after {timeout}s")
+                if isinstance(v, TaskError):
+                    if isinstance(v.cause, BaseException):
+                        raise v.cause from v
+                    raise v
+                return [v]
         deadline = None if timeout is None else time.monotonic() + timeout
         fut = asyncio.run_coroutine_threadsafe(
             self._get_async(list(refs), deadline), self._loop)
