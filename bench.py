@@ -222,6 +222,33 @@ for label, workers, nenvs, overlap in [
                      "same overlap pipeline"),
         }
     algo.stop()
+
+# fleet-size scaling curve (VERDICT r05 next #7): measure, don't
+# assert, how throughput moves with worker count ON THIS HOST, so the
+# multi-core projection is arithmetic instead of faith.  Shorter
+# windows than the headline rows: the CURVE SHAPE is the datum.
+curve = {}
+for w in (1, 2, 3, 4):
+    config = (PPOConfig()
+              .environment(CartPole, env_config={"max_episode_steps": 200})
+              .rollouts(num_rollout_workers=w,
+                        num_envs_per_worker=16, sample_async=True)
+              .training(train_batch_size=4000, sgd_minibatch_size=512,
+                        num_sgd_iter=4)
+              .debugging(seed=0))
+    algo = config.build()
+    algo.train()  # warm
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < 8.0:
+        r = algo.train()
+        steps += r.get("num_env_steps_sampled_this_iter", 0)
+    dt = time.perf_counter() - t0
+    curve[str(w)] = round(steps / dt, 1)
+    algo.stop()
+out["ppo_scaling_curve"] = curve
+out["ppo_scaling_per_worker"] = {
+    w: round(v / int(w), 1) for w, v in curve.items()}
 ray_tpu.shutdown()
 print("RESULT:" + json.dumps(out))
 """ % (repo,)
@@ -704,6 +731,7 @@ SUMMARY_KEYS = (
     "many_tasks_per_sec_4node", "many_actors_per_sec_4node",
     "many_pgs_per_sec_4node", "broadcast_256mb_4node_s",
     "ppo_env_steps_per_sec_inline", "ppo_env_steps_per_sec_fleet",
+    "ppo_scaling_curve",
     "regressions_vs_prev", "vs_prev_round",
     # failure signals MUST reach the driver-captured line: a partial
     # bench otherwise looks like a sparse-but-clean run

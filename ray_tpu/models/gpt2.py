@@ -38,6 +38,12 @@ class GPT2Config:
     attn_impl: str = "flash"
     #: mesh axis name for ring attention (when attn_impl == "ring")
     sp_axis: str = "sp"
+    #: activation rematerialization per block: "" (store activations),
+    #: "full" (recompute everything in backward), or "dots" (save
+    #: matmul outputs, recompute elementwise).  The train step is
+    #: memory-bound (profiles/ANALYSIS.md), so trading HBM bytes for
+    #: MXU recompute can be a net win.
+    remat: str = ""
 
     @classmethod
     def gpt2_small(cls, **kw) -> "GPT2Config":  # 124M
@@ -172,8 +178,15 @@ class GPT2(nn.Module):
         seq = tokens.shape[1]
         x = wte.astype(cfg.dtype)[tokens] + \
             wpe.astype(cfg.dtype)[None, :seq]
+        block_cls = Block
+        if cfg.remat == "full":
+            block_cls = nn.remat(Block, static_argnums=(2,))
+        elif cfg.remat == "dots":
+            block_cls = nn.remat(
+                Block, static_argnums=(2,),
+                policy=jax.checkpoint_policies.dots_saveable)
         for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"h{i}")(x, deterministic)
+            x = block_cls(cfg, name=f"h{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f",
                          scale_init=nn.with_partitioning(
                              nn.initializers.ones, ("embed",)),

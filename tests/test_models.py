@@ -276,3 +276,38 @@ def test_vit_forward_backward_and_learns():
         params, opt_state, loss = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7
+
+
+def test_gpt2_remat_policies_match_baseline():
+    """remat='full'/'dots' must be numerically identical to storing
+    activations (same loss and same grads) — it only changes WHEN
+    intermediates are (re)computed, not what is computed."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT2, GPT2Config
+    from ray_tpu.models.gpt2 import loss_fn
+
+    base_cfg = GPT2Config.tiny(dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (2, base_cfg.max_seq_len), 0,
+                                base_cfg.vocab_size)
+
+    def loss_and_grad(cfg):
+        model = GPT2(cfg)
+        params = model.init_params(jax.random.PRNGKey(1), batch=1,
+                                   seq=cfg.max_seq_len)
+        return jax.jit(jax.value_and_grad(
+            lambda p: loss_fn(model, p, tokens)))(params)
+
+    base_loss, base_grads = loss_and_grad(base_cfg)
+    for mode in ("full", "dots"):
+        loss, grads = loss_and_grad(
+            dataclasses.replace(base_cfg, remat=mode))
+        assert abs(float(loss) - float(base_loss)) < 1e-5, mode
+        flat_a = jax.tree_util.tree_leaves(base_grads)
+        flat_b = jax.tree_util.tree_leaves(grads)
+        for a, b in zip(flat_a, flat_b):
+            assert jnp.allclose(a, b, atol=1e-5), mode
