@@ -444,6 +444,8 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
             pg = placement_group([{"CPU": 0.01}])
             pg.wait(30)
             remove_placement_group(pg)
+        for _ in range(10):  # warm the PG path before timing
+            pg_cycle()
         out["pg_create_remove_per_sec"] = rate(pg_cycle, 1, reps=100)
 
         # -- scalability envelope (BASELINE.md single-node rows) ------
@@ -553,7 +555,14 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
             time.sleep(1.0)
         out["many_tasks_per_sec_4node"] = statistics.median(samples)
 
-        # many_actors: creation-to-ready rate
+        # many_actors: creation-to-ready rate.  A warmup wave first:
+        # the cold mode (pool prestart competing with the wave on one
+        # CPU) is a boot artifact, not the steady-state creation rate
+        warm = [A.remote() for _ in range(20)]
+        ray_tpu.get([a.ping.remote() for a in warm], timeout=60)
+        for a in warm:
+            ray_tpu.kill(a)
+        time.sleep(3.0)
         n_actors = 100
         samples = []
         for _ in range(3):
@@ -580,6 +589,12 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
         # many_pgs: create N groups, then remove them
         from ray_tpu.util.placement_group import (placement_group,
                                                   remove_placement_group)
+        warm_pgs = [placement_group([{"CPU": 0.01}]) for _ in range(10)]
+        for pg in warm_pgs:
+            pg.wait(30)
+        for pg in warm_pgs:
+            remove_placement_group(pg)
+        time.sleep(1.0)
         n_pgs = 100
         samples = []
         for _ in range(3):

@@ -118,6 +118,9 @@ class GcsServer:
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (ns, name)
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        # long-poll waiters for placement_group_ready (kept OUT of
+        # PlacementGroupInfo: those objects are pickled by persistence)
+        self._pg_waiters: Dict[PlacementGroupID, asyncio.Event] = {}
         self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
         self.functions: Dict[str, bytes] = {}  # function_id -> pickled blob
         self.job_counter = 0
@@ -956,9 +959,27 @@ class GcsServer:
         return {"state": pg.state}
 
     async def handle_placement_group_ready(self, conn, data):
-        pg = self.placement_groups.get(PlacementGroupID(data["pg_id"]))
+        """Current PG state; with ``block_s`` > 0, long-poll: the reply
+        is held until the group reaches CREATED/REMOVED (or the block
+        window closes).  One RPC replaces the client-side sleep loop
+        whose fixed poll interval quantized create+wait latency."""
+        pg_id = PlacementGroupID(data["pg_id"])
+        pg = self.placement_groups.get(pg_id)
         if pg is None:
             return {"state": "REMOVED"}
+        block_s = float(data.get("block_s") or 0.0)
+        if block_s > 0:
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + min(block_s, 30.0)
+            while pg.state not in ("CREATED", "REMOVED"):
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                ev = self._pg_waiters.setdefault(pg_id, asyncio.Event())
+                try:
+                    await asyncio.wait_for(ev.wait(), remaining)
+                except asyncio.TimeoutError:
+                    break
         return {"state": pg.state,
                 "bundle_nodes": {i: n.binary()
                                  for i, n in pg.bundle_nodes.items()}}
@@ -981,6 +1002,7 @@ class GcsServer:
         # _schedule_pg loops observe REMOVED and cannot re-lease against
         # the group while bundles are being returned
         pg.state = "REMOVED"
+        self._wake_pg_waiters(pg.pg_id)
         targets = [(i, self.nodes.get(n)) for i, n in pg.bundle_nodes.items()]
         pg.bundle_nodes.clear()
         # actors gang-bound to the group die with it, through the common
@@ -1043,8 +1065,14 @@ class GcsServer:
         if pg.state == state:
             return
         pg.state = state
+        self._wake_pg_waiters(pg.pg_id)
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": state})
         self._schedule_persist()
+
+    def _wake_pg_waiters(self, pg_id: PlacementGroupID) -> None:
+        ev = self._pg_waiters.pop(pg_id, None)
+        if ev is not None:
+            ev.set()
 
     async def _return_bundles(self, pg: PlacementGroupInfo,
                               targets: List[Tuple[int, "NodeInfo"]]) -> None:
@@ -1120,6 +1148,7 @@ class GcsServer:
                 self._set_pg_state(pg, "PENDING")
             return
         pg.state = "CREATED"
+        self._wake_pg_waiters(pg.pg_id)
         self.publish(f"pg:{pg.pg_id.hex()}",
                      {"state": pg.state,
                       "bundle_nodes": {i: n.binary()

@@ -47,19 +47,21 @@ class PlacementGroup:
         core = worker_mod.global_worker()
         ref = core.put("__pg_ready_pending__")
 
-        # resolve by polling GCS on the io loop, then publishing the ref
+        # resolve via GCS long-polls on the io loop, then publish the ref
         async def _poll():
-            delay = 0.05
             while True:
                 reply = await core.gcs_conn.call(
-                    "placement_group_ready", {"pg_id": self.id.binary()})
+                    "placement_group_ready",
+                    {"pg_id": self.id.binary(), "block_s": 25.0},
+                    timeout=40.0)
                 if reply["state"] == "CREATED":
                     from ray_tpu.core.serialization import serialize
                     core._publish(ref.id(), serialize(self).to_bytes())
                     return
                 # INFEASIBLE is transient: the GCS retries placement as
                 # resources free / nodes join (autoscaler hook).  Only
-                # REMOVED is terminal.
+                # REMOVED is terminal — anything else re-arms the long
+                # poll.
                 if reply["state"] == "REMOVED":
                     from ray_tpu.core.serialization import serialize_exception
                     core._publish(ref.id(), serialize_exception(
@@ -67,9 +69,6 @@ class PlacementGroup:
                             f"placement group state: {reply['state']}")
                     ).to_bytes())
                     return
-                import asyncio
-                await asyncio.sleep(delay)
-                delay = min(delay * 1.5, 1.0)  # unplaceable groups poll at 1 Hz
 
         core.memory_store.delete(ref.id())
         core._post(_poll())
@@ -81,15 +80,23 @@ class PlacementGroup:
             return client.pg_wait(self.id, timeout_seconds)
         core = worker_mod.global_worker()
         deadline = time.monotonic() + timeout_seconds
-        while time.monotonic() < deadline:
+        while True:
+            remaining = deadline - time.monotonic()
+            # GCS-side long poll: the reply is held until the group is
+            # terminal-or-created, so there is no client sleep loop (a
+            # fixed 50 ms poll interval used to quantize every barely-
+            # missed placement to 50 ms)
             reply = core._run(core.gcs_conn.call(
-                "placement_group_ready", {"pg_id": self.id.binary()}))
+                "placement_group_ready",
+                {"pg_id": self.id.binary(),
+                 "block_s": max(0.0, min(remaining, 25.0))},
+                timeout=max(1.0, remaining) + 10.0))
             if reply["state"] == "CREATED":
                 return True
             if reply["state"] == "REMOVED":
                 return False
-            time.sleep(0.05)
-        return False
+            if remaining <= 0:
+                return False
 
     def bundle_nodes(self) -> Dict[int, str]:
         """bundle index -> node id hex (introspection)."""
