@@ -555,38 +555,10 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
             time.sleep(1.0)
         out["many_tasks_per_sec_4node"] = statistics.median(samples)
 
-        # many_actors: creation-to-ready rate.  A warmup wave first:
-        # the cold mode (pool prestart competing with the wave on one
-        # CPU) is a boot artifact, not the steady-state creation rate
-        warm = [A.remote() for _ in range(20)]
-        ray_tpu.get([a.ping.remote() for a in warm], timeout=60)
-        for a in warm:
-            ray_tpu.kill(a)
-        time.sleep(3.0)
-        n_actors = 100
-        samples = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            actors = [A.remote() for _ in range(n_actors)]
-            ray_tpu.get([a.ping.remote() for a in actors],
-                        timeout=budget_s)
-            samples.append(n_actors / (time.perf_counter() - t0))
-            for a in actors:
-                ray_tpu.kill(a)
-            # settle: reaping 100 actor workers + pool refill would
-            # otherwise compete with the next repeat / the PG wave (the
-            # r03 many_pgs regression was exactly this interference)
-            time.sleep(3.0)
-        out["many_actors_per_sec_4node"] = statistics.median(samples)
-        out["vs_ref_many_actors"] = \
-            out["many_actors_per_sec_4node"] / 600.4
-        out["many_actors_note"] = (
-            "process-per-actor on 1 vCPU: each actor's worker costs "
-            "~16 ms of fork+boot CPU, so ~70/s is this host's "
-            "architectural ceiling; the reference's 600/s ran on 64x64 "
-            "cores (0.15 actors/s/core)")
-
-        # many_pgs: create N groups, then remove them
+        # many_pgs BEFORE many_actors: PG cycles spawn no workers, but
+        # the actor waves' kill+reap+pool-rebuild churn bleeds CPU into
+        # whatever runs next for tens of seconds (the r03/r04 many_pgs
+        # "regressions" were exactly this ordering artifact)
         from ray_tpu.util.placement_group import (placement_group,
                                                   remove_placement_group)
         warm_pgs = [placement_group([{"CPU": 0.01}]) for _ in range(10)]
@@ -608,6 +580,37 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
             time.sleep(2.0)
         out["many_pgs_per_sec_4node"] = statistics.median(samples)
         out["vs_ref_many_pgs"] = out["many_pgs_per_sec_4node"] / 16.8
+
+        # many_actors: creation-to-ready rate.  A warmup wave first:
+        # the cold mode (pool prestart competing with the wave on one
+        # CPU) is a boot artifact, not the steady-state creation rate
+        warm = [A.remote() for _ in range(20)]
+        ray_tpu.get([a.ping.remote() for a in warm], timeout=60)
+        for a in warm:
+            ray_tpu.kill(a)
+        time.sleep(3.0)
+        n_actors = 100
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            actors = [A.remote() for _ in range(n_actors)]
+            ray_tpu.get([a.ping.remote() for a in actors],
+                        timeout=budget_s)
+            samples.append(n_actors / (time.perf_counter() - t0))
+            for a in actors:
+                ray_tpu.kill(a)
+            # settle: reaping 100 actor workers + pool refill would
+            # otherwise compete with the next repeat / the broadcast
+            # row (the r03 many_pgs regression was this interference)
+            time.sleep(3.0)
+        out["many_actors_per_sec_4node"] = statistics.median(samples)
+        out["vs_ref_many_actors"] = \
+            out["many_actors_per_sec_4node"] / 600.4
+        out["many_actors_note"] = (
+            "process-per-actor on 1 vCPU: each actor's worker costs "
+            "~16 ms of fork+boot CPU, so ~70/s is this host's "
+            "architectural ceiling; the reference's 600/s ran on 64x64 "
+            "cores (0.15 actors/s/core)")
 
         # broadcast: every node pulls one large object (reference
         # envelope row: 1 GiB to 50 nodes in 91.3 s; reduced scale —
