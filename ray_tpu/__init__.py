@@ -33,6 +33,7 @@ from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID  # noqa: F
 from ray_tpu.core.object_ref import (  # noqa: F401
     ObjectRef,
     ObjectRefGenerator,
+    StreamingObjectRefGenerator,
 )
 from ray_tpu.core import worker as _worker_mod
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401

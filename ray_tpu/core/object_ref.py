@@ -138,3 +138,66 @@ class ObjectRefGenerator:
 
     def __repr__(self) -> str:
         return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
+class StreamingObjectRefGenerator:
+    """num_returns="streaming" handle: yields each item's ObjectRef as
+    the executing task produces it — consumption overlaps execution
+    (parity: the reference's streaming generator protocol,
+    ``python/ray/_raylet.pyx`` StreamingObjectRefGenerator).
+
+    Iteration blocks until the next item is announced (worker → owner
+    push) or the task finishes; a task error raises at the position
+    where the stream broke.
+    """
+
+    def __init__(self, task_id, core):
+        self._task_id = task_id
+        self._core = core
+        self._consumed = 0
+
+    @property
+    def task_id(self):
+        return self._task_id
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ref = self.next_ref(timeout=None)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    def next_ref(self, timeout=None):
+        """Next item's ObjectRef, or None at end-of-stream.  Raises the
+        task's error if it failed before producing another item."""
+        state = self._core._streaming_states.get(self._task_id.binary())
+        if state is None:
+            return None  # never registered / already reaped
+        with state.cond:
+            while True:
+                if self._consumed < len(state.dyn_ids) \
+                        and state.dyn_ids[self._consumed] is not None:
+                    i = self._consumed
+                    self._consumed += 1
+                    return ObjectRef(ObjectID(state.dyn_ids[i]),
+                                     self._core.address)
+                if state.done:
+                    if state.error is not None:
+                        raise state.error
+                    return None
+                if not state.cond.wait(timeout):
+                    raise TimeoutError(
+                        f"no streamed item within {timeout}s")
+
+    def __del__(self):
+        # reap the owner-side stream state once the handle goes away
+        # and the task has finished (a live task still appends)
+        try:
+            core = self._core
+            state = core._streaming_states.get(self._task_id.binary())
+            if state is not None and state.done:
+                core._streaming_states.pop(self._task_id.binary(), None)
+        except Exception:
+            pass

@@ -94,6 +94,11 @@ class TaskSpec:
     # yields a variable number of objects; its single declared return
     # resolves to an ObjectRefGenerator over them.
     dynamic_returns: bool = False
+    # num_returns="streaming": dynamic AND each yielded object is
+    # pushed to the owner AS PRODUCED, so the caller's generator can
+    # consume item i while the task still computes item i+1 (parity:
+    # the reference's streaming ObjectRefGenerator protocol).
+    stream_returns: bool = False
 
     def return_ids(self) -> List[ObjectID]:
         return [

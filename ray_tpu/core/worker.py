@@ -321,6 +321,12 @@ class CoreWorker:
         # batched pushes stream per-task results back; this maps
         # task_id -> (spec, lease state, worker) until settled
         self._streamed: Dict[bytes, tuple] = {}
+        # num_returns="streaming": owner-side per-task stream progress
+        # (task_id bin -> _StreamState) and executor-side per-task item
+        # emitters (installed by the push handlers, consumed in
+        # _post_dynamic_returns)
+        self._streaming_states: Dict[bytes, "_StreamState"] = {}
+        self._stream_emitters: Dict[bytes, Any] = {}
         # same for batched actor pushes: (task_id, attempt) -> (spec, state)
         self._actor_streamed: Dict[tuple, tuple] = {}
 
@@ -1258,6 +1264,7 @@ class CoreWorker:
                     scheduling_strategy: Optional[SchedulingStrategy] = None,
                     runtime_env: Optional[Dict[str, Any]] = None,
                     dynamic_returns: bool = False,
+                    stream_returns: bool = False,
                     ) -> List[ObjectRef]:
         task_id = TaskID.for_normal_task(self.job_id)
         task_args, holds = self._build_args(args, kwargs)
@@ -1280,7 +1287,12 @@ class CoreWorker:
             runtime_env_hash=_renv_hash(runtime_env),
             trace_context=_trace_carrier(),
             dynamic_returns=dynamic_returns,
+            stream_returns=stream_returns,
         )
+        if stream_returns:
+            # register BEFORE submission: the first dynamic_items push
+            # can arrive while .remote() is still unwinding
+            self._streaming_states[task_id.binary()] = _StreamState()
         rets = self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
         refs = [ObjectRef(oid, self.address) for oid in rets]
@@ -1588,6 +1600,9 @@ class CoreWorker:
         self._task_locations[tid_bin] = worker.address
         try:
             conn = await self._pool.get(worker.address)
+            if spec.stream_returns:
+                # dynamic_items pushes ride this conn while it executes
+                conn.set_push_handler(self._on_worker_push)
             self._record_task_event(spec, "RUNNING")
             reply = await conn.call(
                 "push_task", {"spec_blob": _spec_dumps(spec)},
@@ -1668,6 +1683,27 @@ class CoreWorker:
         self._pump_lease_queue(state)
 
     def _on_worker_push(self, channel: str, data: Any) -> None:
+        if channel == "dynamic_items":
+            # streaming returns: own + publish each item as announced,
+            # then wake the generator's consumer
+            for tid_bin, index, dyn_id_bin, entry in data:
+                state = self._streaming_states.get(tid_bin)
+                oid = ObjectID(dyn_id_bin)
+                self.reference_counter.add_owned(
+                    oid, producing_task=TaskID(tid_bin))
+                object_id_bin, kind, payload = entry
+                if kind == "inline":
+                    self._publish(oid, payload)
+                else:  # ("plasma", node raylet address)
+                    self.reference_counter.add_location(oid, tuple(payload))
+                    self._publish(oid, PLASMA_MARKER)
+                if state is not None:
+                    with state.cond:
+                        while len(state.dyn_ids) <= index:
+                            state.dyn_ids.append(None)
+                        state.dyn_ids[index] = dyn_id_bin
+                        state.cond.notify_all()
+            return
         if channel == "actor_task_results":
             for task_id_bin, attempt, reply in data:
                 entry = self._actor_streamed.pop((task_id_bin, attempt),
@@ -1753,7 +1789,8 @@ class CoreWorker:
                     self._enqueue_for_lease, retry_spec)
                 return
         self._complete_task(spec, reply["results"],
-                            reply.get("dynamic_return_ids"))
+                            reply.get("dynamic_return_ids"),
+                            app_error=bool(reply.get("app_error")))
 
     def _retry_or_fail(self, spec: TaskSpec, error: Exception) -> None:
         if spec.task_id.binary() in self._cancel_requested:
@@ -1778,9 +1815,22 @@ class CoreWorker:
         else:
             self._loop.call_soon_threadsafe(fn, *args)
 
+    def _finish_stream(self, spec: TaskSpec,
+                       error: Optional[BaseException] = None) -> None:
+        if not spec.stream_returns:
+            return
+        state = self._streaming_states.get(spec.task_id.binary())
+        if state is None:
+            return
+        with state.cond:
+            state.done = True
+            state.error = error
+            state.cond.notify_all()
+
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self._task_locations.pop(spec.task_id.binary(), None)
         self._cancel_requested.discard(spec.task_id.binary())
+        self._finish_stream(spec, error)
         self.task_manager.fail(spec.task_id)
         blob = serialize_exception(
             error if isinstance(error, TaskError)
@@ -1792,8 +1842,8 @@ class CoreWorker:
         self._call_on_loop(self._signal_task_done, spec.task_id)
 
     def _complete_task(self, spec: TaskSpec, results: List[Tuple],
-                       dynamic_return_ids: Optional[List[bytes]] = None
-                       ) -> None:
+                       dynamic_return_ids: Optional[List[bytes]] = None,
+                       app_error: bool = False) -> None:
         """Store task results as owner (parity: TaskManager::CompletePendingTask)."""
         self._task_locations.pop(spec.task_id.binary(), None)
         self._cancel_requested.discard(spec.task_id.binary())
@@ -1812,6 +1862,19 @@ class CoreWorker:
             else:  # ("plasma", node raylet address)
                 self.reference_counter.add_location(object_id, tuple(payload))
                 self._publish(object_id, PLASMA_MARKER)
+        if spec.stream_returns:
+            err: Optional[BaseException] = None
+            if app_error and results:
+                # the stream broke mid-task: surface the task's real
+                # error at the consumer's next() position
+                try:
+                    v, _ = deserialize(results[0][2])
+                    if isinstance(v, TaskError):
+                        err = v.cause if isinstance(
+                            v.cause, BaseException) else v
+                except Exception:  # noqa: BLE001 — fall back to generic
+                    err = TaskError(None, "", spec.debug_name())
+            self._finish_stream(spec, err)
         self._record_task_event(spec, "FINISHED")
         self._call_on_loop(self._signal_task_done, spec.task_id)
 
@@ -2645,8 +2708,25 @@ class CoreWorker:
             self._loop.call_later(0.05, os._exit, 1)
         return {"running": running}
 
+    def _install_stream_emitter(self, spec: TaskSpec, conn) -> None:
+        """Executor side of num_returns="streaming": each yielded item
+        is pushed to the owner on the task's own connection the moment
+        it is posted (FIFO: items always precede the final reply)."""
+        if not spec.stream_returns:
+            return
+        tid_bin = spec.task_id.binary()
+
+        def emit(index: int, dyn_id_bin: bytes, result: tuple,
+                 _conn=conn, _tid=tid_bin):
+            self._loop.call_soon_threadsafe(
+                _conn.push, "dynamic_items",
+                [(_tid, index, dyn_id_bin, result)])
+
+        self._stream_emitters[tid_bin] = emit
+
     async def handle_push_task(self, conn, data):
         spec: TaskSpec = pickle.loads(data["spec_blob"])
+        self._install_stream_emitter(spec, conn)
         reply_fut = self._loop.create_future()
         # enqueue synchronously (before any await) to preserve arrival order
         self._exec_queue.put((spec, reply_fut))
@@ -2658,6 +2738,8 @@ class CoreWorker:
         _consume_exec_queue); the final reply carries the full list as
         the authoritative completion for bookkeeping."""
         specs: List[TaskSpec] = pickle.loads(data["specs_blob"])
+        for spec in specs:
+            self._install_stream_emitter(spec, conn)
         reply_fut = self._loop.create_future()
 
         def stream(items: List[Tuple[TaskSpec, Dict[str, Any]]]) -> None:
@@ -2872,6 +2954,7 @@ class CoreWorker:
             with self._exec_track_lock:
                 self._executing_by_thread.pop(threading.get_ident(), None)
                 self._interrupted_tasks.discard(tid_bin)
+            self._stream_emitters.pop(tid_bin, None)  # errored pre-yield
 
     def _post_dynamic_returns(self, spec: TaskSpec, value: Any
                               ) -> Dict[str, Any]:
@@ -2882,11 +2965,17 @@ class CoreWorker:
         declared return resolves to an ObjectRefGenerator over them."""
         from ray_tpu.core.object_ref import ObjectRefGenerator
 
+        emit = self._stream_emitters.pop(spec.task_id.binary(), None)
         results = []
         refs = []
         for i, item in enumerate(value):
             rid = spec.dynamic_return_id(i)
-            results.append(self._post_return(rid, item, spec))
+            entry = self._post_return(rid, item, spec)
+            results.append(entry)
+            if emit is not None:
+                # streaming: announce the item NOW — the owner's
+                # generator hands out its ref while we keep iterating
+                emit(i, rid.binary(), entry)
             refs.append(ObjectRef(rid, spec.owner_address,
                                   _register=False))
         gen_id = spec.return_ids()[0]
@@ -3093,6 +3182,18 @@ class _BurstQueue:
                 if q:
                     self._scheduled = True
                     self._loop.call_soon(self._drain)
+
+
+class _StreamState:
+    """Owner-side progress of one streaming-returns task."""
+
+    __slots__ = ("cond", "dyn_ids", "done", "error")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.dyn_ids: List[bytes] = []
+        self.done = False
+        self.error: Optional[BaseException] = None
 
 
 class _PendingMarker:

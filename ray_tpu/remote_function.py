@@ -118,14 +118,18 @@ class RemoteFunction:
             strat_opt = opts.get("scheduling_strategy")
             nret = opts.get("num_returns", 1)
             # num_returns="dynamic" (parity: _raylet.pyx:603): one
-            # declared return that resolves to an ObjectRefGenerator
+            # declared return that resolves to an ObjectRefGenerator.
+            # "streaming": each yielded object is announced as produced
+            # and .remote() hands back the generator itself.
+            generator_mode = nret in ("dynamic", "streaming")
             resolved = (
                 resources,
-                1 if nret == "dynamic" else int(nret),
+                1 if generator_mode else int(nret),
                 opts.get("max_retries"),
                 bool(opts.get("retry_exceptions", False)),
                 _resolve_strategy(strat_opt),
-                nret == "dynamic",
+                generator_mode,
+                nret == "streaming",
             )
             # a duck-typed strategy object (or a user-held resources dict)
             # may be mutated between calls — only cache when everything
@@ -135,7 +139,7 @@ class RemoteFunction:
                     and opts.get("resources") is None:
                 self._resolved = resolved
         (resources, num_returns, max_retries, retry_exc, strategy,
-         dynamic) = resolved
+         dynamic, streaming) = resolved
         refs = core.submit_task(
             function_id,
             self._descriptor,
@@ -148,7 +152,11 @@ class RemoteFunction:
             scheduling_strategy=strategy,
             runtime_env=self._packaged_runtime_env(core),
             dynamic_returns=dynamic,
+            stream_returns=streaming,
         )
+        if streaming:
+            from ray_tpu.core.object_ref import StreamingObjectRefGenerator
+            return StreamingObjectRefGenerator(refs[0].task_id(), core)
         return refs[0] if num_returns == 1 else refs
 
     def _packaged_runtime_env(self, core) -> Optional[Dict[str, Any]]:

@@ -140,3 +140,74 @@ def test_dynamic_returns_reconstruction_after_node_kill(chaos_cluster):
     for i, r in enumerate(refs):
         arr = ray_tpu.get(r, timeout=240)
         assert arr[0] == i, f"chunk {i} reconstructed wrong"
+
+
+# -- num_returns="streaming" -------------------------------------------
+
+
+def test_streaming_refs_arrive_while_task_runs(cluster):
+    """The defining property: item 0 is consumable while the producer
+    still computes later items (parity: reference streaming
+    ObjectRefGenerator)."""
+    from ray_tpu import StreamingObjectRefGenerator
+
+    @ray_tpu.remote(num_cpus=0, num_returns="streaming")
+    def slow_producer():
+        for i in range(4):
+            yield {"i": i, "t": time.time()}
+            time.sleep(0.8)
+
+    gen = slow_producer.remote()
+    assert isinstance(gen, StreamingObjectRefGenerator)
+    first_ref = gen.next_ref(timeout=30)
+    first = ray_tpu.get(first_ref, timeout=30)
+    assert first["i"] == 0
+    # the defining property: item 0 was handed out while the producing
+    # task is STILL RUNNING (it sleeps 0.8s after every yield)
+    from ray_tpu.core import worker as worker_mod
+    core = worker_mod.global_worker()
+    assert core.task_manager.is_pending(gen.task_id), (
+        "first item only became available after the task finished — "
+        "that is dynamic, not streaming")
+    rest = []
+    while True:
+        r = gen.next_ref(timeout=30)
+        if r is None:
+            break
+        rest.append(ray_tpu.get(r, timeout=30)["i"])
+    assert rest == [1, 2, 3]
+
+
+def test_streaming_iteration_protocol(cluster):
+    @ray_tpu.remote(num_cpus=0, num_returns="streaming")
+    def produce():
+        for i in range(6):
+            yield i * 10
+
+    vals = [ray_tpu.get(r, timeout=30) for r in produce.remote()]
+    assert vals == [0, 10, 20, 30, 40, 50]
+
+
+def test_streaming_error_mid_stream(cluster):
+    @ray_tpu.remote(num_cpus=0, num_returns="streaming")
+    def broken():
+        yield 1
+        yield 2
+        raise RuntimeError("stream snapped")
+
+    gen = broken.remote()
+    got = []
+    with pytest.raises(RuntimeError):
+        for r in gen:
+            got.append(ray_tpu.get(r, timeout=30))
+    # items produced before the failure were consumable
+    assert got == [1, 2]
+
+
+def test_streaming_empty(cluster):
+    @ray_tpu.remote(num_cpus=0, num_returns="streaming")
+    def empty():
+        return
+        yield  # pragma: no cover
+
+    assert list(empty.remote()) == []
