@@ -72,7 +72,7 @@ class BaseID:
     """An immutable, hashable, fixed-width binary ID."""
 
     SIZE = 0
-    __slots__ = ("_bytes", "_hash")
+    __slots__ = ("_bytes", "_hash", "_hex")
 
     def __init__(self, id_bytes: bytes):
         if len(id_bytes) != self.SIZE:
@@ -82,6 +82,7 @@ class BaseID:
             )
         self._bytes = bytes(id_bytes)
         self._hash = hash((type(self).__name__, self._bytes))
+        self._hex: "str | None" = None
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -99,7 +100,12 @@ class BaseID:
         return self._bytes
 
     def hex(self) -> str:
-        return self._bytes.hex()
+        # cached: id hexes are compared on hot paths (owner checks run
+        # once per get; profiling showed 6+ hex() calls per task)
+        h = self._hex
+        if h is None:
+            h = self._hex = self._bytes.hex()
+        return h
 
     def is_nil(self) -> bool:
         return self._bytes == _NIL_BYTE * self.SIZE

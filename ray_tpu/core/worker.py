@@ -231,6 +231,7 @@ class CoreWorker:
         self.node_id = node_id
         self.session_dir = session_dir
         self.worker_id = WorkerID.from_random()
+        self._worker_id_hex = self.worker_id.hex()
         self.config = config or get_config()
 
         self.memory_store = MemoryStore()
@@ -347,6 +348,11 @@ class CoreWorker:
         # owner side, recursive cancel: parent task -> child TaskIDs
         # submitted from inside its execution on this worker
         self._children: Dict[bytes, List[TaskID]] = {}
+        # dependency gating (loop-confined): task_id bin -> (spec, deps)
+        # for specs whose owned ref args don't exist yet, and the
+        # reverse index object_id -> [entries] for release on publish
+        self._waiting_for_deps: Dict[bytes, tuple] = {}
+        self._dep_waiters: Dict[ObjectID, list] = {}
 
         _mark("pre_async_init")
         self._run(self._async_init())
@@ -697,6 +703,11 @@ class CoreWorker:
         event = self._object_events.pop(object_id, None)
         if event is not None:
             event.set()
+        # runs on the io loop for EVERY publish, strictly after any
+        # dependency registration that raced it — the safe place to
+        # release dependency-gated specs
+        if self._dep_waiters:
+            self._release_dep_waiters(object_id)
 
     async def _wait_local_object(self, object_id: ObjectID,
                                  deadline: Optional[float]) -> Optional[bytes]:
@@ -761,7 +772,7 @@ class CoreWorker:
         and plasma values return _SYNC_FALLBACK (their fetch must be
         DRIVEN by a coroutine)."""
         owner = ref.owner_address()
-        if owner is not None and owner[3] != self.worker_id.hex():
+        if owner is not None and owner[3] != self._worker_id_hex:
             return self._SYNC_FALLBACK
         object_id = ref.id()
         data = self.memory_store.get(object_id)
@@ -870,7 +881,7 @@ class CoreWorker:
         pull loop) with nobody left to release it."""
         object_id = ref.id()
         owner = ref.owner_address()
-        is_owner = owner is None or owner[3] == self.worker_id.hex()
+        is_owner = owner is None or owner[3] == self._worker_id_hex
         if is_owner:
             data = await self._wait_local_object(
                 object_id, None if batch_managed else deadline)
@@ -975,7 +986,7 @@ class CoreWorker:
                                      deadline: Optional[float]) -> bool:
         """Borrower-side recovery: the owner holds the lineage, so route
         the reconstruction request to it and wait for completion."""
-        if owner is None or owner[3] == self.worker_id.hex():
+        if owner is None or owner[3] == self._worker_id_hex:
             return False
         try:
             conn = await self._pool.get((owner[1], owner[2]))
@@ -1072,7 +1083,7 @@ class CoreWorker:
                            deadline: Optional[float]) -> bool:
         object_id = ref.id()
         owner = ref.owner_address()
-        is_owner = owner is None or owner[3] == self.worker_id.hex()
+        is_owner = owner is None or owner[3] == self._worker_id_hex
         if is_owner:
             data = await self._wait_local_object(object_id, deadline)
             return data is not None
@@ -1138,7 +1149,7 @@ class CoreWorker:
 
     def _on_borrow_added(self, object_id: ObjectID,
                          owner: Optional[tuple]) -> None:
-        if owner is None or self._shutdown or owner[3] == self.worker_id.hex():
+        if owner is None or self._shutdown or owner[3] == self._worker_id_hex:
             return
         async def _notify():
             try:
@@ -1155,7 +1166,7 @@ class CoreWorker:
 
     def _on_borrow_removed(self, object_id: ObjectID,
                            owner: Optional[tuple]) -> None:
-        if owner is None or self._shutdown or owner[3] == self.worker_id.hex():
+        if owner is None or self._shutdown or owner[3] == self._worker_id_hex:
             return
         self.memory_store.delete(object_id)
         async def _notify():
@@ -1372,10 +1383,74 @@ class CoreWorker:
 
     def _route_submit(self, spec: TaskSpec) -> None:
         if spec.task_type == TaskType.ACTOR_TASK:
+            # actor calls are NOT gated: per-caller ordering is by
+            # sequence number assigned at enqueue, and the actor's exec
+            # thread resolving args is reference-equivalent blocking
+            # (it occupies no CPU lease)
             self._enqueue_actor_task(spec)
             return
+        deps = self._unready_deps(spec)
+        if deps is not None:
+            # Dependency gating (parity: the reference raylet's task
+            # dependency manager — a task is not DISPATCHED until its
+            # args exist).  Without this, dependents can occupy every
+            # CPU lease while the producers they block on starve in the
+            # backlog behind them: a resource deadlock (groupby shuffle
+            # hit exactly this interleaving).  The spec parks here and
+            # re-routes when the last missing arg publishes.
+            entry = (spec, deps)
+            self._waiting_for_deps[spec.task_id.binary()] = entry
+            for oid in deps:
+                self._dep_waiters.setdefault(oid, []).append(entry)
+            return
+        self._route_ready(spec)
+
+    def _route_ready(self, spec: TaskSpec) -> None:
         state = self._backlog_enqueue(spec)
         self._touched_states[state.key] = state
+
+    def _unready_deps(self, spec: TaskSpec) -> Optional[set]:
+        """Object ids among this spec's ref args that WE own and whose
+        values do not exist anywhere yet (producing task still pending,
+        nothing published/located), or None when every arg is ready —
+        the overwhelmingly common case, kept allocation-free.  Borrowed
+        args are not gated: their readiness is the remote owner's
+        knowledge, and the executing worker's fetch long-polls the
+        owner (reference behavior)."""
+        out: Optional[set] = None
+        for arg in spec.args:
+            oid = arg.object_id
+            if oid is None:
+                continue
+            owner = arg.owner_address
+            if owner is not None and owner[3] != self._worker_id_hex:
+                continue  # borrowed: not our call to gate
+            if self.memory_store.get(oid) is not None:
+                continue  # value (or plasma marker / error) published
+            ref_info = self.reference_counter.get(oid)
+            if ref_info is not None and (ref_info.in_plasma
+                                         or ref_info.locations):
+                continue
+            if out is None:
+                out = set()
+            out.add(oid)
+        return out
+
+    def _release_dep_waiters(self, object_id: ObjectID) -> None:
+        """An owned object became available: re-route any parked specs
+        whose last missing dependency this was.  Runs on the io loop."""
+        entries = self._dep_waiters.pop(object_id, None)
+        if not entries:
+            return
+        for spec, deps in entries:
+            deps.discard(object_id)
+            if deps:
+                continue
+            if self._waiting_for_deps.pop(spec.task_id.binary(),
+                                          None) is None:
+                continue  # already released (e.g. cancelled)
+            self._route_ready(spec)
+        self._flush_submits()
 
     def _flush_submits(self) -> None:
         touched, self._touched_states = self._touched_states, {}
@@ -2368,6 +2443,11 @@ class CoreWorker:
         if not self.task_manager.is_pending(task_id):
             return
         self._cancel_requested.add(tid_bin)
+        # (0) parked on unready dependencies: unpark + fail
+        parked = self._waiting_for_deps.pop(tid_bin, None)
+        if parked is not None:
+            self._fail_cancelled(parked[0])
+            return
         # (1) still queued owner-side: unqueue + fail without any RPC
         for state in self._lease_states.values():
             for spec in state.backlog:
