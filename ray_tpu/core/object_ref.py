@@ -192,12 +192,19 @@ class StreamingObjectRefGenerator:
                         f"no streamed item within {timeout}s")
 
     def __del__(self):
-        # reap the owner-side stream state once the handle goes away
-        # and the task has finished (a live task still appends)
+        # reap the owner-side stream state once the handle goes away:
+        # immediately if the task finished, else mark it abandoned so
+        # _finish_stream reaps it at completion (a finished-but-never-
+        # drained stream must not pin its dyn_ids forever)
         try:
             core = self._core
-            state = core._streaming_states.get(self._task_id.binary())
-            if state is not None and state.done:
-                core._streaming_states.pop(self._task_id.binary(), None)
+            tid_bin = self._task_id.binary()
+            state = core._streaming_states.get(tid_bin)
+            if state is None:
+                return
+            if state.done:
+                core._streaming_states.pop(tid_bin, None)
+            else:
+                core._stream_abandoned.add(tid_bin)
         except Exception:
             pass

@@ -6,6 +6,13 @@ invocation: function identity (by hash into the GCS function table),
 serialized arguments (small values inlined; larger ones as ObjectRef
 references), resource demand, retry policy, and — for actor tasks —
 ordering metadata.
+
+Wire/snapshot compatibility: spec pickles are SAME-VERSION artifacts —
+every process in a cluster (and the GCS snapshot a restarted head
+reads) runs the same code.  The ``slots=True`` dataclasses therefore
+do not carry cross-version pickle shims; a rolling-upgrade story would
+need a versioned codec here first (the reference takes the same
+same-version stance for its protobuf-fields-at-head wire format).
 """
 
 from __future__ import annotations
@@ -24,7 +31,7 @@ class TaskType(enum.Enum):
     ACTOR_TASK = 2
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskArg:
     """Either an inlined serialized value or an object reference."""
 
@@ -42,7 +49,7 @@ class TaskArg:
         return self.value_bytes is not None
 
 
-@dataclass
+@dataclass(slots=True)
 class SchedulingStrategy:
     """Default / spread / node-affinity / placement-group placement.
 
@@ -57,7 +64,7 @@ class SchedulingStrategy:
     capture_child_tasks: bool = False
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskSpec:
     task_id: TaskID
     job_id: JobID
@@ -132,7 +139,7 @@ class TaskSpec:
         return f"{self.function_descriptor}[{self.task_id.hex()[:12]}]"
 
 
-@dataclass
+@dataclass(slots=True)
 class ActorCreationSpec:
     max_restarts: int = 0
     max_task_retries: int = 0

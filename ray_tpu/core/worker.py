@@ -328,6 +328,9 @@ class CoreWorker:
         # _post_dynamic_returns)
         self._streaming_states: Dict[bytes, "_StreamState"] = {}
         self._stream_emitters: Dict[bytes, Any] = {}
+        # task ids whose StreamingObjectRefGenerator was GC'd while the
+        # task still ran: _finish_stream reaps their state at the end
+        self._stream_abandoned: set = set()
         # same for batched actor pushes: (task_id, attempt) -> (spec, state)
         self._actor_streamed: Dict[tuple, tuple] = {}
 
@@ -1894,13 +1897,19 @@ class CoreWorker:
                        error: Optional[BaseException] = None) -> None:
         if not spec.stream_returns:
             return
-        state = self._streaming_states.get(spec.task_id.binary())
+        tid_bin = spec.task_id.binary()
+        state = self._streaming_states.get(tid_bin)
         if state is None:
             return
         with state.cond:
             state.done = True
             state.error = error
             state.cond.notify_all()
+        if tid_bin in self._stream_abandoned:
+            # the consumer dropped its generator while the task still
+            # ran; nobody will drain (or reap) the state — do it here
+            self._stream_abandoned.discard(tid_bin)
+            self._streaming_states.pop(tid_bin, None)
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self._task_locations.pop(spec.task_id.binary(), None)
@@ -2965,8 +2974,11 @@ class CoreWorker:
         tid_bin = spec.task_id.binary()
         with self._exec_track_lock:
             if tid_bin in self._cancelled_exec:
-                # cancelled while queued: never starts
+                # cancelled while queued: never starts (drop any
+                # streaming emitter installed at push time — the
+                # finally below is never reached)
                 self._cancelled_exec.discard(tid_bin)
+                self._stream_emitters.pop(tid_bin, None)
                 return self._cancelled_reply(spec)
             self._executing_by_thread[threading.get_ident()] = tid_bin
         prev = (self._ctx.task_id, self._ctx.put_counter,

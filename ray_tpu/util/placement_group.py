@@ -49,11 +49,20 @@ class PlacementGroup:
 
         # resolve via GCS long-polls on the io loop, then publish the ref
         async def _poll():
+            import asyncio
+
+            from ray_tpu.core import rpc as rpc_mod
             while True:
-                reply = await core.gcs_conn.call(
-                    "placement_group_ready",
-                    {"pg_id": self.id.binary(), "block_s": 25.0},
-                    timeout=40.0)
+                try:
+                    reply = await core.gcs_conn.call(
+                        "placement_group_ready",
+                        {"pg_id": self.id.binary(), "block_s": 25.0},
+                        timeout=40.0)
+                except (asyncio.TimeoutError, rpc_mod.RpcError):
+                    continue  # saturated GCS: re-arm the long poll
+                except rpc_mod.ConnectionLost:
+                    await asyncio.sleep(0.5)  # head restarting
+                    continue
                 if reply["state"] == "CREATED":
                     from ray_tpu.core.serialization import serialize
                     core._publish(ref.id(), serialize(self).to_bytes())
@@ -86,11 +95,17 @@ class PlacementGroup:
             # terminal-or-created, so there is no client sleep loop (a
             # fixed 50 ms poll interval used to quantize every barely-
             # missed placement to 50 ms)
-            reply = core._run(core.gcs_conn.call(
-                "placement_group_ready",
-                {"pg_id": self.id.binary(),
-                 "block_s": max(0.0, min(remaining, 25.0))},
-                timeout=max(1.0, remaining) + 10.0))
+            try:
+                reply = core._run(core.gcs_conn.call(
+                    "placement_group_ready",
+                    {"pg_id": self.id.binary(),
+                     "block_s": max(0.0, min(remaining, 25.0))},
+                    timeout=max(1.0, remaining) + 10.0))
+            except Exception:  # noqa: BLE001 — saturated GCS/conn loss:
+                if remaining <= 0:  # wait() contract is bool, not raise
+                    return False
+                time.sleep(0.2)
+                continue
             if reply["state"] == "CREATED":
                 return True
             if reply["state"] == "REMOVED":
