@@ -1,0 +1,91 @@
+"""Verify driver (round-5 second leg): user-style cluster exercise."""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+def main():
+    t0 = time.perf_counter()
+    ray_tpu.init(num_cpus=4)
+    print(f"init {time.perf_counter()-t0:.2f}s")
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    t0 = time.perf_counter()
+    first = ray_tpu.get(double.remote(1))
+    print(f"first task {time.perf_counter()-t0:.2f}s -> {first}")
+
+    t0 = time.perf_counter()
+    refs = [add.remote(double.remote(i), double.remote(i + 1))
+            for i in range(40)]
+    out = ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    assert out == [2 * i + 2 * (i + 1) for i in range(40)], out[:5]
+    print(f"120 chained tasks {dt:.2f}s ({dt/120*1e3:.1f} ms/task)")
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self, k):
+            self.n += k
+            return self.n
+
+    t0 = time.perf_counter()
+    actors = [Counter.remote() for _ in range(8)]
+    totals = ray_tpu.get([a.bump.remote(i + 1) for i, a in enumerate(actors)])
+    assert totals == list(range(1, 9)), totals
+    # ordered calls on one actor
+    a = actors[0]
+    seq = ray_tpu.get([a.bump.remote(1) for _ in range(10)])
+    assert seq == list(range(2, 12)), seq
+    print(f"8 actors + ordered calls {time.perf_counter()-t0:.2f}s")
+
+    # data pipeline with an all-to-all shuffle over the object plane
+    t0 = time.perf_counter()
+    ds = rdata.range(2000).map(lambda r: {"id": r["id"] + 1}).random_shuffle()
+    vals = sorted(row["id"] for row in ds.take_all())
+    assert vals == list(range(1, 2001)), (len(vals), vals[:3])
+    print(f"data shuffle {time.perf_counter()-t0:.2f}s")
+
+    # flash-attention eligibility smoke through the public model API
+    # (CPU backend -> reference path; the NL kernel itself was driven on
+    # the chip via the bench train step this session)
+    from ray_tpu.models import GPT2, GPT2Config
+    import jax.numpy as jnp
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), batch=1,
+                               seq=cfg.max_seq_len)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, cfg.max_seq_len),
+                              0, cfg.vocab_size)
+    logits = model.apply({"params": params}, toks)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+    print("model forward OK", logits.shape)
+
+    t0 = time.perf_counter()
+    ray_tpu.shutdown()
+    dt = time.perf_counter() - t0
+    print(f"shutdown {dt:.2f}s")
+    assert dt < 5, f"slow shutdown {dt}"
+    print("VERIFY OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,50 @@ def test_flash_attention_bf16():
                                atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.parametrize("shape", [(2, 256, 4, 64),   # pack=2 slabs
+                                   (1, 256, 3, 128)])  # pack=1 slabs
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_native_layout_matches_head_major(shape, causal):
+    """The native-layout kernels (no transposes around the custom-call)
+    must agree with the head-major kernels bit-for-bit: same blockwise
+    online-softmax order, only the memory layout differs."""
+    rng = np.random.default_rng(4)
+    b, t, h, d = shape
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+
+    def run(native):
+        return jax.vjp(
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=causal, block_q=128, block_k=128,
+                interpret=True, native=native), q, k, v)
+
+    out_hm, vjp_hm = run(False)
+    out_nl, vjp_nl = run(True)
+    np.testing.assert_array_equal(np.asarray(out_hm), np.asarray(out_nl))
+    for a, b_ in zip(vjp_hm(g), vjp_nl(g)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_flash_attention_native_layout_eligibility():
+    from ray_tpu.ops.flash_attention import _nl_eligible
+
+    rng = np.random.default_rng(5)
+
+    def arr(h, d):
+        return jnp.asarray(rng.standard_normal((1, 128, h, d)), jnp.float32)
+
+    assert _nl_eligible(arr(4, 64), arr(4, 64), arr(4, 64))
+    assert _nl_eligible(arr(3, 128), arr(3, 128), arr(3, 128))
+    assert not _nl_eligible(arr(3, 64), arr(3, 64), arr(3, 64))  # odd pack
+    assert not _nl_eligible(arr(4, 32), arr(4, 32), arr(4, 32))  # small dim
+    with pytest.raises(ValueError):
+        flash_attention(arr(4, 32), arr(4, 32), arr(4, 32),
+                        interpret=True, native=True)
+
+
 def test_rmsnorm_matches_reference():
     rng = np.random.default_rng(3)
     x = jnp.asarray(rng.standard_normal((4, 64, 256)), jnp.float32)
