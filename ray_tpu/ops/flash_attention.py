@@ -40,6 +40,25 @@ def _clamp_q_tile(j, i, block_q: int, block_k: int):
     return jnp.maximum(j, jnp.maximum(jmin, 0))
 
 
+def _causal_dispatch(causal: bool, q_offset, k_offset, block_q: int,
+                     block_k: int, tile):
+    """Run ``tile(apply_mask)`` under the causal tile classification:
+    diagonal-straddling tiles get the (iota + compare + select) causal
+    mask, fully-visible tiles skip it, fully-masked tiles run nothing.
+    The two predicates are mutually exclusive and their union equals the
+    old "not fully masked" gate, so no tile is dropped or run twice."""
+    from jax.experimental import pallas as pl
+
+    if not causal:
+        tile(False)
+        return
+    straddles = jnp.logical_and(k_offset <= q_offset + block_q - 1,
+                                k_offset + block_k - 1 > q_offset)
+    fully_visible = k_offset + block_k - 1 <= q_offset
+    pl.when(straddles)(lambda: tile(True))
+    pl.when(fully_visible)(lambda: tile(False))
+
+
 def _attention_reference(q, k, v, causal: bool, scale: float) -> jax.Array:
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -96,8 +115,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         l = l_ref[:][:, 0]
         m_new = jnp.maximum(m, s.max(axis=-1))
         safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        # masked entries: exp(-1e30 - safe_m) underflows to exactly 0.0,
+        # so no [bq, bk] guard select is needed
         p = jnp.exp(s - safe_m[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - safe_m))
         l_new = l * corr + p.sum(axis=-1)
         acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
@@ -217,8 +237,8 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = k_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)  # [bq] clamp: keeps
+        p = jnp.exp(s - lse[:, None])  # fully-masked rows at p == 0
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -271,8 +291,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = k_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)  # [bq] clamp: keeps
+        p = jnp.exp(s - lse[:, None])  # fully-masked rows at p == 0
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -444,12 +464,11 @@ def _fa_nl_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
     q_offset = iq * block_q
     k_offset = ik * block_k
 
-    @pl.when(jnp.logical_or(not causal, k_offset <= q_offset + block_q - 1))
-    def _compute():
+    def _tile(apply_mask: bool):
         q = q_ref[:]
         k = k_ref[:]
         v = v_ref[:]
-        if causal:
+        if apply_mask:
             q_pos = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_offset + lax.broadcasted_iota(
@@ -462,14 +481,15 @@ def _fa_nl_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
             s = jax.lax.dot_general(
                 qh, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
-            if causal:
+            if apply_mask:
                 s = jnp.where(causal_keep, s, NEG_INF)
             m = m_refs[h][:]            # [bq, 1]
             l = l_refs[h][:]
             m_new = jnp.maximum(m, s.max(axis=-1)[:, None])
             safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+            # masked entries: exp(-1e30 - safe_m) underflows to exactly
+            # 0.0, so no [bq, bk] guard select is needed
             p = jnp.exp(s - safe_m)
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
             corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - safe_m))
             l_refs[h][:] = l * corr + p.sum(axis=-1)[:, None]
             m_refs[h][:] = m_new
@@ -484,6 +504,8 @@ def _fa_nl_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
             sel = _head_sel(pack, dim, block_q)
             acc_ref[:] = (acc_ref[:] * jnp.where(sel, corrs[0], corrs[1])
                           + jnp.where(sel, pvs[0], pvs[1]))
+
+    _causal_dispatch(causal, q_offset, k_offset, block_q, block_k, _tile)
 
     @pl.when(ik == n_k - 1)
     def _finish():
@@ -589,14 +611,12 @@ def _fa_nl_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_offset = ik * block_k
     q_offset = iq * block_q
 
-    @pl.when(jnp.logical_or(not causal,
-                            q_offset + block_q - 1 >= k_offset))
-    def _compute():
+    def _tile(apply_mask: bool):
         q = q_ref[:]
         k = k_ref[:]
         v = v_ref[:]
         do = do_ref[:]
-        if causal:
+        if apply_mask:
             q_pos = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_offset + lax.broadcasted_iota(
@@ -612,12 +632,12 @@ def _fa_nl_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(
                 qh, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
-            if causal:
+            if apply_mask:
                 s = jnp.where(causal_keep, s, NEG_INF)
             lse = lse_ref[:][:, h:h + 1]     # [bq, 1]
             delta = delta_ref[:][:, h:h + 1]
-            p = jnp.exp(s - lse)
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)  # [bq, 1]
+            p = jnp.exp(s - lse)  # clamp keeps fully-masked rows at p == 0
             pdo = jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -637,6 +657,8 @@ def _fa_nl_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             sel = _head_sel(pack, dim, block_k)
             dv_acc[:] = dv_acc[:] + jnp.where(sel, pdos[0], pdos[1])
             dk_acc[:] = dk_acc[:] + jnp.where(sel, dsqs[0], dsqs[1])
+
+    _causal_dispatch(causal, q_offset, k_offset, block_q, block_k, _tile)
 
     @pl.when(iq == n_q - 1)
     def _finish():
@@ -661,14 +683,12 @@ def _fa_nl_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_offset = iq * block_q
     k_offset = ik * block_k
 
-    @pl.when(jnp.logical_or(not causal,
-                            k_offset <= q_offset + block_q - 1))
-    def _compute():
+    def _tile(apply_mask: bool):
         q = q_ref[:]
         k = k_ref[:]
         v = v_ref[:]
         do = do_ref[:]
-        if causal:
+        if apply_mask:
             q_pos = q_offset + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_offset + lax.broadcasted_iota(
@@ -683,12 +703,12 @@ def _fa_nl_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(
                 qh, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
-            if causal:
+            if apply_mask:
                 s = jnp.where(causal_keep, s, NEG_INF)
             lse = lse_ref[:][:, h:h + 1]     # [bq, 1]
             delta = delta_ref[:][:, h:h + 1]
-            p = jnp.exp(s - lse)
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)  # [bq, 1]
+            p = jnp.exp(s - lse)  # clamp keeps fully-masked rows at p == 0
             dp = jax.lax.dot_general(
                 doh, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -702,6 +722,8 @@ def _fa_nl_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         else:
             sel = _head_sel(pack, dim, block_q)
             dq_acc[:] = dq_acc[:] + jnp.where(sel, dsks[0], dsks[1])
+
+    _causal_dispatch(causal, q_offset, k_offset, block_q, block_k, _tile)
 
     @pl.when(ik == n_k - 1)
     def _finish():
@@ -869,7 +891,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 1024, block_k: int = 1024,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     bwd_impl: str = "pallas",
                     native: Optional[bool] = None) -> jax.Array:
@@ -895,8 +918,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kernels for A/B.
     Killing the layout transposes around the custom-calls measured
     312.7 -> 276.9 ms/step on the GPT-2 bench step (MFU 45.8 -> 51.7%)
-    and 84.1 -> 80.7 ms on 32k-token fwd+bwd (v5e, round 5); both
-    kernel families produce bit-identical results (test_ops.py).
+    and 84.1 -> 80.7 ms on 32k-token fwd+bwd; the follow-up VPU cuts
+    (guard-select removal, backward lse clamp, diagonal-split causal)
+    took 32k to 73.6 ms (v5e, round 5).  Both kernel families agree to
+    f32-ulp level (test_ops.py).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -915,8 +940,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         if backend not in ("tpu", "axon"):
             return _attention_reference(q, k, v, causal, scale)
         interpret = False
+    import os
+    # tuning escape hatches (trace-time), applied only when the caller
+    # did not pass explicit sizes — an env var must not silently change
+    # a deliberate choice (e.g. the parity tests' 128-blocks)
+    if block_q is None:
+        block_q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q") or 1024)
+    if block_k is None:
+        block_k = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K") or 1024)
     if native is None:
-        import os
         env = os.environ.get("RAY_TPU_FLASH_NATIVE", "").lower()
         # an explicit bwd_impl="xla" request keeps the head-major path —
         # the NL family has no XLA-recompute backward to honor it with

@@ -63,8 +63,12 @@ def test_flash_attention_bf16():
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_native_layout_matches_head_major(shape, causal):
     """The native-layout kernels (no transposes around the custom-call)
-    must agree with the head-major kernels bit-for-bit: same blockwise
-    online-softmax order, only the memory layout differs."""
+    compute the same blockwise online-softmax in the same order as the
+    head-major kernels; only the memory layout differs.  Tolerance is
+    ulp-level rather than exact: the NL kernels skip the causal select
+    on fully-visible tiles (the head-major path applies an all-true
+    mask there), and XLA compiles the two exp() patterns into slightly
+    different vectorized code."""
     rng = np.random.default_rng(4)
     b, t, h, d = shape
     q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
@@ -80,9 +84,11 @@ def test_flash_attention_native_layout_matches_head_major(shape, causal):
 
     out_hm, vjp_hm = run(False)
     out_nl, vjp_nl = run(True)
-    np.testing.assert_array_equal(np.asarray(out_hm), np.asarray(out_nl))
+    np.testing.assert_allclose(np.asarray(out_hm), np.asarray(out_nl),
+                               atol=1e-6, rtol=0)
     for a, b_ in zip(vjp_hm(g), vjp_nl(g)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-5, rtol=0)
 
 
 def test_flash_attention_native_layout_eligibility():
