@@ -138,7 +138,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float,
-                   block_q: int, block_k: int, interpret: bool):
+                   block_q: int, block_k: int, interpret: bool,
+                   out_dtype=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -184,7 +185,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
                          lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct(qt.shape, out_dtype or q.dtype),
             jax.ShapeDtypeStruct((batch, heads, seq_q, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -307,7 +308,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, grad_dtype=None, delta=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -319,11 +320,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     dot = g.transpose(0, 2, 1, 3)
-    # delta_i = rowsum(dO_i * O_i) (FlashAttention-2 eq. for dS);
-    # [B,H,S,1] like lse (TPU blocks need >=2 trailing dims)
-    delta = jnp.sum(dot.astype(jnp.float32)
-                    * out.transpose(0, 2, 1, 3).astype(jnp.float32),
-                    axis=-1, keepdims=True)
+    if delta is None:
+        # delta_i = rowsum(dO_i * O_i) (FlashAttention-2 eq. for dS);
+        # [B,H,S,1] like lse (TPU blocks need >=2 trailing dims)
+        delta = jnp.sum(dot.astype(jnp.float32)
+                        * out.transpose(0, 2, 1, 3).astype(jnp.float32),
+                        axis=-1, keepdims=True)
 
     seq_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel",
@@ -356,8 +358,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         in_specs=[tile_q, tile_k_rev, tile_k_rev, tile_q, rows_q_rev,
                   rows_q_rev],
         out_specs=[tile_k_rev, tile_k_rev],
-        out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype),
-                   jax.ShapeDtypeStruct(vt.shape, v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(kt.shape, grad_dtype or k.dtype),
+                   jax.ShapeDtypeStruct(vt.shape, grad_dtype or v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, dim), jnp.float32),
                         pltpu.VMEM((block_k, dim), jnp.float32)],
         compiler_params=seq_params,
@@ -378,7 +380,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         in_specs=[tile_q_fwd, tile_k_fwd, tile_k_fwd, tile_q_fwd,
                   rows_q_fwd, rows_q_fwd],
         out_specs=tile_q_fwd,
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, grad_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
         compiler_params=seq_params,
         interpret=interpret,
@@ -529,7 +531,8 @@ def _fa_nl_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
 
 
 def _flash_nl_forward(q, k, v, causal: bool, scale: float,
-                      block_q: int, block_k: int, interpret: bool):
+                      block_q: int, block_k: int, interpret: bool,
+                      out_dtype=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -576,7 +579,7 @@ def _flash_nl_forward(q, k, v, causal: bool, scale: float,
                          lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(qr.shape, q.dtype),
+            jax.ShapeDtypeStruct(qr.shape, out_dtype or q.dtype),
             jax.ShapeDtypeStruct((batch, h2, seq_q, pack), jnp.float32),
         ],
         scratch_shapes=(
@@ -731,7 +734,7 @@ def _fa_nl_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_nl_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                       block_k, interpret):
+                       block_k, interpret, grad_dtype=None, delta=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -745,13 +748,14 @@ def _flash_nl_backward(q, k, v, out, lse, g, causal, scale, block_q,
     kr = k.reshape(batch, seq_k, heads * dim)
     vr = v.reshape(batch, seq_k, heads * dim)
     gr = g.reshape(batch, seq_q, heads * dim)
-    # delta_i = rowsum(dO_i * O_i), laid out [B, H2, T, pack] like lse
-    # (T in sublanes so per-head columns broadcast along lanes without
-    # relayout); XLA fuses the product+reduce, the transpose is ~6 MB
-    delta = (jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                     axis=-1)                      # [B, T, H]
-             .reshape(batch, seq_q, h2, pack)
-             .transpose(0, 2, 1, 3))               # [B, H2, T, pack]
+    if delta is None:
+        # delta_i = rowsum(dO_i * O_i), laid out [B, H2, T, pack] like
+        # lse (T in sublanes so per-head columns broadcast along lanes
+        # without relayout); XLA fuses the product+reduce
+        delta = (jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                         axis=-1)                  # [B, T, H]
+                 .reshape(batch, seq_q, h2, pack)
+                 .transpose(0, 2, 1, 3))           # [B, H2, T, pack]
 
     seq_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel",
@@ -790,8 +794,8 @@ def _flash_nl_backward(q, k, v, out, lse, g, causal, scale, block_q,
         in_specs=[tile_q, tile_k_rev, tile_k_rev, tile_q, rows_q_rev,
                   rows_q_rev],
         out_specs=[tile_k_rev, tile_k_rev],
-        out_shape=[jax.ShapeDtypeStruct(kr.shape, k.dtype),
-                   jax.ShapeDtypeStruct(vr.shape, v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(kr.shape, grad_dtype or k.dtype),
+                   jax.ShapeDtypeStruct(vr.shape, grad_dtype or v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, slab), jnp.float32),
                         pltpu.VMEM((block_k, slab), jnp.float32)],
         compiler_params=seq_params,
@@ -812,13 +816,112 @@ def _flash_nl_backward(q, k, v, out, lse, g, causal, scale, block_q,
         in_specs=[tile_q_fwd, tile_k_fwd, tile_k_fwd, tile_q_fwd,
                   rows_q_fwd, rows_q_fwd],
         out_specs=tile_q_fwd,
-        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qr.shape, grad_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, slab), jnp.float32)],
         compiler_params=seq_params,
         interpret=interpret,
     )(qr, kr, vr, gr, lse, delta)
 
     return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
+
+
+def _chunk_blocks(seq_q: int, seq_k: int):
+    """Ring-chunk block sizes: the shared env-overridable defaults,
+    shrunk to divisors of the (arbitrary) chunk lengths."""
+    block_q, block_k = _resolve_blocks(None, None)
+    return fit_block(seq_q, block_q), fit_block(seq_k, block_k)
+
+
+def _flash_chunk_fwd(q, k, v, causal: bool, scale: float,
+                     interpret: bool = False):
+    """Forward-only chunk attention for partial-softmax composition
+    (ring attention): returns ``(out, lse)`` with ``out`` the f32
+    chunk-normalized output and ``lse [B, T, H]`` the chunk's
+    log-sum-exp — the pair downstream code merges across chunks with the
+    standard rescaling identity.  f32 out keeps the cross-chunk
+    accumulation at one rounding total (the per-tile VMEM accumulators
+    are f32 already).  Kernel-dispatched like ``flash_attention`` —
+    same RAY_TPU_FLASH_NATIVE / _BLOCK_Q/K knobs — but with no autodiff
+    rule: callers own the backward (the ring builds it from
+    ``_flash_chunk_bwd``)."""
+    batch, seq_q, heads, dim = q.shape
+    block_q, block_k = _chunk_blocks(seq_q, k.shape[1])
+    if _resolve_native(q, k, v, None):
+        out, lse = _flash_nl_forward(q, k, v, causal, scale, block_q,
+                                     block_k, interpret,
+                                     out_dtype=jnp.float32)
+        # [B, H2, T, pack] -> [B, T, H]  (head index = h2 * pack + h)
+        lse = lse.transpose(0, 2, 1, 3).reshape(batch, seq_q, heads)
+    else:
+        out, lse = _flash_forward(q, k, v, causal, scale, block_q,
+                                  block_k, interpret,
+                                  out_dtype=jnp.float32)
+        lse = lse[..., 0].transpose(0, 2, 1)
+    return out, lse
+
+
+def _flash_chunk_bwd(q, k, v, out, lse, g, causal: bool, scale: float,
+                     interpret: bool = False, delta=None):
+    """Backward of one (Q-chunk, KV-chunk) pair given the GLOBAL row
+    statistics: ``lse [B, T, H]`` must be the final merged log-sum-exp,
+    ``out``/``g`` the final output / its cotangent for the Q chunk, and
+    ``delta [B, T, H]`` (optional, recomputed when absent) their
+    rowsum product — that is exactly what makes per-chunk backwards sum
+    to the global gradient.  Returns f32 ``(dq, dk, dv)`` for exact
+    cross-chunk accumulation."""
+    batch, seq_q, heads, dim = q.shape
+    block_q, block_k = _chunk_blocks(seq_q, k.shape[1])
+    if _resolve_native(q, k, v, None):
+        pack = 128 // dim
+        h2 = heads // pack
+
+        def to_nl(x):
+            return x.reshape(batch, seq_q, h2, pack).transpose(0, 2, 1, 3)
+
+        return _flash_nl_backward(q, k, v, out, to_nl(lse), g, causal,
+                                  scale, block_q, block_k, interpret,
+                                  grad_dtype=jnp.float32,
+                                  delta=None if delta is None
+                                  else to_nl(delta))
+    return _flash_backward(q, k, v, out,
+                           lse.transpose(0, 2, 1)[..., None], g, causal,
+                           scale, block_q, block_k, interpret,
+                           grad_dtype=jnp.float32,
+                           delta=None if delta is None
+                           else delta.transpose(0, 2, 1)[..., None])
+
+
+def _resolve_blocks(block_q, block_k):
+    """Default block sizes, with the RAY_TPU_FLASH_BLOCK_Q/K tuning
+    escape hatches applied only when the caller passed no explicit
+    size."""
+    import os
+    if block_q is None:
+        block_q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q") or 1024)
+    if block_k is None:
+        block_k = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K") or 1024)
+    return block_q, block_k
+
+
+def _resolve_native(q, k, v, native, bwd_impl="pallas"):
+    """Shared native-vs-head-major dispatch: explicit ``native`` wins,
+    otherwise auto-select eligible shapes unless RAY_TPU_FLASH_NATIVE
+    disables it or an XLA backward was requested."""
+    import os
+    if native is not None:
+        return native
+    env = os.environ.get("RAY_TPU_FLASH_NATIVE", "").lower()
+    return (env not in ("0", "false", "off")
+            and bwd_impl == "pallas" and _nl_eligible(q, k, v))
+
+
+def fit_block(seq: int, block: int) -> int:
+    """Largest divisor of ``seq`` that is <= ``block`` (the pallas grids
+    need the sequence to divide into whole tiles)."""
+    for d in range(min(block, seq), 0, -1):
+        if seq % d == 0:
+            return d
+    return 1
 
 
 def _nl_eligible(q, k, v) -> bool:
@@ -940,20 +1043,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         if backend not in ("tpu", "axon"):
             return _attention_reference(q, k, v, causal, scale)
         interpret = False
-    import os
-    # tuning escape hatches (trace-time), applied only when the caller
-    # did not pass explicit sizes — an env var must not silently change
-    # a deliberate choice (e.g. the parity tests' 128-blocks)
-    if block_q is None:
-        block_q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q") or 1024)
-    if block_k is None:
-        block_k = int(os.environ.get("RAY_TPU_FLASH_BLOCK_K") or 1024)
-    if native is None:
-        env = os.environ.get("RAY_TPU_FLASH_NATIVE", "").lower()
-        # an explicit bwd_impl="xla" request keeps the head-major path —
-        # the NL family has no XLA-recompute backward to honor it with
-        native = (env not in ("0", "false", "off")
-                  and bwd_impl == "pallas" and _nl_eligible(q, k, v))
+    block_q, block_k = _resolve_blocks(block_q, block_k)
+    # an explicit bwd_impl="xla" request keeps the head-major path — the
+    # NL family has no XLA-recompute backward to honor it with
+    native = _resolve_native(q, k, v, native, bwd_impl)
     if native:
         return _flash_nl(q, k, v, causal, scale, block_q, block_k,
                          interpret)
