@@ -924,6 +924,15 @@ def fit_block(seq: int, block: int) -> int:
     return 1
 
 
+def kernel_block_for(seq: int, block: int = 1024):
+    """Fitted block size when ``seq`` divides into sublane-aligned tiles
+    big enough for the flash kernels to pay off, else ``None`` — the
+    shared eligibility test for sequence-parallel dispatch (ring and
+    Ulysses both gate on it)."""
+    fit = fit_block(seq, block)
+    return fit if fit >= 128 and fit % 8 == 0 else None
+
+
 def _nl_eligible(q, k, v) -> bool:
     """The NL kernels handle head_dim in {64, 128} with the head count a
     multiple of the per-slab packing factor."""

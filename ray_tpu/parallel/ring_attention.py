@@ -274,16 +274,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if impl == "auto":
-        from ray_tpu.ops.flash_attention import fit_block
+        from ray_tpu.ops.flash_attention import kernel_block_for
         tq_local = (q.shape[1] // mesh.shape[axis_name]
                     if mesh is not None else q.shape[1])
-        # kernel path needs the chunk to divide into reasonably sized
-        # sublane-aligned tiles; awkward chunk lengths fall back to the
-        # reference scan
-        fit = fit_block(tq_local, 1024)
+        # awkward chunk lengths fall back to the reference scan
         impl = ("kernel"
                 if jax.default_backend() in ("tpu", "axon")
-                and fit >= 128 and fit % 8 == 0
+                and kernel_block_for(tq_local) is not None
                 else "reference")
     if impl == "kernel":
         def fn(q_, k_, v_):

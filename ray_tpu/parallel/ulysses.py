@@ -31,8 +31,29 @@ def _default_attn(q, k, v, causal: bool, scale: float):
                       ).astype(q.dtype)
 
 
+def _local_attn(q, k, v, causal: bool, scale: float, interpret: bool):
+    """Post-all-to-all local attention: the pallas flash kernels on TPU
+    (O(block) memory, custom-VJP backward) with the jnp reference as the
+    CPU/awkward-shape fallback.  ``interpret=True`` ALWAYS runs the
+    kernels (through the pallas interpreter) — a test asking for the
+    kernel path must never silently compare the reference to itself."""
+    from ray_tpu.ops.flash_attention import (fit_block, flash_attention,
+                                             kernel_block_for)
+
+    if interpret:
+        fit = fit_block(q.shape[1], 1024)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=fit, block_k=fit, interpret=True)
+    if jax.default_backend() in ("tpu", "axon"):
+        blk = kernel_block_for(q.shape[1])
+        if blk is not None:
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   block_q=blk, block_k=blk)
+    return _default_attn(q, k, v, causal, scale)
+
+
 def _ulysses_sharded(q, k, v, axis_name: str, causal: bool, scale: float,
-                     attn_fn: Optional[Callable]):
+                     attn_fn: Optional[Callable], interpret: bool = False):
     # [B, T/n, H, D] -> all-to-all -> [B, T, H/n, D]
     def seq_to_heads(x):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -43,12 +64,9 @@ def _ulysses_sharded(q, k, v, axis_name: str, causal: bool, scale: float,
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    fn = attn_fn or functools.partial(_default_attn, causal=causal,
-                                      scale=scale)
-    if attn_fn is not None:
-        out = fn(qh, kh, vh)
-    else:
-        out = fn(qh, kh, vh)
+    fn = attn_fn or functools.partial(_local_attn, causal=causal,
+                                      scale=scale, interpret=interpret)
+    out = fn(qh, kh, vh)
     return heads_to_seq(out)
 
 
@@ -56,20 +74,25 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                       axis_name: str = "sp", causal: bool = True,
                       scale: Optional[float] = None,
                       attn_fn: Optional[Callable] = None,
-                      mesh: Optional[Mesh] = None) -> jax.Array:
+                      mesh: Optional[Mesh] = None,
+                      interpret: bool = False) -> jax.Array:
     """All-to-all sequence parallel attention.
 
-    ``attn_fn(q, k, v)`` optionally overrides the local attention (e.g.
-    the pallas flash kernel from ``ray_tpu.ops``); heads must be divisible
-    by the axis size.
+    The local attention after resharding defaults to the pallas flash
+    kernels on TPU (jnp reference elsewhere); ``attn_fn(q, k, v)``
+    overrides it, and ``interpret=True`` forces the kernels through the
+    pallas interpreter on CPU (tests).  Heads must be divisible by the
+    axis size.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if mesh is None:
-        return _ulysses_sharded(q, k, v, axis_name, causal, scale, attn_fn)
+        return _ulysses_sharded(q, k, v, axis_name, causal, scale, attn_fn,
+                                interpret)
     spec = P(None, axis_name, None, None)
     fn = functools.partial(_ulysses_sharded, axis_name=axis_name,
-                           causal=causal, scale=scale, attn_fn=attn_fn)
+                           causal=causal, scale=scale, attn_fn=attn_fn,
+                           interpret=interpret)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
