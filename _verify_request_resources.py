@@ -1,0 +1,52 @@
+"""User-style drive: autoscaler.sdk.request_resources end-to-end."""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import time
+
+import ray_tpu
+from ray_tpu.autoscaler import (FakeMultiNodeProvider, Monitor,
+                                NodeTypeConfig, StandardAutoscaler,
+                                request_resources)
+from ray_tpu.cluster_utils import Cluster
+
+cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+cluster.connect()
+try:
+    provider = FakeMultiNodeProvider(cluster,
+                                     {"cpu2": {"resources": {"CPU": 2}}})
+    asc = StandardAutoscaler(
+        provider, {"cpu2": NodeTypeConfig(resources={"CPU": 2},
+                                          max_workers=2)},
+        max_workers=2, idle_timeout_s=1.0)
+    monitor = Monitor(asc, update_interval_s=0.3)
+    monitor.start()
+
+    request_resources(num_cpus=3)
+    t0 = time.time()
+    while time.time() - t0 < 60 and not provider.non_terminated_nodes({}):
+        time.sleep(0.3)
+    n = len(provider.non_terminated_nodes({}))
+    assert n >= 1, "no node launched"
+    print(f"scale-up OK ({n} worker) in {time.time()-t0:.1f}s")
+
+    # the scaled capacity is actually usable
+    @ray_tpu.remote(num_cpus=2)
+    def f():
+        return "ran-on-scaled-node"
+    print(ray_tpu.get(f.remote(), timeout=60))
+
+    request_resources()  # clear
+    t0 = time.time()
+    while time.time() - t0 < 60 and provider.non_terminated_nodes({}):
+        time.sleep(0.3)
+    assert provider.non_terminated_nodes({}) == [], "did not scale down"
+    print(f"scale-down OK in {time.time()-t0:.1f}s")
+    monitor.stop()
+    print("VERIFY request_resources OK")
+finally:
+    ray_tpu.shutdown()
+    cluster.shutdown()

@@ -14,3 +14,4 @@ from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
     NodeTypeConfig,
     ResourceDemandScheduler,
 )
+from ray_tpu.autoscaler.sdk import request_resources  # noqa: F401

@@ -34,6 +34,7 @@ class LoadMetrics:
     def __init__(self):
         self.nodes: List[Dict[str, Any]] = []
         self.pending_demand: List[Dict[str, float]] = []
+        self.resource_requests: List[Dict[str, float]] = []
         self.pending_placement_groups: List[Dict[str, Any]] = []
         self.last_update = 0.0
 
@@ -41,6 +42,7 @@ class LoadMetrics:
         self.nodes = [n for n in snapshot.get("nodes", [])
                       if n.get("alive")]
         self.pending_demand = list(snapshot.get("pending_demand", []))
+        self.resource_requests = list(snapshot.get("resource_requests", []))
         self.pending_placement_groups = list(
             snapshot.get("pending_placement_groups", []))
         self.last_update = time.monotonic()
@@ -115,6 +117,23 @@ class StandardAutoscaler:
         for name, count in demand_launch.items():
             to_launch[name] = to_launch.get(name, 0) + count
 
+        # standing sdk.request_resources bundles: a min-cluster-size
+        # request, packed against TOTAL capacity (busy nodes still
+        # count — it is not a reservation; reference sdk.py:206)
+        if lm.resource_requests:
+            request_launch = self.scheduler.get_nodes_to_launch(
+                existing_nodes=[
+                    (ntype, self._node_resources(nid, "resources_total"))
+                    for nid, ntype in live
+                ] + self._head_nodes("resources_total"),
+                demand=lm.resource_requests,
+                pending_placement_groups=[],
+                launching={k: launching.get(k, 0) + to_launch.get(k, 0)
+                           for k in set(launching) | set(to_launch)},
+            )
+            for name, count in request_launch.items():
+                to_launch[name] = to_launch.get(name, 0) + count
+
         budget = self.max_workers - len(workers)
         launched: Dict[str, int] = {}
         for name, count in to_launch.items():
@@ -135,12 +154,16 @@ class StandardAutoscaler:
             now = time.monotonic()
             idle_by_id = {n["node_id"]: self.node_idle(n)
                           for n in lm.nodes}
+            protected = self._protected_by_requests(live)
 
             def is_idle(provider_id: str) -> bool:
                 return any(v for g, v in idle_by_id.items()
                            if g.startswith(provider_id))
 
             for nid, ntype in live:
+                if nid in protected:
+                    self._idle_since.pop(nid, None)
+                    continue
                 if is_idle(nid):
                     since = self._idle_since.setdefault(nid, now)
                     floor = self.node_types[ntype].min_workers \
@@ -166,12 +189,41 @@ class StandardAutoscaler:
 
     # ------------------------------------------------------------------
     def _node_available(self, provider_id: str) -> Dict[str, float]:
+        return self._node_resources(provider_id, "resources_available")
+
+    def _node_resources(self, provider_id: str,
+                        key: str) -> Dict[str, float]:
         for n in self.load_metrics.nodes:
             if n["node_id"].startswith(provider_id):
-                return dict(n.get("resources_available", {}))
+                return dict(n.get(key, {}))
         return {}
 
-    def _head_nodes(self) -> List[Tuple[str, Dict[str, float]]]:
+    def _protected_by_requests(self, live) -> set:
+        """Provider ids of the workers a standing resource request needs
+        (first-fit against node TOTALS, head capacity first so requests
+        the head covers pin nothing) — only these skip idle scale-down;
+        a request must not pin the whole cluster."""
+        reqs = self.load_metrics.resource_requests
+        if not reqs:
+            return set()
+        caps: List[Tuple[Optional[str], Dict[str, float]]] = [
+            (None, tot) for _, tot in self._head_nodes("resources_total")]
+        caps += [(nid, self._node_resources(nid, "resources_total"))
+                 for nid, ntype in live]
+        protected = set()
+        for bundle in reqs:
+            for owner, cap in caps:
+                if all(cap.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    if owner is not None:
+                        protected.add(owner)
+                    break
+            # bundles no node fits need launches, not protection
+        return protected
+
+    def _head_nodes(self, key: str = "resources_available"
+                    ) -> List[Tuple[str, Dict[str, float]]]:
         """Head capacity also absorbs demand (it's not a provider node).
 
         Nodes we just terminated may still look alive in the GCS until
@@ -186,5 +238,5 @@ class StandardAutoscaler:
         out = []
         for n in self.load_metrics.nodes:
             if not any(n["node_id"].startswith(p) for p in prefixes):
-                out.append(("", dict(n.get("resources_available", {}))))
+                out.append(("", dict(n.get(key, {}))))
         return out

@@ -49,6 +49,10 @@ class NodeInfo:
     stats: Dict[str, Any] = field(default_factory=dict)
 
 
+#: internal-KV key (default namespace) holding the standing
+#: ``autoscaler.sdk.request_resources`` bundles as a JSON list
+RESOURCE_REQUEST_KV_KEY = "__autoscaler_resource_request"
+
 ACTOR_PENDING = "PENDING_CREATION"
 ACTOR_ALIVE = "ALIVE"
 ACTOR_RESTARTING = "RESTARTING"
@@ -424,8 +428,26 @@ class GcsServer:
             ],
             "pending_demand": [d for n in self.nodes.values() if n.alive
                                for d in n.pending_demand],
+            "resource_requests": self._requested_resources(),
             "pending_placement_groups": pending_pgs,
         }
+
+    def _requested_resources(self):
+        """Standing ``autoscaler.sdk.request_resources`` bundles (stored
+        in internal KV by the SDK; reference autoscaler/sdk/sdk.py:206).
+        Reported separately from queued-work demand: the autoscaler
+        packs these against TOTAL capacity (a min-cluster-size request,
+        not a reservation) and they must not pin unrelated idle
+        nodes."""
+        import json
+
+        raw = self.kv.get("", {}).get(RESOURCE_REQUEST_KV_KEY)
+        if not raw:
+            return []
+        try:
+            return [b for b in json.loads(raw) if isinstance(b, dict)]
+        except (ValueError, TypeError):
+            return []
 
     async def handle_get_nodes(self, conn, data):
         return [

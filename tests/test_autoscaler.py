@@ -69,8 +69,9 @@ def test_infeasible_demand_not_launched():
     assert out == {}
 
 
-def _snapshot(nodes, demand=(), pgs=()):
+def _snapshot(nodes, demand=(), pgs=(), requests=()):
     return {"nodes": nodes, "pending_demand": list(demand),
+            "resource_requests": list(requests),
             "pending_placement_groups": list(pgs)}
 
 
@@ -161,6 +162,129 @@ def test_autoscaler_fake_multinode_end_to_end():
             if not provider.non_terminated_nodes({}):
                 break
             time.sleep(0.5)
+        assert provider.non_terminated_nodes({}) == []
+        monitor.stop()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_request_resources_packs_against_totals_not_free():
+    """A busy cluster whose TOTAL capacity already covers the standing
+    request launches nothing — request_resources is a min-cluster-size
+    ask, not a reservation (reference sdk semantics)."""
+    provider = MockProvider()
+    asc = StandardAutoscaler(
+        provider, {"cpu4": NodeTypeConfig(resources={"CPU": 4},
+                                          max_workers=5)},
+        idle_timeout_s=60.0)
+    # one fully-busy cpu4 worker; request for 4 CPUs fits its TOTALS
+    provider.create_node({}, {TAG_NODE_KIND: "worker",
+                              TAG_NODE_TYPE: "cpu4"}, 1)
+    wid = provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})[0]
+    asc.update_load_metrics(_snapshot(
+        [_gcs_node("head", {"CPU": 1}, {"CPU": 1}),
+         _gcs_node(wid[:12], {"CPU": 4}, {"CPU": 0}, load=4)],
+        requests=[{"CPU": 1}] * 4))
+    r = asc.update()
+    assert r["launched"] == {}
+    # but a request BEYOND total capacity does launch
+    asc.update_load_metrics(_snapshot(
+        [_gcs_node("head", {"CPU": 1}, {"CPU": 1}),
+         _gcs_node(wid[:12], {"CPU": 4}, {"CPU": 0}, load=4)],
+        requests=[{"CPU": 1}] * 9))
+    r = asc.update()
+    assert r["launched"] == {"cpu4": 1}
+
+
+def test_request_resources_pins_only_needed_nodes():
+    """A standing request the head already covers must not block idle
+    scale-down of unrelated workers; a request needing one worker pins
+    exactly one of two idle workers."""
+    provider = MockProvider()
+    asc = StandardAutoscaler(
+        provider, {"cpu4": NodeTypeConfig(resources={"CPU": 4},
+                                          max_workers=5)},
+        idle_timeout_s=0.1)
+    provider.create_node({}, {TAG_NODE_KIND: "worker",
+                              TAG_NODE_TYPE: "cpu4"}, 2)
+    w1, w2 = provider.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+    nodes = [_gcs_node("head", {"CPU": 1}, {"CPU": 1}),
+             _gcs_node(w1[:12], {"CPU": 4}, {"CPU": 4}),
+             _gcs_node(w2[:12], {"CPU": 4}, {"CPU": 4})]
+
+    # head covers a 1-CPU request: both idle workers terminate
+    asc.update_load_metrics(_snapshot(nodes, requests=[{"CPU": 1}]))
+    asc.update()
+    time.sleep(0.2)
+    r = asc.update()
+    assert len(r["terminated"]) == 2
+
+    # a 4-CPU request needs one worker: exactly one survives
+    provider2 = MockProvider()
+    asc2 = StandardAutoscaler(
+        provider2, {"cpu4": NodeTypeConfig(resources={"CPU": 4},
+                                           max_workers=5)},
+        idle_timeout_s=0.1)
+    provider2.create_node({}, {TAG_NODE_KIND: "worker",
+                               TAG_NODE_TYPE: "cpu4"}, 2)
+    w1, w2 = provider2.non_terminated_nodes({TAG_NODE_KIND: "worker"})
+    nodes = [_gcs_node("head", {"CPU": 1}, {"CPU": 1}),
+             _gcs_node(w1[:12], {"CPU": 4}, {"CPU": 4}),
+             _gcs_node(w2[:12], {"CPU": 4}, {"CPU": 4})]
+    asc2.update_load_metrics(_snapshot(nodes, requests=[{"CPU": 4}]))
+    asc2.update()
+    time.sleep(0.2)
+    asc2.update_load_metrics(_snapshot(nodes, requests=[{"CPU": 4}]))
+    r = asc2.update()
+    assert len(r["terminated"]) == 1
+    assert len(provider2.non_terminated_nodes(
+        {TAG_NODE_KIND: "worker"})) == 1
+
+
+def test_request_resources_scales_up_and_holds():
+    """autoscaler.sdk.request_resources (reference sdk.py:206): a
+    standing capacity request scales the cluster up without any queued
+    task, holds it there past the idle timeout, and clearing the
+    request releases the nodes."""
+    from ray_tpu.autoscaler import (FakeMultiNodeProvider, Monitor,
+                                    request_resources)
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    cluster.connect()
+    try:
+        node_types = {"cpu2": NodeTypeConfig(resources={"CPU": 2},
+                                             max_workers=2)}
+        provider = FakeMultiNodeProvider(
+            cluster, {"cpu2": {"resources": {"CPU": 2}}})
+        asc = StandardAutoscaler(provider, node_types, max_workers=2,
+                                 idle_timeout_s=1.0)
+        monitor = Monitor(asc, update_interval_s=0.3)
+        monitor.start()
+
+        # 3 one-CPU bundles; the 1-CPU head covers one -> 1 cpu2 node
+        request_resources(num_cpus=3)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if provider.non_terminated_nodes({}):
+                break
+            time.sleep(0.3)
+        assert provider.non_terminated_nodes({}), \
+            "standing request did not launch a node"
+
+        # idle_timeout is 1s, but the standing request pins the node
+        time.sleep(3.0)
+        assert provider.non_terminated_nodes({}), \
+            "standing request did not hold the node"
+
+        request_resources()  # clear -> normal idle scale-down
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if not provider.non_terminated_nodes({}):
+                break
+            time.sleep(0.3)
         assert provider.non_terminated_nodes({}) == []
         monitor.stop()
     finally:
