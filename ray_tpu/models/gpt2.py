@@ -34,9 +34,9 @@ class GPT2Config:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    #: "flash" | "ring" | "reference"
+    #: "flash" | "ring" | "ulysses" | "reference"
     attn_impl: str = "flash"
-    #: mesh axis name for ring attention (when attn_impl == "ring")
+    #: mesh axis name for ring/ulysses attention (sequence-parallel impls)
     sp_axis: str = "sp"
     #: activation rematerialization per block: "" (store activations),
     #: "full" (recompute everything in backward), or "dots" (save
@@ -131,6 +131,14 @@ class Block(nn.Module):
             # user shard_map the axis is already bound and mesh is None
             attn = ring_attention(q, k, v, axis_name=cfg.sp_axis,
                                   causal=True, mesh=get_global_mesh())
+        elif cfg.attn_impl == "ulysses":
+            from ray_tpu.parallel.mesh import get_global_mesh
+            from ray_tpu.parallel.ulysses import ulysses_attention
+
+            # same binding rules as "ring": mesh when under plain
+            # jit/GSPMD, already-bound axis inside a user shard_map
+            attn = ulysses_attention(q, k, v, axis_name=cfg.sp_axis,
+                                     causal=True, mesh=get_global_mesh())
         elif cfg.attn_impl == "reference":
             from ray_tpu.ops.flash_attention import _attention_reference
 
