@@ -1,0 +1,57 @@
+"""User-style drive: max_calls recycling + exit_actor after the fixes."""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import time
+import ray_tpu
+from ray_tpu.actor import exit_actor
+from ray_tpu.core.exceptions import ActorError
+
+ray_tpu.init(num_cpus=2, resources={"TPU": 1})
+
+@ray_tpu.remote(max_calls=3)
+def w(x):
+    import os
+    time.sleep(0.01)
+    return (x + 1, os.getpid())
+
+t0 = time.perf_counter()
+out = ray_tpu.get([w.remote(i) for i in range(30)], timeout=120)
+dt = time.perf_counter() - t0
+assert [v for v, _ in out] == list(range(1, 31))
+pids = {p for _, p in out}
+print(f"30 pipelined tasks, max_calls=3: {dt:.1f}s across {len(pids)} workers")
+
+@ray_tpu.remote(num_tpus=1)
+def tpu_task():
+    import os
+    return os.getpid()
+tp = [ray_tpu.get(tpu_task.remote()) for _ in range(3)]
+assert len(set(tp)) == 3
+print("TPU default max_calls=1: fresh worker per call")
+
+@ray_tpu.remote(max_restarts=5)
+class Svc:
+    def ping(self):
+        return "pong"
+    def shutdown(self):
+        exit_actor()
+
+s = Svc.remote()
+assert ray_tpu.get(s.ping.remote()) == "pong"
+try:
+    ray_tpu.get(s.shutdown.remote(), timeout=30)
+    raise AssertionError("expected ActorError")
+except ActorError:
+    pass
+time.sleep(1.5)
+try:
+    ray_tpu.get(s.ping.remote(), timeout=10)
+    raise AssertionError("restarted despite exit_actor")
+except Exception:
+    pass
+print("exit_actor: caller errored, no restart despite max_restarts=5")
+ray_tpu.shutdown()
+print("VERIFY LIFECYCLE OK")

@@ -235,3 +235,18 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
         raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
     return ActorHandle(ActorID(info["actor_id"]),
                        info.get("class_name", ""))
+
+
+def exit_actor() -> None:
+    """Intentionally exit the current actor (reference
+    ``ray.actor.exit_actor``): the in-flight call raises
+    ``ActorDiedError`` at its caller, queued calls fail with actor
+    death, the actor is marked DEAD with no restart (even with
+    ``max_restarts``), and the worker process exits."""
+    from ray_tpu.core import worker as worker_mod
+    from ray_tpu.core.exceptions import ActorExitRequest
+
+    core = worker_mod.global_worker()
+    if getattr(core, "_actor_id", None) is None:
+        raise RuntimeError("exit_actor() called outside an actor")
+    raise ActorExitRequest()

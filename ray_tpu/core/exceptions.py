@@ -102,3 +102,9 @@ class PlacementGroupUnschedulableError(RayTpuError):
 
 class RayTpuSystemError(RayTpuError):
     """Internal invariant violation; indicates a framework bug."""
+
+
+class ActorExitRequest(BaseException):
+    """Raised by :func:`ray_tpu.actor.exit_actor`; BaseException so a
+    user-level ``except Exception`` inside the method cannot swallow
+    the exit (parity: the reference signals via a SystemExit path)."""

@@ -77,6 +77,11 @@ class TaskSpec:
     resources: Dict[str, float] = field(default_factory=dict)
     max_retries: int = 0
     retry_exceptions: bool = False
+    #: worker recycling: after this many executions of this function the
+    #: worker exits and a fresh one serves the next call (0 = unlimited;
+    #: reference remote_function.py:58 — and like its num_gpus rule,
+    #: TPU-resource tasks default to 1 so device memory is released)
+    max_calls: int = 0
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     owner_address: Optional[OwnerAddress] = None
     # Actor-related fields.

@@ -58,6 +58,7 @@ from ray_tpu.core import rpc
 from ray_tpu.core.config import Config, get_config, set_config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
+    ActorExitRequest,
     GetTimeoutError,
     ObjectLostError,
     RayTpuError,
@@ -265,6 +266,13 @@ class CoreWorker:
 
         # execution (worker mode)
         self._exec_queue: "queue_mod.Queue" = queue_mod.Queue()
+        # max_calls worker recycling: executions per function_id; once a
+        # spec's max_calls is reached the worker replies with
+        # worker_exit=True and exits after the reply flushes
+        self._fn_exec_counts: Dict[str, int] = {}
+        self._exit_after_reply = False
+        #: a future the exit sequence must wait on (exit_actor's GCS ack)
+        self._exit_barrier = None
         self._exec_threads: List[threading.Thread] = []
         self._function_cache: Dict[str, Any] = {}
         # raylet-prefetched function blobs, decoded lazily on exec threads
@@ -1279,6 +1287,7 @@ class CoreWorker:
                     runtime_env: Optional[Dict[str, Any]] = None,
                     dynamic_returns: bool = False,
                     stream_returns: bool = False,
+                    max_calls: int = 0,
                     ) -> List[ObjectRef]:
         task_id = TaskID.for_normal_task(self.job_id)
         task_args, holds = self._build_args(args, kwargs)
@@ -1302,6 +1311,7 @@ class CoreWorker:
             trace_context=_trace_carrier(),
             dynamic_returns=dynamic_returns,
             stream_returns=stream_returns,
+            max_calls=max_calls,
         )
         if stream_returns:
             # register BEFORE submission: the first dynamic_items push
@@ -1695,8 +1705,20 @@ class CoreWorker:
             self._pump_lease_queue(state)
             return
         worker.inflight -= 1
+        if reply.get("worker_exit"):
+            self._drop_exiting_worker(state, worker)
         self._handle_task_reply(spec, reply)
         self._pump_lease_queue(state)
+
+    def _drop_exiting_worker(self, state: "_LeaseState", worker) -> None:
+        """The worker announced max_calls recycling in its reply: stop
+        targeting it (the process exits right after the reply flushes;
+        the raylet reclaims its lease resources on death)."""
+        state.workers.pop(worker.worker_id, None)
+        # deliberately NOT invalidating the pooled connection here:
+        # pipelined calls may still be awaiting replies on it (the
+        # worker drains its queue before exiting); the close lands
+        # naturally when the process exits
 
     async def _push_task_batch(self, state: "_LeaseState",
                                worker: "_LeasedWorker",
@@ -1806,6 +1828,8 @@ class CoreWorker:
                 continue  # a stale attempt's late push
             spec, state, worker = entry
             worker.inflight -= 1
+            if reply.get("worker_exit"):
+                self._drop_exiting_worker(state, worker)
             self._handle_task_reply(spec, reply)
             states[id(state)] = state
         for state in states.values():
@@ -2648,6 +2672,31 @@ class CoreWorker:
         except KeyboardInterrupt:
             return self._cancelled_reply(spec)
 
+    def _actor_exit_reply(self, spec: TaskSpec) -> Dict[str, Any]:
+        """The method called exit_actor(): the caller gets
+        ActorDiedError, the GCS is told to mark the actor DEAD with no
+        restart (kill_actor), and _exit_after_reply recycles the
+        process once the reply flushes."""
+        self._exit_after_reply = True
+        aid = self._actor_id
+
+        def _notify():
+            try:
+                fut = self.gcs_conn.start_call(
+                    "kill_actor", {"actor_id": aid.binary()})
+                self._exit_barrier = fut
+                fut.add_done_callback(
+                    lambda f: f.exception() if not f.cancelled() else None)
+            except Exception:  # noqa: BLE001 — exit proceeds regardless
+                pass
+        self._loop.call_soon_threadsafe(_notify)
+        blob = serialize_exception(ActorDiedError(
+            f"actor {aid.hex()[:12]} exited via exit_actor() "
+            f"during {spec.debug_name()}")).to_bytes()
+        return {"results": [(rid.binary(), "inline", blob)
+                            for rid in spec.return_ids()],
+                "app_error": True}
+
     def _exec_queue_for(self, spec: TaskSpec) -> "queue_mod.Queue":
         """Concurrency-group routing (parity: reference actor.py:65-83):
         an actor task runs in its named group's executor pool when the
@@ -2694,13 +2743,23 @@ class CoreWorker:
                 ready = _BurstQueue(self._loop, out_batch.append, _ship)
                 for s in specs:
                     r = self._exec_one(s)
+                    self._track_max_calls(s)
                     replies.append(r)
                     ready.push((s, r))
+                if self._exit_after_reply and replies:
+                    # overshoot is bounded by one pushed batch: specs
+                    # already shipped to this worker still run here
+                    replies[-1]["worker_exit"] = True
                 self._loop.call_soon_threadsafe(_set_future, reply_fut,
                                                 replies)
+                if self._exit_after_reply and q.empty():
+                    self._schedule_worker_exit()
                 continue
             spec, reply_fut = item
             reply = self._exec_one(spec)
+            self._track_max_calls(spec)
+            if self._exit_after_reply:
+                reply["worker_exit"] = True
             while True:
                 # commit must survive a late SetAsyncExc interrupt (the
                 # extra-exec-thread cancel path has no signal-handler
@@ -2711,6 +2770,39 @@ class CoreWorker:
                     break
                 except KeyboardInterrupt:
                     continue
+            if self._exit_after_reply and q.empty():
+                self._schedule_worker_exit()
+
+    def _track_max_calls(self, spec: TaskSpec) -> None:
+        if not getattr(spec, "max_calls", 0) or spec.actor_id is not None:
+            return
+        n = self._fn_exec_counts.get(spec.function_id, 0) + 1
+        self._fn_exec_counts[spec.function_id] = n
+        if n >= spec.max_calls:
+            self._exit_after_reply = True
+
+    def _schedule_worker_exit(self) -> None:
+        """Exit AFTER (a) any pending GCS notification (exit_actor's
+        kill_actor must land before the death report, or the GCS would
+        restart the actor) and (b) a short grace so the final reply
+        flushes; the owner already learned from worker_exit in the
+        reply, and the raylet reclaims lease resources on death."""
+        def _arm():
+            logger.info("worker exiting: %s",
+                        "exit_actor" if self._exit_barrier is not None
+                        else "max_calls reached")
+
+            async def _exit_soon():
+                barrier = self._exit_barrier
+                if barrier is not None:
+                    try:
+                        await asyncio.wait_for(asyncio.shield(barrier), 5.0)
+                    except Exception:  # noqa: BLE001 — exit regardless
+                        pass
+                await asyncio.sleep(0.25)
+                os._exit(0)
+            self._loop.create_task(_exit_soon())
+        self._loop.call_soon_threadsafe(_arm)
 
     def _start_extra_exec_threads(self, n: int) -> None:
         for _ in range(n):
@@ -3033,6 +3125,8 @@ class CoreWorker:
                 # into this thread), not a user Ctrl-C
                 self._interrupted_tasks.discard(tid_bin)
                 return self._cancelled_reply(spec)
+            if isinstance(e, ActorExitRequest):
+                return self._actor_exit_reply(spec)
             logger.debug("task %s raised", spec.debug_name(), exc_info=True)
             blob = serialize_exception(
                 TaskError.from_exception(e, spec.debug_name())).to_bytes()

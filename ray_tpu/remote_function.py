@@ -122,6 +122,13 @@ class RemoteFunction:
             # "streaming": each yielded object is announced as produced
             # and .remote() hands back the generator itself.
             generator_mode = nret in ("dynamic", "streaming")
+            max_calls = opts.get("max_calls")
+            if max_calls is None:
+                # TPU tasks recycle their worker by default so device
+                # memory/state is released between tasks (the reference
+                # applies the same rule to num_gpus,
+                # remote_function.py:101)
+                max_calls = 1 if resources.get("TPU") else 0
             resolved = (
                 resources,
                 1 if generator_mode else int(nret),
@@ -130,6 +137,7 @@ class RemoteFunction:
                 _resolve_strategy(strat_opt),
                 generator_mode,
                 nret == "streaming",
+                int(max_calls),
             )
             # a duck-typed strategy object (or a user-held resources dict)
             # may be mutated between calls — only cache when everything
@@ -139,7 +147,7 @@ class RemoteFunction:
                     and opts.get("resources") is None:
                 self._resolved = resolved
         (resources, num_returns, max_retries, retry_exc, strategy,
-         dynamic, streaming) = resolved
+         dynamic, streaming, max_calls) = resolved
         refs = core.submit_task(
             function_id,
             self._descriptor,
@@ -153,6 +161,7 @@ class RemoteFunction:
             runtime_env=self._packaged_runtime_env(core),
             dynamic_returns=dynamic,
             stream_returns=streaming,
+            max_calls=max_calls,
         )
         if streaming:
             from ray_tpu.core.object_ref import StreamingObjectRefGenerator

@@ -1,0 +1,106 @@
+"""Worker-recycling lifecycle: max_calls and exit_actor (reference
+``remote_function.py:58`` / ``ray.actor.exit_actor``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorError
+
+
+@pytest.mark.usefixtures("shutdown_only")
+def test_max_calls_recycles_workers():
+    """After ``max_calls`` executions the worker exits and a fresh
+    process serves the next call; TPU-resource tasks default to
+    ``max_calls=1`` (the reference applies the same rule to GPUs) so
+    device memory is released between tasks."""
+    ray_tpu.init(num_cpus=2, resources={"TPU": 1})
+
+    @ray_tpu.remote(max_calls=2)
+    def pid():
+        import os
+        return os.getpid()
+
+    pids = [ray_tpu.get(pid.remote()) for _ in range(6)]
+    assert pids[0] == pids[1] and pids[2] == pids[3], pids
+    assert len(set(pids)) >= 3, pids
+
+    @ray_tpu.remote(num_tpus=1)
+    def tpu_pid():
+        import os
+        return os.getpid()
+
+    tpu_pids = [ray_tpu.get(tpu_pid.remote()) for _ in range(3)]
+    assert len(set(tpu_pids)) == 3, tpu_pids  # fresh worker per call
+
+
+@pytest.mark.usefixtures("shutdown_only")
+def test_max_calls_drains_pipelined_tasks():
+    """Bursts pipeline several tasks onto one worker; a worker that
+    reaches max_calls must drain everything already queued to it before
+    exiting — no task may be lost or spuriously retried."""
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_calls=3)
+    def square(x):
+        import os
+        return (x * x, os.getpid())
+
+    refs = [square.remote(i) for i in range(24)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert [v for v, _ in out] == [i * i for i in range(24)]
+    assert len({p for _, p in out}) >= 3  # recycling actually happened
+
+
+@pytest.mark.usefixtures("shutdown_only")
+def test_exit_actor():
+    """exit_actor(): the in-flight caller gets ActorDiedError, the
+    actor never restarts (even with max_restarts), and a user-level
+    ``except Exception`` cannot swallow the exit signal."""
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_restarts=3)
+    class A:
+        def ping(self):
+            return "pong"
+
+        def bye(self):
+            from ray_tpu.actor import exit_actor
+            exit_actor()
+
+        def swallow(self):
+            from ray_tpu.actor import exit_actor
+            try:
+                exit_actor()
+            except Exception:
+                return "swallowed"  # must not happen (BaseException)
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    with pytest.raises(ActorError):
+        ray_tpu.get(a.bye.remote(), timeout=30)
+
+    time.sleep(1.0)  # a restart (the bug) would need a beat to land
+    with pytest.raises(Exception):
+        ray_tpu.get(a.ping.remote(), timeout=10)
+
+    b = A.remote()
+    with pytest.raises(ActorError):
+        ray_tpu.get(b.swallow.remote(), timeout=30)
+
+
+@pytest.mark.usefixtures("shutdown_only")
+def test_exit_actor_outside_actor_raises():
+    ray_tpu.init(num_cpus=1)
+    from ray_tpu.actor import exit_actor
+
+    @ray_tpu.remote
+    def not_an_actor():
+        try:
+            exit_actor()
+        except RuntimeError as e:
+            return str(e)
+        return "no error"
+
+    assert "outside an actor" in ray_tpu.get(not_an_actor.remote())
