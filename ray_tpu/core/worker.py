@@ -271,6 +271,8 @@ class CoreWorker:
         # worker_exit=True and exits after the reply flushes
         self._fn_exec_counts: Dict[str, int] = {}
         self._exit_after_reply = False
+        #: exit_actor() ran: queued calls fail instead of executing
+        self._actor_exiting = False
         #: a future the exit sequence must wait on (exit_actor's GCS ack)
         self._exit_barrier = None
         self._exec_threads: List[threading.Thread] = []
@@ -2667,6 +2669,10 @@ class CoreWorker:
         returned (in _execute_task's finally, while it waits on the
         tracking lock) — without this catch it would kill the exec loop
         and drop the computed reply."""
+        if self._actor_exiting:
+            # calls queued behind exit_actor() fail with actor death
+            # instead of executing (reference exit semantics)
+            return self._actor_dead_reply(spec)
         try:
             return self._execute_task(spec)
         except KeyboardInterrupt:
@@ -2678,6 +2684,7 @@ class CoreWorker:
         restart (kill_actor), and _exit_after_reply recycles the
         process once the reply flushes."""
         self._exit_after_reply = True
+        self._actor_exiting = True
         aid = self._actor_id
 
         def _notify():
@@ -2690,9 +2697,13 @@ class CoreWorker:
             except Exception:  # noqa: BLE001 — exit proceeds regardless
                 pass
         self._loop.call_soon_threadsafe(_notify)
+        return self._actor_dead_reply(spec)
+
+    def _actor_dead_reply(self, spec: TaskSpec) -> Dict[str, Any]:
+        aid = self._actor_id
         blob = serialize_exception(ActorDiedError(
             f"actor {aid.hex()[:12]} exited via exit_actor() "
-            f"during {spec.debug_name()}")).to_bytes()
+            f"({spec.debug_name()} will not run)")).to_bytes()
         return {"results": [(rid.binary(), "inline", blob)
                             for rid in spec.return_ids()],
                 "app_error": True}
@@ -2741,15 +2752,17 @@ class CoreWorker:
                         stream(out_batch[:])
                         out_batch.clear()
                 ready = _BurstQueue(self._loop, out_batch.append, _ship)
-                for s in specs:
+                for i, s in enumerate(specs):
                     r = self._exec_one(s)
                     self._track_max_calls(s)
+                    if i == len(specs) - 1 and self._exit_after_reply:
+                        # flag BEFORE the push: the streamed copy is the
+                        # only one the owner reads, and the drain races
+                        # this thread.  Overshoot is bounded by one
+                        # pushed batch: specs already shipped here run.
+                        r["worker_exit"] = True
                     replies.append(r)
                     ready.push((s, r))
-                if self._exit_after_reply and replies:
-                    # overshoot is bounded by one pushed batch: specs
-                    # already shipped to this worker still run here
-                    replies[-1]["worker_exit"] = True
                 self._loop.call_soon_threadsafe(_set_future, reply_fut,
                                                 replies)
                 if self._exit_after_reply and q.empty():

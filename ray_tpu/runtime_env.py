@@ -41,6 +41,7 @@ import os
 import shutil
 import sys
 import tempfile
+import fnmatch
 import zipfile
 from typing import Any, Dict, List, Optional
 
@@ -48,6 +49,7 @@ _CACHE_ROOT = os.path.join(os.environ.get("TMPDIR", "/tmp"),
                            "ray_tpu_runtime_env_cache")
 
 SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+             "excludes",
              "container", "py_executable"}
 
 
@@ -76,6 +78,18 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         raise ValueError(f"unknown runtime_env keys {sorted(unknown)} "
                          f"(supported: {sorted(SUPPORTED)})")
     out = dict(runtime_env)
+    if "excludes" in out:
+        if not isinstance(out["excludes"], (list, tuple)) or not all(
+                isinstance(x, str) for x in out["excludes"]):
+            raise ValueError("runtime_env['excludes'] must be a list of "
+                             "path patterns")
+        if "working_dir" not in out:
+            raise ValueError("runtime_env['excludes'] requires "
+                             "'working_dir'")
+        if str(out["working_dir"]).startswith("kv://"):
+            raise ValueError(
+                "runtime_env['excludes'] cannot apply to an already-"
+                "packaged kv:// working_dir — the zip is final")
     if "pip" in out:
         out["pip"] = _normalize_pip(out["pip"])
     if "conda" in out:
@@ -142,15 +156,41 @@ def env_hash(runtime_env: Dict[str, Any]) -> str:
         json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:16]
 
 
-def _walk_files(path: str):
+def _excluded(rel: str, patterns) -> bool:
+    """fnmatch-style exclude check against the POSIX relpath (reference
+    packaging.py honors gitwildmatch; this covers the common forms:
+    "*.ext", "dir/**", "dir/", "name", "/anchored")."""
+    rel = rel.replace(os.sep, "/")
+    for pat in patterns:
+        pat = pat.replace(os.sep, "/")
+        anchored = pat.startswith("/")
+        pat = pat.lstrip("/").rstrip("/")
+        if fnmatch.fnmatch(rel, pat) or fnmatch.fnmatch(rel, pat + "/*"):
+            return True
+        if not anchored and (fnmatch.fnmatch(os.path.basename(rel), pat)
+                             or any(fnmatch.fnmatch(part, pat)
+                                    for part in rel.split("/")[:-1])):
+            return True
+    return False
+
+
+def _walk_files(path: str, excludes=None):
     out = []
     for root, dirs, files in os.walk(path):
         dirs.sort()
+        if excludes:
+            # prune excluded trees so packaging cost doesn't scale with
+            # the directories the user asked to skip
+            dirs[:] = [d for d in dirs if not _excluded(
+                os.path.relpath(os.path.join(root, d), path), excludes)]
         if "__pycache__" in root:
             continue
         for name in sorted(files):
             full = os.path.join(root, name)
-            out.append((os.path.relpath(full, path), full))
+            rel = os.path.relpath(full, path)
+            if excludes and _excluded(rel, excludes):
+                continue
+            out.append((rel, full))
     return out
 
 
@@ -188,10 +228,13 @@ def package(runtime_env: Dict[str, Any], kv_put) -> Dict[str, Any]:
     out = dict(runtime_env)
     if "working_dir" in out and not str(out["working_dir"]).startswith(
             "kv://"):
-        entries = _walk_files(out["working_dir"])
+        entries = _walk_files(out["working_dir"], out.get("excludes"))
         digest = _content_digest(entries)
         kv_put(f"pkg:{digest}", _zip_entries(entries), "_runtime_env")
         out["working_dir"] = f"kv://{digest}"
+    # excludes is a driver-side packaging directive only; workers never
+    # need it (the zip already omits the files)
+    out.pop("excludes", None)
     if "py_modules" in out:
         uris: List[str] = []
         for mod in out["py_modules"]:

@@ -45,6 +45,42 @@ def test_py_modules(tmp_path):
     assert ray_tpu.get(import_it.remote(), timeout=60) == 1234
 
 
+
+def test_working_dir_excludes(tmp_path):
+    """excludes filters working_dir packaging (reference packaging.py
+    gitwildmatch): matched files never reach the uploaded zip."""
+    import ray_tpu.runtime_env as renv
+
+    (tmp_path / "keep.py").write_text("x = 1\n")
+    (tmp_path / "secret.env").write_text("KEY=1\n")
+    (tmp_path / "data").mkdir()
+    (tmp_path / "data" / "big.bin").write_text("blob")
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "mod.py").write_text("y = 2\n")
+
+    captured = {}
+
+    def kv_put(key, blob, ns):
+        captured[key] = blob
+
+    env = renv.validate({"working_dir": str(tmp_path),
+                         "excludes": ["*.env", "data/"]})
+    out = renv.package(env, kv_put)
+    assert "excludes" not in out
+    import io
+    import zipfile
+    names = zipfile.ZipFile(io.BytesIO(next(iter(captured.values())))
+                            ).namelist()
+    assert "keep.py" in names and "src/mod.py" in names
+    assert not any("secret.env" in n or n.startswith("data") for n in names)
+
+    import pytest
+    with pytest.raises(ValueError):
+        renv.validate({"excludes": ["*.env"]})  # needs working_dir
+    with pytest.raises(ValueError):
+        renv.validate({"working_dir": "kv://deadbeef",
+                       "excludes": ["*.env"]})  # zip already final
+
 def test_working_dir(tmp_path):
     wd = tmp_path / "wd"
     wd.mkdir()

@@ -91,6 +91,32 @@ def test_exit_actor():
 
 
 @pytest.mark.usefixtures("shutdown_only")
+def test_exit_actor_fails_queued_calls():
+    """Calls already queued behind an exit_actor() call must fail with
+    actor death, not execute their side effects."""
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    class S:
+        def slow_then_exit(self):
+            import time
+            time.sleep(0.3)
+            from ray_tpu.actor import exit_actor
+            exit_actor()
+
+        def work(self):
+            return "must-not-run"
+
+    s = S.remote()
+    r1 = s.slow_then_exit.remote()
+    r2 = s.work.remote()  # queued behind the exit
+    with pytest.raises(ActorError):
+        ray_tpu.get(r1, timeout=30)
+    with pytest.raises(Exception):
+        assert ray_tpu.get(r2, timeout=30) != "must-not-run"
+
+
+@pytest.mark.usefixtures("shutdown_only")
 def test_exit_actor_outside_actor_raises():
     ray_tpu.init(num_cpus=1)
     from ray_tpu.actor import exit_actor
