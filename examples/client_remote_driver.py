@@ -12,6 +12,11 @@ then run this from ANY machine that can reach it:
     python examples/client_remote_driver.py ray://127.0.0.1:10001
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import sys
 
 import numpy as np

@@ -3,6 +3,11 @@
 Usage: python examples/rllib_ppo.py [--workers 2]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 import ray_tpu

@@ -3,6 +3,11 @@
 Usage: python examples/serve_inference.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import ray_tpu

@@ -3,6 +3,11 @@
 Usage: python examples/train_gpt2.py [--steps 30] [--model tiny|small]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 import numpy as np

@@ -3,6 +3,11 @@
 Usage: python examples/tune_asha.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import ray_tpu
 from ray_tpu import tune
 
