@@ -32,7 +32,6 @@ if os.environ.get("JAX_PLATFORMS", "") == "cpu":
     except RuntimeError:
         pass  # backend already initialized; fall through to the guard
 
-import sys
 
 import jax
 import jax.numpy as jnp

@@ -91,6 +91,28 @@ def test_exit_actor():
 
 
 @pytest.mark.usefixtures("shutdown_only")
+def test_max_calls_composes_with_retries(tmp_path):
+    """A transiently-failing task keeps its retry budget across worker
+    recycling: the retry lands on a FRESH worker (max_calls=1 recycled
+    the first) and succeeds."""
+    ray_tpu.init(num_cpus=2)
+    marker = str(tmp_path / "attempt1")
+
+    @ray_tpu.remote(max_calls=1, max_retries=3, retry_exceptions=True)
+    def flaky():
+        import os
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write(str(os.getpid()))
+            raise RuntimeError("first attempt fails")
+        return os.getpid()
+
+    pid = ray_tpu.get(flaky.remote(), timeout=60)
+    first_pid = int(open(marker).read())
+    assert pid != first_pid, "retry ran on the recycled worker"
+
+
+@pytest.mark.usefixtures("shutdown_only")
 def test_exit_actor_fails_queued_calls():
     """Calls already queued behind an exit_actor() call must fail with
     actor death, not execute their side effects."""
