@@ -126,3 +126,36 @@ def test_usage_telemetry_local_only(tmp_path, monkeypatch):
     monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
     usage.record_library_usage("serve")
     assert "serve" not in usage.usage_report()["libraries"]
+
+
+def test_actor_pool_survives_timeout_and_task_errors():
+    """get_next with a too-short timeout must leave the pool intact
+    (retry succeeds), and a task exception must still return the actor
+    to the idle set (the pool keeps working).  Uses the module's shared
+    cluster (ray_start_regular)."""
+    import time
+
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class W:
+        def work(self, x):
+            if x == "boom":
+                raise ValueError("boom")
+            time.sleep(float(x))
+            return x
+
+    pool = ActorPool([W.remote(), W.remote()])
+    pool.submit(lambda a, v: a.work.remote(v), 0.5)
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        pool.get_next(timeout=0.05)
+    # state intact: the same result is still claimable
+    assert pool.get_next(timeout=30) == 0.5
+
+    pool.submit(lambda a, v: a.work.remote(v), "boom")
+    with pytest.raises(Exception):
+        pool.get_next(timeout=30)
+    # the actor came back: the pool still serves new work
+    pool.submit(lambda a, v: a.work.remote(v), 0.0)
+    assert pool.get_next(timeout=30) == 0.0
+    assert not pool.has_next()
