@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Tuple, Optional
 
 from ray_tpu.autoscaler.node_provider import (NodeProvider, TAG_NODE_KIND,
                                               TAG_NODE_STATUS,

@@ -1493,7 +1493,8 @@ class CoreWorker:
         # tasks spread across workers/nodes instead of serializing into one
         # worker's pipeline.
         for worker in list(state.workers.values()):
-            if state.backlog and worker.inflight == 0:
+            if state.backlog and worker.inflight == 0 \
+                    and self._worker_accepts(worker, state.backlog[0]):
                 self._dispatch_to_worker(state, worker)
         # Phase 2 — grow the fleet while there is queued work (the raylet
         # answers with local grants or spillback to other nodes).  Several
@@ -1520,8 +1521,12 @@ class CoreWorker:
             while len(state.backlog) > reserve and room > 0:
                 batch: List[TaskSpec] = []
                 while (len(state.backlog) > reserve and room > 0
-                       and len(batch) < chunk_size):
-                    batch.append(state.backlog.popleft())
+                       and len(batch) < chunk_size
+                       and self._worker_accepts(worker,
+                                                state.backlog[0])):
+                    spec = state.backlog.popleft()
+                    self._charge_dispatch(worker, spec)
+                    batch.append(spec)
                     room -= 1
                 if not batch:
                     break
@@ -1571,9 +1576,27 @@ class CoreWorker:
         except (rpc.ConnectionLost, rpc.RpcError, OSError):
             pass  # best-effort; the request chain handles its own errors
 
+    def _worker_accepts(self, worker: "_LeasedWorker",
+                        spec: TaskSpec) -> bool:
+        """max_calls dispatch cap: never pipeline more executions of a
+        function onto one worker than it will perform before recycling
+        (the TPU default of max_calls=1 means exactly one task per
+        worker, even under bursts)."""
+        mc = getattr(spec, "max_calls", 0)
+        if not mc or spec.actor_id is not None:
+            return True
+        return worker.fn_calls.get(spec.function_id, 0) < mc
+
+    def _charge_dispatch(self, worker: "_LeasedWorker",
+                         spec: TaskSpec) -> None:
+        if getattr(spec, "max_calls", 0) and spec.actor_id is None:
+            worker.fn_calls[spec.function_id] = \
+                worker.fn_calls.get(spec.function_id, 0) + 1
+
     def _dispatch_to_worker(self, state: "_LeaseState",
                             worker: "_LeasedWorker") -> None:
         spec = state.backlog.popleft()
+        self._charge_dispatch(worker, spec)
         worker.inflight += 1
         task = self._loop.create_task(self._push_task(state, worker, spec))
         task.add_done_callback(lambda t: t.exception())
@@ -1709,6 +1732,12 @@ class CoreWorker:
         worker.inflight -= 1
         if reply.get("worker_exit"):
             self._drop_exiting_worker(state, worker)
+        if reply.get("rejected"):
+            # the worker refused the push (exiting): the task never ran,
+            # so this is a re-dispatch, not a retry
+            self._loop.call_soon_threadsafe(self._enqueue_for_lease, spec)
+            self._pump_lease_queue(state)
+            return
         self._handle_task_reply(spec, reply)
         self._pump_lease_queue(state)
 
@@ -1772,6 +1801,17 @@ class CoreWorker:
                 worker.inflight -= 1
                 self._retry_or_fail(spec, WorkerCrashedError(
                     f"worker died while running {spec.debug_name()}: {e}"))
+            self._pump_lease_queue(state)
+            return
+        if isinstance(reply, dict) and reply.get("rejected"):
+            # the worker refused the whole batch (exiting): nothing ran
+            self._drop_exiting_worker(state, worker)
+            for spec, key in zip(specs, keys):
+                if self._streamed.pop(key, None) is None:
+                    continue
+                worker.inflight -= 1
+                self._loop.call_soon_threadsafe(self._enqueue_for_lease,
+                                                spec)
             self._pump_lease_queue(state)
             return
         # results stream on the same FIFO connection BEFORE the final
@@ -2754,7 +2794,7 @@ class CoreWorker:
                 ready = _BurstQueue(self._loop, out_batch.append, _ship)
                 for i, s in enumerate(specs):
                     r = self._exec_one(s)
-                    self._track_max_calls(s)
+                    self._track_max_calls(s, r)
                     if i == len(specs) - 1 and self._exit_after_reply:
                         # flag BEFORE the push: the streamed copy is the
                         # only one the owner reads, and the drain races
@@ -2770,7 +2810,7 @@ class CoreWorker:
                 continue
             spec, reply_fut = item
             reply = self._exec_one(spec)
-            self._track_max_calls(spec)
+            self._track_max_calls(spec, reply)
             if self._exit_after_reply:
                 reply["worker_exit"] = True
             while True:
@@ -2786,9 +2826,11 @@ class CoreWorker:
             if self._exit_after_reply and q.empty():
                 self._schedule_worker_exit()
 
-    def _track_max_calls(self, spec: TaskSpec) -> None:
+    def _track_max_calls(self, spec: TaskSpec, reply) -> None:
         if not getattr(spec, "max_calls", 0) or spec.actor_id is not None:
             return
+        if reply.get("cancelled"):
+            return  # cancelled while queued: the body never executed
         n = self._fn_exec_counts.get(spec.function_id, 0) + 1
         self._fn_exec_counts[spec.function_id] = n
         if n >= spec.max_calls:
@@ -2919,6 +2961,10 @@ class CoreWorker:
         self._stream_emitters[tid_bin] = emit
 
     async def handle_push_task(self, conn, data):
+        if self._exit_after_reply:
+            # the exit decision is made: never accept new work (a task
+            # accepted here could be killed mid-run by the exit timer)
+            return {"rejected": "worker exiting", "worker_exit": True}
         spec: TaskSpec = pickle.loads(data["spec_blob"])
         self._install_stream_emitter(spec, conn)
         reply_fut = self._loop.create_future()
@@ -2931,6 +2977,8 @@ class CoreWorker:
         Each task's result is PUSHED back as it completes (see
         _consume_exec_queue); the final reply carries the full list as
         the authoritative completion for bookkeeping."""
+        if self._exit_after_reply:
+            return {"rejected": "worker exiting", "worker_exit": True}
         specs: List[TaskSpec] = pickle.loads(data["specs_blob"])
         for spec in specs:
             self._install_stream_emitter(spec, conn)
@@ -3401,7 +3449,7 @@ class _PendingMarker:
 
 class _LeasedWorker:
     __slots__ = ("worker_id", "address", "raylet", "inflight",
-                 "return_handle", "contended")
+                 "return_handle", "contended", "fn_calls")
 
     def __init__(self, worker_id: WorkerID, address: rpc.Address,
                  raylet: rpc.Address, contended: bool = False):
@@ -3413,6 +3461,9 @@ class _LeasedWorker:
         # granted while other demand queued at the raylet: hand the
         # worker back the moment it idles (skip the idle-lease grace)
         self.contended = contended
+        # dispatched executions per function_id, mirroring the worker's
+        # max_calls accounting so pipelining never overshoots the cap
+        self.fn_calls: Dict[str, int] = {}
 
 
 class _LeaseState:

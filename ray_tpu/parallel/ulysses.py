@@ -37,18 +37,19 @@ def _local_attn(q, k, v, causal: bool, scale: float, interpret: bool):
     CPU/awkward-shape fallback.  ``interpret=True`` ALWAYS runs the
     kernels (through the pallas interpreter) — a test asking for the
     kernel path must never silently compare the reference to itself."""
-    from ray_tpu.ops.flash_attention import (fit_block, flash_attention,
+    from ray_tpu.ops.flash_attention import (_chunk_blocks,
+                                             flash_attention,
                                              kernel_block_for)
 
+    block_q, block_k = _chunk_blocks(q.shape[1], k.shape[1])
     if interpret:
-        fit = fit_block(q.shape[1], 1024)
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               block_q=fit, block_k=fit, interpret=True)
-    if jax.default_backend() in ("tpu", "axon"):
-        blk = kernel_block_for(q.shape[1])
-        if blk is not None:
-            return flash_attention(q, k, v, causal=causal, scale=scale,
-                                   block_q=blk, block_k=blk)
+                               block_q=block_q, block_k=block_k,
+                               interpret=True)
+    if jax.default_backend() in ("tpu", "axon") \
+            and kernel_block_for(q.shape[1]) is not None:
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)
     return _default_attn(q, k, v, causal, scale)
 
 

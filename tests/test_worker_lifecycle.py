@@ -34,6 +34,17 @@ def test_max_calls_recycles_workers():
     tpu_pids = [ray_tpu.get(tpu_pid.remote()) for _ in range(3)]
     assert len(set(tpu_pids)) == 3, tpu_pids  # fresh worker per call
 
+    # a BURST of max_calls=1 tasks must also get one worker each (the
+    # owner-side dispatch cap, not just sequential recycling)
+    @ray_tpu.remote(max_calls=1)
+    def burst_pid(_):
+        import os
+        return os.getpid()
+
+    burst = ray_tpu.get([burst_pid.remote(i) for i in range(6)],
+                        timeout=120)
+    assert len(set(burst)) == 6, burst
+
 
 @pytest.mark.usefixtures("shutdown_only")
 def test_max_calls_drains_pipelined_tasks():
@@ -50,7 +61,12 @@ def test_max_calls_drains_pipelined_tasks():
     refs = [square.remote(i) for i in range(24)]
     out = ray_tpu.get(refs, timeout=120)
     assert [v for v, _ in out] == [i * i for i in range(24)]
-    assert len({p for _, p in out}) >= 3  # recycling actually happened
+    from collections import Counter
+    per_pid = Counter(p for _, p in out)
+    # the owner-side dispatch cap guarantees NO worker exceeded its
+    # max_calls budget even under burst pipelining
+    assert max(per_pid.values()) <= 3, per_pid
+    assert len(per_pid) >= 8  # 24 tasks / max_calls=3
 
 
 @pytest.mark.usefixtures("shutdown_only")
