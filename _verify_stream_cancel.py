@@ -1,0 +1,56 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import time
+import ray_tpu
+
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote(num_returns="streaming")
+def ticker():
+    import time as t
+    i = 0
+    while True:
+        yield i
+        i += 1
+        t.sleep(0.05)
+
+gen = ticker.remote()
+assert ray_tpu.get(next(gen), timeout=30) == 0
+t0 = time.monotonic()
+ray_tpu.cancel(gen)  # the handle itself — used to TypeError
+stopped = False
+try:
+    while time.monotonic() - t0 < 20:
+        ray_tpu.get(next(gen), timeout=5)
+except Exception as e:
+    stopped = True
+    print(f"stream stopped in {time.monotonic()-t0:.1f}s via {type(e).__name__}")
+assert stopped, "producer kept running"
+
+# abandoned-stream reap end-to-end
+@ray_tpu.remote(num_returns="streaming")
+def burst():
+    for i in range(40):
+        yield bytes(2000)
+
+g2 = burst.remote()
+ray_tpu.get(next(g2), timeout=30)
+tid = g2.task_id.binary()
+from ray_tpu.core import worker as wm
+core = wm.global_worker()
+deadline = time.time() + 20
+while time.time() < deadline:
+    st = core._streaming_states.get(tid)
+    if st is not None and st.done:
+        break
+    time.sleep(0.1)
+del g2
+import gc; gc.collect(); time.sleep(1.0)
+left = [o for o in core.reference_counter._refs if o.task_id().binary() == tid]
+assert len(left) <= 2, f"leaked {len(left)}"
+print(f"abandoned stream reaped ({len(left)} refs remain)")
+ray_tpu.shutdown()
+print("VERIFY STREAM-CANCEL OK")

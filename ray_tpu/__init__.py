@@ -290,12 +290,21 @@ def cancel(ref: ObjectRef, *, force: bool = False,
     supported for actor tasks); ``recursive=True`` also cancels the
     task's children.  ``get`` on the ref then raises
     :class:`TaskCancelledError` — unless the task finished first."""
+    from ray_tpu.core.object_ref import StreamingObjectRefGenerator
+    streaming = isinstance(ref, StreamingObjectRefGenerator)
     client = _client_or_none()
     if client is not None:
+        if streaming:
+            raise TypeError(
+                "streaming generators are driver-local handles; "
+                "cancel them from the process that created them")
         client.cancel(ref, force=force, recursive=recursive)
         return
+    # the streaming handle is the ONLY thing a streaming caller holds
+    # (parity: the reference cancels the generator object directly)
+    task_id = ref.task_id if streaming else ref.task_id()
     _worker_mod.global_worker().cancel_task(
-        ref.task_id(), force=force, recursive=recursive)
+        task_id, force=force, recursive=recursive)
 
 
 def free(refs: Sequence[ObjectRef]) -> None:

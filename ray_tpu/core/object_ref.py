@@ -181,6 +181,7 @@ class StreamingObjectRefGenerator:
                         and state.dyn_ids[self._consumed] is not None:
                     i = self._consumed
                     self._consumed += 1
+                    state.consumed = max(state.consumed, self._consumed)
                     return ObjectRef(ObjectID(state.dyn_ids[i]),
                                      self._core.address)
                 if state.done:
@@ -203,7 +204,9 @@ class StreamingObjectRefGenerator:
             if state is None:
                 return
             if state.done:
-                core._streaming_states.pop(tid_bin, None)
+                # finished-but-undrained: free the unconsumed remainder
+                # too (they hold zero refs and would leak)
+                core._reap_stream_remainder(tid_bin)
             else:
                 core._stream_abandoned.add(tid_bin)
         except Exception:

@@ -1932,6 +1932,13 @@ class Raylet:
     # spilling (LocalObjectManager)
     # ------------------------------------------------------------------
     def _maybe_spill(self, incoming: int) -> None:
+        # KNOWN LIMITATION (round-5 review): spill I/O runs on this
+        # event loop.  Bounded for the local-disk tier, but a SLOW
+        # object_spilling_uri backend (NFS, remote stores) can stall
+        # heartbeats/leases for the write's duration — operators should
+        # size the URI tier's latency accordingly.  Moving the write to
+        # a thread needs seal/evict bookkeeping to become two-phase;
+        # deferred rather than rushed (see docs/ROUND5.md).
         stats = self.store.stats()
         threshold = self.config.object_spilling_threshold * stats["capacity"]
         if stats["used"] + incoming <= threshold:

@@ -341,6 +341,7 @@ class CoreWorker:
         # task ids whose StreamingObjectRefGenerator was GC'd while the
         # task still ran: _finish_stream reaps their state at the end
         self._stream_abandoned: set = set()
+        self._children_prune_pos = 0
         # same for batched actor pushes: (task_id, attempt) -> (spec, state)
         self._actor_streamed: Dict[tuple, tuple] = {}
 
@@ -1337,11 +1338,17 @@ class CoreWorker:
             return
         self._children.setdefault(parent.binary(), []).append(task_id)
         if len(self._children) > 256:
-            # prune parents whose children have all settled
-            for key in list(self._children):
+            # amortized prune: a full rescan of every parent's child
+            # list on EVERY submission is quadratic in tree width (and
+            # prunes nothing while a fan-out is live); instead sweep a
+            # bounded slice per call, rotating through the table
+            keys = list(self._children)
+            start = self._children_prune_pos % len(keys)
+            for key in keys[start:start + 32]:
                 kids = self._children.get(key, [])
                 if not any(self.task_manager.is_pending(k) for k in kids):
                     self._children.pop(key, None)
+            self._children_prune_pos = start + 32
 
     def _build_args(self, args: tuple, kwargs: dict
                     ) -> Tuple[List[TaskArg], List[ObjectRef]]:
@@ -1975,7 +1982,33 @@ class CoreWorker:
             # the consumer dropped its generator while the task still
             # ran; nobody will drain (or reap) the state — do it here
             self._stream_abandoned.discard(tid_bin)
-            self._streaming_states.pop(tid_bin, None)
+            self._reap_stream_remainder(tid_bin)
+
+    def _reap_stream_remainder(self, tid_bin: bytes) -> None:
+        """Free published-but-never-consumed streamed items: the
+        consumer abandoned the generator (or dropped it after the task
+        finished), so those values hold zero ObjectRefs and ordinary
+        refcounting can never reclaim them — without this they pin the
+        owner's memory store for the life of the process."""
+        state = self._streaming_states.pop(tid_bin, None)
+        if state is None:
+            return
+        with state.cond:
+            leftovers = [b for b in state.dyn_ids[state.consumed:]
+                         if b is not None]
+        if not leftovers:
+            return
+
+        def _free():
+            for b in leftovers:
+                oid = ObjectID(b)
+                info = self.reference_counter.get(oid)
+                if info is not None and info.owned:
+                    # ride the normal zero-transition: fires the free
+                    # callback AND drops the reference-table entry
+                    self.reference_counter.add_local_ref(oid)
+                    self.reference_counter.remove_local_ref(oid)
+        self._call_on_loop(_free)
 
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self._task_locations.pop(spec.task_id.binary(), None)
@@ -3158,6 +3191,12 @@ class CoreWorker:
                 value = fn(*args, **kwargs)
             if asyncio.iscoroutine(value):
                 value = asyncio.run(value)
+            if spec.dynamic_returns:
+                # the generator BODY runs inside _post_dynamic_returns
+                # (calling fn only created the generator object), so the
+                # cancel-interrupt window must stay open through the
+                # iteration — it closes in there before results commit
+                return self._post_dynamic_returns(spec, value)
             # body done: results are being committed from here on — a
             # cancel interrupt landing now must not drop them
             INTERRUPT_WINDOW.open = False
@@ -3165,8 +3204,6 @@ class CoreWorker:
                 results = [(rid.binary(), "inline", serialize(None).to_bytes())
                            for rid in spec.return_ids()]
                 return {"results": results}
-            if spec.dynamic_returns:
-                return self._post_dynamic_returns(spec, value)
             if spec.num_returns == 1:
                 values = [value]
             else:
@@ -3216,6 +3253,11 @@ class CoreWorker:
         results = []
         refs = []
         for i, item in enumerate(value):
+            # still USER code (the generator body resumes per item):
+            # leave the cancel-interrupt window open while iterating,
+            # close it around each commit so an interrupt cannot drop a
+            # produced entry
+            INTERRUPT_WINDOW.open = False
             rid = spec.dynamic_return_id(i)
             entry = self._post_return(rid, item, spec)
             results.append(entry)
@@ -3225,6 +3267,8 @@ class CoreWorker:
                 emit(i, rid.binary(), entry)
             refs.append(ObjectRef(rid, spec.owner_address,
                                   _register=False))
+            INTERRUPT_WINDOW.open = True
+        INTERRUPT_WINDOW.open = False  # commit phase
         gen_id = spec.return_ids()[0]
         gen = ObjectRefGenerator(refs)
         # the generator handle is listed LAST: the owner registers the
@@ -3434,13 +3478,16 @@ class _BurstQueue:
 class _StreamState:
     """Owner-side progress of one streaming-returns task."""
 
-    __slots__ = ("cond", "dyn_ids", "done", "error")
+    __slots__ = ("cond", "dyn_ids", "done", "error", "consumed")
 
     def __init__(self):
         self.cond = threading.Condition()
         self.dyn_ids: List[bytes] = []
         self.done = False
         self.error: Optional[BaseException] = None
+        #: items the consumer turned into ObjectRefs (those are governed
+        #: by normal refcounting; anything past this index has NO refs)
+        self.consumed = 0
 
 
 class _PendingMarker:

@@ -106,13 +106,29 @@ class ClientService:
         self._actor_classes.pop(conn, None)
         self._upload.pop(conn, None)
         self._download.pop(conn, None)
-        for pg in (self._pgs.pop(conn, None) or {}).values():
-            try:
+        pgs = list((self._pgs.pop(conn, None) or {}).values())
+        if pgs:
+            # reap off-loop: each removal is a GCS round trip, and this
+            # runs on the shared server loop — blocking it would stall
+            # every other connected client (the pg_* handlers use
+            # to_thread for the same reason)
+            import asyncio as _asyncio
+
+            def _reap():
                 from ray_tpu.util.placement_group import \
                     remove_placement_group
-                remove_placement_group(pg)
-            except Exception:  # noqa: BLE001 — best-effort reap
-                logger.debug("client PG cleanup failed", exc_info=True)
+                for pg in pgs:
+                    try:
+                        remove_placement_group(pg)
+                    except Exception:  # noqa: BLE001 — best-effort reap
+                        logger.debug("client PG cleanup failed",
+                                     exc_info=True)
+            try:
+                loop = _asyncio.get_running_loop()
+                task = loop.create_task(_asyncio.to_thread(_reap))
+                task.add_done_callback(lambda t: t.exception())
+            except RuntimeError:
+                _reap()  # not on a loop (tests/teardown): inline
         if self.single_client and dropped is not None:
             self.closed.set()
 

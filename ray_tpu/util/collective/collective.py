@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 import ray_tpu
-from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.core.exceptions import GetTimeoutError, RayTpuError
 
 
 class CollectiveError(RayTpuError):
@@ -351,6 +351,17 @@ def _exchange(g: _GroupHandle, kind: str, payload_ref,
             box = ray_tpu.get(
                 g.rendezvous.collect.remote(key, g.world_size, g.rank),
                 timeout=30)
+        except GetTimeoutError:
+            # slow-but-alive rendezvous (stalled GCS health probe, host
+            # overload): NOT a death signal — keep polling until the op
+            # deadline; aborting here would desynchronize ranks that
+            # already posted from ones that hadn't
+            if time.monotonic() > deadline:
+                raise CollectiveError(
+                    f"{kind} on group {g.group_name!r} timed out after "
+                    f"{timeout_s or DEFAULT_COLLECTIVE_TIMEOUT_S:.0f}s "
+                    f"(rendezvous unresponsive)")
+            continue
         except RayTpuError as e:
             # the rendezvous actor itself died (e.g. its node was lost)
             raise CollectiveError(

@@ -58,8 +58,13 @@ class PlacementGroup:
                         "placement_group_ready",
                         {"pg_id": self.id.binary(), "block_s": 25.0},
                         timeout=40.0)
-                except (asyncio.TimeoutError, rpc_mod.RpcError):
+                except asyncio.TimeoutError:
                     continue  # saturated GCS: re-arm the long poll
+                except rpc_mod.RpcError:
+                    # a FAST server-side error would hot-spin this loop
+                    # (and flood the GCS) without a pause
+                    await asyncio.sleep(0.5)
+                    continue
                 except rpc_mod.ConnectionLost:
                     await asyncio.sleep(0.5)  # head restarting
                     continue

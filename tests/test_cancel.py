@@ -209,3 +209,31 @@ def test_cancel_over_ray_client(ray_client):
     # free over the client: releases without error
     keep = ray_tpu.put(b"x" * 128)
     ray_tpu.free([keep])
+
+
+def test_cancel_streaming_generator(cluster):
+    """Cancelling via the streaming handle (the only handle a streaming
+    caller holds) interrupts the RUNNING generator body — the interrupt
+    window stays open between yields (review finding: it used to close
+    after fn() returned the generator object, making every streaming
+    task uncancellable)."""
+    @ray_tpu.remote(num_returns="streaming")
+    def endless():
+        import time as t
+        i = 0
+        while True:
+            yield i
+            i += 1
+            t.sleep(0.05)
+
+    gen = endless.remote()
+    first = ray_tpu.get(next(gen), timeout=30)
+    assert first == 0
+    ray_tpu.cancel(gen)
+    # the producer stops: iteration ends (StopIteration) or surfaces
+    # the cancellation within the deadline instead of running forever
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        while time.monotonic() - t0 < 25:
+            ray_tpu.get(next(gen), timeout=5)
+    assert time.monotonic() - t0 < 25, "cancel did not stop the stream"
