@@ -6,7 +6,7 @@
 #   make tsan   — ThreadSanitizer build of the concurrency stress
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
-.PHONY: all native test tsan asan sanitize clean
+.PHONY: all native test chaos tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -19,6 +19,14 @@ native:
 
 test: native
 	python -m pytest tests/ -q
+
+# Deterministic chaos: failpoint-injection suite + node-kill suite with
+# fixed seeds (failpoint sites seed per-site; NodeKiller seeds in-test;
+# PYTHONHASHSEED pins dict/hash order) so a failing run replays exactly.
+chaos: native
+	PYTHONHASHSEED=0 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_failpoints.py tests/test_chaos.py -q \
+	  -p no:cacheprovider -p no:randomly
 
 build/store_stress_tsan: $(SAN_SRCS)
 	@mkdir -p build

@@ -295,9 +295,12 @@ def cancel(ref: ObjectRef, *, force: bool = False,
     client = _client_or_none()
     if client is not None:
         if streaming:
-            raise TypeError(
-                "streaming generators are driver-local handles; "
-                "cancel them from the process that created them")
+            # the generator's task id is the handle: route it through
+            # the client cancel protocol (parity: the reference cancels
+            # streaming generators through the client too)
+            client.cancel_task_id(ref.task_id.binary(), force=force,
+                                  recursive=recursive)
+            return
         client.cancel(ref, force=force, recursive=recursive)
         return
     # the streaming handle is the ONLY thing a streaming caller holds

@@ -92,8 +92,21 @@ class Config:
 
     # ---- RPC / transport -------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
+    #: Base delay for the first RPC retry (grows exponentially).
     rpc_retry_delay_s: float = 0.1
+    #: Attempts per retried call chain, counting the first try.
     rpc_max_retries: int = 5
+    #: Cap on the exponential backoff between attempts.
+    rpc_backoff_max_s: float = 5.0
+    #: Backoff growth factor per retry.
+    rpc_backoff_multiplier: float = 2.0
+    #: ± fraction of jitter applied to every backoff delay (decorrelates
+    #: retry storms after a node/GCS blip).
+    rpc_backoff_jitter: float = 0.2
+    #: Total wall-clock budget for one retried call chain, across all
+    #: attempts and backoffs (0 disables the budget).  Per-attempt
+    #: timeouts shrink to the remaining budget.
+    rpc_call_deadline_s: float = 30.0
     #: Long-poll pubsub batch window.
     pubsub_batch_window_s: float = 0.01
 

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core import rpc
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
 
@@ -373,6 +374,9 @@ class GcsServer:
     # node membership + health (GcsNodeManager / GcsHealthCheckManager)
     # ------------------------------------------------------------------
     async def handle_register_node(self, conn, data):
+        # failpoint: registration rejected/stalled — the raylet's boot
+        # (or its reconnect loop) must retry, keyed on its stable node_id
+        await _fp.afailpoint("gcs.register_node.fail")
         peer_proto = data.get("protocol_version", rpc.PROTOCOL_VERSION)
         if peer_proto != rpc.PROTOCOL_VERSION:
             raise rpc.RpcError(
@@ -397,6 +401,9 @@ class GcsServer:
         return {"config": self.config.to_json()}
 
     async def handle_health_report(self, conn, data):
+        # failpoint: a stalled/failed heartbeat ack — raylets must ride
+        # it out (miss counter + reconnect), never wedge or false-exit
+        await _fp.afailpoint("gcs.heartbeat.delay")
         node_id = NodeID(data["node_id"])
         info = self.nodes.get(node_id)
         if info is None or not info.alive:
@@ -497,8 +504,13 @@ class GcsServer:
         self._emit_event("ERROR", "NODE_DEAD",
                          f"node {node_id.hex()[:12]} dead: {reason}",
                          node_id=node_id.hex())
-        self.publish("nodes", {"event": "dead", "node_id": node_id.binary(),
-                               "address": info.raylet_address})
+        # failpoint: the death broadcast is lost — consumers must
+        # converge via the versioned resource-view sync (gap → resync)
+        # instead of trusting one pubsub delivery
+        if not _fp.failpoint("gcs.node_death.publish_drop"):
+            self.publish("nodes",
+                         {"event": "dead", "node_id": node_id.binary(),
+                          "address": info.raylet_address})
         # fail actors on the node (restart if budget remains)
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ACTOR_ALIVE,
@@ -662,6 +674,10 @@ class GcsServer:
         ``data``: actor_id, creation spec blob (pickled TaskSpec),
         resources, name/namespace/detached, max_restarts, class_name.
         """
+        # failpoint: GCS stalls/crashes mid-registration — the owner's
+        # register future must resolve with a typed error or the retry
+        # must converge on ONE directory entry (keyed on actor_id)
+        await _fp.afailpoint("gcs.register_actor.stall")
         actor_id = ActorID(data["actor_id"])
         name = data.get("name")
         namespace = data.get("namespace", "default")

@@ -40,6 +40,7 @@ from ray_tpu.core.config import Config
 from ray_tpu.core.exceptions import ObjectStoreFullError
 from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
 
@@ -65,6 +66,9 @@ class WorkerHandle:
     #: whether the leased work survives a kill (owner retries it)
     lease_retriable: bool = True
     lease_granted_at: float = 0.0
+    #: token of the acquiring lease request — keys return_worker so a
+    #: retried (duplicate) return can never settle a newer lease
+    lease_token: Optional[str] = None
     #: chip indices assigned to this lease (parity: raylet GPU-id
     #: assignment backing ray.get_gpu_ids)
     lease_tpu_ids: List[int] = field(default_factory=list)
@@ -300,6 +304,9 @@ class Raylet:
         })
         # adopt the cluster-wide config decided by the head node
         self.config = Config.from_json(reply["config"])
+        # adopt cluster-armed failpoints (see util/failpoint.py; no-op
+        # unless a chaos test armed sites in the GCS KV)
+        await _fp.sync_from_kv(self.gcs_conn)
         loop = asyncio.get_running_loop()
         from ray_tpu.util import event as event_mod
         self._event_mod = event_mod
@@ -1055,6 +1062,10 @@ class Raylet:
     async def handle_request_worker_lease(self, conn, data):
         """Returns {granted, worker_address, lease_id} | {spillback: addr} —
         or blocks (queues) until a local grant is possible."""
+        # failpoint: a slow/failed lease grant — owners must keep their
+        # backlog intact (freeze or redispatch), never burn retry budget
+        # on a raylet that is merely late
+        await _fp.afailpoint("raylet.lease_grant.delay")
         resources = dict(data.get("resources", {}))
         bundle = None
         pg_bin = data.get("placement_group_id")
@@ -1282,6 +1293,7 @@ class Raylet:
             worker.lease_bundle = lease.bundle
             worker.lease_retriable = lease.retriable
             worker.lease_granted_at = time.monotonic()
+            worker.lease_token = lease.token
             worker.owner_conn = lease.conn
             if lease.env_hash is not None:
                 worker.env_hash = lease.env_hash
@@ -1468,8 +1480,22 @@ class Raylet:
         return None
 
     async def handle_return_worker(self, conn, data):
+        # failpoint: the lease return is lost/failed — the owner RETRIES
+        # it (it's classified idempotent), so duplicates must be inert
+        await _fp.afailpoint("raylet.lease_return.fail")
         worker = self.workers.get(WorkerID(data["worker_id"]))
         if worker is None:
+            return False
+        if not worker.leased:
+            # duplicate of an already-settled return (the first attempt
+            # executed but its reply was lost): appending to the idle
+            # pool again would grant one worker to two leases
+            return False
+        token = data.get("token")
+        if token is not None and worker.lease_token is not None \
+                and token != worker.lease_token:
+            # stale duplicate from a PREVIOUS lease of this worker —
+            # releasing it would free the current owner's live lease
             return False
         if data.get("job_id") is not None and worker.job_id_bin is None:
             worker.job_id_bin = data["job_id"]
@@ -1502,6 +1528,7 @@ class Raylet:
         if worker.leased:
             self._give(worker.lease_resources, worker.lease_bundle)
             worker.leased = False
+            worker.lease_token = None
             worker.owner_conn = None
             worker.lease_resources = {}
             worker.lease_bundle = None
@@ -1956,6 +1983,9 @@ class Raylet:
                 continue
             offset, size = lease
             try:
+                # failpoint: the spill tier write fails — the in-store
+                # primary must survive (pin kept) so readers see no loss
+                _fp.failpoint("raylet.spill.fail")
                 if spill_uri:
                     # external tier: the blob outlives this node, and
                     # the owner learns the URI so ANY node can restore

@@ -30,10 +30,13 @@ import asyncio
 import itertools
 import logging
 import pickle
+import random
 import struct
+from dataclasses import dataclass
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from ray_tpu.core.messages import validate as _validate_schema
+from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
 
@@ -61,6 +64,178 @@ class RpcError(Exception):
 
 class ConnectionLost(Exception):
     pass
+
+
+class RpcDeadlineExceeded(RpcError):
+    """A retried call chain ran out of its total deadline budget."""
+
+
+#: Methods safe to retry blindly after they MAY have executed once.
+#: Reads are trivially safe; the mutations listed are keyed on a
+#: caller-supplied id (node/worker/actor/token) or naturally converge
+#: (kv_put overwrites, kv_del/object_release/unsubscribe are no-ops the
+#: second time, return_worker/cancel_lease hit an already-settled entry,
+#: health_report is per-beat state).  Everything else — push_task(s),
+#: push_actor_task(s), request_worker_lease, lease_worker_for_actor,
+#: register_job, register_actor, object_create/seal — either executes
+#: user code, allocates a resource, or assigns an id, and must only be
+#: retried by its caller's own dedup/redispatch logic.
+IDEMPOTENT_METHODS = frozenset({
+    # pure reads
+    "ping", "get_nodes", "kv_get", "kv_keys", "get_actor", "list_actors",
+    "get_cluster_load", "get_function", "store_info", "store_stats",
+    "debug_state", "get_metrics", "list_jobs", "get_task_events",
+    "get_cluster_stats", "list_events", "object_contains", "list_workers",
+    "list_objects", "stack_traces", "list_placement_groups",
+    # keyed / convergent mutations
+    "register_node", "register_worker", "subscribe", "unsubscribe",
+    "kv_put", "kv_del", "health_report", "actor_started",
+    "object_release", "return_worker", "cancel_lease", "cancel_task",
+    "report_metrics", "report_task_events", "drain_node", "reattach_job",
+})
+
+
+def is_idempotent(method: str) -> bool:
+    return method in IDEMPOTENT_METHODS
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with jitter and a total deadline budget
+    (parity: the reference GcsRpcClient's retry/backoff and gRPC
+    service-config retryPolicy).  ``max_attempts`` counts the first try;
+    ``deadline_s`` caps the WHOLE chain — per-attempt timeouts shrink to
+    whatever budget remains, so a retried call can never outlive its
+    deadline no matter how many attempts fit."""
+
+    max_attempts: int = 5
+    base_delay_s: float = 0.1
+    max_delay_s: float = 5.0
+    multiplier: float = 2.0
+    jitter: float = 0.2
+    deadline_s: Optional[float] = 30.0
+
+    @classmethod
+    def from_config(cls, config=None) -> "RetryPolicy":
+        if config is None:
+            from ray_tpu.core.config import get_config
+            config = get_config()
+        deadline = getattr(config, "rpc_call_deadline_s", 30.0)
+        return cls(
+            max_attempts=max(1, int(getattr(config, "rpc_max_retries", 5))),
+            base_delay_s=getattr(config, "rpc_retry_delay_s", 0.1),
+            max_delay_s=getattr(config, "rpc_backoff_max_s", 5.0),
+            multiplier=getattr(config, "rpc_backoff_multiplier", 2.0),
+            jitter=getattr(config, "rpc_backoff_jitter", 0.2),
+            deadline_s=deadline if deadline and deadline > 0 else None,
+        )
+
+    def backoff_delay(self, retry_index: int, rng: random.Random) -> float:
+        raw = min(self.max_delay_s,
+                  self.base_delay_s * self.multiplier ** retry_index)
+        if self.jitter:
+            raw *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(0.0, raw)
+
+
+#: process-local jitter stream; seeded so a test re-run reproduces the
+#: same backoff schedule (determinism > cross-process decorrelation — a
+#: cluster's processes still decorrelate via their differing call mixes)
+_retry_rng = random.Random(0x52504331)
+
+
+async def call_with_retry(get_conn, method: str, data: Any = None, *,
+                          policy: Optional[RetryPolicy] = None,
+                          timeout: Optional[float] = None,
+                          idempotent: Optional[bool] = None,
+                          invalidate: Optional[
+                              Callable[[Optional["Connection"]],
+                                       None]] = None
+                          ) -> Any:
+    """One retried call chain with backoff + deadline budget.
+
+    ``get_conn``: async callable returning a live :class:`Connection`
+    (called fresh each attempt so the caller can reconnect between
+    attempts); ``invalidate`` is called with the FAILED attempt's
+    connection (or None if none was obtained) before a retry, so the
+    caller can drop exactly that connection from its pool — never a
+    fresh one another coroutine raced in.
+
+    Classification: failures while OBTAINING the connection (OSError,
+    ConnectionLost, TimeoutError, an armed connect failpoint) are always
+    retryable — no request bytes went out.  Failures after the request
+    may have been sent (ConnectionLost, per-attempt timeout) are retried
+    only when the method is idempotent (callee keyed/convergent — see
+    ``IDEMPOTENT_METHODS``) or the caller forces ``idempotent=True``
+    because it dedupes.  A structured remote error (``RpcError``) is
+    never retried: the peer is healthy and deterministic."""
+    if policy is None:
+        policy = RetryPolicy.from_config()
+    if idempotent is None:
+        idempotent = is_idempotent(method)
+    loop = asyncio.get_running_loop()
+    deadline = (loop.time() + policy.deadline_s
+                if policy.deadline_s is not None else None)
+
+    def _remaining() -> Optional[float]:
+        if deadline is None:
+            return None
+        return deadline - loop.time()
+
+    def _attempt_timeout() -> Optional[float]:
+        rem = _remaining()
+        if rem is None:
+            return timeout
+        if timeout is None:
+            return max(rem, 0.001)
+        return max(min(timeout, rem), 0.001)
+
+    last_exc: Optional[BaseException] = None
+    failed_conn: Optional[Connection] = None
+    for attempt in range(policy.max_attempts):
+        if attempt:
+            if invalidate is not None:
+                invalidate(failed_conn)
+            failed_conn = None
+            delay = policy.backoff_delay(attempt - 1, _retry_rng)
+            rem = _remaining()
+            if rem is not None and rem <= delay:
+                break  # budget can't fund another attempt
+            await asyncio.sleep(delay)
+        raw = get_conn()
+        try:
+            conn = await asyncio.wait_for(_ensure_coro(raw),
+                                          _attempt_timeout())
+        except (ConnectionLost, OSError, asyncio.TimeoutError,
+                _fp.FailpointError) as e:
+            if hasattr(raw, "close") and not isinstance(raw, Connection):
+                raw.close()  # un-awaited coroutine (cancelled pre-start)
+            last_exc = e  # nothing was sent: always retryable
+            continue
+        try:
+            return await conn.call(method, data,
+                                   timeout=_attempt_timeout())
+        except RpcDeadlineExceeded:
+            raise
+        except (ConnectionLost, asyncio.TimeoutError,
+                _fp.FailpointError) as e:
+            last_exc = e
+            failed_conn = conn
+            if not idempotent:
+                raise
+    raise RpcDeadlineExceeded(
+        f"{method} failed after {policy.max_attempts} attempt(s)"
+        + (f" within {policy.deadline_s:.1f}s" if policy.deadline_s else "")
+        + f": {type(last_exc).__name__}: {last_exc}")
+
+
+async def _ensure_coro(value):
+    # inspect (not asyncio) iscoroutine: the asyncio variant also
+    # matches plain generators before 3.11
+    import inspect
+    if inspect.iscoroutine(value) or isinstance(value, asyncio.Future):
+        return await value
+    return value
 
 
 class _FrameProtocol(asyncio.Protocol):
@@ -164,6 +339,9 @@ class Connection:
         self._loop = asyncio.get_running_loop()
         self._writable = asyncio.Event()
         self._writable.set()
+        #: request handlers currently running on this link (drain gate
+        #: for graceful process exit — see Connection.drain_outbound)
+        self._dispatching = 0
         # Application state slot (e.g. the worker/node this conn belongs to).
         self.context: Dict[str, Any] = {}
 
@@ -277,19 +455,36 @@ class Connection:
                 logger.exception("on_close callback failed")
 
     async def _dispatch(self, msg_id: int, method: str, data: Any) -> None:
+        self._dispatching += 1
         try:
-            if self._handler is None:
-                raise RpcError(f"no handler for {method}")
-            result = await self._handler.dispatch(self, method, data)
-            reply = (msg_id, KIND_REP, method, result)
-        except Exception as e:
-            logger.debug("handler %s raised", method, exc_info=True)
-            reply = (msg_id, KIND_ERR, method, f"{type(e).__name__}: {e}")
-        if not self._closed:
             try:
-                self._send_frame(*reply)
-            except Exception:
-                self._teardown()
+                if self._handler is None:
+                    raise RpcError(f"no handler for {method}")
+                # failpoint: delay/raise/kill BEFORE the handler runs —
+                # models a stalled executor / a handler crash (dormant:
+                # one module-global truth test)
+                if _fp.active():
+                    await _fp.afailpoint(f"rpc.{method}.handler_delay")
+                result = await self._handler.dispatch(self, method, data)
+                reply = (msg_id, KIND_REP, method, result)
+            except Exception as e:
+                logger.debug("handler %s raised", method, exc_info=True)
+                reply = (msg_id, KIND_ERR, method,
+                         f"{type(e).__name__}: {e}")
+            if _fp.active():
+                # failpoint: the handler ran but its reply is lost or
+                # late (drop/delay) — the partial failure node-kill
+                # chaos can never produce
+                if await _fp.afailpoint(f"rpc.{method}.reply_drop"):
+                    logger.warning("dropping %s reply (failpoint)", method)
+                    return
+            if not self._closed:
+                try:
+                    self._send_frame(*reply)
+                except Exception:
+                    self._teardown()
+        finally:
+            self._dispatching -= 1
 
     def start_call(self, method: str, data: Any = None) -> asyncio.Future:
         """Queue the request frame and return the reply future.
@@ -304,6 +499,13 @@ class Connection:
         msg_id = next(self._msg_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
+        if _fp.active():
+            # failpoint: the request frame is lost on the wire (drop) or
+            # the caller crashes at send (raise/kill); the pending
+            # future is left to the caller's timeout/deadline budget
+            if _fp.failpoint(f"rpc.{method}.request_drop"):
+                logger.warning("dropping %s request (failpoint)", method)
+                return fut
         self._send_frame(msg_id, KIND_REQ, method, data)
         return fut
 
@@ -326,6 +528,38 @@ class Connection:
     @property
     def closed(self) -> bool:
         return self._closed
+
+    @property
+    def pending_dispatches(self) -> int:
+        """Request handlers still running on this link (their replies
+        are not yet queued)."""
+        return self._dispatching
+
+    def outbound_pending(self) -> int:
+        """Bytes queued toward the peer: the per-tick coalescing buffer
+        plus whatever the transport hasn't handed to the kernel yet."""
+        n = sum(len(b) for b in self._wbuf)
+        try:
+            n += self._transport.get_write_buffer_size()
+        except Exception:  # noqa: BLE001 — transport already closed
+            pass
+        return n
+
+    async def drain_outbound(self, timeout: float = 2.0) -> bool:
+        """Wait until every in-flight handler has queued its reply and
+        the socket buffer is handed to the kernel (or the link closed).
+        Returns False on deadline — the caller decides whether to exit
+        anyway.  Used by graceful worker exit so a final reply is never
+        torn off mid-flush (a completed task must not be reported as a
+        worker crash)."""
+        deadline = self._loop.time() + timeout
+        while not self._closed and self._loop.time() < deadline:
+            self._flush_wbuf()
+            if self._dispatching == 0 and self.outbound_pending() == 0:
+                return True
+            await asyncio.sleep(0.005)
+        return self._closed or (self._dispatching == 0
+                                and self.outbound_pending() == 0)
 
     async def drain(self) -> None:
         self._flush_wbuf()
@@ -427,6 +661,10 @@ class Server:
 
 async def connect(address: Address, handler: Optional[Server] = None,
                   timeout: float = 10.0) -> Connection:
+    if _fp.active():
+        # failpoint: connection establishment fails/stalls — models a
+        # peer in a connect() backlog storm or a dropped SYN
+        await _fp.afailpoint("rpc.connect")
     loop = asyncio.get_running_loop()
     _, protocol = await asyncio.wait_for(
         loop.create_connection(
@@ -465,9 +703,34 @@ class ConnectionPool:
             self._conns[address] = conn
             return conn
 
+    async def call(self, address: Address, method: str, data: Any = None,
+                   *, timeout: Optional[float] = None,
+                   policy: Optional[RetryPolicy] = None,
+                   idempotent: Optional[bool] = None) -> Any:
+        """Retried call through the pool: reconnects between attempts
+        (dead cached connections are invalidated) under the policy's
+        backoff + deadline budget.  Retry-after-send only happens for
+        idempotent methods — see :func:`call_with_retry`."""
+        return await call_with_retry(
+            lambda: self.get(address), method, data, policy=policy,
+            timeout=timeout, idempotent=idempotent,
+            invalidate=lambda failed: self.invalidate_conn(address, failed))
+
     def invalidate(self, address: Address) -> None:
         conn = self._conns.pop(address, None)
         if conn is not None:
+            conn.close()
+
+    def invalidate_conn(self, address: Address,
+                        conn: Optional[Connection]) -> None:
+        """Drop/close exactly ``conn``, and only if this pool still
+        caches it — never a fresh connection another coroutine raced in,
+        and never a caller-owned link (e.g. the worker's registration
+        conn) that merely timed out."""
+        if conn is None:
+            return
+        if self._conns.get(address) is conn:
+            self._conns.pop(address, None)
             conn.close()
 
     def close_all(self) -> None:

@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import hashlib
+import inspect
 import itertools
 import logging
 import os
@@ -78,6 +79,7 @@ from ray_tpu.core.ids import (
 from ray_tpu.core.object_ref import ObjectRef, OwnerAddress
 from ray_tpu.core.object_store import MemoryStore, StoreClient
 from ray_tpu.core.refcount import ReferenceCounter, TaskManager
+from ray_tpu.util import failpoint as _fp
 from ray_tpu.core.serialization import (
     SerializedObject,
     deserialize,
@@ -369,6 +371,9 @@ class CoreWorker:
         self._dep_waiters: Dict[ObjectID, list] = {}
 
         _mark("pre_async_init")
+        # load env-armed failpoints up front: site checks (and the actor
+        # fast-path gate) then reduce to one empty-dict truth test
+        _fp.armed()
         self._run(self._async_init())
         _mark("async_init")
         set_global_worker(self)
@@ -402,6 +407,10 @@ class CoreWorker:
         self.gcs_conn = await rpc.connect(self.gcs_address,
                                           handler=self.task_server)
         self.gcs_conn.set_push_handler(self._on_gcs_push)
+        if self.mode == "worker":
+            # adopt cluster-armed failpoints (tests arm via internal KV
+            # after processes exist; env-var arming covers spawn time)
+            await _fp.sync_from_kv(self.gcs_conn)
         if self.mode == "driver" and self.config.log_to_driver:
             # stream worker stdout/stderr to this driver (parity: the
             # reference's log monitor -> driver echo with pid prefixes)
@@ -1576,11 +1585,18 @@ class CoreWorker:
 
     async def _cancel_lease_request(self, token: str,
                                     address: rpc.Address) -> None:
-        try:
-            conn = self.raylet_conn if address == self.raylet_address \
+        async def _get():
+            return self.raylet_conn if address == self.raylet_address \
                 else await self._pool.get(address)
-            await conn.call("cancel_lease", {"token": token})
-        except (rpc.ConnectionLost, rpc.RpcError, OSError):
+        try:
+            # idempotent (keyed on token): retried with backoff so a
+            # transient raylet blip doesn't strand a parked request
+            await rpc.call_with_retry(
+                _get, "cancel_lease", {"token": token},
+                invalidate=lambda failed: self._pool.invalidate_conn(
+                    address, failed))
+        except (rpc.ConnectionLost, rpc.RpcError, OSError,
+                asyncio.TimeoutError):
             pass  # best-effort; the request chain handles its own errors
 
     def _worker_accepts(self, worker: "_LeasedWorker",
@@ -1691,6 +1707,7 @@ class CoreWorker:
                 address=tuple(reply["worker_address"]),
                 raylet=raylet_address,
                 contended=bool(reply.get("contended")),
+                token=token,
             )
             state.workers[worker.worker_id] = worker
 
@@ -1719,6 +1736,8 @@ class CoreWorker:
             return
         self._task_locations[tid_bin] = worker.address
         try:
+            if _fp.active():
+                await _fp.afailpoint("worker.push_task.pre")
             conn = await self._pool.get(worker.address)
             if spec.stream_returns:
                 # dynamic_items pushes ride this conn while it executes
@@ -1728,7 +1747,7 @@ class CoreWorker:
                 "push_task", {"spec_blob": _spec_dumps(spec)},
                 timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
-                OSError) as e:
+                OSError, _fp.FailpointError) as e:
             worker.inflight -= 1
             state.workers.pop(worker.worker_id, None)
             self._pool.invalidate(worker.address)
@@ -1789,15 +1808,17 @@ class CoreWorker:
             self._streamed[key] = (spec, state, worker)
             self._task_locations[key[0]] = worker.address
         try:
+            if _fp.active():
+                await _fp.afailpoint("worker.push_tasks.pre")
             conn = await self._pool.get(worker.address)
             conn.set_push_handler(self._on_worker_push)
             for spec in specs:
                 self._record_task_event(spec, "RUNNING")
-            await conn.call(
+            reply = await conn.call(
                 "push_tasks", {"specs_blob": _spec_dumps(specs)},
                 timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
-                OSError) as e:
+                OSError, _fp.FailpointError) as e:
             state.workers.pop(worker.worker_id, None)
             self._pool.invalidate(worker.address)
             for spec, key in zip(specs, keys):
@@ -1906,14 +1927,22 @@ class CoreWorker:
         task.add_done_callback(lambda t: t.exception())
 
     async def _send_return_worker(self, worker: "_LeasedWorker") -> None:
-        try:
-            conn = self.raylet_conn if worker.raylet == self.raylet_address \
+        async def _get():
+            return self.raylet_conn if worker.raylet == self.raylet_address \
                 else await self._pool.get(worker.raylet)
-            await conn.call("return_worker", {
-                "worker_id": worker.worker_id.binary(),
-                "job_id": self.job_id.binary() if self.job_id else None,
-            })
-        except (rpc.ConnectionLost, rpc.RpcError):
+        try:
+            # idempotent (keyed on worker_id): a lost/failed return is
+            # retried with backoff — a leaked lease deadlocks the node
+            # once its CPUs are exhausted, so this must ride out blips
+            await rpc.call_with_retry(
+                _get, "return_worker", {
+                    "worker_id": worker.worker_id.binary(),
+                    "job_id": self.job_id.binary() if self.job_id else None,
+                    "token": worker.token,
+                },
+                invalidate=lambda failed: self._pool.invalidate_conn(
+                    worker.raylet, failed))
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
             pass
 
     def push_reclaim_idle(self, conn, data) -> None:
@@ -2210,8 +2239,11 @@ class CoreWorker:
         # len(pending)==1 gates it to the pure-latency shape: with other
         # calls in flight (an async burst), frames must keep flowing
         # through the sender loop so they BATCH (push_actor_tasks) —
-        # per-call frames were exactly the n:n cost this trades against
-        if len(state.pending) == 1 and not state.queue \
+        # per-call frames were exactly the n:n cost this trades against.
+        # Armed failpoints route through the sender loop so injection
+        # sites see every call (dormant registries keep the fast path).
+        if not _fp.active() \
+                and len(state.pending) == 1 and not state.queue \
                 and state.address is not None \
                 and state.dead_cause is None \
                 and (state.sender_task is None
@@ -2270,13 +2302,19 @@ class CoreWorker:
             # would settle one spec twice while dropping the other
             spec = state.queue.popleft()
             try:
+                # failpoint: the actor's address resolution / connect
+                # fails mid-restart — the per-task retry budget applies,
+                # and the restarted actor's new address must be re-read
+                if _fp.active():
+                    await _fp.afailpoint("worker.actor_resolve.pre")
                 address = await self._resolve_actor_address(state)
                 conn = await self._pool.get(address)
             except ActorDiedError as e:
                 state.pending.pop(spec.sequence_number, None)
                 self._fail_task(spec, e)
                 continue
-            except (rpc.ConnectionLost, rpc.RpcError, OSError):
+            except (rpc.ConnectionLost, rpc.RpcError, OSError,
+                    _fp.FailpointError):
                 state.address = None
                 await self._retry_or_fail_actor_task(state, spec,
                                                      "connect failed")
@@ -2607,24 +2645,35 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # GCS conveniences
     # ------------------------------------------------------------------
+    def _gcs_call_retry(self, method: str, data: dict):
+        """Idempotent GCS call that rides out a head restart: each
+        attempt re-reads ``self.gcs_conn`` (the reconnect loop swaps in
+        the fresh connection), backing off under the config policy."""
+        async def _get():
+            conn = self.gcs_conn
+            if conn is None or conn.closed:
+                raise rpc.ConnectionLost()
+            return conn
+        return self._run(rpc.call_with_retry(_get, method, data))
+
     def kv_put(self, key: str, value: bytes, namespace: str = "") -> None:
-        self._run(self.gcs_conn.call("kv_put", {
-            "key": key, "value": value, "namespace": namespace}))
+        self._gcs_call_retry("kv_put", {
+            "key": key, "value": value, "namespace": namespace})
 
     def kv_get(self, key: str, namespace: str = "") -> Optional[bytes]:
-        return self._run(self.gcs_conn.call("kv_get", {
-            "key": key, "namespace": namespace}))
+        return self._gcs_call_retry("kv_get", {
+            "key": key, "namespace": namespace})
 
     def kv_del(self, key: str, namespace: str = "") -> bool:
-        return self._run(self.gcs_conn.call("kv_del", {
-            "key": key, "namespace": namespace}))
+        return self._gcs_call_retry("kv_del", {
+            "key": key, "namespace": namespace})
 
     def kv_keys(self, prefix: str = "", namespace: str = "") -> List[str]:
-        return self._run(self.gcs_conn.call("kv_keys", {
-            "prefix": prefix, "namespace": namespace}))
+        return self._gcs_call_retry("kv_keys", {
+            "prefix": prefix, "namespace": namespace})
 
     def get_nodes(self) -> List[Dict[str, Any]]:
-        return self._run(self.gcs_conn.call("get_nodes", {}))
+        return self._gcs_call_retry("get_nodes", {})
 
     def cluster_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}
@@ -2872,9 +2921,15 @@ class CoreWorker:
     def _schedule_worker_exit(self) -> None:
         """Exit AFTER (a) any pending GCS notification (exit_actor's
         kill_actor must land before the death report, or the GCS would
-        restart the actor) and (b) a short grace so the final reply
-        flushes; the owner already learned from worker_exit in the
-        reply, and the raylet reclaims lease resources on death."""
+        restart the actor) and (b) every in-flight reply has DRAINED to
+        the kernel; the owner already learned from worker_exit in the
+        reply, and the raylet reclaims lease resources on death.
+
+        The drain replaces a fixed 0.25 s grace: a large final reply (or
+        a slow link) could outlive the grace, and the owner would see
+        the connection drop first — misreporting a COMPLETED max_calls
+        task as WorkerCrashedError and re-executing it (double side
+        effects)."""
         def _arm():
             logger.info("worker exiting: %s",
                         "exit_actor" if self._exit_barrier is not None
@@ -2887,7 +2942,19 @@ class CoreWorker:
                         await asyncio.wait_for(asyncio.shield(barrier), 5.0)
                     except Exception:  # noqa: BLE001 — exit regardless
                         pass
-                await asyncio.sleep(0.25)
+                await _fp.afailpoint("worker.exit.predrain")
+                # the exec thread schedules the reply-future resolution
+                # before calling us, but the reply FRAME is only queued
+                # once the handler coroutine resumes — drain each owner
+                # link (in-flight dispatches done + socket buffers in
+                # the kernel) under one shared deadline
+                deadline = self._loop.time() + 2.0
+                server = self.task_server
+                for conn in (list(server.connections) if server else []):
+                    remaining = deadline - self._loop.time()
+                    if remaining <= 0:
+                        break
+                    await conn.drain_outbound(remaining)
                 os._exit(0)
             self._loop.create_task(_exit_soon())
         self._loop.call_soon_threadsafe(_arm)
@@ -3010,7 +3077,13 @@ class CoreWorker:
         Each task's result is PUSHED back as it completes (see
         _consume_exec_queue); the final reply carries the full list as
         the authoritative completion for bookkeeping."""
-        if self._exit_after_reply:
+        if self._exit_after_reply or (
+                _fp.active()
+                and _fp.failpoint("worker.push_tasks.reject")):
+            # failpoint: force the exiting-worker rejection reply — the
+            # production trigger (a batch racing the max_calls exit
+            # decision) is a sub-millisecond window no test can hit
+            # deterministically
             return {"rejected": "worker exiting", "worker_exit": True}
         specs: List[TaskSpec] = pickle.loads(data["specs_blob"])
         for spec in specs:
@@ -3189,7 +3262,11 @@ class CoreWorker:
                                            *args, **kwargs)
             else:
                 value = fn(*args, **kwargs)
-            if asyncio.iscoroutine(value):
+            if inspect.iscoroutine(value):
+                # inspect (not asyncio) iscoroutine: before 3.11 the
+                # asyncio variant also matched plain GENERATORS (legacy
+                # generator-coroutines), feeding streaming task bodies
+                # to asyncio.run -> "Task got bad yield"
                 value = asyncio.run(value)
             if spec.dynamic_returns:
                 # the generator BODY runs inside _post_dynamic_returns
@@ -3496,13 +3573,18 @@ class _PendingMarker:
 
 class _LeasedWorker:
     __slots__ = ("worker_id", "address", "raylet", "inflight",
-                 "return_handle", "contended", "fn_calls")
+                 "return_handle", "contended", "fn_calls", "token")
 
     def __init__(self, worker_id: WorkerID, address: rpc.Address,
-                 raylet: rpc.Address, contended: bool = False):
+                 raylet: rpc.Address, contended: bool = False,
+                 token: Optional[str] = None):
         self.worker_id = worker_id
         self.address = address
         self.raylet = raylet
+        # the acquiring lease request's token: keys the eventual
+        # return_worker so a RETRIED return can never settle a newer
+        # lease of the same worker
+        self.token = token
         self.inflight = 0
         self.return_handle = None
         # granted while other demand queued at the raylet: hand the
@@ -3548,11 +3630,18 @@ class _ActorSubmitState:
 
 def _deserialize_pinned(view: memoryview, pin: _Pin):
     """Deserialize with out-of-band buffers wrapped in _PinnedBuffer so the
-    store slot stays pinned while any consumer is alive."""
+    store slot stays pinned while any consumer is alive.
+
+    The zero-copy wrapper relies on PEP 688 (``__buffer__``), which the
+    interpreter only honors for Python classes from 3.12 on.  On older
+    runtimes consumers (e.g. ``np.frombuffer``) reject the wrapper, so
+    each buffer is copied out instead — correctness over zero-copy."""
     import pickle
     import struct as struct_mod
+    import sys as sys_mod
     from ray_tpu.core import serialization as ser_mod
 
+    zero_copy = sys_mod.version_info >= (3, 12)
     magic = ser_mod._MAGIC
     if bytes(view[: len(magic)]) != magic:
         raise ValueError("corrupt serialized object (bad magic)")
@@ -3567,7 +3656,9 @@ def _deserialize_pinned(view: memoryview, pin: _Pin):
     for _ in range(n_buffers):
         (buf_len,) = struct_mod.unpack_from("<Q", view, offset)
         offset = ser_mod._pad(offset + 8)
-        buffers.append(_PinnedBuffer(view[offset : offset + buf_len], pin))
+        chunk = view[offset : offset + buf_len]
+        buffers.append(_PinnedBuffer(chunk, pin) if zero_copy
+                       else bytes(chunk))
         offset += buf_len
     is_exception = meta.endswith(ser_mod.META_EXCEPTION)
     if is_exception:

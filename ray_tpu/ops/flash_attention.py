@@ -26,6 +26,14 @@ from jax import lax
 NEG_INF = -1e30
 
 
+def _compiler_params(pltpu, **kwargs):
+    """Version-portable Pallas-TPU compiler params: ``CompilerParams``
+    where it exists, ``TPUCompilerParams`` on older jax."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def _clamp_k_tile(j, i, block_q: int, block_k: int):
     """Causal DMA elision: clamp streaming K-tile index ``j`` to the last
     tile intersecting Q-tile ``i``'s causal triangle — fully-masked grid
@@ -193,7 +201,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
             pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
             pltpu.VMEM((block_q, dim), jnp.float32),  # accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu, 
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -327,7 +335,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
                         * out.transpose(0, 2, 1, 3).astype(jnp.float32),
                         axis=-1, keepdims=True)
 
-    seq_params = pltpu.CompilerParams(
+    seq_params = _compiler_params(pltpu, 
         dimension_semantics=("parallel", "parallel", "parallel",
                              "arbitrary"))
 
@@ -587,7 +595,7 @@ def _flash_nl_forward(q, k, v, causal: bool, scale: float,
             + [pltpu.VMEM((block_q, 1), jnp.float32)] * pack   # running sum
             + [pltpu.VMEM((block_q, pack * dim), jnp.float32)]  # accumulator
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu, 
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -757,7 +765,7 @@ def _flash_nl_backward(q, k, v, out, lse, g, causal, scale, block_q,
                  .reshape(batch, seq_q, h2, pack)
                  .transpose(0, 2, 1, 3))           # [B, H2, T, pack]
 
-    seq_params = pltpu.CompilerParams(
+    seq_params = _compiler_params(pltpu, 
         dimension_semantics=("parallel", "parallel", "parallel",
                              "arbitrary"))
 

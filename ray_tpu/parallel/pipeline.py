@@ -20,6 +20,8 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ray_tpu.parallel.mesh import shard_map as _shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -90,7 +92,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     def wrapped(params, inputs):
         return fn(squeeze_stage(params), inputs)
 
-    return jax.shard_map(
+    return _shard_map(
         wrapped, mesh=mesh,
         in_specs=(param_spec, P()), out_specs=P(),
         check_vma=False,

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.parallel.mesh import shard_map as _shard_map
+
 NEG_INF = -1e30
 
 
@@ -296,7 +298,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return fn(q, k, v)
 
     spec = P(None, axis_name, None, None)
-    return jax.shard_map(
+    return _shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

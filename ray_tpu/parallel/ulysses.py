@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_tpu.parallel.mesh import shard_map as _shard_map
+
 
 def _default_attn(q, k, v, causal: bool, scale: float):
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -94,7 +96,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     fn = functools.partial(_ulysses_sharded, axis_name=axis_name,
                            causal=causal, scale=scale, attn_fn=attn_fn,
                            interpret=interpret)
-    return jax.shard_map(
+    return _shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -34,10 +34,12 @@ io loop.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import io
 import json
 import os
+import re
 import shutil
 import sys
 import tempfile
@@ -156,21 +158,77 @@ def env_hash(runtime_env: Dict[str, Any]) -> str:
         json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:16]
 
 
+@functools.lru_cache(maxsize=1024)
+def _exclude_regex(core: str) -> "re.Pattern":
+    """Translate one gitwildmatch-style pattern into a relpath regex.
+
+    Unlike ``fnmatch`` (whose ``*`` crosses ``/``), ``*`` and ``?``
+    stop at path-segment boundaries and only ``**`` spans directories —
+    the reference's gitwildmatch semantics, so ``data/*.bin`` excludes
+    ``data/x.bin`` but NOT ``data/sub/x.bin``.  The compiled regex also
+    matches the pattern as a directory prefix (``dir`` excludes
+    ``dir/anything``)."""
+    out = []
+    i, n = 0, len(core)
+    while i < n:
+        c = core[i]
+        if c == "*":
+            if core.startswith("**/", i):
+                out.append("(?:[^/]+/)*")  # zero or more whole segments
+                i += 3
+            elif core.startswith("**", i):
+                out.append(".*")
+                i += 2
+            else:
+                out.append("[^/]*")
+                i += 1
+        elif c == "?":
+            out.append("[^/]")
+            i += 1
+        elif c == "[":
+            j = core.find("]", i + 1)
+            if j == -1:
+                out.append(re.escape(c))
+                i += 1
+            else:
+                cls = core[i + 1:j]
+                if cls.startswith("!"):
+                    # gitwildmatch negation; never matches a separator
+                    cls = "^/" + cls[1:]
+                out.append("[" + cls + "]")
+                i = j + 1
+        else:
+            out.append(re.escape(c))
+            i += 1
+    try:
+        return re.compile("".join(out) + r"(?:/.*)?\Z")
+    except re.error:
+        # degenerate class (e.g. "[]]"): fall back to a literal match
+        # rather than crashing working_dir packaging
+        return re.compile(re.escape(core) + r"(?:/.*)?\Z")
+
+
 def _excluded(rel: str, patterns) -> bool:
-    """fnmatch-style exclude check against the POSIX relpath (reference
-    packaging.py honors gitwildmatch; this covers the common forms:
-    "*.ext", "dir/**", "dir/", "name", "/anchored")."""
+    """Gitwildmatch-style exclude check against the POSIX relpath
+    (reference packaging.py semantics; covers the common forms:
+    "*.ext", "dir/*.ext", "dir/**", "dir/", "name", "/anchored")."""
     rel = rel.replace(os.sep, "/")
     for pat in patterns:
         pat = pat.replace(os.sep, "/")
         anchored = pat.startswith("/")
-        pat = pat.lstrip("/").rstrip("/")
-        if fnmatch.fnmatch(rel, pat) or fnmatch.fnmatch(rel, pat + "/*"):
+        core = pat.lstrip("/").rstrip("/")
+        if not core:
+            continue
+        if _exclude_regex(core).match(rel):
             return True
-        if not anchored and (fnmatch.fnmatch(os.path.basename(rel), pat)
-                             or any(fnmatch.fnmatch(part, pat)
-                                    for part in rel.split("/")[:-1])):
-            return True
+        if not anchored and "/" not in core:
+            # bare name: floats to any depth — matches the basename or
+            # any directory segment (segments contain no "/", so plain
+            # fnmatch is exact here)
+            if fnmatch.fnmatch(os.path.basename(rel), core) \
+                    or any(fnmatch.fnmatch(part, core)
+                           for part in rel.split("/")[:-1]):
+                return True
     return False
 
 

@@ -223,6 +223,15 @@ class ClientWorker:
         self._call("cancel", {"id": ref.binary(), "force": force,
                               "recursive": recursive})
 
+    def cancel_task_id(self, task_id_bin: bytes, *, force: bool = False,
+                       recursive: bool = False) -> None:
+        """Cancel by task id — the only handle a streaming-generator
+        caller holds (parity: the reference cancels the generator object
+        directly; over ray:// the task id travels instead)."""
+        self._call("cancel_task_id", {
+            "task_id": task_id_bin, "force": force,
+            "recursive": recursive})
+
     def free(self, refs: List[ObjectRef]) -> None:
         self._call("free", {"ids": [r.binary() for r in refs]})
 

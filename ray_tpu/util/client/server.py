@@ -274,6 +274,18 @@ class ClientService:
             ray_tpu.cancel, ref, force=bool(data.get("force")),
             recursive=bool(data.get("recursive")))
 
+    async def handle_cancel_task_id(self, conn, data) -> None:
+        """Cancel by task id (streaming generators hold no ObjectRef the
+        client could resolve — the id is the handle)."""
+        from ray_tpu.core import worker as _worker_mod
+        from ray_tpu.core.ids import TaskID
+
+        core = _worker_mod.global_worker()
+        await asyncio.to_thread(
+            core.cancel_task, TaskID(data["task_id"]),
+            force=bool(data.get("force")),
+            recursive=bool(data.get("recursive")))
+
     async def handle_free(self, conn, data) -> None:
         refs = [self._resolve(conn, b) for b in data["ids"]]
         await asyncio.to_thread(ray_tpu.free, refs)

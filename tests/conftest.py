@@ -19,7 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the XLA_FLAGS host-platform override above already
+    # provides the 8-device CPU mesh
+    pass
 
 import pytest  # noqa: E402
 
@@ -62,6 +67,9 @@ def chaos_cluster():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long regression runs (deselect with -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "failpoints: deterministic fault-injection suite "
+        "(run via `make chaos`)")
 
 
 def pytest_collection_modifyitems(config, items):

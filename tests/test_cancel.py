@@ -211,6 +211,33 @@ def test_cancel_over_ray_client(ray_client):
     ray_tpu.free([keep])
 
 
+def test_cancel_streaming_generator_over_client(ray_client):
+    """A streaming generator's only handle is its task id; with a
+    ray:// client attached, cancel() must route that id through the
+    client cancel protocol (it used to raise TypeError)."""
+    from ray_tpu.core.object_ref import StreamingObjectRefGenerator
+
+    @ray_tpu.remote
+    def sleeper():
+        import time as t
+        t.sleep(60)
+        return "done"
+
+    ref = sleeper.remote()
+    time.sleep(1.5)  # let it start executing on the cluster
+    # the wire protocol carries the TASK ID — the same handle a
+    # streaming generator holds (the client cannot resolve an ObjectRef
+    # for a stream, so the id is the cancel key)
+    gen = StreamingObjectRefGenerator(ref.task_id(), None)
+    ray_tpu.cancel(gen)  # must not raise TypeError
+    t0 = time.monotonic()
+    with pytest.raises(Exception) as exc_info:
+        ray_tpu.get(ref, timeout=20)
+    assert time.monotonic() - t0 < 15, "cancel did not interrupt the task"
+    assert "cancel" in str(exc_info.value).lower() \
+        or "Cancelled" in type(exc_info.value).__name__
+
+
 def test_cancel_streaming_generator(cluster):
     """Cancelling via the streaming handle (the only handle a streaming
     caller holds) interrupts the RUNNING generator body — the interrupt

@@ -81,6 +81,46 @@ def test_working_dir_excludes(tmp_path):
         renv.validate({"working_dir": "kv://deadbeef",
                        "excludes": ["*.env"]})  # zip already final
 
+
+def test_excludes_star_stops_at_segment_boundaries():
+    """Gitwildmatch semantics: ``*`` must not cross ``/`` (fnmatch's
+    did, silently over-excluding nested files), ``**`` must."""
+    from ray_tpu.runtime_env import _excluded
+
+    # * stays within one path segment
+    assert _excluded("data/x.bin", ["data/*.bin"])
+    assert not _excluded("data/sub/x.bin", ["data/*.bin"])
+    assert not _excluded("other/data/x.bin", ["data/*.bin"])
+    # ** spans directories
+    assert _excluded("data/sub/x.bin", ["data/**"])
+    assert _excluded("data/a/b/c.txt", ["data/**/*.txt"])
+    assert _excluded("data/c.txt", ["data/**/*.txt"])
+    assert not _excluded("data/a/b/c.bin", ["data/**/*.txt"])
+    # ? matches one non-separator character
+    assert _excluded("logs/a.txt", ["logs/?.txt"])
+    assert not _excluded("logs/ab.txt", ["logs/?.txt"])
+    assert not _excluded("logs/a/b.txt", ["logs/?.txt"])
+    # character classes, including gitwildmatch negation
+    assert _excluded("dir/b1.txt", ["dir/[ab]*.txt"])
+    assert not _excluded("dir/c1.txt", ["dir/[ab]*.txt"])
+    assert _excluded("dir/x.txt", ["dir/[!a]*.txt"])
+    assert not _excluded("dir/a.txt", ["dir/[!a]*.txt"])
+
+
+def test_excludes_bare_names_float_and_anchors_pin():
+    from ray_tpu.runtime_env import _excluded
+
+    # bare names match at any depth (basename or directory segment)
+    assert _excluded("a/b/__pycache__/mod.pyc", ["__pycache__"])
+    assert _excluded("deep/nest/notes.txt", ["*.txt"])
+    assert _excluded("ckpt/step1/weights", ["ckpt"])
+    # anchored patterns only match from the package root
+    assert _excluded("build/out.o", ["/build"])
+    assert not _excluded("src/build/out.o", ["/build"])
+    # directory pattern covers the whole subtree
+    assert _excluded("data/sub/deep/x", ["data/"])
+    assert not _excluded("metadata/x", ["data/"])
+
 def test_working_dir(tmp_path):
     wd = tmp_path / "wd"
     wd.mkdir()
