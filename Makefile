@@ -6,7 +6,7 @@
 #   make tsan   — ThreadSanitizer build of the concurrency stress
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
-.PHONY: all native test chaos tsan asan sanitize clean
+.PHONY: all native test chaos bench-transfer tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -20,13 +20,22 @@ native:
 test: native
 	python -m pytest tests/ -q
 
-# Deterministic chaos: failpoint-injection suite + node-kill suite with
-# fixed seeds (failpoint sites seed per-site; NodeKiller seeds in-test;
-# PYTHONHASHSEED pins dict/hash order) so a failing run replays exactly.
+# Deterministic chaos: failpoint-injection suite + node-kill suite +
+# mid-transfer source-kill suite with fixed seeds (failpoint sites seed
+# per-site; NodeKiller seeds in-test; PYTHONHASHSEED pins dict/hash
+# order) so a failing run replays exactly.  The explicit -m expression
+# also opts IN the slow-marked transfer failover test that plain runs
+# auto-skip.
 chaos: native
 	PYTHONHASHSEED=0 JAX_PLATFORMS=cpu python -m pytest \
-	  tests/test_failpoints.py tests/test_chaos.py -q \
+	  tests/test_failpoints.py tests/test_chaos.py \
+	  tests/test_object_transfer.py -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
+
+# Quick transfer-plane microbench (broadcast + multi-client put) with a
+# one-line JSON delta vs the newest BENCH_r*.json baseline artifact.
+bench-transfer: native
+	JAX_PLATFORMS=cpu python scripts/bench_transfer.py
 
 build/store_stress_tsan: $(SAN_SRCS)
 	@mkdir -p build

@@ -787,6 +787,18 @@ SUMMARY_KEYS = (
 
 
 def main() -> None:
+    if "--transfer" in sys.argv[1:]:
+        # reduced transfer-plane microbench (broadcast + multi-client
+        # put) with a one-line JSON delta vs the newest BENCH_r*.json —
+        # same entry `make bench-transfer` uses, minus the full harness
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_transfer
+
+        sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
+                                    if a != "--transfer"]
+        bench_transfer.main()
+        return
     model_stats = bench_gpt2()
     details = dict(model_stats)
     try:

@@ -37,6 +37,27 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     #: Chunk size for node-to-node object transfer.
     object_transfer_chunk_size: int = 5 * 1024 * 1024
+    #: In-flight chunk requests per transfer source (pipelining depth of
+    #: a pull; 1 = the old serial request/reply protocol).
+    object_transfer_window: int = 8
+    #: Max holders a single pull stripes chunks across (sources beyond
+    #: this are kept as failover spares).
+    object_transfer_max_sources: int = 4
+    #: Register in-progress pulls as *partial* locations with the owner
+    #: so concurrent pullers chain off each other (1->N broadcasts
+    #: self-organize into a tree instead of N pulls hammering the one
+    #: sealed holder).
+    object_transfer_partial_locations: bool = True
+    #: Per-chunk request timeout; also bounds how long a chunk request
+    #: against a partial (in-progress) holder waits for that holder's
+    #: own transfer to produce the chunk.
+    object_transfer_chunk_timeout_s: float = 30.0
+    #: When the holder's arena file is visible on this host (multiple
+    #: raylets per machine — virtual clusters, multi-node tests), copy
+    #: arena-to-arena through shared memory instead of the TCP stack
+    #: (the reference runs ONE plasma store per host for this reason;
+    #: the pin/lease protocol still runs over RPC).
+    object_transfer_shm_fastpath: bool = True
     #: Fraction of store capacity at which LRU eviction starts.
     object_store_eviction_fraction: float = 1.0
     #: Directory for spilled objects ("" = <session_dir>/spill).

@@ -93,6 +93,13 @@ register_schema("object_get", object_ids=list)
 register_schema("object_release", object_ids=list)
 register_schema("object_free", object_ids=list)
 register_schema("get_small_object", object_id=bytes)
+# node-to-node transfer protocol (raylet <-> raylet)
+register_schema("object_pull_start", object_id=bytes)
+register_schema("object_pull_chunk", object_id=bytes, offset=int, n=int)
+register_schema("object_pull_end", object_id=bytes)
+# owner-side object directory updates (raylet -> owner worker)
+register_schema("object_location_added", object_id=bytes, node=None)
+register_schema("object_location_removed", object_id=bytes, node=None)
 
 # kv / functions / pubsub
 register_schema("kv_put", key=str, value=None)

@@ -67,6 +67,8 @@ def load() -> ctypes.CDLL:
         lib.rtpu_store_destroy.argtypes = [ctypes.c_void_p]
         lib.rtpu_store_put.restype = ctypes.c_int64
         lib.rtpu_store_put.argtypes = [ctypes.c_void_p, buf, u64]
+        lib.rtpu_store_put_hint.restype = ctypes.c_int64
+        lib.rtpu_store_put_hint.argtypes = [ctypes.c_void_p, buf, u64, u64]
         lib.rtpu_store_seal.restype = ctypes.c_int
         lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, buf]
         lib.rtpu_store_get.restype = ctypes.c_int

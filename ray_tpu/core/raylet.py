@@ -29,9 +29,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import subprocess
 import sys
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -43,6 +45,9 @@ from ray_tpu.core.object_store import SharedMemoryStore
 from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
+
+#: seeded source-sampling stream for pull probes (reproducible runs)
+_probe_rng = random.Random(0x52545055)
 
 
 @dataclass
@@ -194,6 +199,64 @@ class PendingLease:
     conn: Optional[rpc.Connection] = None
 
 
+class _InflightPull:
+    """One in-progress incoming transfer (the receive side of a pull).
+
+    Registered in ``Raylet._inflight_pulls`` so the node can serve
+    already-received chunk ranges to OTHER pullers before the copy
+    seals: a 1->N broadcast then self-organizes into a tree/chain
+    instead of N pulls hammering the one sealed holder (parity:
+    ObjectManager registers in-progress copies as pull targets).
+    """
+
+    __slots__ = ("size", "offset", "chunk", "have", "waiters", "failed")
+
+    def __init__(self, size: int, offset: int, chunk: int):
+        self.size = size
+        self.offset = offset  # arena offset of the partial create
+        self.chunk = chunk    # chunk stride the ``have`` set is keyed by
+        self.have: Set[int] = set()  # completed chunk indices
+        self.waiters: List[asyncio.Future] = []
+        self.failed = False
+
+    def mark(self, index: int) -> None:
+        self.have.add(index)
+        self._wake()
+
+    def fail(self) -> None:
+        self.failed = True
+        self._wake()
+
+    def _wake(self) -> None:
+        waiters, self.waiters = self.waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+    def covered(self, start: int, n: int) -> bool:
+        last = (start + max(n, 1) - 1) // self.chunk
+        return all(i in self.have
+                   for i in range(start // self.chunk, last + 1))
+
+    async def wait_range(self, start: int, n: int, timeout: float) -> bool:
+        """Block until [start, start+n) has been received (True) or the
+        transfer failed / the timeout expired (False)."""
+        deadline = time.monotonic() + timeout
+        while not self.covered(start, n):
+            if self.failed:
+                return False
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            fut = asyncio.get_running_loop().create_future()
+            self.waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, remaining)
+            except asyncio.TimeoutError:
+                return False
+        return not self.failed
+
+
 class Raylet:
     def __init__(self, config: Config, gcs_address: rpc.Address,
                  session_dir: str, resources: Optional[Dict[str, float]] = None,
@@ -235,7 +298,17 @@ class Raylet:
         self._spill_dir = config.object_spilling_directory or os.path.join(
             session_dir, "spill")
         os.makedirs(self._spill_dir, exist_ok=True)
-        self._pull_locks: Dict[ObjectID, asyncio.Lock] = {}
+        # per-object pull serialization: oid -> [lock, waiter_count]; the
+        # entry is dropped when the last waiter leaves (a bare
+        # setdefault'd Lock leaked one dict entry per object pulled)
+        self._pull_locks: Dict[ObjectID, list] = {}
+        # in-progress incoming transfers, served to other pullers as
+        # *partial* sources (emergent broadcast trees; ObjectManager
+        # parity: in-progress copies are registered pull targets)
+        self._inflight_pulls: Dict[ObjectID, _InflightPull] = {}
+        # same-host peer arenas mapped for the shm transfer fast path:
+        # store path -> (mmap, base address, ctypes export)
+        self._peer_arenas: Dict[str, tuple] = {}
 
         # worker pool: spawned-but-unregistered procs as
         # (proc, tpu_capable, spawned_with_needs_tpu, spawn_token)
@@ -360,6 +433,13 @@ class Raylet:
         if self.gcs_conn:
             self.gcs_conn.close()
         self.pool.close_all()
+        for path in list(self._peer_arenas):
+            ent = self._peer_arenas.pop(path)
+            ent[2] = None  # drop the ctypes export before unmapping
+            try:
+                ent[0].close()
+            except BufferError:
+                pass  # export still referenced; process teardown
         self.store.close()
 
     def _on_gcs_push(self, channel: str, data: Any) -> None:
@@ -983,6 +1063,15 @@ class Raylet:
                 "config": self.config.to_json()}
 
     def on_disconnection(self, conn) -> None:
+        # release transfer pins a crashed/vanished puller left behind —
+        # without this a dead puller pinned this node's copies forever
+        # (they could never be evicted or spilled)
+        for oid in conn.context.pop("pull_leases", set()):
+            try:
+                self.store.release(oid)
+            except Exception:  # noqa: BLE001 — store may be closing
+                pass
+        conn.context.pop("pull_offsets", None)
         worker_id = conn.context.get("worker_id")
         if worker_id is not None:
             w = self.workers.get(worker_id)
@@ -1748,11 +1837,21 @@ class Raylet:
             raise ObjectStoreFullError(
                 f"object of {size} bytes exceeds the store capacity "
                 f"({self.store_capacity}) — no amount of spilling fits it")
+        # per-client allocation affinity: creates from one connection
+        # (i.e. one producing process) reuse blocks that process freed,
+        # so its writes land on page-table-warm offsets.  Fault-expensive
+        # hosts write cold pages ~10x slower — with a single shared free
+        # list, four concurrent putters permanently shuffled each other
+        # onto cold blocks (the multi-client put collapse).
+        hint = conn.context.get("alloc_hint")
+        if hint is None:
+            hint = conn.context["alloc_hint"] = \
+                (id(conn) >> 4) % 63 + 1  # 0 is the raylet's own bucket
         deadline = time.monotonic() + 30.0
         while True:
             self._maybe_spill(size)
             try:
-                offset, _ = self.store.alloc(object_id, size)
+                offset, _ = self.store.alloc(object_id, size, hint)
                 return {"offset": offset, "size": size}
             except ValueError:
                 raise  # already exists — caller bug, don't retry
@@ -1797,109 +1896,497 @@ class Raylet:
 
     async def _make_local(self, oid: ObjectID, owner: Optional[tuple],
                           deadline: Optional[float]) -> bool:
-        """Restore from spill or pull from a remote holder."""
-        lock = self._pull_locks.setdefault(oid, asyncio.Lock())
-        async with lock:
-            if self.store.contains(oid):
-                return True
-            if oid in self._spilled:
-                return self._restore_from_spill(oid)
-            if owner is None:
-                owner = self._owner_of.get(oid)
-            if owner is None:
-                return False
-            # ownership-based directory: ask the owner where copies live
-            while True:
-                try:
-                    owner_conn = await self.pool.get((owner[1], owner[2]))
-                    locs = await owner_conn.call(
-                        "get_object_locations",
-                        {"object_id": oid.binary()}, timeout=10.0)
-                except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
-                    return False
-                if locs is None:
-                    return False  # owner no longer knows the object
-                for node_addr in locs.get("nodes", []):
-                    if tuple(node_addr) == self.server.address:
-                        continue
-                    if await self._pull_from(tuple(node_addr), oid):
-                        return True
-                if locs.get("spilled_uri"):
-                    # external tier: restore directly, no matter which
-                    # node spilled it (it may be dead — that's the point)
-                    if self._restore_from_uri(oid, locs["spilled_uri"]):
-                        return True
-                if locs.get("spilled_on"):
-                    node_addr = tuple(locs["spilled_on"])
-                    if node_addr == self.server.address:
-                        return self._restore_from_spill(oid)
-                    if await self._pull_from(node_addr, oid):
-                        return True
-                if locs.get("pending"):
-                    # object not produced yet; wait and retry
-                    if deadline is not None and time.monotonic() > deadline:
-                        return False
-                    await asyncio.sleep(0.05)
-                    continue
-                return False
-
-    async def _pull_from(self, node_addr: rpc.Address, oid: ObjectID) -> bool:
-        """Chunked pull (parity: ObjectManager Push/Pull, pull_manager.h)."""
+        """Restore from spill or pull from remote holders (serialized
+        per object; concurrent readers share one transfer)."""
+        entry = self._pull_locks.get(oid)
+        if entry is None:
+            entry = self._pull_locks[oid] = [asyncio.Lock(), 0]
+        entry[1] += 1
         try:
-            conn = await self.pool.get(node_addr)
-            meta = await conn.call("object_pull_start",
-                                   {"object_id": oid.binary()}, timeout=10.0)
-            if meta is None:
-                return False
-            size = meta["size"]
-            self._maybe_spill(size)
-            view = self.store.create(oid, size)
-            chunk = self.config.object_transfer_chunk_size
-            try:
-                for off in range(0, size, chunk):
-                    n = min(chunk, size - off)
-                    data = await conn.call(
-                        "object_pull_chunk",
-                        {"object_id": oid.binary(), "offset": off, "n": n},
-                        timeout=60.0)
-                    if data is None:
-                        raise IOError("remote dropped object mid-transfer")
-                    view[off:off + n] = data
-            except Exception:
-                self.store.delete(oid)
-                raise
-            finally:
-                await conn.call("object_pull_end",
-                                {"object_id": oid.binary()}, timeout=10.0)
-            self.store.seal(oid)
-            # secondary copy: not pinned, evictable
+            async with entry[0]:
+                return await self._make_local_locked(oid, owner, deadline)
+        finally:
+            entry[1] -= 1
+            if entry[1] == 0 and self._pull_locks.get(oid) is entry:
+                del self._pull_locks[oid]
+
+    async def _make_local_locked(self, oid: ObjectID,
+                                 owner: Optional[tuple],
+                                 deadline: Optional[float]) -> bool:
+        if self.store.contains(oid):
             return True
-        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
-                OSError):
-            # OSError covers connect-refused to a dead holder: treat the
-            # location as gone and let the caller try the next one
+        if oid in self._spilled:
+            return self._restore_from_spill(oid)
+        if owner is None:
+            owner = self._owner_of.get(oid)
+        if owner is None:
             return False
+        # ownership-based directory: ask the owner where copies live
+        failures = 0
+        while True:
+            try:
+                owner_conn = await self.pool.get((owner[1], owner[2]))
+                locs = await owner_conn.call(
+                    "get_object_locations",
+                    {"object_id": oid.binary()}, timeout=10.0)
+            except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
+                    OSError):
+                return False
+            if locs is None:
+                return False  # owner no longer knows the object
+            my_addr = self.server.address
+            sealed = [tuple(a) for a in locs.get("nodes", [])
+                      if tuple(a) != my_addr]
+            partials = [tuple(a) for a in (locs.get("partial_nodes") or [])
+                        if tuple(a) != my_addr]
+            if (sealed or partials) and await self._pull_object(
+                    oid, sealed, partials, owner_conn):
+                return True
+            if locs.get("spilled_uri"):
+                # external tier: restore directly, no matter which
+                # node spilled it (it may be dead — that's the point)
+                if self._restore_from_uri(oid, locs["spilled_uri"]):
+                    return True
+            if locs.get("spilled_on"):
+                node_addr = tuple(locs["spilled_on"])
+                if node_addr == my_addr:
+                    return self._restore_from_spill(oid)
+                if await self._pull_object(oid, [node_addr], [],
+                                           owner_conn):
+                    return True
+            if locs.get("pending"):
+                # object not produced yet; wait and retry
+                if deadline is not None and time.monotonic() > deadline:
+                    return False
+                await asyncio.sleep(0.05)
+                continue
+            failures += 1
+            if not (sealed or partials) or failures >= 3:
+                return False
+            # every source failed mid-transfer: re-query the owner —
+            # fresh holders may have sealed since (chained broadcast)
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            await asyncio.sleep(0.1)
+
+    async def _pull_object(self, oid: ObjectID,
+                           sealed_nodes: List[rpc.Address],
+                           partial_nodes: List[rpc.Address],
+                           owner_conn: Optional[rpc.Connection]) -> bool:
+        """Windowed, multi-source pull (parity: ObjectManager Push/Pull,
+        pull_manager.h).
+
+        Up to ``object_transfer_window`` chunk requests are kept in
+        flight per source, and sources serve disjoint chunks off one
+        shared queue, so holders stripe the object between them and a
+        faster source automatically carries more.  A source that dies
+        mid-transfer re-queues its outstanding chunks for the survivors
+        — the transfer restarts only when EVERY source is gone.  While
+        the transfer runs it is registered as a *partial* location with
+        the owner; once sealed it is registered as a full location, so
+        later pullers fan out across the copies instead of all draining
+        the producer.
+        """
+        config = self.config
+        window = max(1, getattr(config, "object_transfer_window", 8))
+        max_sources = max(1, getattr(config, "object_transfer_max_sources",
+                                     4))
+        chunk = config.object_transfer_chunk_size
+        chunk_timeout = getattr(config, "object_transfer_chunk_timeout_s",
+                                30.0)
+        partial_cfg = getattr(config, "object_transfer_partial_locations",
+                              True)
+
+        t_start = time.monotonic()
+        # sample rather than slice when many holders exist: a prefix of
+        # dead nodes (the owner never unlearns crashed holders) would
+        # otherwise shadow live copies further down the list on every
+        # attempt.  Seeded stream for reproducible test runs.
+        sealed_pick = list(sealed_nodes)
+        if len(sealed_pick) > max_sources + 2:
+            sealed_pick = _probe_rng.sample(sealed_pick, max_sources + 2)
+        candidates = sealed_pick
+        candidates += [addr for addr in partial_nodes[:2]
+                       if addr not in candidates]
+
+        async def probe(addr: rpc.Address):
+            try:
+                conn = await self.pool.get(addr)
+                meta = await conn.call(
+                    "object_pull_start", {"object_id": oid.binary()},
+                    timeout=10.0)
+            except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
+                    OSError):
+                return None
+            if meta is None:
+                return None
+            return {"addr": addr, "conn": conn, "size": meta["size"],
+                    "partial": bool(meta.get("partial")), "dead": False,
+                    "meta": meta}
+
+        if not candidates:
+            return False
+        # two-phase probe wait: a single black-holed candidate (e.g. a
+        # stale partial location from a crashed puller) must not stall
+        # transfer start for its full timeout when a healthy source
+        # answered in milliseconds.  Stragglers keep running in the
+        # background and release their pins when they land.
+        probe_tasks = [asyncio.ensure_future(probe(a)) for a in candidates]
+        done, pending_probes = await asyncio.wait(probe_tasks, timeout=2.0)
+        if not any(t.result() is not None for t in done):
+            if pending_probes:
+                more, pending_probes = await asyncio.wait(pending_probes,
+                                                          timeout=10.0)
+                done |= more
+        for t in pending_probes:
+            t.add_done_callback(self._release_late_probe(oid))
+        probed = [t.result() for t in done if t.result() is not None]
+        if not probed:
+            return False
+        # prefer sealed copies over partial chains (bounded waits beat
+        # no waits only when there's nothing better), then cap the
+        # stripe width
+        probed.sort(key=lambda s: s["partial"])
+        sources = [s for s in probed if s["size"] == probed[0]["size"]]
+        sources, spares = sources[:max_sources], sources[max_sources:]
+        await self._release_sources(oid, spares)
+        if not sources:
+            return False
+        size = sources[0]["size"]
+
+        registered_partial = False
+        if partial_cfg and owner_conn is not None and size > chunk:
+            # announce the in-progress copy so concurrent pullers can
+            # chain on this node instead of re-draining the holders
+            try:
+                await owner_conn.call("object_location_added", {
+                    "object_id": oid.binary(),
+                    "node": list(self.server.address),
+                    "partial": True}, timeout=5.0)
+                registered_partial = True
+            except (rpc.ConnectionLost, rpc.RpcError,
+                    asyncio.TimeoutError):
+                pass
+
+        try:
+            self._maybe_spill(size)
+            offset, view = self.store.alloc(oid, size)
+        except ValueError:
+            # concurrently produced on this node (e.g. a local worker
+            # sealed it while we probed)
+            await self._release_sources(oid, sources)
+            return self.store.contains(oid)
+        except ObjectStoreFullError:
+            await self._release_sources(oid, sources)
+            if registered_partial:
+                await self._retract_partial(oid, owner_conn)
+            raise
+
+        inflight = _InflightPull(size, offset, chunk)
+        self._inflight_pulls[oid] = inflight
+        pending = deque((off, min(chunk, size - off))
+                        for off in range(0, size, chunk))
+        total_chunks = len(pending)
+        state = {"active": 0}
+        # sinks write into the arena only while the transfer owns the
+        # block: a straggler reply arriving after cleanup (its request
+        # timed out and the chunk was re-fetched elsewhere) must not
+        # scribble over a freed/re-allocated region
+        alive = {"ok": True}
+        loop = asyncio.get_running_loop()
+
+        async def write_chunk(off: int, data) -> None:
+            if len(data) >= (1 << 18):
+                # GIL-releasing memmove off the event loop: cold arena
+                # pages fault at ~0.3 GB/s on sandboxed kernels, which
+                # would stall every other RPC this raylet serves
+                await loop.run_in_executor(
+                    None, self.store.write_range, offset + off, data)
+            else:
+                view[off:off + len(data)] = data
+
+        async def fetch_loop(src) -> None:
+            while not inflight.failed:
+                if len(inflight.have) >= total_chunks or src["dead"]:
+                    return
+                try:
+                    item = pending.popleft()
+                except IndexError:
+                    if state["active"] == 0:
+                        return  # done, or every remaining chunk is lost
+                    await asyncio.sleep(0.02)
+                    continue
+                off, n = item
+                if off // chunk in inflight.have:
+                    continue  # already landed via the shm fast path
+                state["active"] += 1
+                got = [0]
+
+                def sink(payload, _off=off, _got=got):
+                    # runs synchronously at frame arrival: the chunk
+                    # goes from the socket buffer straight into the
+                    # arena — no intermediate bytes object
+                    if alive["ok"] and not inflight.failed:
+                        view[_off:_off + len(payload)] = payload
+                        _got[0] = len(payload)
+
+                try:
+                    reply = await src["conn"].call(
+                        "object_pull_chunk",
+                        {"object_id": oid.binary(), "offset": off,
+                         "n": n}, timeout=chunk_timeout, sink=sink)
+                    if got[0] != n:
+                        # no OOB payload: a partial holder / fallback
+                        # path served plain bytes (or dropped the object)
+                        if reply is None or len(reply) != n:
+                            raise IOError(
+                                "holder dropped object mid-transfer")
+                        await write_chunk(off, reply)
+                except (rpc.ConnectionLost, rpc.RpcError,
+                        asyncio.TimeoutError, OSError):
+                    # mid-transfer failover: the chunk goes back on the
+                    # shared queue for the surviving sources; this
+                    # source serves no further chunks
+                    pending.append(item)
+                    src["dead"] = True
+                    return
+                finally:
+                    state["active"] -= 1
+                inflight.mark(off // chunk)
+
+        async def pump(src) -> None:
+            n = min(window, total_chunks)
+            # return_exceptions: one crashing fetcher must not strand
+            # its siblings mid-write while cleanup deletes the object
+            for res in await asyncio.gather(
+                    *(fetch_loop(src) for _ in range(n)),
+                    return_exceptions=True):
+                if isinstance(res, BaseException):
+                    logger.exception("pull fetcher failed for %s",
+                                     oid.hex()[:12], exc_info=res)
+                    inflight.fail()
+
+        # same-host fast path: the holder's arena file is visible on
+        # this machine (virtual clusters / multi-raylet hosts) — copy
+        # arena-to-arena instead of paying the socket stack.  The
+        # source pin taken at pull_start guards the range either way.
+        shm_src = None
+        if getattr(config, "object_transfer_shm_fastpath", True):
+            for s in sources:
+                meta = s.get("meta") or {}
+                path = meta.get("store_path")
+                if not s["partial"] and path and path != self.store.path \
+                        and "offset" in meta and os.path.exists(path):
+                    shm_src = s
+                    break
+        try:
+            if shm_src is not None:
+                try:
+                    await self._pull_via_shm(shm_src, size, offset,
+                                             inflight, chunk)
+                except Exception:  # noqa: BLE001 — any shm failure
+                    logger.exception(  # falls back to the socket path
+                        "shm fast-path pull of %s failed; falling back "
+                        "to network transfer", oid.hex()[:12])
+            if len(inflight.have) < total_chunks:
+                await asyncio.gather(*(pump(src) for src in sources))
+        finally:
+            alive["ok"] = False
+            ok = len(inflight.have) >= total_chunks and not inflight.failed
+            # seal BEFORE popping the inflight entry (no await between):
+            # a chained puller must always find the copy either inflight
+            # or sealed — the source releases below can take seconds and
+            # previously left a neither-state window that broke chains
+            if ok:
+                self.store.seal(oid)
+            self._inflight_pulls.pop(oid, None)
+            if not ok:
+                inflight.fail()
+                self.store.delete(oid)
+            await self._release_sources(oid, sources)
+        if not ok:
+            if registered_partial:
+                await self._retract_partial(oid, owner_conn)
+            return False
+        elapsed = time.monotonic() - t_start
+        log = logger.info if size >= (64 << 20) else logger.debug
+        log("pulled %s (%d MiB) in %.2fs via %s from %d source(s)",
+            oid.hex()[:12], size >> 20, elapsed,
+            "shm" if shm_src is not None else "net", len(sources))
+        # secondary copy: not pinned, evictable.  Register it with the
+        # owner so later pullers stripe across it and the owner's free
+        # fan-out reaches this node.
+        if owner_conn is not None:
+            try:
+                await owner_conn.call("object_location_added", {
+                    "object_id": oid.binary(),
+                    "node": list(self.server.address),
+                    "partial": False}, timeout=5.0)
+            except (rpc.ConnectionLost, rpc.RpcError,
+                    asyncio.TimeoutError):
+                pass
+        return True
+
+    def _release_late_probe(self, oid: ObjectID):
+        """Done-callback for a probe that outlived the two-phase wait:
+        if it did reach its holder, hand the pin straight back."""
+        def _cb(task):
+            src = None if task.cancelled() else task.result()
+            if src is None or self._closing:
+                return
+            asyncio.ensure_future(self._release_sources(oid, [src]))
+        return _cb
+
+    def _peer_arena(self, path: str, capacity: int) -> list:
+        """Cached mapping of a same-host peer raylet's arena as a
+        ``[mmap, base_addr, export, refcount]`` entry.  Each call also
+        sweeps mappings whose backing file is gone (a dead peer's
+        unlinked arena would otherwise stay pinned in tmpfs until this
+        raylet stops); in-use entries (refcount > 0) are spared."""
+        for stale in [p for p, e in self._peer_arenas.items()
+                      if e[3] == 0 and not os.path.exists(p)]:
+            ent = self._peer_arenas.pop(stale)
+            ent[2] = None  # drop the export before unmapping
+            try:
+                ent[0].close()
+            except BufferError:
+                pass
+        ent = self._peer_arenas.get(path)
+        if ent is None:
+            from ray_tpu.core.object_store import map_arena
+
+            mm, base, export = map_arena(path, capacity)
+            ent = self._peer_arenas[path] = [mm, base, export, 0]
+        return ent
+
+    async def _pull_via_shm(self, src, size: int, dest_offset: int,
+                            inflight: _InflightPull, chunk: int) -> None:
+        """Copy the object straight out of a same-host holder's arena:
+        chunked GIL-releasing memmoves in the executor, with per-chunk
+        progress marks so partial-location chaining still works."""
+        meta = src["meta"]
+        ent = self._peer_arena(meta["store_path"], meta["capacity"])
+        base = ent[1]
+        src_off = meta["offset"]
+        loop = asyncio.get_running_loop()
+        ent[3] += 1  # hold the mapping against the stale sweep
+        try:
+            pos = 0
+            while pos < size and not inflight.failed:
+                n = min(chunk, size - pos)
+                await loop.run_in_executor(
+                    None, self.store.copy_in, dest_offset + pos,
+                    base + src_off + pos, n)
+                inflight.mark(pos // chunk)
+                pos += n
+        finally:
+            ent[3] -= 1
+
+    async def _release_sources(self, oid: ObjectID, sources) -> None:
+        """Best-effort pull_end on every source — a dead holder's pins
+        are reclaimed by its disconnect cleanup instead (a raising
+        ``finally`` here used to mask the transfer's real error)."""
+        for src in sources:
+            conn = src["conn"]
+            if conn.closed:
+                continue
+            try:
+                await conn.call("object_pull_end",
+                                {"object_id": oid.binary()}, timeout=5.0)
+            except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError,
+                    OSError):
+                pass
+
+    async def _retract_partial(self, oid: ObjectID,
+                               owner_conn: Optional[rpc.Connection]) -> None:
+        if owner_conn is None or owner_conn.closed:
+            return
+        try:
+            await owner_conn.call("object_location_removed", {
+                "object_id": oid.binary(),
+                "node": list(self.server.address),
+                "partial": True}, timeout=5.0)
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+            pass
 
     async def handle_object_pull_start(self, conn, data):
+        # failpoint: the transfer source fails at serve start (chaos)
+        await _fp.afailpoint("raylet.pull_start.serve")
         oid = ObjectID(data["object_id"])
         lease = self.store.lease(oid)
         if lease is None:
             if oid in self._spilled and self._restore_from_spill(oid):
                 lease = self.store.lease(oid)
-            if lease is None:
-                return None
-        conn.context.setdefault("pull_leases", set()).add(oid)
-        return {"size": lease[1]}
+        if lease is not None:
+            leases = conn.context.setdefault("pull_leases", set())
+            if oid in leases:
+                # duplicate start on this link: keep a single pin so
+                # pull_end / disconnect cleanup stays balanced
+                self.store.release(oid)
+            else:
+                leases.add(oid)
+            # cache {offset,size} for the whole transfer: chunk serving
+            # then reads straight from the arena without re-taking the
+            # store lease per chunk (the pin above keeps it valid)
+            conn.context.setdefault("pull_offsets", {})[oid] = lease
+            # arena coordinates let a same-host puller copy through
+            # shared memory instead of the socket (the pin still
+            # guards the range until pull_end)
+            return {"size": lease[1], "offset": lease[0],
+                    "store_path": self.store.path,
+                    "capacity": self.store_capacity}
+        inflight = self._inflight_pulls.get(oid)
+        if inflight is not None and not inflight.failed:
+            # in-progress copy: serve as a *partial* source — chunk
+            # requests wait (bounded) for this node's own transfer to
+            # produce the range (wait-and-chain broadcast)
+            return {"size": inflight.size, "partial": True}
+        return None
 
     async def handle_object_pull_chunk(self, conn, data):
         oid = ObjectID(data["object_id"])
+        start = data["offset"]
+        n = data["n"]
+        # failpoint: the source dies mid-transfer (chaos: striped pulls
+        # must fail over to the surviving sources)
+        if _fp.active():
+            await _fp.afailpoint("raylet.pull_chunk.serve")
+        if start < 0 or n <= 0:
+            return None
+        entry = (conn.context.get("pull_offsets") or {}).get(oid)
+        if entry is not None:
+            offset, size = entry
+            if start + n <= size:
+                # out-of-band payload: the chunk travels as raw frame
+                # bytes straight from the arena view to the socket — no
+                # bytes() copy, no pickle copy.  Safe because the
+                # pull_start pin is held and the frame is queued before
+                # this handler yields.
+                return rpc.OobPayload(
+                    {"n": n}, self.store.view(offset + start, n))
+            return None
+        inflight = self._inflight_pulls.get(oid)
+        if inflight is not None:
+            ok = await inflight.wait_range(
+                start, n,
+                getattr(self.config, "object_transfer_chunk_timeout_s",
+                        30.0))
+            # serve from the in-progress copy only while its transfer
+            # still OWNS the block (entry present and not failed): a
+            # just-sealed copy is unpinned/evictable, so the sealed
+            # case must go through the pinning lease path below
+            if ok and not inflight.failed and start + n <= inflight.size \
+                    and self._inflight_pulls.get(oid) is inflight:
+                return bytes(self.store.view(inflight.offset + start, n))
+            # fall through: the transfer may have sealed (serve from the
+            # store) or failed (lease below misses -> None)
         lease = self.store.lease(oid)
         if lease is None:
             return None
         try:
             offset, size = lease
-            start = data["offset"]
-            n = data["n"]
+            if start + n > size:
+                return None
             return bytes(self.store.view(offset + start, n))
         finally:
             self.store.release(oid)
@@ -1909,6 +2396,7 @@ class Raylet:
         leases = conn.context.get("pull_leases", set())
         if oid in leases:
             leases.discard(oid)
+            (conn.context.get("pull_offsets") or {}).pop(oid, None)
             self.store.release(oid)
         return True
 
@@ -1925,6 +2413,15 @@ class Raylet:
         """Owner-driven free: drop primaries, spill files, local copies."""
         for b in data["object_ids"]:
             oid = ObjectID(b)
+            inflight = self._inflight_pulls.get(oid)
+            if inflight is not None:
+                # freeing mid-pull: fail the transfer and let ITS
+                # cleanup delete the create once every writer stopped —
+                # deleting here would free the block under in-flight
+                # chunk writes and corrupt whatever reuses it
+                inflight.fail()
+                self._owner_of.pop(oid, None)
+                continue
             if oid in self._primary:
                 self._primary.discard(oid)
                 self.store.release(oid)

@@ -44,18 +44,45 @@ logger = logging.getLogger(__name__)
 #: schemas).  Carried on EVERY frame header (plus the registration
 #: handshakes); a mismatched frame gets a structured per-message
 #: rejection at the boundary instead of an unpickle traceback.
-PROTOCOL_VERSION = 2
+#: v3: out-of-band payload frames (KIND_OOB_FLAG + payload-length
+#: prefix in the frame body) for the object-transfer data plane.
+PROTOCOL_VERSION = 3
 
 _LEN = struct.Struct("<Q")
 #: post-length header: [1B version][8B LE msg_id][1B kind]
 _HDR = struct.Struct("<BQB")
+_PLEN = struct.Struct("<Q")
 
 KIND_REQ = 0
 KIND_REP = 1
 KIND_ERR = 2
 KIND_PUSH = 3
+#: kind-byte flag: an out-of-band payload (raw bytes, outside the
+#: pickle) is appended to the frame as [8B payload_len][pickle][payload]
+KIND_OOB_FLAG = 0x40
+KIND_MASK = 0x3F
 
 Address = Tuple[str, int]
+
+
+class OobPayload:
+    """Reply wrapper carrying a bulk buffer OUT of the pickle stream.
+
+    ``meta`` rides the pickled frame body as usual; ``payload`` (any
+    bytes-like — typically a pinned object-store arena view) is appended
+    to the frame raw.  The object-transfer data plane uses this to cut
+    per-chunk copies: the sender never pickles the chunk, and a receiver
+    that registered a ``sink`` (see :meth:`Connection.start_call`)
+    consumes it straight out of the receive buffer — one copy from
+    socket buffer to destination instead of three.  A receiver without a
+    sink gets the whole ``OobPayload`` back with ``payload`` as bytes.
+    """
+
+    __slots__ = ("meta", "payload")
+
+    def __init__(self, meta: Any, payload):
+        self.meta = meta
+        self.payload = payload
 
 
 class RpcError(Exception):
@@ -87,11 +114,15 @@ IDEMPOTENT_METHODS = frozenset({
     "debug_state", "get_metrics", "list_jobs", "get_task_events",
     "get_cluster_stats", "list_events", "object_contains", "list_workers",
     "list_objects", "stack_traces", "list_placement_groups",
+    "get_object_locations", "object_pull_chunk",
     # keyed / convergent mutations
     "register_node", "register_worker", "subscribe", "unsubscribe",
     "kv_put", "kv_del", "health_report", "actor_started",
     "object_release", "return_worker", "cancel_lease", "cancel_task",
     "report_metrics", "report_task_events", "drain_node", "reattach_job",
+    # transfer bookkeeping: pull_start re-pins idempotently (the holder
+    # keeps one pin per link), pull_end/location updates converge
+    "object_pull_end", "object_location_added", "object_location_removed",
 })
 
 
@@ -238,8 +269,18 @@ async def _ensure_coro(value):
     return value
 
 
-class _FrameProtocol(asyncio.Protocol):
-    """Length-prefixed frame parser bound to one Connection."""
+class _FrameProtocol(asyncio.BufferedProtocol):
+    """Length-prefixed frame parser bound to one Connection.
+
+    A ``BufferedProtocol``: the transport ``recv_into``s the parse
+    buffer directly, so inbound bytes are copied exactly once from the
+    socket into ``_buf`` (the default ``Protocol`` path allocates a
+    fresh bytes object per recv and we'd append it into the parse buffer
+    — two copies per byte, which dominated multi-MiB object-transfer
+    frames on slow-memcpy sandboxed hosts)."""
+
+    #: always expose at least this much writable space to recv_into
+    _MIN_READ = 256 * 1024
 
     def __init__(self, handler: Optional["Server"] = None,
                  on_close: Optional[Callable[["Connection"], None]] = None,
@@ -247,10 +288,22 @@ class _FrameProtocol(asyncio.Protocol):
         self._handler = handler
         self._on_close = on_close
         self._server_side = server_side
-        self._buf = bytearray()
+        self._buf = bytearray(self._MIN_READ)
+        self._start = 0  # parse position
+        self._end = 0    # filled position
         self.conn: Optional[Connection] = None
 
     def connection_made(self, transport) -> None:
+        # large kernel buffers: fewer (expensive) syscalls per transfer
+        # frame and less write-pause churn under windowed pulls
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            import socket as socket_mod
+            for opt in (socket_mod.SO_RCVBUF, socket_mod.SO_SNDBUF):
+                try:
+                    sock.setsockopt(socket_mod.SOL_SOCKET, opt, 4 << 20)
+                except OSError:
+                    pass
         self.conn = Connection(transport, self, handler=self._handler,
                                on_close=self._on_close)
         # only server-ACCEPTED links join server.connections / fire the
@@ -271,11 +324,44 @@ class _FrameProtocol(asyncio.Protocol):
         if self.conn is not None:
             self.conn._writable.set()
 
-    def data_received(self, data: bytes) -> None:
+    def get_buffer(self, sizehint: int) -> memoryview:
         buf = self._buf
-        buf += data
-        offset = 0
-        total = len(buf)
+        avail = len(buf) - self._end
+        if avail < self._MIN_READ:
+            if self._start:
+                # compact the consumed prefix (bounded: runs at most
+                # once per buffer-full of parsed frames)
+                n = self._end - self._start
+                buf[:n] = buf[self._start:self._end]
+                self._start = 0
+                self._end = n
+                avail = len(buf) - n
+            while avail < self._MIN_READ:
+                buf += bytes(len(buf))  # double
+                avail = len(buf) - self._end
+        return memoryview(buf)[self._end:]
+
+    def buffer_updated(self, nbytes: int) -> None:
+        self._end += nbytes
+        self._parse()
+        if self._start == self._end:
+            self._start = self._end = 0  # cheap reset, no compaction
+            if len(self._buf) > (4 << 20):
+                # shrink after a large-transfer backlog: long-lived
+                # peer links must not pin their high-water buffer
+                self._buf = bytearray(self._MIN_READ)
+        elif self._start > (1 << 20):
+            # keep long-lived partial frames anchored near the buffer
+            # head so get_buffer doesn't keep doubling
+            n = self._end - self._start
+            self._buf[:n] = self._buf[self._start:self._end]
+            self._start = 0
+            self._end = n
+
+    def _parse(self) -> None:
+        buf = self._buf
+        offset = self._start
+        total = self._end
         conn = self.conn
         while True:
             if total - offset < 8:
@@ -296,24 +382,47 @@ class _FrameProtocol(asyncio.Protocol):
                 # bytes are interpreted — a mixed-version cluster fails
                 # at the boundary with a clear error, not mid-unpickle
                 if conn is not None:
-                    conn._reject_version(msg_id, kind, version)
+                    conn._reject_version(msg_id, kind & KIND_MASK, version)
                 continue
-            try:
-                method, payload = pickle.loads(
-                    memoryview(buf)[body + _HDR.size:frame_end])
-            except Exception:
-                logger.exception("undecodable frame from %s",
+            pickle_start = body + _HDR.size
+            pickle_end = frame_end
+            oob_view = None
+            if kind & KIND_OOB_FLAG:
+                kind &= KIND_MASK
+                if frame_end - pickle_start < _PLEN.size:
+                    logger.error("runt OOB frame from %s",
                                  conn.peername if conn else "?")
-                continue
-            if conn is not None:
+                    continue
+                (oob_len,) = _PLEN.unpack_from(buf, pickle_start)
+                pickle_start += _PLEN.size
+                if oob_len > frame_end - pickle_start:
+                    logger.error("bad OOB length from %s",
+                                 conn.peername if conn else "?")
+                    continue
+                pickle_end = frame_end - oob_len
+                oob_view = memoryview(buf)[pickle_end:frame_end]
+            try:
                 try:
-                    conn._on_frame(msg_id, kind, method, payload)
+                    method, payload = pickle.loads(
+                        memoryview(buf)[pickle_start:pickle_end])
                 except Exception:
-                    # a malformed frame must skip, not fatal-error the
-                    # transport and kill every in-flight RPC on the link
-                    logger.exception("bad frame from %s", conn.peername)
-        if offset:
-            del buf[:offset]
+                    logger.exception("undecodable frame from %s",
+                                     conn.peername if conn else "?")
+                    continue
+                if conn is not None:
+                    try:
+                        conn._on_frame(msg_id, kind, method, payload,
+                                       oob_view)
+                    except Exception:
+                        # a malformed frame must skip, not fatal-error the
+                        # transport and kill every in-flight RPC on the link
+                        logger.exception("bad frame from %s", conn.peername)
+            finally:
+                if oob_view is not None:
+                    # the view must be consumed synchronously — a live
+                    # export would make buffer compaction/growth raise
+                    oob_view.release()
+        self._start = offset
 
 
 class Connection:
@@ -328,6 +437,11 @@ class Connection:
         self._on_close = on_close
         self._msg_ids = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
+        #: msg_id -> callable(memoryview) consuming a reply's OOB
+        #: payload synchronously at frame arrival (object-transfer
+        #: chunks land straight in the store arena, no intermediate
+        #: bytes object)
+        self._payload_sinks: Dict[int, Callable] = {}
         self._push_handler: Optional[Callable[[str, Any], None]] = None
         self._closed = False
         self.peername = transport.get_extra_info("peername")
@@ -378,14 +492,27 @@ class Connection:
                 fut.set_exception(RpcError(msg))
 
     def _on_frame(self, msg_id: int, kind: int, method: str,
-                  data: Any) -> None:
+                  data: Any, oob: Optional[memoryview] = None) -> None:
         if kind == KIND_REQ:
             self._loop.create_task(self._dispatch(msg_id, method, data))
         elif kind == KIND_REP:
             fut = self._pending.pop(msg_id, None)
+            sink = self._payload_sinks.pop(msg_id, None)
+            if oob is not None:
+                if sink is not None:
+                    try:
+                        sink(oob)
+                    except Exception as e:  # noqa: BLE001 — surface to
+                        if fut is not None and not fut.done():  # caller
+                            fut.set_exception(
+                                RpcError(f"payload sink failed: {e!r}"))
+                        return
+                else:
+                    data = OobPayload(data, bytes(oob))
             if fut is not None and not fut.done():
                 fut.set_result(data)
         elif kind == KIND_ERR:
+            self._payload_sinks.pop(msg_id, None)
             fut = self._pending.pop(msg_id, None)
             if fut is not None and not fut.done():
                 fut.set_exception(RpcError(data))
@@ -405,24 +532,57 @@ class Connection:
     # -- send path -------------------------------------------------------
     def _send_frame(self, msg_id: int, kind: int, method: str,
                     data: Any) -> None:
-        payload = pickle.dumps((method, data), protocol=5)
-        self._wbuf.append(_LEN.pack(_HDR.size + len(payload)))
-        self._wbuf.append(_HDR.pack(PROTOCOL_VERSION, msg_id, kind))
-        self._wbuf.append(payload)
+        oob = None
+        if isinstance(data, OobPayload):
+            oob = data.payload
+            data = data.meta
+            kind |= KIND_OOB_FLAG
+        body = pickle.dumps((method, data), protocol=5)
+        if oob is None:
+            self._wbuf.append(_LEN.pack(_HDR.size + len(body)))
+            self._wbuf.append(_HDR.pack(PROTOCOL_VERSION, msg_id, kind))
+            self._wbuf.append(body)
+        else:
+            n = len(oob)
+            self._wbuf.append(_LEN.pack(
+                _HDR.size + _PLEN.size + len(body) + n))
+            self._wbuf.append(_HDR.pack(PROTOCOL_VERSION, msg_id, kind))
+            self._wbuf.append(_PLEN.pack(n))
+            self._wbuf.append(body)
+            # appended as its own buffer: _flush_wbuf hands big items to
+            # the transport un-joined, so the bulk bytes go from their
+            # source buffer (e.g. a pinned arena view) to the socket
+            # without an intermediate copy
+            self._wbuf.append(oob)
         if not self._wflush_scheduled:
             self._wflush_scheduled = True
             self._loop.call_soon(self._flush_wbuf)
+
+    #: frames at or above this size are handed to the transport on their
+    #: own instead of being joined with neighbors: re-joining multi-MiB
+    #: object-transfer chunks copied every chunk an extra time
+    _BIG_FRAME = 1 << 20
 
     def _flush_wbuf(self) -> None:
         self._wflush_scheduled = False
         if not self._wbuf:
             return
-        buf = b"".join(self._wbuf)
-        self._wbuf.clear()
+        items, self._wbuf = self._wbuf, []
         if self._closed:
             return
+        small: list = []
         try:
-            self._transport.write(buf)
+            for item in items:
+                if len(item) >= self._BIG_FRAME:
+                    if small:
+                        self._transport.write(b"".join(small))
+                        small = []
+                    self._transport.write(item)
+                else:
+                    small.append(item)
+            if small:
+                self._transport.write(
+                    small[0] if len(small) == 1 else b"".join(small))
         except Exception:
             self._teardown()
 
@@ -442,6 +602,7 @@ class Connection:
             if not fut.done():
                 fut.set_exception(ConnectionLost())
         self._pending.clear()
+        self._payload_sinks.clear()
         # wake any drain() waiter parked on a paused transport
         self._writable.set()
         try:
@@ -486,19 +647,28 @@ class Connection:
         finally:
             self._dispatching -= 1
 
-    def start_call(self, method: str, data: Any = None) -> asyncio.Future:
+    def start_call(self, method: str, data: Any = None,
+                   sink: Optional[Callable] = None) -> asyncio.Future:
         """Queue the request frame and return the reply future.
 
         Frames are delivered in ``start_call`` order (the write buffer is
         FIFO and flushed once per loop tick), so callers that need ordered
         delivery (e.g. per-actor sequential submission) can sequence their
         ``start_call``s without waiting for replies.
+
+        ``sink``: consumes the reply's out-of-band payload (a
+        ``memoryview`` valid only for the duration of the call) the
+        moment the frame arrives; the future then resolves to the
+        reply's meta.  Replies without an OOB payload leave the sink
+        uncalled.
         """
         if self._closed:
             raise ConnectionLost()
         msg_id = next(self._msg_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
+        if sink is not None:
+            self._payload_sinks[msg_id] = sink
         if _fp.active():
             # failpoint: the request frame is lost on the wire (drop) or
             # the caller crashes at send (raise/kill); the pending
@@ -510,8 +680,9 @@ class Connection:
         return fut
 
     async def call(self, method: str, data: Any = None,
-                   timeout: Optional[float] = None) -> Any:
-        fut = self.start_call(method, data)
+                   timeout: Optional[float] = None,
+                   sink: Optional[Callable] = None) -> Any:
+        fut = self.start_call(method, data, sink=sink)
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)

@@ -353,6 +353,11 @@ class CoreWorker:
         # never resubmits) and task_id bin -> executing worker address
         self._cancel_requested: set = set()
         self._task_locations: Dict[bytes, rpc.Address] = {}
+        # owner-side object directory extension: nodes holding an
+        # IN-PROGRESS copy of an owned object (registered by pulling
+        # raylets at transfer start, promoted to a real location on
+        # seal) — lets concurrent pullers chain into a broadcast tree
+        self._partial_locations: Dict[bytes, set] = {}
         # executor side: queued-task cancels (checked at exec start),
         # currently-executing task per exec thread, and tasks whose exec
         # thread got an async KeyboardInterrupt (so the catch block can
@@ -1142,13 +1147,26 @@ class CoreWorker:
 
     def _on_object_freed(self, object_id: ObjectID, ref_info) -> None:
         self.memory_store.delete(object_id)
+        self._partial_locations.pop(object_id.binary(), None)
         if ref_info.in_plasma and not self._shutdown:
             locations = set(ref_info.locations)
             spilled_uri = getattr(ref_info, "spilled_uri", None)
             async def _free():
                 for node_addr in locations:
                     try:
-                        conn = await self._pool.get(tuple(node_addr))
+                        addr = tuple(node_addr)
+                        # local raylet: free over the SAME FIFO link the
+                        # next object_create rides, so a dropped ref's
+                        # arena block is back in this client's allocator
+                        # bucket before the next put asks for one
+                        # (put/free/put churn then reuses page-table-warm
+                        # blocks instead of carving cold slabs)
+                        if self.raylet_conn is not None \
+                                and not self.raylet_conn.closed \
+                                and addr == tuple(self.raylet_address):
+                            conn = self.raylet_conn
+                        else:
+                            conn = await self._pool.get(addr)
                         await conn.call("object_free",
                                         {"object_ids": [object_id.binary()]})
                     except Exception:
@@ -1230,7 +1248,10 @@ class CoreWorker:
             return None
         locations, spilled = self.reference_counter.get_locations(object_id)
         pending = self.task_manager.is_pending(object_id.task_id())
+        partials = self._partial_locations.get(object_id.binary())
         return {"nodes": [list(a) for a in locations],
+                "partial_nodes": [list(a) for a in partials]
+                if partials else [],
                 "spilled_on": list(spilled) if spilled else None,
                 "spilled_uri":
                     self.reference_counter.get_spilled_uri(object_id),
@@ -1241,6 +1262,46 @@ class CoreWorker:
         record it so restores survive that node's death."""
         self.reference_counter.set_spilled_uri(
             ObjectID(data["object_id"]), data["uri"])
+        return True
+
+    async def handle_object_location_added(self, conn, data):
+        """A raylet holds (or is receiving) a copy of an owned object.
+
+        ``partial=True``: the copy is mid-transfer — recorded separately
+        so pullers can chain on it without the owner ever treating it
+        as a restorable location.  ``partial=False`` promotes/records a
+        sealed copy in the reference counter (later pullers stripe
+        across it; the owner's free fan-out reaches it)."""
+        oid_bin = data["object_id"]
+        object_id = ObjectID(oid_bin)
+        node = tuple(data["node"])
+        if data.get("partial"):
+            # guard against resurrecting an already-freed ref: partials
+            # only matter while the owner still tracks the object
+            if self.reference_counter.get(object_id) is not None:
+                self._partial_locations.setdefault(oid_bin, set()).add(node)
+            return True
+        partials = self._partial_locations.get(oid_bin)
+        if partials is not None:
+            partials.discard(node)
+            if not partials:
+                del self._partial_locations[oid_bin]
+        if self.reference_counter.get(object_id) is not None:
+            self.reference_counter.add_location(object_id, node)
+        return True
+
+    async def handle_object_location_removed(self, conn, data):
+        """A transfer failed (partial retraction) or a holder dropped
+        its sealed copy."""
+        oid_bin = data["object_id"]
+        node = tuple(data["node"])
+        partials = self._partial_locations.get(oid_bin)
+        if partials is not None:
+            partials.discard(node)
+            if not partials:
+                del self._partial_locations[oid_bin]
+        if not data.get("partial"):
+            self.reference_counter.remove_location(ObjectID(oid_bin), node)
         return True
 
     async def handle_add_borrow(self, conn, data):

@@ -11,17 +11,31 @@
 // memory here — no in-shm metadata, no lock-free tricks needed, and the
 // data plane stays zero-copy.
 //
-// Allocation: first-fit over an offset-ordered free list with coalescing
-// on free; 64-byte alignment so numpy/XLA host buffers are aligned.
+// Allocation: per-client slab buckets over a global offset-ordered free
+// list.  Each client (keyed by an allocation *hint* the raylet derives
+// from the producing connection) owns a bucket of free blocks carved
+// from the arena in large slabs; blocks freed by a delete return to the
+// bucket that allocated them, so a client's next allocation lands on
+// offsets its process has already faulted in.  This is the multi-client
+// put fix: on hosts with expensive page faults (gVisor-class sandboxes
+// fault at ~0.3 GB/s vs ~5 GB/s warm) the old single free list shuffled
+// blocks between writer processes on every churn cycle, so every 64 MiB
+// put wrote through cold page-table entries.  Buckets also give the
+// finer locking: the first-fit scan runs under the bucket's (or the
+// global allocator's) own mutex, off the metadata mutex that Get/
+// Release/Seal take.  First-fit with coalescing within each list;
+// 64-byte alignment so numpy/XLA host buffers are aligned.
 // Eviction: LRU over sealed, unpinned objects (reference
 // eviction_policy.h:160), triggered on allocation failure and by an
 // explicit spill-candidate query so the raylet can spill before the store
-// is hard-full.
+// is hard-full.  When the global list cannot carve a new slab, free
+// blocks hoarded in buckets are reclaimed into the global list first.
 //
 // C ABI only (loaded via ctypes): every function is `extern "C"`, handles
 // are opaque pointers, ids are fixed 28-byte blobs.
 
 #include <algorithm>
+#include <array>
 #include <cstdint>
 #include <cstring>
 #include <list>
@@ -40,6 +54,11 @@ namespace {
 
 constexpr uint64_t kAlign = 64;
 constexpr size_t kIdSize = 28;
+// Slab granularity for per-client buckets (shrunk for small arenas so
+// buckets still engage); allocations larger than a slab go to the
+// global list directly.
+constexpr uint64_t kSlabSize = 128ull * 1024 * 1024;
+constexpr uint64_t kNumBuckets = 64;  // hints fold into this many buckets
 
 inline uint64_t AlignUp(uint64_t n) { return (n + kAlign - 1) / kAlign * kAlign; }
 
@@ -62,7 +81,16 @@ enum class ObjectState : uint8_t { kCreated, kSealed };
 struct Entry {
   uint64_t offset = 0;
   uint64_t size = 0;          // payload size requested by the client
-  uint64_t alloc_size = 0;    // aligned size actually reserved
+  uint64_t alloc_size = 0;    // aligned size actually reserved (0 while
+                              // allocation is still in flight)
+  uint32_t bucket = 0;        // owning bucket when !global_owner
+  bool global_owner = false;  // block came from the global list directly
+  bool doomed = false;        // Delete() arrived while pinned: free on
+                              // the last Release (plasma parity — a
+                              // freed-but-still-read object must not
+                              // strand its block, else churny put/free
+                              // workloads walk the arena through
+                              // ever-colder offsets)
   ObjectState state = ObjectState::kCreated;
   int64_t pin_count = 0;      // outstanding get leases (evict only at 0)
   uint64_t seq = 0;           // LRU clock value at last touch
@@ -70,11 +98,48 @@ struct Entry {
   bool in_lru = false;
 };
 
+// Offset-ordered free list with coalescing insert (shared by the global
+// list and every bucket).
+using FreeList = std::map<uint64_t, uint64_t>;  // offset -> length
+
+void CoalescingInsert(FreeList& fl, uint64_t off, uint64_t len) {
+  if (len == 0) return;
+  auto next = fl.lower_bound(off);
+  if (next != fl.begin()) {
+    auto prev = std::prev(next);
+    if (prev->first + prev->second == off) {
+      off = prev->first;
+      len += prev->second;
+      fl.erase(prev);
+    }
+  }
+  if (next != fl.end() && off + len == next->first) {
+    len += next->second;
+    fl.erase(next);
+  }
+  fl.emplace(off, len);
+}
+
+int64_t FirstFit(FreeList& fl, uint64_t need) {
+  for (auto it = fl.begin(); it != fl.end(); ++it) {
+    if (it->second >= need) {
+      uint64_t off = it->first;
+      uint64_t remaining = it->second - need;
+      fl.erase(it);
+      if (remaining > 0) fl.emplace(off + need, remaining);
+      return static_cast<int64_t>(off);
+    }
+  }
+  return -1;
+}
+
 class Store {
  public:
   Store(void* base, uint64_t capacity, int fd, std::string path)
       : base_(static_cast<unsigned char*>(base)),
         capacity_(capacity),
+        slab_(std::min(kSlabSize,
+                       std::max(kAlign, AlignUp(capacity / kNumBuckets)))),
         fd_(fd),
         path_(std::move(path)) {
     free_.emplace(0, capacity);
@@ -86,31 +151,77 @@ class Store {
   }
 
   // Returns payload offset, or -1 if full even after eviction, or -2 if
-  // the id already exists.
-  int64_t Create(const IdKey& id, uint64_t size) {
-    std::lock_guard<std::mutex> g(mu_);
-    if (table_.count(id)) return -2;
+  // the id already exists.  ``hint`` keys the allocation bucket: objects
+  // created by the same client reuse blocks that client freed before,
+  // keeping its page-table entries warm (see file header).
+  int64_t Create(const IdKey& id, uint64_t size, uint64_t hint) {
     uint64_t need = AlignUp(std::max<uint64_t>(size, 1));
-    int64_t off = AllocLocked(need);
-    if (off < 0) {
-      EvictLocked(need);
-      off = AllocLocked(need);
-      if (off < 0) return -1;
+    uint32_t b = static_cast<uint32_t>(hint % kNumBuckets);
+    {
+      // reserve the id first so a racing create of the same id fails
+      // fast instead of double-allocating
+      std::lock_guard<std::mutex> g(mu_);
+      if (table_.count(id)) return -2;
+      Entry placeholder;
+      table_.emplace(id, std::move(placeholder));
     }
-    Entry e;
+    bool global_owner = false;
+    int64_t off = TryAlloc(need, b, &global_owner);
+    if (off < 0) {
+      ReclaimBuckets();
+      off = TryAlloc(need, b, &global_owner);
+    }
+    // Evict-then-allocate is not atomic (eviction runs under mu_, the
+    // allocators under their own locks), so a concurrent Create can
+    // steal the freed space — retry a few rounds before giving up.
+    for (int attempt = 0; attempt < 3 && off < 0; ++attempt) {
+      uint64_t freed;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        freed = EvictLocked(need);
+      }
+      ReclaimBuckets();
+      off = TryAlloc(need, b, &global_owner);
+      if (off < 0 && freed == 0) break;  // nothing left to evict
+    }
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = table_.find(id);
+    if (it == table_.end()) {
+      // the placeholder was deleted while we allocated (caller bug, but
+      // must not leak the block)
+      if (off >= 0) ReturnBlock(static_cast<uint64_t>(off), need, b,
+                                global_owner);
+      return -1;
+    }
+    if (off < 0) {
+      table_.erase(it);
+      return -1;
+    }
+    Entry& e = it->second;
+    if (e.in_lru) {  // defensive: a racing Seal/Touch on the placeholder
+      lru_.erase(e.lru_it);
+      e.in_lru = false;
+    }
     e.offset = static_cast<uint64_t>(off);
     e.size = size;
     e.alloc_size = need;
+    e.bucket = b;
+    e.global_owner = global_owner;
     e.state = ObjectState::kCreated;
     used_ += need;
-    table_.emplace(id, std::move(e));
     return off;
   }
 
   bool Seal(const IdKey& id) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = table_.find(id);
-    if (it == table_.end() || it->second.state == ObjectState::kSealed) return false;
+    if (it == table_.end() || it->second.state == ObjectState::kSealed ||
+        it->second.alloc_size == 0) {
+      // alloc_size == 0: a placeholder whose Create is still
+      // allocating — sealing it would put a zero-sized entry in the
+      // LRU and let eviction free the block mid-commit
+      return false;
+    }
     it->second.state = ObjectState::kSealed;
     TouchLocked(id, it->second);
     return true;
@@ -120,7 +231,10 @@ class Store {
   bool Get(const IdKey& id, uint64_t* offset, uint64_t* size) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = table_.find(id);
-    if (it == table_.end() || it->second.state != ObjectState::kSealed) return false;
+    if (it == table_.end() || it->second.state != ObjectState::kSealed ||
+        it->second.doomed) {
+      return false;
+    }
     it->second.pin_count++;
     if (it->second.in_lru) {  // pinned objects leave the eviction queue
       lru_.erase(it->second.lru_it);
@@ -135,21 +249,34 @@ class Store {
     std::lock_guard<std::mutex> g(mu_);
     auto it = table_.find(id);
     if (it == table_.end() || it->second.pin_count <= 0) return false;
-    if (--it->second.pin_count == 0) TouchLocked(id, it->second);
+    if (--it->second.pin_count == 0) {
+      if (it->second.doomed) {
+        FreeEntryLocked(it);  // deferred Delete lands now
+      } else {
+        TouchLocked(id, it->second);
+      }
+    }
     return true;
   }
 
   bool Contains(const IdKey& id) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = table_.find(id);
-    return it != table_.end() && it->second.state == ObjectState::kSealed;
+    return it != table_.end() &&
+           it->second.state == ObjectState::kSealed && !it->second.doomed;
   }
 
-  // Abort an unsealed create or delete a sealed, unpinned object.
+  // Abort an unsealed create or delete a sealed object.  A pinned
+  // object is doomed instead: invisible to new Gets, freed when the
+  // last outstanding lease releases.
   bool Delete(const IdKey& id) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = table_.find(id);
-    if (it == table_.end() || it->second.pin_count > 0) return false;
+    if (it == table_.end()) return false;
+    if (it->second.pin_count > 0) {
+      it->second.doomed = true;
+      return false;
+    }
     FreeEntryLocked(it);
     return true;
   }
@@ -180,37 +307,69 @@ class Store {
   const std::string& path() const { return path_; }
 
  private:
-  // ---- locked helpers ----
-  int64_t AllocLocked(uint64_t need) {
-    for (auto it = free_.begin(); it != free_.end(); ++it) {
-      if (it->second >= need) {
-        uint64_t off = it->first;
-        uint64_t remaining = it->second - need;
-        free_.erase(it);
-        if (remaining > 0) free_.emplace(off + need, remaining);
-        return static_cast<int64_t>(off);
+  struct Bucket {
+    std::mutex mu;
+    FreeList free;
+  };
+
+  // ---- allocation (lock order: mu_ -> {alloc_mu_ | bucket.mu}; the
+  // allocator locks are never taken together, and never before mu_) ----
+
+  // One allocation pass: the client's bucket first (small allocations),
+  // then a fresh slab carved from the global list, then the global list
+  // directly.  No metadata lock held.
+  int64_t TryAlloc(uint64_t need, uint32_t b, bool* global_owner) {
+    if (need <= slab_) {
+      *global_owner = false;
+      {
+        std::lock_guard<std::mutex> g(buckets_[b].mu);
+        int64_t off = FirstFit(buckets_[b].free, need);
+        if (off >= 0) return off;
+      }
+      uint64_t carve = std::max(slab_, need);
+      int64_t slab = -1;
+      {
+        std::lock_guard<std::mutex> g(alloc_mu_);
+        slab = FirstFit(free_, carve);
+      }
+      if (slab >= 0) {
+        std::lock_guard<std::mutex> g(buckets_[b].mu);
+        CoalescingInsert(buckets_[b].free,
+                         static_cast<uint64_t>(slab) + need, carve - need);
+        return slab;
       }
     }
-    return -1;
+    *global_owner = true;
+    std::lock_guard<std::mutex> g(alloc_mu_);
+    return FirstFit(free_, need);
   }
 
-  void FreeBlockLocked(uint64_t off, uint64_t len) {
-    auto next = free_.lower_bound(off);
-    // coalesce with predecessor
-    if (next != free_.begin()) {
-      auto prev = std::prev(next);
-      if (prev->first + prev->second == off) {
-        off = prev->first;
-        len += prev->second;
-        free_.erase(prev);
-      }
+  void ReturnBlock(uint64_t off, uint64_t len, uint32_t b,
+                   bool global_owner) {
+    if (len == 0) return;
+    if (global_owner) {
+      std::lock_guard<std::mutex> g(alloc_mu_);
+      CoalescingInsert(free_, off, len);
+    } else {
+      std::lock_guard<std::mutex> g(buckets_[b].mu);
+      CoalescingInsert(buckets_[b].free, off, len);
     }
-    // coalesce with successor
-    if (next != free_.end() && off + len == next->first) {
-      len += next->second;
-      free_.erase(next);
+  }
+
+  // Memory-pressure slow path: drain every bucket's free blocks back
+  // into the global list so a large allocation / fresh slab can be
+  // carved.  Costs other clients their warm blocks — only called when
+  // the fast paths failed.
+  void ReclaimBuckets() {
+    std::vector<std::pair<uint64_t, uint64_t>> blocks;
+    for (auto& bucket : buckets_) {
+      std::lock_guard<std::mutex> g(bucket.mu);
+      for (auto& kv : bucket.free) blocks.emplace_back(kv.first, kv.second);
+      bucket.free.clear();
     }
-    free_.emplace(off, len);
+    if (blocks.empty()) return;
+    std::lock_guard<std::mutex> g(alloc_mu_);
+    for (auto& kv : blocks) CoalescingInsert(free_, kv.first, kv.second);
   }
 
   void TouchLocked(const IdKey& id, Entry& e) {
@@ -224,7 +383,9 @@ class Store {
   void FreeEntryLocked(std::unordered_map<IdKey, Entry, IdHash>::iterator it) {
     Entry& e = it->second;
     if (e.in_lru) lru_.erase(e.lru_it);
-    FreeBlockLocked(e.offset, e.alloc_size);
+    // alloc_size == 0: a placeholder whose allocation is still in
+    // flight (Create cleans up the block itself)
+    ReturnBlock(e.offset, e.alloc_size, e.bucket, e.global_owner);
     used_ -= e.alloc_size;
     table_.erase(it);
   }
@@ -241,16 +402,19 @@ class Store {
     return freed;
   }
 
-  std::mutex mu_;
+  std::mutex mu_;        // table_, lru_, used_, clock_
+  std::mutex alloc_mu_;  // free_ (the global, un-bucketed free list)
   unsigned char* base_;
   uint64_t capacity_;
+  uint64_t slab_;
   uint64_t used_ = 0;
   uint64_t clock_ = 0;
   int fd_;
   std::string path_;
   std::unordered_map<IdKey, Entry, IdHash> table_;
-  std::map<uint64_t, uint64_t> free_;  // offset -> length, offset-ordered
+  FreeList free_;                      // offset -> length, offset-ordered
   std::list<IdKey> lru_;               // front = oldest evictable
+  std::array<Bucket, kNumBuckets> buckets_;
 };
 
 IdKey MakeKey(const unsigned char* id) {
@@ -282,7 +446,14 @@ void* rtpu_store_create(const char* path, uint64_t capacity) {
 void rtpu_store_destroy(void* handle) { delete static_cast<Store*>(handle); }
 
 int64_t rtpu_store_put(void* handle, const unsigned char* id, uint64_t size) {
-  return static_cast<Store*>(handle)->Create(MakeKey(id), size);
+  return static_cast<Store*>(handle)->Create(MakeKey(id), size, 0);
+}
+
+// Hinted create: allocations with the same hint reuse each other's freed
+// blocks (per-client page-table warmth — see the file header).
+int64_t rtpu_store_put_hint(void* handle, const unsigned char* id,
+                            uint64_t size, uint64_t hint) {
+  return static_cast<Store*>(handle)->Create(MakeKey(id), size, hint);
 }
 
 int rtpu_store_seal(void* handle, const unsigned char* id) {

@@ -29,6 +29,8 @@ extern "C" {
 void* rtpu_store_create(const char* path, uint64_t capacity);
 void rtpu_store_destroy(void* handle);
 int64_t rtpu_store_put(void* handle, const unsigned char* id, uint64_t size);
+int64_t rtpu_store_put_hint(void* handle, const unsigned char* id,
+                            uint64_t size, uint64_t hint);
 int rtpu_store_seal(void* handle, const unsigned char* id);
 int rtpu_store_get(void* handle, const unsigned char* id, uint64_t* offset,
                    uint64_t* size);
@@ -63,8 +65,12 @@ void StoreWorker(void* store, int seed, std::atomic<long>* ops_done) {
   for (int i = 0; i < kOpsPerThread; i++) {
     FillId(id, static_cast<int>(rng() % kKeySpace));
     switch (rng() % 6) {
-      case 0: {  // create + seal
-        int64_t off = rtpu_store_put(store, id, 1024 + rng() % 4096);
+      case 0: {  // create + seal (alternating plain and hinted creates
+                 // so bucketed and global allocations race each other)
+        int64_t off = (rng() % 2)
+            ? rtpu_store_put(store, id, 1024 + rng() % 4096)
+            : rtpu_store_put_hint(store, id, 1024 + rng() % 4096,
+                                  rng() % 8);
         if (off >= 0) rtpu_store_seal(store, id);
         break;
       }
