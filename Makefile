@@ -6,7 +6,8 @@
 #   make tsan   — ThreadSanitizer build of the concurrency stress
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
-.PHONY: all native test chaos bench-transfer tsan asan sanitize clean
+.PHONY: all native test chaos bench-transfer metrics-smoke tsan asan \
+	sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -36,6 +37,12 @@ chaos: native
 # one-line JSON delta vs the newest BENCH_r*.json baseline artifact.
 bench-transfer: native
 	JAX_PLATFORMS=cpu python scripts/bench_transfer.py
+
+# Boot a mini-cluster, scrape dashboard /metrics, and diff the exported
+# ray_tpu_* series list against scripts/metrics_golden.txt (catches
+# accidental metric renames; update deliberately with --update).
+metrics-smoke: native
+	JAX_PLATFORMS=cpu python scripts/metrics_smoke.py
 
 build/store_stress_tsan: $(SAN_SRCS)
 	@mkdir -p build

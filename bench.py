@@ -681,6 +681,30 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
     return out
 
 
+def bench_telemetry_overhead() -> dict:
+    """Instrumentation tax of the telemetry hot path, measured directly:
+    one instrumented RPC pays a per-method histogram observe plus two
+    byte-counter adds.  Reported as ``telemetry_overhead`` (µs per
+    instrumented call) so BENCH_r*.json tracks the tax across PRs —
+    regressions here silently eat every row above."""
+    import timeit
+
+    from ray_tpu.core import telemetry as tm
+    from ray_tpu.util import metrics as metrics_mod
+
+    def one_call():
+        tm.add_bytes_sent(512)
+        tm.add_bytes_received(2048)
+        tm.rpc_call_observed("bench_probe", 0.003)
+
+    n = 100_000
+    one_call()  # warm the metric/tag-key caches out of the timed loop
+    elapsed = timeit.timeit(one_call, number=n)
+    tm.presample()
+    metrics_mod.flush_all()  # don't leak the probe series to any flusher
+    return {"telemetry_overhead": round(elapsed / n * 1e6, 3)}
+
+
 #: every BASELINE.md row this harness measures -> the reference number
 #: (all rows get a ``vs_ref_<row>`` ratio so LOSING rows are visible in
 #: the artifact itself, not only by cross-reading BASELINE.md)
@@ -775,6 +799,7 @@ SUMMARY_KEYS = (
     "pg_create_remove_per_sec",
     "many_tasks_per_sec_4node", "many_actors_per_sec_4node",
     "many_pgs_per_sec_4node", "broadcast_256mb_4node_s",
+    "telemetry_overhead",
     "ppo_env_steps_per_sec_inline", "ppo_env_steps_per_sec_fleet",
     "ppo_scaling_curve",
     "regressions_vs_prev", "vs_prev_round",
@@ -809,6 +834,10 @@ def main() -> None:
         details.update(bench_runtime_tasks())
         details.update(bench_cluster_scale())
         details.update(bench_rllib_ppo())
+    try:
+        details.update(bench_telemetry_overhead())
+    except Exception as e:  # noqa: BLE001 — tax probe must not kill bench
+        details["telemetry_overhead_error"] = f"{type(e).__name__}: {e}"
     annotate_vs_ref(details)
     annotate_vs_prev(details)
     result = {

@@ -148,7 +148,21 @@ class Config:
     log_to_driver: bool = True
     event_stats: bool = True
     task_events_buffer_size: int = 10000
+
+    # ---- telemetry -------------------------------------------------------
+    #: Period of the per-process metrics/span flush to the GCS (worker,
+    #: raylet, and GCS-local loops all use it).
     metrics_report_period_s: float = 5.0
+    #: Master switch for the runtime ``ray_tpu_*`` producers and span
+    #: recording (user-defined metrics still flush when off).
+    metrics_enabled: bool = True
+    #: Per-process cap on live tagsets per metric; new tagsets beyond it
+    #: are dropped with one warning (guards against unbounded tag values).
+    metrics_max_tagsets: int = 64
+    #: Per-process buffer of timeline spans awaiting flush (oldest drop).
+    telemetry_spans_buffer_size: int = 4096
+    #: GCS-side ring of transfer/RPC spans served to ``timeline()``.
+    telemetry_spans_table_size: int = 20000
 
     def apply_env_overrides(self) -> "Config":
         for f in fields(self):

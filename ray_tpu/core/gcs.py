@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import rpc
+from ray_tpu.core import telemetry as _tm
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.util import failpoint as _fp
@@ -144,8 +145,20 @@ class GcsServer:
         self._actor_lease_charges: Dict[ActorID, NodeID] = {}
         self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
         self._tasks_finished_total = 0  # monotonic (metrics counter)
+        # ring-buffer overflow accounting (satellite: silent event loss):
+        # job hex -> events evicted unread, plus burst-logging state
+        self._task_event_drops: Dict[str, int] = {}
+        self._task_event_drops_total = 0
+        self._drop_burst_started = 0.0  # 0 = not in an overflow burst
+        self._drop_burst_count = 0
         # (name, sorted-tags) -> aggregated metric record
         self._metrics: Dict[Any, Dict[str, Any]] = {}
+        # transfer / rpc-retry spans for timeline() (clock-aligned by
+        # the reporting process; see telemetry.measure_clock_offset)
+        from collections import deque as _dq
+        self._spans: "_dq" = _dq(maxlen=getattr(
+            config, "telemetry_spans_table_size", 20000))
+        self._metrics_task: Optional[asyncio.Task] = None
         # durable tables behind the pluggable TableStorage interface
         # (reference: GcsTableStorage over Redis/in-memory store clients):
         # kv, functions, jobs, the FULL actor table, and placement groups
@@ -268,6 +281,9 @@ class GcsServer:
         self._sync_task = asyncio.get_running_loop().create_task(
             self._resource_sync_loop()
         )
+        self._metrics_task = asyncio.get_running_loop().create_task(
+            self._metrics_flush_loop()
+        )
         if getattr(self.config, "event_stats", True):
             from ray_tpu.util.event_stats import HandlerStats, LoopMonitor
             self.server.handler_stats = HandlerStats()
@@ -279,9 +295,15 @@ class GcsServer:
 
     async def handle_debug_state(self, conn, data):
         """Event-loop lag + per-handler timing snapshot (parity: the
-        reference's event_stats / debug_state.txt dump)."""
+        reference's event_stats / debug_state.txt dump), plus telemetry
+        plane health (ring-buffer drops, table sizes)."""
         mon = getattr(self, "_loop_monitor", None)
-        return mon.snapshot() if mon is not None else {}
+        out = mon.snapshot() if mon is not None else {}
+        out["task_event_drops_total"] = self._task_event_drops_total
+        out["task_event_drops"] = dict(self._task_event_drops)
+        out["metrics_series"] = len(self._metrics)
+        out["spans_buffered"] = len(self._spans)
+        return out
 
     # -- versioned resource broadcast (parity: ray_syncer.h:27-60 —
     # batched, versioned snapshots of per-node resource views instead of
@@ -299,6 +321,33 @@ class GcsServer:
             "topology": info.topology,
             "load": info.load,
         }
+
+    async def _metrics_flush_loop(self) -> None:
+        """GCS-local producer half: this process's registry deltas and
+        spans fold straight into the cluster tables (no RPC hop).  In
+        the head process a co-located raylet also flushes the shared
+        registry over RPC — each delta still lands exactly once, since
+        ``flush_all`` clears what it returns."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        period = max(0.25, getattr(self.config,
+                                   "metrics_report_period_s", 5.0))
+        while True:
+            await asyncio.sleep(period)
+            if not _tm.enabled():
+                continue
+            try:
+                _tm.set_gauge(
+                    "ray_tpu_gcs_subscriber_channels",
+                    "live pubsub channels on the GCS hub",
+                    len(self.subscribers))
+                _tm.presample()
+                self._ingest_metrics(metrics_mod.flush_all())
+                spans = _tm.drain_spans("gcs")  # offset 0 by definition
+                if spans:
+                    self._spans.extend(spans)
+            except Exception:
+                logger.exception("GCS-local metrics flush failed")
 
     async def _resource_sync_loop(self) -> None:
         period = getattr(self.config, "resource_broadcast_period_s", 0.1)
@@ -318,6 +367,8 @@ class GcsServer:
     async def stop(self) -> None:
         if getattr(self, "_sync_task", None):
             self._sync_task.cancel()
+        if getattr(self, "_metrics_task", None):
+            self._metrics_task.cancel()
         if getattr(self, "_loop_monitor", None) is not None:
             self._loop_monitor.stop()
         if self._health_task:
@@ -331,11 +382,14 @@ class GcsServer:
     # pubsub hub
     # ------------------------------------------------------------------
     def publish(self, channel: str, message: Any) -> None:
+        delivered = 0
         for conn in list(self.subscribers.get(channel, ())):
             if conn.closed:
                 self.subscribers[channel].discard(conn)
             else:
                 conn.push(channel, message)
+                delivered += 1
+        _tm.gcs_published(channel, delivered)
 
     async def handle_subscribe(self, conn, data):
         channel = data["channel"]
@@ -499,6 +553,7 @@ class GcsServer:
         info.alive = False
         info.resources_available = {}
         self._node_conns.pop(node_id, None)
+        _tm.node_death()
         logger.warning("node %s dead: %s", node_id.hex()[:12], reason)
         self._mark_sync_dirty(node_id)
         self._emit_event("ERROR", "NODE_DEAD",
@@ -613,15 +668,46 @@ class GcsServer:
             1 for e in data["events"] if e.get("state") == "FINISHED")
         overflow = len(self._task_events) - self.config.task_events_buffer_size
         if overflow > 0:
+            # ring-buffer eviction is DATA LOSS for the state API —
+            # count it per job and surface it (debug_state, metrics)
+            # instead of deleting silently
+            for ev in self._task_events[:overflow]:
+                job = ev.get("job_id") or "unknown"
+                self._task_event_drops[job] = \
+                    self._task_event_drops.get(job, 0) + 1
+                _tm.task_events_dropped(job, 1)
+            self._task_event_drops_total += overflow
             del self._task_events[:overflow]
+            now = time.monotonic()
+            if not self._drop_burst_started or \
+                    now - self._drop_burst_started > 10.0:
+                # log once per overflow burst, not once per batch — a
+                # sustained storm would otherwise flood the log
+                if self._drop_burst_count:
+                    logger.warning(
+                        "previous task-event overflow burst dropped %d "
+                        "events", self._drop_burst_count)
+                logger.warning(
+                    "task-event buffer full (%d): dropping oldest events "
+                    "(per-job counts in debug_state; raise "
+                    "task_events_buffer_size to keep more)",
+                    self.config.task_events_buffer_size)
+                self._drop_burst_count = 0
+            self._drop_burst_started = now
+            self._drop_burst_count += overflow
         return True
 
     # ------------------------------------------------------------------
     # metrics aggregation (parity: MetricsAgent / OpenCensus proxy
     # collector metrics_agent.py:188,374 — here the GCS is the hub)
     # ------------------------------------------------------------------
-    async def handle_report_metrics(self, conn, data):
-        for rec in data.get("records", []):
+    def _ingest_metrics(self, records) -> None:
+        """Fold one process's flush batch into the cluster table:
+        counters/histograms accumulate, gauges replace.  ``_ts`` stamps
+        each entry so stale gauges (dead workers' last values) age out
+        of the export instead of lingering forever."""
+        now = time.monotonic()
+        for rec in records:
             key = (rec["name"], tuple(sorted(rec.get("tags", {}).items())))
             cur = self._metrics.get(key)
             if rec["type"] == "counter":
@@ -641,11 +727,46 @@ class GcsServer:
                     cur["count"] += rec["count"]
             else:
                 continue
+            cur["_ts"] = now
             self._metrics[key] = cur
+
+    #: gauges older than this stop being exported (their process is gone
+    #: or stopped flushing); cumulative series are kept forever
+    _GAUGE_STALE_S = 120.0
+
+    async def handle_report_metrics(self, conn, data):
+        self._ingest_metrics(data.get("records", []))
         return True
 
     async def handle_get_metrics(self, conn, data):
-        return list(self._metrics.values())
+        now = time.monotonic()
+        out = []
+        for key, rec in list(self._metrics.items()):
+            if rec["type"] == "gauge" and \
+                    now - rec.get("_ts", now) > self._GAUGE_STALE_S:
+                del self._metrics[key]  # dead process's last value
+                continue
+            out.append({k: v for k, v in rec.items() if k != "_ts"})
+        return out
+
+    async def handle_report_spans(self, conn, data):
+        self._spans.extend(data.get("spans", []))
+        return True
+
+    async def handle_get_spans(self, conn, data):
+        limit = (data or {}).get("limit")
+        if limit is None:
+            limit = 20000
+        if limit <= 0:  # out[-0:] would be the WHOLE table
+            return []
+        cat = (data or {}).get("cat")
+        out = [s for s in self._spans if cat is None or s.get("cat") == cat]
+        return out[-limit:]
+
+    async def handle_clock_sync(self, conn, data):
+        """Timebase for span alignment: reporters NTP-probe this and
+        correct their span timestamps onto the GCS wall clock."""
+        return {"time": time.time()}
 
     async def handle_list_jobs(self, conn, data):
         return [{"job_id": jid.hex(), **{k: v for k, v in j.items()}}
@@ -663,6 +784,8 @@ class GcsServer:
             "alive_nodes": sum(1 for n in self.nodes.values() if n.alive),
             "actors_alive": sum(1 for a in self.actors.values()
                                 if a.state == ACTOR_ALIVE),
+            "task_event_drops_total": self._task_event_drops_total,
+            "task_event_drops": dict(self._task_event_drops),
         }
 
     # ------------------------------------------------------------------

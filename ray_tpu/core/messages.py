@@ -101,6 +101,10 @@ register_schema("object_pull_end", object_id=bytes)
 register_schema("object_location_added", object_id=bytes, node=None)
 register_schema("object_location_removed", object_id=bytes, node=None)
 
+# telemetry pipeline
+register_schema("report_metrics", records=list)
+register_schema("report_spans", spans=list)
+
 # kv / functions / pubsub
 register_schema("kv_put", key=str, value=None)
 register_schema("kv_get", key=str)

@@ -86,6 +86,16 @@ def load() -> ctypes.CDLL:
                                                   ctypes.c_char_p, u64]
         lib.rtpu_store_stats.restype = None
         lib.rtpu_store_stats.argtypes = [ctypes.c_void_p, p_u64, p_u64, p_u64]
+        try:
+            # telemetry extensions (absent from a stale pre-built .so;
+            # stats_ex callers fall back to the basic stats)
+            lib.rtpu_store_stats_ex.restype = u64
+            lib.rtpu_store_stats_ex.argtypes = [ctypes.c_void_p, p_u64, u64]
+            lib.rtpu_store_bucket_used.restype = u64
+            lib.rtpu_store_bucket_used.argtypes = [ctypes.c_void_p, p_u64,
+                                                   u64]
+        except AttributeError:
+            pass
 
         f64p = ctypes.POINTER(ctypes.c_double)
         i64p = ctypes.POINTER(ctypes.c_int64)

@@ -234,6 +234,34 @@ class SharedMemoryStore:
         )
         return {"used": used.value, "capacity": cap.value, "num_objects": num.value}
 
+    #: StatsEx value layout (keep in sync with Store::StatsEx)
+    _STATS_EX_FIELDS = ("used", "capacity", "num_objects",
+                        "doomed_current", "doomed_total",
+                        "reuse_hits", "reuse_misses",
+                        "active_buckets", "bucket_free_bytes")
+
+    def stats_ex(self) -> Dict[str, int]:
+        """Arena telemetry: basic stats plus slab-bucket reuse hit/miss
+        counters, doomed-object counts, and bucket occupancy (the
+        observability half of the per-client allocator)."""
+        fn = getattr(self._lib, "rtpu_store_stats_ex", None)
+        if fn is None:
+            return self.stats()
+        out = (ctypes.c_uint64 * len(self._STATS_EX_FIELDS))()
+        n = fn(self._handle, out, len(self._STATS_EX_FIELDS))
+        return {name: out[i]
+                for i, name in enumerate(self._STATS_EX_FIELDS[:n])}
+
+    def bucket_occupancy(self) -> List[Tuple[int, int]]:
+        """Per-bucket live allocation bytes, nonzero buckets only, as
+        (bucket index, bytes) — arena occupancy by producing client."""
+        fn = getattr(self._lib, "rtpu_store_bucket_used", None)
+        if fn is None:
+            return []
+        out = (ctypes.c_uint64 * 64)()
+        n = fn(self._handle, out, 64)
+        return [(i, out[i]) for i in range(n) if out[i]]
+
     def close(self) -> None:
         if self._handle:
             self._closed = True

@@ -38,6 +38,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core import rpc
+from ray_tpu.core import telemetry as _tm
 from ray_tpu.core.config import Config
 from ray_tpu.core.exceptions import ObjectStoreFullError
 from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
@@ -404,6 +405,7 @@ class Raylet:
         self._tasks.append(loop.create_task(self._health_loop()))
         self._tasks.append(loop.create_task(self._reap_loop()))
         self._tasks.append(loop.create_task(self._log_monitor_loop()))
+        self._tasks.append(loop.create_task(self._metrics_flush_loop()))
         if self.config.memory_monitor_refresh_ms > 0 and \
                 self.config.memory_usage_threshold > 0:
             self._tasks.append(
@@ -511,6 +513,7 @@ class Raylet:
                 if self._closing:
                     break
                 self._gcs_misses = getattr(self, "_gcs_misses", 0) + 1
+                _tm.heartbeat_miss()
                 logger.warning("GCS unreachable from raylet %s (%d)",
                                self.node_id.hex()[:12], self._gcs_misses)
                 # the GCS may be RESTARTING (reference: raylets buffer
@@ -1377,6 +1380,7 @@ class Raylet:
                                      id(lease.conn)))
                 continue
             self._take(lease.resources, lease.bundle)
+            _tm.lease_granted(time.monotonic() - lease.enqueued_at)
             worker.leased = True
             worker.lease_resources = lease.resources
             worker.lease_bundle = lease.bundle
@@ -1726,13 +1730,115 @@ class Raylet:
         return out
 
     # ------------------------------------------------------------------
+    # telemetry flush (the per-raylet producer half of the metrics
+    # pipeline; parity: the per-node MetricsAgent push loop,
+    # metrics_agent.py:374)
+    # ------------------------------------------------------------------
+    def _sample_gauges(self) -> None:
+        """Point-in-time gauges refreshed right before each flush; all
+        tagged with this node so per-node series don't overwrite each
+        other in the GCS aggregation."""
+        tags = {"node": self.node_id.hex()[:12]}
+        _tm.set_gauge("ray_tpu_sched_pending_leases",
+                      "worker-lease requests queued on the raylet",
+                      len(self._pending_leases), tags)
+        _tm.set_gauge("ray_tpu_transfer_inflight_pulls",
+                      "object transfers currently being received",
+                      len(self._inflight_pulls), tags)
+        _tm.set_gauge("ray_tpu_workers_total",
+                      "worker processes registered on the node",
+                      len(self.workers), tags)
+        _tm.set_gauge("ray_tpu_workers_idle",
+                      "idle pool workers on the node",
+                      len(self._idle), tags)
+        try:
+            stats = self.store.stats_ex()
+        except Exception:  # noqa: BLE001 — stats must not kill the loop
+            stats = self.store.stats()
+        _tm.set_gauge("ray_tpu_arena_used_bytes",
+                      "object-store arena bytes allocated",
+                      stats.get("used", 0), tags)
+        _tm.set_gauge("ray_tpu_arena_num_objects",
+                      "objects resident in the arena",
+                      stats.get("num_objects", 0), tags)
+        if "reuse_hits" in stats:
+            hits = stats["reuse_hits"]
+            misses = stats.get("reuse_misses", 0)
+            rate = hits / (hits + misses) if hits + misses else 0.0
+            _tm.set_gauge("ray_tpu_arena_reuse_hit_rate",
+                          "fraction of allocations served from the "
+                          "client's warm slab bucket", rate, tags)
+            _tm.set_gauge("ray_tpu_arena_doomed_objects",
+                          "deleted-while-pinned objects awaiting their "
+                          "last release", stats.get("doomed_current", 0),
+                          tags)
+            _tm.set_gauge("ray_tpu_arena_active_buckets",
+                          "slab buckets with live allocations",
+                          stats.get("active_buckets", 0), tags)
+            _tm.set_gauge("ray_tpu_arena_bucket_free_bytes",
+                          "free bytes parked in per-client slab buckets",
+                          stats.get("bucket_free_bytes", 0), tags)
+
+    async def _metrics_flush_loop(self) -> None:
+        """Batch registry deltas + spans to the GCS metrics/span tables
+        every ``metrics_report_period_s``.  Drop-don't-block: an
+        unreachable GCS costs this window's deltas, never the loop."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        period = max(0.25, getattr(self.config,
+                                   "metrics_report_period_s", 5.0))
+        synced_conn = None  # re-probe on failure AND after a reconnect
+        source = f"raylet-{self.node_id.hex()[:12]}"
+        while not self._closing:
+            await asyncio.sleep(period)
+            if not _tm.enabled():
+                continue
+            conn = self.gcs_conn
+            if conn is None or conn.closed:
+                continue
+            if conn is not synced_conn:
+                # a restarted GCS may run on a different host clock
+                if await _tm.measure_clock_offset(conn) is not None:
+                    synced_conn = conn
+            try:
+                self._sample_gauges()
+                _tm.presample()
+                records = metrics_mod.flush_all()
+                spans = _tm.drain_spans(source)
+                if records:
+                    await conn.call("report_metrics",
+                                    {"records": records}, timeout=2.0)
+                if spans:
+                    await conn.call("report_spans", {"spans": spans},
+                                    timeout=2.0)
+            except (rpc.ConnectionLost, rpc.RpcError,
+                    asyncio.TimeoutError, OSError):
+                pass  # dropped: counters re-accumulate, gauges refresh
+            except Exception:
+                logger.exception("metrics flush iteration failed")
+
+    # ------------------------------------------------------------------
     # state API (per-node sources; parity: raylet handlers behind
     # StateDataSourceClient state_manager.py:130)
     # ------------------------------------------------------------------
     async def handle_debug_state(self, conn, data):
-        """Event-loop lag + per-handler timings (event_stats parity)."""
+        """Event-loop lag + per-handler timings (event_stats parity),
+        plus the raylet's live control/data-plane depths for the status
+        surface."""
         mon = getattr(self, "_loop_monitor", None)
-        return mon.snapshot() if mon is not None else {}
+        out = mon.snapshot() if mon is not None else {}
+        out["pending_leases"] = len(self._pending_leases)
+        out["inflight_pulls"] = len(self._inflight_pulls)
+        out["workers"] = len(self.workers)
+        out["idle_workers"] = len(self._idle)
+        out["spilled_objects"] = len(self._spilled)
+        try:
+            out["store"] = self.store.stats_ex()
+            out["store"]["bucket_occupancy"] = \
+                self.store.bucket_occupancy()
+        except Exception:  # noqa: BLE001
+            out["store"] = self.store.stats()
+        return out
 
     async def handle_stack_traces(self, conn, data):
         """All-thread stack dumps from every worker on this node
@@ -1998,6 +2104,7 @@ class Raylet:
                               True)
 
         t_start = time.monotonic()
+        t_wall = time.time()  # span timestamps are wall-clock
         # sample rather than slice when many holders exist: a prefix of
         # dead nodes (the owner never unlearns crashed holders) would
         # otherwise shadow live copies further down the list on every
@@ -2120,6 +2227,7 @@ class Raylet:
                 if off // chunk in inflight.have:
                     continue  # already landed via the shm fast path
                 state["active"] += 1
+                _tm.transfer_window_occupancy(state["active"])
                 got = [0]
 
                 def sink(payload, _off=off, _got=got):
@@ -2148,10 +2256,13 @@ class Raylet:
                     # shared queue for the surviving sources; this
                     # source serves no further chunks
                     pending.append(item)
+                    if not src["dead"]:
+                        _tm.transfer_failover()
                     src["dead"] = True
                     return
                 finally:
                     state["active"] -= 1
+                _tm.transfer_chunk("net", n)
                 inflight.mark(off // chunk)
 
         async def pump(src) -> None:
@@ -2204,11 +2315,17 @@ class Raylet:
                 inflight.fail()
                 self.store.delete(oid)
             await self._release_sources(oid, sources)
+        path = "shm" if shm_src is not None else "net"
+        elapsed = time.monotonic() - t_start
+        _tm.transfer_pull_done(ok, path, size, elapsed, len(sources))
+        _tm.record_span(
+            "transfer", f"pull:{oid.hex()[:12]}", t_wall,
+            t_wall + elapsed, bytes=size, sources=len(sources),
+            path=path, ok=ok, node=self.node_id.hex()[:12])
         if not ok:
             if registered_partial:
                 await self._retract_partial(oid, owner_conn)
             return False
-        elapsed = time.monotonic() - t_start
         log = logger.info if size >= (64 << 20) else logger.debug
         log("pulled %s (%d MiB) in %.2fs via %s from %d source(s)",
             oid.hex()[:12], size >> 20, elapsed,
@@ -2277,6 +2394,7 @@ class Raylet:
                 await loop.run_in_executor(
                     None, self.store.copy_in, dest_offset + pos,
                     base + src_off + pos, n)
+                _tm.transfer_chunk("shm", n)
                 inflight.mark(pos // chunk)
                 pos += n
         finally:
@@ -2447,7 +2565,10 @@ class Raylet:
                 "node_id": self.node_id.binary()}
 
     async def handle_store_stats(self, conn, data):
-        stats = self.store.stats()
+        try:
+            stats = self.store.stats_ex()
+        except Exception:  # noqa: BLE001 — older .so without stats_ex
+            stats = self.store.stats()
         stats["num_primary"] = len(self._primary)
         stats["num_spilled"] = len(self._spilled)
         return stats

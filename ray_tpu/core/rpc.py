@@ -32,10 +32,12 @@ import logging
 import pickle
 import random
 import struct
+import time
 from dataclasses import dataclass
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from ray_tpu.core.messages import validate as _validate_schema
+from ray_tpu.core import telemetry as _tm
 from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
@@ -114,11 +116,13 @@ IDEMPOTENT_METHODS = frozenset({
     "debug_state", "get_metrics", "list_jobs", "get_task_events",
     "get_cluster_stats", "list_events", "object_contains", "list_workers",
     "list_objects", "stack_traces", "list_placement_groups",
-    "get_object_locations", "object_pull_chunk",
+    "get_object_locations", "object_pull_chunk", "clock_sync", "get_spans",
     # keyed / convergent mutations
     "register_node", "register_worker", "subscribe", "unsubscribe",
     "kv_put", "kv_del", "health_report", "actor_started",
     "object_release", "return_worker", "cancel_lease", "cancel_task",
+    # report_spans is deliberately NOT here: its handler appends, so a
+    # retry-after-send would duplicate spans (flush loops drop instead)
     "report_metrics", "report_task_events", "drain_node", "reattach_job",
     # transfer bookkeeping: pull_start re-pins idempotently (the holder
     # keeps one pin per link), pull_end/location updates converge
@@ -223,6 +227,7 @@ async def call_with_retry(get_conn, method: str, data: Any = None, *,
 
     last_exc: Optional[BaseException] = None
     failed_conn: Optional[Connection] = None
+    chain_start = time.time()
     for attempt in range(policy.max_attempts):
         if attempt:
             if invalidate is not None:
@@ -232,6 +237,7 @@ async def call_with_retry(get_conn, method: str, data: Any = None, *,
             rem = _remaining()
             if rem is not None and rem <= delay:
                 break  # budget can't fund another attempt
+            _tm.rpc_retry(method)
             await asyncio.sleep(delay)
         raw = get_conn()
         try:
@@ -244,8 +250,8 @@ async def call_with_retry(get_conn, method: str, data: Any = None, *,
             last_exc = e  # nothing was sent: always retryable
             continue
         try:
-            return await conn.call(method, data,
-                                   timeout=_attempt_timeout())
+            result = await conn.call(method, data,
+                                     timeout=_attempt_timeout())
         except RpcDeadlineExceeded:
             raise
         except (ConnectionLost, asyncio.TimeoutError,
@@ -254,6 +260,17 @@ async def call_with_retry(get_conn, method: str, data: Any = None, *,
             failed_conn = conn
             if not idempotent:
                 raise
+            continue
+        if attempt:
+            # a chain that actually retried is a timeline-worthy anomaly
+            _tm.record_span("rpc_retry", f"rpc:{method}", chain_start,
+                            time.time(), attempts=attempt + 1,
+                            outcome="ok")
+        return result
+    _tm.rpc_deadline_exceeded(method)
+    _tm.record_span("rpc_retry", f"rpc:{method}", chain_start, time.time(),
+                    attempts=policy.max_attempts, outcome="deadline",
+                    error=f"{type(last_exc).__name__}: {last_exc}")
     raise RpcDeadlineExceeded(
         f"{method} failed after {policy.max_attempts} attempt(s)"
         + (f" within {policy.deadline_s:.1f}s" if policy.deadline_s else "")
@@ -342,6 +359,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
         return memoryview(buf)[self._end:]
 
     def buffer_updated(self, nbytes: int) -> None:
+        _tm.add_bytes_received(nbytes)
         self._end += nbytes
         self._parse()
         if self._start == self._end:
@@ -539,11 +557,13 @@ class Connection:
             kind |= KIND_OOB_FLAG
         body = pickle.dumps((method, data), protocol=5)
         if oob is None:
+            _tm.add_bytes_sent(8 + _HDR.size + len(body))
             self._wbuf.append(_LEN.pack(_HDR.size + len(body)))
             self._wbuf.append(_HDR.pack(PROTOCOL_VERSION, msg_id, kind))
             self._wbuf.append(body)
         else:
             n = len(oob)
+            _tm.add_bytes_sent(8 + _HDR.size + _PLEN.size + len(body) + n)
             self._wbuf.append(_LEN.pack(
                 _HDR.size + _PLEN.size + len(body) + n))
             self._wbuf.append(_HDR.pack(PROTOCOL_VERSION, msg_id, kind))
@@ -682,10 +702,14 @@ class Connection:
     async def call(self, method: str, data: Any = None,
                    timeout: Optional[float] = None,
                    sink: Optional[Callable] = None) -> Any:
+        t0 = self._loop.time()
         fut = self.start_call(method, data, sink=sink)
-        if timeout is None:
-            return await fut
-        return await asyncio.wait_for(fut, timeout)
+        try:
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            _tm.rpc_call_observed(method, self._loop.time() - t0)
 
     def push(self, channel: str, data: Any) -> None:
         """Fire-and-forget push (pubsub delivery, notifications)."""

@@ -1,0 +1,421 @@
+"""Runtime telemetry: hot-path metric producers, timeline spans, clock sync.
+
+Parity: the reference's ``src/ray/stats/metric_defs.cc`` (the ``ray_*``
+series every core component emits) plus the per-task profile events that
+feed ``ray timeline``.  This module is the single home of the runtime's
+``ray_tpu_*`` metric instances and of the per-process span buffer; the
+flush loops in worker/raylet/GCS drain both toward the GCS every
+``metrics_report_period_s``.
+
+Design constraints:
+
+- **Hot paths stay cheap.**  Every helper early-returns on one module
+  flag when ``metrics_enabled`` is off.  Per-method tag keys are cached
+  (one dict lookup instead of a merge+sort per call), and the two
+  per-frame byte counters are plain ints folded into real Counters only
+  at flush time (``presample``) — the io loop is single-threaded per
+  process, so unlocked increments are safe.
+- **Metrics must never hurt the runtime.**  All helpers swallow nothing:
+  they do only dict/arithmetic work that cannot raise in practice; the
+  flush loops that do I/O live with their owners and drop on failure.
+
+Span records are wall-clock (``time.time()``) pairs corrected by this
+process's offset against the GCS clock (measured by ``clock_sync``
+round trips — see ``measure_clock_offset``), so cross-host spans line
+up in one Perfetto track without per-consumer correction.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.util import metrics as _m
+
+# ---------------------------------------------------------------------------
+# enable gate
+# ---------------------------------------------------------------------------
+
+_enabled: Optional[bool] = None
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        env = os.environ.get("RAY_TPU_METRICS_ENABLED")
+        if env is not None:
+            _enabled = env.lower() in ("1", "true", "yes")
+        else:
+            try:
+                from ray_tpu.core.config import get_config
+                _enabled = bool(getattr(get_config(), "metrics_enabled",
+                                        True))
+            except Exception:  # noqa: BLE001 — config unavailable: stay on
+                _enabled = True
+    return _enabled
+
+
+def _reset_for_tests() -> None:
+    global _enabled, _clock_offset_s, _bytes_sent, _bytes_received
+    _enabled = None
+    _clock_offset_s = 0.0
+    _bytes_sent = 0
+    _bytes_received = 0
+    _spans.clear()
+
+
+# ---------------------------------------------------------------------------
+# metric instances (created lazily so importing this module costs nothing;
+# held in module globals so the weakref registry keeps them alive)
+# ---------------------------------------------------------------------------
+
+_LAT_BOUNDS = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+               0.5, 1.0, 2.5, 5.0, 10.0, 30.0]
+_OCC_BOUNDS = [1, 2, 4, 8, 16, 32]
+_MBPS_BOUNDS = [1, 5, 25, 50, 100, 250, 500, 1000, 2500, 5000]
+
+_metrics: Dict[str, _m.Metric] = {}
+_metrics_lock = threading.Lock()
+
+
+def _get_metric(name: str, factory) -> _m.Metric:
+    # double-checked: helpers run on the io loop AND submitting threads;
+    # a racing double-create would register a loser whose pending data
+    # drains as a duplicate orphan
+    m = _metrics.get(name)
+    if m is None:
+        with _metrics_lock:
+            m = _metrics.get(name)
+            if m is None:
+                m = _metrics[name] = factory()
+    return m
+
+
+def _counter(name: str, desc: str, tag_keys: Tuple[str, ...] = ()
+             ) -> _m.Counter:
+    return _get_metric(
+        name, lambda: _m.Counter(name, desc, tag_keys=tag_keys))
+
+
+def _gauge(name: str, desc: str, tag_keys: Tuple[str, ...] = ()) -> _m.Gauge:
+    return _get_metric(
+        name, lambda: _m.Gauge(name, desc, tag_keys=tag_keys))
+
+
+def _hist(name: str, desc: str, bounds, tag_keys: Tuple[str, ...] = ()
+          ) -> _m.Histogram:
+    h = _get_metric(
+        name, lambda: _m.Histogram(name, desc, boundaries=bounds,
+                                   tag_keys=tag_keys))
+    return h
+
+
+# per-method tag-key cache: method -> (("method", m),)
+_method_keys: Dict[str, Tuple] = {}
+
+
+def _mkey(method: str) -> Tuple:
+    key = _method_keys.get(method)
+    if key is None:
+        key = _method_keys[method] = (("method", method),)
+    return key
+
+
+_EMPTY_KEY: Tuple = ()
+
+# ---------------------------------------------------------------------------
+# RPC plane (core/rpc.py)
+# ---------------------------------------------------------------------------
+
+#: plain-int per-frame byte accumulators (io-loop-thread confined; folded
+#: into Counters by presample() so the per-frame cost is one integer add)
+_bytes_sent = 0
+_bytes_received = 0
+
+
+def add_bytes_sent(n: int) -> None:
+    global _bytes_sent
+    _bytes_sent += n
+
+
+def add_bytes_received(n: int) -> None:
+    global _bytes_received
+    _bytes_received += n
+
+
+def rpc_call_observed(method: str, seconds: float) -> None:
+    """Client-side wall latency of one RPC attempt."""
+    if not enabled():
+        return
+    _hist("ray_tpu_rpc_client_latency_s",
+          "client-side RPC latency per method (per attempt)",
+          _LAT_BOUNDS, ("method",)).observe_key(_mkey(method), seconds)
+
+
+def rpc_retry(method: str) -> None:
+    if not enabled():
+        return
+    _counter("ray_tpu_rpc_retries_total",
+             "RPC retry attempts (beyond the first try)",
+             ("method",)).inc_key(_mkey(method))
+
+
+def rpc_deadline_exceeded(method: str) -> None:
+    if not enabled():
+        return
+    _counter("ray_tpu_rpc_deadline_exceeded_total",
+             "retried RPC chains that ran out of deadline budget",
+             ("method",)).inc_key(_mkey(method))
+
+
+# ---------------------------------------------------------------------------
+# transfer plane (core/raylet.py)
+# ---------------------------------------------------------------------------
+
+_PATH_KEYS = {"net": (("path", "net"),), "shm": (("path", "shm"),)}
+_RESULT_KEYS = {("ok", "net"): (("path", "net"), ("result", "ok")),
+                ("ok", "shm"): (("path", "shm"), ("result", "ok")),
+                ("failed", "net"): (("path", "net"), ("result", "failed")),
+                ("failed", "shm"): (("path", "shm"), ("result", "failed"))}
+
+
+def transfer_chunk(path: str, nbytes: int) -> None:
+    """One object-transfer chunk landed (path: net|shm)."""
+    if not enabled():
+        return
+    key = _PATH_KEYS[path]
+    _counter("ray_tpu_transfer_chunks_total",
+             "object-transfer chunks received", ("path",)).inc_key(key)
+    _counter("ray_tpu_transfer_bytes_total",
+             "object-transfer bytes received", ("path",)).inc_key(
+        key, float(nbytes))
+
+
+def transfer_window_occupancy(depth: int) -> None:
+    """In-flight chunk requests at the moment a new one is issued."""
+    if not enabled():
+        return
+    _hist("ray_tpu_transfer_window_occupancy",
+          "in-flight chunk requests per pull when issuing the next",
+          _OCC_BOUNDS).observe_key(_EMPTY_KEY, depth)
+
+
+def transfer_failover() -> None:
+    if not enabled():
+        return
+    _counter("ray_tpu_transfer_failovers_total",
+             "mid-transfer source failovers (chunks re-queued to "
+             "surviving sources)").inc_key(_EMPTY_KEY)
+
+
+def transfer_pull_done(ok: bool, path: str, nbytes: int,
+                       elapsed_s: float, n_sources: int) -> None:
+    if not enabled():
+        return
+    _counter("ray_tpu_transfer_pulls_total",
+             "object pulls completed, by result and data path",
+             ("path", "result")).inc_key(
+        _RESULT_KEYS[("ok" if ok else "failed", path)])
+    if ok and elapsed_s > 0:
+        _hist("ray_tpu_transfer_throughput_mbps",
+              "per-pull transfer throughput (MB/s)",
+              _MBPS_BOUNDS).observe_key(
+            _EMPTY_KEY, nbytes / elapsed_s / 1e6)
+
+
+# ---------------------------------------------------------------------------
+# scheduler / lease plane
+# ---------------------------------------------------------------------------
+
+def lease_granted(wait_s: float) -> None:
+    """Queue-entry -> grant latency of one worker lease on the raylet."""
+    if not enabled():
+        return
+    _hist("ray_tpu_lease_grant_latency_s",
+          "worker-lease queue wait until grant on the raylet",
+          _LAT_BOUNDS).observe_key(_EMPTY_KEY, wait_s)
+
+
+def task_dispatch_latency(seconds: float) -> None:
+    """Owner-side submit -> push-to-worker latency of one task."""
+    if not enabled():
+        return
+    _hist("ray_tpu_task_dispatch_latency_s",
+          "owner-side task submit -> dispatch-to-worker latency",
+          _LAT_BOUNDS).observe_key(_EMPTY_KEY, seconds)
+
+
+# ---------------------------------------------------------------------------
+# GCS plane
+# ---------------------------------------------------------------------------
+
+_channel_keys: Dict[str, Tuple] = {}
+
+
+def gcs_published(channel: str, n_subscribers: int) -> None:
+    """One pubsub publish; ``channel`` is folded to its prefix (the part
+    before ``:``) so per-actor channels don't explode cardinality."""
+    if not enabled():
+        return
+    prefix = channel.split(":", 1)[0]
+    key = _channel_keys.get(prefix)
+    if key is None:
+        key = _channel_keys[prefix] = (("channel", prefix),)
+    _counter("ray_tpu_gcs_publish_total",
+             "GCS pubsub publishes by channel prefix",
+             ("channel",)).inc_key(key)
+    if n_subscribers:
+        _counter("ray_tpu_gcs_publish_deliveries_total",
+                 "GCS pubsub per-subscriber deliveries by channel prefix",
+                 ("channel",)).inc_key(key, float(n_subscribers))
+
+
+def heartbeat_miss() -> None:
+    """Raylet-side: one failed/timed-out health report to the GCS."""
+    if not enabled():
+        return
+    _counter("ray_tpu_gcs_heartbeat_misses_total",
+             "raylet health reports that failed or timed out"
+             ).inc_key(_EMPTY_KEY)
+
+
+def node_death() -> None:
+    if not enabled():
+        return
+    _counter("ray_tpu_gcs_node_deaths_total",
+             "nodes the GCS declared dead").inc_key(_EMPTY_KEY)
+
+
+def task_events_dropped(job_id: Optional[str], n: int) -> None:
+    if not enabled() or n <= 0:
+        return
+    job = job_id or "unknown"
+    _counter("ray_tpu_task_events_dropped_total",
+             "task events evicted from the GCS ring buffer before "
+             "any consumer read them", ("job",)).inc_key(
+        (("job", job),), float(n))
+
+
+# ---------------------------------------------------------------------------
+# gauges set by the flush loops (samplers run right before a flush)
+# ---------------------------------------------------------------------------
+
+def set_gauge(name: str, desc: str, value: float,
+              tags: Optional[Dict[str, str]] = None) -> None:
+    if not enabled():
+        return
+    keys = tuple(sorted(tags)) if tags else ()
+    _gauge(name, desc, keys).set_key(
+        tuple(sorted(tags.items())) if tags else _EMPTY_KEY, value)
+
+
+def presample() -> None:
+    """Fold the plain-int hot counters into real Counter objects; called
+    by each flush loop right before ``metrics.flush_all()``."""
+    global _bytes_sent, _bytes_received
+    if not enabled():
+        return
+    sent, _bytes_sent = _bytes_sent, 0
+    recv, _bytes_received = _bytes_received, 0
+    if sent:
+        _counter("ray_tpu_rpc_bytes_sent_total",
+                 "bytes written to RPC transports (frames incl. OOB "
+                 "payloads)").inc_key(_EMPTY_KEY, float(sent))
+    if recv:
+        _counter("ray_tpu_rpc_bytes_received_total",
+                 "bytes received from RPC transports"
+                 ).inc_key(_EMPTY_KEY, float(recv))
+
+
+# ---------------------------------------------------------------------------
+# timeline spans (chrome-trace complete events, GCS-clock aligned)
+# ---------------------------------------------------------------------------
+
+def _span_cap() -> int:
+    try:
+        from ray_tpu.core.config import get_config
+        return int(getattr(get_config(), "telemetry_spans_buffer_size",
+                           4096))
+    except Exception:  # noqa: BLE001
+        return 4096
+
+
+_spans: "deque[Dict[str, Any]]" = deque(maxlen=4096)
+_span_cap_applied = False
+_clock_offset_s = 0.0
+
+
+def spans_enabled() -> bool:
+    return enabled()
+
+
+def record_span(cat: str, name: str, start: float, end: float,
+                **args: Any) -> None:
+    """Buffer one completed span (wall-clock seconds, local clock; the
+    GCS offset is applied at drain time).  Bounded: the oldest spans
+    drop when the buffer outpaces the flush loop."""
+    if not enabled():
+        return
+    global _spans, _span_cap_applied
+    if not _span_cap_applied:
+        _span_cap_applied = True
+        cap = _span_cap()
+        if cap != _spans.maxlen:
+            _spans = deque(_spans, maxlen=cap)
+    _spans.append({"cat": cat, "name": name, "start": start, "end": end,
+                   "pid": os.getpid(), "args": args})
+
+
+def drain_spans(source: str) -> List[Dict[str, Any]]:
+    """Pop buffered spans, clock-corrected onto the GCS timebase and
+    stamped with their source process."""
+    if not _spans:
+        return []
+    off = _clock_offset_s
+    out = []
+    while _spans:
+        s = _spans.popleft()
+        s["start"] += off
+        s["end"] += off
+        s["source"] = source
+        out.append(s)
+    return out
+
+
+def set_clock_offset(offset_s: float) -> None:
+    global _clock_offset_s
+    _clock_offset_s = offset_s
+
+
+def clock_offset() -> float:
+    return _clock_offset_s
+
+
+async def measure_clock_offset(gcs_conn, probes: int = 3
+                               ) -> Optional[float]:
+    """NTP-style offset of this process's wall clock vs the GCS's:
+    ``offset = gcs_time - (t0 + t1) / 2`` over the minimum-RTT probe
+    (the tightest round trip bounds the error by rtt/2).  Stored via
+    :func:`set_clock_offset` on success; returns the measured offset,
+    or None when EVERY probe failed (previous offset kept) — callers
+    must retry later rather than treating the process as synced."""
+    best_rtt = None
+    best_off = None
+    for _ in range(probes):
+        try:
+            t0 = time.time()
+            reply = await gcs_conn.call("clock_sync", {}, timeout=5.0)
+            t1 = time.time()
+        except Exception:  # noqa: BLE001 — unreachable GCS: keep old
+            continue
+        rtt = t1 - t0
+        if best_rtt is None or rtt < best_rtt:
+            best_rtt = rtt
+            best_off = reply["time"] - (t0 + t1) / 2.0
+    if best_off is None:
+        return None
+    set_clock_offset(best_off)
+    return best_off

@@ -56,6 +56,7 @@ def _spec_dumps(obj) -> bytes:
         return cloudpickle.dumps(obj)
 
 from ray_tpu.core import rpc
+from ray_tpu.core import telemetry as _tm
 from ray_tpu.core.config import Config, get_config, set_config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -310,6 +311,8 @@ class CoreWorker:
         self.task_address: Optional[rpc.Address] = None
         self._shutdown = False
         self._task_events: List[tuple] = []  # raw task-state tuples, formatted at flush
+        # task_id bin -> submit monotonic time (dispatch-latency metric)
+        self._dispatch_ts: Dict[bytes, float] = {}
         self._lease_tpu_ids: List[int] = []
 
         # GC-driven ref releases (ObjectRef.__del__) are deferred here and
@@ -460,6 +463,8 @@ class CoreWorker:
         if self.job_id is not None:
             self._bind_driver_context()
         self._flusher = self._loop.create_task(self._task_event_flush_loop())
+        self._metrics_flusher = self._loop.create_task(
+            self._metrics_flush_loop())
         if self.config.gcs_client_reconnect_timeout_s > 0:
             # head fault tolerance: when the GCS (and, for drivers, the
             # local raylet) dies, reconnect instead of wedging — parity:
@@ -2811,18 +2816,33 @@ class CoreWorker:
             (spec.task_id, spec.function_descriptor, state,
              spec.task_type, spec.actor_id, time.time(),
              spec.attempt_number))
+        # owner-side submit -> dispatch latency: PENDING stamps, RUNNING
+        # observes; terminal states clear stamps of never-dispatched
+        # tasks (cancelled / failed in queue) so the table can't grow
+        tid_bin = spec.task_id.binary()
+        if state == "PENDING":
+            self._dispatch_ts[tid_bin] = time.monotonic()
+        else:
+            t0 = self._dispatch_ts.pop(tid_bin, None)
+            if t0 is not None and state == "RUNNING":
+                _tm.task_dispatch_latency(time.monotonic() - t0)
 
     def _format_task_events(self, batch) -> List[Dict[str, Any]]:
         wid = self.worker_id.hex()
+        job = self.job_id.hex() if self.job_id else None
+        # same GCS-clock correction the span reporters apply, so task
+        # rows and transfer/rpc spans share one timeline() timebase
+        off = _tm.clock_offset()
         return [{
             "task_id": task_id.hex(),
             "name": name,
             "state": state,
             "type": task_type.name,
             "actor_id": actor_id.hex() if actor_id else None,
-            "time": ts,
+            "time": ts + off,
             "attempt": attempt,
             "worker_id": wid,
+            "job_id": job,
         } for task_id, name, state, task_type, actor_id, ts, attempt
             in batch]
 
@@ -2837,6 +2857,58 @@ class CoreWorker:
                         {"events": self._format_task_events(batch)})
                 except (rpc.ConnectionLost, rpc.RpcError):
                     pass
+
+    def _queued_task_depth(self) -> int:
+        """Owner-side backlog: tasks waiting for a lease/dispatch plus
+        queued actor calls (the queue-depth metric)."""
+        n = sum(len(s.backlog) for s in self._lease_states.values())
+        n += sum(len(s.queue) for s in self._actor_states.values())
+        n += len(self._waiting_for_deps)
+        return n
+
+    async def _metrics_flush_loop(self) -> None:
+        """Per-process half of the metrics pipeline (parity: the
+        reference worker pushing its OpenCensus view deltas to the node
+        MetricsAgent).  Batches registry deltas + runtime spans to the
+        GCS every ``metrics_report_period_s`` with drop-don't-block
+        semantics: an unreachable GCS costs the window's deltas only."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        period = max(0.25, getattr(self.config,
+                                   "metrics_report_period_s", 5.0))
+        synced_conn = None  # re-probe on failure AND after a reconnect
+        source = f"{self.mode}-{self._worker_id_hex[:8]}"
+        wid_tags = {"wid": self._worker_id_hex[:8]}
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            if not _tm.enabled():
+                continue
+            conn = self.gcs_conn
+            if conn is None or conn.closed:
+                continue
+            if conn is not synced_conn:
+                # a restarted GCS may run on a different host clock
+                if await _tm.measure_clock_offset(conn) is not None:
+                    synced_conn = conn
+            try:
+                _tm.set_gauge("ray_tpu_task_backlog",
+                              "tasks queued owner-side awaiting "
+                              "lease/dispatch", self._queued_task_depth(),
+                              wid_tags)
+                _tm.presample()
+                records = metrics_mod.flush_all()
+                spans = _tm.drain_spans(source)
+                if records:
+                    await conn.call("report_metrics",
+                                    {"records": records}, timeout=2.0)
+                if spans:
+                    await conn.call("report_spans", {"spans": spans},
+                                    timeout=2.0)
+            except (rpc.ConnectionLost, rpc.RpcError,
+                    asyncio.TimeoutError, OSError):
+                pass  # dropped: counters re-accumulate next window
+            except Exception:
+                logger.exception("metrics flush iteration failed")
 
     # ------------------------------------------------------------------
     # task execution (worker mode)

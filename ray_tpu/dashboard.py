@@ -25,6 +25,18 @@ from aiohttp import web
 logger = logging.getLogger(__name__)
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping (exposition spec): backslash
+    first, then quote and newline — unescaped values broke scrapes for
+    any tag carrying a path, quote, or multi-line message."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _prometheus_text(records) -> str:
     lines = []
     seen_help = set()
@@ -32,10 +44,11 @@ def _prometheus_text(records) -> str:
         name = rec["name"].replace(".", "_").replace("-", "_")
         if name not in seen_help:
             if rec.get("description"):
-                lines.append(f"# HELP {name} {rec['description']}")
+                lines.append(
+                    f"# HELP {name} {_escape_help(rec['description'])}")
             lines.append(f"# TYPE {name} {rec['type']}")
             seen_help.add(name)
-        tags = ",".join(f'{k}="{v}"' for k, v in
+        tags = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in
                         sorted(rec.get("tags", {}).items()))
         label = f"{{{tags}}}" if tags else ""
         if rec["type"] == "histogram":
@@ -221,6 +234,9 @@ class Dashboard:
                     "type": "counter",
                     "description": "tasks finished (monotonic)",
                     "value": stats["tasks_finished_total"]})
+                # ring-buffer drops export as the per-job
+                # ray_tpu_task_events_dropped_total counter (GCS-side
+                # producer) — no derived duplicate here
                 store = core.raylet_call(core.raylet_address,
                                          "store_stats", {})
                 records.append({

@@ -165,9 +165,28 @@ def available_resources() -> Dict[str, float]:
     return dict(avail)
 
 
+def list_spans(cat: Optional[str] = None, limit: int = 20000
+               ) -> List[Dict[str, Any]]:
+    """Raw runtime spans (object transfers, RPC retry chains) from the
+    GCS span table; timestamps are already corrected onto the GCS
+    clock by the reporting process."""
+    return _core().gcs_call("get_spans", {"cat": cat, "limit": limit})
+
+
+def task_event_drops() -> Dict[str, Any]:
+    """Per-job counts of task events the GCS ring buffer evicted before
+    any consumer read them (0s mean the state API is lossless so far)."""
+    stats = _core().gcs_call("get_cluster_stats", {})
+    return {"total": stats.get("task_event_drops_total", 0),
+            "by_job": stats.get("task_event_drops", {})}
+
+
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Chrome-trace (``chrome://tracing`` / Perfetto) export of task
-    events (reference ``ray timeline``, profiling.h events)."""
+    events (reference ``ray timeline``, profiling.h events), merged
+    with the runtime's object-transfer and RPC-retry spans.  Span
+    sources clock-correct against the GCS before reporting, so
+    cross-host rows line up on one Perfetto timebase."""
     events = _core().gcs_call("get_task_events", {"limit": 100_000})
     # pair RUNNING -> FINISHED/FAILED per (task, attempt)
     starts: Dict[tuple, Dict[str, Any]] = {}
@@ -186,6 +205,20 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "tid": ev["task_id"][:8],
                 "args": {"state": ev["state"], "attempt": ev.get("attempt")},
             })
+    try:
+        spans = list_spans()
+    except Exception:  # noqa: BLE001 — pre-telemetry GCS: tasks only
+        spans = []
+    for span in spans:
+        trace.append({
+            "name": span.get("name", "span"), "ph": "X",
+            "cat": span.get("cat", "runtime"),
+            "ts": span["start"] * 1e6,
+            "dur": max(0.0, (span["end"] - span["start"]) * 1e6),
+            "pid": span.get("source", "runtime"),
+            "tid": span.get("cat", "runtime"),
+            "args": dict(span.get("args") or {}),
+        })
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -132,9 +132,20 @@ def cmd_stop(args) -> None:
         pass
 
 
+def _metric_total(records, name: str) -> float:
+    """Sum a metric's value across every tagset in the GCS table."""
+    return sum(r.get("value", 0) for r in records if r["name"] == name)
+
+
 def cmd_status(args) -> None:
+    """One-screen cluster snapshot: nodes/resources, per-node arena +
+    transfer/lease state (from raylet ``debug_state``), and the
+    cluster-wide telemetry counters (retries, heartbeat misses, event
+    drops) from the GCS metrics table."""
     _connect(args)
+    from ray_tpu.core.worker import global_worker
     from ray_tpu.experimental.state import api as state
+    w = global_worker()
     nodes = state.list_nodes()
     total = state.cluster_resources()
     avail = state.available_resources()
@@ -142,21 +153,64 @@ def cmd_status(args) -> None:
           f"({sum(1 for n in nodes if n['state'] == 'ALIVE')} alive)")
     for k in sorted(total):
         print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g} available")
-    # per-node reporter: cpu/mem + per-worker process stats
+    # per-node reporter (cpu/mem + workers) and runtime plane snapshot
     for n in nodes:
         stats = n.get("stats") or {}
-        if not stats:
+        if stats:
+            print(f"node {n['node_id'][:12]}: "
+                  f"cpu {stats.get('cpu_percent', 0):.0f}%  "
+                  f"mem {stats.get('mem_percent', 0):.0f}% "
+                  f"({stats.get('mem_used', 0)/2**30:.1f}/"
+                  f"{stats.get('mem_total', 0)/2**30:.1f} GiB)")
+            for wk in stats.get("workers", []):
+                kind = "actor " if wk.get("is_actor") else "worker"
+                print(f"    {kind} pid {wk['pid']:>7}  "
+                      f"cpu {wk.get('cpu_percent', 0):5.1f}%  "
+                      f"rss {wk.get('rss', 0)/2**20:8.1f} MiB")
+        if n["state"] != "ALIVE":
             continue
-        print(f"node {n['node_id'][:12]}: "
-              f"cpu {stats.get('cpu_percent', 0):.0f}%  "
-              f"mem {stats.get('mem_percent', 0):.0f}% "
-              f"({stats.get('mem_used', 0)/2**30:.1f}/"
-              f"{stats.get('mem_total', 0)/2**30:.1f} GiB)")
-        for w in stats.get("workers", []):
-            kind = "actor " if w.get("is_actor") else "worker"
-            print(f"    {kind} pid {w['pid']:>7}  "
-                  f"cpu {w.get('cpu_percent', 0):5.1f}%  "
-                  f"rss {w.get('rss', 0)/2**20:8.1f} MiB")
+        try:
+            dbg = w.raylet_call(tuple(n["address"]), "debug_state", {})
+        except Exception:  # noqa: BLE001 — raylet unreachable
+            print(f"  node {n['node_id'][:12]}: debug_state unreachable")
+            continue
+        store = dbg.get("store") or {}
+        cap = store.get("capacity", 0) or 1
+        line = (f"  arena {store.get('used', 0)/2**20:8.1f}/"
+                f"{cap/2**20:.0f} MiB  "
+                f"objects {store.get('num_objects', 0)}")
+        hits = store.get("reuse_hits", 0)
+        misses = store.get("reuse_misses", 0)
+        if hits + misses:
+            line += f"  reuse {hits / (hits + misses):.0%}"
+        if store.get("doomed_current"):
+            line += f"  doomed {store['doomed_current']}"
+        print(line)
+        print(f"  transfers inflight {dbg.get('inflight_pulls', 0)}  "
+              f"leases queued {dbg.get('pending_leases', 0)}  "
+              f"workers {dbg.get('workers', 0)} "
+              f"({dbg.get('idle_workers', 0)} idle)  "
+              f"spilled {dbg.get('spilled_objects', 0)}")
+    # cluster-wide telemetry counters (populated by the per-process
+    # flush loops; zeros just mean a quiet or freshly-booted cluster)
+    try:
+        records = w.gcs_call("get_metrics", {})
+        gcs_dbg = w.gcs_call("debug_state", {})
+    except Exception:  # noqa: BLE001
+        return
+    retries = _metric_total(records, "ray_tpu_rpc_retries_total")
+    deadlines = _metric_total(records,
+                              "ray_tpu_rpc_deadline_exceeded_total")
+    misses = _metric_total(records, "ray_tpu_gcs_heartbeat_misses_total")
+    pulls = _metric_total(records, "ray_tpu_transfer_pulls_total")
+    tbytes = _metric_total(records, "ray_tpu_transfer_bytes_total")
+    drops = gcs_dbg.get("task_event_drops_total", 0)
+    print(f"rpc: {retries:g} retries, {deadlines:g} deadline-exceeded, "
+          f"{misses:g} heartbeat misses")
+    print(f"transfers: {pulls:g} pulls, {tbytes/2**20:.1f} MiB moved")
+    if drops:
+        print(f"WARNING: {drops} task events dropped by the GCS ring "
+              f"buffer (per-job: {gcs_dbg.get('task_event_drops')})")
 
 
 def cmd_events(args) -> None:

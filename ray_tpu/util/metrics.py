@@ -5,16 +5,59 @@ classes and tag semantics, flowing through the same pipeline the C++
 runtime metrics use (``src/ray/stats/`` → node agent →
 Prometheus): here each process's registry flushes deltas to the GCS
 metrics table, and the dashboard exports Prometheus text from it.
+
+Registry lifetime: the process registry holds *weak* references, so a
+metric owned by a short-lived actor disappears from the flush payload
+when the actor drops it (previously the module-global list pinned every
+metric ever created and the flush payload grew forever).  Pending
+deltas are NOT lost on teardown: a finalizer drains them into a
+process-level orphan buffer that the next ``flush_all`` ships, so
+``Counter("x").inc()`` followed by an immediate GC still reaches the
+GCS.  ``close()`` does the same deterministically.
+
+Cardinality: each metric caps its live tagsets per process
+(``metrics_max_tagsets`` in ``core/config.py``).  Observations against
+tagsets beyond the cap are dropped with one warning per metric — an
+unbounded tag (request id, object id) would otherwise grow every flush
+payload and the GCS table without bound.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+logger = logging.getLogger(__name__)
+
 _registry_lock = threading.Lock()
-_registry: List["Metric"] = []
+_registry: List["weakref.ref[Metric]"] = []
+#: records drained from dying metrics (finalizer / close), shipped by
+#: the next flush_all
+_orphans: List[Dict[str, Any]] = []
+
+
+def _adopt_orphans(drain) -> None:
+    """weakref.finalize callback: capture a dead metric's pending
+    records.  ``drain`` closes over the metric's state dicts only —
+    never the metric itself."""
+    try:
+        records = drain()
+    except Exception:  # noqa: BLE001 — interpreter teardown
+        return
+    if records:
+        with _registry_lock:
+            _orphans.extend(records)
+
+
+def _max_tagsets() -> int:
+    try:
+        from ray_tpu.core.config import get_config
+        return int(getattr(get_config(), "metrics_max_tagsets", 64))
+    except Exception:  # noqa: BLE001 — config not importable (teardown)
+        return 64
 
 
 def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
@@ -35,12 +78,39 @@ class Metric:
         self._lock = threading.Lock()
         # per-tagset state; counters accumulate deltas since last flush
         self._values: Dict[Tuple, float] = {}
+        self._cardinality_warned = False
+        self._finalizer: Optional[weakref.finalize] = None
         with _registry_lock:
-            _registry.append(self)
+            _registry.append(weakref.ref(self))
+
+    def _arm_finalizer(self) -> None:
+        """Called at the end of each concrete __init__ (the state dicts
+        must exist): on GC, pending records drain into the orphan
+        buffer instead of vanishing."""
+        drain = self._make_drain()
+        if drain is not None:
+            self._finalizer = weakref.finalize(self, _adopt_orphans, drain)
+
+    def _make_drain(self):
+        """Return a callable producing this metric's pending records
+        from CAPTURED state only (must not reference ``self``)."""
+        return None
 
     def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
         self._default_tags = dict(tags)
         return self
+
+    def close(self) -> None:
+        """Deregister this metric from the process flush registry.
+
+        Idempotent.  Pending records are drained into the orphan buffer
+        (shipped by the next flush); observations made after close()
+        never leave the process."""
+        if self._finalizer is not None:
+            self._finalizer()  # runs at most once, even if GC races
+        with _registry_lock:
+            _registry[:] = [r for r in _registry
+                            if r() is not None and r() is not self]
 
     def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
         out = dict(self._default_tags)
@@ -52,6 +122,22 @@ class Metric:
                              f"{self.tag_keys})")
         return out
 
+    def _admit_key(self, key: Tuple, table: Dict) -> bool:
+        """Cardinality gate (caller holds self._lock): a NEW tagset past
+        the per-process cap is dropped with one warning per metric."""
+        if key in table:
+            return True
+        if len(table) < _max_tagsets():
+            return True
+        if not self._cardinality_warned:
+            self._cardinality_warned = True
+            logger.warning(
+                "metric %r exceeded %d tagsets in this process; further "
+                "new tagsets are dropped (unbounded tag values — ids, "
+                "addresses — do not belong in metric tags)",
+                self.name, _max_tagsets())
+        return False
+
     def _flush(self) -> List[Dict[str, Any]]:
         raise NotImplementedError
 
@@ -59,12 +145,36 @@ class Metric:
 class Counter(Metric):
     TYPE = "counter"
 
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._arm_finalizer()
+
+    def _make_drain(self):
+        name, typ, desc = self.name, self.TYPE, self.description
+        values, lock = self._values, self._lock
+
+        def drain():
+            with lock:
+                out = [{"name": name, "type": typ, "description": desc,
+                        "tags": dict(k), "value": v}
+                       for k, v in values.items() if v]
+                values.clear()
+            return out
+        return drain
+
     def inc(self, value: float = 1.0,
             tags: Optional[Dict[str, str]] = None) -> None:
         if value < 0:
             raise ValueError("counters only increase")
-        key = _tags_key(self._merged(tags))
+        self.inc_key(_tags_key(self._merged(tags)), value)
+
+    def inc_key(self, key: Tuple, value: float = 1.0) -> None:
+        """Hot-path increment with a precomputed tags key (skips the
+        merge/validate path — internal runtime instrumentation)."""
         with self._lock:
+            if not self._admit_key(key, self._values):
+                return
             self._values[key] = self._values.get(key, 0.0) + value
 
     def _flush(self):
@@ -80,10 +190,32 @@ class Counter(Metric):
 class Gauge(Metric):
     TYPE = "gauge"
 
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._arm_finalizer()
+
+    def _make_drain(self):
+        name, typ, desc = self.name, self.TYPE, self.description
+        values, lock = self._values, self._lock
+
+        def drain():
+            with lock:
+                out = [{"name": name, "type": typ, "description": desc,
+                        "tags": dict(k), "value": v}
+                       for k, v in values.items()]
+                values.clear()
+            return out
+        return drain
+
     def set(self, value: float,
             tags: Optional[Dict[str, str]] = None) -> None:
-        key = _tags_key(self._merged(tags))
+        self.set_key(_tags_key(self._merged(tags)), value)
+
+    def set_key(self, key: Tuple, value: float) -> None:
         with self._lock:
+            if not self._admit_key(key, self._values):
+                return
             self._values[key] = float(value)
 
     def _flush(self):
@@ -107,17 +239,43 @@ class Histogram(Metric):
         self._buckets: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
         self._counts: Dict[Tuple, int] = {}
+        self._arm_finalizer()
+
+    def _make_drain(self):
+        name, typ, desc = self.name, self.TYPE, self.description
+        boundaries = self.boundaries
+        buckets, sums = self._buckets, self._sums
+        counts, lock = self._counts, self._lock
+
+        def drain():
+            with lock:
+                out = [{"name": name, "type": typ, "description": desc,
+                        "tags": dict(k), "buckets": list(b),
+                        "boundaries": boundaries,
+                        "sum": sums.get(k, 0.0),
+                        "count": counts.get(k, 0)}
+                       for k, b in buckets.items()]
+                buckets.clear()
+                sums.clear()
+                counts.clear()
+            return out
+        return drain
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
-        key = _tags_key(self._merged(tags))
+        self.observe_key(_tags_key(self._merged(tags)), value)
+
+    def observe_key(self, key: Tuple, value: float) -> None:
+        """Hot-path observe with a precomputed tags key."""
+        from bisect import bisect_left
         with self._lock:
-            buckets = self._buckets.setdefault(
-                key, [0] * (len(self.boundaries) + 1))
-            i = 0
-            while i < len(self.boundaries) and value > self.boundaries[i]:
-                i += 1
-            buckets[i] += 1
+            if not self._admit_key(key, self._buckets):
+                return
+            buckets = self._buckets.get(key)
+            if buckets is None:
+                buckets = self._buckets[key] = \
+                    [0] * (len(self.boundaries) + 1)
+            buckets[bisect_left(self.boundaries, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._counts[key] = self._counts.get(key, 0) + 1
 
@@ -137,21 +295,34 @@ class Histogram(Metric):
 
 
 def flush_all() -> List[Dict[str, Any]]:
-    """Collect pending records from every metric in this process."""
+    """Collect pending records from every live metric in this process,
+    plus records drained from metrics that died since the last flush
+    (dead weak references are pruned as a side effect)."""
     with _registry_lock:
-        metrics = list(_registry)
-    out: List[Dict[str, Any]] = []
+        metrics = [m for m in (r() for r in _registry) if m is not None]
+        if len(metrics) != len(_registry):
+            _registry[:] = [r for r in _registry if r() is not None]
+        out: List[Dict[str, Any]] = list(_orphans)
+        _orphans.clear()
     for m in metrics:
         out.extend(m._flush())
     return out
+
+
+def registry_size() -> int:
+    with _registry_lock:
+        return sum(1 for r in _registry if r() is not None)
 
 
 _flusher_started = False
 
 
 def start_flusher(period_s: float = 5.0) -> None:
-    """Push this process's metrics to the GCS periodically (parity: the
-    per-node MetricsAgent pipeline, metrics_agent.py:374)."""
+    """Push this process's metrics to the GCS periodically.
+
+    Thread-based legacy entry point; runtime processes (worker, raylet,
+    GCS) run their own asyncio flush loops instead (see
+    ``core/telemetry.py``), which also carry runtime spans and gauges."""
     global _flusher_started
     if _flusher_started:
         return

@@ -25,9 +25,15 @@ import json
 import os
 import re
 import statistics
+import sys
 import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# runnable as `python scripts/bench_transfer.py` (make bench-transfer)
+# without an installed package or PYTHONPATH
+if HERE not in sys.path:
+    sys.path.insert(0, HERE)
 
 #: seed-era fallbacks when no BENCH_r*.json artifact parses
 FALLBACK_BASELINE = {

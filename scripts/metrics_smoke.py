@@ -1,0 +1,136 @@
+"""Metrics smoke test: boot a mini-cluster, scrape ``/metrics``, diff
+the exported series list against the checked-in golden file.
+
+Catches accidental metric renames/removals: every name in
+``scripts/metrics_golden.txt`` must appear in a fresh scrape, and every
+scraped ``ray_tpu_*`` name must be either in the golden file or in the
+TRAFFIC_DEPENDENT allowlist (series that only appear under multi-node
+traffic or failures).  A NEW runtime series therefore fails the smoke
+until the golden file is updated deliberately::
+
+    python scripts/metrics_smoke.py            # check (CI: make metrics-smoke)
+    python scripts/metrics_smoke.py --update   # regenerate the golden file
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import urllib.request
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLDEN = os.path.join(HERE, "metrics_golden.txt")
+
+# runnable as `python scripts/metrics_smoke.py` from a fresh checkout
+_ROOT = os.path.dirname(HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+#: legitimately absent from a quiet single-node boot: transfer data
+#: paths need a second node, failure counters need failures
+TRAFFIC_DEPENDENT = {
+    "ray_tpu_transfer_chunks_total",
+    "ray_tpu_transfer_bytes_total",
+    "ray_tpu_transfer_pulls_total",
+    "ray_tpu_transfer_failovers_total",
+    "ray_tpu_transfer_window_occupancy",
+    "ray_tpu_transfer_throughput_mbps",
+    "ray_tpu_rpc_retries_total",
+    "ray_tpu_rpc_deadline_exceeded_total",
+    "ray_tpu_gcs_heartbeat_misses_total",
+    "ray_tpu_gcs_node_deaths_total",
+    "ray_tpu_task_events_dropped_total",
+    "ray_tpu_arena_doomed_objects",
+}
+
+
+def scrape_series(timeout_s: float = 60.0) -> set:
+    import ray_tpu
+    from ray_tpu.dashboard import Dashboard
+
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024,
+                 _system_config={"metrics_report_period_s": 0.5})
+    try:
+        @ray_tpu.remote
+        def probe(i):
+            return i * 2
+
+        assert ray_tpu.get([probe.remote(i) for i in range(8)],
+                           timeout=120) == [i * 2 for i in range(8)]
+        ray_tpu.put(bytes(1_000_000))
+
+        dash = Dashboard(port=0)
+        url = dash.start()
+        try:
+            deadline = time.monotonic() + timeout_s
+            names: set = set()
+            stable_since = None
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(url + "/metrics",
+                                            timeout=30) as r:
+                    text = r.read().decode()
+                new = {line.split()[2] for line in text.splitlines()
+                       if line.startswith("# TYPE ")}
+                if new == names and stable_since is not None and \
+                        time.monotonic() - stable_since > 2.0 and names:
+                    break  # two quiet seconds: the flush loops caught up
+                if new != names:
+                    names = new
+                    stable_since = time.monotonic()
+                time.sleep(0.5)
+            return names
+        finally:
+            dash.stop()
+    finally:
+        ray_tpu.shutdown()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the golden file from a fresh scrape")
+    args = ap.parse_args()
+
+    names = scrape_series()
+    runtime = {n for n in names if n.startswith("ray_tpu_")}
+    if args.update:
+        with open(GOLDEN, "w") as f:
+            f.write("# Golden ray_tpu_* series exported by a quiet "
+                    "single-node boot\n# (regenerate: python "
+                    "scripts/metrics_smoke.py --update)\n")
+            for n in sorted(runtime):
+                f.write(n + "\n")
+        print(f"wrote {len(runtime)} series to {GOLDEN}")
+        return 0
+
+    try:
+        with open(GOLDEN) as f:
+            golden = {line.strip() for line in f
+                      if line.strip() and not line.startswith("#")}
+    except FileNotFoundError:
+        print(f"missing golden file {GOLDEN}; run with --update first",
+              file=sys.stderr)
+        return 2
+
+    missing = golden - names
+    unexpected = runtime - golden - TRAFFIC_DEPENDENT
+    ok = not missing and not unexpected
+    print(f"scraped {len(runtime)} ray_tpu_* series "
+          f"({len(names)} total)")
+    if missing:
+        print("MISSING (renamed or producer broken):", file=sys.stderr)
+        for n in sorted(missing):
+            print(f"  - {n}", file=sys.stderr)
+    if unexpected:
+        print("UNEXPECTED (new series? update the golden file):",
+              file=sys.stderr)
+        for n in sorted(unexpected):
+            print(f"  + {n}", file=sys.stderr)
+    print("metrics smoke:", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

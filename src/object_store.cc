@@ -36,6 +36,7 @@
 
 #include <algorithm>
 #include <array>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <list>
@@ -209,6 +210,7 @@ class Store {
     e.global_owner = global_owner;
     e.state = ObjectState::kCreated;
     used_ += need;
+    if (!global_owner) bucket_used_[b] += need;
     return off;
   }
 
@@ -274,7 +276,11 @@ class Store {
     auto it = table_.find(id);
     if (it == table_.end()) return false;
     if (it->second.pin_count > 0) {
-      it->second.doomed = true;
+      if (!it->second.doomed) {
+        it->second.doomed = true;
+        ++doomed_current_;
+        ++doomed_total_;
+      }
       return false;
     }
     FreeEntryLocked(it);
@@ -304,12 +310,60 @@ class Store {
     *num_objects = table_.size();
   }
 
+  // Extended stats for the telemetry plane.  Fills up to ``max`` values
+  // of: [used, capacity, num_objects, doomed_current, doomed_total,
+  // reuse_hits, reuse_misses, active_buckets, bucket_free_bytes];
+  // returns the count written.  Lock order: mu_ first for the metadata
+  // scalars, then each bucket's own mutex for its free list (never
+  // nested — mu_ is released before the bucket sweep).
+  uint64_t StatsEx(uint64_t* out, uint64_t max) {
+    uint64_t vals[9] = {0};
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      vals[0] = used_;
+      vals[1] = capacity_;
+      vals[2] = table_.size();
+      vals[3] = doomed_current_;
+      vals[4] = doomed_total_;
+      for (uint64_t b = 0; b < kNumBuckets; ++b)
+        if (bucket_used_[b] > 0) ++vals[7];
+    }
+    uint64_t hits = 0, misses = global_misses_.load(
+        std::memory_order_relaxed);
+    uint64_t bucket_free = 0;
+    for (auto& bucket : buckets_) {
+      hits += bucket.hits.load(std::memory_order_relaxed);
+      misses += bucket.misses.load(std::memory_order_relaxed);
+      std::lock_guard<std::mutex> g(bucket.mu);
+      for (auto& kv : bucket.free) bucket_free += kv.second;
+    }
+    vals[5] = hits;
+    vals[6] = misses;
+    vals[8] = bucket_free;
+    uint64_t n = std::min<uint64_t>(max, 9);
+    for (uint64_t i = 0; i < n; ++i) out[i] = vals[i];
+    return n;
+  }
+
+  // Per-bucket live allocation bytes (arena occupancy by client bucket);
+  // fills up to ``max`` entries, returns the count written.
+  uint64_t BucketUsed(uint64_t* out, uint64_t max) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint64_t n = std::min<uint64_t>(max, kNumBuckets);
+    for (uint64_t b = 0; b < n; ++b) out[b] = bucket_used_[b];
+    return n;
+  }
+
   const std::string& path() const { return path_; }
 
  private:
   struct Bucket {
     std::mutex mu;
     FreeList free;
+    // reuse telemetry (relaxed atomics: monotonic counters, read racily
+    // by StatsEx — exact ordering is irrelevant)
+    std::atomic<uint64_t> hits{0};
+    std::atomic<uint64_t> misses{0};
   };
 
   // ---- allocation (lock order: mu_ -> {alloc_mu_ | bucket.mu}; the
@@ -317,14 +371,21 @@ class Store {
 
   // One allocation pass: the client's bucket first (small allocations),
   // then a fresh slab carved from the global list, then the global list
-  // directly.  No metadata lock held.
+  // directly.  No metadata lock held.  Reuse telemetry: an allocation
+  // served from the bucket's existing free list is a *hit* (the client
+  // writes through page-table-warm offsets); a slab carve or global-list
+  // allocation is a *miss* (cold pages) — the hit rate is the health
+  // signal for the per-client warmth machinery.
   int64_t TryAlloc(uint64_t need, uint32_t b, bool* global_owner) {
     if (need <= slab_) {
       *global_owner = false;
       {
         std::lock_guard<std::mutex> g(buckets_[b].mu);
         int64_t off = FirstFit(buckets_[b].free, need);
-        if (off >= 0) return off;
+        if (off >= 0) {
+          buckets_[b].hits.fetch_add(1, std::memory_order_relaxed);
+          return off;
+        }
       }
       uint64_t carve = std::max(slab_, need);
       int64_t slab = -1;
@@ -334,12 +395,14 @@ class Store {
       }
       if (slab >= 0) {
         std::lock_guard<std::mutex> g(buckets_[b].mu);
+        buckets_[b].misses.fetch_add(1, std::memory_order_relaxed);
         CoalescingInsert(buckets_[b].free,
                          static_cast<uint64_t>(slab) + need, carve - need);
         return slab;
       }
     }
     *global_owner = true;
+    global_misses_.fetch_add(1, std::memory_order_relaxed);
     std::lock_guard<std::mutex> g(alloc_mu_);
     return FirstFit(free_, need);
   }
@@ -383,10 +446,13 @@ class Store {
   void FreeEntryLocked(std::unordered_map<IdKey, Entry, IdHash>::iterator it) {
     Entry& e = it->second;
     if (e.in_lru) lru_.erase(e.lru_it);
+    if (e.doomed && doomed_current_ > 0) --doomed_current_;
     // alloc_size == 0: a placeholder whose allocation is still in
     // flight (Create cleans up the block itself)
     ReturnBlock(e.offset, e.alloc_size, e.bucket, e.global_owner);
     used_ -= e.alloc_size;
+    if (!e.global_owner && e.alloc_size > 0)
+      bucket_used_[e.bucket] -= e.alloc_size;
     table_.erase(it);
   }
 
@@ -402,7 +468,8 @@ class Store {
     return freed;
   }
 
-  std::mutex mu_;        // table_, lru_, used_, clock_
+  std::mutex mu_;        // table_, lru_, used_, clock_, doomed_*,
+                         // bucket_used_
   std::mutex alloc_mu_;  // free_ (the global, un-bucketed free list)
   unsigned char* base_;
   uint64_t capacity_;
@@ -411,10 +478,14 @@ class Store {
   uint64_t clock_ = 0;
   int fd_;
   std::string path_;
+  uint64_t doomed_current_ = 0;  // deleted-while-pinned, not yet freed
+  uint64_t doomed_total_ = 0;    // monotonic
+  std::atomic<uint64_t> global_misses_{0};  // allocations > slab size
   std::unordered_map<IdKey, Entry, IdHash> table_;
   FreeList free_;                      // offset -> length, offset-ordered
   std::list<IdKey> lru_;               // front = oldest evictable
   std::array<Bucket, kNumBuckets> buckets_;
+  std::array<uint64_t, kNumBuckets> bucket_used_ = {};  // live bytes
 };
 
 IdKey MakeKey(const unsigned char* id) {
@@ -489,6 +560,17 @@ uint64_t rtpu_store_lru_candidates(void* handle, unsigned char* out,
 void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
                       uint64_t* num_objects) {
   static_cast<Store*>(handle)->Stats(used, capacity, num_objects);
+}
+
+// Extended stats (see Store::StatsEx for the value layout); returns the
+// number of values written into out (caller passes its array length).
+uint64_t rtpu_store_stats_ex(void* handle, uint64_t* out, uint64_t max) {
+  return static_cast<Store*>(handle)->StatsEx(out, max);
+}
+
+// Per-bucket live allocation bytes; returns entries written (<= 64).
+uint64_t rtpu_store_bucket_used(void* handle, uint64_t* out, uint64_t max) {
+  return static_cast<Store*>(handle)->BucketUsed(out, max);
 }
 
 }  // extern "C"
