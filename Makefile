@@ -6,8 +6,8 @@
 #   make tsan   — ThreadSanitizer build of the concurrency stress
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
-.PHONY: all native test chaos bench-transfer metrics-smoke tsan asan \
-	sanitize clean
+.PHONY: all native check test chaos bench-transfer metrics-smoke tsan \
+	asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -18,7 +18,14 @@ all: native
 native:
 	python -m ray_tpu.core.native
 
-test: native
+# Static analysis (rtpu-check): async-safety lints + registry
+# conformance over ray_tpu/ (docs/static_analysis.md).  Exits non-zero
+# on any finding that is neither inline-suppressed nor baselined;
+# output is file:line rule message.
+check:
+	python -m ray_tpu.tools.check
+
+test: native check
 	python -m pytest tests/ -q
 
 # Deterministic chaos: failpoint-injection suite + node-kill suite +

@@ -21,21 +21,41 @@ line, not a migration.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
-__all__ = ["SchemaError", "register_schema", "validate", "SCHEMAS"]
+__all__ = ["SchemaError", "Opt", "register_schema", "validate", "SCHEMAS"]
 
 
 class SchemaError(Exception):
     """A message failed boundary validation (method + field in text)."""
 
 
-#: method -> {field: expected_type_or_None}; None = presence only
-SCHEMAS: Dict[str, Dict[str, Optional[type]]] = {}
+class Opt:
+    """Marks a schema field as optional: absent or None passes; when
+    present and non-None, the wrapped type (if any) is enforced."""
+
+    __slots__ = ("type",)
+
+    def __init__(self, type_: Optional[type] = None):
+        self.type = type_
 
 
-def register_schema(method: str, **fields: Optional[type]) -> None:
+#: method -> {field: expected_type | None (presence only) | Opt(...)}
+SCHEMAS: Dict[str, Dict[str, Any]] = {}
+
+
+def register_schema(method: str, **fields: Any) -> None:
     SCHEMAS[method] = fields
+
+
+def _type_ok(value: Any, expected: type) -> bool:
+    """isinstance with JSON-ish numerics: a float field accepts an int
+    (handlers coerce with float(...)), but bool never passes for a
+    numeric field."""
+    if expected is float:
+        return isinstance(value, (int, float)) \
+            and not isinstance(value, bool)
+    return isinstance(value, expected)
 
 
 def validate(method: str, data: Any) -> None:
@@ -44,13 +64,28 @@ def validate(method: str, data: Any) -> None:
     if schema is None:
         return
     if not isinstance(data, dict):
+        # payload-free methods (pure reads like get_nodes/clock_sync)
+        # accept the conventional ``None`` body.  Methods with Opt
+        # fields still require a dict: their handlers index into the
+        # payload, so letting None through would trade this structured
+        # error for an AttributeError inside the handler.
+        if data is None and not schema:
+            return
         raise SchemaError(
             f"{method}: payload must be a dict, got {type(data).__name__}")
     for field, expected in schema.items():
+        if isinstance(expected, Opt):
+            value = data.get(field)
+            if value is not None and expected.type is not None \
+                    and not _type_ok(value, expected.type):
+                raise SchemaError(
+                    f"{method}: optional field {field!r} must be "
+                    f"{expected.type.__name__}, got {type(value).__name__}")
+            continue
         if field not in data:
             raise SchemaError(f"{method}: missing required field {field!r}")
         if expected is not None and data[field] is not None \
-                and not isinstance(data[field], expected):
+                and not _type_ok(data[field], expected):
             raise SchemaError(
                 f"{method}: field {field!r} must be "
                 f"{getattr(expected, '__name__', expected)}, got "
@@ -104,6 +139,56 @@ register_schema("object_location_removed", object_id=bytes, node=None)
 # telemetry pipeline
 register_schema("report_metrics", records=list)
 register_schema("report_spans", spans=list)
+register_schema("clock_sync")
+register_schema("get_metrics")
+register_schema("get_spans", cat=Opt(str), limit=Opt(int))
+
+# introspection / state surface (payload-free or optional-only reads)
+register_schema("ping")
+register_schema("debug_state")          # served by both GCS and raylet
+register_schema("get_nodes")
+register_schema("get_cluster_load")
+register_schema("get_cluster_stats")
+register_schema("list_jobs")
+register_schema("list_actors")
+register_schema("list_placement_groups")
+register_schema("list_workers")
+register_schema("list_events", limit=Opt(int), severity=Opt(str))
+register_schema("list_objects", limit=Opt(int))
+register_schema("get_task_events", limit=Opt(int))
+register_schema("store_info")
+register_schema("store_stats")
+register_schema("stack_trace")          # one worker's dump
+register_schema("stack_traces")         # raylet fan-out over its workers
+register_schema("kv_keys", prefix=Opt(str), namespace=Opt(str))
+
+# job / node lifecycle
+register_schema("job_finished", job_id=bytes)
+register_schema("drain_node", node_id=bytes, reason=Opt(str))
+
+# actor lifecycle (beyond registration)
+register_schema("actor_creation_failed", actor_id=bytes, reason=Opt(str))
+register_schema("get_actor", actor_id=Opt(bytes), name=Opt(str),
+                namespace=Opt(str))
+
+# pubsub fan-in
+register_schema("publish", channel=str, message=None)
+
+# placement-group internals (GCS <-> raylet two-phase commit, client poll)
+register_schema("placement_group_ready", pg_id=bytes, block_s=Opt(float))
+register_schema("prepare_bundle", pg_id=bytes, bundle_index=int,
+                resources=dict)
+register_schema("commit_bundle", pg_id=bytes, bundle_index=int)
+register_schema("return_bundle", pg_id=bytes, bundle_index=int)
+
+# object plane: owner-side directory / recovery / borrow tracking
+register_schema("reconstruct_object", object_id=bytes)
+register_schema("get_object_locations", object_id=bytes)
+register_schema("object_spilled", object_id=bytes, uri=str)
+register_schema("object_contains", object_id=bytes)
+register_schema("add_borrow", object_id=bytes, borrower=None)
+register_schema("remove_borrow", object_id=bytes, borrower=None)
+register_schema("report_task_events", events=list)
 
 # kv / functions / pubsub
 register_schema("kv_put", key=str, value=None)

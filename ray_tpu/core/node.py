@@ -84,6 +84,32 @@ def detect_topology() -> Dict[str, Any]:
     return topo
 
 
+def _write_handshake(path: str, payload: Dict[str, Any]) -> None:
+    """Write the session handshake file atomically (tmp + rename).
+    Sync on purpose: callers are async and run it in an executor so the
+    raylet/GCS loop never blocks on filesystem latency."""
+    with open(path + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(path + ".tmp", path)
+
+
+async def _publish_handshake(handshake_path: str, raylet: "Raylet",
+                             gcs_address: Tuple[str, int],
+                             raylet_address: Tuple[str, int],
+                             session_dir: str) -> None:
+    """One handshake schema for head and worker nodes — consumers
+    (connect(), the CLI) must never need to care which wrote it."""
+    await asyncio.get_running_loop().run_in_executor(
+        None, _write_handshake, handshake_path, {
+            "gcs_address": list(gcs_address),
+            "raylet_address": list(raylet_address),
+            "node_id": raylet.node_id.hex(),
+            "store_path": raylet.store.path,
+            "store_capacity": raylet.store_capacity,
+            "session_dir": session_dir,
+        })
+
+
 async def run_head(config: Config, session_dir: str,
                    resources: Optional[Dict[str, float]],
                    handshake_path: str, host: str = "127.0.0.1",
@@ -107,16 +133,8 @@ async def run_head(config: Config, session_dir: str,
     raylet_address = await raylet.start()
     _spawn_dashboard_agent(session_dir, raylet.node_id.hex(),
                            gcs_address, config, host=host)
-    with open(handshake_path + ".tmp", "w") as f:
-        json.dump({
-            "gcs_address": list(gcs_address),
-            "raylet_address": list(raylet_address),
-            "node_id": raylet.node_id.hex(),
-            "store_path": raylet.store.path,
-            "store_capacity": raylet.store_capacity,
-            "session_dir": session_dir,
-        }, f)
-    os.replace(handshake_path + ".tmp", handshake_path)
+    await _publish_handshake(handshake_path, raylet, gcs_address,
+                             raylet_address, session_dir)
     stop = asyncio.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         asyncio.get_running_loop().add_signal_handler(sig, stop.set)
@@ -138,16 +156,8 @@ async def run_node(config: Config, gcs_address: Tuple[str, int],
     raylet_address = await raylet.start()
     _spawn_dashboard_agent(session_dir, raylet.node_id.hex(),
                            gcs_address, config, host=host)
-    with open(handshake_path + ".tmp", "w") as f:
-        json.dump({
-            "gcs_address": list(gcs_address),
-            "raylet_address": list(raylet_address),
-            "node_id": raylet.node_id.hex(),
-            "store_path": raylet.store.path,
-            "store_capacity": raylet.store_capacity,
-            "session_dir": session_dir,
-        }, f)
-    os.replace(handshake_path + ".tmp", handshake_path)
+    await _publish_handshake(handshake_path, raylet, gcs_address,
+                             raylet_address, session_dir)
     stop = asyncio.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         asyncio.get_running_loop().add_signal_handler(sig, stop.set)

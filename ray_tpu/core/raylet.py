@@ -670,41 +670,61 @@ class Raylet:
             self._log_pids.pop(path, None)
             self._log_offsets.pop(path, None)
 
+    @staticmethod
+    def _scan_worker_logs(snapshot):
+        """Read new complete lines from worker log files.  Sync —
+        ``_log_monitor_loop`` runs it in an executor because a tick can
+        read up to 1 MiB per file off a cold page cache, which must not
+        stall the raylet's event loop (leases, pulls, heartbeats).
+        Takes ``[(path, pid, offset)]``; returns ``(batch, offsets)``
+        with only the offsets that advanced."""
+        batch: List[Dict[str, Any]] = []
+        offsets: Dict[str, int] = {}
+        for path, pid, offset in snapshot:
+            try:
+                size = os.path.getsize(path)
+                if size <= offset:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read(min(size - offset, 1 << 20))
+            except OSError:
+                # file vanished/unreadable mid-scan (worker reaped):
+                # skip it, keep the rest of the tick's batch
+                continue
+            # only complete lines; partial tail re-read next
+            # tick.  A single line longer than the read window
+            # would never complete — force-flush so the offset
+            # always advances.
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                if len(chunk) < (1 << 20):
+                    continue
+                cut = len(chunk) - 1
+            offsets[path] = offset + cut + 1
+            lines = chunk[:cut + 1].decode(errors="replace").splitlines()
+            if lines:
+                batch.append({"pid": pid,
+                              "is_err": path.endswith(".err"),
+                              "lines": lines})
+        return batch, offsets
+
     async def _log_monitor_loop(self) -> None:
         """Tail worker stdout/stderr files and publish new lines to the
         GCS so drivers can echo them (parity: log_monitor.py:100 ->
         pubsub -> driver '(pid=...)' prefixes)."""
+        loop = asyncio.get_running_loop()
         while not self._closing:
             await asyncio.sleep(0.5)
             try:
-                batch: List[Dict[str, Any]] = []
-                for path, pid in list(self._log_pids.items()):
-                    try:
-                        size = os.path.getsize(path)
-                    except OSError:
-                        continue
-                    offset = self._log_offsets.get(path, 0)
-                    if size <= offset:
-                        continue
-                    with open(path, "rb") as f:
-                        f.seek(offset)
-                        chunk = f.read(min(size - offset, 1 << 20))
-                    # only complete lines; partial tail re-read next
-                    # tick.  A single line longer than the read window
-                    # would never complete — force-flush so the offset
-                    # always advances.
-                    cut = chunk.rfind(b"\n")
-                    if cut < 0:
-                        if len(chunk) < (1 << 20):
-                            continue
-                        cut = len(chunk) - 1
-                    self._log_offsets[path] = offset + cut + 1
-                    lines = chunk[:cut + 1].decode(errors="replace") \
-                        .splitlines()
-                    if lines:
-                        batch.append({"pid": pid,
-                                      "is_err": path.endswith(".err"),
-                                      "lines": lines})
+                snapshot = [(path, pid, self._log_offsets.get(path, 0))
+                            for path, pid in self._log_pids.items()]
+                batch, offsets = await loop.run_in_executor(
+                    None, self._scan_worker_logs, snapshot)
+                for path, offset in offsets.items():
+                    # a worker reaped mid-scan must stay forgotten
+                    if path in self._log_pids:
+                        self._log_offsets[path] = offset
                 if batch and self.gcs_conn and not self.gcs_conn.closed:
                     await self.gcs_conn.call("publish", {
                         "channel": "worker_logs",

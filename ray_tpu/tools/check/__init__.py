@@ -1,0 +1,34 @@
+"""rtpu-check: runtime-invariant static analysis for the ray_tpu tree.
+
+The reference runtime leans on protobuf codegen, C++ type checking, and
+tsan/asan CI to keep its control plane honest.  This reproduction's
+control plane is dynamic Python on asyncio, so its invariants — never
+block the event loop, never ``await`` under a thread lock, never swallow
+cancellation, keep the RPC/failpoint/metric registries in agreement with
+the code — are enforced here instead, by a small AST analyzer with
+project-specific rules.
+
+Entry points::
+
+    python -m ray_tpu.tools.check      # or: make check
+
+Programmatic: :func:`ray_tpu.tools.check.cli.run_rules` over parsed
+:class:`~ray_tpu.tools.check.astrules.ModuleContext` objects.  Rule
+catalogue and workflow: ``docs/static_analysis.md``.
+"""
+
+from ray_tpu.tools.check.astrules import (  # noqa: F401
+    ASYNC_RULES, ModuleContext, check_async_blocking,
+    check_await_under_lock, check_cancellation_swallow, parse_module,
+)
+from ray_tpu.tools.check.cli import (  # noqa: F401
+    ALL_RULES, discover_files, main, parse_files, run_rules,
+)
+from ray_tpu.tools.check.findings import (  # noqa: F401
+    Finding, Suppressions, format_baseline, load_baseline,
+    load_baseline_comments, merge_baseline, split_new_findings,
+)
+from ray_tpu.tools.check.project import (  # noqa: F401
+    PROJECT_RULES, ProjectConfig, check_failpoint_registry,
+    check_metric_drift, check_rpc_conformance,
+)

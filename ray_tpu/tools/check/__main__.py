@@ -1,0 +1,5 @@
+import sys
+
+from ray_tpu.tools.check.cli import main
+
+sys.exit(main())

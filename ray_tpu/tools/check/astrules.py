@@ -1,0 +1,408 @@
+"""Per-file AST rules: the async-safety lints.
+
+The control plane is a single-threaded asyncio loop per process; its
+correctness invariants are invisible to generic linters because they are
+*project conventions*:
+
+* ``async-blocking`` — a blocking call (``time.sleep``, sync file or
+  socket I/O, ``subprocess.run``, ``Future.result()``,
+  ``threading.Lock.acquire``, ``Thread.join``) inside an ``async def``
+  stalls every RPC, lease, transfer and heartbeat sharing that loop.
+* ``await-under-lock`` — an ``await`` while holding a ``threading.Lock``
+  parks the coroutine mid-critical-section; any *thread* then touching
+  the lock blocks the whole loop, and a second coroutine on the same
+  loop deadlocks outright (the holder can only resume on the loop the
+  waiter is blocking).
+* ``cancellation-swallow`` — ``asyncio.CancelledError`` is BaseException
+  precisely so ``except Exception`` can't eat it; a bare ``except:`` /
+  ``except BaseException`` / explicit ``except CancelledError`` that
+  does not re-raise turns task cancellation into a silent no-op (the
+  canceller believes the task stopped; it didn't).
+
+Scope notes: nested *sync* ``def``s inside an ``async def`` are treated
+as opaque — they usually run in an executor (``build_and_spawn`` in the
+raylet) or as done-callbacks, where blocking is legal.  The receiver of
+``.acquire()`` / ``with``-items is matched against the set of symbols
+assigned ``threading.Lock()``-family objects anywhere in the module, so
+``asyncio.Lock`` usage is never confused with a thread lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ray_tpu.tools.check.findings import Finding, Suppressions
+
+__all__ = ["ModuleContext", "parse_module", "check_async_blocking",
+           "check_await_under_lock", "check_cancellation_swallow",
+           "ASYNC_RULES"]
+
+#: dotted call names that block the calling thread (the curated,
+#: project-relevant set — not an exhaustive stdlib audit)
+BLOCKING_CALLS = {
+    "time.sleep",
+    "os.system", "os.wait", "os.waitpid", "os.popen",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "subprocess.getstatusoutput", "subprocess.Popen",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen",
+    "shutil.copy", "shutil.copy2", "shutil.copyfile", "shutil.copytree",
+    "shutil.rmtree", "shutil.move",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.head", "requests.request",
+}
+
+#: blocking builtins (no module prefix)
+BLOCKING_BUILTINS = {"open", "input"}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+@dataclass
+class ModuleContext:
+    """One parsed source file plus the module-level symbol tables the
+    async rules share."""
+
+    path: str                   # repo-root-relative
+    source: str
+    tree: ast.Module
+    suppressions: Suppressions
+    #: attribute/variable names assigned threading.Lock()-family objects
+    lock_symbols: Set[str] = field(default_factory=set)
+    #: names assigned threading.Thread(...)
+    thread_symbols: Set[str] = field(default_factory=set)
+    #: import alias -> canonical module path ("sp" -> "subprocess")
+    aliases: Dict[str, str] = field(default_factory=dict)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _receiver_symbol(node: ast.AST) -> Optional[str]:
+    """``self._lock`` -> ``_lock``; ``_lock`` -> ``_lock``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def parse_module(path: str, source: str) -> ModuleContext:
+    tree = ast.parse(source, filename=path)
+    ctx = ModuleContext(path=path, source=source, tree=tree,
+                        suppressions=Suppressions(source))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    ctx.aliases[alias.asname] = alias.name
+                else:
+                    # `import a.b` binds `a`, and `a.b.f()` already
+                    # spells the full path — mapping `a` -> `a.b`
+                    # would corrupt it to `a.b.b.f`
+                    top = alias.name.split(".")[0]
+                    ctx.aliases[top] = top
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                ctx.aliases[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                and isinstance(node.value, ast.Call):
+            d = _resolve_dotted(ctx, node.value.func)
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            names = {s for t in targets
+                     if (s := _receiver_symbol(t)) is not None}
+            if d in {f"threading.{f}" for f in _LOCK_FACTORIES}:
+                ctx.lock_symbols |= names
+            elif d == "threading.Thread":
+                ctx.thread_symbols |= names
+    return ctx
+
+
+def _resolve_dotted(ctx: ModuleContext, func: ast.AST) -> Optional[str]:
+    """Dotted name of a call target with import aliases resolved, so
+    ``from time import sleep; sleep()`` still reads ``time.sleep``."""
+    d = _dotted(func)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    canon = ctx.aliases.get(head)
+    if canon is not None:
+        return f"{canon}.{rest}" if rest else canon
+    return d
+
+
+class _AsyncScopeVisitor(ast.NodeVisitor):
+    """Shared walk that tracks whether the *innermost* enclosing
+    function is async (nested sync defs and lambdas are opaque)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        self._stack: List[bool] = []
+        self._names: List[str] = []
+
+    # -- scope bookkeeping -------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter(node, False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter(node, True)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._stack.append(False)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _enter(self, node, is_async: bool) -> None:
+        self._stack.append(is_async)
+        self._names.append(node.name)
+        self.enter_function(node, is_async)
+        self.generic_visit(node)
+        self._names.pop()
+        self._stack.pop()
+
+    def enter_function(self, node, is_async: bool) -> None:
+        pass
+
+    @property
+    def in_async(self) -> bool:
+        return bool(self._stack) and self._stack[-1]
+
+    @property
+    def func_name(self) -> str:
+        return self._names[-1] if self._names else "<module>"
+
+    def emit(self, line: int, rule: str, message: str, symbol: str) -> None:
+        self.findings.append(Finding(
+            path=self.ctx.path, line=line, rule=rule, message=message,
+            symbol=f"{self.func_name}.{symbol}"))
+
+
+# ---------------------------------------------------------------------------
+# rule: async-blocking
+# ---------------------------------------------------------------------------
+
+class _BlockingVisitor(_AsyncScopeVisitor):
+    RULE = "async-blocking"
+
+    def __init__(self, ctx: ModuleContext):
+        super().__init__(ctx)
+        #: per-async-function locals bound to concurrent futures
+        self._future_locals: List[Set[str]] = []
+
+    def enter_function(self, node, is_async: bool) -> None:
+        pass  # future-locals scoping handled in _enter override below
+
+    def _enter(self, node, is_async: bool) -> None:
+        self._future_locals.append(set())
+        super()._enter(node, is_async)
+        self._future_locals.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.in_async and self._future_locals \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr in ("submit", "run_in_executor"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._future_locals[-1].add(t.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.in_async:
+            self._check_call(node)
+        self.generic_visit(node)
+
+    def _check_call(self, node: ast.Call) -> None:
+        d = _resolve_dotted(self.ctx, node.func)
+        if d in BLOCKING_CALLS or (d in BLOCKING_BUILTINS
+                                   and d not in self.ctx.aliases):
+            self.emit(node.lineno, self.RULE,
+                      f"blocking call {d}() on the event loop; use "
+                      f"loop.run_in_executor or an async equivalent", d)
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return
+        attr = node.func.attr
+        recv = node.func.value
+        if attr == "result":
+            # v = pool.submit(...); v.result()  /  x.submit(...).result()
+            blocking_future = (
+                (isinstance(recv, ast.Name) and self._future_locals
+                 and recv.id in self._future_locals[-1])
+                or (isinstance(recv, ast.Call)
+                    and isinstance(recv.func, ast.Attribute)
+                    and recv.func.attr in ("submit", "run_in_executor")))
+            if blocking_future:
+                self.emit(node.lineno, self.RULE,
+                          "Future.result() blocks the event loop; await "
+                          "the future (or asyncio.wrap_future it) instead",
+                          "Future.result")
+        elif attr == "acquire":
+            sym = _receiver_symbol(recv)
+            if sym in self.ctx.lock_symbols \
+                    and not _nonblocking_acquire(node):
+                self.emit(node.lineno, self.RULE,
+                          f"threading lock {sym}.acquire() on the event "
+                          f"loop; use asyncio.Lock or run_in_executor",
+                          f"{sym}.acquire")
+        elif attr == "join":
+            sym = _receiver_symbol(recv)
+            if sym in self.ctx.thread_symbols:
+                self.emit(node.lineno, self.RULE,
+                          f"Thread {sym}.join() blocks the event loop; "
+                          f"await an executor future instead",
+                          f"{sym}.join")
+
+
+def _nonblocking_acquire(node: ast.Call) -> bool:
+    """True for ``lock.acquire(False)`` / ``acquire(blocking=False)``."""
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and node.args[0].value is False:
+        return True
+    return any(kw.arg == "blocking" and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False for kw in node.keywords)
+
+
+def check_async_blocking(ctx: ModuleContext) -> List[Finding]:
+    v = _BlockingVisitor(ctx)
+    v.visit(ctx.tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
+# rule: await-under-lock
+# ---------------------------------------------------------------------------
+
+class _AwaitUnderLockVisitor(_AsyncScopeVisitor):
+    RULE = "await-under-lock"
+
+    def visit_With(self, node: ast.With) -> None:
+        if self.in_async:
+            for item in node.items:
+                expr = item.context_expr
+                # `with lock:` or `with lock.acquire_timeout(...)`-style
+                sym = _receiver_symbol(
+                    expr.func.value if isinstance(expr, ast.Call)
+                    and isinstance(expr.func, ast.Attribute) else expr)
+                if sym in self.ctx.lock_symbols:
+                    awaited = _first_await(node.body)
+                    if awaited is not None:
+                        self.emit(
+                            node.lineno, self.RULE,
+                            f"await at line {awaited.lineno} while "
+                            f"holding threading lock {sym}: the coroutine "
+                            f"parks mid-critical-section (cross-task "
+                            f"deadlock); release first or use "
+                            f"asyncio.Lock", sym)
+                    break
+        self.generic_visit(node)
+
+
+def _first_await(body: List[ast.stmt]) -> Optional[ast.AST]:
+    """First Await/AsyncFor/AsyncWith in ``body``, not descending into
+    nested function definitions (their awaits run later, elsewhere)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+def check_await_under_lock(ctx: ModuleContext) -> List[Finding]:
+    v = _AwaitUnderLockVisitor(ctx)
+    v.visit(ctx.tree)
+    return v.findings
+
+
+# ---------------------------------------------------------------------------
+# rule: cancellation-swallow
+# ---------------------------------------------------------------------------
+
+def _mentions(node: Optional[ast.AST], name: str) -> bool:
+    if node is None:
+        return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == name:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == name:
+            return True
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """Any ``raise`` in the handler body (nested defs excluded) counts:
+    a bare re-raise, ``raise e``, or wrapping in a typed error all keep
+    the exception moving."""
+    stack: List[ast.AST] = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+class _CancellationVisitor(_AsyncScopeVisitor):
+    RULE = "cancellation-swallow"
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        bare = node.type is None
+        base = _mentions(node.type, "BaseException")
+        cancelled = _mentions(node.type, "CancelledError")
+        if (bare or ((base or cancelled) and self.in_async)) \
+                and not _reraises(node):
+            if bare:
+                what, sym = "bare except", "bare-except"
+                hint = ("catches SystemExit/KeyboardInterrupt"
+                        + (" and asyncio.CancelledError"
+                           if self.in_async else "")
+                        + "; narrow to `except Exception`")
+            elif base:
+                what, sym = "except BaseException", "BaseException"
+                hint = ("swallows asyncio.CancelledError in async code; "
+                        "narrow to Exception or re-raise")
+            else:
+                what, sym = "except CancelledError", "CancelledError"
+                hint = ("suppresses task cancellation; clean up, then "
+                        "re-raise")
+            self.emit(node.lineno, self.RULE,
+                      f"{what} without re-raise: {hint}", sym)
+        self.generic_visit(node)
+
+
+def check_cancellation_swallow(ctx: ModuleContext) -> List[Finding]:
+    v = _CancellationVisitor(ctx)
+    v.visit(ctx.tree)
+    return v.findings
+
+
+#: rule name -> per-file checker
+ASYNC_RULES = {
+    "async-blocking": check_async_blocking,
+    "await-under-lock": check_await_under_lock,
+    "cancellation-swallow": check_cancellation_swallow,
+}

@@ -1,0 +1,182 @@
+"""rtpu-check command line: discover files, run rules, report.
+
+Usage::
+
+    python -m ray_tpu.tools.check [paths...]      # default: ray_tpu/
+    python -m ray_tpu.tools.check --list-rules
+    python -m ray_tpu.tools.check --select async-blocking,metric-drift
+    python -m ray_tpu.tools.check --update-baseline
+
+Exit status: 0 clean (every finding suppressed inline or baselined),
+1 when new findings exist, 2 on usage/internal error.  Findings print
+as ``file:line rule message`` so CI output is click-through-able.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Iterable, List, Optional
+
+from ray_tpu.tools.check.astrules import ASYNC_RULES, ModuleContext, \
+    parse_module
+from ray_tpu.tools.check.findings import Finding, Suppressions, \
+    load_baseline, merge_baseline, split_new_findings
+from ray_tpu.tools.check.project import PROJECT_RULES, ProjectConfig
+
+ALL_RULES = {**ASYNC_RULES, **PROJECT_RULES}
+
+#: default baseline location (checked in; starts empty)
+BASELINE_REL = os.path.join("ray_tpu", "tools", "check", "baseline.txt")
+
+
+def _repo_root() -> str:
+    """The directory that holds the ``ray_tpu`` package this module was
+    imported from — works from any cwd."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+
+def discover_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    seen: set = set()
+
+    def _add(fn: str) -> None:
+        # dedupe across overlapping path args (`ray_tpu ray_tpu/x.py`):
+        # a double-parsed file doubles per-file findings and makes
+        # failpoint-registry call every site a duplicate of itself
+        key = os.path.abspath(fn)
+        if key not in seen:
+            seen.add(key)
+            out.append(fn)
+
+    for p in paths:
+        if os.path.isfile(p):
+            _add(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        _add(os.path.join(dirpath, f))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def parse_files(files: Iterable[str], root: str) -> List[ModuleContext]:
+    contexts: List[ModuleContext] = []
+    for fn in files:
+        with open(fn, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(os.path.abspath(fn), root).replace(os.sep, "/")
+        contexts.append(parse_module(rel, source))
+    return contexts
+
+
+def run_rules(contexts: List[ModuleContext], cfg: ProjectConfig,
+              select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the selected rules (default: all) and drop findings covered
+    by an inline ``# rtpu-check: disable=`` comment."""
+    selected = set(select) if select is not None else set(ALL_RULES)
+    unknown = selected - set(ALL_RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    findings: List[Finding] = []
+    for name, rule in ASYNC_RULES.items():
+        if name in selected:
+            for ctx in contexts:
+                findings.extend(rule(ctx))
+    for name, rule in PROJECT_RULES.items():
+        if name in selected:
+            findings.extend(rule(contexts, cfg))
+    by_path = {ctx.path: ctx.suppressions for ctx in contexts}
+
+    def suppressions_for(path: str) -> Suppressions:
+        # cross-file rules can anchor findings at registry files (e.g.
+        # rpc.py's IDEMPOTENT_METHODS) outside the scan scope; their
+        # inline markers must still count, else the same tree passes or
+        # fails depending on which paths were passed
+        if path not in by_path:
+            by_path[path] = Suppressions(cfg.read(path) or "")
+        return by_path[path]
+
+    kept = [f for f in findings
+            if not suppressions_for(f.path).covers(f.line, f.rule)]
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+    return kept
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rtpu-check",
+        description="runtime-invariant static analysis for ray_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: ray_tpu/)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--select", default=None, metavar="RULE[,RULE...]",
+                    help="run only these rules")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{BASELINE_REL})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings too")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="update the baseline from current findings "
+                         "(out-of-scope entries and '# why' comments "
+                         "are preserved)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(ALL_RULES):
+            kind = "per-file" if name in ASYNC_RULES else "cross-file"
+            print(f"{name:24s} [{kind}]")
+        return 0
+
+    root = os.path.abspath(args.root or _repo_root())
+    paths = args.paths or [os.path.join(root, "ray_tpu")]
+    baseline_path = args.baseline or os.path.join(root, BASELINE_REL)
+    select = ([r.strip() for r in args.select.split(",") if r.strip()]
+              if args.select else None)
+    try:
+        files = discover_files(paths)
+        contexts = parse_files(files, root)
+        findings = run_rules(contexts, ProjectConfig(root=root), select)
+    except (FileNotFoundError, SyntaxError, ValueError) as e:
+        print(f"rtpu-check: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        content = merge_baseline(
+            baseline_path, findings,
+            scanned_paths={ctx.path for ctx in contexts},
+            selected_rules=set(select) if select else set(ALL_RULES))
+        with open(baseline_path, "w") as f:
+            f.write(content)
+        n_keys = sum(1 for ln in content.splitlines()
+                     if ln and not ln.startswith("#"))
+        print(f"rtpu-check: wrote {n_keys} key(s) to {baseline_path}")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(baseline_path)
+    new, baselined = split_new_findings(findings, baseline)
+    for f in new:
+        print(f.render())
+    n_files = len(files)
+    if new:
+        print(f"rtpu-check: {len(new)} finding(s) in {n_files} file(s)"
+              + (f" (+{len(baselined)} baselined)" if baselined else ""),
+              file=sys.stderr)
+        return 1
+    print(f"rtpu-check: clean ({n_files} files"
+          + (f", {len(baselined)} baselined finding(s)" if baselined
+             else "") + ")")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,162 @@
+"""Core types for rtpu-check: findings, suppressions, the baseline file.
+
+A **finding** is one rule violation at one source location.  Its ``key``
+deliberately excludes the line number — ``path::rule::symbol`` — so a
+baseline entry survives unrelated edits that shift lines.  ``symbol`` is
+whatever stable token the rule anchors on (the blocked call's dotted
+name, the RPC method, the metric name, ...).
+
+Two escape hatches keep the tree at zero *unsuppressed* findings without
+forcing a fix-everything flag day:
+
+* **Inline suppression** — ``# rtpu-check: disable=<rule>[,<rule>...]``
+  either trailing the flagged line or on a standalone comment line
+  directly above it.  Use for violations that are *correct by local
+  argument* (say why in the surrounding comment).
+* **Baseline** — a checked-in file of finding keys
+  (``ray_tpu/tools/check/baseline.txt``); entries are debt, each line
+  carries a justification after ``#``.  ``--update-baseline`` refreshes
+  it from the current run, preserving justifications and any entries
+  the run's scope (paths / ``--select``) could not have re-observed.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = [
+    "Finding", "Suppressions", "parse_catalogue", "load_baseline",
+    "load_baseline_comments", "format_baseline", "merge_baseline",
+    "split_new_findings",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str       # repo-root-relative, '/'-separated
+    line: int       # 1-based
+    rule: str
+    message: str
+    symbol: str     # stable token for the baseline key
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}::{self.symbol}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*rtpu-check:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+class Suppressions:
+    """Per-file map of line -> suppressed rule names.
+
+    A ``# rtpu-check: disable=r1,r2`` comment suppresses its own line;
+    when the comment is the whole line (nothing but whitespace before
+    the ``#``), it also suppresses the next line — so multi-line
+    statements can carry the marker directly above their first line.
+    """
+
+    def __init__(self, source: str):
+        self._by_line: Dict[int, Set[str]] = {}
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self._by_line.setdefault(lineno, set()).update(rules)
+            if text[:m.start()].strip() in ("", "#"):
+                # standalone comment: covers the following line too
+                self._by_line.setdefault(lineno + 1, set()).update(rules)
+
+    def covers(self, line: int, rule: str) -> bool:
+        return rule in self._by_line.get(line, ())
+
+    def __bool__(self) -> bool:
+        return bool(self._by_line)
+
+
+def parse_catalogue(text: str) -> Set[str]:
+    """Entries of a one-name-per-line file where ``#`` starts a comment
+    anywhere — the single grammar for baseline and golden-catalogue
+    files (also used by ``scripts/metrics_smoke.py``)."""
+    out: Set[str] = set()
+    for raw in text.splitlines():
+        entry = raw.split("#", 1)[0].strip()
+        if entry:
+            out.add(entry)
+    return out
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Read finding keys from a baseline file.  Missing file == empty
+    baseline."""
+    try:
+        with open(path) as f:
+            return parse_catalogue(f.read())
+    except FileNotFoundError:
+        return set()
+
+
+def load_baseline_comments(path: str) -> Dict[str, str]:
+    """key -> its trailing ``# why`` justification, so a baseline
+    rewrite keeps the hand-written rationale for keys that survive."""
+    comments: Dict[str, str] = {}
+    try:
+        with open(path) as f:
+            for raw in f:
+                entry, sep, comment = raw.partition("#")
+                key = entry.strip()
+                if key and sep and comment.strip():
+                    comments[key] = comment.strip()
+    except FileNotFoundError:
+        pass
+    return comments
+
+
+def format_baseline(keys: Iterable[str],
+                    comments: Optional[Dict[str, str]] = None) -> str:
+    header = (
+        "# rtpu-check baseline: known findings tolerated in this tree.\n"
+        "# One key per line (path::rule::symbol); document WHY after '#'.\n"
+        "# Regenerate: python -m ray_tpu.tools.check --update-baseline\n")
+    lines = []
+    for k in sorted(set(keys)):
+        why = (comments or {}).get(k)
+        lines.append(k + (f"  # {why}" if why else "") + "\n")
+    return header + "".join(lines)
+
+
+def merge_baseline(existing_path: str, findings: Iterable[Finding],
+                   scanned_paths: Set[str],
+                   selected_rules: Set[str]) -> str:
+    """Baseline content for ``--update-baseline``: the current run's
+    finding keys plus every existing entry the run could *not* have
+    re-observed (file outside the scanned paths, or rule deselected) —
+    so a ``--select``/path-restricted update never silently drops
+    out-of-scope debt.  Hand-written ``# why`` justifications are kept
+    for keys that survive."""
+    comments = load_baseline_comments(existing_path)
+    keys = {f.key for f in findings}
+    for key in load_baseline(existing_path):
+        parts = key.split("::", 2)
+        if len(parts) == 3 and (parts[0] not in scanned_paths
+                                or parts[1] not in selected_rules):
+            keys.add(key)
+    return format_baseline(keys, comments)
+
+
+def split_new_findings(
+        findings: List[Finding], baseline: Set[str]
+) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined) partition of ``findings`` against ``baseline``."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        (old if f.key in baseline else new).append(f)
+    return new, old

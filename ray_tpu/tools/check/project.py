@@ -1,0 +1,352 @@
+"""Cross-file conformance rules: RPC registries, failpoints, metrics.
+
+These rules check agreement between *places that must not drift apart*:
+
+* ``rpc-conformance`` — a client-side method string with no
+  ``handle_<method>`` coroutine anywhere is a call that can only ever
+  produce a remote "no method" error; an ``IDEMPOTENT_METHODS`` entry
+  with no handler is a stale registry line that silently licenses
+  retry-after-send for a method that no longer exists; a control-plane
+  handler (gcs/raylet/worker) missing a ``messages.py`` schema is a
+  typed-boundary hole — its payloads cross processes unvalidated.
+* ``failpoint-registry`` — failpoint site names must be unique (two
+  sites sharing a name are armed together: a chaos test thinks it
+  injected one fault and injected two) and documented in
+  ``docs/fault_injection.md`` (an undocumented site is invisible to
+  anyone writing chaos coverage).
+* ``metric-drift`` — every ``ray_tpu_*`` series constructed in code must
+  appear in ``scripts/metrics_golden.txt``, the exporter catalogue that
+  dashboards and the metrics smoke test key on.  A name typo'd or added
+  without updating the catalogue ships a series nobody scrapes.
+
+All checks are static (AST + text); nothing here imports runtime
+modules, so the analyzer runs in CI without booting a cluster.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.tools.check.astrules import ModuleContext, _dotted, \
+    parse_module
+from ray_tpu.tools.check.findings import Finding, parse_catalogue
+
+__all__ = ["ProjectConfig", "check_rpc_conformance",
+           "check_failpoint_registry", "check_metric_drift",
+           "collect_metric_names", "parse_catalogue", "PROJECT_RULES"]
+
+
+@dataclass
+class ProjectConfig:
+    """Repo-layout knobs, overridable so tests can point the rules at
+    fixture trees."""
+
+    root: str = "."
+    #: services whose handlers form the typed control plane (schema
+    #: coverage is enforced here; the ray:// client proxy opts out of
+    #: schema validation and is exempt)
+    core_service_files: Tuple[str, ...] = (
+        "ray_tpu/core/gcs.py", "ray_tpu/core/raylet.py",
+        "ray_tpu/core/worker.py")
+    messages_path: str = "ray_tpu/core/messages.py"
+    rpc_path: str = "ray_tpu/core/rpc.py"
+    failpoint_doc: str = "docs/fault_injection.md"
+    metrics_golden: str = "scripts/metrics_golden.txt"
+
+    def read(self, rel: str) -> Optional[str]:
+        try:
+            with open(os.path.join(self.root, rel)) as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+def _str_arg(call: ast.Call, index: int) -> Optional[str]:
+    if len(call.args) > index and isinstance(call.args[index], ast.Constant) \
+            and isinstance(call.args[index].value, str):
+        return call.args[index].value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# rpc-conformance
+# ---------------------------------------------------------------------------
+
+def _collect_schemas(cfg: ProjectConfig) -> Set[str]:
+    """Methods registered via ``register_schema("name", ...)`` in
+    messages.py — parsed statically so the analyzer never imports
+    runtime code."""
+    src = cfg.read(cfg.messages_path)
+    if src is None:
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is not None and d.split(".")[-1] == "register_schema":
+                name = _str_arg(node, 0)
+                if name:
+                    out.add(name)
+    return out
+
+
+def _collect_idempotent(cfg: ProjectConfig) -> Tuple[Set[str], int]:
+    """(methods, line) of the IDEMPOTENT_METHODS frozenset in rpc.py."""
+    src = cfg.read(cfg.rpc_path)
+    if src is None:
+        return set(), 0
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "IDEMPOTENT_METHODS"
+                        for t in node.targets):
+            methods = {c.value for c in ast.walk(node.value)
+                       if isinstance(c, ast.Constant)
+                       and isinstance(c.value, str)}
+            return methods, node.lineno
+    return set(), 0
+
+
+def _collect_handlers(
+        contexts: List[ModuleContext]
+) -> Dict[str, List[Tuple[str, int]]]:
+    handlers: Dict[str, List[Tuple[str, int]]] = {}
+    for ctx in contexts:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name.startswith("handle_"):
+                handlers.setdefault(node.name[len("handle_"):], []).append(
+                    (ctx.path, node.lineno))
+    return handlers
+
+
+def _collect_client_calls(
+        contexts: List[ModuleContext]
+) -> List[Tuple[str, str, int]]:
+    """(method, path, line) for every literal-method RPC call site:
+    ``conn.call("m", ...)``, ``pool.call(addr, "m", ...)``,
+    ``conn.start_call("m", ...)``, ``call_with_retry(get_conn, "m")``."""
+    calls: List[Tuple[str, str, int]] = []
+    for ctx in contexts:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            method: Optional[str] = None
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "call":
+                    method = _str_arg(node, 0) or _str_arg(node, 1)
+                elif node.func.attr == "start_call":
+                    method = _str_arg(node, 0)
+            d = _dotted(node.func)
+            if d is not None and d.split(".")[-1] == "call_with_retry":
+                method = _str_arg(node, 1)
+            if method is not None:
+                calls.append((method, ctx.path, node.lineno))
+    return calls
+
+
+def _tree_contexts(contexts: List[ModuleContext],
+                   cfg: ProjectConfig) -> List[ModuleContext]:
+    """``contexts`` plus a parse of every ``ray_tpu/`` module the scan
+    scope left out.  The handler registry must reflect the whole tree
+    even on a path-restricted run — otherwise scanning one file floods
+    false "no service defines handle_X" findings (and could poison the
+    baseline via ``--update-baseline``)."""
+    seen = {ctx.path for ctx in contexts}
+    extra: List[ModuleContext] = []
+    pkg = os.path.join(cfg.root, "ray_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, cfg.root).replace(os.sep, "/")
+            if rel in seen:
+                continue
+            try:
+                with open(full, encoding="utf-8") as f:
+                    extra.append(parse_module(rel, f.read()))
+            except (OSError, SyntaxError):
+                continue
+    return contexts + extra
+
+
+def check_rpc_conformance(contexts: List[ModuleContext],
+                          cfg: ProjectConfig) -> List[Finding]:
+    rule = "rpc-conformance"
+    findings: List[Finding] = []
+    schemas = _collect_schemas(cfg)
+    idempotent, idem_line = _collect_idempotent(cfg)
+    # registry questions ("does a handler exist?") consult the whole
+    # tree; findings are only emitted for the scanned contexts
+    handlers_all = _collect_handlers(_tree_contexts(contexts, cfg))
+    handlers = _collect_handlers(contexts)
+    core_files = set(cfg.core_service_files)
+
+    for method, path, line in _collect_client_calls(contexts):
+        if method.startswith("_"):
+            continue  # internal pseudo-methods (e.g. _protocol rejects)
+        if method not in handlers_all:
+            findings.append(Finding(
+                path=path, line=line, rule=rule, symbol=method,
+                message=f"client calls method {method!r} but no service "
+                        f"defines handle_{method}"))
+
+    for method in sorted(idempotent):
+        if method not in handlers_all:
+            findings.append(Finding(
+                path=cfg.rpc_path, line=idem_line, rule=rule,
+                symbol=f"idempotent.{method}",
+                message=f"IDEMPOTENT_METHODS lists {method!r} but no "
+                        f"service defines handle_{method} (stale entry "
+                        f"licensing retry-after-send for nothing)"))
+
+    for method, sites in sorted(handlers.items()):
+        for path, line in sites:
+            if path in core_files and method not in schemas:
+                findings.append(Finding(
+                    path=path, line=line, rule=rule,
+                    symbol=f"schema.{method}",
+                    message=f"control-plane handler handle_{method} has "
+                            f"no messages.py schema: payloads cross "
+                            f"processes unvalidated (register_schema"
+                            f"({method!r}, ...))"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# failpoint-registry
+# ---------------------------------------------------------------------------
+
+def _failpoint_name(call: ast.Call) -> Optional[str]:
+    """Literal site name, with f-string holes normalized to ``<expr>``
+    (``f"rpc.{method}.reply_drop"`` -> ``rpc.<method>.reply_drop`` —
+    the exact spelling the doc's generic-site table uses)."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts: List[str] = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                try:
+                    parts.append(f"<{ast.unparse(v.value)}>")
+                except Exception:  # pragma: no cover - unparse fallback
+                    parts.append("<expr>")
+        return "".join(parts)
+    return None
+
+
+def check_failpoint_registry(contexts: List[ModuleContext],
+                             cfg: ProjectConfig) -> List[Finding]:
+    rule = "failpoint-registry"
+    findings: List[Finding] = []
+    doc = cfg.read(cfg.failpoint_doc) or ""
+    # exact-match against backtick-quoted names: a plain substring test
+    # would let `raylet.spill` ride on a documented `raylet.spill.fail`.
+    # Single-line matches only, else ``` fences swallow whole sections.
+    documented = set(re.findall(r"`([^`\n]+)`", doc))
+    sites: Dict[str, List[Tuple[str, int]]] = {}
+    for ctx in contexts:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None or d.split(".")[-1] not in ("failpoint",
+                                                     "afailpoint"):
+                continue
+            name = _failpoint_name(node)
+            if name is not None:
+                sites.setdefault(name, []).append((ctx.path, node.lineno))
+    for name, locs in sorted(sites.items()):
+        if len(locs) > 1:
+            first = f"{locs[0][0]}:{locs[0][1]}"
+            for path, line in locs[1:]:
+                findings.append(Finding(
+                    path=path, line=line, rule=rule,
+                    symbol=f"dup.{name}",
+                    message=f"failpoint site {name!r} already defined at "
+                            f"{first}: arming it fires both sites"))
+        if name not in documented:
+            path, line = locs[0]
+            findings.append(Finding(
+                path=path, line=line, rule=rule, symbol=f"doc.{name}",
+                message=f"failpoint site {name!r} not documented in "
+                        f"{cfg.failpoint_doc} (add it to the woven-sites "
+                        f"table)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# metric-drift
+# ---------------------------------------------------------------------------
+
+_METRIC_FACTORIES = {"Counter", "Gauge", "Histogram",
+                     "_counter", "_gauge", "_hist", "set_gauge"}
+
+
+def collect_metric_names(
+        contexts: List[ModuleContext]
+) -> Dict[str, List[Tuple[str, int]]]:
+    """``ray_tpu_*`` series name -> construction sites.  Shared with
+    ``scripts/metrics_smoke.py --update`` so the regenerated golden
+    catalogue is exactly the set of names the code constructs."""
+    names: Dict[str, List[Tuple[str, int]]] = {}
+    for ctx in contexts:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None or d.split(".")[-1] not in _METRIC_FACTORIES:
+                continue
+            name = _str_arg(node, 0)
+            if name is None:
+                # constructors accept the name as a keyword too
+                for kw in node.keywords:
+                    if kw.arg == "name" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        name = kw.value.value
+            if name is None or not name.startswith("ray_tpu_"):
+                continue
+            names.setdefault(name, []).append((ctx.path, node.lineno))
+    return names
+
+
+def check_metric_drift(contexts: List[ModuleContext],
+                       cfg: ProjectConfig) -> List[Finding]:
+    rule = "metric-drift"
+    findings: List[Finding] = []
+    golden_src = cfg.read(cfg.metrics_golden)
+    golden = parse_catalogue(golden_src) if golden_src is not None else set()
+    for name, sites in sorted(collect_metric_names(contexts).items()):
+        if name in golden:
+            continue
+        for path, line in sites:
+            findings.append(Finding(
+                path=path, line=line, rule=rule,
+                symbol=name,
+                message=f"metric {name!r} is not in "
+                        f"{cfg.metrics_golden}: dashboards and the "
+                        f"metrics smoke test won't see it (add it, "
+                        f"or run scripts/metrics_smoke.py --update)"))
+    return findings
+
+
+#: rule name -> cross-file checker
+PROJECT_RULES = {
+    "rpc-conformance": check_rpc_conformance,
+    "failpoint-registry": check_failpoint_registry,
+    "metric-drift": check_metric_drift,
+}

@@ -428,8 +428,13 @@ async def _serve_isolated(gcs_address: str, host: str, port: int) -> None:
                     break
                 writer.write(data)
                 await writer.drain()
-        except (ConnectionError, asyncio.CancelledError):
+        except ConnectionError:
             pass
+        except asyncio.CancelledError:
+            # swallowing this would mark the splice task as finished
+            # cleanly and leave the canceller waiting on a half-open
+            # proxy; close the writer (finally) and keep cancelling
+            raise
         finally:
             try:
                 writer.close()

@@ -46,6 +46,16 @@ TRAFFIC_DEPENDENT = {
 }
 
 
+def constructed_names() -> set:
+    """Every ``ray_tpu_*`` series name constructed anywhere in the
+    tree, via rtpu-check's AST scan — the same view its metric-drift
+    rule enforces against the golden file."""
+    from ray_tpu.tools.check.cli import discover_files, parse_files
+    from ray_tpu.tools.check.project import collect_metric_names
+    files = discover_files([os.path.join(_ROOT, "ray_tpu")])
+    return set(collect_metric_names(parse_files(files, _ROOT)))
+
+
 def scrape_series(timeout_s: float = 60.0) -> set:
     import ray_tpu
     from ray_tpu.dashboard import Dashboard
@@ -96,26 +106,80 @@ def main() -> int:
     names = scrape_series()
     runtime = {n for n in names if n.startswith("ray_tpu_")}
     if args.update:
+        # basis: the names the code actually constructs (rtpu-check's
+        # view), so feature-gated series survive a quiet-boot regen
+        # while renamed/removed series genuinely drop out
+        constructed = constructed_names()
+        # NOT unioned with TRAFFIC_DEPENDENT: every live entry there is
+        # also constructed, so including it could only ever re-write
+        # stale names into the catalogue
+        catalogue = runtime | constructed
         with open(GOLDEN, "w") as f:
-            f.write("# Golden ray_tpu_* series exported by a quiet "
-                    "single-node boot\n# (regenerate: python "
-                    "scripts/metrics_smoke.py --update)\n")
-            for n in sorted(runtime):
+            f.write(
+                "# Golden catalogue of every ray_tpu_* series the "
+                "runtime constructs.\n"
+                "# Two classes:\n"
+                "#   - boot series: exported by a quiet single-node "
+                "boot; metrics_smoke\n"
+                "#     fails if a scrape is missing one (renamed or "
+                "producer broken).\n"
+                "#   - traffic-dependent series (listed in "
+                "TRAFFIC_DEPENDENT in\n"
+                "#     scripts/metrics_smoke.py): only appear under "
+                "multi-node traffic\n"
+                "#     or failures; smoke tolerates their absence, but "
+                "rtpu-check's\n"
+                "#     metric-drift rule still requires them HERE so "
+                "the catalogue is\n"
+                "#     the single source of truth for dashboards.\n"
+                "# Regenerate: python scripts/metrics_smoke.py "
+                "--update\n")
+            for n in sorted(catalogue):
                 f.write(n + "\n")
-        print(f"wrote {len(runtime)} series to {GOLDEN}")
-        return 0
+        print(f"wrote {len(catalogue)} series to {GOLDEN}")
+        # a constructed series that neither appears in a quiet boot nor
+        # is classified traffic-dependent would make the next check
+        # report it MISSING — and rerunning --update can't fix that, so
+        # say exactly what will
+        rc = 0
+        unclassified = constructed - runtime - TRAFFIC_DEPENDENT
+        if unclassified:
+            print("these constructed series are absent from a quiet "
+                  "boot and not in TRAFFIC_DEPENDENT; the next check "
+                  "will report them MISSING — add them to "
+                  "TRAFFIC_DEPENDENT in scripts/metrics_smoke.py:",
+                  file=sys.stderr)
+            for n in sorted(unclassified):
+                print(f"  {n}", file=sys.stderr)
+            rc = 1
+        # the inverse rot: an entry that outlived its constructor would
+        # be re-written into the catalogue by every --update and
+        # excused from the missing-check forever
+        stale = TRAFFIC_DEPENDENT - constructed
+        if stale:
+            print("these TRAFFIC_DEPENDENT entries are no longer "
+                  "constructed anywhere (renamed/removed metric?); "
+                  "drop them from scripts/metrics_smoke.py:",
+                  file=sys.stderr)
+            for n in sorted(stale):
+                print(f"  {n}", file=sys.stderr)
+            rc = 1
+        return rc
 
     try:
+        from ray_tpu.tools.check.project import parse_catalogue
         with open(GOLDEN) as f:
-            golden = {line.strip() for line in f
-                      if line.strip() and not line.startswith("#")}
+            golden = parse_catalogue(f.read())
     except FileNotFoundError:
         print(f"missing golden file {GOLDEN}; run with --update first",
               file=sys.stderr)
         return 2
 
-    missing = golden - names
-    unexpected = runtime - golden - TRAFFIC_DEPENDENT
+    # the golden file is the FULL catalogue (rtpu-check's metric-drift
+    # rule keys on it); traffic-dependent series are legitimately
+    # absent from a quiet boot
+    missing = golden - names - TRAFFIC_DEPENDENT
+    unexpected = runtime - golden
     ok = not missing and not unexpected
     print(f"scraped {len(runtime)} ray_tpu_* series "
           f"({len(names)} total)")

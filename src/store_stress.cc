@@ -40,6 +40,8 @@ int rtpu_store_delete(void* handle, const unsigned char* id);
 uint64_t rtpu_store_evict(void* handle, uint64_t bytes_needed);
 void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
                       uint64_t* num_objects);
+uint64_t rtpu_store_stats_ex(void* handle, uint64_t* out, uint64_t max);
+uint64_t rtpu_store_bucket_used(void* handle, uint64_t* out, uint64_t max);
 
 int rtpu_sched_pick_node(const double* node_avail, const int64_t* node_load,
                          int n_nodes, int n_res, const double* demand,
@@ -64,7 +66,7 @@ void StoreWorker(void* store, int seed, std::atomic<long>* ops_done) {
   unsigned char id[kIdSize];
   for (int i = 0; i < kOpsPerThread; i++) {
     FillId(id, static_cast<int>(rng() % kKeySpace));
-    switch (rng() % 6) {
+    switch (rng() % 8) {
       case 0: {  // create + seal (alternating plain and hinted creates
                  // so bucketed and global allocations race each other)
         int64_t off = (rng() % 2)
@@ -90,6 +92,32 @@ void StoreWorker(void* store, int seed, std::atomic<long>* ops_done) {
       case 4:
         rtpu_store_evict(store, 8192);
         break;
+      case 5: {  // doomed-delete reclaim: Delete() of a PINNED object
+                 // dooms it (invisible to new Gets, freed on the last
+                 // Release) — race the whole doom/reclaim transition
+        uint64_t offset = 0, size = 0;
+        if (rtpu_store_get(store, id, &offset, &size)) {
+          rtpu_store_delete(store, id);
+          // our pin keeps the (now doomed) entry in the table, and
+          // Create of an occupied id fails, so no racing thread can
+          // resurrect this id until we release: Contains must miss
+          if (rtpu_store_contains(store, id)) {
+            std::fprintf(stderr, "doomed object visible to Contains "
+                                 "while still pinned\n");
+            std::abort();
+          }
+          rtpu_store_release(store, id);   // last pin: deferred free
+        }
+        break;
+      }
+      case 6: {  // extended-stats sweep: walks bucket free lists under
+                 // the per-bucket mutexes while allocators mutate them
+        uint64_t ex[9];
+        rtpu_store_stats_ex(store, ex, 9);
+        uint64_t per_bucket[64];
+        rtpu_store_bucket_used(store, per_bucket, 64);
+        break;
+      }
       default: {
         uint64_t used, cap, n;
         rtpu_store_stats(store, &used, &cap, &n);
@@ -141,11 +169,62 @@ int main() {
   }
   for (auto& th : threads) th.join();
 
+  // Deterministic doomed-delete reclaim check (the racing phase
+  // exercises the transitions; this asserts the accounting): a Delete
+  // of a pinned object must doom it — invisible to Contains/Get, still
+  // counted in stats_ex[3] (doomed_current) — and the last Release
+  // must reclaim it.
+  unsigned char probe[kIdSize];
+  FillId(probe, kKeySpace + 1);
+  if (rtpu_store_put_hint(store, probe, 2048, 3) < 0 ||
+      !rtpu_store_seal(store, probe)) {
+    std::fprintf(stderr, "probe create failed\n");
+    return 2;
+  }
+  uint64_t poff = 0, psize = 0;
+  if (!rtpu_store_get(store, probe, &poff, &psize)) {
+    std::fprintf(stderr, "probe get failed\n");
+    return 2;
+  }
+  rtpu_store_delete(store, probe);  // pinned: dooms instead of freeing
+  uint64_t ex_doomed[9] = {0};
+  rtpu_store_stats_ex(store, ex_doomed, 9);
+  if (ex_doomed[3] < 1) {
+    std::fprintf(stderr, "pinned delete did not doom (doomed_current=%llu)\n",
+                 (unsigned long long)ex_doomed[3]);
+    return 3;
+  }
+  if (rtpu_store_contains(store, probe)) {
+    std::fprintf(stderr, "doomed object still visible to Contains\n");
+    return 3;
+  }
+  rtpu_store_release(store, probe);  // last pin: deferred free lands
+  uint64_t ex_after[9] = {0};
+  rtpu_store_stats_ex(store, ex_after, 9);
+  if (ex_after[3] != ex_doomed[3] - 1) {
+    std::fprintf(stderr, "release did not reclaim doomed object "
+                 "(doomed_current %llu -> %llu)\n",
+                 (unsigned long long)ex_doomed[3],
+                 (unsigned long long)ex_after[3]);
+    return 3;
+  }
+  if (ex_after[4] < ex_after[3] || ex_after[4] < 1) {
+    std::fprintf(stderr, "doomed_total accounting wrong (%llu)\n",
+                 (unsigned long long)ex_after[4]);
+    return 3;
+  }
+
   uint64_t used = 0, cap = 0, n = 0;
   rtpu_store_stats(store, &used, &cap, &n);
-  std::printf("ops=%ld objects=%llu used=%llu/%llu\n", ops.load(),
-              (unsigned long long)n, (unsigned long long)used,
-              (unsigned long long)cap);
+  uint64_t ex[9] = {0};
+  uint64_t n_ex = rtpu_store_stats_ex(store, ex, 9);
+  std::printf("ops=%ld objects=%llu used=%llu/%llu doomed_total=%llu "
+              "reuse=%llu/%llu stats_ex_vals=%llu\n",
+              ops.load(), (unsigned long long)n, (unsigned long long)used,
+              (unsigned long long)cap, (unsigned long long)ex[4],
+              (unsigned long long)ex[5],
+              (unsigned long long)(ex[5] + ex[6]),
+              (unsigned long long)n_ex);
   rtpu_store_destroy(store);
   std::remove(path);
   return 0;

@@ -1,0 +1,582 @@
+"""rtpu-check analyzer tests: every rule has at least one flagged-bad
+and one clean fixture, plus suppression/baseline semantics and a
+whole-tree run asserting the checked-in tree is at zero unsuppressed
+findings."""
+
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.tools.check import cli as check_cli
+from ray_tpu.tools.check.astrules import (
+    check_async_blocking, check_await_under_lock,
+    check_cancellation_swallow, parse_module,
+)
+from ray_tpu.tools.check.findings import (
+    Finding, Suppressions, load_baseline, split_new_findings,
+)
+from ray_tpu.tools.check.project import (
+    ProjectConfig, check_failpoint_registry, check_metric_drift,
+    check_rpc_conformance,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ctx(source, path="fixture.py"):
+    return parse_module(path, textwrap.dedent(source))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# async-blocking
+# ---------------------------------------------------------------------------
+
+def test_async_blocking_flags_sleep_and_io():
+    findings = check_async_blocking(_ctx("""
+        import time, subprocess
+
+        async def handler():
+            time.sleep(1)                      # line 5
+            subprocess.run(["true"])           # line 6
+            with open("/tmp/x") as f:          # line 7
+                return f.read()
+    """))
+    assert _rules(findings) == ["async-blocking"] * 3
+    assert [f.line for f in findings] == [5, 6, 7]
+    assert "time.sleep" in findings[0].message
+
+
+def test_async_blocking_resolves_import_aliases():
+    findings = check_async_blocking(_ctx("""
+        from time import sleep
+        import subprocess as sp
+
+        async def handler():
+            sleep(0.1)
+            sp.check_output(["true"])
+    """))
+    assert len(findings) == 2
+    assert findings[0].symbol.endswith("time.sleep")
+
+
+def test_async_blocking_resolves_dotted_imports():
+    # `import a.b` binds `a`; the call already spells the full dotted
+    # path and must not be double-expanded into a.b.b.f (which would
+    # silently miss BLOCKING_CALLS)
+    findings = check_async_blocking(_ctx("""
+        import urllib.request
+        import os.path
+
+        async def fetch(u):
+            urllib.request.urlopen(u)
+            os.system("true")
+    """))
+    assert sorted(f.symbol for f in findings) == [
+        "fetch.os.system", "fetch.urllib.request.urlopen"]
+
+
+def test_async_blocking_flags_future_result_and_lock_acquire():
+    findings = check_async_blocking(_ctx("""
+        import threading
+
+        _lock = threading.Lock()
+
+        async def handler(pool):
+            fut = pool.submit(work)
+            fut.result()
+            _lock.acquire()
+    """))
+    assert sorted(f.symbol for f in findings) == [
+        "handler.Future.result", "handler._lock.acquire"]
+
+
+def test_async_blocking_clean_fixtures():
+    # sync code, executor offload, asyncio primitives, nested sync defs
+    # (executor/callback bodies), and non-blocking acquire: no findings
+    findings = check_async_blocking(_ctx("""
+        import time, threading
+
+        _lock = threading.Lock()
+
+        def sync_path():
+            time.sleep(1)          # sync caller: fine
+            with open("/x") as f:
+                return f.read()
+
+        async def handler(loop):
+            await asyncio.sleep(1)
+            data = await loop.run_in_executor(None, sync_path)
+            _lock.acquire(blocking=False)
+
+            def done_callback(f):
+                time.sleep(0.01)   # nested sync def: opaque
+            return data
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# await-under-lock
+# ---------------------------------------------------------------------------
+
+def test_await_under_lock_flagged():
+    findings = check_await_under_lock(_ctx("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def update(self, conn):
+                with self._lock:
+                    await conn.call("kv_put", {})
+    """))
+    assert _rules(findings) == ["await-under-lock"]
+    assert "_lock" in findings[0].message
+
+
+def test_await_under_lock_clean():
+    findings = check_await_under_lock(_ctx("""
+        import threading, asyncio
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._alock = asyncio.Lock()
+
+            async def ok(self, conn):
+                with self._lock:
+                    snapshot = dict(self.table)   # no await inside
+                async with self._alock:
+                    await conn.call("kv_put", {})  # asyncio lock: fine
+                await conn.call("kv_put", snapshot)
+
+            def sync_ok(self):
+                with self._lock:
+                    return 1
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# cancellation-swallow
+# ---------------------------------------------------------------------------
+
+def test_cancellation_swallow_flagged():
+    findings = check_cancellation_swallow(_ctx("""
+        import asyncio
+
+        async def a():
+            try:
+                await work()
+            except BaseException:
+                pass
+
+        async def b():
+            try:
+                await work()
+            except asyncio.CancelledError:
+                log()
+
+        def c():
+            try:
+                work()
+            except:
+                pass
+    """))
+    assert sorted(f.symbol for f in findings) == [
+        "a.BaseException", "b.CancelledError", "c.bare-except"]
+
+
+def test_cancellation_swallow_clean():
+    findings = check_cancellation_swallow(_ctx("""
+        import asyncio
+
+        async def a():
+            try:
+                await work()
+            except Exception:      # CancelledError passes through: fine
+                pass
+
+        async def b():
+            try:
+                await work()
+            except asyncio.CancelledError:
+                cleanup()
+                raise              # re-raised: fine
+
+        def c():
+            try:
+                work()
+            except BaseException:  # sync code may catch KeyboardInterrupt
+                report()
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# rpc-conformance
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fixture_project(tmp_path):
+    """A miniature repo layout the cross-file rules can run against."""
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "messages.py").write_text(
+        "def register_schema(m, **f):\n    pass\n"
+        "register_schema('ping')\n")
+    (tmp_path / "rpc.py").write_text(
+        "IDEMPOTENT_METHODS = frozenset({'ping', 'vanished'})\n")
+    (tmp_path / "docs" / "fault.md").write_text(
+        "| `gcs.heartbeat.delay` | documented |\n"
+        "| `rpc.<method>.reply_drop` | generic |\n")
+    (tmp_path / "scripts" / "golden.txt").write_text(
+        "ray_tpu_known_total\n")
+    return ProjectConfig(
+        root=str(tmp_path),
+        core_service_files=("service.py",),
+        messages_path="messages.py",
+        rpc_path="rpc.py",
+        failpoint_doc="docs/fault.md",
+        metrics_golden="scripts/golden.txt")
+
+
+def test_rpc_conformance_flags_drift(fixture_project):
+    contexts = [
+        _ctx("""
+            class Service:
+                async def handle_ping(self, conn, data):
+                    return True
+
+                async def handle_unregistered(self, conn, data):
+                    return data["x"]
+        """, path="service.py"),
+        _ctx("""
+            async def client(conn):
+                await conn.call("ping")
+                await conn.call("no_such_method", {})
+        """, path="client.py"),
+    ]
+    findings = check_rpc_conformance(contexts, fixture_project)
+    symbols = sorted(f.symbol for f in findings)
+    # missing handler, stale idempotent entry, missing schema — one each
+    assert symbols == ["idempotent.vanished", "no_such_method",
+                       "schema.unregistered"]
+    missing = [f for f in findings if f.symbol == "no_such_method"][0]
+    assert missing.path == "client.py"
+
+
+def test_rpc_conformance_clean(fixture_project):
+    contexts = [
+        _ctx("""
+            class Service:
+                async def handle_ping(self, conn, data):
+                    return True
+        """, path="service.py"),
+        _ctx("""
+            async def client(conn, pool, addr):
+                await conn.call("ping")
+                await pool.call(addr, "ping", {})
+        """, path="client.py"),
+    ]
+    findings = [f for f in check_rpc_conformance(contexts, fixture_project)
+                if f.symbol != "idempotent.vanished"]
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# failpoint-registry
+# ---------------------------------------------------------------------------
+
+def test_failpoint_registry_flags_dup_and_undocumented(fixture_project):
+    contexts = [
+        _ctx("""
+            from ray_tpu.util import failpoint as _fp
+
+            async def a():
+                await _fp.afailpoint("gcs.heartbeat.delay")
+
+            async def b():
+                await _fp.afailpoint("gcs.heartbeat.delay")
+
+            def c():
+                _fp.failpoint("raylet.secret.site")
+        """, path="svc.py"),
+    ]
+    findings = check_failpoint_registry(contexts, fixture_project)
+    assert sorted(f.symbol for f in findings) == [
+        "doc.raylet.secret.site", "dup.gcs.heartbeat.delay"]
+
+
+def test_failpoint_registry_normalizes_fstrings(fixture_project):
+    contexts = [
+        _ctx("""
+            from ray_tpu.util import failpoint as _fp
+
+            async def dispatch(method):
+                await _fp.afailpoint(f"rpc.{method}.reply_drop")
+        """, path="rpcish.py"),
+    ]
+    assert check_failpoint_registry(contexts, fixture_project) == []
+
+
+def test_failpoint_registry_requires_exact_doc_entry(fixture_project):
+    # `gcs.heartbeat` is a substring of the documented
+    # `gcs.heartbeat.delay` — substring matching must not let it pass
+    contexts = [
+        _ctx("""
+            from ray_tpu.util import failpoint as _fp
+
+            async def beat():
+                await _fp.afailpoint("gcs.heartbeat")
+        """, path="gcsish.py"),
+    ]
+    findings = check_failpoint_registry(contexts, fixture_project)
+    assert [f.symbol for f in findings] == ["doc.gcs.heartbeat"]
+
+
+# ---------------------------------------------------------------------------
+# metric-drift
+# ---------------------------------------------------------------------------
+
+def test_metric_drift_flags_unknown_series(fixture_project):
+    contexts = [
+        _ctx("""
+            def loop():
+                _counter("ray_tpu_known_total", "d").inc_key(())
+                _counter("ray_tpu_typo_total", "d").inc_key(())
+                set_gauge("ray_tpu_also_unknown", "d", 1.0)
+                Counter("unprefixed_series", "d")       # not ours: skip
+        """, path="tele.py"),
+    ]
+    findings = check_metric_drift(contexts, fixture_project)
+    assert sorted(f.symbol for f in findings) == [
+        "ray_tpu_also_unknown", "ray_tpu_typo_total"]
+
+
+def test_metric_drift_sees_keyword_name(fixture_project):
+    contexts = [
+        _ctx("""
+            def loop():
+                Gauge(name="ray_tpu_kw_series", desc="d")
+        """, path="tele.py"),
+    ]
+    findings = check_metric_drift(contexts, fixture_project)
+    assert [f.symbol for f in findings] == ["ray_tpu_kw_series"]
+
+
+# ---------------------------------------------------------------------------
+# suppressions / baseline
+# ---------------------------------------------------------------------------
+
+BAD_SLEEP = """
+    import time
+
+    async def handler():
+        time.sleep(1)
+"""
+
+
+def test_inline_suppression_same_line():
+    src = textwrap.dedent("""
+        import time
+
+        async def handler():
+            time.sleep(1)  # rtpu-check: disable=async-blocking
+    """)
+    ctx = parse_module("x.py", src)
+    findings = [f for f in check_async_blocking(ctx)
+                if not ctx.suppressions.covers(f.line, f.rule)]
+    assert findings == []
+
+
+def test_inline_suppression_preceding_line_and_wrong_rule():
+    src = textwrap.dedent("""
+        import time
+
+        async def handler():
+            # rtpu-check: disable=async-blocking
+            time.sleep(1)
+            # rtpu-check: disable=metric-drift
+            time.sleep(2)
+    """)
+    ctx = parse_module("x.py", src)
+    findings = [f for f in check_async_blocking(ctx)
+                if not ctx.suppressions.covers(f.line, f.rule)]
+    assert [f.line for f in findings] == [8]  # wrong rule: still flagged
+
+
+def test_suppression_trailing_code_does_not_cover_next_line():
+    sup = Suppressions("x = 1  # rtpu-check: disable=async-blocking\ny = 2")
+    assert sup.covers(1, "async-blocking")
+    assert not sup.covers(2, "async-blocking")
+
+
+def test_baseline_roundtrip(tmp_path):
+    f1 = Finding("a.py", 3, "async-blocking", "m", "h.time.sleep")
+    f2 = Finding("b.py", 9, "metric-drift", "m", "ray_tpu_x")
+    baseline_file = tmp_path / "baseline.txt"
+    baseline_file.write_text(f"{f1.key}  # justified: boot-time only\n")
+    baseline = load_baseline(str(baseline_file))
+    new, old = split_new_findings([f1, f2], baseline)
+    assert [f.key for f in old] == [f1.key]
+    assert [f.key for f in new] == [f2.key]
+    # keys are line-number-free: the entry survives the finding moving
+    assert f1.key == Finding("a.py", 99, "async-blocking", "m",
+                             "h.time.sleep").key
+
+
+def test_missing_baseline_file_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.txt")) == set()
+
+
+# ---------------------------------------------------------------------------
+# CLI / whole-tree
+# ---------------------------------------------------------------------------
+
+def test_scoped_run_consults_whole_tree_registries(tmp_path, capsys):
+    """Scanning one file must not flag its client calls just because
+    the handler's file is outside the scan scope."""
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir()
+    (pkg / "service.py").write_text(textwrap.dedent("""
+        class Service:
+            async def handle_ping(self, conn, data):
+                return True
+    """))
+    (pkg / "client.py").write_text(textwrap.dedent("""
+        async def client(conn):
+            await conn.call("ping")
+    """))
+    rc = check_cli.main([str(pkg / "client.py"), "--root", str(tmp_path),
+                         "--baseline", str(tmp_path / "b.txt"),
+                         "--select", "rpc-conformance"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out
+
+
+def test_scoped_run_honors_out_of_scope_suppressions(tmp_path, capsys):
+    """An inline marker in a registry file (rpc.py) must count even
+    when that file is outside the scan scope — cross-file rules anchor
+    findings there regardless of which paths were passed."""
+    core = tmp_path / "ray_tpu" / "core"
+    core.mkdir(parents=True)
+    (core / "rpc.py").write_text(textwrap.dedent("""
+        # rtpu-check: disable=rpc-conformance
+        IDEMPOTENT_METHODS = frozenset({'vanished'})
+    """))
+    (tmp_path / "client.py").write_text("x = 1\n")
+    rc = check_cli.main([str(tmp_path / "client.py"),
+                         "--root", str(tmp_path),
+                         "--baseline", str(tmp_path / "b.txt"),
+                         "--select", "rpc-conformance"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out
+
+
+def test_overlapping_paths_scan_each_file_once(tmp_path, capsys):
+    """`check dir dir/file.py` must not double-parse file.py (which
+    would make failpoint-registry call every site its own duplicate)."""
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""
+        from ray_tpu.util import failpoint as _fp
+
+        def site():
+            _fp.failpoint("solo.site")  # rtpu-check: disable=failpoint-registry
+    """))
+    rc = check_cli.main([str(tmp_path), str(tmp_path / "mod.py"),
+                         "--root", str(tmp_path),
+                         "--baseline", str(tmp_path / "b.txt"),
+                         "--select", "failpoint-registry"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out
+    assert "1 files" in out.out
+
+
+def test_cli_list_rules(capsys):
+    assert check_cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("async-blocking", "await-under-lock",
+                 "cancellation-swallow", "rpc-conformance",
+                 "failpoint-registry", "metric-drift"):
+        assert rule in out
+
+
+def test_cli_rejects_unknown_rule(tmp_path):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    assert check_cli.main([str(tmp_path), "--select", "no-such-rule"]) == 2
+
+
+def test_whole_tree_zero_unsuppressed_findings(capsys):
+    """The acceptance gate: `make check` over the checked-in tree is
+    clean."""
+    rc = check_cli.main(["--root", REPO_ROOT])
+    out = capsys.readouterr()
+    assert rc == 0, f"rtpu-check found new violations:\n{out.out}"
+
+
+def test_seeded_violation_fails_the_run(tmp_path, capsys):
+    """Seeding one fixture violation into a scanned tree flips the exit
+    code and prints a clickable file:line rule message."""
+    bad = tmp_path / "seeded.py"
+    bad.write_text(textwrap.dedent(BAD_SLEEP))
+    rc = check_cli.main(["--root", REPO_ROOT,
+                         os.path.join(REPO_ROOT, "ray_tpu"), str(bad)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "seeded.py:5 async-blocking" in out
+
+
+def test_cli_update_and_respect_baseline(tmp_path, capsys):
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent(BAD_SLEEP))
+    baseline = tmp_path / "baseline.txt"
+    args = [str(bad), "--root", str(tmp_path), "--baseline", str(baseline)]
+    assert check_cli.main(args) == 1
+    capsys.readouterr()
+    assert check_cli.main(args + ["--update-baseline"]) == 0
+    assert "mod.py::async-blocking" in baseline.read_text()
+    capsys.readouterr()
+    assert check_cli.main(args) == 0          # baselined: clean
+    out = capsys.readouterr().out
+    assert "baselined" in out
+
+
+def test_update_baseline_preserves_out_of_scope_and_comments(tmp_path,
+                                                            capsys):
+    """A scoped --update-baseline must not drop entries the run could
+    not have re-observed (other files, deselected rules), and must keep
+    hand-written '# why' justifications on surviving keys."""
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent(BAD_SLEEP))
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "elsewhere.py::metric-drift::ray_tpu_debt  # traffic-only\n"
+        "mod.py::cancellation-swallow::handler  # narrowed later\n")
+    args = [str(bad), "--root", str(tmp_path), "--baseline", str(baseline)]
+    assert check_cli.main(
+        args + ["--select", "async-blocking", "--update-baseline"]) == 0
+    text = baseline.read_text()
+    # unscanned file and deselected rule both survive, comments intact
+    assert "elsewhere.py::metric-drift::ray_tpu_debt  # traffic-only" in text
+    assert "mod.py::cancellation-swallow::handler  # narrowed later" in text
+    assert "mod.py::async-blocking" in text
+
+    # annotate the re-found key; a full-scope rerun keeps the note,
+    # keeps the unscanned file's debt, and drops the stale in-scope key
+    text = text.replace(
+        "mod.py::async-blocking::handler.time.sleep",
+        "mod.py::async-blocking::handler.time.sleep  # boot only")
+    baseline.write_text(text)
+    capsys.readouterr()
+    assert check_cli.main(args + ["--update-baseline"]) == 0
+    text = baseline.read_text()
+    assert "mod.py::async-blocking::handler.time.sleep  # boot only" in text
+    assert "elsewhere.py::metric-drift::ray_tpu_debt  # traffic-only" in text
+    assert "cancellation-swallow" not in text
+    assert check_cli.main(args + ["--no-baseline"]) == 1

@@ -97,3 +97,14 @@ def test_validate_helper():
     # None values pass type checks (optional-field convention)
     validate("register_worker", {"worker_id": b"w", "pid": 3,
                                  "task_address": None})
+    # payload-free methods accept the conventional None body...
+    validate("ping", None)
+    validate("clock_sync", None)
+    # ...but Opt-field methods still need a dict: their handlers index
+    # into the payload, so None must fail here, not inside the handler
+    with pytest.raises(SchemaError, match="kv_keys.*must be a dict"):
+        validate("kv_keys", None)
+    validate("kv_keys", {})                      # all fields optional
+    validate("kv_keys", {"prefix": "a"})
+    with pytest.raises(SchemaError, match="optional field 'prefix'"):
+        validate("kv_keys", {"prefix": 42})
