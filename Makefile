@@ -6,8 +6,8 @@
 #   make tsan   — ThreadSanitizer build of the concurrency stress
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
-.PHONY: all native check test chaos bench-transfer metrics-smoke tsan \
-	asan sanitize clean
+.PHONY: all native check test chaos bench bench-transfer metrics-smoke \
+	tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -39,6 +39,13 @@ chaos: native
 	  tests/test_failpoints.py tests/test_chaos.py \
 	  tests/test_object_transfer.py -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
+
+# Full microbenchmark suite; persists BENCH_RESULT.json and regenerates
+# the README table from it in the same run, so the committed table can
+# never lag the artifact it names (tests/test_bench_table.py enforces).
+bench: native
+	JAX_PLATFORMS=cpu python bench.py
+	python scripts/gen_bench_table.py --write
 
 # Quick transfer-plane microbench (broadcast + multi-client put) with a
 # one-line JSON delta vs the newest BENCH_r*.json baseline artifact.

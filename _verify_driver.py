@@ -66,6 +66,65 @@ for a in actors:
 assert all(ray_tpu.get(a.all.remote()) == [0, 1, 2, 3, 4] for a in actors)
 t("8 actors, ordered calls", s0)
 
+# PR 5: arm the continuous profiler cluster-wide, run a busy named task
+# and a 3-task chain under it (profile + analyzer checked further down,
+# after the flush loops have had time to land the window)
+s0 = time.perf_counter()
+from ray_tpu.core.worker import global_worker  # noqa: E402
+
+_w = global_worker()
+_reply = _w.gcs_call("profiler_control",
+                     {"enabled": True, "hz": 100.0, "duration_s": 6.0})
+assert _reply["nodes_applied"] >= 1, _reply
+
+
+@ray_tpu.remote
+def busy_loop(seconds):
+    end = time.time() + seconds
+    while time.time() < end:
+        sum(range(2500))
+    return True
+
+
+@ray_tpu.remote
+def chain_step(x):
+    time.sleep(0.3)
+    return x + 1
+
+
+# busy task first, chain strictly after — the chain must be the job's
+# last-finishing work for the critical-path assertion below
+assert ray_tpu.get(busy_loop.remote(1.5), timeout=60)
+_chain = chain_step.remote(chain_step.remote(chain_step.remote(0)))
+assert ray_tpu.get(_chain, timeout=60) == 3
+t("profiler armed + busy/chain tasks", s0)
+
+# analyzer check runs NOW, while the chain is still the job's last-
+# finishing work — later stages (shuffle/tune/serve) would rightly
+# steal the critical path
+s0 = time.perf_counter()
+from ray_tpu.experimental.state import analyze as analyze_mod  # noqa: E402
+
+_job = _w.job_id.hex()
+_result, _deadline = {}, time.time() + 25
+while time.time() < _deadline:
+    _result = analyze_mod.analyze_job(_job)
+    _tail = _result.get("critical_path", [])[-3:]
+    if not _result.get("error") and len(_tail) == 3 and all(
+            "chain_step" in (seg["name"] or "") for seg in _tail):
+        break
+    time.sleep(0.5)
+assert len(_result.get("critical_path", [])) >= 3, _result
+_tail = _result["critical_path"][-3:]
+assert all("chain_step" in (seg["name"] or "") for seg in _tail), _tail
+for seg in _tail:
+    assert seg["total"] >= 0.28, seg  # each link runs a 0.3s body
+_covered = _result["critical_path_s"] + _result["lead_in_s"]
+assert abs(_covered - _result["makespan_s"]) <= max(
+    0.05, 0.1 * _result["makespan_s"]), _result
+print(analyze_mod.summary_line(_result))
+t("analyze: 3-task chain critical path telescopes to makespan", s0)
+
 # data pipeline with all-to-all shuffle over the object plane
 s0 = time.perf_counter()
 import ray_tpu.data  # noqa: E402
@@ -119,6 +178,30 @@ with urllib.request.urlopen(req, timeout=30) as resp:
     body = resp.read().decode()
 assert "tpu" in body, body
 t("serve + HTTP", s0)
+
+# PR 5: merged profile carries frames attributed to the named remote
+# function; the analyzer's critical path telescopes to the makespan
+s0 = time.perf_counter()
+from ray_tpu.core import profiler as profiler_mod  # noqa: E402
+
+_deadline = time.time() + 20
+_prof, _attributed = {}, []
+while time.time() < _deadline:
+    _prof = _w.gcs_call("get_profile", {})
+    _attributed = [r for r in _prof["records"]
+                   if "busy_loop" in (r.get("task") or "")]
+    if _attributed:
+        break
+    time.sleep(0.5)
+assert _attributed, "no samples attributed to busy_loop"
+_collapsed = profiler_mod.to_collapsed(_prof["records"])
+assert "task:__main__.busy_loop" in _collapsed
+_sc = profiler_mod.to_speedscope(_prof["records"])
+assert _sc["profiles"][0]["weights"], "speedscope profile empty"
+t(f"profile merged ({_prof['total_samples']} samples, "
+  f"{len(_prof['sources'])} procs, busy_loop attributed)", s0)
+
+_w.gcs_call("profiler_control", {"enabled": False})
 
 s0 = time.perf_counter()
 ray_tpu.shutdown()

@@ -164,6 +164,19 @@ class Config:
     #: GCS-side ring of transfer/RPC spans served to ``timeline()``.
     telemetry_spans_table_size: int = 20000
 
+    # ---- continuous profiling (core/profiler.py) -------------------------
+    #: Start every process's sampling profiler at boot (always-on mode).
+    #: Off by default: the runtime pays ZERO profiling cost unless this
+    #: is set or ``ray-tpu profile`` arms the cluster at runtime.
+    profiler_enabled: bool = False
+    #: Stack samples per second while profiling is active.
+    profiler_hz: float = 25.0
+    #: Per-process cap on distinct (task, stack) fold keys between
+    #: flushes; overflow samples are counted, not stored.
+    profiler_max_stacks: int = 2000
+    #: GCS-side ring of profile records served by ``get_profile``.
+    profiler_table_size: int = 50000
+
     def apply_env_overrides(self) -> "Config":
         for f in fields(self):
             env = os.environ.get(_ENV_PREFIX + f.name.upper())

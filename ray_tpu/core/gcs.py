@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
 from ray_tpu.core.config import Config
@@ -158,6 +159,14 @@ class GcsServer:
         from collections import deque as _dq
         self._spans: "_dq" = _dq(maxlen=getattr(
             config, "telemetry_spans_table_size", 20000))
+        # continuous-profiling ring (report_profile producer records,
+        # served merged by get_profile) + eviction accounting
+        self._profile: "_dq" = _dq(maxlen=getattr(
+            config, "profiler_table_size", 50000))
+        self._profile_evicted = 0
+        #: live cluster profiling window ({enabled, hz, deadline}) for
+        #: raylets that register mid-window
+        self._profiler_state: Optional[Dict[str, Any]] = None
         self._metrics_task: Optional[asyncio.Task] = None
         # durable tables behind the pluggable TableStorage interface
         # (reference: GcsTableStorage over Redis/in-memory store clients):
@@ -284,6 +293,8 @@ class GcsServer:
         self._metrics_task = asyncio.get_running_loop().create_task(
             self._metrics_flush_loop()
         )
+        # always-on profiling mode: the GCS process samples itself too
+        _prof.maybe_start_from_config()
         if getattr(self.config, "event_stats", True):
             from ray_tpu.util.event_stats import HandlerStats, LoopMonitor
             self.server.handler_stats = HandlerStats()
@@ -303,6 +314,8 @@ class GcsServer:
         out["task_event_drops"] = dict(self._task_event_drops)
         out["metrics_series"] = len(self._metrics)
         out["spans_buffered"] = len(self._spans)
+        out["profile_records"] = len(self._profile)
+        out["profile_records_evicted"] = self._profile_evicted
         return out
 
     # -- versioned resource broadcast (parity: ray_syncer.h:27-60 —
@@ -333,19 +346,31 @@ class GcsServer:
         period = max(0.25, getattr(self.config,
                                    "metrics_report_period_s", 5.0))
         while True:
-            await asyncio.sleep(period)
-            if not _tm.enabled():
+            await asyncio.sleep(min(period, 1.0) if _prof.pending()
+                                else period)
+            # profile records flush even with metrics disabled (the
+            # profiler is armed explicitly; same rule as the worker/
+            # raylet loops)
+            if not _tm.enabled() and not _prof.pending():
                 continue
             try:
-                _tm.set_gauge(
-                    "ray_tpu_gcs_subscriber_channels",
-                    "live pubsub channels on the GCS hub",
-                    len(self.subscribers))
-                _tm.presample()
-                self._ingest_metrics(metrics_mod.flush_all())
-                spans = _tm.drain_spans("gcs")  # offset 0 by definition
-                if spans:
-                    self._spans.extend(spans)
+                if _tm.enabled():
+                    _tm.set_gauge(
+                        "ray_tpu_gcs_subscriber_channels",
+                        "live pubsub channels on the GCS hub",
+                        len(self.subscribers))
+                    _tm.presample()
+                    self._ingest_metrics(metrics_mod.flush_all())
+                    spans = _tm.drain_spans("gcs")  # offset 0 by defn
+                    if spans:
+                        self._spans.extend(spans)
+                profile = _prof.drain()
+                if profile:
+                    for rec in profile:
+                        rec["node"] = "gcs"
+                        rec["source"] = "gcs"
+                    await self.handle_report_profile(
+                        None, {"records": profile})
             except Exception:
                 logger.exception("GCS-local metrics flush failed")
 
@@ -452,7 +477,20 @@ class GcsServer:
                                "address": info.raylet_address})
         self._mark_sync_dirty(node_id)
         logger.info("node %s registered: %s", node_id.hex()[:12], info.resources_total)
-        return {"config": self.config.to_json()}
+        # hand a raylet registering MID-profiling-window the remaining
+        # slice so its node doesn't show up as a gap in the profile
+        prof = None
+        state = self._profiler_state
+        if state and state.get("enabled"):
+            deadline = state.get("deadline")
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is None or remaining > 0:
+                prof = {"enabled": True, "hz": state.get("hz"),
+                        "duration_s": remaining}
+            else:
+                self._profiler_state = None
+        return {"config": self.config.to_json(), "profiler": prof}
 
     async def handle_health_report(self, conn, data):
         # failpoint: a stalled/failed heartbeat ack — raylets must ride
@@ -768,13 +806,100 @@ class GcsServer:
         correct their span timestamps onto the GCS wall clock."""
         return {"time": time.time()}
 
+    # ------------------------------------------------------------------
+    # continuous profiling plane (core/profiler.py)
+    # ------------------------------------------------------------------
+    async def handle_report_profile(self, conn, data):
+        # failpoint: the profile ingest drops a batch — the reporter
+        # must not notice (drop-don't-block), only the ring is poorer
+        if _fp.active() and _fp.failpoint("gcs.report_profile.drop"):
+            return True
+        records = data.get("records", [])
+        overflow = len(self._profile) + len(records) \
+            - (self._profile.maxlen or 0)
+        if overflow > 0:
+            # deque eviction is silent data loss for get_profile —
+            # count it (debug_state + metrics) like task-event drops
+            self._profile_evicted += overflow
+            _tm.profiler_records_evicted(overflow)
+        self._profile.extend(records)
+        return True
+
+    async def handle_get_profile(self, conn, data):
+        """Merged profile view: fold every reporting process's records
+        into one (stack, task, job)-keyed count table (the cluster
+        flamegraph), optionally filtered by job / node / window."""
+        from ray_tpu.core import profiler as profiler_mod
+
+        data = data or {}
+        job = data.get("job")
+        node = data.get("node")
+        since = data.get("since")
+        limit = data.get("limit") or 10000
+        rows = [r for r in self._profile
+                if (job is None or r.get("job") == job)
+                and (node is None
+                     or (r.get("node") or "").startswith(node))
+                and (since is None or r.get("end", 0) >= since)]
+        sources = sorted({(r.get("node"), r.get("pid"))
+                          for r in rows})
+        merged = profiler_mod.merge_records(rows)[:limit]
+        return {"records": merged,
+                "total_samples": sum(r.get("count", 0) for r in merged),
+                "sources": [{"node": n, "pid": p} for n, p in sources],
+                "raw_records": len(rows)}
+
+    async def handle_profiler_control(self, conn, data):
+        """Arm/disarm the cluster profiling window: applies to the GCS
+        process itself, then fans out to every alive raylet (each
+        raylet fans out to its own workers)."""
+        from ray_tpu.core import profiler as profiler_mod
+
+        enabled = bool(data["enabled"])
+        hz = data.get("hz")
+        duration = data.get("duration_s")
+        profiler_mod.configure(enabled, hz=hz, duration_s=duration)
+        self._profiler_state = {
+            "enabled": enabled, "hz": hz,
+            "deadline": (time.monotonic() + float(duration)
+                         if enabled and duration else None),
+        } if enabled else None
+
+        async def one(node):
+            conn2 = self._node_conns.get(node.node_id)
+            if conn2 is None or conn2.closed:
+                return None
+            try:
+                return await asyncio.wait_for(
+                    conn2.call("profiler_control", data), 10.0)
+            except Exception:  # noqa: BLE001 — best-effort fan-out
+                return None
+        replies = await asyncio.gather(
+            *(one(n) for n in list(self.nodes.values()) if n.alive))
+        applied = [r for r in replies if r]
+        return {"nodes_applied": len(applied),
+                "workers_applied": sum(r.get("workers_applied", 0)
+                                       for r in applied)}
+
     async def handle_list_jobs(self, conn, data):
         return [{"job_id": jid.hex(), **{k: v for k, v in j.items()}}
                 for jid, j in self.jobs.items()]
 
     async def handle_get_task_events(self, conn, data):
+        """Task-event rows, newest-last.  ``job_id``/``state`` filters
+        and the limit apply HERE so consumers (state API list_tasks,
+        the analyzer) stop shipping the whole ring over the wire and
+        filtering client-side."""
+        data = data or {}
         limit = data.get("limit", 1000)
-        return self._task_events[-limit:]
+        job_id = data.get("job_id")
+        state = data.get("state")
+        if job_id is None and state is None:
+            return self._task_events[-limit:]
+        out = [ev for ev in self._task_events
+               if (job_id is None or ev.get("job_id") == job_id)
+               and (state is None or ev.get("state") == state)]
+        return out[-limit:]
 
     async def handle_get_cluster_stats(self, conn, data):
         """Cheap scalar gauges for the metrics surface (one dict, not a

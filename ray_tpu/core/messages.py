@@ -143,6 +143,13 @@ register_schema("clock_sync")
 register_schema("get_metrics")
 register_schema("get_spans", cat=Opt(str), limit=Opt(int))
 
+# continuous profiling plane (core/profiler.py)
+register_schema("report_profile", records=list)
+register_schema("get_profile", job=Opt(str), node=Opt(str),
+                since=Opt(float), limit=Opt(int))
+register_schema("profiler_control", enabled=bool, hz=Opt(float),
+                duration_s=Opt(float))
+
 # introspection / state surface (payload-free or optional-only reads)
 register_schema("ping")
 register_schema("debug_state")          # served by both GCS and raylet
@@ -155,7 +162,8 @@ register_schema("list_placement_groups")
 register_schema("list_workers")
 register_schema("list_events", limit=Opt(int), severity=Opt(str))
 register_schema("list_objects", limit=Opt(int))
-register_schema("get_task_events", limit=Opt(int))
+register_schema("get_task_events", limit=Opt(int), job_id=Opt(str),
+                state=Opt(str))
 register_schema("store_info")
 register_schema("store_stats")
 register_schema("stack_trace")          # one worker's dump

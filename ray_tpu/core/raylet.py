@@ -37,6 +37,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
 from ray_tpu.core.config import Config
@@ -327,6 +328,10 @@ class Raylet:
         self._env_broken: Dict[str, str] = {}
         self._pending_leases: List[PendingLease] = []
         self._register_waiters: List[asyncio.Future] = []
+        # cluster profiling window state (profiler_control): kept so
+        # workers that register MID-window join it via the register
+        # reply instead of sampling nothing
+        self._profiler_state: Optional[Dict[str, Any]] = None
         max_workers = config.max_workers_per_node
         self._max_workers = max_workers if max_workers > 0 else int(
             4 * self.resources_total.get("CPU", 1))
@@ -368,7 +373,11 @@ class Raylet:
     async def start(self) -> rpc.Address:
         address = await self.server.start()
         self.address = address
-        self.gcs_conn = await rpc.connect(self.gcs_address)
+        # carry our handler so the GCS can call back over the
+        # registration link (profiler_control fan-out) without opening
+        # a second connection
+        self.gcs_conn = await rpc.connect(self.gcs_address,
+                                          handler=self.server)
         reply = await self.gcs_conn.call("register_node", {
             "node_id": self.node_id.binary(),
             "raylet_address": address,
@@ -378,6 +387,16 @@ class Raylet:
         })
         # adopt the cluster-wide config decided by the head node
         self.config = Config.from_json(reply["config"])
+        # join an in-progress cluster profiling window (node added
+        # mid-`ray-tpu profile`)
+        prof = reply.get("profiler")
+        if prof and prof.get("enabled"):
+            _prof.configure(True, hz=prof.get("hz"),
+                            duration_s=prof.get("duration_s"))
+            self._profiler_state = {
+                "enabled": True, "hz": prof.get("hz"),
+                "deadline": (time.monotonic() + prof["duration_s"]
+                             if prof.get("duration_s") else None)}
         # adopt cluster-armed failpoints (see util/failpoint.py; no-op
         # unless a chaos test armed sites in the GCS KV)
         await _fp.sync_from_kv(self.gcs_conn)
@@ -406,6 +425,9 @@ class Raylet:
         self._tasks.append(loop.create_task(self._reap_loop()))
         self._tasks.append(loop.create_task(self._log_monitor_loop()))
         self._tasks.append(loop.create_task(self._metrics_flush_loop()))
+        # always-on profiling mode (profiler_enabled): sample this
+        # raylet's own loop/executor threads too
+        _prof.maybe_start_from_config()
         if self.config.memory_monitor_refresh_ms > 0 and \
                 self.config.memory_usage_threshold > 0:
             self._tasks.append(
@@ -533,7 +555,8 @@ class Raylet:
 
     async def _try_gcs_reconnect(self) -> bool:
         try:
-            conn = await rpc.connect(self.gcs_address, timeout=3.0)
+            conn = await rpc.connect(self.gcs_address, timeout=3.0,
+                                     handler=self.server)
             reply = await conn.call("register_node", {
                 "node_id": self.node_id.binary(),
                 "raylet_address": list(self.address),
@@ -1046,7 +1069,8 @@ class Raylet:
             # drivers use the object plane but never join the worker pool
             conn.context["is_driver"] = True
             return {"node_id": self.node_id.binary(),
-                    "config": self.config.to_json()}
+                    "config": self.config.to_json(),
+                    "profiler": self._profiler_handoff()}
         worker = WorkerHandle(
             worker_id=WorkerID(data["worker_id"]),
             pid=data["pid"],
@@ -1083,7 +1107,58 @@ class Raylet:
         self._idle.append(worker)
         self._maybe_schedule()
         return {"node_id": self.node_id.binary(),
-                "config": self.config.to_json()}
+                "config": self.config.to_json(),
+                "profiler": self._profiler_handoff()}
+
+    def _profiler_handoff(self) -> Optional[Dict[str, Any]]:
+        """Profiler state for a registering worker: the remaining slice
+        of an in-progress window, or None when not profiling."""
+        state = self._profiler_state
+        if not state or not state.get("enabled"):
+            return None
+        deadline = state.get("deadline")
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._profiler_state = None
+                return None
+        return {"enabled": True, "hz": state.get("hz"),
+                "remaining_s": remaining}
+
+    async def handle_profiler_control(self, conn, data):
+        """Apply a cluster profiling window to this node: the raylet's
+        own sampler plus a best-effort fan-out to every live worker
+        (dead/wedged workers are exactly what the profile should not
+        block on)."""
+        enabled = bool(data["enabled"])
+        hz = data.get("hz")
+        duration = data.get("duration_s")
+        _prof.configure(enabled, hz=hz, duration_s=duration)
+        self._profiler_state = {
+            "enabled": enabled, "hz": hz,
+            "deadline": (time.monotonic() + float(duration)
+                         if enabled and duration else None),
+        } if enabled else None
+
+        async def one(conn2):
+            try:
+                await asyncio.wait_for(
+                    conn2.call("profiler_control", data), 5.0)
+                return True
+            except Exception:  # noqa: BLE001 — best effort
+                return False
+
+        # workers by handle, plus DRIVER registration conns (drivers
+        # never join the pool, but a training driver's loop is often
+        # exactly the thing worth sampling)
+        targets = [w.conn for w in self.workers.values()]
+        targets += [c for c in self.server.connections
+                    if c.context.get("is_driver") and not c.closed]
+        results = await asyncio.gather(*(one(c) for c in targets))
+        return {"node_id": self.node_id.hex(),
+                "workers_applied": sum(1 for r in results if r),
+                "workers_total": len(results)}
 
     def on_disconnection(self, conn) -> None:
         # release transfer pins a crashed/vanished puller left behind —
@@ -1810,8 +1885,14 @@ class Raylet:
         synced_conn = None  # re-probe on failure AND after a reconnect
         source = f"raylet-{self.node_id.hex()[:12]}"
         while not self._closing:
-            await asyncio.sleep(period)
-            if not _tm.enabled():
+            # active profiling flushes at >= 1 Hz (short windows must
+            # not wait out the 5 s metrics period)
+            await asyncio.sleep(min(period, 1.0) if _prof.pending()
+                                else period)
+            # profile records flush even with metrics disabled: the
+            # profiler is armed explicitly, and skipping drain here
+            # would also leave pending() true -> 1 Hz ticks forever
+            if not _tm.enabled() and not _prof.pending():
                 continue
             conn = self.gcs_conn
             if conn is None or conn.closed:
@@ -1821,16 +1902,27 @@ class Raylet:
                 if await _tm.measure_clock_offset(conn) is not None:
                     synced_conn = conn
             try:
-                self._sample_gauges()
-                _tm.presample()
-                records = metrics_mod.flush_all()
-                spans = _tm.drain_spans(source)
+                records: list = []
+                spans: list = []
+                if _tm.enabled():
+                    self._sample_gauges()
+                    _tm.presample()
+                    records = metrics_mod.flush_all()
+                    spans = _tm.drain_spans(source)
+                profile = _prof.drain()
                 if records:
                     await conn.call("report_metrics",
                                     {"records": records}, timeout=2.0)
                 if spans:
                     await conn.call("report_spans", {"spans": spans},
                                     timeout=2.0)
+                if profile:
+                    node = self.node_id.hex()
+                    for rec in profile:
+                        rec["node"] = node
+                        rec["source"] = source
+                    await conn.call("report_profile",
+                                    {"records": profile}, timeout=2.0)
             except (rpc.ConnectionLost, rpc.RpcError,
                     asyncio.TimeoutError, OSError):
                 pass  # dropped: counters re-accumulate, gauges refresh
@@ -1861,8 +1953,10 @@ class Raylet:
         return out
 
     async def handle_stack_traces(self, conn, data):
-        """All-thread stack dumps from every worker on this node
-        (parity: the dashboard reporter's py-spy fan-out)."""
+        """All-thread stack dumps from every worker on this node PLUS
+        the raylet process itself (parity: the dashboard reporter's
+        py-spy fan-out; the raylet's own loop is where transfer/lease
+        wedges live, so `ray-tpu stack` must see it too)."""
         async def one(worker):
             try:
                 return await asyncio.wait_for(
@@ -1871,9 +1965,16 @@ class Raylet:
                 return {"pid": worker.pid,  # exactly what you're hunting
                         "error": f"{type(e).__name__}: {e}"}
 
+        import threading
+        import traceback
+        names = {t.ident: t.name for t in threading.enumerate()}
+        own = [{"thread": names.get(ident, str(ident)),
+                "stack": "".join(traceback.format_stack(frame))}
+               for ident, frame in sys._current_frames().items()]
         dumps = await asyncio.gather(
             *(one(w) for w in list(self.workers.values())))
-        return {"node_id": self.node_id.hex(), "workers": dumps}
+        return {"node_id": self.node_id.hex(), "workers": dumps,
+                "raylet": {"pid": os.getpid(), "threads": own}}
 
     async def handle_list_workers(self, conn, data):
         return [{"worker_id": w.worker_id.hex(), "pid": w.pid,

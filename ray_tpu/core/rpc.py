@@ -354,7 +354,21 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 self._end = n
                 avail = len(buf) - n
             while avail < self._MIN_READ:
-                buf += bytes(len(buf))  # double
+                try:
+                    buf += bytes(len(buf))  # double in place
+                except BufferError:
+                    # someone still exports a view of this buffer (an
+                    # arena sink mid-copy on another conn's frame, a
+                    # transport read view): bytearray resize is illegal
+                    # with live exports, so move the unparsed region to
+                    # a fresh buffer instead — the old one stays alive
+                    # (and intact) exactly as long as its exports do
+                    new = bytearray(max(len(buf) * 2, self._MIN_READ))
+                    n = self._end - self._start
+                    new[:n] = buf[self._start:self._end]
+                    self._buf = buf = new
+                    self._start = 0
+                    self._end = n
                 avail = len(buf) - self._end
         return memoryview(buf)[self._end:]
 

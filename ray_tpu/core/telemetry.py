@@ -300,6 +300,39 @@ def task_events_dropped(job_id: Optional[str], n: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# profiling plane (core/profiler.py / GCS profile ring)
+# ---------------------------------------------------------------------------
+
+def profiler_samples(n: int) -> None:
+    """Stack samples folded this window (called once per drain, never
+    per sample — the sampler keeps plain ints)."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_profiler_samples_total",
+             "profiler stack samples taken").inc_key(_EMPTY_KEY, float(n))
+
+
+def profiler_stack_drops(n: int) -> None:
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_profiler_stacks_dropped_total",
+             "profiler samples dropped by the per-process "
+             "profiler_max_stacks fold-table cap").inc_key(
+        _EMPTY_KEY, float(n))
+
+
+def profiler_records_evicted(n: int) -> None:
+    """GCS-side: profile records the ring evicted before any consumer
+    read them."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_profiler_records_evicted_total",
+             "profile records evicted from the GCS ring buffer "
+             "(raise profiler_table_size to keep more)").inc_key(
+        _EMPTY_KEY, float(n))
+
+
+# ---------------------------------------------------------------------------
 # gauges set by the flush loops (samplers run right before a flush)
 # ---------------------------------------------------------------------------
 

@@ -55,6 +55,7 @@ def _spec_dumps(obj) -> bytes:
     except Exception:  # e.g. an exotic strategy payload — keep working
         return cloudpickle.dumps(obj)
 
+from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
 from ray_tpu.core.config import Config, get_config, set_config
@@ -368,6 +369,10 @@ class CoreWorker:
         self._cancelled_exec: set = set()
         self._exec_track_lock = threading.Lock()
         self._executing_by_thread: Dict[int, bytes] = {}
+        # profiler attribution: thread ident -> (task name, task_id hex,
+        # actor hex, job hex) while that thread executes a task; the
+        # sampling profiler snapshots this dict each tick
+        self._executing_info: Dict[int, tuple] = {}
         self._interrupted_tasks: set = set()
         # owner side, recursive cancel: parent task -> child TaskIDs
         # submitted from inside its execution on this worker
@@ -382,6 +387,9 @@ class CoreWorker:
         # load env-armed failpoints up front: site checks (and the actor
         # fast-path gate) then reduce to one empty-dict truth test
         _fp.armed()
+        # profiler attribution provider: a dict() copy per sample tick
+        # (25 Hz), zero cost on the task hot path itself
+        _prof.set_task_info_provider(lambda: dict(self._executing_info))
         self._run(self._async_init())
         _mark("async_init")
         set_global_worker(self)
@@ -460,6 +468,15 @@ class CoreWorker:
         })
         set_config(Config.from_json(reply["config"]))
         self.config = get_config()
+        # join an in-progress cluster profiling window (workers spawned
+        # mid-`ray-tpu profile` must not appear as blank gaps), else
+        # honor the always-on config switch
+        prof_state = reply.get("profiler")
+        if prof_state and prof_state.get("enabled"):
+            _prof.configure(True, hz=prof_state.get("hz"),
+                            duration_s=prof_state.get("remaining_s"))
+        else:
+            _prof.maybe_start_from_config()
         if self.job_id is not None:
             self._bind_driver_context()
         self._flusher = self._loop.create_task(self._task_event_flush_loop())
@@ -1328,15 +1345,30 @@ class CoreWorker:
 
         frames = sys._current_frames()
         names = {t.ident: t.name for t in threading.enumerate()}
+        executing = dict(self._executing_info)
         out = []
         for ident, frame in frames.items():
             stack = "".join(traceback.format_stack(frame))
-            out.append({"thread": names.get(ident, str(ident)),
-                        "stack": stack})
+            entry = {"thread": names.get(ident, str(ident)),
+                     "stack": stack}
+            info = executing.get(ident)
+            if info is not None:
+                # task attribution (same table the profiler samples):
+                # `ray-tpu stack` names the task each thread is running
+                entry["task"] = info[0]
+                entry["task_id"] = info[1]
+            out.append(entry)
         return {"pid": os.getpid(),
                 "actor_id": self._actor_id.hex() if self._actor_id
                 else None,
                 "threads": out}
+
+    async def handle_profiler_control(self, conn, data):
+        """Runtime profiler switch (GCS -> raylet -> worker fan-out;
+        see ``ray-tpu profile``)."""
+        _prof.configure(bool(data["enabled"]), hz=data.get("hz"),
+                        duration_s=data.get("duration_s"))
+        return True
 
     async def handle_ping(self, conn, data):
         return {"worker_id": self.worker_id.hex(), "mode": self.mode,
@@ -2757,8 +2789,20 @@ class CoreWorker:
                     total[k] = total.get(k, 0.0) + v
         return total
 
+    def set_log_hook(self, hook) -> None:
+        """Route ``worker_logs`` pubsub batches to ``hook(message)``
+        instead of the default driver echo (``ray-tpu logs`` filters)."""
+        self._log_hook = hook
+
     def _on_gcs_push(self, channel: str, message: Any) -> None:
         if channel == "worker_logs":
+            hook = getattr(self, "_log_hook", None)
+            if hook is not None:
+                try:
+                    hook(message)
+                except Exception:  # noqa: BLE001 — consumer bug only
+                    logger.debug("log hook failed", exc_info=True)
+                return
             import sys as _sys
             node = message.get("node_id", "")
             for rec in message.get("records", []):
@@ -2811,11 +2855,21 @@ class CoreWorker:
     # task events (state API feed)
     # ------------------------------------------------------------------
     def _record_task_event(self, spec: TaskSpec, state: str) -> None:
-        # raw tuple on the hot path; formatted into dicts at flush time
+        # raw tuple on the hot path; formatted into dicts at flush time.
+        # PENDING rows carry lineage (submitting task + the tasks that
+        # produced ref args — ObjectIDs embed their producing TaskID),
+        # which is what `ray-tpu analyze` reconstructs the DAG from.
+        lineage = None
+        if state == "PENDING":
+            deps = [a.object_id.task_id() for a in spec.args
+                    if a.object_id is not None]
+            for a in spec.args:
+                deps.extend(c.task_id() for c in a.contained_ids)
+            lineage = (self._ctx.task_id, deps)
         self._task_events.append(
             (spec.task_id, spec.function_descriptor, state,
              spec.task_type, spec.actor_id, time.time(),
-             spec.attempt_number))
+             spec.attempt_number, lineage))
         # owner-side submit -> dispatch latency: PENDING stamps, RUNNING
         # observes; terminal states clear stamps of never-dispatched
         # tasks (cancelled / failed in queue) so the table can't grow
@@ -2833,18 +2887,26 @@ class CoreWorker:
         # same GCS-clock correction the span reporters apply, so task
         # rows and transfer/rpc spans share one timeline() timebase
         off = _tm.clock_offset()
-        return [{
-            "task_id": task_id.hex(),
-            "name": name,
-            "state": state,
-            "type": task_type.name,
-            "actor_id": actor_id.hex() if actor_id else None,
-            "time": ts + off,
-            "attempt": attempt,
-            "worker_id": wid,
-            "job_id": job,
-        } for task_id, name, state, task_type, actor_id, ts, attempt
-            in batch]
+        out = []
+        for (task_id, name, state, task_type, actor_id, ts, attempt,
+             lineage) in batch:
+            row = {
+                "task_id": task_id.hex(),
+                "name": name,
+                "state": state,
+                "type": task_type.name,
+                "actor_id": actor_id.hex() if actor_id else None,
+                "time": ts + off,
+                "attempt": attempt,
+                "worker_id": wid,
+                "job_id": job,
+            }
+            if lineage is not None:
+                parent, deps = lineage
+                row["parent_task_id"] = parent.hex() if parent else None
+                row["deps"] = sorted({d.hex() for d in deps})
+            out.append(row)
+        return out
 
     async def _task_event_flush_loop(self) -> None:
         while not self._shutdown:
@@ -2880,8 +2942,14 @@ class CoreWorker:
         source = f"{self.mode}-{self._worker_id_hex[:8]}"
         wid_tags = {"wid": self._worker_id_hex[:8]}
         while not self._shutdown:
-            await asyncio.sleep(period)
-            if not _tm.enabled():
+            # an active profiling window flushes at >= 1 Hz so a short
+            # `ray-tpu profile --duration 2` sees its samples arrive
+            await asyncio.sleep(min(period, 1.0) if _prof.pending()
+                                else period)
+            # profile records flush even with metrics disabled: the
+            # profiler is armed explicitly, and skipping drain here
+            # would also leave pending() true -> 1 Hz ticks forever
+            if not _tm.enabled() and not _prof.pending():
                 continue
             conn = self.gcs_conn
             if conn is None or conn.closed:
@@ -2891,19 +2959,30 @@ class CoreWorker:
                 if await _tm.measure_clock_offset(conn) is not None:
                     synced_conn = conn
             try:
-                _tm.set_gauge("ray_tpu_task_backlog",
-                              "tasks queued owner-side awaiting "
-                              "lease/dispatch", self._queued_task_depth(),
-                              wid_tags)
-                _tm.presample()
-                records = metrics_mod.flush_all()
-                spans = _tm.drain_spans(source)
+                records: list = []
+                spans: list = []
+                if _tm.enabled():
+                    _tm.set_gauge("ray_tpu_task_backlog",
+                                  "tasks queued owner-side awaiting "
+                                  "lease/dispatch",
+                                  self._queued_task_depth(), wid_tags)
+                    _tm.presample()
+                    records = metrics_mod.flush_all()
+                    spans = _tm.drain_spans(source)
+                profile = _prof.drain()
                 if records:
                     await conn.call("report_metrics",
                                     {"records": records}, timeout=2.0)
                 if spans:
                     await conn.call("report_spans", {"spans": spans},
                                     timeout=2.0)
+                if profile:
+                    node = self.node_id.hex()
+                    for rec in profile:
+                        rec["node"] = node
+                        rec["source"] = source
+                    await conn.call("report_profile",
+                                    {"records": profile}, timeout=2.0)
             except (rpc.ConnectionLost, rpc.RpcError,
                     asyncio.TimeoutError, OSError):
                 pass  # dropped: counters re-accumulate next window
@@ -3372,7 +3451,13 @@ class CoreWorker:
                 self._cancelled_exec.discard(tid_bin)
                 self._stream_emitters.pop(tid_bin, None)
                 return self._cancelled_reply(spec)
-            self._executing_by_thread[threading.get_ident()] = tid_bin
+            ident = threading.get_ident()
+            self._executing_by_thread[ident] = tid_bin
+            self._executing_info[ident] = (
+                spec.function_descriptor, spec.task_id.hex(),
+                spec.actor_id.hex() if spec.actor_id else None,
+                spec.job_id.hex() if spec.job_id else None)
+        exec_t0 = None  # stamped AFTER arg resolution (fetch != exec)
         prev = (self._ctx.task_id, self._ctx.put_counter,
                 self._ctx.attempt_number, self._ctx.current_resources)
         self._ctx.task_id = spec.task_id
@@ -3386,6 +3471,9 @@ class CoreWorker:
             self._apply_job_syspath(spec.job_id)
             self._ensure_runtime_env(spec)
             args, kwargs = self._resolve_args(spec)
+            # body start: env setup + network arg pulls above belong to
+            # the analyzer's 'fetch' phase, not 'exec'
+            exec_t0 = time.time()
             fn = self._resolve_callable(spec)
             if spec.trace_context is not None:
                 from ray_tpu.util.tracing.tracing_helper import \
@@ -3443,10 +3531,24 @@ class CoreWorker:
             return {"results": results, "app_error": True}
         finally:
             INTERRUPT_WINDOW.open = False
+            # executor-side exec span: the analyzer splits RUNNING ->
+            # FINISHED into fetch/exec/reply phases with this (spans
+            # are clock-corrected at drain, same timebase as events).
+            # exec_t0 is None when env/arg resolution itself failed —
+            # no body ran, so no span.
+            if exec_t0 is not None:
+                _tm.record_span("task_exec", spec.function_descriptor,
+                                exec_t0, time.time(),
+                                task_id=spec.task_id.hex(),
+                                attempt=spec.attempt_number,
+                                job=spec.job_id.hex() if spec.job_id
+                                else None)
             (self._ctx.task_id, self._ctx.put_counter,
              self._ctx.attempt_number, self._ctx.current_resources) = prev
             with self._exec_track_lock:
-                self._executing_by_thread.pop(threading.get_ident(), None)
+                ident = threading.get_ident()
+                self._executing_by_thread.pop(ident, None)
+                self._executing_info.pop(ident, None)
                 self._interrupted_tasks.discard(tid_bin)
             self._stream_emitters.pop(tid_bin, None)  # errored pre-yield
 

@@ -210,6 +210,41 @@ class Dashboard:
         except Exception:  # noqa: BLE001 — agents are optional
             return {}
 
+    async def handle_profile(self, request):
+        """Merged continuous-profiling view from the GCS ring.  Query
+        params: job, node, since (epoch s), format=json|collapsed|
+        speedscope (speedscope output loads directly at
+        https://speedscope.app)."""
+        from ray_tpu.core import profiler as profiler_mod
+        from ray_tpu.core import worker as worker_mod
+
+        job = request.query.get("job")
+        node = request.query.get("node")
+        since = request.query.get("since")
+        fmt = request.query.get("format", "json")
+
+        def fetch():
+            core = worker_mod.global_worker()
+            return core.gcs_call("get_profile", {
+                "job": job, "node": node,
+                "since": float(since) if since else None})
+        profile = await self._state(fetch)
+        if fmt == "collapsed":
+            return web.Response(
+                text=profiler_mod.to_collapsed(profile["records"]),
+                content_type="text/plain")
+        if fmt == "speedscope":
+            return self._json(profiler_mod.to_speedscope(
+                profile["records"]))
+        return self._json(profile)
+
+    async def handle_analyze(self, request):
+        """Job time-attribution analysis (?job=<hex>, default latest)."""
+        from ray_tpu.experimental.state import analyze as analyze_mod
+
+        job = request.query.get("job")
+        return self._json(await self._state(analyze_mod.analyze_job, job))
+
     async def handle_metrics(self, request):
         from ray_tpu.core import worker as worker_mod
 
@@ -263,6 +298,9 @@ class Dashboard:
         app.router.add_get("/api/serve/applications", self.handle_serve)
         app.router.add_get("/api/events", self.handle_events)
         app.router.add_get("/api/node_stats", self.handle_node_stats)
+        app.router.add_get("/api/profile", self.handle_profile)
+        app.router.add_get("/profile", self.handle_profile)
+        app.router.add_get("/api/analyze", self.handle_analyze)
         app.router.add_get("/metrics", self.handle_metrics)
         try:
             from ray_tpu.job.job_head import add_job_routes

@@ -75,10 +75,31 @@ def list_jobs(filters=None, limit: int = 1000) -> List[Dict[str, Any]]:
 def list_tasks(filters=None, limit: int = 1000,
                latest_state_only: bool = True) -> List[Dict[str, Any]]:
     """Task rows from the GCS task-event buffer; by default one row per
-    task attempt, carrying its latest state."""
-    events = _core().gcs_call("get_task_events", {"limit": 100_000})
+    task attempt, carrying its latest state.
+
+    ``job_id``/``state`` equality filters are pushed down into the GCS
+    handler so a busy cluster ships matching rows, not the whole ring
+    (state only in raw-event mode: filtering events by state BEFORE the
+    latest-state fold would resurrect superseded states)."""
+    query: Dict[str, Any] = {"limit": 100_000}
+    remaining = []
+    for key, op, value in filters or []:
+        if op == "=" and key == "job_id" and "job_id" not in query:
+            query["job_id"] = str(value)
+        elif op == "=" and key == "state" and not latest_state_only \
+                and "state" not in query:
+            query["state"] = str(value)
+        else:
+            remaining.append((key, op, value))
+    filters = remaining
     if not latest_state_only:
+        # NOTE: the GCS applies `limit` to the TAIL (newest rows) while
+        # this API has always truncated the HEAD of the filtered set —
+        # so ship the filters down but keep the wide fetch limit and
+        # truncate client-side to preserve oldest-first semantics
+        events = _core().gcs_call("get_task_events", query)
         return _apply_filters(events, filters)[:limit]
+    events = _core().gcs_call("get_task_events", query)
     latest: Dict[tuple, Dict[str, Any]] = {}
     for ev in events:
         key = (ev["task_id"], ev.get("attempt", 0))
@@ -171,6 +192,22 @@ def list_spans(cat: Optional[str] = None, limit: int = 20000
     GCS span table; timestamps are already corrected onto the GCS
     clock by the reporting process."""
     return _core().gcs_call("get_spans", {"cat": cat, "limit": limit})
+
+
+def get_profile(job: Optional[str] = None, node: Optional[str] = None,
+                since: Optional[float] = None,
+                limit: Optional[int] = None) -> Dict[str, Any]:
+    """Merged continuous-profiling records from the GCS ring (see
+    core/profiler.py; ``ray-tpu profile`` / dashboard ``/profile``)."""
+    return _core().gcs_call("get_profile", {
+        "job": job, "node": node, "since": since, "limit": limit})
+
+
+def analyze(job: Optional[str] = None) -> Dict[str, Any]:
+    """Job time-attribution analysis (critical path + phase breakdown;
+    see experimental/state/analyze.py)."""
+    from ray_tpu.experimental.state import analyze as analyze_mod
+    return analyze_mod.analyze_job(job)
 
 
 def task_event_drops() -> Dict[str, Any]:

@@ -211,6 +211,15 @@ def cmd_status(args) -> None:
     if drops:
         print(f"WARNING: {drops} task events dropped by the GCS ring "
               f"buffer (per-job: {gcs_dbg.get('task_event_drops')})")
+    # one-line time attribution of the most recent job (full breakdown
+    # via `ray-tpu analyze`)
+    try:
+        from ray_tpu.experimental.state import analyze as analyze_mod
+        result = analyze_mod.analyze_job()
+        if not result.get("error"):
+            print(analyze_mod.summary_line(result))
+    except Exception:  # noqa: BLE001 — status must survive a quiet GCS
+        pass
 
 
 def cmd_events(args) -> None:
@@ -374,6 +383,13 @@ def cmd_stack(args) -> None:
             continue
         print(f"=== node {dump['node_id'][:12]} "
               f"({len(dump['workers'])} workers) ===")
+        raylet = dump.get("raylet")
+        if raylet:
+            print(f"--- raylet pid {raylet.get('pid')} ---")
+            for t in raylet.get("threads", []):
+                print(f"  thread {t['thread']}:")
+                for line in t["stack"].rstrip().splitlines():
+                    print(f"    {line}")
         for wk in dump["workers"]:
             head = f"--- pid {wk.get('pid')}"
             if wk.get("actor_id"):
@@ -383,9 +399,123 @@ def cmd_stack(args) -> None:
                 print(f"  <{wk['error']}>")
                 continue
             for t in wk.get("threads", []):
-                print(f"  thread {t['thread']}:")
+                head = f"  thread {t['thread']}"
+                if t.get("task"):
+                    head += (f" [task {t['task']}"
+                             f" {(t.get('task_id') or '')[:12]}]")
+                print(head + ":")
                 for line in t["stack"].rstrip().splitlines():
                     print(f"    {line}")
+
+
+def cmd_profile(args) -> None:
+    """Arm the cluster's continuous profiler for a window, then pull
+    the merged flamegraph (collapsed-stack + speedscope files)."""
+    _connect(args)
+    from ray_tpu.core import profiler as profiler_mod
+    from ray_tpu.core.worker import global_worker
+
+    w = global_worker()
+    duration = max(0.5, args.duration)
+    # the GCS profile ring keeps records from EARLIER windows; scope
+    # this pull to samples drained after the arm (GCS timebase)
+    window_start = w.gcs_call("clock_sync", {}).get("time")
+    reply = w.gcs_call("profiler_control", {
+        "enabled": True, "hz": args.hz, "duration_s": duration})
+    print(f"profiling {reply.get('nodes_applied', 0)} nodes / "
+          f"{reply.get('workers_applied', 0)} workers at "
+          f"{args.hz or 'default'} Hz for {duration:g}s ...")
+    time.sleep(duration)
+    # wait for the per-process flush loops (1 Hz while profiling) to
+    # land the tail of the window: poll until the ring stops growing
+    query = {"job": args.job, "node": args.node, "since": window_start}
+    prev = -1
+    deadline = time.time() + 15.0
+    profile = w.gcs_call("get_profile", query)
+    while time.time() < deadline:
+        if profile["raw_records"] > 0 and \
+                profile["raw_records"] == prev:
+            break
+        prev = profile["raw_records"]
+        time.sleep(1.0)
+        profile = w.gcs_call("get_profile", query)
+    records = profile["records"]
+    if not records:
+        sys.exit("no profile samples collected (cluster idle, or the "
+                 "window was too short)")
+    base = args.output
+    collapsed_path = base + ".collapsed"
+    speedscope_path = base + ".speedscope.json"
+    with open(collapsed_path, "w") as f:
+        f.write(profiler_mod.to_collapsed(records))
+    with open(speedscope_path, "w") as f:
+        json.dump(profiler_mod.to_speedscope(
+            records, name=f"ray_tpu {duration:g}s @ "
+                          f"{args.hz or 'default'} Hz"), f)
+    total = profile["total_samples"]
+    print(f"{total} samples from {len(profile['sources'])} processes, "
+          f"{len(records)} distinct stacks")
+    print(f"  collapsed:  {collapsed_path}")
+    print(f"  speedscope: {speedscope_path} "
+          f"(open at https://speedscope.app)")
+    print("top stacks:")
+    for rec in records[:args.top]:
+        leaf = (rec.get("stack") or "?").rsplit(";", 1)[-1]
+        task = f"  [{rec['task']}]" if rec.get("task") else ""
+        print(f"  {rec['count']:>6} ({rec['count']/total:5.1%}) "
+              f"{leaf}{task}")
+
+
+def cmd_analyze(args) -> None:
+    """Per-task time attribution of one job: critical path + phase
+    breakdown (pending->sched->fetch->exec->reply)."""
+    _connect(args)
+    from ray_tpu.experimental.state import analyze as analyze_mod
+
+    job = None if args.job in (None, "latest") else args.job
+    result = analyze_mod.analyze_job(job)
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(analyze_mod.format_report(result))
+
+
+def cmd_logs(args) -> None:
+    """Tail worker stdout/stderr cluster-wide off the ``worker_logs``
+    GCS channel (the raylet log monitors already publish; this is the
+    first consumer beyond the driver echo)."""
+    import re as re_mod
+
+    _connect(args)
+    from ray_tpu.core.worker import global_worker
+
+    w = global_worker()
+    pattern = re_mod.compile(args.grep) if args.grep else None
+
+    def show(message) -> None:
+        node = message.get("node_id", "")
+        if args.node and not node.startswith(args.node):
+            return
+        for rec in message.get("records", []):
+            if args.pid and rec.get("pid") != args.pid:
+                continue
+            stream = sys.stderr if rec.get("is_err") else sys.stdout
+            for line in rec.get("lines", []):
+                if pattern is not None and not pattern.search(line):
+                    continue
+                print(f"(pid={rec['pid']}, node={node}) {line}",
+                      file=stream, flush=True)
+
+    w.set_log_hook(show)
+    # idempotent when the driver already auto-subscribed (log_to_driver)
+    w.gcs_call("subscribe", {"channel": "worker_logs"})
+    print("tailing worker logs (ctrl-c to exit)", file=sys.stderr)
+    deadline = time.time() + args.duration if args.duration else None
+    try:
+        while deadline is None or time.time() < deadline:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
 
 
 def cmd_metrics_export_config(args) -> None:
@@ -429,6 +559,48 @@ def build_parser() -> argparse.ArgumentParser:
         "stack", help="all-thread stack dumps from every worker")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser(
+        "profile",
+        help="sample the whole cluster for a window and emit a merged "
+             "flamegraph (collapsed + speedscope)")
+    sp.add_argument("--duration", "-d", type=float, default=10.0,
+                    help="sampling window in seconds (default 10)")
+    sp.add_argument("--hz", type=float, default=None,
+                    help="samples/s per process (default: profiler_hz)")
+    sp.add_argument("--job", default=None,
+                    help="only samples attributed to this job (hex)")
+    sp.add_argument("--node", default=None,
+                    help="only samples from this node (hex prefix)")
+    sp.add_argument("--output", "-o", default="profile",
+                    help="output path prefix (default ./profile)")
+    sp.add_argument("--top", type=int, default=10,
+                    help="top stacks to print (default 10)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser(
+        "analyze",
+        help="job critical path + per-phase time attribution")
+    sp.add_argument("job", nargs="?", default="latest",
+                    help="job id hex (default: most recent job)")
+    sp.add_argument("--json", action="store_true",
+                    help="emit the raw analysis dict as JSON")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_analyze)
+
+    sp = sub.add_parser(
+        "logs", help="tail worker logs cluster-wide")
+    sp.add_argument("--node", default=None,
+                    help="only this node (hex prefix)")
+    sp.add_argument("--pid", type=int, default=None,
+                    help="only this worker pid")
+    sp.add_argument("--grep", default=None,
+                    help="only lines matching this regex")
+    sp.add_argument("--duration", type=float, default=None,
+                    help="stop after N seconds (default: until ctrl-c)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser(
         "metrics", help="metrics tooling")

@@ -43,6 +43,11 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_gcs_node_deaths_total",
     "ray_tpu_task_events_dropped_total",
     "ray_tpu_arena_doomed_objects",
+    # profiler series: the sampler is off by default (profiler_enabled /
+    # `ray-tpu profile` arm it), so a quiet boot exports none of them
+    "ray_tpu_profiler_samples_total",
+    "ray_tpu_profiler_stacks_dropped_total",
+    "ray_tpu_profiler_records_evicted_total",
 }
 
 

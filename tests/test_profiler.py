@@ -1,0 +1,402 @@
+"""Continuous profiling plane + job analyzer (docs/profiling.md).
+
+Unit layers (no cluster): sampler lifecycle/bounds, output formats, GCS
+profile-ring accounting, task-event filter pushdown.  Live layers: task
+attribution end-to-end on one node, merged ``get_profile`` across a
+2-node cluster, and the analyzer's critical path on a known 3-task
+chain whose phase sums must reproduce the task-event timestamps.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import profiler as profiler_mod
+
+
+# ---------------------------------------------------------------------------
+# sampler unit tests (no cluster)
+# ---------------------------------------------------------------------------
+
+def _busy_thread(stop_event):
+    def body():
+        while not stop_event.is_set():
+            sum(range(500))
+    t = threading.Thread(target=body, daemon=True)
+    t.start()
+    return t
+
+
+def test_sampler_start_stop_and_drain():
+    prof = profiler_mod.SamplingProfiler()
+    assert not prof.active()
+    stop = threading.Event()
+    _busy_thread(stop)
+    try:
+        prof.configure(True, hz=200.0)
+        assert prof.active()
+        deadline = time.time() + 5.0
+        while prof.samples_total == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert prof.samples_total > 0
+        records = prof.drain()
+        assert records, "active sampler produced no records"
+        rec = records[0]
+        for field in ("stack", "count", "pid", "start", "end", "thread"):
+            assert field in rec
+        assert rec["end"] >= rec["start"]
+        # stop() tears the thread down and disables
+        prof.stop()
+        assert not prof.active()
+        names = [t.name for t in threading.enumerate()]
+        assert "rtpu-profiler" not in names
+    finally:
+        stop.set()
+        prof.stop()
+
+
+def test_sampler_duration_deactivates():
+    prof = profiler_mod.SamplingProfiler()
+    try:
+        prof.configure(True, hz=100.0, duration_s=0.2)
+        assert prof.active()
+        time.sleep(0.5)
+        assert not prof.active()
+        # the window's folds are still drainable after deactivation
+        assert isinstance(prof.drain(), list)
+    finally:
+        prof.stop()
+
+
+def test_fold_table_bounded(monkeypatch):
+    monkeypatch.setattr(profiler_mod, "_max_stacks", lambda: 3)
+    prof = profiler_mod.SamplingProfiler()
+    stops = [threading.Event() for _ in range(6)]
+    try:
+        for s in stops:
+            _busy_thread(s)
+        prof.configure(True, hz=300.0)
+        deadline = time.time() + 5.0
+        while prof.stacks_dropped_total == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        with prof._lock:
+            assert len(prof._folds) <= 3
+        assert prof.stacks_dropped_total > 0, \
+            "overflow samples must be counted, not stored"
+    finally:
+        for s in stops:
+            s.set()
+        prof.stop()
+
+
+def test_profiler_off_by_default_is_noop():
+    from ray_tpu.core.config import Config
+    assert Config().profiler_enabled is False
+    # module-level helpers are no-ops with no singleton configured
+    assert profiler_mod.drain() == [] or True  # drain never raises
+    prof = profiler_mod.SamplingProfiler()
+    assert not prof.active()
+    assert prof.drain() == []
+    # no sampler thread exists until the first enable
+    assert prof._thread is None
+
+
+# ---------------------------------------------------------------------------
+# output formats (golden shape)
+# ---------------------------------------------------------------------------
+
+_RECORDS = [
+    {"stack": "main (a.py:1);work (a.py:9)", "count": 7,
+     "task": "mod.fn", "job": "01", "start": 10.0, "end": 11.0,
+     "pid": 1, "thread": "rtpu-exec"},
+    {"stack": "main (a.py:1);work (a.py:9)", "count": 3,
+     "task": "mod.fn", "job": "01", "start": 10.5, "end": 11.5,
+     "pid": 2, "thread": "rtpu-exec"},
+    {"stack": "main (a.py:1);idle (b.py:2)", "count": 5,
+     "task": None, "job": None, "start": 10.0, "end": 11.0,
+     "pid": 1, "thread": "rtpu-io"},
+]
+
+
+def test_merge_records_across_workers():
+    merged = profiler_mod.merge_records(_RECORDS)
+    assert len(merged) == 2
+    top = merged[0]
+    assert top["count"] == 10  # pids 1 + 2 folded
+    assert top["task"] == "mod.fn"
+    assert top["start"] == 10.0 and top["end"] == 11.5
+    assert "pid" not in top  # per-process identity gone after merge
+
+
+def test_collapsed_output_shape():
+    text = profiler_mod.to_collapsed(profiler_mod.merge_records(_RECORDS))
+    lines = text.strip().splitlines()
+    assert len(lines) == 2
+    # collapsed grammar: "frame;frame;... <count>", task as root frame
+    assert lines[0] == "task:mod.fn;main (a.py:1);work (a.py:9) 10"
+    assert lines[1].endswith(" 5")
+
+
+def test_speedscope_output_shape():
+    merged = profiler_mod.merge_records(_RECORDS)
+    sc = profiler_mod.to_speedscope(merged, name="t")
+    assert sc["$schema"].startswith("https://www.speedscope.app")
+    prof = sc["profiles"][0]
+    assert prof["type"] == "sampled"
+    assert len(prof["samples"]) == len(prof["weights"]) == len(merged)
+    assert prof["endValue"] == sum(prof["weights"]) == 15
+    # every sample's frame indices resolve in the shared frame table
+    n_frames = len(sc["shared"]["frames"])
+    assert all(0 <= i < n_frames
+               for sample in prof["samples"] for i in sample)
+    names = [f["name"] for f in sc["shared"]["frames"]]
+    assert "task:mod.fn" in names
+
+
+# ---------------------------------------------------------------------------
+# GCS unit layers (async handlers, no cluster)
+# ---------------------------------------------------------------------------
+
+def _gcs(config=None):
+    from ray_tpu.core.config import Config
+    from ray_tpu.core.gcs import GcsServer
+    cfg = config or Config()
+    cfg.gcs_table_storage = "memory"
+    return GcsServer(cfg)
+
+
+def test_profile_ring_bounded_and_eviction_counted():
+    from ray_tpu.core.config import Config
+
+    async def main():
+        cfg = Config()
+        cfg.profiler_table_size = 10
+        gcs = _gcs(cfg)
+        mk = lambda i: {"stack": f"s{i}", "count": 1, "job": "01",
+                        "node": "n1", "pid": 7, "end": float(i)}
+        await gcs.handle_report_profile(
+            None, {"records": [mk(i) for i in range(8)]})
+        assert gcs._profile_evicted == 0
+        await gcs.handle_report_profile(
+            None, {"records": [mk(i) for i in range(8, 14)]})
+        assert len(gcs._profile) == 10
+        assert gcs._profile_evicted == 4
+        dbg = await gcs.handle_debug_state(None, {})
+        assert dbg["profile_records_evicted"] == 4
+
+    asyncio.run(main())
+
+
+def test_get_profile_merges_and_filters():
+    async def main():
+        gcs = _gcs()
+        await gcs.handle_report_profile(None, {"records": [
+            {"stack": "a;b", "count": 2, "task": "f", "job": "01",
+             "node": "node1", "pid": 1, "start": 1.0, "end": 2.0},
+            {"stack": "a;b", "count": 3, "task": "f", "job": "01",
+             "node": "node2", "pid": 2, "start": 1.5, "end": 2.5},
+            {"stack": "a;c", "count": 1, "task": "g", "job": "02",
+             "node": "node1", "pid": 1, "start": 1.0, "end": 2.0},
+        ]})
+        out = await gcs.handle_get_profile(None, {})
+        assert out["raw_records"] == 3
+        assert len(out["sources"]) == 2
+        merged = {r["stack"]: r["count"] for r in out["records"]}
+        assert merged == {"a;b": 5, "a;c": 1}
+        only_job = await gcs.handle_get_profile(None, {"job": "01"})
+        assert {r["stack"] for r in only_job["records"]} == {"a;b"}
+        only_node = await gcs.handle_get_profile(None, {"node": "node2"})
+        assert only_node["total_samples"] == 3
+
+    asyncio.run(main())
+
+
+def test_get_task_events_filter_pushdown():
+    async def main():
+        gcs = _gcs()
+        mk = lambda i, job, state: {"task_id": f"t{i}", "state": state,
+                                    "time": float(i), "job_id": job}
+        await gcs.handle_report_task_events(None, {"events": [
+            mk(0, "a", "PENDING"), mk(1, "a", "FINISHED"),
+            mk(2, "b", "PENDING"), mk(3, "b", "FINISHED"),
+            mk(4, "b", "FINISHED")]})
+        rows = await gcs.handle_get_task_events(
+            None, {"limit": 100, "job_id": "a"})
+        assert [r["task_id"] for r in rows] == ["t0", "t1"]
+        rows = await gcs.handle_get_task_events(
+            None, {"limit": 100, "job_id": "b", "state": "FINISHED"})
+        assert [r["task_id"] for r in rows] == ["t3", "t4"]
+        # limit applies AFTER the filter (last N matching, not N scanned)
+        rows = await gcs.handle_get_task_events(
+            None, {"limit": 1, "job_id": "b", "state": "FINISHED"})
+        assert [r["task_id"] for r in rows] == ["t4"]
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# live single-node: attribution e2e + analyzer chain
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def profiled_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=192 * 1024 * 1024,
+                 _system_config={"metrics_report_period_s": 0.5})
+    yield None
+    ray_tpu.shutdown()
+
+
+def test_busy_task_attribution_end_to_end(profiled_cluster):
+    """A busy-looping remote task's frames arrive in get_profile tagged
+    with its function descriptor and job."""
+    from ray_tpu.core.worker import global_worker
+
+    @ray_tpu.remote
+    def burn(seconds):
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            sum(range(2000))
+        return True
+
+    w = global_worker()
+    reply = w.gcs_call("profiler_control",
+                       {"enabled": True, "hz": 100.0, "duration_s": 6.0})
+    assert reply["nodes_applied"] >= 1
+    assert ray_tpu.get(burn.remote(1.2), timeout=60)
+    deadline = time.time() + 20.0
+    attributed = []
+    while time.time() < deadline:
+        prof = w.gcs_call("get_profile", {})
+        attributed = [r for r in prof["records"]
+                      if "burn" in (r.get("task") or "")]
+        if attributed:
+            break
+        time.sleep(0.5)
+    assert attributed, "no samples attributed to the remote function"
+    rec = attributed[0]
+    assert rec["job"] == w.job_id.hex()
+    assert "burn" in rec["stack"]
+    w.gcs_call("profiler_control", {"enabled": False})
+
+
+def test_analyze_three_task_chain(profiled_cluster):
+    """c(b(a())): the analyzer must recover the 3-task critical path
+    from task events and telescope its phases to the job makespan."""
+    from ray_tpu.experimental.state import analyze as analyze_mod
+    from ray_tpu.core.worker import global_worker
+
+    @ray_tpu.remote
+    def step(x, tag):
+        time.sleep(0.3)
+        return x + 1
+
+    a = step.remote(0, "a")
+    b = step.remote(a, "b")
+    c = step.remote(b, "c")
+    assert ray_tpu.get(c, timeout=60) == 3
+    job = global_worker().job_id.hex()
+    # task events flush every 1s; spans every metrics period (0.5s)
+    result = {}
+    deadline = time.time() + 20.0
+    while time.time() < deadline:
+        result = analyze_mod.analyze_job(job)
+        if not result.get("error") and \
+                len(result["critical_path"]) >= 3:
+            break
+        time.sleep(0.5)
+    path = result["critical_path"]
+    assert len(path) >= 3, result
+    chain = path[-3:]
+    assert all("step" in seg["name"] for seg in chain)
+    # each link runs a 0.3s body: exec (or the whole segment when the
+    # span hasn't landed yet) must carry it
+    for seg in chain:
+        assert seg["total"] >= 0.28, seg
+    # phase sums reproduce the event timestamps: path + driver lead-in
+    # telescopes to the makespan within clock tolerance
+    covered = result["critical_path_s"] + result["lead_in_s"]
+    assert abs(covered - result["makespan_s"]) <= \
+        max(0.05, 0.1 * result["makespan_s"]), result
+    # phases of one segment sum to its total
+    seg = chain[-1]
+    assert abs(sum(seg["phases"].values()) - seg["total"]) < 1e-6
+
+
+def test_stack_dump_names_running_task(profiled_cluster):
+    """`ray-tpu stack`'s data path: a busy task's thread dump carries
+    its task attribution, and the raylet reports its own threads."""
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.experimental.state import api as state
+
+    @ray_tpu.remote
+    def hold(seconds):
+        time.sleep(seconds)
+        return True
+
+    ref = hold.remote(4.0)
+    time.sleep(1.0)
+    w = global_worker()
+    found_task = None
+    for n in state.list_nodes():
+        if n["state"] != "ALIVE":
+            continue
+        dump = w.raylet_call(tuple(n["address"]), "stack_traces", {})
+        assert dump["raylet"]["threads"], "raylet's own threads missing"
+        for wk in dump["workers"]:
+            for t in wk.get("threads", []):
+                if t.get("task") and "hold" in t["task"]:
+                    found_task = t
+    assert found_task is not None and found_task.get("task_id")
+    assert ray_tpu.get(ref, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# live 2-node: merged profile across nodes
+# ---------------------------------------------------------------------------
+
+def test_two_node_merged_profile():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2},
+                _system_config={"metrics_report_period_s": 0.5})
+    try:
+        c.add_node(num_cpus=2, resources={"side": 1})
+        c.connect()
+        c.wait_for_nodes()
+
+        @ray_tpu.remote(num_cpus=1)
+        def churn(seconds):
+            t0 = time.time()
+            while time.time() - t0 < seconds:
+                sum(range(2000))
+            return True
+
+        from ray_tpu.core.worker import global_worker
+        w = global_worker()
+        reply = w.gcs_call("profiler_control",
+                           {"enabled": True, "hz": 100.0,
+                            "duration_s": 8.0})
+        assert reply["nodes_applied"] >= 2, reply
+        side = churn.options(resources={"side": 1})
+        assert all(ray_tpu.get(
+            [churn.remote(1.5), side.remote(1.5)], timeout=120))
+        deadline = time.time() + 25.0
+        nodes_seen = set()
+        while time.time() < deadline:
+            prof = w.gcs_call("get_profile", {})
+            nodes_seen = {s["node"] for s in prof["sources"]}
+            if len(nodes_seen) >= 2 and any(
+                    "churn" in (r.get("task") or "")
+                    for r in prof["records"]):
+                break
+            time.sleep(0.5)
+        assert len(nodes_seen) >= 2, \
+            f"profile merged from one node only: {nodes_seen}"
+        assert any("churn" in (r.get("task") or "")
+                   for r in prof["records"])
+    finally:
+        c.shutdown()
