@@ -6,8 +6,8 @@
 #   make tsan   — ThreadSanitizer build of the concurrency stress
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
-.PHONY: all native check test chaos bench bench-transfer metrics-smoke \
-	tsan asan sanitize clean
+.PHONY: all native check test chaos bench bench-transfer bench-serve \
+	metrics-smoke tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -37,7 +37,8 @@ test: native check
 chaos: native
 	PYTHONHASHSEED=0 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_failpoints.py tests/test_chaos.py \
-	  tests/test_object_transfer.py -q -m "slow or not slow" \
+	  tests/test_object_transfer.py tests/test_serve_batching.py \
+	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 
 # Full microbenchmark suite; persists BENCH_RESULT.json and regenerates
@@ -51,6 +52,12 @@ bench: native
 # one-line JSON delta vs the newest BENCH_r*.json baseline artifact.
 bench-transfer: native
 	JAX_PLATFORMS=cpu python scripts/bench_transfer.py
+
+# Sustained-load serving bench: continuous-batching QPS/p50/p99 vs
+# max_batch_size=1, plus 2x-overload goodput with 429 shedding on vs
+# off; one-line JSON delta vs the newest BENCH_r*.json serve rows.
+bench-serve: native
+	JAX_PLATFORMS=cpu python scripts/bench_serve.py
 
 # Boot a mini-cluster, scrape dashboard /metrics, and diff the exported
 # ray_tpu_* series list against scripts/metrics_golden.txt (catches

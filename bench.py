@@ -812,6 +812,18 @@ SUMMARY_KEYS = (
 
 
 def main() -> None:
+    if "--serve" in sys.argv[1:]:
+        # sustained-load serving bench (continuous batching QPS/p99 +
+        # overload goodput with shedding on/off) with a one-line JSON
+        # delta — same entry `make bench-serve` uses
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_serve
+
+        sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
+                                    if a != "--serve"]
+        bench_serve.main()
+        return
     if "--transfer" in sys.argv[1:]:
         # reduced transfer-plane microbench (broadcast + multi-client
         # put) with a one-line JSON delta vs the newest BENCH_r*.json —

@@ -164,6 +164,27 @@ class Config:
     #: GCS-side ring of transfer/RPC spans served to ``timeline()``.
     telemetry_spans_table_size: int = 20000
 
+    # ---- serving plane (serve/) ------------------------------------------
+    #: Per-deployment backlog cap at the ingress proxy (queued + in
+    #: flight); beyond it requests shed with 429 (0 = unbounded, i.e.
+    #: shedding off — overload then collapses into queueing delay).
+    serve_proxy_queue_limit: int = 128
+    #: ``Retry-After`` seconds attached to shed (429) responses.
+    serve_shed_retry_after_s: float = 1.0
+    #: Default per-request deadline when the client sends none.
+    serve_request_deadline_s: float = 60.0
+    #: Sustained-signal delay before the autoscaler adds replicas.
+    serve_autoscale_upscale_delay_s: float = 0.3
+    #: Sustained-signal delay before it removes replicas (hysteresis:
+    #: much longer than upscale so brief lulls don't thrash).
+    serve_autoscale_downscale_delay_s: float = 2.0
+    #: One bounded wait for ALL replica metric probes per reconcile
+    #: tick (replaces the old serial per-replica 5 s timeouts).
+    serve_metrics_timeout_s: float = 2.0
+    #: Attempts for a serve request whose replica died mid-flight
+    #: (router re-assigns to a healthy replica between attempts).
+    serve_request_retries: int = 3
+
     # ---- continuous profiling (core/profiler.py) -------------------------
     #: Start every process's sampling profiler at boot (always-on mode).
     #: Off by default: the runtime pays ZERO profiling cost unless this

@@ -81,6 +81,12 @@ class ActorInfo:
     # gang binding: schedule onto this group's bundle, charged to it
     pg_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
+    # placement strategy (actor.options(scheduling_strategy=...)):
+    # DEFAULT least-loaded, SPREAD fans across nodes by live-actor
+    # count, NODE_AFFINITY pins to strategy_node (soft = fall back)
+    strategy: str = "DEFAULT"
+    strategy_node: Optional[str] = None
+    strategy_soft: bool = False
     env_hash: Optional[str] = None
     env_spawn: Optional[Dict[str, Any]] = None
 
@@ -954,6 +960,9 @@ class GcsServer:
             pg_id=PlacementGroupID(data["placement_group_id"])
             if data.get("placement_group_id") else None,
             bundle_index=data.get("bundle_index", -1),
+            strategy=data.get("strategy") or "DEFAULT",
+            strategy_node=data.get("strategy_node"),
+            strategy_soft=bool(data.get("strategy_soft", False)),
             env_hash=data.get("env_hash"),
             env_spawn=data.get("env_spawn"),
         )
@@ -1035,7 +1044,10 @@ class GcsServer:
                         await asyncio.sleep(0.2)
                         continue
                 else:
-                    node = self._pick_node(info.resources)
+                    node = self._pick_node(info.resources,
+                                           strategy=info.strategy,
+                                           strategy_node=info.strategy_node,
+                                           strategy_soft=info.strategy_soft)
                     if node is None:
                         await asyncio.sleep(0.2)  # wait for resources/nodes
                         continue
@@ -1119,10 +1131,33 @@ class GcsServer:
             self._actor_lease_inflight[node_id] = n_in - 1
 
     def _pick_node(self, resources: Dict[str, float],
-                   required_node: Optional[NodeID] = None) -> Optional[NodeInfo]:
+                   required_node: Optional[NodeID] = None,
+                   strategy: str = "DEFAULT",
+                   strategy_node: Optional[str] = None,
+                   strategy_soft: bool = False) -> Optional[NodeInfo]:
         """Least-loaded feasible node (actors spread by default); load
         counts this GCS's own unresolved actor leases on top of the
-        beat-reported queue so creation bursts fan out immediately."""
+        beat-reported queue so creation bursts fan out immediately.
+
+        ``strategy`` refines the pick: NODE_AFFINITY restricts to the
+        named node (``strategy_soft`` falls back to any feasible node
+        when it is gone/full), SPREAD ranks by live-actor count so
+        sequentially created replicas fan across nodes instead of
+        piling onto whichever node's beat-reported load looked lowest
+        (equal-load ties broke to the same node every time)."""
+        if strategy == "NODE_AFFINITY" and strategy_node and \
+                required_node is None:
+            try:
+                required_node = NodeID(bytes.fromhex(strategy_node))
+            except ValueError:
+                logger.warning("NODE_AFFINITY node id %r is not valid "
+                               "hex", strategy_node)
+                if not strategy_soft:
+                    # a HARD pin must never silently land elsewhere:
+                    # stay pending (creation times out with a
+                    # diagnostic) rather than violate the pin
+                    return None
+                required_node = None
         candidates = []
         for node in self.nodes.values():
             if not node.alive:
@@ -1133,7 +1168,19 @@ class GcsServer:
                    for k, v in resources.items()):
                 candidates.append(node)
         if not candidates:
+            if required_node is not None and strategy_soft:
+                return self._pick_node(resources)
             return None
+        if strategy == "SPREAD":
+            per_node: Dict[NodeID, int] = {}
+            for other in self.actors.values():
+                if other.state == ACTOR_ALIVE and other.node_id is not None:
+                    per_node[other.node_id] = \
+                        per_node.get(other.node_id, 0) + 1
+            return min(candidates, key=lambda n: (
+                per_node.get(n.node_id, 0)
+                + self._actor_lease_inflight.get(n.node_id, 0),
+                n.load))
         return min(candidates,
                    key=lambda n: n.load + self._actor_lease_inflight.get(
                        n.node_id, 0))

@@ -117,7 +117,8 @@ register_schema("create_actor", spec_blob=bytes)
 register_schema("push_actor_task", spec_blob=bytes)
 register_schema("push_actor_tasks", specs_blob=bytes)
 register_schema("register_actor", actor_id=bytes, spec_blob=bytes,
-                resources=dict, job_id=bytes)
+                resources=dict, job_id=bytes, strategy=Opt(str),
+                strategy_node=Opt(str), strategy_soft=Opt(bool))
 register_schema("actor_started", actor_id=bytes, task_address=None)
 register_schema("kill_actor", actor_id=bytes)
 

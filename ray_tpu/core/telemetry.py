@@ -333,6 +333,76 @@ def profiler_records_evicted(n: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# serving plane (serve/_internal.py, serve/batching.py, serve/http_proxy.py)
+# ---------------------------------------------------------------------------
+
+_dep_keys: Dict[str, Tuple] = {}
+
+
+def _dkey(deployment: str) -> Tuple:
+    key = _dep_keys.get(deployment)
+    if key is None:
+        key = _dep_keys[deployment] = (("deployment", deployment),)
+    return key
+
+
+_OCC_FRAC_BOUNDS = [0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0]
+_SHED_KEYS: Dict[Tuple[str, str], Tuple] = {}
+
+
+def serve_request_observed(deployment: str, seconds: float) -> None:
+    """End-to-end latency of one served request (replica-side: queue
+    wait + decode; proxy-side spans add transport on top)."""
+    if not enabled():
+        return
+    _hist("ray_tpu_serve_request_latency_s",
+          "serve request latency (admission to completion) per deployment",
+          _LAT_BOUNDS, ("deployment",)).observe_key(
+        _dkey(deployment), seconds)
+
+
+def serve_request_shed(deployment: str, where: str) -> None:
+    """One request shed by backpressure (``where``: proxy|replica)."""
+    if not enabled():
+        return
+    key = _SHED_KEYS.get((deployment, where))
+    if key is None:
+        key = _SHED_KEYS[(deployment, where)] = (
+            ("deployment", deployment), ("where", where))
+    _counter("ray_tpu_serve_shed_total",
+             "serve requests shed by backpressure (429), by layer",
+             ("deployment", "where")).inc_key(key)
+
+
+def serve_batch_occupancy(deployment: str, frac: float) -> None:
+    """Slot-pool occupancy of one continuous-batching decode step."""
+    if not enabled():
+        return
+    _hist("ray_tpu_serve_batch_occupancy",
+          "continuous-batch slot occupancy per decode step (fraction)",
+          _OCC_FRAC_BOUNDS, ("deployment",)).observe_key(
+        _dkey(deployment), frac)
+
+
+def serve_queue_depth(deployment: str, depth: int) -> None:
+    """Pending (unadmitted) requests across a deployment's replicas —
+    the autoscaler's primary signal, refreshed each reconcile tick."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_serve_queue_depth",
+           "queued serve requests awaiting a batch slot, per deployment",
+           ("deployment",)).set_key(_dkey(deployment), float(depth))
+
+
+def serve_replicas(deployment: str, n: int) -> None:
+    if not enabled():
+        return
+    _gauge("ray_tpu_serve_replicas",
+           "live replicas per serve deployment",
+           ("deployment",)).set_key(_dkey(deployment), float(n))
+
+
+# ---------------------------------------------------------------------------
 # gauges set by the flush loops (samplers run right before a flush)
 # ---------------------------------------------------------------------------
 

@@ -2232,6 +2232,12 @@ class CoreWorker:
                 strat.placement_group_id.binary()
                 if strat.placement_group_id else None,
             "bundle_index": strat.bundle_index,
+            # placement strategy rides to the GCS actor scheduler:
+            # SPREAD fans replicas across nodes, NODE_AFFINITY pins
+            # (serve replica spread / per-node proxies depend on this)
+            "strategy": strat.kind,
+            "strategy_node": strat.node_id_hex,
+            "strategy_soft": strat.soft,
             "env_hash": spec.runtime_env_hash,
             "env_spawn": _renv_spawn(spec.runtime_env),
         }

@@ -74,6 +74,67 @@ def shutdown() -> None:
         pass
 
 
+class _SlotWaiter:
+    """ONE shared background thread releasing router slots as results
+    land.  Replaces the old thread-per-request waiter (a daemon thread
+    per in-flight request collapses under load: 10k in-flight requests
+    was 10k threads).  Completions drain in batches through a single
+    ``ray_tpu.wait`` over everything outstanding."""
+
+    _MAX_WAIT_S = 3600.0  # a ref that never resolves still frees its slot
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: Dict[ray_tpu.ObjectRef, tuple] = {}
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, router, key, ref: ray_tpu.ObjectRef) -> None:
+        with self._lock:
+            self._pending[ref] = (router, key, time.monotonic())
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="rtpu-serve-waiter", daemon=True)
+                self._thread.start()
+        self._wake.set()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                refs = list(self._pending)
+            if not refs:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            done: List[ray_tpu.ObjectRef] = []
+            try:
+                # short timeout on purpose: the wait covers a SNAPSHOT
+                # of pending refs, and refs added while it blocks are
+                # invisible to it — a long block would delay THEIR slot
+                # release past the poll period and stall the router's
+                # admission (fast requests queueing behind slow ones)
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=0.2)
+                done.extend(ready)
+            except Exception:  # noqa: BLE001 — cluster torn down: every
+                done.extend(refs)  # slot frees (router is stale anyway)
+            now = time.monotonic()
+            with self._lock:
+                for ref in refs:
+                    entry = self._pending.get(ref)
+                    if entry is None:
+                        continue
+                    if ref in done or now - entry[2] > self._MAX_WAIT_S:
+                        self._pending.pop(ref, None)
+                        try:
+                            entry[0].release(entry[1])
+                        except Exception:  # noqa: BLE001
+                            pass
+
+
+_slot_waiter = _SlotWaiter()
+
+
 class DeploymentHandle:
     """Parity: reference ``serve/handle.py`` RayServeHandle."""
 
@@ -89,22 +150,49 @@ class DeploymentHandle:
             raise AttributeError(name)
         return DeploymentHandle(self._name, name)
 
-    def remote(self, *args, **kwargs) -> ray_tpu.ObjectRef:
+    def remote(self, *args, _deadline_s: Optional[float] = None,
+               _request_id: Optional[str] = None,
+               **kwargs) -> ray_tpu.ObjectRef:
+        """Fast path: one dispatch to a routed replica; the returned ref
+        errors if that replica dies mid-request (use :meth:`call`, or
+        the HTTP ingress, for transparent retry-on-death)."""
         router = _get_router()
         replica, key = router.assign(self._name)
-        ref = replica.handle_request.remote(self._method, args, kwargs)
-        # release the slot when the result lands (best effort: piggyback on
-        # a waiter thread so the caller needn't call back)
-        threading.Thread(target=_release_on_done,
-                         args=(router, key, ref), daemon=True).start()
+        ref = replica.handle_request.remote(
+            self._method, args, kwargs, deadline_s=_deadline_s,
+            request_id=_request_id)
+        _slot_waiter.add(router, key, ref)
         return ref
 
+    def call(self, *args, timeout: Optional[float] = None,
+             _deadline_s: Optional[float] = None, **kwargs):
+        """Blocking request with replica-death retry: a replica that
+        dies mid-request is excluded and the request re-dispatches to a
+        healthy replica (parity: the reference router's
+        retry-on-replica-failure).  Application errors never retry."""
+        from ray_tpu.core.config import get_config
+        from ray_tpu.core.exceptions import (ActorDiedError,
+                                             WorkerCrashedError)
 
-def _release_on_done(router, key, ref):
-    try:
-        ray_tpu.wait([ref], num_returns=1, timeout=3600)
-    finally:
-        router.release(key)
+        attempts = max(1, int(getattr(get_config(),
+                                      "serve_request_retries", 3)))
+        router = _get_router()
+        exclude: List[bytes] = []
+        last_err: Optional[BaseException] = None
+        for _ in range(attempts):
+            replica, key = router.assign(self._name,
+                                         exclude=tuple(exclude))
+            ref = replica.handle_request.remote(
+                self._method, args, kwargs, deadline_s=_deadline_s)
+            try:
+                return ray_tpu.get(ref, timeout=timeout)
+            except (ActorDiedError, WorkerCrashedError) as e:
+                last_err = e
+                exclude.append(key[1])
+                router.mark_dead(key)
+            finally:
+                router.release(key)
+        raise last_err  # type: ignore[misc]
 
 
 class Application:
@@ -131,6 +219,8 @@ class Deployment:
                 user_config: Any = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
                 autoscaling_config: Optional[Dict[str, Any]] = None,
+                batching: Optional[Dict[str, Any]] = None,
+                max_queued_requests: Optional[int] = None,
                 **_ignored) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=num_replicas if num_replicas is not None
@@ -146,6 +236,11 @@ class Deployment:
             autoscaling_config=autoscaling_config
             if autoscaling_config is not None
             else self.config.autoscaling_config,
+            batching=batching if batching is not None
+            else self.config.batching,
+            max_queued_requests=max_queued_requests
+            if max_queued_requests is not None
+            else self.config.max_queued_requests,
         )
         return Deployment(self._target, name or self.name, cfg)
 
@@ -186,8 +281,17 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
                user_config: Any = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
+               batching: Optional[Dict[str, Any]] = None,
+               max_queued_requests: int = -1,
                **_ignored):
-    """``@serve.deployment`` decorator (parity: serve/api.py)."""
+    """``@serve.deployment`` decorator (parity: serve/api.py).
+
+    ``batching``: continuous-batching knobs (see
+    ``serve.batching.BatchingConfig``) — the decorated class must
+    implement the decode-engine protocol; requests then share an
+    in-flight autoregressive batch.  ``max_queued_requests``: ingress
+    backlog cap before 429 shedding (-1 = global knob, 0 = unbounded).
+    """
 
     def wrap(target):
         cfg = DeploymentConfig(
@@ -196,6 +300,8 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
             user_config=user_config,
             ray_actor_options=ray_actor_options or {},
             autoscaling_config=autoscaling_config,
+            batching=batching,
+            max_queued_requests=max_queued_requests,
         )
         return Deployment(target, name or target.__name__, cfg)
 

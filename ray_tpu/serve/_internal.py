@@ -7,29 +7,51 @@ Parity: reference ``python/ray/serve/`` —
 - ``RayServeReplica`` (``_private/replica.py:250``): wraps the user
   callable, tracks queue depth for backpressure/autoscaling.
 - ``Router``/``ReplicaSet`` (``_private/router.py:261,:134``): power-of-two
-  choices over replicas, skipping those at ``max_concurrent_queries``.
+  choices over replica queue depths, skipping those at
+  ``max_concurrent_queries``.
 
 TPU twist: a deployment whose callable jits a model keeps the compiled
-executable warm in the replica process; replicas requesting TPU resources
-gang onto chips via the core scheduler.
+executable warm in the replica process, and a deployment configured with
+``batching=...`` runs a **continuous-batching decode loop**
+(serve/batching.py): requests join an in-flight autoregressive batch at
+step boundaries with padding-bucketed shapes, so XLA compiles once per
+bucket and the chip never idles between requests.
+
+Autoscaling is SLO-aware: replicas export queue depth / batch occupancy
+/ latency percentiles; the controller polls them **in parallel with one
+bounded wait** per reconcile tick (a slow replica cannot stall the
+loop), feeds the ``ray_tpu_serve_*`` telemetry series, and moves the
+target replica count with scale-up/down hysteresis so transient spikes
+don't thrash replica churn.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.core import telemetry as _tm
 from ray_tpu.core.exceptions import ActorDiedError
+from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+def _serve_knob(name: str, default):
+    try:
+        from ray_tpu.core.config import get_config
+        return getattr(get_config(), name, default)
+    except Exception:  # noqa: BLE001 — config unavailable (unit tests)
+        return default
 
 
 @dataclass
@@ -43,6 +65,13 @@ class DeploymentConfig:
     #: grace period for draining in-flight requests before a replaced or
     #: scaled-down replica is killed (reference graceful_shutdown_*)
     graceful_shutdown_timeout_s: float = 10.0
+    #: continuous-batching knobs (serve/batching.py BatchingConfig as a
+    #: plain dict); None = request-at-a-time dispatch
+    batching: Optional[Dict[str, Any]] = None
+    #: per-deployment ingress backlog cap (queued + in flight at the
+    #: proxy); -1 = the global ``serve_proxy_queue_limit`` knob,
+    #: 0 = unbounded (shedding off)
+    max_queued_requests: int = -1
 
 
 @ray_tpu.remote
@@ -50,15 +79,27 @@ class ServeReplica:
     """One replica actor (parity: RayServeReplica replica.py:250)."""
 
     def __init__(self, pickled_callable: bytes, init_args: tuple,
-                 init_kwargs: dict, user_config: Any = None):
+                 init_kwargs: dict, user_config: Any = None,
+                 deployment_name: str = "",
+                 batching: Optional[Dict[str, Any]] = None):
         target = cloudpickle.loads(pickled_callable)
         if isinstance(target, type):
             self._callable = target(*init_args, **init_kwargs)
         else:
             self._callable = target
+        self._deployment = deployment_name
         self._inflight = 0
         self._total = 0
+        self._shed = 0
+        self._lat_ms: List[float] = []
         self._lock = threading.Lock()
+        self._batcher = None
+        if batching is not None:
+            from ray_tpu.serve.batching import (BatchingConfig,
+                                                ContinuousBatcher)
+            self._batcher = ContinuousBatcher(
+                self._callable, BatchingConfig.from_dict(batching),
+                deployment_name)
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -69,23 +110,85 @@ class ServeReplica:
             fn(user_config)
         return True
 
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict,
+                       deadline_s: Optional[float] = None,
+                       request_id: Optional[str] = None):
+        _fp.failpoint("serve.replica.handle_request")
+        t0 = time.monotonic()
         with self._lock:
             self._inflight += 1
             self._total += 1
         try:
-            target = self._callable
-            if method_name and method_name != "__call__":
-                target = getattr(self._callable, method_name)
-            return target(*args, **kwargs)
+            if self._batcher is not None \
+                    and method_name in ("", "__call__"):
+                from ray_tpu.serve.batching import ReplicaOverloaded
+                payload = args[0] if args else kwargs.get("payload")
+                try:
+                    result = self._batcher(payload, deadline_s=deadline_s,
+                                           request_id=request_id)
+                except ReplicaOverloaded:
+                    with self._lock:
+                        self._shed += 1
+                    _tm.serve_request_shed(self._deployment, "replica")
+                    raise
+            else:
+                target = self._callable
+                if method_name and method_name != "__call__":
+                    target = getattr(self._callable, method_name)
+                result = target(*args, **kwargs)
+            elapsed = time.monotonic() - t0
+            _tm.serve_request_observed(self._deployment, elapsed)
+            # only SERVED requests enter the latency ring: microsecond
+            # shed/error exits would drown the p99 exactly when the
+            # replica is overloaded and the signal matters most
+            with self._lock:
+                self._lat_ms.append(elapsed * 1e3)
+                if len(self._lat_ms) > 512:
+                    del self._lat_ms[:-512]
+            return result
         finally:
             with self._lock:
                 self._inflight -= 1
 
     @ray_tpu.method(concurrency_group="control")
+    def cancel_request(self, request_id: str) -> bool:
+        """Free the request's batch slot (client disconnected): the
+        pending/active request errors with RequestCancelled and its
+        handler thread returns."""
+        if self._batcher is None or not request_id:
+            return False
+        return self._batcher.cancel(request_id)
+
+    @ray_tpu.method(concurrency_group="control")
     def metrics(self) -> Dict[str, Any]:
         with self._lock:
-            return {"inflight": self._inflight, "total": self._total}
+            lat = sorted(self._lat_ms)
+            out = {
+                "inflight": self._inflight,
+                "total": self._total,
+                "shed_total": self._shed,
+                "queue_depth": 0,
+                "batch_occupancy": 0.0,
+                "p99_ms": lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+                if lat else 0.0,
+            }
+        if self._batcher is not None:
+            s = self._batcher.stats()
+            out["queue_depth"] = s["queue_depth"]
+            out["batch_occupancy"] = s["mean_occupancy"]
+            # NOT += s["shed_total"]: every batcher shed already bumped
+            # self._shed in handle_request (it would double-count)
+            out["batch_steps"] = s["steps"]
+            out["step_shapes"] = s["step_shapes"]
+        return out
+
+    @ray_tpu.method(concurrency_group="control")
+    def arm_failpoint(self, name: str, action: str = "raise",
+                      **options) -> bool:
+        """Arm a failpoint in THIS replica only (chaos tooling: lets a
+        test fault one replica of a set without arming its siblings)."""
+        _fp.arm(name, action, **options)
+        return True
 
     @ray_tpu.method(concurrency_group="control")
     def ready(self) -> bool:
@@ -114,10 +217,15 @@ class ServeController:
         self._lock = threading.Lock()
         self._stop = False
         # replicas removed from routing, awaiting drain: (handle, deadline)
-        self._draining: List[Tuple[Any, float]] = []
+        self._draining: List[Tuple[Any, float, float]] = []
         # actor_id -> node hex, for locality-aware routing (reference
         # replica_scheduler's node-locality ranking)
         self._replica_nodes: Dict[bytes, Optional[str]] = {}
+        # actor_id -> last metrics dict, refreshed by ONE parallel poll
+        # per reconcile tick (never serial per-replica gets)
+        self._replica_metrics: Dict[bytes, Dict[str, Any]] = {}
+        # name -> autoscaler hysteresis state
+        self._scale_state: Dict[str, Dict[str, Any]] = {}
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
         self._thread.start()
 
@@ -141,6 +249,7 @@ class ServeController:
     def delete_deployment(self, name: str) -> bool:
         with self._lock:
             dep = self._deployments.pop(name, None)
+            self._scale_state.pop(name, None)
         if dep:
             for r in dep["replicas"]:
                 try:
@@ -160,17 +269,37 @@ class ServeController:
                 break
             time.sleep(0.02)
         with self._lock:
-            table = {
-                name: {"replicas": list(replicas),
-                       "replica_nodes": [
-                           self._replica_nodes.get(r.actor_id.binary())
-                           for r in replicas],
-                       "max_concurrent_queries":
-                           self._configs[name].max_concurrent_queries
-                           if name in self._configs else 100}
-                for name, replicas in self._routing.items()
-            }
+            table = {}
+            for name, replicas in self._routing.items():
+                cfg = self._configs.get(name)
+                table[name] = {
+                    "replicas": list(replicas),
+                    "replica_nodes": [
+                        self._replica_nodes.get(r.actor_id.binary())
+                        for r in replicas],
+                    # queue depth + inflight snapshot per replica: the
+                    # router's power-of-two-choices signal (staleness
+                    # bounded by the reconcile tick, corrected client-
+                    # side by the router's own inflight deltas)
+                    "replica_depths": [
+                        self._depth_of(r.actor_id.binary())
+                        for r in replicas],
+                    "max_concurrent_queries":
+                        cfg.max_concurrent_queries if cfg else 100,
+                    "max_queued_requests":
+                        getattr(cfg, "max_queued_requests", -1)
+                        if cfg else -1,
+                }
         return {"version": self._routing_version, "table": table}
+
+    def _depth_of(self, key: bytes) -> int:
+        m = self._replica_metrics.get(key)
+        if not m:
+            return 0
+        # max, not sum: on a batched replica every queued request is
+        # ALSO a blocked handle_request thread (counted in inflight),
+        # so summing would double-count the backlog
+        return max(int(m.get("inflight", 0)), int(m.get("queue_depth", 0)))
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -178,6 +307,9 @@ class ServeController:
                 name: {"num_replicas": len(dep["replicas"]),
                        "target_replicas": dep["config"].num_replicas,
                        "version": dep["config"].version,
+                       "queue_depth": sum(
+                           self._depth_of(r.actor_id.binary())
+                           for r in dep["replicas"]),
                        "stale_replicas": sum(
                            1 for v in dep["replica_versions"]
                            if v != dep["config"].version)}
@@ -224,6 +356,9 @@ class ServeController:
             self._replica_nodes = {
                 k: v for k, v in self._replica_nodes.items()
                 if k in live}
+            self._replica_metrics = {
+                k: v for k, v in self._replica_metrics.items()
+                if k in live}
             self._routing_version += 1
 
     def _control_loop(self) -> None:
@@ -231,13 +366,58 @@ class ServeController:
         (parity: DeploymentStateManager.update deployment_state.py)."""
         while not self._stop:
             try:
+                self._poll_replica_metrics()
                 changed = self._reconcile_once()
                 if changed:
                     self._bump_routing()
                 self._reap_drained()
+                self._publish_serve_gauges()
             except Exception:  # noqa: BLE001
                 logger.exception("serve control loop iteration failed")
             time.sleep(0.1)
+
+    def _poll_replica_metrics(self) -> None:
+        """Refresh every routed replica's metrics with ONE parallel
+        fan-out and ONE bounded wait: a slow or dead replica costs the
+        tick at most ``serve_metrics_timeout_s``, not 5 s each."""
+        with self._lock:
+            replicas = [r for dep in self._deployments.values()
+                        for r in dep["replicas"]]
+        if not replicas:
+            return
+        refs, keys = [], []
+        for r in replicas:
+            try:
+                refs.append(r.metrics.remote())
+                keys.append(r.actor_id.binary())
+            except Exception:  # noqa: BLE001 — handle gone mid-kill
+                continue
+        if not refs:
+            return
+        timeout = float(_serve_knob("serve_metrics_timeout_s", 2.0))
+        try:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=timeout)
+        except Exception:  # noqa: BLE001 — cluster teardown
+            return
+        ready_set = set(ready)
+        for key, ref in zip(keys, refs):
+            if ref not in ready_set:
+                continue  # slow replica: keep its last snapshot
+            try:
+                self._replica_metrics[key] = ray_tpu.get(ref, timeout=1.0)
+            except Exception:  # noqa: BLE001 — died since the poll
+                self._replica_metrics.pop(key, None)
+
+    def _publish_serve_gauges(self) -> None:
+        with self._lock:
+            items = [(name, list(dep["replicas"]))
+                     for name, dep in self._deployments.items()]
+        for name, replicas in items:
+            _tm.serve_replicas(name, len(replicas))
+            _tm.serve_queue_depth(name, sum(
+                int((self._replica_metrics.get(r.actor_id.binary()) or {})
+                    .get("queue_depth", 0)) for r in replicas))
 
     def _reconcile_once(self) -> bool:
         changed = False
@@ -245,15 +425,24 @@ class ServeController:
             items = list(self._deployments.items())
         for name, dep in items:
             config: DeploymentConfig = dep["config"]
-            target = self._autoscaled_target(dep, config)
+            target = self._autoscaled_target(name, dep, config)
             replicas: List[Any] = dep["replicas"]
             versions: List[int] = dep["replica_versions"]
+            # dead replicas leave the set immediately (their requests
+            # already failed; the router retries them elsewhere) so the
+            # replace path below restores capacity this tick
+            dead = [i for i, r in enumerate(replicas)
+                    if self._known_dead(r)]
+            for i in reversed(dead):
+                replicas.pop(i)
+                versions.pop(i)
+                changed = True
             # rolling update: replace one stale replica at a time
             stale = [i for i, v in enumerate(versions)
                      if v != config.version]
             if stale and len(replicas) >= target:
                 i = stale[0]
-                new = self._start_replica(dep, config)
+                new = self._start_replica(name, dep, config)
                 if new is not None:
                     old = replicas[i]
                     replicas[i] = new
@@ -262,7 +451,7 @@ class ServeController:
                     changed = True
                     continue
             while len(replicas) < target:
-                new = self._start_replica(dep, config)
+                new = self._start_replica(name, dep, config)
                 if new is None:
                     break
                 replicas.append(new)
@@ -274,6 +463,25 @@ class ServeController:
                 self._drain(old, config)
                 changed = True
         return changed
+
+    def _known_dead(self, replica: Any) -> bool:
+        """True when the last metrics poll found the replica's actor
+        dead (its cached snapshot was evicted AND a liveness probe
+        fails fast)."""
+        key = replica.actor_id.binary()
+        if key in self._replica_metrics:
+            return False
+        try:
+            ready, _ = ray_tpu.wait([replica.ready.remote()],
+                                    num_returns=1, timeout=0.5)
+            if not ready:
+                return False  # slow, not provably dead
+            ray_tpu.get(ready[0], timeout=0.5)
+            return False
+        except ActorDiedError:
+            return True
+        except Exception:  # noqa: BLE001 — inconclusive: keep it
+            return False
 
     def _drain(self, replica: Any, config: DeploymentConfig) -> None:
         """Stop routing to the replica (caller bumps routing) and kill it
@@ -300,21 +508,47 @@ class ServeController:
             draining = list(self._draining)
         if not draining:
             return
+        now = time.monotonic()
+        # one parallel probe round for every drain candidate past its
+        # minimum age (was: serial 5s-timeout gets, one per replica)
+        probes: Dict[int, Any] = {}
+        for idx, (replica, deadline, not_before) in enumerate(draining):
+            if now >= not_before and now <= deadline:
+                try:
+                    probes[idx] = replica.metrics.remote()
+                except Exception:  # noqa: BLE001
+                    pass
+        probe_vals: Dict[int, Optional[Dict[str, Any]]] = {}
+        if probes:
+            refs = list(probes.values())
+            timeout = float(_serve_knob("serve_metrics_timeout_s", 2.0))
+            try:
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=timeout)
+                ready_set = set(ready)
+            except Exception:  # noqa: BLE001
+                ready_set = set()
+            for idx, ref in probes.items():
+                if ref not in ready_set:
+                    continue
+                try:
+                    probe_vals[idx] = ray_tpu.get(ref, timeout=1.0)
+                except ActorDiedError:
+                    probe_vals[idx] = None  # dead: reap below
+                except Exception:  # noqa: BLE001
+                    pass  # busy/slow: keep draining until the deadline
         still: List[Tuple[Any, float, float]] = []
-        for replica, deadline, not_before in draining:
+        for idx, (replica, deadline, not_before) in enumerate(draining):
             now = time.monotonic()
             if now < not_before:
                 still.append((replica, deadline, not_before))
                 continue
             done = now > deadline
-            if not done:
-                try:
-                    m = ray_tpu.get(replica.metrics.remote(), timeout=5)
-                    done = m.get("inflight", 0) == 0
-                except ActorDiedError:
-                    done = True  # already dead
-                except Exception:  # noqa: BLE001
-                    pass  # busy/slow: keep draining until the deadline
+            if not done and idx in probe_vals:
+                m = probe_vals[idx]
+                done = m is None or (
+                    m.get("inflight", 0) == 0
+                    and m.get("queue_depth", 0) == 0)
             if done:
                 try:
                     ray_tpu.kill(replica)
@@ -326,29 +560,57 @@ class ServeController:
             if not self._stop:
                 self._draining = still
 
-    def _autoscaled_target(self, dep: Dict[str, Any],
+    def _autoscaled_target(self, name: str, dep: Dict[str, Any],
                            config: DeploymentConfig) -> int:
         ac = config.autoscaling_config
         if not ac:
             return config.num_replicas
-        metrics = []
-        for r in dep["replicas"]:
-            try:
-                metrics.append(ray_tpu.get(r.metrics.remote(), timeout=5))
-            except Exception:  # noqa: BLE001
-                pass
-        if not metrics:
-            return max(1, ac.get("min_replicas", 1))
-        # parity: BasicAutoscalingPolicy (autoscaling_policy.py:93) —
-        # scale toward (total queued) / target_per_replica
-        total_inflight = sum(m["inflight"] for m in metrics)
-        target_per = ac.get("target_num_ongoing_requests_per_replica", 1)
-        desired = int(total_inflight / max(target_per, 1e-9) + 0.999)
         lo = ac.get("min_replicas", 1)
         hi = ac.get("max_replicas", config.num_replicas)
-        return min(max(desired, lo), hi)
+        state = self._scale_state.setdefault(
+            name, {"target": max(lo, min(len(dep["replicas"]) or lo, hi)),
+                   "proposed": None, "since": 0.0})
+        metrics = [self._replica_metrics.get(r.actor_id.binary())
+                   for r in dep["replicas"]]
+        metrics = [m for m in metrics if m]
+        if not metrics:
+            # no signal yet (cold deploy / all replicas just died):
+            # hold the floor, never scale on silence
+            state["target"] = max(lo, min(state["target"], hi))
+            return state["target"]
+        # SLO signal: ongoing requests per replica. On a batched
+        # replica the batcher queue is a subset of inflight (each
+        # queued request holds a blocked handler thread), so take the
+        # max — queue depth still leads once inflight saturates at
+        # max_concurrent_queries, without double-counting below it.
+        load = sum(max(int(m.get("inflight", 0)),
+                       int(m.get("queue_depth", 0)))
+                   for m in metrics)
+        target_per = ac.get("target_num_ongoing_requests_per_replica", 1)
+        desired = int(load / max(float(target_per), 1e-9) + 0.999)
+        desired = min(max(desired, lo), hi)
+        cur = state["target"]
+        now = time.monotonic()
+        if desired == cur:
+            state["proposed"] = None
+            return cur
+        if state["proposed"] != desired:
+            # new proposal: start its sustain clock
+            state["proposed"] = desired
+            state["since"] = now
+            return cur
+        delay = float(_serve_knob("serve_autoscale_upscale_delay_s", 0.3)
+                      if desired > cur else
+                      _serve_knob("serve_autoscale_downscale_delay_s", 2.0))
+        if now - state["since"] >= delay:
+            state["target"] = desired
+            state["proposed"] = None
+            logger.info("autoscaling %s: %d -> %d replicas (load signal)",
+                        name, cur, desired)
+            return desired
+        return cur
 
-    def _start_replica(self, dep: Dict[str, Any],
+    def _start_replica(self, name: str, dep: Dict[str, Any],
                        config: DeploymentConfig) -> Optional[Any]:
         try:
             opts = dict(config.ray_actor_options or {})
@@ -361,13 +623,20 @@ class ServeController:
                 max_concurrency=max(4, config.max_concurrent_queries),
                 concurrency_groups={"control": 2},
                 **opts).remote(dep["blob"], init_args, init_kwargs,
-                               config.user_config)
+                               config.user_config,
+                               deployment_name=name,
+                               batching=getattr(config, "batching", None))
             ray_tpu.get(replica.ready.remote(), timeout=120)
             try:
                 self._replica_nodes[replica.actor_id.binary()] = \
                     ray_tpu.get(replica.node_id.remote(), timeout=10)
             except Exception:  # noqa: BLE001 — locality is best-effort
                 pass
+            # seed the metrics cache so a fresh replica isn't treated
+            # as dead by _known_dead before its first poll round
+            self._replica_metrics.setdefault(
+                replica.actor_id.binary(),
+                {"inflight": 0, "queue_depth": 0, "total": 0})
             return replica
         except Exception:  # noqa: BLE001
             logger.exception("failed to start replica")
@@ -376,7 +645,10 @@ class ServeController:
 
 class Router:
     """Client-side replica picker with long-poll refresh (parity:
-    router.py Router/ReplicaSet)."""
+    router.py Router/ReplicaSet).  Replica choice is power-of-two
+    choices over estimated queue depth (controller-reported snapshot +
+    this process's own in-flight delta), preferring same-node replicas
+    and skipping saturated or known-dead ones."""
 
     def __init__(self, controller):
         self._controller = controller
@@ -384,6 +656,8 @@ class Router:
         self._version = -1
         self._rr: Dict[str, int] = {}
         self._inflight: Dict[Tuple[str, bytes], int] = {}
+        self._dead: Set[bytes] = set()
+        self._rng = random.Random(0x5EED)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # this process's node, for same-node-first replica ranking
@@ -410,6 +684,12 @@ class Router:
         with self._lock:
             self._version = reply["version"]
             self._table = reply["table"]
+            # a replica the controller no longer routes is gone for
+            # good; stop remembering it as dead
+            live = {r.actor_id.binary()
+                    for entry in self._table.values()
+                    for r in entry["replicas"]}
+            self._dead &= live
 
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
@@ -418,55 +698,135 @@ class Router:
             except Exception:  # noqa: BLE001
                 self._stop.wait(1.0)
 
-    def assign(self, deployment: str):
-        """Pick a replica (round-robin, skipping saturated ones).  Unknown
-        deployments fail fast (one short grace for table propagation);
-        known deployments with no live replica yet wait for them."""
-        deadline = time.monotonic() + 30.0
+    def mark_dead(self, key: Tuple[str, bytes]) -> None:
+        """Caller observed the replica's actor die: exclude it from
+        assignment until the controller's table stops routing it."""
+        with self._lock:
+            self._dead.add(key[1])
+            self._inflight.pop(key, None)
+
+    def queue_limit(self, deployment: str) -> int:
+        """Effective ingress backlog cap for the deployment (0 =
+        unbounded)."""
+        with self._lock:
+            entry = self._table.get(deployment) or {}
+        limit = entry.get("max_queued_requests", -1)
+        if limit is None or limit < 0:
+            limit = int(_serve_knob("serve_proxy_queue_limit", 128))
+        return max(0, int(limit))
+
+    def known(self, deployment: str) -> bool:
+        with self._lock:
+            return deployment in self._table
+
+    def _try_assign(self, deployment: str,
+                    exclude: Tuple[bytes, ...] = ()):
+        """One nonblocking pick; returns (replica, key), None when no
+        assignable replica exists right now, or raises KeyError for a
+        deployment the table doesn't know."""
+        _fp.failpoint("serve.router.assign")
+        with self._lock:
+            entry = self._table.get(deployment)
+            if entry is None:
+                raise KeyError(deployment)
+            replicas = entry["replicas"]
+            if not replicas:
+                return None
+            n = len(replicas)
+            nodes = entry.get("replica_nodes") or [None] * n
+            depths = entry.get("replica_depths") or [0] * n
+            cap = entry["max_concurrent_queries"]
+            skip = set(exclude) | self._dead
+
+            def score(i: int) -> int:
+                key = (deployment, replicas[i].actor_id.binary())
+                return depths[i] + self._inflight.get(key, 0)
+
+            eligible = [i for i in range(n)
+                        if replicas[i].actor_id.binary() not in skip
+                        and self._inflight.get(
+                            (deployment, replicas[i].actor_id.binary()),
+                            0) < cap]
+            if not eligible:
+                return None
+            # locality first: exhaust same-node replicas before
+            # crossing nodes (each group scored independently)
+            local = [i for i in eligible
+                     if self._local_node is not None
+                     and nodes[i] == self._local_node]
+            group = local or eligible
+            if len(group) == 1:
+                idx = group[0]
+            else:
+                # power of two choices: two distinct random candidates,
+                # lower estimated depth wins; ties alternate round-robin
+                # so equal-depth replicas share load deterministically
+                a, b = self._rng.sample(group, 2)
+                if score(a) < score(b):
+                    idx = a
+                elif score(b) < score(a):
+                    idx = b
+                else:
+                    rr = self._rr.get(deployment, 0)
+                    self._rr[deployment] = rr + 1
+                    idx = group[rr % len(group)]
+            r = replicas[idx]
+            key = (deployment, r.actor_id.binary())
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+            return (r, key)
+
+    def assign(self, deployment: str, timeout_s: float = 30.0,
+               exclude: Tuple[bytes, ...] = ()):
+        """Pick a replica (blocking).  Unknown deployments fail fast
+        (one short grace for table propagation); known deployments with
+        no assignable replica yet wait for one."""
+        deadline = time.monotonic() + timeout_s
         grace = time.monotonic() + 1.0
         while time.monotonic() < deadline:
-            with self._lock:
-                entry = self._table.get(deployment)
-            if entry is None:
+            try:
+                picked = self._try_assign(deployment, exclude)
+            except KeyError:
                 if time.monotonic() > grace:
-                    raise KeyError(f"no deployment named {deployment!r}")
+                    raise KeyError(
+                        f"no deployment named {deployment!r}") from None
                 time.sleep(0.05)
                 continue
-            with self._lock:
-                entry = self._table.get(deployment)
-                if entry and entry["replicas"]:
-                    replicas = entry["replicas"]
-                    nodes = entry.get("replica_nodes") \
-                        or [None] * len(replicas)
-                    cap = entry["max_concurrent_queries"]
-                    start = self._rr.get(deployment, 0)
-                    # strict locality: exhaust same-node replicas before
-                    # crossing nodes; round-robin within each group
-                    local = [i for i in range(len(replicas))
-                             if self._local_node is not None
-                             and nodes[i] == self._local_node]
-                    rest = [i for i in range(len(replicas))
-                            if i not in set(local)]
-                    picked = None
-                    for group in (local, rest):
-                        for i in range(len(group)):
-                            idx = group[(start + i) % len(group)]
-                            r = replicas[idx]
-                            key = (deployment, r.actor_id.binary())
-                            if self._inflight.get(key, 0) < cap:
-                                picked = (r, key)
-                                break
-                        if picked:
-                            break
-                    if picked:
-                        self._rr[deployment] = start + 1
-                        self._inflight[picked[1]] = \
-                            self._inflight.get(picked[1], 0) + 1
-                        return picked
+            if picked is not None:
+                return picked
             time.sleep(0.05)
+        raise RuntimeError(
+            f"no available replica for deployment {deployment!r}")
+
+    async def assign_async(self, deployment: str, timeout_s: float = 30.0,
+                           exclude: Tuple[bytes, ...] = ()):
+        """``assign`` for event-loop callers (the ingress proxy): same
+        semantics, polling with ``asyncio.sleep`` so the loop keeps
+        serving other connections while this one waits for capacity."""
+        import asyncio
+
+        deadline = time.monotonic() + timeout_s
+        grace = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            try:
+                picked = self._try_assign(deployment, exclude)
+            except KeyError:
+                if time.monotonic() > grace:
+                    raise KeyError(
+                        f"no deployment named {deployment!r}") from None
+                await asyncio.sleep(0.05)
+                continue
+            if picked is not None:
+                return picked
+            await asyncio.sleep(0.05)
         raise RuntimeError(
             f"no available replica for deployment {deployment!r}")
 
     def release(self, key) -> None:
         with self._lock:
-            self._inflight[key] = max(0, self._inflight.get(key, 1) - 1)
+            n = self._inflight.get(key, 1) - 1
+            if n <= 0:
+                # drop zeroed keys: with replica churn the map would
+                # otherwise grow one dead entry per replica forever
+                self._inflight.pop(key, None)
+            else:
+                self._inflight[key] = n

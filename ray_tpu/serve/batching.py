@@ -1,0 +1,461 @@
+"""Continuous-batching replica runtime for autoregressive decode.
+
+Parity model: the reference Serve's ``@serve.batch`` handles *one-shot*
+batching (gather N requests, run once, scatter).  Autoregressive decode
+on an XLA-compiled predictor breaks that model: a request is not one
+call but a *sequence* of steps, and naive request-at-a-time serving
+leaves the chip idle between requests while fixed-per-request shapes
+force a fresh XLA compile whenever the prompt length moves.  This
+module implements the production shape (vLLM/Orca-style **continuous
+batching**, the Gemma-on-TPU serving recipe):
+
+- one decode loop per replica owns a fixed pool of ``max_batch_size``
+  slots; new requests are admitted into free slots **at step
+  boundaries**, mid-flight — the batch never drains to empty before
+  refilling;
+- input shapes are **padding-bucketed**: the token buffer passed to the
+  model is always ``[max_batch_size, bucket]`` where ``bucket`` comes
+  from a small capped set of power-of-two lengths, so XLA compiles once
+  per bucket instead of once per request shape;
+- every request carries a **deadline**: expired requests are evicted at
+  the next step boundary (their slot frees immediately), and an
+  abandoned client can :meth:`ContinuousBatcher.cancel` to release its
+  slot without waiting for the deadline;
+- admission is bounded: when the pending queue exceeds
+  ``max_queue_len`` the submit **sheds** (raises
+  :class:`ReplicaOverloaded`) instead of growing an unbounded backlog —
+  the ingress translates that into HTTP 429 + ``Retry-After``.
+
+Engine protocol (duck-typed; :mod:`ray_tpu.serve.toy_decoder` is the
+reference implementation):
+
+``begin_request(payload) -> state``
+    Parse one request payload into a mutable per-request state dict
+    with at least ``tokens`` (list[int] prompt) and ``max_new_tokens``.
+``step(tokens, lengths, active) -> next_tokens``
+    One decode step over the whole slot pool.  ``tokens`` is an int32
+    array ``[max_batch_size, bucket]`` (right-padded with ``pad_token``),
+    ``lengths`` an int32 ``[max_batch_size]`` of real lengths, ``active``
+    a bool ``[max_batch_size]`` mask.  Returns one next token per slot
+    (ignored for inactive slots).  This is the jitted hot path — its
+    input shapes only change when the bucket does.
+``finish_request(state) -> result``
+    Build the response value once the request completes.
+``eos_token`` (attribute, optional)
+    Token id that terminates a sequence early; ``None`` decodes to
+    ``max_new_tokens`` always.
+``pad_token`` (attribute, optional, default 0)
+    Fill value for padded positions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core import telemetry as _tm
+
+__all__ = [
+    "BatchingConfig", "ContinuousBatcher", "ReplicaOverloaded",
+    "RequestCancelled", "RequestDeadlineExceeded", "default_buckets",
+]
+
+
+class ReplicaOverloaded(Exception):
+    """Raised at submit time when the replica's admission queue is full.
+    Carries a retry hint so ingress layers can map it straight onto
+    ``429 Too Many Requests`` + ``Retry-After``."""
+
+    def __init__(self, deployment: str = "", queue_len: int = 0,
+                 retry_after_s: float = 1.0):
+        super().__init__(
+            f"replica overloaded (queue={queue_len}); retry in "
+            f"{retry_after_s:.1f}s")
+        self.deployment = deployment
+        self.queue_len = queue_len
+        self.retry_after_s = retry_after_s
+
+    def __reduce__(self):
+        # keep the structured fields across the task-error pickle round
+        # trip (default Exception pickling would replay the formatted
+        # message into the ``deployment`` arg)
+        return (type(self),
+                (self.deployment, self.queue_len, self.retry_after_s))
+
+
+class RequestDeadlineExceeded(Exception):
+    """The request's deadline passed before decode finished; its batch
+    slot was reclaimed at the step boundary."""
+
+
+class RequestCancelled(Exception):
+    """The client cancelled (or abandoned) the request; its batch slot
+    was reclaimed at the step boundary."""
+
+
+def default_buckets(max_seq_len: int, cap: int = 8) -> Tuple[int, ...]:
+    """Powers of two up to ``max_seq_len`` (inclusive, rounded up),
+    keeping at most ``cap`` buckets — each bucket is one XLA compile, so
+    the set stays small.  When the range needs more than ``cap`` doubling
+    steps the SMALLEST buckets are dropped (short prompts pad a little
+    more; long prompts keep their granularity)."""
+    buckets: List[int] = []
+    b = 8
+    while b < max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(b)  # first power of two >= max_seq_len
+    return tuple(buckets[-cap:])
+
+
+@dataclass
+class BatchingConfig:
+    """Knobs for one replica's continuous batcher.  Travels inside
+    ``DeploymentConfig.batching`` as a plain dict (cloudpickle-free)."""
+
+    #: slot-pool size — the fixed batch dimension of every step call
+    max_batch_size: int = 8
+    #: hard cap on tokens per sequence (prompt + generated)
+    max_seq_len: int = 256
+    #: padding buckets (sorted ascending); () = default_buckets()
+    bucket_lens: Tuple[int, ...] = ()
+    #: cap on the bucket set when derived (one XLA compile per bucket)
+    max_buckets: int = 8
+    #: pending-queue cap; submits beyond it shed with ReplicaOverloaded
+    max_queue_len: int = 64
+    #: deadline applied when a request does not carry its own
+    default_deadline_s: float = 30.0
+    #: Retry-After hint attached to shed responses
+    shed_retry_after_s: float = 1.0
+
+    def resolved_buckets(self) -> Tuple[int, ...]:
+        buckets = tuple(sorted(self.bucket_lens)) or default_buckets(
+            self.max_seq_len, self.max_buckets)
+        if buckets[-1] < self.max_seq_len:
+            buckets = buckets + (self.max_seq_len,)
+        return buckets
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "BatchingConfig":
+        d = dict(d or {})
+        if "bucket_lens" in d:
+            d["bucket_lens"] = tuple(d["bucket_lens"])
+        known = {f.name for f in cls.__dataclass_fields__.values()}  # type: ignore[attr-defined]
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class _Request:
+    payload: Any
+    future: Future
+    deadline: float
+    request_id: str
+    enqueued_at: float
+    state: Optional[Dict[str, Any]] = None
+    slot: int = -1
+    cancelled: bool = False
+    generated: int = 0
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+
+class ContinuousBatcher:
+    """One replica's decode loop + admission queue.
+
+    Thread model: submitters are the replica's request-handling threads
+    (the actor's execution pool); one dedicated ``rtpu-serve-batcher``
+    thread runs the decode loop.  Submitters block on a per-request
+    Future, so the replica's ``max_concurrency`` still bounds in-flight
+    requests end to end.
+    """
+
+    def __init__(self, engine: Any, config: BatchingConfig,
+                 deployment: str = ""):
+        self._engine = engine
+        self._cfg = config
+        self._deployment = deployment
+        self._buckets = config.resolved_buckets()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._queue: List[_Request] = []
+        self._by_id: Dict[str, _Request] = {}
+        self._slots: List[Optional[_Request]] = \
+            [None] * config.max_batch_size
+        self._active = 0
+        self._stop = False
+        self._next_id = 0
+        # stats the replica exports for routing/autoscaling/tests
+        self._steps = 0
+        self._step_shapes: set = set()
+        self._shed_total = 0
+        self._completed = 0
+        self._occupancy_sum = 0.0
+        self._latencies_ms: List[float] = []  # bounded ring, p99 source
+        self._thread = threading.Thread(
+            target=self._run, name="rtpu-serve-batcher", daemon=True)
+        self._thread.start()
+
+    # -- submit side -------------------------------------------------------
+    def submit(self, payload: Any, *, deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to the
+        engine's ``finish_request`` value.  Sheds when the queue is
+        full.  The request joins the in-flight batch at the next step
+        boundary with a free slot."""
+        now = time.monotonic()
+        budget = self._cfg.default_deadline_s if deadline_s is None \
+            else deadline_s
+        fut: Future = Future()
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("batcher stopped")
+            backlog = len(self._queue)
+            if backlog >= self._cfg.max_queue_len:
+                self._shed_total += 1
+                raise ReplicaOverloaded(
+                    self._deployment, backlog, self._cfg.shed_retry_after_s)
+            if request_id is None:
+                request_id = f"r{self._next_id}"
+                self._next_id += 1
+            req = _Request(payload=payload, future=fut,
+                           deadline=now + budget, request_id=request_id,
+                           enqueued_at=now)
+            self._queue.append(req)
+            self._by_id[request_id] = req
+            self._wake.notify()
+        return fut
+
+    def __call__(self, payload: Any, *, deadline_s: Optional[float] = None,
+                 request_id: Optional[str] = None) -> Any:
+        """Blocking submit — what the replica's request handler calls."""
+        fut = self.submit(payload, deadline_s=deadline_s,
+                          request_id=request_id)
+        return fut.result()
+
+    def cancel(self, request_id: str) -> bool:
+        """Release the request's slot at the next step boundary (or
+        immediately when still queued).  True if the request was known
+        and not yet finished."""
+        with self._lock:
+            req = self._by_id.get(request_id)
+            if req is None or req.future.done():
+                return False
+            req.cancelled = True
+            if req.slot < 0 and req in self._queue:
+                self._queue.remove(req)
+                self._finish_locked(req, error=RequestCancelled(request_id))
+            self._wake.notify()
+        return True
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._wake.notify()
+        self._thread.join(timeout=5.0)
+        # fail whatever never ran (slots drain in the loop's last pass)
+        with self._lock:
+            for req in list(self._queue):
+                self._finish_locked(
+                    req, error=RuntimeError("replica shutting down"))
+            self._queue.clear()
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+            p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat \
+                else 0.0
+            p50 = lat[len(lat) // 2] if lat else 0.0
+            return {
+                "queue_depth": len(self._queue),
+                "active": self._active,
+                "steps": self._steps,
+                "step_shapes": sorted(self._step_shapes),
+                "shed_total": self._shed_total,
+                "completed": self._completed,
+                "mean_occupancy": (self._occupancy_sum / self._steps)
+                if self._steps else 0.0,
+                "p50_ms": p50,
+                "p99_ms": p99,
+            }
+
+    # -- decode loop -------------------------------------------------------
+    def _bucket_for(self, length: int) -> int:
+        for b in self._buckets:
+            if length <= b:
+                return b
+        return self._buckets[-1]
+
+    def _finish_locked(self, req: _Request, *, value: Any = None,
+                       error: Optional[BaseException] = None) -> None:
+        self._by_id.pop(req.request_id, None)
+        if req.future.done():
+            return
+        if error is not None:
+            req.future.set_exception(error)
+            return
+        self._latencies_ms.append(
+            (time.monotonic() - req.enqueued_at) * 1e3)
+        if len(self._latencies_ms) > 512:
+            del self._latencies_ms[:-512]
+        self._completed += 1
+        req.future.set_result(value)
+
+    def _admit_locked(self, now: float) -> None:
+        """Step boundary: free finished/cancelled/expired slots already
+        handled; pull queued requests into free slots."""
+        if not self._queue:
+            return
+        for i, slot in enumerate(self._slots):
+            if slot is not None or not self._queue:
+                continue
+            req = self._queue.pop(0)
+            if req.cancelled:
+                self._finish_locked(
+                    req, error=RequestCancelled(req.request_id))
+                continue
+            if now > req.deadline:
+                self._finish_locked(
+                    req, error=RequestDeadlineExceeded(
+                        f"request {req.request_id} expired in queue"))
+                continue
+            try:
+                state = self._engine.begin_request(req.payload)
+            except Exception as e:  # noqa: BLE001 — bad payload: that
+                self._finish_locked(req, error=e)  # request only
+                continue
+            state.setdefault("max_new_tokens", 16)
+            tokens = list(state.get("tokens") or [0])
+            cap = self._cfg.max_seq_len
+            if len(tokens) >= cap:
+                tokens = tokens[:cap - 1]
+            state["tokens"] = tokens
+            req.state = state
+            req.slot = i
+            req.generated = 0
+            self._slots[i] = req
+            self._active += 1
+
+    def _evict_locked(self, now: float) -> None:
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if req.cancelled:
+                self._release_slot_locked(
+                    i, error=RequestCancelled(req.request_id))
+            elif now > req.deadline:
+                self._release_slot_locked(
+                    i, error=RequestDeadlineExceeded(
+                        f"request {req.request_id} expired after "
+                        f"{req.generated} tokens"))
+        # QUEUED requests expire on their deadline too — a full slot
+        # pool must not hold an already-dead request (and its blocked
+        # submitter) hostage until a slot happens to free
+        expired = [r for r in self._queue
+                   if r.cancelled or now > r.deadline]
+        for req in expired:
+            self._queue.remove(req)
+            if req.cancelled:
+                self._finish_locked(
+                    req, error=RequestCancelled(req.request_id))
+            else:
+                self._finish_locked(
+                    req, error=RequestDeadlineExceeded(
+                        f"request {req.request_id} expired in queue"))
+
+    def _release_slot_locked(self, i: int, *, value: Any = None,
+                             error: Optional[BaseException] = None) -> None:
+        req = self._slots[i]
+        self._slots[i] = None
+        self._active -= 1
+        if req is not None:
+            self._finish_locked(req, value=value, error=error)
+
+    def _run(self) -> None:
+        import numpy as np
+
+        B = self._cfg.max_batch_size
+        pad = int(getattr(self._engine, "pad_token", 0) or 0)
+        eos = getattr(self._engine, "eos_token", None)
+        while True:
+            with self._lock:
+                if self._stop:
+                    for i in range(B):
+                        if self._slots[i] is not None:
+                            self._release_slot_locked(
+                                i, error=RuntimeError(
+                                    "replica shutting down"))
+                    return
+                now = time.monotonic()
+                self._evict_locked(now)
+                self._admit_locked(now)
+                if self._active == 0:
+                    # idle: park until a submit/cancel/stop wakes us
+                    self._wake.wait(timeout=0.1)
+                    continue
+                # snapshot the batch under the lock; run the step outside
+                batch: List[Tuple[int, _Request]] = [
+                    (i, r) for i, r in enumerate(self._slots)
+                    if r is not None]
+                longest = max(len(r.state["tokens"]) + 1
+                              for _, r in batch)
+                bucket = self._bucket_for(longest)
+                tokens = np.full((B, bucket), pad, dtype=np.int32)
+                lengths = np.zeros((B,), dtype=np.int32)
+                active = np.zeros((B,), dtype=bool)
+                for i, r in batch:
+                    seq = r.state["tokens"]
+                    tokens[i, :len(seq)] = seq
+                    lengths[i] = len(seq)
+                    active[i] = True
+                occupancy = len(batch) / B
+                self._occupancy_sum += occupancy
+            # metric export stays OUTSIDE the lock: the registry takes
+            # its own locks and must not serialize submit()/cancel()
+            _tm.serve_batch_occupancy(self._deployment, occupancy)
+            try:
+                next_tokens = self._engine.step(tokens, lengths, active)
+            except Exception as e:  # noqa: BLE001 — a broken step fails
+                # the whole in-flight batch (callers see the error);
+                # queued requests stay queued for the next pass
+                with self._lock:
+                    for i, _ in batch:
+                        if self._slots[i] is not None:
+                            self._release_slot_locked(i, error=e)
+                continue
+            next_tokens = np.asarray(next_tokens).reshape(-1)
+            with self._lock:
+                self._steps += 1
+                self._step_shapes.add((B, bucket))
+                for i, req in batch:
+                    if self._slots[i] is not req:
+                        continue  # cancelled during the step
+                    tok = int(next_tokens[i])
+                    req.state["tokens"].append(tok)
+                    req.generated += 1
+                    done = (eos is not None and tok == eos) \
+                        or req.generated >= int(req.state["max_new_tokens"]) \
+                        or len(req.state["tokens"]) >= self._cfg.max_seq_len
+                    if done:
+                        try:
+                            value = self._engine.finish_request(req.state)
+                        except Exception as e:  # noqa: BLE001
+                            self._release_slot_locked(i, error=e)
+                            continue
+                        self._release_slot_locked(i, value=value)
+
+
+def bucketize(lengths: Sequence[int], buckets: Sequence[int]) -> List[int]:
+    """Map each length onto its padding bucket (helper for tests and
+    offline capacity planning)."""
+    out = []
+    for n in lengths:
+        for b in buckets:
+            if n <= b:
+                out.append(b)
+                break
+        else:
+            out.append(buckets[-1])
+    return out

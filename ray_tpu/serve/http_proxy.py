@@ -1,26 +1,84 @@
 """HTTP ingress for serve (parity: reference ``serve/_private/http_proxy.py``
 ``HTTPProxy:218`` — uvicorn is unavailable here, so a small asyncio
 HTTP/1.1 server provides the same routing contract: ``/<deployment>``
-paths dispatch to deployment handles, JSON in/out)."""
+or ``/<deployment>/<method>`` paths dispatch to replicas, JSON in/out).
+
+Production behaviors layered onto the routing contract:
+
+- **Backpressure / load shedding**: each deployment has an ingress
+  backlog budget (``max_queued_requests`` on the deployment, falling
+  back to the ``serve_proxy_queue_limit`` knob; 0 = unbounded).  A
+  request arriving past the budget is shed immediately with ``429 Too
+  Many Requests`` + ``Retry-After`` instead of joining an unbounded
+  queue — under overload the deployment keeps serving at capacity
+  (goodput) rather than collapsing into queueing delay.
+- **Power-of-two-choices routing**: dispatch goes through the shared
+  Router, which picks the less-loaded of two random replicas by
+  estimated queue depth (controller-reported snapshot + local in-flight
+  delta) instead of blind round-robin.
+- **Replica-death retry**: a replica dying mid-request (chaos, scale-in
+  race, crash) is marked dead, excluded, and the request re-dispatched
+  to a healthy replica — the client sees an answer, not an error.
+- **Deadlines + cancellation**: the per-request deadline (header
+  ``x-serve-deadline-s``, default ``serve_request_deadline_s``) rides to
+  the replica's batcher, which evicts expired requests at step
+  boundaries; a client that disconnects mid-request triggers
+  ``cancel_request`` on the replica so an abandoned connection frees
+  its batch slot instead of decoding into the void.
+- **Streaming**: ``?stream=1`` (or header ``x-serve-stream: 1``) writes
+  a list-valued result incrementally as chunked JSON lines, one element
+  per chunk, so clients consume partial output as it exists.
+
+The whole request path is async — dispatch, result wait, disconnect
+watch and shedding never block the proxy's event loop; only control
+queries (``/``, ``serve.status``) hop to the executor.
+"""
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
+import os
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.core import telemetry as _tm
+from ray_tpu.util import failpoint as _fp
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            429: "Too Many Requests", 500: "Internal Server Error",
+            503: "Service Unavailable", 504: "Gateway Timeout"}
+
+
+class _ClientGone(Exception):
+    """The HTTP client disconnected before the response was ready."""
+
+
+from ray_tpu.serve._internal import _serve_knob as _knob  # noqa: E402
 
 
 @ray_tpu.remote
 class HTTPProxy:
-    """Per-cluster HTTP proxy actor."""
+    """Per-cluster (or per-node) HTTP proxy actor."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._host = host
         self._port = port
         self._started = threading.Event()
+        self._router = None
+        self._router_lock = threading.Lock()
+        #: per-deployment requests admitted and not yet answered — the
+        #: ingress backlog the shed budget is enforced against
+        self._admitted: Dict[str, int] = {}
+        self._shed: Dict[str, int] = {}
+        self._rid = itertools.count()
+        # pid alone collides across per-node proxies (containers reuse
+        # pids); a colliding request id would let one client's
+        # disconnect cancel another client's batch slot
+        self._rid_prefix = f"{os.getpid():x}-{os.urandom(3).hex()}"
         self._thread = threading.Thread(target=self._serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -35,6 +93,10 @@ class HTTPProxy:
     def node_id(self) -> str:
         return ray_tpu.get_runtime_context().get_node_id()
 
+    def proxy_stats(self) -> Dict[str, Any]:
+        return {"admitted": dict(self._admitted),
+                "shed": dict(self._shed)}
+
     def _serve_forever(self) -> None:
         asyncio.run(self._main())
 
@@ -47,6 +109,14 @@ class HTTPProxy:
         async with server:
             await server.serve_forever()
 
+    def _get_router(self):
+        # the shared process-wide Router (blocking bootstrap — callers
+        # hop through the executor on first touch)
+        from ray_tpu import serve
+        with self._router_lock:
+            return serve._get_router()
+
+    # -- connection handling ----------------------------------------------
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         try:
@@ -65,41 +135,224 @@ class HTTPProxy:
             length = int(headers.get("content-length", "0"))
             if length:
                 body = await reader.readexactly(length)
-            status, payload = await asyncio.get_running_loop().run_in_executor(
-                None, self._route, method, path, body)
-            blob = json.dumps(payload).encode()
-            writer.write(
-                f"HTTP/1.1 {status}\r\ncontent-type: application/json\r\n"
-                f"content-length: {len(blob)}\r\nconnection: close"
-                f"\r\n\r\n".encode() + blob)
+            await self._route(method, path, headers, body, reader, writer)
             await writer.drain()
-        except Exception:  # noqa: BLE001
-            pass
+        except _ClientGone:
+            pass  # nothing to write to
+        except Exception:  # noqa: BLE001 — a broken connection must not
+            pass  # take the acceptor down
         finally:
             try:
                 writer.close()
             except Exception:  # noqa: BLE001
                 pass
 
-    def _route(self, method: str, path: str, body: bytes):
-        from ray_tpu import serve
+    async def _write_json(self, writer: asyncio.StreamWriter, status: int,
+                          payload: Any,
+                          extra_headers: Tuple[Tuple[str, str], ...] = ()
+                          ) -> None:
+        blob = json.dumps(payload).encode()
+        head = [f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}",
+                "content-type: application/json",
+                f"content-length: {len(blob)}", "connection: close"]
+        head.extend(f"{k}: {v}" for k, v in extra_headers)
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + blob)
+        await writer.drain()
 
-        name = path.strip("/").split("/")[0]
+    async def _write_stream(self, writer: asyncio.StreamWriter,
+                            items) -> None:
+        """Chunked transfer encoding, one JSON line per item — written
+        incrementally so a slow consumer reads partial output early."""
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"content-type: application/json-lines\r\n"
+                     b"transfer-encoding: chunked\r\n"
+                     b"connection: close\r\n\r\n")
+        for item in items:
+            chunk = (json.dumps(item) + "\n").encode()
+            writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+            await writer.drain()
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
+    async def _route(self, method: str, path: str, headers: Dict[str, str],
+                     body: bytes, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        loop = asyncio.get_running_loop()
+        path, _, query = path.partition("?")
+        name, _, sub = path.strip("/").partition("/")
         if not name:
-            return "200 OK", {"deployments": list(serve.status().keys())}
-        if name == "-" or name == "healthz":
-            return "200 OK", {"status": "ok"}
+            deployments = await loop.run_in_executor(None, self._status)
+            await self._write_json(writer, 200,
+                                   {"deployments": deployments})
+            return
+        if name in ("-", "healthz"):
+            await self._write_json(writer, 200, {"status": "ok"})
+            return
+        stream = "stream=1" in query \
+            or headers.get("x-serve-stream") in ("1", "true")
+        method_name = sub or "__call__"
         try:
-            args: tuple = ()
-            if body:
+            deadline_s = float(headers["x-serve-deadline-s"]) \
+                if "x-serve-deadline-s" in headers \
+                else float(_knob("serve_request_deadline_s", 60.0))
+        except ValueError:
+            deadline_s = float(_knob("serve_request_deadline_s", 60.0))
+        args: tuple = ()
+        if body:
+            try:
                 args = (json.loads(body),)
-            handle = serve.get_deployment_handle(name)
-            result = ray_tpu.get(handle.remote(*args), timeout=60)
-            return "200 OK", {"result": result}
-        except KeyError as e:
-            return "404 Not Found", {"error": str(e)}
-        except Exception as e:  # noqa: BLE001
-            return "500 Internal Server Error", {"error": str(e)}
+            except ValueError:
+                await self._write_json(writer, 400,
+                                       {"error": "body is not JSON"})
+                return
+
+        router = self._router
+        if router is None:
+            router = self._router = await loop.run_in_executor(
+                None, self._get_router)
+
+        # -- admission / shedding -------------------------------------
+        limit = router.queue_limit(name)
+        backlog = self._admitted.get(name, 0)
+        if limit and backlog >= limit:
+            self._shed[name] = self._shed.get(name, 0) + 1
+            _tm.serve_request_shed(name, "proxy")
+            retry_after = float(_knob("serve_shed_retry_after_s", 1.0))
+            await self._write_json(
+                writer, 429,
+                {"error": "deployment overloaded", "backlog": backlog,
+                 "retry_after_s": retry_after},
+                (("retry-after", f"{max(1, int(retry_after + 0.999))}"),))
+            return
+
+        self._admitted[name] = backlog + 1
+        try:
+            await self._dispatch(router, name, method_name, args,
+                                 deadline_s, stream, reader, writer)
+        finally:
+            self._admitted[name] = max(0, self._admitted.get(name, 1) - 1)
+
+    async def _dispatch(self, router, name: str, method_name: str,
+                        args: tuple, deadline_s: float, stream: bool,
+                        reader: asyncio.StreamReader,
+                        writer: asyncio.StreamWriter) -> None:
+        from ray_tpu.core.exceptions import (ActorDiedError, TaskError,
+                                             WorkerCrashedError)
+        from ray_tpu.serve.batching import (ReplicaOverloaded,
+                                            RequestCancelled,
+                                            RequestDeadlineExceeded)
+
+        rid = f"http-{self._rid_prefix}-{next(self._rid)}"
+        attempts = max(1, int(_knob("serve_request_retries", 3)))
+        deadline = time.monotonic() + deadline_s
+        exclude: list = []
+        last_death: Optional[BaseException] = None
+        for _ in range(attempts):
+            await _fp.afailpoint("serve.proxy.dispatch")
+            try:
+                replica, key = await router.assign_async(
+                    name, timeout_s=max(0.05, deadline - time.monotonic()),
+                    exclude=tuple(exclude))
+            except KeyError as e:
+                await self._write_json(writer, 404, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                await self._write_json(writer, 503, {"error": str(e)})
+                return
+            ref = replica.handle_request.remote(
+                method_name, args, {},
+                deadline_s=max(0.05, deadline - time.monotonic()),
+                request_id=rid)
+            try:
+                result = await self._await_or_disconnect(
+                    ref, reader, replica, rid)
+            except (ActorDiedError, WorkerCrashedError) as e:
+                # replica died mid-request: exclude it and re-dispatch —
+                # the client gets an answer from a surviving replica
+                last_death = e
+                exclude.append(key[1])
+                router.mark_dead(key)
+                continue
+            except ReplicaOverloaded as e:
+                retry_after = getattr(e, "retry_after_s", 1.0)
+                await self._write_json(
+                    writer, 429,
+                    {"error": "replica overloaded",
+                     "retry_after_s": retry_after},
+                    (("retry-after",
+                      f"{max(1, int(retry_after + 0.999))}"),))
+                return
+            except RequestDeadlineExceeded as e:
+                await self._write_json(
+                    writer, 504, {"error": f"deadline exceeded: {e}"})
+                return
+            except RequestCancelled:
+                raise _ClientGone()  # our own cancel racing the reply
+            except TaskError as e:
+                # app errors whose cause was unpicklable arrive wrapped
+                await self._write_json(writer, 500, {"error": str(e)})
+                return
+            except _ClientGone:
+                raise
+            except Exception as e:  # noqa: BLE001 — transport-level
+                await self._write_json(writer, 500, {"error": str(e)})
+                return
+            finally:
+                router.release(key)
+            if stream and isinstance(result, (list, tuple)):
+                await self._write_stream(writer, result)
+            else:
+                await self._write_json(writer, 200, {"result": result})
+            return
+        await self._write_json(
+            writer, 503,
+            {"error": f"all {attempts} dispatch attempts hit dying "
+                      f"replicas: {last_death}"})
+
+    async def _await_or_disconnect(self, ref, reader: asyncio.StreamReader,
+                                   replica, rid: str):
+        """Wait for the result while watching the connection: a client
+        that goes away mid-request cancels the replica-side work (the
+        batcher frees its slot at the next step boundary)."""
+
+        async def _get():
+            return await ref
+
+        loop = asyncio.get_running_loop()
+        result_t = asyncio.ensure_future(_get())
+        eof_t = asyncio.ensure_future(reader.read(1))
+        try:
+            done, _ = await asyncio.wait(
+                {result_t, eof_t}, return_when=asyncio.FIRST_COMPLETED)
+            if result_t in done:
+                return result_t.result()
+            # connection closed (or client wrote garbage — treat as
+            # abandoned): free the batch slot, drop the task
+            try:
+                replica.cancel_request.remote(rid)
+            except Exception:  # noqa: BLE001 — replica may be dying
+                pass
+            await loop.run_in_executor(None, self._cancel_quietly, ref)
+            raise _ClientGone()
+        finally:
+            for t in (result_t, eof_t):
+                if not t.done():
+                    t.cancel()
+
+    @staticmethod
+    def _cancel_quietly(ref) -> None:
+        try:
+            ray_tpu.cancel(ref)
+        except Exception:  # noqa: BLE001 — best-effort
+            pass
+
+    @staticmethod
+    def _status():
+        from ray_tpu import serve
+        try:
+            return list(serve.status().keys())
+        except Exception:  # noqa: BLE001 — controller not up yet
+            return []
 
 
 _proxy_handle: Optional[Any] = None

@@ -28,7 +28,8 @@ import importlib
 from typing import Any, Dict, List, Optional, Union
 
 OVERRIDE_KEYS = ("num_replicas", "max_concurrent_queries", "user_config",
-                 "ray_actor_options", "autoscaling_config")
+                 "ray_actor_options", "autoscaling_config", "batching",
+                 "max_queued_requests")
 
 
 def _import_target(import_path: str):

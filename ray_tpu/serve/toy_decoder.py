@@ -1,0 +1,126 @@
+"""Reference engine for the continuous batcher: a jitted toy
+autoregressive decoder.
+
+The model is deliberately tiny but *real* for serving purposes: the
+step function is an XLA-compiled fixed-shape program (one embedding
+gather + a small MLP mixed over the causal prefix), so it exercises
+exactly the property the batcher exists to protect — **one compile per
+padding bucket** — and its outputs are a deterministic function of the
+prompt, so tests can assert that continuous batching never leaks state
+across the requests sharing a batch.
+
+``step_delay_s`` adds a host-side sleep per decode step to emulate a
+model whose step cost dwarfs dispatch overhead (a 7B-class decode step
+is a few ms on a TPU chip).  Because the sleep is paid once per *step*
+— not once per request — it makes batching economics realistic on the
+CPU bench box: 8 co-scheduled requests share each step's cost.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ToyDecoder", "make_prompt"]
+
+
+class ToyDecoder:
+    """Duck-typed continuous-batching engine (see serve/batching.py).
+
+    Payload: ``{"prompt": [int, ...], "max_new_tokens": int}`` (or a
+    bare list of ints).  Result: ``{"prompt_len", "tokens", "text"}``
+    where ``tokens`` are the generated ids.
+    """
+
+    vocab_size = 64
+    eos_token = 1
+    pad_token = 0
+
+    def __init__(self, dim: int = 32, step_delay_s: float = 0.0,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        self.dim = dim
+        self.step_delay_s = float(step_delay_s)
+        rng = np.random.default_rng(seed)
+        self._embed = jnp.asarray(
+            rng.normal(size=(self.vocab_size, dim)).astype("float32"))
+        self._w1 = jnp.asarray(
+            rng.normal(size=(dim, dim)).astype("float32") / dim ** 0.5)
+        self._w2 = jnp.asarray(
+            rng.normal(size=(dim, self.vocab_size)).astype("float32")
+            / dim ** 0.5)
+        self.trace_count = 0  # python side effect: fires once per compile
+
+        def _step(tokens, lengths, active):
+            self.trace_count += 1  # traced, not executed, per shape
+            emb = self._embed[tokens]                      # [B, L, D]
+            L = tokens.shape[1]
+            pos = jnp.arange(L)[None, :]                   # [1, L]
+            mask = (pos < lengths[:, None]).astype(emb.dtype)
+            pooled = (emb * mask[..., None]).sum(axis=1) \
+                / jnp.maximum(lengths[:, None].astype(emb.dtype), 1.0)
+            h = jnp.tanh(pooled @ self._w1)
+            logits = h @ self._w2                          # [B, V]
+            # greedy, never emitting pad; eos reachable so sequences
+            # can terminate early
+            logits = logits.at[:, self.pad_token].set(-1e9)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jnp.where(active, nxt, self.pad_token)
+
+        self._jstep = jax.jit(_step)
+
+    # -- engine protocol ---------------------------------------------------
+    def begin_request(self, payload: Any) -> Dict[str, Any]:
+        if isinstance(payload, dict):
+            prompt = list(payload.get("prompt") or [2])
+            max_new = int(payload.get("max_new_tokens", 16))
+        else:
+            prompt = list(payload)
+            max_new = 16
+        prompt = [int(t) % self.vocab_size for t in prompt] or [2]
+        return {"tokens": prompt, "prompt_len": len(prompt),
+                "max_new_tokens": max_new}
+
+    def step(self, tokens, lengths, active):
+        if self.step_delay_s > 0:
+            time.sleep(self.step_delay_s)
+        return self._jstep(tokens, lengths, active)
+
+    def finish_request(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        plen = state["prompt_len"]
+        gen = state["tokens"][plen:]
+        return {"prompt_len": plen, "tokens": gen,
+                "text": " ".join(str(t) for t in gen)}
+
+    # -- convenience -------------------------------------------------------
+    def generate_unbatched(self, payload: Any) -> Dict[str, Any]:
+        """Request-at-a-time decode through the SAME jitted step (batch
+        dim 1 pool) — the baseline `bench.py --serve` compares against."""
+        import numpy as np
+
+        state = self.begin_request(payload)
+        buckets = [8, 16, 32, 64, 128, 256]
+        while True:
+            seq = state["tokens"]
+            bucket = next((b for b in buckets if len(seq) + 1 <= b),
+                          buckets[-1])
+            tokens = np.full((1, bucket), self.pad_token, dtype=np.int32)
+            tokens[0, :len(seq)] = seq
+            lengths = np.asarray([len(seq)], dtype=np.int32)
+            active = np.asarray([True])
+            nxt = int(np.asarray(self.step(tokens, lengths, active))[0])
+            seq.append(nxt)
+            done = nxt == self.eos_token \
+                or len(seq) - state["prompt_len"] \
+                >= state["max_new_tokens"] or len(seq) >= buckets[-1]
+            if done:
+                return self.finish_request(state)
+
+
+def make_prompt(i: int, length: Optional[int] = None) -> List[int]:
+    """Deterministic per-request prompt (bench/test helper)."""
+    n = length if length is not None else 3 + (i % 5)
+    return [2 + ((i * 7 + j) % 60) for j in range(n)]

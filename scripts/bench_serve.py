@@ -1,0 +1,292 @@
+"""Sustained-load serving benchmark: continuous batching + shedding.
+
+Drives the full ingress path (HTTP proxy -> router -> replica ->
+continuous batcher -> jitted toy decoder) and reports the two numbers
+ISSUE 6 / ROADMAP item 1 care about:
+
+1. **Batching speedup** — closed-loop QPS of a continuous-batching
+   deployment (``max_batch_size=8``) vs the same engine serving
+   ``max_batch_size=1``, with client-side p50/p99 and measured batch
+   occupancy.  The decode step pays a fixed host-side cost per *step*
+   (emulating a TPU decode step whose cost dwarfs dispatch), so
+   co-scheduling N requests into one step is the only way to scale.
+2. **Goodput under overload** — open-loop arrivals at 2x the measured
+   capacity for a few seconds, once with the ingress backlog budget
+   enforcing 429 shedding and once with it unbounded.  Goodput counts
+   only requests answered within the SLO latency budget: with shedding
+   the deployment keeps answering at ~capacity; without it the queue
+   grows and on-time completions collapse.
+
+Prints ONE line of JSON (the ``make bench-transfer`` contract) with
+deltas against the newest ``BENCH_r*.json`` artifact that carries serve
+rows (first run: no deltas).
+
+Usage::
+
+    python scripts/bench_serve.py [--duration 5] [--workers 16]
+                                  [--step-delay-ms 5] [--slo-s 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if HERE not in sys.path:
+    sys.path.insert(0, HERE)
+
+SERVE_KEYS = ("serve_qps_batched", "serve_qps_serial",
+              "serve_batching_speedup", "serve_goodput_frac_shed",
+              "serve_goodput_frac_noshed")
+
+
+def load_baseline() -> dict:
+    arts = sorted(
+        glob.glob(os.path.join(HERE, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    for path in reversed(arts):
+        try:
+            with open(path) as f:
+                details = (json.load(f).get("parsed") or {}) \
+                    .get("details") or {}
+        except Exception:  # noqa: BLE001 — artifact tails can truncate
+            continue
+        if any(k in details for k in SERVE_KEYS):
+            base = {k: details[k] for k in SERVE_KEYS if k in details}
+            base["baseline_round"] = int(
+                re.search(r"r(\d+)", os.path.basename(path)).group(1))
+            return base
+    return {}
+
+
+def _post(url: str, payload: dict, deadline_s: float = 30.0):
+    """One POST; returns (status, latency_s)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"content-type": "application/json",
+                 "x-serve-deadline-s": str(deadline_s)})
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+            return resp.status, time.perf_counter() - t0
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, time.perf_counter() - t0
+    except Exception:  # noqa: BLE001 — connection torn down under churn
+        return -1, time.perf_counter() - t0
+
+
+def closed_loop(url: str, payload: dict, workers: int,
+                duration_s: float) -> dict:
+    """N workers each looping request-after-request for duration_s."""
+    lats, statuses = [], []
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+
+    def worker(i):
+        while time.perf_counter() < stop_at:
+            status, lat = _post(url, dict(payload, prompt=[2 + i % 50]))
+            with lock:
+                statuses.append(status)
+                if status == 200:
+                    lats.append(lat)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    lats.sort()
+    return {
+        "qps": len(lats) / elapsed,
+        "p50_ms": lats[len(lats) // 2] * 1e3 if lats else 0.0,
+        "p99_ms": lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3
+        if lats else 0.0,
+        "completed": len(lats),
+        "errors": sum(1 for s in statuses if s not in (200,)),
+    }
+
+
+def open_loop(url: str, payload: dict, qps: float, duration_s: float,
+              slo_s: float, pool: int = 64) -> dict:
+    """Open-loop arrivals at fixed QPS: requests fire on their schedule
+    whether or not earlier ones finished.  A persistent worker pool
+    sends them (thread-per-request melts a small CI box); latency is
+    measured from each request's SCHEDULED arrival, so client-side
+    queueing behind an overloaded server counts against the SLO exactly
+    like server-side queueing does.  Goodput counts on-time (<= slo_s)
+    200s only."""
+    import queue
+
+    lock = threading.Lock()
+    on_time = late = shed = errors = 0
+    work: "queue.Queue" = queue.Queue()
+
+    def worker():
+        nonlocal on_time, late, shed, errors
+        while True:
+            item = work.get()
+            if item is None:
+                return
+            i, scheduled = item
+            status, _ = _post(url, dict(payload, prompt=[2 + i % 50]),
+                              deadline_s=30.0)
+            lat = time.perf_counter() - scheduled
+            with lock:
+                if status == 200 and lat <= slo_s:
+                    on_time += 1
+                elif status == 200:
+                    late += 1
+                elif status == 429:
+                    shed += 1
+                else:
+                    errors += 1
+
+    threads = [threading.Thread(target=worker) for _ in range(pool)]
+    for t in threads:
+        t.start()
+    n = int(qps * duration_s)
+    t0 = time.perf_counter()
+    for i in range(n):
+        delay = t0 + i / qps - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        work.put((i, t0 + i / qps))
+    for _ in threads:
+        work.put(None)
+    for t in threads:
+        t.join(timeout=120)
+    # goodput over the offered window: on-time answers can only land in
+    # [0, duration+slo], and the post-schedule drain (workers finishing
+    # doomed requests) is the overload's fault, not extra serving time
+    return {"offered": n, "on_time": on_time, "late": late, "shed": shed,
+            "errors": errors, "goodput_qps": on_time / duration_s}
+
+
+def bench(duration_s: float, workers: int, step_delay_ms: float,
+          slo_s: float) -> dict:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.http_proxy import start_proxy
+    from ray_tpu.serve.toy_decoder import ToyDecoder
+
+    out: dict = {}
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    try:
+        delay = step_delay_ms / 1e3
+        common = {"max_seq_len": 64, "max_queue_len": 512}
+
+        batched = serve.deployment(
+            name="decoder", max_concurrent_queries=256,
+            batching=dict(common, max_batch_size=8))(ToyDecoder)
+        serial = serve.deployment(
+            name="decoder1", max_concurrent_queries=256,
+            batching=dict(common, max_batch_size=1))(ToyDecoder)
+        shed_on = serve.deployment(
+            name="overload_shed", max_concurrent_queries=256,
+            max_queued_requests=16,
+            batching=dict(common, max_batch_size=8))(ToyDecoder)
+        shed_off = serve.deployment(
+            name="overload_noshed", max_concurrent_queries=256,
+            max_queued_requests=0,  # unbounded ingress backlog
+            batching={"max_seq_len": 64, "max_queue_len": 100_000,
+                      "max_batch_size": 8})(ToyDecoder)
+        handles = {}
+        for dep in (batched, serial, shed_on, shed_off):
+            handles[dep.name] = dep.deploy(step_delay_s=delay)
+        host, port = start_proxy()
+        base = f"http://{host}:{port}"
+        payload = {"prompt": [2], "max_new_tokens": 16}
+
+        # warm every deployment's XLA bucket compiles out of the timing
+        for name in handles:
+            st, _ = _post(f"{base}/{name}", payload)
+            assert st == 200, f"warmup against {name} failed ({st})"
+
+        # -- 1) continuous batching vs request-at-a-time ---------------
+        b = closed_loop(f"{base}/decoder", payload, workers, duration_s)
+        s = closed_loop(f"{base}/decoder1", payload, workers, duration_s)
+        out["serve_qps_batched"] = round(b["qps"], 1)
+        out["serve_p50_ms_batched"] = round(b["p50_ms"], 1)
+        out["serve_p99_ms_batched"] = round(b["p99_ms"], 1)
+        out["serve_qps_serial"] = round(s["qps"], 1)
+        out["serve_p99_ms_serial"] = round(s["p99_ms"], 1)
+        out["serve_batching_speedup"] = round(b["qps"] / max(s["qps"], .1), 2)
+        from ray_tpu.serve._internal import CONTROLLER_NAME
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        table = ray_tpu.get(
+            controller.get_routing_table.remote(-1, 1.0), timeout=30)
+        m = ray_tpu.get(table["table"]["decoder"]["replicas"][0]
+                        .metrics.remote(), timeout=30)
+        out["serve_batch_occupancy"] = round(m["batch_occupancy"], 3)
+
+        # -- 2) 2x-overload goodput: shedding on vs off ----------------
+        capacity = b["qps"]
+        overload = 2.0 * capacity
+        on = open_loop(f"{base}/overload_shed", payload, overload,
+                       duration_s, slo_s)
+        off = open_loop(f"{base}/overload_noshed", payload, overload,
+                        duration_s, slo_s)
+        out["serve_overload_qps"] = round(overload, 1)
+        out["serve_goodput_qps_shed"] = round(on["goodput_qps"], 1)
+        out["serve_goodput_frac_shed"] = round(
+            on["goodput_qps"] / capacity, 3)
+        out["serve_shed_429"] = on["shed"]
+        out["serve_goodput_qps_noshed"] = round(off["goodput_qps"], 1)
+        out["serve_goodput_frac_noshed"] = round(
+            off["goodput_qps"] / capacity, 3)
+        out["serve_slo_s"] = slo_s
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 — teardown must not eat results
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="seconds per load phase")
+    ap.add_argument("--workers", type=int, default=16,
+                    help="closed-loop client threads")
+    ap.add_argument("--step-delay-ms", type=float, default=5.0,
+                    help="emulated per-decode-step device cost")
+    ap.add_argument("--slo-s", type=float, default=1.0,
+                    help="on-time latency budget for goodput")
+    args = ap.parse_args()
+
+    result = bench(args.duration, args.workers, args.step_delay_ms,
+                   args.slo_s)
+    baseline = load_baseline()
+    line = dict(result)
+    for key, value in result.items():
+        base = baseline.get(key)
+        if not isinstance(base, (int, float)) or base <= 0:
+            continue
+        line[f"vs_baseline_{key}"] = round(value / base, 2)
+    if "baseline_round" in baseline:
+        line["baseline_round"] = baseline["baseline_round"]
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,12 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_profiler_samples_total",
     "ray_tpu_profiler_stacks_dropped_total",
     "ray_tpu_profiler_records_evicted_total",
+    # serve series: only exported once a deployment is running/serving
+    "ray_tpu_serve_request_latency_s",
+    "ray_tpu_serve_shed_total",
+    "ray_tpu_serve_batch_occupancy",
+    "ray_tpu_serve_queue_depth",
+    "ray_tpu_serve_replicas",
 }
 
 
