@@ -38,6 +38,7 @@ chaos: native
 	PYTHONHASHSEED=0 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_failpoints.py tests/test_chaos.py \
 	  tests/test_object_transfer.py tests/test_serve_batching.py \
+	  tests/test_tracing.py \
 	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 

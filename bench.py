@@ -705,6 +705,57 @@ def bench_telemetry_overhead() -> dict:
     return {"telemetry_overhead": round(elapsed / n * 1e6, 3)}
 
 
+def bench_trace_overhead() -> dict:
+    """Distributed-tracing tax on the sync-task microbench, measured
+    the way PR-5 measured the profiler: 12 alternating off/on block
+    pairs of sync nop tasks in ONE cluster (noise-cancelling pairing),
+    reported as the median paired on/off ratio minus 1, in percent.
+    Acceptance bar (ISSUE 7): <= 1% with tracing enabled at default
+    sampling; disabled tracing is the off block by construction."""
+    import statistics as stats
+
+    import ray_tpu
+    from ray_tpu.core import tracing as trc
+
+    out: dict = {}
+    try:
+        ray_tpu.init(num_cpus=2,
+                     object_store_memory=256 * 1024 * 1024)
+
+        @ray_tpu.remote(num_cpus=0)
+        def nop():
+            return None
+
+        ray_tpu.get([nop.remote() for _ in range(200)], timeout=120)
+        n = 300
+
+        def block() -> float:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                ray_tpu.get(nop.remote())
+            return time.perf_counter() - t0
+
+        block()  # warm
+        ratios = []
+        for _ in range(12):
+            trc._reset_for_tests(force=False)   # tracing off
+            off = block()
+            trc._reset_for_tests(force=True)    # tracing on
+            on = block()
+            ratios.append(on / off)
+        trc._reset_for_tests()  # restore config-driven gate
+        out["trace_overhead_pct"] = round(
+            (stats.median(ratios) - 1.0) * 100.0, 3)
+    except Exception as e:  # noqa: BLE001 — probe must not kill bench
+        out["trace_overhead_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
 #: every BASELINE.md row this harness measures -> the reference number
 #: (all rows get a ``vs_ref_<row>`` ratio so LOSING rows are visible in
 #: the artifact itself, not only by cross-reading BASELINE.md)
@@ -799,7 +850,7 @@ SUMMARY_KEYS = (
     "pg_create_remove_per_sec",
     "many_tasks_per_sec_4node", "many_actors_per_sec_4node",
     "many_pgs_per_sec_4node", "broadcast_256mb_4node_s",
-    "telemetry_overhead",
+    "telemetry_overhead", "trace_overhead_pct",
     "ppo_env_steps_per_sec_inline", "ppo_env_steps_per_sec_fleet",
     "ppo_scaling_curve",
     "regressions_vs_prev", "vs_prev_round",
@@ -850,6 +901,8 @@ def main() -> None:
         details.update(bench_telemetry_overhead())
     except Exception as e:  # noqa: BLE001 — tax probe must not kill bench
         details["telemetry_overhead_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("RAY_TPU_BENCH_RUNTIME", "1") != "0":
+        details.update(bench_trace_overhead())
     annotate_vs_ref(details)
     annotate_vs_prev(details)
     result = {

@@ -164,6 +164,23 @@ class Config:
     #: GCS-side ring of transfer/RPC spans served to ``timeline()``.
     telemetry_spans_table_size: int = 20000
 
+    # ---- distributed tracing (core/tracing.py) ---------------------------
+    #: Master switch for the native request-scoped tracing plane.  Off:
+    #: no trace context is ever born, every hop short-circuits on its
+    #: absence — the hot path pays nothing.
+    tracing_enabled: bool = True
+    #: Tail-sampling retention for FAST SUCCESSFUL traces, decided at
+    #: trace completion in the GCS (errors, sheds, deadline misses,
+    #: retried and SLO-violating traces are always kept).
+    trace_sample_keep_fraction: float = 0.05
+    #: GCS-side cap on traces held (assembling + retained); oldest
+    #: evict with accounting (``ray_tpu_trace_evicted_total``).
+    trace_table_size: int = 2000
+    #: Serve latency SLO (seconds): a request slower than this is
+    #: tagged ``slo_miss`` on its root span and always retained by tail
+    #: sampling (0 disables; errors/sheds are always retained anyway).
+    serve_slo_latency_s: float = 0.0
+
     # ---- serving plane (serve/) ------------------------------------------
     #: Per-deployment backlog cap at the ingress proxy (queued + in
     #: flight); beyond it requests shed with 429 (0 = unbounded, i.e.

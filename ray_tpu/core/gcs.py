@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
+from ray_tpu.core import tracing as _trace
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.util import failpoint as _fp
@@ -170,6 +171,28 @@ class GcsServer:
         self._profile: "_dq" = _dq(maxlen=getattr(
             config, "profiler_table_size", 50000))
         self._profile_evicted = 0
+        # distributed-tracing assembly ring: trace_id -> entry, insertion
+        # ordered for eviction.  Entries assemble spans until the root
+        # arrives, then TAIL SAMPLING decides retention (errors / sheds /
+        # deadline misses / SLO violations / retried traces always kept;
+        # fast successes kept at trace_sample_keep_fraction).  A
+        # sampled-out entry stays as a tombstone (keep=False, spans
+        # cleared) so stragglers from slower processes drop instead of
+        # resurrecting the trace; the ring cap evicts oldest-first.
+        from collections import OrderedDict as _od
+        self._traces: "_od[str, Dict[str, Any]]" = _od()
+        self._traces_evicted = 0
+        self._traces_retained = 0
+        self._traces_sampled_out = 0
+        # recently-evicted trace ids: stragglers flushing after their
+        # trace (or its tombstone) left the ring must DROP, not
+        # resurrect a rootless phantom entry that occupies a slot and
+        # can never complete
+        self._trace_evicted_ids: "_dq[str]" = _dq()
+        self._trace_evicted_set: set = set()
+        #: spans kept per trace before truncation (a runaway decode
+        #: loop must not let one trace eat the ring's memory)
+        self._trace_span_cap = 512
         #: live cluster profiling window ({enabled, hz, deadline}) for
         #: raylets that register mid-window
         self._profiler_state: Optional[Dict[str, Any]] = None
@@ -322,6 +345,10 @@ class GcsServer:
         out["spans_buffered"] = len(self._spans)
         out["profile_records"] = len(self._profile)
         out["profile_records_evicted"] = self._profile_evicted
+        out["traces"] = len(self._traces)
+        out["traces_retained"] = self._traces_retained
+        out["traces_sampled_out"] = self._traces_sampled_out
+        out["traces_evicted"] = self._traces_evicted
         return out
 
     # -- versioned resource broadcast (parity: ray_syncer.h:27-60 —
@@ -356,8 +383,9 @@ class GcsServer:
                                 else period)
             # profile records flush even with metrics disabled (the
             # profiler is armed explicitly; same rule as the worker/
-            # raylet loops)
-            if not _tm.enabled() and not _prof.pending():
+            # raylet loops; trace spans likewise flush independently)
+            if not _tm.enabled() and not _prof.pending() \
+                    and not _trace.pending():
                 continue
             try:
                 if _tm.enabled():
@@ -370,6 +398,8 @@ class GcsServer:
                     spans = _tm.drain_spans("gcs")  # offset 0 by defn
                     if spans:
                         self._spans.extend(spans)
+                for tspan in _trace.drain("gcs"):
+                    self._ingest_trace_span(tspan)
                 profile = _prof.drain()
                 if profile:
                     for rec in profile:
@@ -769,6 +799,11 @@ class GcsServer:
                                       zip(cur["buckets"], rec["buckets"])]
                     cur["sum"] += rec["sum"]
                     cur["count"] += rec["count"]
+                    if rec.get("exemplars"):
+                        # per-bucket exemplars: newest flush wins
+                        ex = dict(cur.get("exemplars") or {})
+                        ex.update(rec["exemplars"])
+                        cur["exemplars"] = ex
             else:
                 continue
             cur["_ts"] = now
@@ -811,6 +846,164 @@ class GcsServer:
         """Timebase for span alignment: reporters NTP-probe this and
         correct their span timestamps onto the GCS wall clock."""
         return {"time": time.time()}
+
+    # ------------------------------------------------------------------
+    # distributed tracing plane (core/tracing.py -> trace ring)
+    # ------------------------------------------------------------------
+    def _tail_keep(self, trace_id: str, root: Dict[str, Any]) -> bool:
+        """Tail-sampling decision, made at trace COMPLETION (the root
+        span's arrival), never at ingress: anything anomalous is kept
+        in full, fast successes keep a deterministic fraction (hash of
+        the trace id, so every process agrees without coordination).
+        ``unknown_deployment`` (bad URLs) is client junk, not an
+        anomaly — it samples like a success so scanners can't evict
+        the real SLO-miss evidence from the bounded ring."""
+        if root.get("status", "ok") not in ("ok", "unknown_deployment"):
+            return True
+        tags = root.get("tags") or {}
+        if tags.get("slo_miss") or tags.get("retried"):
+            return True
+        frac = float(getattr(self.config,
+                             "trace_sample_keep_fraction", 0.05))
+        if frac >= 1.0:
+            return True
+        if frac <= 0.0:
+            return False
+        try:
+            return (int(trace_id[:8], 16) % 10000) < frac * 10000
+        except ValueError:
+            return True  # unhashable id: keep rather than lose signal
+
+    def _note_trace_evicted(self, trace_id: str) -> None:
+        if len(self._trace_evicted_ids) >= 8192:
+            self._trace_evicted_set.discard(
+                self._trace_evicted_ids.popleft())
+        self._trace_evicted_ids.append(trace_id)
+        self._trace_evicted_set.add(trace_id)
+
+    def _trace_entry(self, trace_id: str) -> Dict[str, Any]:
+        entry = self._traces.get(trace_id)
+        if entry is None:
+            cap = max(16, int(getattr(self.config,
+                                      "trace_table_size", 2000)))
+            while len(self._traces) >= cap:
+                old_id, old = self._traces.popitem(last=False)
+                self._note_trace_evicted(old_id)
+                if old.get("spans") or old.get("keep") is None:
+                    self._traces_evicted += 1
+                    _tm.trace_evicted(1)
+            entry = self._traces[trace_id] = {
+                "spans": [], "keep": None, "root": None,
+                "first": time.time(), "truncated": 0}
+        return entry
+
+    def _ingest_trace_span(self, span: Dict[str, Any]) -> None:
+        trace_id = span.get("trace_id")
+        if not trace_id:
+            return
+        if trace_id not in self._traces \
+                and trace_id in self._trace_evicted_set:
+            return  # straggler of an evicted trace: gone is gone
+        entry = self._trace_entry(trace_id)
+        if entry["keep"] is False:
+            return  # sampled out: stragglers drop against the tombstone
+        if len(entry["spans"]) >= self._trace_span_cap \
+                and not span.get("root"):
+            # the root is load-bearing (tail-sampling decision, tree
+            # anchor, telescoping) — it lands even past the cap
+            entry["truncated"] += 1
+        else:
+            entry["spans"].append(span)
+        if span.get("root"):
+            entry["root"] = span
+            keep = self._tail_keep(trace_id, span)
+            entry["keep"] = keep
+            if keep:
+                self._traces_retained += 1
+                _tm.trace_retained(1)
+            else:
+                entry["spans"] = []
+                self._traces_sampled_out += 1
+                _tm.trace_sampled_out(1)
+
+    async def handle_report_trace_spans(self, conn, data):
+        # failpoint: the trace ingest drops a batch — reporters must not
+        # notice (drop-don't-block); only the assembled tree is poorer
+        if _fp.active() and _fp.failpoint("gcs.report_spans.trace_drop"):
+            return True
+        spans = data.get("spans", [])
+        _tm.trace_spans_ingested(len(spans))
+        for span in spans:
+            self._ingest_trace_span(span)
+        return True
+
+    def _find_trace(self, trace_id: str
+                    ) -> Tuple[Optional[str], Optional[Dict[str, Any]]]:
+        entry = self._traces.get(trace_id)
+        if entry is not None:
+            return trace_id, entry
+        # prefix match (CLI convenience: ids print truncated)
+        for tid, e in self._traces.items():
+            if tid.startswith(trace_id):
+                return tid, e
+        return None, None
+
+    @staticmethod
+    def _trace_summary(trace_id: str, entry: Dict[str, Any]
+                       ) -> Dict[str, Any]:
+        root = entry.get("root")
+        tags = (root or {}).get("tags") or {}
+        return {
+            "trace_id": trace_id,
+            "name": root.get("name") if root else None,
+            "status": root.get("status") if root else "incomplete",
+            "start": root.get("start") if root
+            else entry.get("first"),
+            "duration_s": (root["end"] - root["start"]) if root else None,
+            "deployment": tags.get("deployment"),
+            "slo_miss": bool(tags.get("slo_miss")),
+            "retried": bool(tags.get("retried")),
+            "n_spans": len(entry.get("spans", [])),
+            "complete": root is not None,
+        }
+
+    async def handle_get_trace(self, conn, data):
+        trace_id, entry = self._find_trace(data["trace_id"])
+        if entry is None:
+            return None
+        if entry.get("keep") is False:
+            return {"trace_id": trace_id, "sampled_out": True,
+                    "spans": []}
+        spans = sorted(entry["spans"], key=lambda s: s.get("start", 0.0))
+        out = self._trace_summary(trace_id, entry)
+        out["spans"] = spans
+        out["truncated_spans"] = entry.get("truncated", 0)
+        return out
+
+    async def handle_list_traces(self, conn, data):
+        data = data or {}
+        deployment = data.get("deployment")
+        slo_only = bool(data.get("slo_misses"))
+        since = data.get("since")
+        limit = data.get("limit") or 100
+        out = []
+        for trace_id, entry in reversed(self._traces.items()):
+            if entry.get("keep") is False:
+                continue
+            row = self._trace_summary(trace_id, entry)
+            if deployment is not None \
+                    and row["deployment"] != deployment:
+                continue
+            if slo_only and not (row["slo_miss"]
+                                 or (row["complete"]
+                                     and row["status"] != "ok")):
+                continue
+            if since is not None and (row["start"] or 0.0) < since:
+                continue
+            out.append(row)
+            if len(out) >= limit:
+                break
+        return out
 
     # ------------------------------------------------------------------
     # continuous profiling plane (core/profiler.py)
@@ -932,6 +1125,9 @@ class GcsServer:
         # register future must resolve with a typed error or the retry
         # must converge on ONE directory entry (keyed on actor_id)
         await _fp.afailpoint("gcs.register_actor.stall")
+        # traced registrations (the payload carried "trace", re-activated
+        # by rpc dispatch) get a gcs.register_actor hop span
+        _hop = _trace.start_span("gcs.register_actor")
         actor_id = ActorID(data["actor_id"])
         name = data.get("name")
         namespace = data.get("namespace", "default")
@@ -942,8 +1138,12 @@ class GcsServer:
                 existing = self.actors.get(existing_id)
                 if existing is not None and existing.state != ACTOR_DEAD:
                     if data.get("get_if_exists"):
+                        if _hop is not None:
+                            _hop.end(outcome="existing")
                         return {"existing": True,
                                 "actor_id": existing_id.binary()}
+                    if _hop is not None:
+                        _hop.end(status="error", outcome="name_conflict")
                     raise ValueError(
                         f"actor name {name!r} already taken in {namespace!r}")
             self.named_actors[key] = actor_id
@@ -975,6 +1175,8 @@ class GcsServer:
         self.subscribers.setdefault(
             f"actor:{actor_id.hex()}", set()).add(conn)
         asyncio.get_running_loop().create_task(self._schedule_actor(info))
+        if _hop is not None:
+            _hop.end(actor=actor_id.hex()[:12])
         return {"existing": False, "actor_id": actor_id.binary(),
                 "subscribed": True}
 

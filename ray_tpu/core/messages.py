@@ -103,7 +103,7 @@ register_schema("reattach_job", job_id=bytes)
 register_schema("health_report", node_id=bytes, resources_available=dict)
 
 # leases / scheduling
-register_schema("request_worker_lease", resources=dict)
+register_schema("request_worker_lease", resources=dict, trace=Opt(dict))
 register_schema("cancel_lease", token=str)
 register_schema("return_worker", worker_id=bytes)
 register_schema("lease_worker_for_actor", actor_id=bytes, resources=dict,
@@ -143,6 +143,12 @@ register_schema("report_spans", spans=list)
 register_schema("clock_sync")
 register_schema("get_metrics")
 register_schema("get_spans", cat=Opt(str), limit=Opt(int))
+
+# distributed tracing plane (core/tracing.py -> GCS trace ring)
+register_schema("report_trace_spans", spans=list)
+register_schema("get_trace", trace_id=str)
+register_schema("list_traces", deployment=Opt(str), slo_misses=Opt(bool),
+                since=Opt(float), limit=Opt(int))
 
 # continuous profiling plane (core/profiler.py)
 register_schema("report_profile", records=list)

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
+from ray_tpu.core import tracing as _trace
 from ray_tpu.core.config import Config
 from ray_tpu.core.exceptions import ObjectStoreFullError
 from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
@@ -1282,7 +1283,23 @@ class Raylet:
             retriable=bool(data.get("retriable", True)),
             token=data.get("token"), conn=conn))
         self._maybe_schedule()
-        return await fut
+        # traced lease (the owner forwarded its head task's context):
+        # the queue-wait-until-grant hop joins the request's trace tree
+        lease_span = _trace.start_span("raylet.lease",
+                                       node=self.node_id.hex()[:12])
+        if lease_span is None:
+            return await fut
+        try:
+            result = await fut
+        except BaseException:
+            # owner conn dropped / dispatch cancelled: the queue-wait
+            # hop must still land — a lost span would hide exactly the
+            # slow-lease case it exists to explain
+            lease_span.end(status="error")
+            raise
+        lease_span.end(granted=bool(result.get("granted"))
+                       if isinstance(result, dict) else False)
+        return result
 
     async def handle_cancel_lease(self, conn, data):
         """The owner's backlog drained before the grant: drop the queued
@@ -1892,7 +1909,9 @@ class Raylet:
             # profile records flush even with metrics disabled: the
             # profiler is armed explicitly, and skipping drain here
             # would also leave pending() true -> 1 Hz ticks forever
-            if not _tm.enabled() and not _prof.pending():
+            # (trace spans likewise flush independently of metrics)
+            if not _tm.enabled() and not _prof.pending() \
+                    and not _trace.pending():
                 continue
             conn = self.gcs_conn
             if conn is None or conn.closed:
@@ -1916,6 +1935,10 @@ class Raylet:
                 if spans:
                     await conn.call("report_spans", {"spans": spans},
                                     timeout=2.0)
+                tspans = _trace.drain(source)
+                if tspans:
+                    await conn.call("report_trace_spans",
+                                    {"spans": tspans}, timeout=2.0)
                 if profile:
                     node = self.node_id.hex()
                     for rec in profile:

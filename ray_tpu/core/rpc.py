@@ -38,6 +38,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from ray_tpu.core.messages import validate as _validate_schema
 from ray_tpu.core import telemetry as _tm
+from ray_tpu.core import tracing as _trace
 from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
@@ -117,6 +118,7 @@ IDEMPOTENT_METHODS = frozenset({
     "get_cluster_stats", "list_events", "object_contains", "list_workers",
     "list_objects", "stack_traces", "list_placement_groups",
     "get_object_locations", "object_pull_chunk", "clock_sync", "get_spans",
+    "get_trace", "list_traces",
     # keyed / convergent mutations
     "register_node", "register_worker", "subscribe", "unsubscribe",
     "kv_put", "kv_del", "health_report", "actor_started",
@@ -651,6 +653,15 @@ class Connection:
 
     async def _dispatch(self, msg_id: int, method: str, data: Any) -> None:
         self._dispatching += 1
+        # trace-context propagation: a request payload carrying the
+        # ``"trace"`` carrier re-activates it for the handler (and for
+        # everything the handler awaits — contextvars ride the task).
+        # Untraced requests pay one cached-bool check; tracing off pays
+        # the same.
+        if _trace.enabled() and type(data) is dict:
+            tctx = data.get("trace")
+            if tctx is not None:
+                _trace.set_current(_trace.ctx_of(tctx))
         try:
             try:
                 if self._handler is None:

@@ -350,14 +350,46 @@ _OCC_FRAC_BOUNDS = [0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0]
 _SHED_KEYS: Dict[Tuple[str, str], Tuple] = {}
 
 
-def serve_request_observed(deployment: str, seconds: float) -> None:
+def serve_request_observed(deployment: str, seconds: float,
+                           trace_id: Optional[str] = None) -> None:
     """End-to-end latency of one served request (replica-side: queue
-    wait + decode; proxy-side spans add transport on top)."""
+    wait + decode; proxy-side spans add transport on top).  When the
+    request was traced, the observation carries an OpenMetrics exemplar
+    linking its latency bucket to the concrete ``trace_id`` — a
+    dashboard can jump from "p99 spiked" straight to ``ray-tpu trace``."""
     if not enabled():
         return
     _hist("ray_tpu_serve_request_latency_s",
           "serve request latency (admission to completion) per deployment",
           _LAT_BOUNDS, ("deployment",)).observe_key(
+        _dkey(deployment), seconds,
+        exemplar={"trace_id": trace_id} if trace_id else None)
+
+
+def serve_ttft_observed(deployment: str, seconds: float) -> None:
+    """Time-to-first-token of one STREAMING (?stream=1) request: submit
+    to first generated token, the latency a streaming client actually
+    perceives."""
+    if not enabled():
+        return
+    _hist("ray_tpu_serve_ttft_seconds",
+          "time-to-first-token for streaming serve requests",
+          _LAT_BOUNDS, ("deployment",)).observe_key(
+        _dkey(deployment), seconds)
+
+
+_STEP_BOUNDS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0]
+
+
+def serve_decode_step(deployment: str, seconds: float) -> None:
+    """Wall duration of one continuous-batching decode step (the jitted
+    hot path; regressions here multiply into every token)."""
+    if not enabled():
+        return
+    _hist("ray_tpu_serve_decode_step_seconds",
+          "per-decode-step latency of the continuous batcher",
+          _STEP_BOUNDS, ("deployment",)).observe_key(
         _dkey(deployment), seconds)
 
 
@@ -400,6 +432,46 @@ def serve_replicas(deployment: str, n: int) -> None:
     _gauge("ray_tpu_serve_replicas",
            "live replicas per serve deployment",
            ("deployment",)).set_key(_dkey(deployment), float(n))
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing plane (core/tracing.py / GCS trace ring)
+# ---------------------------------------------------------------------------
+
+def trace_spans_ingested(n: int) -> None:
+    """GCS-side: trace spans accepted into the assembly ring."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_trace_spans_total",
+             "trace spans ingested by the GCS trace ring"
+             ).inc_key(_EMPTY_KEY, float(n))
+
+
+def trace_retained(n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_trace_retained_total",
+             "traces kept by tail sampling (errors/sheds/SLO misses "
+             "always; fast successes at trace_sample_keep_fraction)"
+             ).inc_key(_EMPTY_KEY, float(n))
+
+
+def trace_sampled_out(n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_trace_sampled_out_total",
+             "completed traces dropped by tail sampling (fast successes "
+             "beyond the keep fraction)").inc_key(_EMPTY_KEY, float(n))
+
+
+def trace_evicted(n: int = 1) -> None:
+    """GCS-side: traces evicted from the ring before any consumer read
+    them (raise trace_table_size to keep more)."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_trace_evicted_total",
+             "traces evicted from the GCS trace ring"
+             ).inc_key(_EMPTY_KEY, float(n))
 
 
 # ---------------------------------------------------------------------------

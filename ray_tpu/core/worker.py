@@ -125,6 +125,8 @@ def _renv_spawn(runtime_env: Optional[Dict[str, Any]]
     return spawn_spec(runtime_env)
 
 
+from ray_tpu.core import tracing as _trace
+
 _tracing_fns: Optional[tuple] = None
 
 
@@ -315,6 +317,10 @@ class CoreWorker:
         # task_id bin -> submit monotonic time (dispatch-latency metric)
         self._dispatch_ts: Dict[bytes, float] = {}
         self._lease_tpu_ids: List[int] = []
+        # task_id bin -> in-flight owner-side trace span (born at
+        # submission, ended at terminal completion/failure); entries
+        # live exactly as long as the task is pending
+        self._trace_spans: Dict[bytes, "_trace.Span"] = {}
 
         # GC-driven ref releases (ObjectRef.__del__) are deferred here and
         # drained on the io loop: __del__ can fire on ANY thread at ANY
@@ -1423,6 +1429,7 @@ class CoreWorker:
             stream_returns=stream_returns,
             max_calls=max_calls,
         )
+        self._trace_begin(spec)
         if stream_returns:
             # register BEFORE submission: the first dynamic_items push
             # can arrive while .remote() is still unwinding
@@ -1433,6 +1440,40 @@ class CoreWorker:
         self._track_child(task_id)
         self._submit_to_lease_queue(spec)
         return refs
+
+    def _trace_begin(self, spec: TaskSpec) -> None:
+        """Native tracing tag, applied ONCE at submission: join the
+        ambient trace when one is active (a traced serve request or
+        parent task submitting children); otherwise a fresh trace is
+        born — but only at DRIVER-side ``remote()`` (worker-mode
+        submissions outside any trace are runtime plumbing like the
+        serve controller's metrics polls, and tracing each would flood
+        the ring with noise).  The span ends at the task's terminal
+        completion/failure — its status is the tail-sampling signal.
+        Disabled tracing costs one cached-bool check."""
+        if not _trace.enabled():
+            return
+        name = f"task:{spec.function_descriptor}"
+        ambient = _trace.current()
+        if ambient is not None:
+            span = _trace.start_span(name, parent=ambient)
+        elif self.mode == "driver":
+            span = _trace.start_trace(name)
+        else:
+            return
+        if span is None:
+            return
+        # merge with the optional OTel W3C carrier already on the spec
+        if spec.trace_context is None:
+            spec.trace_context = span.ctx()
+        else:
+            spec.trace_context.update(span.ctx())
+        self._trace_spans[spec.task_id.binary()] = span
+
+    def _trace_end(self, spec: TaskSpec, status: str, **tags) -> None:
+        span = self._trace_spans.pop(spec.task_id.binary(), None)
+        if span is not None:
+            span.end(status=status, **tags)
 
     def _track_child(self, task_id: TaskID) -> None:
         """Record parent->child lineage for recursive cancellation: a
@@ -1758,6 +1799,9 @@ class CoreWorker:
                 "env_spawn": _renv_spawn(spec.runtime_env),
                 "retriable": spec.max_retries > 0,
                 "token": token,
+                # head-of-queue task's trace context: the raylet's
+                # queue-wait-until-grant span joins that trace's tree
+                "trace": _trace.ctx_of(spec.trace_context),
             }, timeout=None)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             if raylet_address == self.raylet_address and \
@@ -2140,6 +2184,7 @@ class CoreWorker:
     def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         self._task_locations.pop(spec.task_id.binary(), None)
         self._cancel_requested.discard(spec.task_id.binary())
+        self._trace_end(spec, "error", error=type(error).__name__)
         self._finish_stream(spec, error)
         self.task_manager.fail(spec.task_id)
         blob = serialize_exception(
@@ -2157,6 +2202,9 @@ class CoreWorker:
         """Store task results as owner (parity: TaskManager::CompletePendingTask)."""
         self._task_locations.pop(spec.task_id.binary(), None)
         self._cancel_requested.discard(spec.task_id.binary())
+        self._trace_end(spec, "error" if app_error else "ok",
+                        **({"retried": True} if spec.attempt_number
+                           else {}))
         self.task_manager.complete(spec.task_id)
         if dynamic_return_ids:
             # own the yielded objects BEFORE publishing anything (the
@@ -2216,6 +2264,17 @@ class CoreWorker:
             runtime_env_hash=_renv_hash(runtime_env),
             trace_context=_trace_carrier(),
         )
+        if _trace.enabled():
+            # actor creation under an active trace (e.g. a traced serve
+            # scale-up) carries the chain to the GCS registration hop;
+            # nothing is born here — creations outside a trace stay
+            # untraced (they are not requests)
+            _ctx = _trace.current()
+            if _ctx is not None:
+                if spec.trace_context is None:
+                    spec.trace_context = dict(_ctx)
+                else:
+                    spec.trace_context.update(_ctx)
         strat = spec.scheduling_strategy
         payload = {
             "actor_id": actor_id.binary(),
@@ -2240,6 +2299,9 @@ class CoreWorker:
             "strategy_soft": strat.soft,
             "env_hash": spec.runtime_env_hash,
             "env_spawn": _renv_spawn(spec.runtime_env),
+            # trace carrier: the GCS records its registration hop span
+            # when the creation belongs to an active trace
+            "trace": _trace.ctx_of(spec.trace_context),
         }
         # pin creation args for the actor's lifetime (restarts re-run the
         # creation task and need them)
@@ -2319,6 +2381,7 @@ class CoreWorker:
             concurrency_group=concurrency_group,
             trace_context=_trace_carrier(),
         )
+        self._trace_begin(spec)
         rets = self.task_manager.register(spec)
         del holds  # submitted-refs now pin the promoted args
         refs = [ObjectRef(oid, self.address) for oid in rets]
@@ -2955,7 +3018,9 @@ class CoreWorker:
             # profile records flush even with metrics disabled: the
             # profiler is armed explicitly, and skipping drain here
             # would also leave pending() true -> 1 Hz ticks forever
-            if not _tm.enabled() and not _prof.pending():
+            # (trace spans likewise flush independently of metrics)
+            if not _tm.enabled() and not _prof.pending() \
+                    and not _trace.pending():
                 continue
             conn = self.gcs_conn
             if conn is None or conn.closed:
@@ -2982,6 +3047,10 @@ class CoreWorker:
                 if spans:
                     await conn.call("report_spans", {"spans": spans},
                                     timeout=2.0)
+                tspans = _trace.drain(source)
+                if tspans:
+                    await conn.call("report_trace_spans",
+                                    {"spans": tspans}, timeout=2.0)
                 if profile:
                     node = self.node_id.hex()
                     for rec in profile:
@@ -3464,6 +3533,8 @@ class CoreWorker:
                 spec.actor_id.hex() if spec.actor_id else None,
                 spec.job_id.hex() if spec.job_id else None)
         exec_t0 = None  # stamped AFTER arg resolution (fetch != exec)
+        espan = None  # executor-side trace span (traced tasks only)
+        trace_token = None  # ambient-context reset token (outer finally)
         prev = (self._ctx.task_id, self._ctx.put_counter,
                 self._ctx.attempt_number, self._ctx.current_resources)
         self._ctx.task_id = spec.task_id
@@ -3481,12 +3552,35 @@ class CoreWorker:
             # the analyzer's 'fetch' phase, not 'exec'
             exec_t0 = time.time()
             fn = self._resolve_callable(spec)
-            if spec.trace_context is not None:
+            # native trace context: the executor span becomes the body's
+            # ambient parent, so nested submissions / serve batcher
+            # spans nest UNDER the exec hop (keeps the phase rollup
+            # telescoping instead of double-counting siblings).  Gated
+            # on THIS process's switch too: a node with tracing
+            # disabled must pay nothing even for spec-carried contexts
+            # (same contract as rpc._dispatch).
+            nctx = _trace.ctx_of(spec.trace_context) \
+                if _trace.enabled() else None
+            if nctx is not None:
+                espan = _trace.start_span(
+                    f"exec:{spec.function_descriptor}", parent=nctx,
+                    task_id=spec.task_id.hex()[:16],
+                    attempt=spec.attempt_number)
+                # reset in the OUTER finally, not here: an async body
+                # only runs inside asyncio.run below (calling fn merely
+                # built the coroutine), and dynamic-returns generators
+                # resume in _post_dynamic_returns — both must still see
+                # the ambient context or their nested submissions fall
+                # off the trace
+                trace_token = _trace.set_current(espan.ctx())
+            if spec.trace_context is not None \
+                    and "traceparent" in spec.trace_context:
+                # opt-in OTel half (separate exporter pipeline)
                 from ray_tpu.util.tracing.tracing_helper import \
                     execute_with_trace
-                value = execute_with_trace(fn, spec.function_descriptor,
-                                           spec.trace_context,
-                                           *args, **kwargs)
+                value = execute_with_trace(
+                    fn, spec.function_descriptor, spec.trace_context,
+                    *args, **kwargs)
             else:
                 value = fn(*args, **kwargs)
             if inspect.iscoroutine(value):
@@ -3521,6 +3615,10 @@ class CoreWorker:
                 results.append(self._post_return(rid, v, spec))
             return {"results": results}
         except BaseException as e:  # noqa: BLE001 — errors travel to caller
+            if espan is not None:
+                # the finally's end() is then a no-op: a failed body
+                # must not render as an ok exec hop in the trace tree
+                espan.end(status="error", error=type(e).__name__)
             if (isinstance(e, KeyboardInterrupt)
                     and tid_bin in self._interrupted_tasks):
                 # cancel-driven interrupt (handle_cancel_task raised it
@@ -3549,6 +3647,13 @@ class CoreWorker:
                                 attempt=spec.attempt_number,
                                 job=spec.job_id.hex() if spec.job_id
                                 else None)
+            if trace_token is not None:
+                _trace.reset_current(trace_token)
+            if espan is not None:
+                # executor-side hop of the request's trace tree
+                # (parent = the owner's task span); a failed body
+                # already ended it with status=error (end is idempotent)
+                espan.end()
             (self._ctx.task_id, self._ctx.put_counter,
              self._ctx.attempt_number, self._ctx.current_resources) = prev
             with self._exec_track_lock:

@@ -37,7 +37,12 @@ def _escape_help(text: str) -> str:
     return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
-def _prometheus_text(records) -> str:
+def _prometheus_text(records, exemplars: bool = False) -> str:
+    """Prometheus text exposition.  ``exemplars=True`` switches bucket
+    lines to OpenMetrics exemplar syntax (``... # {trace_id="..."} v ts``)
+    so a dashboard can jump from a hot latency bucket to the concrete
+    trace — classic Prometheus parsers reject that syntax, so it is
+    opt-in via ``/metrics?openmetrics=1``."""
     lines = []
     seen_help = set()
     for rec in records:
@@ -54,10 +59,21 @@ def _prometheus_text(records) -> str:
         if rec["type"] == "histogram":
             cum = 0
             bounds = rec["boundaries"] + ["+Inf"]
-            for count, bound in zip(rec["buckets"], bounds):
+            bucket_exemplars = rec.get("exemplars") or {}
+            for idx, (count, bound) in enumerate(
+                    zip(rec["buckets"], bounds)):
                 cum += count
                 btags = tags + ("," if tags else "") + f'le="{bound}"'
-                lines.append(f"{name}_bucket{{{btags}}} {cum}")
+                line = f"{name}_bucket{{{btags}}} {cum}"
+                ex = bucket_exemplars.get(idx) if exemplars else None
+                if ex:
+                    ex_tags = ",".join(
+                        f'{k}="{_escape_label_value(v)}"'
+                        for k, v in sorted(ex.items())
+                        if k not in ("value", "ts"))
+                    line += (f" # {{{ex_tags}}} {ex.get('value', 0)}"
+                             f" {ex.get('ts', 0)}")
+                lines.append(line)
             lines.append(f"{name}_sum{label} {rec['sum']}")
             lines.append(f"{name}_count{label} {rec['count']}")
         else:
@@ -284,8 +300,42 @@ class Dashboard:
                              exc_info=True)
             return records
         records = await self._state(fetch)
-        return web.Response(text=_prometheus_text(records),
+        exemplars = request.query.get("openmetrics") in ("1", "true")
+        return web.Response(text=_prometheus_text(records,
+                                                  exemplars=exemplars),
                             content_type="text/plain")
+
+    async def handle_traces(self, request):
+        """Distributed traces from the GCS ring.  ``?trace_id=`` emits
+        ONE trace's spans as Perfetto-compatible chrome-trace JSON;
+        without it, a JSON list of retained trace summaries
+        (``?deployment=``, ``?slo_misses=1``, ``?limit=``)."""
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.experimental.state import traces as traces_mod
+
+        trace_id = request.query.get("trace_id")
+
+        def fetch():
+            core = worker_mod.global_worker()
+            if trace_id:
+                return core.gcs_call("get_trace", {"trace_id": trace_id})
+            return core.gcs_call("list_traces", {
+                "deployment": request.query.get("deployment"),
+                "slo_misses": request.query.get("slo_misses")
+                in ("1", "true"),
+                "limit": int(request.query.get("limit", "100"))})
+        result = await self._state(fetch)
+        if trace_id:
+            if result is None:
+                return self._json({"error": "trace not found"})
+            return self._json({
+                "trace_id": result.get("trace_id"),
+                "status": result.get("status"),
+                "duration_s": result.get("duration_s"),
+                "traceEvents": traces_mod.perfetto_events(
+                    result.get("spans") or []),
+            })
+        return self._json(result)
 
     # -- lifecycle ------------------------------------------------------
     def _make_app(self) -> web.Application:
@@ -301,6 +351,7 @@ class Dashboard:
         app.router.add_get("/api/profile", self.handle_profile)
         app.router.add_get("/profile", self.handle_profile)
         app.router.add_get("/api/analyze", self.handle_analyze)
+        app.router.add_get("/api/traces", self.handle_traces)
         app.router.add_get("/metrics", self.handle_metrics)
         try:
             from ray_tpu.job.job_head import add_job_routes

@@ -14,3 +14,7 @@ from ray_tpu.experimental.state.api import (  # noqa: F401
     summarize_tasks,
     timeline,
 )
+from ray_tpu.experimental.state.traces import (  # noqa: F401
+    get_trace,
+    list_traces,
+)

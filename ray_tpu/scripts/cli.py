@@ -211,6 +211,13 @@ def cmd_status(args) -> None:
     if drops:
         print(f"WARNING: {drops} task events dropped by the GCS ring "
               f"buffer (per-job: {gcs_dbg.get('task_event_drops')})")
+    # serving plane: one line per deployment (replicas, queue depth,
+    # shed, p99 from the controller's replica poll) + SLO-miss trace
+    # counts from the GCS trace ring
+    try:
+        _print_serve_section(w)
+    except Exception:  # noqa: BLE001 — serve not running
+        pass
     # one-line time attribution of the most recent job (full breakdown
     # via `ray-tpu analyze`)
     try:
@@ -220,6 +227,44 @@ def cmd_status(args) -> None:
             print(analyze_mod.summary_line(result))
     except Exception:  # noqa: BLE001 — status must survive a quiet GCS
         pass
+
+
+def _print_serve_section(w) -> None:
+    """Serve deployments in the one-screen status (sourced from the
+    controller's per-replica metrics poll + the GCS trace ring)."""
+    import ray_tpu
+    from ray_tpu.serve._internal import CONTROLLER_NAME
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return  # serve never started on this cluster
+    deps = ray_tpu.get(controller.list_deployments.remote(), timeout=10)
+    if not deps:
+        return
+    # SLO-miss/error trace counts per deployment from tail sampling
+    miss_counts: Dict[str, int] = {}
+    try:
+        for row in w.gcs_call("list_traces",
+                              {"slo_misses": True, "limit": 1000}):
+            dep = row.get("deployment")
+            if dep:
+                miss_counts[dep] = miss_counts.get(dep, 0) + 1
+    except Exception:  # noqa: BLE001 — pre-tracing GCS
+        pass
+    print("serve deployments:")
+    for name in sorted(deps):
+        info = deps[name]
+        line = (f"  {name}: replicas "
+                f"{info['num_replicas']}/{info['target_replicas']}  "
+                f"queue {info.get('queue_depth', 0)}  "
+                f"shed {info.get('shed_total', 0)}  "
+                f"p99 {info.get('p99_ms', 0.0):.1f}ms")
+        misses = miss_counts.get(name, 0)
+        if misses:
+            line += (f"  SLO-miss traces {misses} "
+                     f"(ray-tpu trace --slo-misses {name})")
+        print(line)
 
 
 def cmd_events(args) -> None:
@@ -480,6 +525,30 @@ def cmd_analyze(args) -> None:
         print(analyze_mod.format_report(result))
 
 
+def cmd_trace(args) -> None:
+    """Render one assembled request trace (span tree with per-hop
+    durations telescoping to the client-observed latency), or list
+    retained traces (``--slo-misses <deployment>``, ``--list``)."""
+    _connect(args)
+    from ray_tpu.experimental.state import traces as traces_mod
+
+    if args.trace_id:
+        trace = traces_mod.get_trace(args.trace_id)
+        if args.json:
+            print(json.dumps(trace, indent=2, default=str))
+        else:
+            print(traces_mod.format_trace(trace))
+        return
+    rows = traces_mod.list_traces(
+        deployment=args.slo_misses or args.deployment,
+        slo_misses=args.slo_misses is not None,
+        limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+    else:
+        print(traces_mod.format_trace_list(rows))
+
+
 def cmd_logs(args) -> None:
     """Tail worker stdout/stderr cluster-wide off the ``worker_logs``
     GCS channel (the raylet log monitors already publish; this is the
@@ -588,6 +657,22 @@ def build_parser() -> argparse.ArgumentParser:
                     help="emit the raw analysis dict as JSON")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_analyze)
+
+    sp = sub.add_parser(
+        "trace",
+        help="render a distributed request trace (or list retained "
+             "traces / SLO misses)")
+    sp.add_argument("trace_id", nargs="?", default=None,
+                    help="trace id (prefix ok); omit to list traces")
+    sp.add_argument("--slo-misses", default=None, metavar="DEPLOYMENT",
+                    help="list retained SLO-missing/error traces of "
+                         "this deployment")
+    sp.add_argument("--deployment", default=None,
+                    help="filter the trace list by deployment")
+    sp.add_argument("--limit", type=int, default=50)
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser(
         "logs", help="tail worker logs cluster-wide")

@@ -38,6 +38,7 @@ import cloudpickle
 
 import ray_tpu
 from ray_tpu.core import telemetry as _tm
+from ray_tpu.core import tracing as _trace
 from ray_tpu.core.exceptions import ActorDiedError
 from ray_tpu.util import failpoint as _fp
 
@@ -112,9 +113,13 @@ class ServeReplica:
 
     def handle_request(self, method_name: str, args: tuple, kwargs: dict,
                        deadline_s: Optional[float] = None,
-                       request_id: Optional[str] = None):
+                       request_id: Optional[str] = None,
+                       stream: bool = False):
         _fp.failpoint("serve.replica.handle_request")
         t0 = time.monotonic()
+        # ambient trace context was activated by the executor from the
+        # task spec; the batcher parents its queue/decode spans on it
+        tctx = _trace.current()
         with self._lock:
             self._inflight += 1
             self._total += 1
@@ -125,7 +130,8 @@ class ServeReplica:
                 payload = args[0] if args else kwargs.get("payload")
                 try:
                     result = self._batcher(payload, deadline_s=deadline_s,
-                                           request_id=request_id)
+                                           request_id=request_id,
+                                           stream=stream)
                 except ReplicaOverloaded:
                     with self._lock:
                         self._shed += 1
@@ -137,7 +143,11 @@ class ServeReplica:
                     target = getattr(self._callable, method_name)
                 result = target(*args, **kwargs)
             elapsed = time.monotonic() - t0
-            _tm.serve_request_observed(self._deployment, elapsed)
+            # exemplar: a traced request links its latency bucket to the
+            # concrete trace_id (dashboard p99 spike -> ray-tpu trace)
+            _tm.serve_request_observed(
+                self._deployment, elapsed,
+                trace_id=tctx.get("trace_id") if tctx else None)
             # only SERVED requests enter the latency ring: microsecond
             # shed/error exits would drown the p99 exactly when the
             # replica is overloaded and the signal matters most
@@ -302,6 +312,9 @@ class ServeController:
         return max(int(m.get("inflight", 0)), int(m.get("queue_depth", 0)))
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        def _m(r) -> Dict[str, Any]:
+            return self._replica_metrics.get(r.actor_id.binary()) or {}
+
         with self._lock:
             return {
                 name: {"num_replicas": len(dep["replicas"]),
@@ -310,6 +323,15 @@ class ServeController:
                        "queue_depth": sum(
                            self._depth_of(r.actor_id.binary())
                            for r in dep["replicas"]),
+                       # serving-plane health for `ray-tpu status`:
+                       # shed rate + worst replica p99 from the same
+                       # poll the autoscaler runs on
+                       "shed_total": sum(
+                           int(_m(r).get("shed_total", 0))
+                           for r in dep["replicas"]),
+                       "p99_ms": max(
+                           [float(_m(r).get("p99_ms", 0.0))
+                            for r in dep["replicas"]] or [0.0]),
                        "stale_replicas": sum(
                            1 for v in dep["replica_versions"]
                            if v != dep["config"].version)}

@@ -57,6 +57,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import telemetry as _tm
+from ray_tpu.core import tracing as _trace
 
 __all__ = [
     "BatchingConfig", "ContinuousBatcher", "ReplicaOverloaded",
@@ -159,6 +160,19 @@ class _Request:
     cancelled: bool = False
     generated: int = 0
     extras: Dict[str, Any] = field(default_factory=dict)
+    #: streaming (?stream=1) request: its first generated token feeds
+    #: the ray_tpu_serve_ttft_seconds histogram
+    stream: bool = False
+    #: trace carrier captured at submit (ambient context of the
+    #: submitting handler thread); None = untraced, zero further cost
+    trace: Optional[Dict[str, str]] = None
+    #: wall-clock submit stamp (spans use wall time; enqueued_at stays
+    #: monotonic for deadlines)
+    t0_wall: float = 0.0
+    #: live decode span (admission -> finish) of a traced request
+    decode_span: Optional[Any] = None
+    #: per-step spans already recorded (capped; see _STEP_SPAN_CAP)
+    step_spans: int = 0
 
 
 class ContinuousBatcher:
@@ -169,7 +183,15 @@ class ContinuousBatcher:
     thread runs the decode loop.  Submitters block on a per-request
     Future, so the replica's ``max_concurrency`` still bounds in-flight
     requests end to end.
+
+    Tracing: a traced request's per-step spans are capped (the decode
+    span keeps the full step count in its ``steps`` tag) so a
+    max_new_tokens=4096 request cannot flood the span buffer.
     """
+
+    #: per-request cap on decode.step spans (full count rides the
+    #: decode span's ``steps`` tag)
+    _STEP_SPAN_CAP = 64
 
     def __init__(self, engine: Any, config: BatchingConfig,
                  deployment: str = ""):
@@ -199,15 +221,18 @@ class ContinuousBatcher:
 
     # -- submit side -------------------------------------------------------
     def submit(self, payload: Any, *, deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> Future:
+               request_id: Optional[str] = None,
+               stream: bool = False) -> Future:
         """Enqueue one request; returns a Future resolving to the
         engine's ``finish_request`` value.  Sheds when the queue is
         full.  The request joins the in-flight batch at the next step
-        boundary with a free slot."""
+        boundary with a free slot.  A request submitted under an active
+        trace context gets queue-wait / decode / per-step spans."""
         now = time.monotonic()
         budget = self._cfg.default_deadline_s if deadline_s is None \
             else deadline_s
         fut: Future = Future()
+        trace = _trace.current()
         with self._lock:
             if self._stop:
                 raise RuntimeError("batcher stopped")
@@ -221,17 +246,19 @@ class ContinuousBatcher:
                 self._next_id += 1
             req = _Request(payload=payload, future=fut,
                            deadline=now + budget, request_id=request_id,
-                           enqueued_at=now)
+                           enqueued_at=now, stream=stream, trace=trace,
+                           t0_wall=time.time() if trace or stream else 0.0)
             self._queue.append(req)
             self._by_id[request_id] = req
             self._wake.notify()
         return fut
 
     def __call__(self, payload: Any, *, deadline_s: Optional[float] = None,
-                 request_id: Optional[str] = None) -> Any:
+                 request_id: Optional[str] = None,
+                 stream: bool = False) -> Any:
         """Blocking submit — what the replica's request handler calls."""
         fut = self.submit(payload, deadline_s=deadline_s,
-                          request_id=request_id)
+                          request_id=request_id, stream=stream)
         return fut.result()
 
     def cancel(self, request_id: str) -> bool:
@@ -291,6 +318,13 @@ class ContinuousBatcher:
     def _finish_locked(self, req: _Request, *, value: Any = None,
                        error: Optional[BaseException] = None) -> None:
         self._by_id.pop(req.request_id, None)
+        if req.decode_span is not None:
+            # trace-span append only — the metrics registry (its own
+            # locks) is never touched under self._lock
+            req.decode_span.end(
+                status="ok" if error is None else type(error).__name__,
+                steps=req.generated)
+            req.decode_span = None
         if req.future.done():
             return
         if error is not None:
@@ -326,6 +360,12 @@ class ContinuousBatcher:
             except Exception as e:  # noqa: BLE001 — bad payload: that
                 self._finish_locked(req, error=e)  # request only
                 continue
+            if req.trace is not None:
+                admit_wall = time.time()
+                _trace.record("batch.queue", req.t0_wall, admit_wall,
+                              parent=req.trace, slot=i)
+                req.decode_span = _trace.start_span(
+                    "batch.decode", parent=req.trace, slot=i)
             state.setdefault("max_new_tokens", 16)
             tokens = list(state.get("tokens") or [0])
             cap = self._cfg.max_seq_len
@@ -415,6 +455,7 @@ class ContinuousBatcher:
             # metric export stays OUTSIDE the lock: the registry takes
             # its own locks and must not serialize submit()/cancel()
             _tm.serve_batch_occupancy(self._deployment, occupancy)
+            step_t0 = time.time()
             try:
                 next_tokens = self._engine.step(tokens, lengths, active)
             except Exception as e:  # noqa: BLE001 — a broken step fails
@@ -425,7 +466,10 @@ class ContinuousBatcher:
                         if self._slots[i] is not None:
                             self._release_slot_locked(i, error=e)
                 continue
+            step_t1 = time.time()
+            _tm.serve_decode_step(self._deployment, step_t1 - step_t0)
             next_tokens = np.asarray(next_tokens).reshape(-1)
+            ttfts: List[float] = []  # emitted outside the lock
             with self._lock:
                 self._steps += 1
                 self._step_shapes.add((B, bucket))
@@ -435,6 +479,16 @@ class ContinuousBatcher:
                     tok = int(next_tokens[i])
                     req.state["tokens"].append(tok)
                     req.generated += 1
+                    if req.generated == 1 and req.stream:
+                        # time-to-first-token: what a streaming client
+                        # perceives as responsiveness
+                        ttfts.append(time.monotonic() - req.enqueued_at)
+                    if req.decode_span is not None \
+                            and req.step_spans < self._STEP_SPAN_CAP:
+                        req.step_spans += 1
+                        _trace.record("decode.step", step_t0, step_t1,
+                                      parent=req.decode_span.ctx(),
+                                      step=req.generated, bucket=bucket)
                     done = (eos is not None and tok == eos) \
                         or req.generated >= int(req.state["max_new_tokens"]) \
                         or len(req.state["tokens"]) >= self._cfg.max_seq_len
@@ -445,6 +499,8 @@ class ContinuousBatcher:
                             self._release_slot_locked(i, error=e)
                             continue
                         self._release_slot_locked(i, value=value)
+            for ttft in ttfts:
+                _tm.serve_ttft_observed(self._deployment, ttft)
 
 
 def bucketize(lengths: Sequence[int], buckets: Sequence[int]) -> List[int]:

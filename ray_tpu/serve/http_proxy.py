@@ -46,6 +46,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.core import telemetry as _tm
+from ray_tpu.core import tracing as _trace
 from ray_tpu.util import failpoint as _fp
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
@@ -211,6 +212,13 @@ class HTTPProxy:
             router = self._router = await loop.run_in_executor(
                 None, self._get_router)
 
+        # trace is BORN here (the serve ingress); its root span's status
+        # at completion is the tail-sampling signal, so every shed /
+        # error / SLO-missing request is retained in full while fast
+        # successes sample down.  Tagged once — no per-hop branching.
+        tspan = _trace.start_trace(f"ingress:{name}", deployment=name,
+                                   http_method=method_name)
+
         # -- admission / shedding -------------------------------------
         limit = router.queue_limit(name)
         backlog = self._admitted.get(name, 0)
@@ -223,19 +231,41 @@ class HTTPProxy:
                 {"error": "deployment overloaded", "backlog": backlog,
                  "retry_after_s": retry_after},
                 (("retry-after", f"{max(1, int(retry_after + 0.999))}"),))
+            if tspan is not None:
+                tspan.end(status="shed", where="proxy")
             return
 
         self._admitted[name] = backlog + 1
         try:
-            await self._dispatch(router, name, method_name, args,
-                                 deadline_s, stream, reader, writer)
+            outcome, attempts = await self._dispatch(
+                router, name, method_name, args, deadline_s, stream,
+                reader, writer, tspan)
+        except _ClientGone:
+            if tspan is not None:
+                tspan.end(status="client_gone")
+            raise
+        except BaseException:
+            if tspan is not None:
+                tspan.end(status="error")
+            raise
         finally:
             self._admitted[name] = max(0, self._admitted.get(name, 1) - 1)
+        if tspan is not None:
+            tags: Dict[str, Any] = {}
+            if attempts > 1:
+                tags["retried"] = True  # retry hops are always retained
+            slo = float(_knob("serve_slo_latency_s", 0.0))
+            if slo > 0 and time.time() - tspan.start > slo:
+                tags["slo_miss"] = True
+            tspan.end(status=outcome, **tags)
 
     async def _dispatch(self, router, name: str, method_name: str,
                         args: tuple, deadline_s: float, stream: bool,
                         reader: asyncio.StreamReader,
-                        writer: asyncio.StreamWriter) -> None:
+                        writer: asyncio.StreamWriter,
+                        tspan=None) -> Tuple[str, int]:
+        """Returns (outcome, attempts_used) for the root trace span —
+        every return path has written the HTTP response."""
         from ray_tpu.core.exceptions import (ActorDiedError, TaskError,
                                              WorkerCrashedError)
         from ray_tpu.serve.batching import (ReplicaOverloaded,
@@ -247,67 +277,121 @@ class HTTPProxy:
         deadline = time.monotonic() + deadline_s
         exclude: list = []
         last_death: Optional[BaseException] = None
-        for _ in range(attempts):
+        root_ctx = tspan.ctx() if tspan is not None else None
+        for attempt in range(attempts):
             await _fp.afailpoint("serve.proxy.dispatch")
+            dspan = _trace.start_span("proxy.dispatch", parent=root_ctx,
+                                      attempt=attempt)
+            dctx = dspan.ctx() if dspan is not None else None
+            # every arm below sets dstatus and returns/raises/continues;
+            # ONE finally ends the attempt's span, so a future exception
+            # arm cannot leak it (a lost span reads as unattributed gap)
+            dstatus = "error"
+            dtags: Dict[str, Any] = {}
             try:
-                replica, key = await router.assign_async(
-                    name, timeout_s=max(0.05, deadline - time.monotonic()),
-                    exclude=tuple(exclude))
-            except KeyError as e:
-                await self._write_json(writer, 404, {"error": str(e)})
-                return
-            except RuntimeError as e:
-                await self._write_json(writer, 503, {"error": str(e)})
-                return
-            ref = replica.handle_request.remote(
-                method_name, args, {},
-                deadline_s=max(0.05, deadline - time.monotonic()),
-                request_id=rid)
-            try:
-                result = await self._await_or_disconnect(
-                    ref, reader, replica, rid)
-            except (ActorDiedError, WorkerCrashedError) as e:
-                # replica died mid-request: exclude it and re-dispatch —
-                # the client gets an answer from a surviving replica
-                last_death = e
-                exclude.append(key[1])
-                router.mark_dead(key)
-                continue
-            except ReplicaOverloaded as e:
-                retry_after = getattr(e, "retry_after_s", 1.0)
-                await self._write_json(
-                    writer, 429,
-                    {"error": "replica overloaded",
-                     "retry_after_s": retry_after},
-                    (("retry-after",
-                      f"{max(1, int(retry_after + 0.999))}"),))
-                return
-            except RequestDeadlineExceeded as e:
-                await self._write_json(
-                    writer, 504, {"error": f"deadline exceeded: {e}"})
-                return
-            except RequestCancelled:
-                raise _ClientGone()  # our own cancel racing the reply
-            except TaskError as e:
-                # app errors whose cause was unpicklable arrive wrapped
-                await self._write_json(writer, 500, {"error": str(e)})
-                return
-            except _ClientGone:
-                raise
-            except Exception as e:  # noqa: BLE001 — transport-level
-                await self._write_json(writer, 500, {"error": str(e)})
-                return
+                aspan = _trace.start_span("router.assign", parent=dctx)
+                # "error" until the assign SUCCEEDS: the finally must
+                # not touch `key` (unbound) when e.g. a CancelledError
+                # escapes the await
+                astatus = "error"
+                try:
+                    replica, key = await router.assign_async(
+                        name,
+                        timeout_s=max(0.05, deadline - time.monotonic()),
+                        exclude=tuple(exclude))
+                    astatus = "ok"
+                except KeyError as e:
+                    astatus = dstatus = "unknown_deployment"
+                    await self._write_json(writer, 404,
+                                           {"error": str(e)})
+                    # NOT "error": a bad URL is client junk, and junk
+                    # must be tail-SAMPLED, not always-retained — a
+                    # scanner hammering 404s would otherwise evict the
+                    # real anomaly traces from the bounded ring
+                    return "unknown_deployment", attempt + 1
+                except RuntimeError as e:
+                    astatus = dstatus = "no_replica"
+                    await self._write_json(writer, 503,
+                                           {"error": str(e)})
+                    return "error", attempt + 1
+                finally:
+                    if aspan is not None:
+                        aspan.end(status=astatus, **(
+                            {"replica": key[1].hex()[:12]}
+                            if astatus == "ok" else {}))
+                dtags["replica"] = key[1].hex()[:12]
+                # the actor call is submitted under the dispatch span's
+                # context, so the owner-side task span (and the
+                # replica's exec/batch spans under it) join this
+                # attempt's subtree
+                with _trace.use_ctx(dctx):
+                    ref = replica.handle_request.remote(
+                        method_name, args, {},
+                        deadline_s=max(0.05, deadline - time.monotonic()),
+                        request_id=rid, stream=stream)
+                try:
+                    result = await self._await_or_disconnect(
+                        ref, reader, replica, rid)
+                except (ActorDiedError, WorkerCrashedError) as e:
+                    # replica died mid-request: exclude it and
+                    # re-dispatch — the client gets an answer from a
+                    # surviving replica
+                    last_death = e
+                    exclude.append(key[1])
+                    router.mark_dead(key)
+                    dstatus = "replica_died"
+                    continue
+                except ReplicaOverloaded as e:
+                    dstatus = "shed"
+                    retry_after = getattr(e, "retry_after_s", 1.0)
+                    await self._write_json(
+                        writer, 429,
+                        {"error": "replica overloaded",
+                         "retry_after_s": retry_after},
+                        (("retry-after",
+                          f"{max(1, int(retry_after + 0.999))}"),))
+                    return "shed", attempt + 1
+                except RequestDeadlineExceeded as e:
+                    dstatus = "deadline"
+                    await self._write_json(
+                        writer, 504,
+                        {"error": f"deadline exceeded: {e}"})
+                    return "deadline", attempt + 1
+                except RequestCancelled:
+                    dstatus = "client_gone"
+                    raise _ClientGone()  # our cancel racing the reply
+                except TaskError as e:
+                    # app errors whose cause was unpicklable arrive
+                    # wrapped
+                    dstatus = "error"
+                    await self._write_json(writer, 500,
+                                           {"error": str(e)})
+                    return "error", attempt + 1
+                except _ClientGone:
+                    dstatus = "client_gone"
+                    raise
+                except Exception as e:  # noqa: BLE001 — transport-level
+                    dstatus = "error"
+                    await self._write_json(writer, 500,
+                                           {"error": str(e)})
+                    return "error", attempt + 1
+                finally:
+                    router.release(key)
+                dstatus = "ok"
+                if stream and isinstance(result, (list, tuple)):
+                    await self._write_stream(writer, result)
+                else:
+                    await self._write_json(writer, 200,
+                                           {"result": result})
+                return "ok", attempt + 1
             finally:
-                router.release(key)
-            if stream and isinstance(result, (list, tuple)):
-                await self._write_stream(writer, result)
-            else:
-                await self._write_json(writer, 200, {"result": result})
-            return
+                if dspan is not None:
+                    dspan.end(status=dstatus, **dtags)
         await self._write_json(
             writer, 503,
             {"error": f"all {attempts} dispatch attempts hit dying "
                       f"replicas: {last_death}"})
+        return "error", attempts
 
     async def _await_or_disconnect(self, ref, reader: asyncio.StreamReader,
                                    replica, rid: str):

@@ -18,6 +18,10 @@ These rules check agreement between *places that must not drift apart*:
   appear in ``scripts/metrics_golden.txt``, the exporter catalogue that
   dashboards and the metrics smoke test key on.  A name typo'd or added
   without updating the catalogue ships a series nobody scrapes.
+* ``trace-propagation`` — RPC call sites on the serve request path and
+  in the worker's submit-path functions must forward the distributed
+  trace context (a ``trace`` payload key or a spec blob); a site that
+  drops it silently truncates every assembled trace at that hop.
 
 All checks are static (AST + text); nothing here imports runtime
 modules, so the analyzer runs in CI without booting a cluster.
@@ -37,7 +41,8 @@ from ray_tpu.tools.check.findings import Finding, parse_catalogue
 
 __all__ = ["ProjectConfig", "check_rpc_conformance",
            "check_failpoint_registry", "check_metric_drift",
-           "collect_metric_names", "parse_catalogue", "PROJECT_RULES"]
+           "check_trace_propagation", "collect_metric_names",
+           "parse_catalogue", "PROJECT_RULES"]
 
 
 @dataclass
@@ -56,6 +61,15 @@ class ProjectConfig:
     rpc_path: str = "ray_tpu/core/rpc.py"
     failpoint_doc: str = "docs/fault_injection.md"
     metrics_golden: str = "scripts/metrics_golden.txt"
+    #: trace-propagation scope: every RPC call site under these dirs
+    #: (the serve request path) ...
+    trace_scope_dirs: Tuple[str, ...] = ("ray_tpu/serve/",)
+    #: ... plus these submit-path functions of the worker (the file is
+    #: huge; only its task/actor/lease submission chain carries traces)
+    trace_worker_file: str = "ray_tpu/core/worker.py"
+    trace_worker_funcs: Tuple[str, ...] = (
+        "_request_lease_chain", "_push_task", "_push_task_batch",
+        "create_actor", "_start_single_push", "_send_actor_batch")
 
     def read(self, rel: str) -> Optional[str]:
         try:
@@ -289,6 +303,114 @@ def check_failpoint_registry(contexts: List[ModuleContext],
 
 
 # ---------------------------------------------------------------------------
+# trace-propagation
+# ---------------------------------------------------------------------------
+
+#: payload dict keys that carry the trace chain: an explicit ``trace``
+#: carrier, or a pickled TaskSpec (whose ``trace_context`` field is it)
+_TRACE_PAYLOAD_KEYS = {"trace", "spec_blob", "specs_blob"}
+
+#: telemetry/infra methods that legitimately carry no request context
+#: (their payloads are aggregates of many requests, not one chain)
+_TRACE_EXEMPT_METHODS = {
+    "clock_sync", "report_metrics", "report_spans", "report_trace_spans",
+    "report_profile", "report_task_events",
+}
+
+
+def _call_site_payload(node: ast.Call
+                       ) -> Tuple[Optional[str], Optional[ast.expr]]:
+    """(literal method, payload expression) of one RPC call site, or
+    (None, None) when the method isn't a string literal."""
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr == "call":
+            m = _str_arg(node, 0)
+            if m is not None:  # conn.call("m", data)
+                return m, node.args[1] if len(node.args) > 1 else None
+            m = _str_arg(node, 1)
+            if m is not None:  # pool.call(addr, "m", data)
+                return m, node.args[2] if len(node.args) > 2 else None
+        elif node.func.attr == "start_call":
+            m = _str_arg(node, 0)
+            if m is not None:
+                return m, node.args[1] if len(node.args) > 1 else None
+    d = _dotted(node.func)
+    if d is not None and d.split(".")[-1] == "call_with_retry":
+        m = _str_arg(node, 1)
+        if m is not None:  # call_with_retry(get_conn, "m", data)
+            return m, node.args[2] if len(node.args) > 2 else None
+    return None, None
+
+
+def check_trace_propagation(contexts: List[ModuleContext],
+                            cfg: ProjectConfig) -> List[Finding]:
+    """Every RPC call site on the serve request path (all of
+    ``serve/``) and in the worker's submit-path functions must forward
+    the trace context: a payload dict literal carrying ``trace`` or a
+    spec blob (``TaskSpec.trace_context`` rides inside).  A site that
+    cannot is one more RPC hop where the chain silently breaks — the
+    assembled trace then loses everything downstream of it.  Suppress
+    deliberate exceptions with ``# rtpu-check: disable=trace-propagation``."""
+    rule = "trace-propagation"
+    findings: List[Finding] = []
+    # a call inside a nested def is reached by the walk of BOTH the
+    # outer and the inner function — report each site once
+    seen_sites: set = set()
+    worker_funcs = set(cfg.trace_worker_funcs)
+    for ctx in contexts:
+        in_serve = any(ctx.path.startswith(p)
+                       for p in cfg.trace_scope_dirs)
+        is_worker = ctx.path == cfg.trace_worker_file
+        if not in_serve and not is_worker:
+            continue
+        for fnode in ast.walk(ctx.tree):
+            if not isinstance(fnode, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            if is_worker and fnode.name not in worker_funcs:
+                continue
+            # name -> dict-literal assignment (payload built above the
+            # call: ``payload = {...}; conn.call("m", payload)``)
+            dict_assigns: Dict[str, ast.Dict] = {}
+            for n in ast.walk(fnode):
+                if isinstance(n, ast.Assign) \
+                        and isinstance(n.value, ast.Dict):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            dict_assigns[t.id] = n.value
+            for n in ast.walk(fnode):
+                if not isinstance(n, ast.Call):
+                    continue
+                method, payload = _call_site_payload(n)
+                if method is None or method.startswith("_") \
+                        or method in _TRACE_EXEMPT_METHODS:
+                    continue
+                resolved = payload
+                if isinstance(resolved, ast.Name):
+                    resolved = dict_assigns.get(resolved.id)
+                ok = False
+                if isinstance(resolved, ast.Dict):
+                    keys = {k.value for k in resolved.keys
+                            if isinstance(k, ast.Constant)}
+                    ok = bool(keys & _TRACE_PAYLOAD_KEYS)
+                if not ok:
+                    site = (ctx.path, n.lineno, n.col_offset, method)
+                    if site in seen_sites:
+                        continue
+                    seen_sites.add(site)
+                    findings.append(Finding(
+                        path=ctx.path, line=n.lineno, rule=rule,
+                        symbol=method,
+                        message=f"RPC call {method!r} on the traced "
+                                f"request path does not forward the "
+                                f"trace context (payload needs a "
+                                f"'trace' key or a spec blob; or "
+                                f"suppress with # rtpu-check: "
+                                f"disable={rule})"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # metric-drift
 # ---------------------------------------------------------------------------
 
@@ -349,4 +471,5 @@ PROJECT_RULES = {
     "rpc-conformance": check_rpc_conformance,
     "failpoint-registry": check_failpoint_registry,
     "metric-drift": check_metric_drift,
+    "trace-propagation": check_trace_propagation,
 }

@@ -239,6 +239,10 @@ class Histogram(Metric):
         self._buckets: Dict[Tuple, List[int]] = {}
         self._sums: Dict[Tuple, float] = {}
         self._counts: Dict[Tuple, int] = {}
+        # OpenMetrics exemplars: tagset -> {bucket_index: exemplar dict}
+        # (last observation wins per bucket; shipped with each flush so
+        # dashboards can jump from a hot p99 bucket to a trace_id)
+        self._exemplars: Dict[Tuple, Dict[int, Dict[str, Any]]] = {}
         self._arm_finalizer()
 
     def _make_drain(self):
@@ -246,27 +250,26 @@ class Histogram(Metric):
         boundaries = self.boundaries
         buckets, sums = self._buckets, self._sums
         counts, lock = self._counts, self._lock
+        exemplars = self._exemplars
 
         def drain():
             with lock:
-                out = [{"name": name, "type": typ, "description": desc,
-                        "tags": dict(k), "buckets": list(b),
-                        "boundaries": boundaries,
-                        "sum": sums.get(k, 0.0),
-                        "count": counts.get(k, 0)}
-                       for k, b in buckets.items()]
-                buckets.clear()
-                sums.clear()
-                counts.clear()
-            return out
+                return _histogram_records(name, typ, desc, boundaries,
+                                          buckets, sums, counts,
+                                          exemplars)
         return drain
 
     def observe(self, value: float,
-                tags: Optional[Dict[str, str]] = None) -> None:
-        self.observe_key(_tags_key(self._merged(tags)), value)
+                tags: Optional[Dict[str, str]] = None,
+                exemplar: Optional[Dict[str, Any]] = None) -> None:
+        self.observe_key(_tags_key(self._merged(tags)), value,
+                         exemplar=exemplar)
 
-    def observe_key(self, key: Tuple, value: float) -> None:
-        """Hot-path observe with a precomputed tags key."""
+    def observe_key(self, key: Tuple, value: float,
+                    exemplar: Optional[Dict[str, Any]] = None) -> None:
+        """Hot-path observe with a precomputed tags key.  ``exemplar``
+        (e.g. ``{"trace_id": ...}``) attaches to the bucket the value
+        lands in — OpenMetrics exemplar semantics, last-wins."""
         from bisect import bisect_left
         with self._lock:
             if not self._admit_key(key, self._buckets):
@@ -275,23 +278,44 @@ class Histogram(Metric):
             if buckets is None:
                 buckets = self._buckets[key] = \
                     [0] * (len(self.boundaries) + 1)
-            buckets[bisect_left(self.boundaries, value)] += 1
+            idx = bisect_left(self.boundaries, value)
+            buckets[idx] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._counts[key] = self._counts.get(key, 0) + 1
+            if exemplar is not None:
+                ex = dict(exemplar)
+                ex.setdefault("value", value)
+                ex.setdefault("ts", time.time())
+                self._exemplars.setdefault(key, {})[idx] = ex
 
     def _flush(self):
         with self._lock:
-            out = [{"name": self.name, "type": self.TYPE,
-                    "description": self.description,
-                    "tags": dict(k), "buckets": list(b),
-                    "boundaries": self.boundaries,
-                    "sum": self._sums.get(k, 0.0),
-                    "count": self._counts.get(k, 0)}
-                   for k, b in self._buckets.items()]
-            self._buckets.clear()
-            self._sums.clear()
-            self._counts.clear()
-        return out
+            return _histogram_records(
+                self.name, self.TYPE, self.description, self.boundaries,
+                self._buckets, self._sums, self._counts, self._exemplars)
+
+
+def _histogram_records(name, typ, desc, boundaries, buckets, sums,
+                       counts, exemplars) -> List[Dict[str, Any]]:
+    """Drain one histogram's per-tagset state into flush records
+    (caller holds the metric's lock).  Shared by ``_flush`` and the
+    finalizer drain so the two record shapes can never drift."""
+    out = []
+    for k, b in buckets.items():
+        rec = {"name": name, "type": typ, "description": desc,
+               "tags": dict(k), "buckets": list(b),
+               "boundaries": boundaries,
+               "sum": sums.get(k, 0.0),
+               "count": counts.get(k, 0)}
+        ex = exemplars.get(k)
+        if ex:
+            rec["exemplars"] = dict(ex)
+        out.append(rec)
+    buckets.clear()
+    sums.clear()
+    counts.clear()
+    exemplars.clear()
+    return out
 
 
 def flush_all() -> List[Dict[str, Any]]:

@@ -54,6 +54,14 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_serve_batch_occupancy",
     "ray_tpu_serve_queue_depth",
     "ray_tpu_serve_replicas",
+    "ray_tpu_serve_ttft_seconds",
+    "ray_tpu_serve_decode_step_seconds",
+    # tracing series: need traced traffic (and retention/eviction need
+    # the tail-sampler / ring pressure to actually fire)
+    "ray_tpu_trace_spans_total",
+    "ray_tpu_trace_retained_total",
+    "ray_tpu_trace_sampled_out_total",
+    "ray_tpu_trace_evicted_total",
 }
 
 

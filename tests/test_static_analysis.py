@@ -18,7 +18,7 @@ from ray_tpu.tools.check.findings import (
 )
 from ray_tpu.tools.check.project import (
     ProjectConfig, check_failpoint_registry, check_metric_drift,
-    check_rpc_conformance,
+    check_rpc_conformance, check_trace_propagation,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -287,6 +287,75 @@ def test_rpc_conformance_clean(fixture_project):
     ]
     findings = [f for f in check_rpc_conformance(contexts, fixture_project)
                 if f.symbol != "idempotent.vanished"]
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# trace-propagation
+# ---------------------------------------------------------------------------
+
+def test_trace_propagation_flags_dropped_chain(fixture_project):
+    cfg = fixture_project
+    contexts = [
+        _ctx("""
+            async def dispatch(conn, pool, addr):
+                await conn.call("handle_thing", {"payload": 1})    # 3
+                await pool.call(addr, "other_thing", {"x": 2})     # 4
+                await conn.call("ping")                            # 5
+        """, path="ray_tpu/serve/router2.py"),
+    ]
+    findings = check_trace_propagation(contexts, cfg)
+    assert _rules(findings) == ["trace-propagation"] * 3
+    assert [f.line for f in findings] == [3, 4, 5]
+    assert "trace" in findings[0].message
+
+
+def test_trace_propagation_clean_and_exempt(fixture_project):
+    contexts = [
+        _ctx("""
+            async def dispatch(conn, pool, addr, blob):
+                await conn.call("push_task", {"spec_blob": blob})
+                await conn.call("handle_thing", {"trace": None, "x": 1})
+                payload = {"trace": None, "y": 2}
+                await pool.call(addr, "other_thing", payload)
+                await conn.call("report_metrics", {"records": []})
+        """, path="ray_tpu/serve/router2.py"),
+        _ctx("""
+            async def outside_scope(conn):
+                await conn.call("handle_thing", {"payload": 1})
+        """, path="ray_tpu/core/other.py"),
+    ]
+    assert check_trace_propagation(contexts, fixture_project) == []
+
+
+def test_trace_propagation_worker_scope_is_function_limited(
+        fixture_project):
+    import dataclasses
+    cfg = dataclasses.replace(
+        fixture_project, trace_worker_file="ray_tpu/core/worker.py",
+        trace_worker_funcs=("_push_task",))
+    contexts = [
+        _ctx("""
+            async def _push_task(conn):
+                await conn.call("push_task", {"other": 1})        # flagged
+
+            async def _metrics_flush_loop(conn):
+                await conn.call("report_spans", {"spans": []})    # out of
+        """, path="ray_tpu/core/worker.py"),                      # scope
+    ]
+    findings = check_trace_propagation(contexts, cfg)
+    assert len(findings) == 1 and findings[0].symbol == "push_task"
+
+
+def test_trace_propagation_suppressible():
+    from ray_tpu.tools.check.cli import run_rules
+    ctx = _ctx("""
+        async def dispatch(conn):
+            # rtpu-check: disable=trace-propagation
+            await conn.call("handle_thing", {"payload": 1})
+    """, path="ray_tpu/serve/router2.py")
+    findings = run_rules([ctx], ProjectConfig(root="/nonexistent"),
+                         select=["trace-propagation"])
     assert findings == []
 
 

@@ -202,9 +202,13 @@ def test_flush_pipeline_end_to_end(telemetry_cluster):
     try:
         deadline = time.monotonic() + 30
         text = ""
+        # wait for the AGGREGATED value, not first appearance: the 3
+        # bump tasks may land on different workers whose flush loops
+        # tick at different phases — a partial count is mid-pipeline,
+        # not a failure
         while time.monotonic() < deadline:
             text = _scrape(url)
-            if "tele_e2e_requests" in text:
+            if 'tele_e2e_requests{route="/bump"} 6.0' in text:
                 break
             time.sleep(0.5)
         assert 'tele_e2e_requests{route="/bump"} 6.0' in text, text[-2000:]

@@ -1,0 +1,600 @@
+"""Distributed tracing plane tests (ISSUE 7): context/span unit
+behavior, GCS tail sampling, trace assembly + telescoping rendering,
+serve e2e traces through the HTTP ingress, and the 2-node
+replica-kill-mid-request chaos scenario (``make chaos``)."""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import tracing
+from ray_tpu.core.config import Config
+from ray_tpu.experimental.state import traces as traces_mod
+
+
+# ---------------------------------------------------------------------------
+# unit: context + span buffer (no cluster)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _fresh_tracing():
+    tracing._reset_for_tests()
+    yield
+    tracing._reset_for_tests()
+
+
+def test_context_birth_join_and_carrier():
+    tracing._reset_for_tests(force=True)
+    root = tracing.start_trace("ingress:t", deployment="t")
+    assert root.root and len(root.trace_id) == 16
+    # no ambient, no parent -> no span (untraced requests cost nothing)
+    assert tracing.start_span("child") is None
+    with tracing.use_ctx(root.ctx()):
+        child = tracing.start_span("child")
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        child.end()
+    root.end(status="ok")
+    recs = tracing.drain("unit")
+    assert [r["name"] for r in recs] == ["child", "ingress:t"]
+    assert recs[1]["root"] is True and recs[1]["status"] == "ok"
+    assert all(r["source"] == "unit" for r in recs)
+
+
+def test_disabled_tracing_creates_nothing():
+    tracing._reset_for_tests(force=False)
+    assert tracing.start_trace("x") is None
+    tracing.record("y", 0.0, 1.0, parent={"trace_id": "a", "span_id": "b"})
+    # record with explicit parent still appends (callers gate on ctx
+    # presence; a ctx can only exist if tracing was enabled at ingress)
+    assert tracing.pending() == 1
+
+
+def test_ctx_of_extracts_native_keys_from_mixed_carrier():
+    assert tracing.ctx_of(None) is None
+    assert tracing.ctx_of({"traceparent": "00-...-01"}) is None
+    ctx = tracing.ctx_of({"trace_id": "t", "span_id": "s",
+                          "traceparent": "00-...-01"})
+    assert ctx == {"trace_id": "t", "span_id": "s"}
+
+
+def test_buffer_bounded_and_drain_clock_corrects():
+    tracing._reset_for_tests(force=True)
+    from ray_tpu.core import telemetry as tm
+    cap = tracing._buf.maxlen
+    root = tracing.start_trace("r")
+    with tracing.use_ctx(root.ctx()):
+        for i in range(cap + 10):
+            tracing.record("s", 1000.0, 1001.0)
+    assert tracing.pending() == cap  # oldest dropped, never blocked
+    old_off = tm.clock_offset()
+    tm.set_clock_offset(5.0)
+    try:
+        recs = tracing.drain("unit")
+    finally:
+        tm.set_clock_offset(old_off)
+    assert recs[0]["start"] == 1005.0 and recs[0]["end"] == 1006.0
+    assert tracing.pending() == 0
+
+
+def test_span_ids_unique_across_fork_prefix_refresh():
+    tracing._reset_for_tests(force=True)
+    a = tracing._new_span_id()
+    prefix_a = tracing._id_prefix
+    # the zygote-fork path runs _reseed via os.register_at_fork: the
+    # child's prefix (and counter) must diverge from the parent's
+    tracing._reseed()
+    b = tracing._new_span_id()
+    assert tracing._id_prefix != prefix_a
+    assert a != b and a[:8] != b[:8]
+
+
+# ---------------------------------------------------------------------------
+# unit: GCS tail sampling + trace ring (handlers, no cluster)
+# ---------------------------------------------------------------------------
+
+def _gcs(**cfg):
+    from ray_tpu.core.gcs import GcsServer
+    return GcsServer(Config(gcs_table_storage="memory", **cfg))
+
+
+def _span(trace_id, name="s", root=False, status="ok", tags=None,
+          parent=None, start=1.0, end=2.0):
+    rec = {"trace_id": trace_id, "span_id": f"{trace_id}-{name}",
+           "parent_id": parent, "name": name, "start": start,
+           "end": end, "status": status, "source": "unit"}
+    if root:
+        rec["root"] = True
+    if tags:
+        rec["tags"] = tags
+    return rec
+
+
+def _report(gcs, spans):
+    asyncio.run(gcs.handle_report_trace_spans(None, {"spans": spans}))
+
+
+def test_tail_sampling_keeps_anomalies_drops_fast_successes():
+    gcs = _gcs(trace_sample_keep_fraction=0.0)
+    # fast success: sampled out at COMPLETION (root arrival)
+    _report(gcs, [_span("a" * 16, "child"),
+                  _span("a" * 16, "ingress", root=True)])
+    # error, shed, deadline: always kept
+    _report(gcs, [_span("b" * 16, "ingress", root=True, status="error")])
+    _report(gcs, [_span("c" * 16, "ingress", root=True, status="shed")])
+    _report(gcs, [_span("d" * 16, "ingress", root=True,
+                        status="deadline")])
+    # SLO-violating and retried successes: always kept
+    _report(gcs, [_span("e" * 16, "ingress", root=True,
+                        tags={"slo_miss": True, "deployment": "dep"})])
+    _report(gcs, [_span("f" * 16, "ingress", root=True,
+                        tags={"retried": True})])
+    out = asyncio.run(gcs.handle_get_trace(None, {"trace_id": "a" * 16}))
+    assert out["sampled_out"] and out["spans"] == []
+    for tid in ("b", "c", "d", "e", "f"):
+        t = asyncio.run(gcs.handle_get_trace(None, {"trace_id": tid * 16}))
+        assert t["spans"], tid
+    rows = asyncio.run(gcs.handle_list_traces(None, {}))
+    assert {r["trace_id"][0] for r in rows} == {"b", "c", "d", "e", "f"}
+    # --slo-misses surface: errors + slo_miss, not the plain retried ok
+    rows = asyncio.run(gcs.handle_list_traces(None, {"slo_misses": True}))
+    assert {r["trace_id"][0] for r in rows} == {"b", "c", "d", "e"}
+    rows = asyncio.run(gcs.handle_list_traces(
+        None, {"slo_misses": True, "deployment": "dep"}))
+    assert [r["trace_id"][0] for r in rows] == ["e"]
+
+
+def test_tail_sampling_keep_fraction_one_keeps_everything():
+    gcs = _gcs(trace_sample_keep_fraction=1.0)
+    _report(gcs, [_span("a" * 16, "ingress", root=True)])
+    t = asyncio.run(gcs.handle_get_trace(None, {"trace_id": "a" * 16}))
+    assert t["spans"] and not t.get("sampled_out")
+
+
+def test_late_spans_of_sampled_out_trace_drop_on_tombstone():
+    gcs = _gcs(trace_sample_keep_fraction=0.0)
+    _report(gcs, [_span("a" * 16, "ingress", root=True)])
+    _report(gcs, [_span("a" * 16, "straggler")])  # flushed later
+    t = asyncio.run(gcs.handle_get_trace(None, {"trace_id": "a" * 16}))
+    assert t["sampled_out"] and t["spans"] == []
+
+
+def test_trace_ring_eviction_accounting():
+    gcs = _gcs(trace_sample_keep_fraction=1.0, trace_table_size=16)
+    for i in range(40):
+        tid = f"{i:016x}"
+        _report(gcs, [_span(tid, "ingress", root=True)])
+    dbg = asyncio.run(gcs.handle_debug_state(None, None))
+    assert dbg["traces"] <= 16
+    assert dbg["traces_evicted"] >= 24
+    # newest traces survive, oldest evicted
+    assert asyncio.run(gcs.handle_get_trace(
+        None, {"trace_id": f"{39:016x}"})) is not None
+    assert asyncio.run(gcs.handle_get_trace(
+        None, {"trace_id": f"{0:016x}"})) is None
+
+
+def test_get_trace_prefix_match():
+    gcs = _gcs(trace_sample_keep_fraction=1.0)
+    _report(gcs, [_span("abcdef0123456789", "ingress", root=True)])
+    t = asyncio.run(gcs.handle_get_trace(None, {"trace_id": "abcdef"}))
+    assert t is not None and t["trace_id"] == "abcdef0123456789"
+
+
+@pytest.mark.failpoints
+def test_trace_drop_failpoint_discards_batch():
+    from ray_tpu.util import failpoint as fp
+    gcs = _gcs(trace_sample_keep_fraction=1.0)
+    fp.arm("gcs.report_spans.trace_drop", "drop", count=1)
+    try:
+        _report(gcs, [_span("a" * 16, "ingress", root=True)])
+    finally:
+        fp.disarm("gcs.report_spans.trace_drop")
+    assert asyncio.run(gcs.handle_get_trace(
+        None, {"trace_id": "a" * 16})) is None
+    # next batch ingests normally (drop-don't-block, reporter unaware)
+    _report(gcs, [_span("b" * 16, "ingress", root=True)])
+    assert asyncio.run(gcs.handle_get_trace(
+        None, {"trace_id": "b" * 16})) is not None
+
+
+# ---------------------------------------------------------------------------
+# unit: assembly + rendering
+# ---------------------------------------------------------------------------
+
+def _mk(name, start, end, span_id, parent=None, tags=None, root=False):
+    rec = {"trace_id": "t" * 16, "span_id": span_id, "parent_id": parent,
+           "name": name, "start": start, "end": end, "status": "ok",
+           "source": "unit"}
+    if root:
+        rec["root"] = True
+    if tags:
+        rec["tags"] = tags
+    return rec
+
+
+def test_tree_build_and_phase_rollup_telescopes():
+    spans = [
+        _mk("ingress:d", 0.0, 1.0, "r", root=True),
+        _mk("proxy.dispatch", 0.05, 0.95, "d", parent="r"),
+        _mk("router.assign", 0.05, 0.10, "a", parent="d"),
+        _mk("exec:handle_request", 0.15, 0.90, "e", parent="d"),
+        _mk("batch.queue", 0.15, 0.20, "q", parent="e"),
+        _mk("batch.decode", 0.20, 0.90, "b", parent="e"),
+    ]
+    roots = traces_mod.build_tree(spans)
+    assert len(roots) == 1 and roots[0]["span_id"] == "r"
+    assert [c["span_id"] for c in roots[0]["children"]] == ["d"]
+    rollup = traces_mod.phase_rollup(roots[0])
+    total = sum(rollup.values())
+    # phases telescope to the root duration exactly on clean intervals
+    assert abs(total - 1.0) < 1e-9
+    assert abs(rollup["sched"] - 0.10) < 1e-9   # assign + queue
+    assert abs(rollup["exec"] - 0.70) < 1e-9    # exec self + decode
+    assert rollup["gap"] > 0                    # uncovered seams
+
+
+def test_format_trace_renders_tree_and_skew():
+    trace = {"trace_id": "t" * 16, "name": "ingress:d", "status": "ok",
+             "duration_s": 1.0, "complete": True, "slo_miss": False,
+             "retried": False,
+             "spans": [
+                 _mk("ingress:d", 0.0, 1.0, "r", root=True),
+                 _mk("exec:f", 0.2, 0.8, "e", parent="r"),
+             ]}
+    out = traces_mod.format_trace(trace)
+    assert "ingress:d" in out and "exec:f" in out
+    assert "telescoping:" in out and "skew" in out
+    # orphan spans (parent never reported) still render as roots
+    trace["spans"].append(_mk("orphan", 0.3, 0.4, "o", parent="gone"))
+    assert "orphan" in traces_mod.format_trace(trace)
+
+
+def test_perfetto_events_shape():
+    events = traces_mod.perfetto_events(
+        [_mk("exec:f", 2.0, 2.5, "e", parent="r", tags={"slot": 1})])
+    (ev,) = events
+    assert ev["ph"] == "X" and ev["ts"] == 2.0e6 and ev["dur"] == 0.5e6
+    assert ev["args"]["slot"] == 1 and ev["args"]["parent_id"] == "r"
+
+
+def test_format_trace_list_flags():
+    rows = [{"trace_id": "a" * 16, "status": "ok", "duration_s": 0.5,
+             "deployment": "d", "slo_miss": True, "retried": False,
+             "complete": True, "name": "ingress:d", "n_spans": 3}]
+    out = traces_mod.format_trace_list(rows)
+    assert "slo_miss" in out and "ingress:d" in out
+
+
+# ---------------------------------------------------------------------------
+# e2e: serve request through the HTTP ingress (single node)
+# ---------------------------------------------------------------------------
+
+def _http_json(url, payload=None, timeout=60):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data)
+    t0 = time.time()
+    body = urllib.request.urlopen(req, timeout=timeout).read()
+    return json.loads(body), time.time() - t0
+
+
+def _wait_for_trace(w, deployment, predicate=lambda r: True,
+                    timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rows = w.gcs_call("list_traces",
+                          {"deployment": deployment, "limit": 50})
+        hits = [r for r in rows if r["complete"] and predicate(r)]
+        if hits:
+            return hits
+        time.sleep(0.5)
+    raise AssertionError(f"no retained trace for {deployment}")
+
+
+def test_e2e_serve_trace_telescopes_to_client_latency():
+    """A traced serve request's assembled span tree covers ingress ->
+    dispatch -> assign -> task -> exec -> batch admission -> per-step
+    spans, and the per-hop durations telescope (within clock-sync
+    tolerance) to the client-observed e2e latency."""
+    from ray_tpu.serve.http_proxy import start_proxy
+    from ray_tpu.serve.toy_decoder import ToyDecoder, make_prompt
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                 _system_config={"metrics_report_period_s": 0.5,
+                                 "trace_sample_keep_fraction": 1.0})
+    try:
+        @serve.deployment(num_replicas=1, max_concurrent_queries=8,
+                          batching={"max_batch_size": 2,
+                                    "max_seq_len": 32})
+        class Echo(ToyDecoder):
+            def __init__(self):
+                super().__init__(step_delay_s=0.005)
+
+        serve.run(Echo.bind())
+        host, port = start_proxy()
+        url = f"http://{host}:{port}/Echo"
+        payload = {"prompt": make_prompt(0, 4), "max_new_tokens": 3}
+        _http_json(url, payload)  # warm (jit compile)
+        # streaming request: feeds the TTFT histogram
+        req = urllib.request.Request(f"{url}?stream=1",
+                                     data=json.dumps(payload).encode())
+        urllib.request.urlopen(req, timeout=60).read()
+        # the MEASURED request decodes 5 tokens (warm/stream did 3), so
+        # its trace is identified by its decode span — never by arrival
+        # order, which races the per-process flush cadence
+        reply, client_s = _http_json(
+            url, {"prompt": make_prompt(0, 4), "max_new_tokens": 5})
+        assert "result" in reply
+
+        from ray_tpu.core.worker import global_worker
+        w = global_worker()
+        required = {"proxy.dispatch", "router.assign",
+                    "task:handle_request", "exec:handle_request",
+                    "batch.queue", "batch.decode", "decode.step"}
+
+        def measured_and_assembled(t):
+            # fully assembled (replica spans flush later than the
+            # proxy's root) AND the 5-step request's trace
+            names = {s["name"] for s in t.get("spans") or []}
+            return required <= names and any(
+                s["name"] == "batch.decode"
+                and (s.get("tags") or {}).get("steps") == 5
+                for s in t["spans"])
+
+        trace = None
+        deadline = time.time() + 30
+        while time.time() < deadline and trace is None:
+            for r in w.gcs_call("list_traces",
+                                {"deployment": "Echo", "limit": 50}):
+                if r["status"] != "ok" or not r["complete"]:
+                    continue
+                t = w.gcs_call("get_trace", {"trace_id": r["trace_id"]})
+                if measured_and_assembled(t):
+                    trace = t
+                    break
+            if trace is None:
+                time.sleep(0.5)
+        assert trace is not None, "measured trace never fully assembled"
+        # spans from at least two processes (proxy worker + replica)
+        assert len({s["source"] for s in trace["spans"]}) >= 2
+        # telescoping: per-hop spans account for the root's duration
+        # within clock-sync tolerance
+        roots = traces_mod.build_tree(trace["spans"])
+        root = roots[0]
+        root_s = root["end"] - root["start"]
+        accounted = sum(traces_mod.phase_rollup(root).values())
+        assert abs(accounted - root_s) < 0.1, (accounted, root_s)
+        # ...and the root sits inside what the client actually measured
+        assert root_s <= client_s + 0.05, (root_s, client_s)
+        assert root_s > 0.01  # 5 decode steps at >=5ms each
+        # children nest inside the root's interval (clock-corrected)
+        for s in trace["spans"]:
+            assert s["start"] >= root["start"] - 0.05
+            assert s["end"] <= root["end"] + 0.05
+        # rendering works on real data
+        out = traces_mod.format_trace(trace)
+        assert "telescoping:" in out
+        # exemplar: the latency histogram links a bucket to a trace_id
+        deadline = time.time() + 15
+        exemplars = None
+        while time.time() < deadline and not exemplars:
+            recs = w.gcs_call("get_metrics", {})
+            for rec in recs:
+                if rec["name"] == "ray_tpu_serve_request_latency_s":
+                    exemplars = rec.get("exemplars")
+            if not exemplars:
+                time.sleep(0.5)
+        assert exemplars, "no exemplar on the serve latency histogram"
+        assert any("trace_id" in ex for ex in exemplars.values())
+        # TTFT series flowed for the streaming request
+        assert any(r["name"] == "ray_tpu_serve_ttft_seconds"
+                   for r in recs)
+        assert any(r["name"] == "ray_tpu_serve_decode_step_seconds"
+                   for r in recs)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_e2e_async_task_body_keeps_ambient_trace():
+    """A traced ASYNC task body still sees the ambient context (the
+    executor resets it only after asyncio.run, not when calling fn
+    merely built the coroutine), so its nested submissions join the
+    parent's trace instead of silently truncating at the exec hop."""
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024,
+                 _system_config={"metrics_report_period_s": 0.5,
+                                 "trace_sample_keep_fraction": 1.0})
+    try:
+        @ray_tpu.remote
+        def leaf():
+            return 41
+
+        @ray_tpu.remote
+        async def parent():
+            return ray_tpu.get(leaf.remote()) + 1
+
+        assert ray_tpu.get(parent.remote(), timeout=60) == 42
+        from ray_tpu.core.worker import global_worker
+        w = global_worker()
+        deadline = time.time() + 20
+        joined = False
+        while time.time() < deadline and not joined:
+            for r in w.gcs_call("list_traces", {"limit": 100}):
+                if "parent" not in (r["name"] or ""):
+                    continue
+                t = w.gcs_call("get_trace", {"trace_id": r["trace_id"]})
+                names = {s["name"] for s in t.get("spans") or []}
+                joined = any("leaf" in n for n in names)
+                if joined:
+                    break
+            if not joined:
+                time.sleep(0.5)
+        assert joined, "child task's spans never joined the parent trace"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_e2e_tail_sampling_keeps_slo_miss_drops_fast():
+    """With keep_fraction=0, a fast success is sampled out while a
+    request breaching serve_slo_latency_s is retained (the acceptance
+    shape: SLO-missing kept, fast successes sampled down)."""
+    from ray_tpu.serve.http_proxy import start_proxy
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024,
+                 _system_config={"metrics_report_period_s": 0.5,
+                                 "trace_sample_keep_fraction": 0.0,
+                                 "serve_slo_latency_s": 0.4})
+    try:
+        @serve.deployment(num_replicas=1)
+        def fast(_payload=None):
+            return "ok"
+
+        @serve.deployment(num_replicas=1)
+        def slow(_payload=None):
+            time.sleep(0.8)
+            return "ok"
+
+        serve.run(fast.bind())
+        serve.run(slow.bind())
+        host, port = start_proxy()
+        _http_json(f"http://{host}:{port}/fast", {})
+        _http_json(f"http://{host}:{port}/fast", {})  # post-warm-up: fast
+        _http_json(f"http://{host}:{port}/slow", {})
+
+        from ray_tpu.core.worker import global_worker
+        w = global_worker()
+        rows = _wait_for_trace(w, "slow",
+                               lambda r: r["slo_miss"])
+        assert rows[0]["status"] == "ok" and rows[0]["slo_miss"]
+        # SLO-miss listing surfaces it
+        misses = w.gcs_call("list_traces",
+                            {"slo_misses": True, "deployment": "slow"})
+        assert misses
+        # the warmed fast request completed under the SLO: sampled out
+        time.sleep(2.0)
+        fast_rows = w.gcs_call("list_traces",
+                               {"deployment": "fast", "limit": 50})
+        assert all(r["slo_miss"] for r in fast_rows), fast_rows
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: 2-node traced request with a replica killed mid-request
+# ---------------------------------------------------------------------------
+
+@pytest.mark.failpoints
+def test_two_node_traced_request_shows_retry_hop():
+    """A traced serve request crossing nodes whose first replica is
+    SIGKILLed mid-request assembles a trace showing BOTH dispatch
+    attempts — the failed hop and the retry on the surviving replica —
+    with spans from both nodes telescoping to the client latency.
+    Retried traces are retained even at keep_fraction=0."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.serve.http_proxy import start_proxy
+    from ray_tpu.serve.toy_decoder import ToyDecoder, make_prompt
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 3},
+                _system_config={
+                    "metrics_report_period_s": 0.5,
+                    "trace_sample_keep_fraction": 0.0})
+    try:
+        c.add_node(num_cpus=3)
+        c.connect()
+        c.wait_for_nodes()
+
+        @serve.deployment(num_replicas=2, max_concurrent_queries=8,
+                          ray_actor_options={
+                              "scheduling_strategy": "SPREAD"},
+                          batching={"max_batch_size": 2,
+                                    "max_seq_len": 32})
+        class Echo(ToyDecoder):
+            def __init__(self):
+                super().__init__(step_delay_s=0.01)
+
+        serve.run(Echo.bind())
+        from ray_tpu.serve._internal import CONTROLLER_NAME
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        table = ray_tpu.get(
+            controller.get_routing_table.remote(-1, 1.0), timeout=30)
+        entry = table["table"]["Echo"]
+        replicas = entry["replicas"]
+        nodes = [ray_tpu.get(r.node_id.remote(), timeout=30)
+                 for r in replicas]
+        assert len(set(nodes)) == 2, "replicas must spread across nodes"
+
+        host, port = start_proxy()
+        proxy = ray_tpu.get_actor("SERVE_HTTP_PROXY")
+        proxy_node = ray_tpu.get(proxy.node_id.remote(), timeout=30)
+        # doom the replica the router prefers (same node as the proxy)
+        # so the FIRST request lands on it and must retry cross-node
+        doomed_idx = nodes.index(proxy_node) \
+            if proxy_node in nodes else 0
+        doomed = replicas[doomed_idx]
+        ray_tpu.get(doomed.arm_failpoint.remote(
+            "serve.replica.handle_request", "kill"), timeout=30)
+
+        url = f"http://{host}:{port}/Echo"
+        payload = {"prompt": make_prompt(0, 4), "max_new_tokens": 3}
+        client_s = None
+        from ray_tpu.core.exceptions import ActorDiedError
+        for _ in range(10):
+            reply, elapsed = _http_json(url, payload, timeout=90)
+            assert "result" in reply, reply  # client always answered
+            try:
+                ray_tpu.get(doomed.ready.remote(), timeout=5)
+            except (ActorDiedError, Exception):
+                client_s = elapsed
+                break
+        assert client_s is not None, "armed replica never hit"
+
+        from ray_tpu.core.worker import global_worker
+        w = global_worker()
+        # wait until the retried trace is fully assembled: the SURVIVING
+        # replica's exec span flushes on its own process's cadence,
+        # later than the proxy's root (the killed replica's buffered
+        # spans die with it — that attempt legitimately has no subtree)
+        trace = None
+        deadline = time.time() + 40
+        while time.time() < deadline and trace is None:
+            for r in w.gcs_call("list_traces",
+                                {"deployment": "Echo", "limit": 50}):
+                if not (r["retried"] and r["complete"]):
+                    continue
+                t = w.gcs_call("get_trace", {"trace_id": r["trace_id"]})
+                if any(s["name"] == "exec:handle_request"
+                       for s in t.get("spans") or []):
+                    trace = t
+                    break
+            if trace is None:
+                time.sleep(0.5)
+        assert trace is not None, "retried trace never fully assembled"
+        spans = trace["spans"]
+        dispatches = [s for s in spans if s["name"] == "proxy.dispatch"]
+        assert len(dispatches) >= 2, "trace must show the retry hop"
+        statuses = {s.get("status") for s in dispatches}
+        assert "replica_died" in statuses and "ok" in statuses
+        # the surviving attempt executed on the OTHER replica's process
+        execs = [s for s in spans if s["name"] == "exec:handle_request"]
+        assert execs, "surviving replica's exec span missing"
+        # telescoping: spans accounted vs client-observed latency
+        root = traces_mod.build_tree(spans)[0]
+        root_s = root["end"] - root["start"]
+        assert root_s <= client_s + 0.1
+        accounted = sum(traces_mod.phase_rollup(root).values())
+        assert abs(accounted - root_s) < 0.15, (accounted, root_s)
+        # non-retried fast successes were sampled down (fraction 0)
+        others = w.gcs_call("list_traces",
+                            {"deployment": "Echo", "limit": 50})
+        assert all(r["retried"] or r["status"] != "ok" for r in others)
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        c.shutdown()
