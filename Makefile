@@ -7,7 +7,7 @@
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
 .PHONY: all native check test chaos bench bench-transfer bench-serve \
-	metrics-smoke tsan asan sanitize clean
+	bench-rl metrics-smoke tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -38,7 +38,7 @@ chaos: native
 	PYTHONHASHSEED=0 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_failpoints.py tests/test_chaos.py \
 	  tests/test_object_transfer.py tests/test_serve_batching.py \
-	  tests/test_tracing.py \
+	  tests/test_tracing.py tests/test_rllib_pipeline.py \
 	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 
@@ -59,6 +59,12 @@ bench-transfer: native
 # off; one-line JSON delta vs the newest BENCH_r*.json serve rows.
 bench-serve: native
 	JAX_PLATFORMS=cpu python scripts/bench_serve.py
+
+# RL-pipeline bench: decoupled PPO (env actors + centralized batched
+# inference) vs the legacy fleet, with both worker-count scaling
+# curves; one-line JSON delta vs the newest BENCH_r*.json PPO rows.
+bench-rl: native
+	JAX_PLATFORMS=cpu python scripts/bench_rl.py
 
 # Boot a mini-cluster, scrape dashboard /metrics, and diff the exported
 # ray_tpu_* series list against scripts/metrics_golden.txt (catches

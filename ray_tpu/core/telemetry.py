@@ -435,6 +435,52 @@ def serve_replicas(deployment: str, n: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# RL pipeline (rllib decoupled acting/learning — docs/rl_pipeline.md)
+# ---------------------------------------------------------------------------
+
+def rl_inference_batch(occupancy: float) -> None:
+    """One centralized-inference dispatch: ``occupancy`` is real rows /
+    padded bucket rows (1.0 = no padding waste); the dispatch count is
+    the histogram's sample count."""
+    if not enabled():
+        return
+    _hist("ray_tpu_rl_inference_batch_occupancy",
+          "rows / padded bucket per centralized RL inference dispatch",
+          _OCC_FRAC_BOUNDS).observe_key(_EMPTY_KEY, occupancy)
+
+
+def rl_fragment_queue_depth(depth: int) -> None:
+    """Learner-side: trajectory fragments ready (returned by env actors)
+    but not yet consumed by the PPO update — sustained growth means the
+    learner is the bottleneck, sustained zero means acting is."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_rl_fragment_queue_depth",
+           "ready-but-unconsumed trajectory fragments at the RL learner"
+           ).set_key(_EMPTY_KEY, float(depth))
+
+
+def rl_weight_sync_age(age_s: float) -> None:
+    """Inference-actor-side: seconds since the last weight publish when
+    a batch is dispatched — the acting policy's staleness in wall time."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_rl_weight_sync_age_s",
+           "age of the acting policy's weights at inference dispatch"
+           ).set_key(_EMPTY_KEY, age_s)
+
+
+def rl_fragments_dropped_stale(n: int = 1) -> None:
+    """Fragments discarded by the learner because their weights version
+    lagged more than ``rl_max_fragment_lag`` behind."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_rl_fragments_dropped_stale_total",
+             "trajectory fragments dropped by the off-policy "
+             "staleness bound").inc_key(_EMPTY_KEY, float(n))
+
+
+# ---------------------------------------------------------------------------
 # distributed tracing plane (core/tracing.py / GCS trace ring)
 # ---------------------------------------------------------------------------
 

@@ -13,13 +13,23 @@ from ray_tpu.rllib.algorithm_config import AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.env import (  # noqa: F401
     Box,
     CartPole,
+    CartPoleVector,
     Discrete,
     MultiAgentCartPole,
     MultiAgentEnv,
     Pendulum,
     RandomEnv,
+    SyncVectorEnv,
+    VectorEnv,
+    as_vector_env,
     make_env,
     register_env,
+    register_vector_env,
+)
+from ray_tpu.rllib.execution import DecoupledPipeline  # noqa: F401
+from ray_tpu.rllib.inference import (  # noqa: F401
+    InferenceActor,
+    InferenceBatcher,
 )
 from ray_tpu.rllib.connectors import (  # noqa: F401
     ClipActions,
@@ -35,7 +45,10 @@ from ray_tpu.rllib.policy_server import (  # noqa: F401
     PolicyServerInput,
 )
 from ray_tpu.rllib.postprocessing import compute_gae  # noqa: F401
-from ray_tpu.rllib.rollout_worker import RolloutWorker  # noqa: F401
+from ray_tpu.rllib.rollout_worker import (  # noqa: F401
+    EnvActor,
+    RolloutWorker,
+)
 from ray_tpu.rllib.sample_batch import (  # noqa: F401
     MultiAgentBatch,
     SampleBatch,

@@ -24,6 +24,16 @@ class AlgorithmConfig:
         self.num_envs_per_worker = 1
         self.sample_async = False
         self.rollout_fragment_length = 200
+        # decoupled (Podracer/Sebulba) pipeline — docs/rl_pipeline.md
+        self.decoupled = False
+        self.num_env_actors: Optional[int] = None
+        self.rl_envs_per_actor: Optional[int] = None
+        self.rl_env_groups = 1
+        self.rl_inference_batch_size = 0
+        self.rl_num_inference_actors = 1
+        self.rl_max_fragment_lag = 2
+        self.rl_inference_max_wait_s = 0.002
+        self.rl_inference_device: Optional[str] = None
         # training
         self.lr = 5e-4
         self.gamma = 0.99
@@ -68,7 +78,15 @@ class AlgorithmConfig:
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
                  num_envs_per_worker: Optional[int] = None,
                  rollout_fragment_length: Optional[int] = None,
-                 sample_async: Optional[bool] = None
+                 sample_async: Optional[bool] = None,
+                 decoupled: Optional[bool] = None,
+                 num_env_actors: Optional[int] = None,
+                 rl_envs_per_actor: Optional[int] = None,
+                 rl_env_groups: Optional[int] = None,
+                 rl_inference_batch_size: Optional[int] = None,
+                 rl_num_inference_actors: Optional[int] = None,
+                 rl_max_fragment_lag: Optional[int] = None,
+                 rl_inference_max_wait_s: Optional[float] = None,
                  ) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = int(num_rollout_workers)
@@ -82,6 +100,26 @@ class AlgorithmConfig:
             # one fragment in flight through learn_on_batch, at the cost
             # of <=1-update-stale weights per fragment
             self.sample_async = bool(sample_async)
+        if decoupled is not None:
+            # Podracer-style decoupled acting/learning: vectorized env
+            # actors + centralized batched inference + async learner
+            # (docs/rl_pipeline.md); falls back to the classic paths for
+            # multi-agent / recurrent / external-input configs
+            self.decoupled = bool(decoupled)
+        if num_env_actors is not None:
+            self.num_env_actors = int(num_env_actors)
+        if rl_envs_per_actor is not None:
+            self.rl_envs_per_actor = int(rl_envs_per_actor)
+        if rl_env_groups is not None:
+            self.rl_env_groups = int(rl_env_groups)
+        if rl_inference_batch_size is not None:
+            self.rl_inference_batch_size = int(rl_inference_batch_size)
+        if rl_num_inference_actors is not None:
+            self.rl_num_inference_actors = int(rl_num_inference_actors)
+        if rl_max_fragment_lag is not None:
+            self.rl_max_fragment_lag = int(rl_max_fragment_lag)
+        if rl_inference_max_wait_s is not None:
+            self.rl_inference_max_wait_s = float(rl_inference_max_wait_s)
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

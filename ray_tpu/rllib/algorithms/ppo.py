@@ -151,7 +151,27 @@ class PPO(Algorithm):
     supports_multi_agent = True
 
     def setup(self) -> None:
+        # decoupled (Podracer/Sebulba) pipeline: vectorized env actors +
+        # centralized batched inference (docs/rl_pipeline.md).  The
+        # WorkerSet keeps only the local learner worker; the acting
+        # plane is the pipeline's.
+        self._pipeline = None
+        if self._wants_decoupled():
+            n = int(self.config.get("num_env_actors")
+                    or self.config.get("num_rollout_workers") or 0)
+            self.config["num_env_actors"] = n
+            self.config["num_rollout_workers"] = 0
         super().setup()
+        if self._wants_decoupled():
+            from ray_tpu.rllib.execution import DecoupledPipeline
+
+            self._pipeline = DecoupledPipeline(
+                self.config["env"], self.policy_class, self.config)
+            # align the acting policy with the learner's init exactly
+            # (same-seed init already matches; restore()/custom weights
+            # must too)
+            self._pipeline.publish_weights(
+                self.workers.local_worker.get_weights())
         # overlapped-sampling pipeline (config.rollouts(sample_async=True)
         # — the reference LearnerThread shape brought to PPO): one
         # fragment stays in flight per worker THROUGH learn_on_batch, so
@@ -164,6 +184,21 @@ class PPO(Algorithm):
         if self._sample_async():
             for w in self.workers.remote_workers:
                 self._inflight[w.sample_with_metrics.remote()] = w
+
+    def _wants_decoupled(self) -> bool:
+        """The decoupled pipeline serves the single-policy feedforward
+        case; multi-agent, recurrent, connector and external-input
+        configs keep the classic per-worker-policy paths."""
+        model = self.config.get("model") or {}
+        return bool(self.config.get("decoupled")) \
+            and int(self.config.get("num_env_actors")
+                    or self.config.get("num_rollout_workers") or 0) > 0 \
+            and not self.config.get("policies") \
+            and not callable(self.config.get("input_")) \
+            and not self.config.get("obs_connectors") \
+            and not self.config.get("action_connectors") \
+            and not model.get("use_lstm") \
+            and not model.get("use_attention")
 
     def _sample_async(self) -> bool:
         # multi-agent batches need the per-policy concat/learn of the
@@ -223,30 +258,75 @@ class PPO(Algorithm):
         """Non-blocking weight push: set_weights queues behind each
         worker's in-flight sample (ordered actor queue), so waiting on it
         would re-serialize the pipeline."""
-        import ray_tpu
-        ref = ray_tpu.put(self.workers.local_worker.get_weights())
-        for w in self.workers.remote_workers:
-            w.set_weights.remote(ref)
+        self.workers.sync_weights()
 
     def _collect_metrics(self):
         out = [self.workers.local_worker.metrics()]
+        if self._pipeline is not None:
+            out.extend(self._pipeline.drain_metrics())
         if self._sample_async():
             out.extend(self._pending_metrics)
             self._pending_metrics = []
         elif self.workers.remote_workers:
+            # bounded gather: the streamed sampler leaves one sample()
+            # in flight per worker, and metrics() queues behind it
+            # (max_concurrency=1) — a blocking full-set get here would
+            # hand the straggler stall right back to the learner.
+            # Unanswered refs stay pending (stats accumulate worker-
+            # side and arrive with a later iteration).
             import ray_tpu
-            out.extend(ray_tpu.get(
-                [w.metrics.remote() for w in self.workers.remote_workers]))
+            pending = getattr(self, "_metrics_inflight", {})
+            live = {id(w) for w in self.workers.remote_workers}
+            pending = {ref: w for ref, w in pending.items()
+                       if id(w) in live}
+            have = {id(w) for w in pending.values()}
+            for w in self.workers.remote_workers:
+                if id(w) not in have:
+                    pending[w.metrics.remote()] = w
+            ready, _ = ray_tpu.wait(list(pending),
+                                    num_returns=len(pending), timeout=2)
+            for ref in ready:
+                pending.pop(ref)
+                try:
+                    out.append(ray_tpu.get(ref))
+                except Exception:  # noqa: BLE001 — dead worker: its
+                    pass           # stats died with it
+            self._metrics_inflight = pending
         return out
+
+    def restore(self, checkpoint_dir: str) -> None:
+        super().restore(checkpoint_dir)
+        if self._pipeline is not None:
+            self._pipeline.publish_weights(
+                self.workers.local_worker.get_weights())
 
     def stop(self) -> None:
         self._inflight.clear()
+        if self._pipeline is not None:
+            self._pipeline.stop()
+            self._pipeline = None
         super().stop()
 
     def training_step(self) -> Dict[str, Any]:
         from ray_tpu.rllib.sample_batch import MultiAgentBatch
 
         target = int(self.config.get("train_batch_size", 4000))
+        if self._pipeline is not None:
+            # async learner loop: env actors keep collecting (through
+            # the inference actors' current weights) WHILE the fused
+            # PPO update runs; the staleness bound caps how old an
+            # admitted fragment's policy may be
+            batch = self._pipeline.collect(target)
+            batch = standardize_advantages(batch)
+            self._timesteps_total += len(batch)
+            stats = self.workers.local_worker.policy.learn_on_batch(batch)
+            self._pipeline.publish_weights(
+                self.workers.local_worker.get_weights())
+            stats["num_env_steps_sampled_this_iter"] = len(batch)
+            stats["rl_weights_version"] = self._pipeline.version
+            stats["rl_fragments_dropped_stale"] = \
+                self._pipeline.stale_dropped
+            return stats
         if self._sample_async():
             batch = self._async_sample(target)
             batch = standardize_advantages(batch)

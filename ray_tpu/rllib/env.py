@@ -13,7 +13,7 @@ Spaces are the minimal ``Discrete``/``Box`` pair the policies need.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -457,6 +457,168 @@ class ContextBandit:
         return self._ctx, rew, False, True, {}
 
 
+class VectorEnv:
+    """Batched environment surface for the decoupled RL pipeline
+    (docs/rl_pipeline.md): N sub-environments step as ONE call over
+    stacked arrays, so a vectorized env actor's per-tick host cost is a
+    few numpy passes instead of N python loops.
+
+    Contract (auto-reset semantics, the Podracer/EnvPool shape):
+
+    ``reset_all() -> obs [N, ...]``
+        (Re)start every sub-env.
+    ``step(actions [N]) -> (obs, rewards, terminateds, truncateds)``
+        One tick for all N sub-envs.  A done sub-env resets
+        *immediately* and ``obs`` carries the FIRST observation of its
+        next episode; its final observation is in ``final_obs`` rows
+        where ``terminateds | truncateds``.
+    ``final_obs [N, ...]``
+        Valid only at rows that finished this tick (bootstrap source
+        for truncated episodes).
+    """
+
+    num_envs: int
+    observation_space: Any
+    action_space: Any
+    final_obs: np.ndarray
+
+    def reset_all(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray):
+        raise NotImplementedError
+
+
+class SyncVectorEnv(VectorEnv):
+    """Generic fallback: wraps N scalar gym-style envs in a python loop.
+    Correct for any registered env; CartPoleVector shows the fully
+    vectorized fast path."""
+
+    def __init__(self, envs: List[Any]):
+        self.envs = envs
+        self.num_envs = len(envs)
+        self.observation_space = envs[0].observation_space
+        self.action_space = envs[0].action_space
+        obs_shape = tuple(self.observation_space.shape)
+        self.final_obs = np.zeros((self.num_envs,) + obs_shape, np.float32)
+
+    def reset_all(self) -> np.ndarray:
+        return np.stack([e.reset()[0] for e in self.envs])
+
+    def step(self, actions: np.ndarray):
+        n = self.num_envs
+        obs = [None] * n
+        rew = np.zeros(n, np.float32)
+        term = np.zeros(n, bool)
+        trunc = np.zeros(n, bool)
+        for i, env in enumerate(self.envs):
+            o, r, te, tr, _ = env.step(actions[i])
+            rew[i], term[i], trunc[i] = r, te, tr
+            if te or tr:
+                self.final_obs[i] = o
+                o = env.reset()[0]
+            obs[i] = o
+        return np.stack(obs), rew, term, trunc
+
+
+class CartPoleVector(VectorEnv):
+    """CartPole dynamics over [N, 4] state arrays: one numpy pass steps
+    every sub-env (same constants as :class:`CartPole`, so learning
+    curves are comparable)."""
+
+    def __init__(self, num_envs: int,
+                 config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.num_envs = int(num_envs)
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.x_threshold = 2.4
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.max_episode_steps = int(config.get("max_episode_steps", 500))
+        self.observation_space = Box(-np.inf, np.inf, (4,), np.float32)
+        self.action_space = Discrete(2)
+        self._rng = np.random.default_rng(config.get("seed"))
+        self._state = np.zeros((self.num_envs, 4))
+        self._steps = np.zeros(self.num_envs, np.int64)
+        self.final_obs = np.zeros((self.num_envs, 4), np.float32)
+
+    def reset_all(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05,
+                                        size=(self.num_envs, 4))
+        self._steps[:] = 0
+        return self._state.astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(np.asarray(actions).reshape(-1) == 1,
+                         self.force_mag, -self.force_mag)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot ** 2 * sintheta) \
+            / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costheta ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._steps += 1
+        term = (np.abs(x) > self.x_threshold) \
+            | (np.abs(theta) > self.theta_threshold)
+        trunc = (~term) & (self._steps >= self.max_episode_steps)
+        rew = np.ones(self.num_envs, np.float32)
+        done = term | trunc
+        if done.any():
+            obs = self._state.astype(np.float32)
+            self.final_obs[done] = obs[done]
+            k = int(done.sum())
+            self._state[done] = self._rng.uniform(-0.05, 0.05, size=(k, 4))
+            self._steps[done] = 0
+        return self._state.astype(np.float32), rew, term, trunc
+
+
+#: env name/class -> natively vectorized implementation
+_VECTOR_REGISTRY: Dict[Any, Any] = {}
+
+
+def register_vector_env(env: Any, vector_cls: Any) -> None:
+    """Register a natively vectorized implementation for an env name or
+    class: ``vector_cls(num_envs, config)`` -> :class:`VectorEnv`."""
+    _VECTOR_REGISTRY[env] = vector_cls
+
+
+def as_vector_env(env_spec: Any, num_envs: int,
+                  config: Optional[Dict[str, Any]] = None) -> VectorEnv:
+    """Best vectorized form of ``env_spec``: a registered native
+    :class:`VectorEnv` when one exists, else N scalar instances behind
+    :class:`SyncVectorEnv`.  Seeds fan out per sub-env like
+    RolloutWorker does."""
+    config = dict(config or {})
+    vec = _VECTOR_REGISTRY.get(env_spec)
+    if vec is None and isinstance(env_spec, str):
+        vec = _VECTOR_REGISTRY.get(_ENV_REGISTRY.get(env_spec))
+    if vec is None and not isinstance(env_spec, str):
+        vec = _VECTOR_REGISTRY.get(getattr(env_spec, "__name__", None))
+    if vec is not None:
+        return vec(num_envs, config)
+    seed = config.get("seed")
+    envs = []
+    for i in range(num_envs):
+        cfg = dict(config)
+        if seed is not None:
+            cfg["seed"] = int(seed) + i
+        envs.append(make_env(env_spec, cfg))
+    return SyncVectorEnv(envs)
+
+
 _ENV_REGISTRY: Dict[str, Any] = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
@@ -469,6 +631,8 @@ _ENV_REGISTRY: Dict[str, Any] = {
     "PendulumMass": PendulumMass,
     "RepeatPrevEnv": RepeatPrevEnv,
 }
+
+register_vector_env(CartPole, CartPoleVector)
 
 
 def _register_extra_envs():

@@ -22,6 +22,235 @@ from ray_tpu.rllib.sample_batch import (MultiAgentBatch, SampleBatch,
                                         concat_samples)
 
 
+class EnvActor:
+    """Policy-free vectorized environment actor (the Sebulba "actor"
+    half — docs/rl_pipeline.md).  Steps ``rl_envs_per_actor`` envs as a
+    batch, ships observation batches to the centralized
+    :class:`~ray_tpu.rllib.inference.InferenceActor`, receives action
+    batches, and hands fixed-length trajectory fragments back over the
+    object plane.  It never holds weights, so weight sync cost is flat
+    in env-actor count.
+
+    Latency hiding: the envs are split into ``rl_env_groups`` groups
+    stepped round-robin — while one group's inference RPC is in flight,
+    the other group steps its envs (double buffering), so the actor is
+    throughput-bound, not inference-round-trip-bound.
+
+    Advantage estimation happens HERE (batched GAE over the [T, N]
+    fragment, one reversed pass over T for all N envs) because the
+    inference replies carry ``vf_preds``; the learner receives
+    train-ready fragments.
+    """
+
+    def __init__(self, env_spec: Any, config: Dict[str, Any],
+                 actor_index: int, inference: Any):
+        from ray_tpu.rllib.env import as_vector_env
+
+        self.config = dict(config)
+        self.actor_index = int(actor_index)
+        self._inference = inference
+        n = int(config.get("rl_envs_per_actor")
+                or config.get("num_envs_per_worker") or 1)
+        groups = max(1, min(int(config.get("rl_env_groups", 1) or 1), n))
+        seed = config.get("seed")
+        env_config = dict(config.get("env_config", {}))
+        sizes = [n // groups + (1 if g < n % groups else 0)
+                 for g in range(groups)]
+        self._groups: List[Any] = []
+        base = 0
+        for g, size in enumerate(sizes):
+            cfg = dict(env_config)
+            if seed is not None:
+                cfg["seed"] = (int(seed) + actor_index) * 1000 + base
+            self._groups.append(as_vector_env(env_spec, size, cfg))
+            base += size
+        self._gamma = float(config.get("gamma", 0.99))
+        self._lambda = float(config.get("lambda_", 0.95))
+        self._fragment = int(config.get("rollout_fragment_length", 200))
+        self._obs = [vec.reset_all() for vec in self._groups]
+        self._eps_ids = []
+        self._next_eps_id = 0
+        for size in sizes:
+            self._eps_ids.append(np.arange(
+                self._next_eps_id, self._next_eps_id + size, dtype=np.int64))
+            self._next_eps_id += size
+        self._ep_rew = [np.zeros(s) for s in sizes]
+        self._ep_len = [np.zeros(s, np.int64) for s in sizes]
+        self._completed_returns: List[float] = []
+        self._completed_lens: List[int] = []
+        self._seq = 0
+        # announce ourselves so the batcher's admission window knows
+        # the fleet size (fire-and-forget; keyed by slot so a recreated
+        # actor re-registers idempotently)
+        inference.register_client.remote(self.actor_index)
+
+    # ------------------------------------------------------------------
+    def collect_fragment(self) -> Dict[str, Any]:
+        """One fixed-length fragment per env group, double-buffered
+        across groups; returns a dict with the GAE-postprocessed
+        ``batch``, piggybacked episode ``metrics``, the per-actor
+        monotonic ``seq``, and the oldest weights ``version`` that
+        contributed actions."""
+        import ray_tpu
+        from ray_tpu.util.failpoint import failpoint
+
+        failpoint("rllib.env_actor.collect")
+        T = self._fragment
+        G = len(self._groups)
+        # per-group per-tick column buffers
+        cols = [{k: [] for k in ("obs", "actions", "logp", "vf", "rew",
+                                 "term", "trunc", "eps")}
+                for _ in range(G)]
+        boot = [np.zeros((T, vec.num_envs), np.float32)
+                for vec in self._groups]
+        # rows pending a bootstrap value: (tick, env_i) aligned with the
+        # extra obs rows appended to the group's next inference call
+        pending: List[List[tuple]] = [[] for _ in range(G)]
+        inflight_pending: List[List[tuple]] = [[] for _ in range(G)]
+        version = None
+
+        def submit(g: int):
+            live = self._obs[g]
+            extra = [row for _, _, row in pending[g]]
+            stacked = np.concatenate([live, np.stack(extra)], axis=0) \
+                if extra else live
+            inflight_pending[g] = [(t, i) for t, i, _ in pending[g]]
+            pending[g] = []
+            return self._inference.infer.remote(stacked)
+
+        refs = [submit(g) for g in range(G)]
+        for t in range(T):
+            for g in range(G):
+                vec = self._groups[g]
+                nlive = vec.num_envs
+                actions, extras, ver = ray_tpu.get(refs[g])
+                version = ver if version is None else min(version, ver)
+                vf_all = np.asarray(extras["vf_preds"], np.float32)
+                for (bt, bi), v in zip(inflight_pending[g],
+                                       vf_all[nlive:]):
+                    boot[g][bt, bi] = v
+                inflight_pending[g] = []
+                acts = np.asarray(actions)[:nlive]
+                obs = self._obs[g]
+                obs2, rew, term, trunc = vec.step(acts)
+                c = cols[g]
+                c["obs"].append(obs)
+                c["actions"].append(acts)
+                c["logp"].append(
+                    np.asarray(extras["action_logp"],
+                               np.float32)[:nlive])
+                c["vf"].append(vf_all[:nlive])
+                c["rew"].append(np.asarray(rew, np.float32))
+                c["term"].append(term)
+                c["trunc"].append(trunc)
+                c["eps"].append(self._eps_ids[g].copy())
+                self._ep_rew[g] += rew
+                self._ep_len[g] += 1
+                done = term | trunc
+                if done.any():
+                    for i in np.nonzero(done)[0]:
+                        self._completed_returns.append(
+                            float(self._ep_rew[g][i]))
+                        self._completed_lens.append(
+                            int(self._ep_len[g][i]))
+                        self._eps_ids[g][i] = self._next_eps_id
+                        self._next_eps_id += 1
+                        if trunc[i] and not term[i]:
+                            # truncated: V(final_obs) rides the next
+                            # inference call as an appended row
+                            pending[g].append(
+                                (t, int(i), vec.final_obs[i].copy()))
+                    self._ep_rew[g][done] = 0.0
+                    self._ep_len[g][done] = 0
+                self._obs[g] = obs2
+                refs[g] = submit(g)  # value pass doubles as next tick
+        # final pass: refs[g] now carries V(current obs) for the
+        # fragment-boundary bootstrap plus any last-tick truncations
+        chunks: List[SampleBatch] = []
+        for g in range(G):
+            vec = self._groups[g]
+            nlive = vec.num_envs
+            _, extras, ver = ray_tpu.get(refs[g])
+            version = ver if version is None else min(version, ver)
+            vf_all = np.asarray(extras["vf_preds"], np.float32)
+            for (bt, bi), v in zip(inflight_pending[g], vf_all[nlive:]):
+                boot[g][bt, bi] = v
+            inflight_pending[g] = []
+            chunks.append(self._postprocess_group(
+                g, cols[g], boot[g], vf_all[:nlive]))
+        self._seq += 1
+        return {
+            "batch": concat_samples(chunks),
+            "metrics": self.metrics(),
+            "seq": self._seq,
+            "version": 0 if version is None else int(version),
+            "actor_index": self.actor_index,
+        }
+
+    def _postprocess_group(self, g: int, c: Dict[str, List[np.ndarray]],
+                           boot: np.ndarray, vf_last: np.ndarray
+                           ) -> SampleBatch:
+        """Batched GAE over one group's [T, N] fragment: a single
+        reversed pass over T handles every env; episode boundaries
+        (term|trunc) zero the carry and switch the bootstrap to 0
+        (terminal) or V(final_obs) (truncated)."""
+        T = len(c["rew"])
+        rew = np.stack(c["rew"]).astype(np.float64)          # [T, N]
+        vf = np.stack(c["vf"]).astype(np.float64)
+        term = np.stack(c["term"])
+        trunc = np.stack(c["trunc"])
+        done = term | trunc
+        bootv = boot.astype(np.float64)                       # 0 at term
+        gamma, lam = self._gamma, self._lambda
+        adv = np.zeros_like(rew)
+        acc = np.zeros(rew.shape[1])
+        for t in reversed(range(T)):
+            vnext = np.where(done[t], bootv[t],
+                             vf[t + 1] if t + 1 < T else vf_last)
+            delta = rew[t] + gamma * vnext - vf[t]
+            acc = delta + gamma * lam * np.where(done[t], 0.0, acc)
+            adv[t] = acc
+        targets = adv + vf
+
+        def flat(x):
+            # env-major so eps_id chunks stay contiguous
+            arr = np.asarray(x)
+            return np.swapaxes(arr, 0, 1).reshape(
+                (-1,) + arr.shape[2:])
+
+        return SampleBatch({
+            SampleBatch.OBS: flat(np.stack(c["obs"])),
+            SampleBatch.ACTIONS: flat(np.stack(c["actions"])),
+            SampleBatch.ACTION_LOGP: flat(np.stack(c["logp"])),
+            SampleBatch.VF_PREDS: flat(vf.astype(np.float32)),
+            SampleBatch.REWARDS: flat(rew.astype(np.float32)),
+            SampleBatch.TERMINATEDS: flat(term),
+            SampleBatch.TRUNCATEDS: flat(trunc),
+            SampleBatch.ADVANTAGES: flat(adv.astype(np.float32)),
+            SampleBatch.VALUE_TARGETS: flat(targets.astype(np.float32)),
+            SampleBatch.EPS_ID: flat(np.stack(c["eps"])),
+        })
+
+    # ------------------------------------------------------------------
+    def metrics(self) -> Dict[str, Any]:
+        out = {"episode_returns": list(self._completed_returns),
+               "episode_lens": list(self._completed_lens)}
+        self._completed_returns = []
+        self._completed_lens = []
+        return out
+
+    def ping(self) -> str:
+        return "ok"
+
+    def arm_failpoint(self, name: str, action: str = "raise",
+                      **options) -> None:
+        """Chaos tooling: arm a failpoint inside THIS actor's process
+        (one env actor of the fleet can be faulted)."""
+        from ray_tpu.util import failpoint as _fp
+
+        _fp.arm(name, action, **options)
+
+
 class RolloutWorker:
     def __init__(self, env_spec: Any, policy_cls: type,
                  config: Dict[str, Any], worker_index: int = 0):

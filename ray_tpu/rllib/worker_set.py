@@ -39,13 +39,22 @@ class WorkerSet:
                                        self._config, index)
 
     # ------------------------------------------------------------------
-    def sync_weights(self) -> None:
-        """Broadcast local weights to all remote workers; the weights ride
-        the object plane once (put + shared ref) rather than per-worker."""
+    def sync_weights(self, *, block: bool = False) -> None:
+        """Publish local weights ONCE as a single object-plane broadcast
+        object; each worker's ``set_weights`` carries only the ref, and
+        concurrent pulls chain on the in-flight copy (the transfer
+        plane's ``_InflightPull`` broadcast-tree path), so sync cost is
+        flat in worker count.  Non-blocking by default: ordered actor
+        queues guarantee every call submitted after this one sees the
+        new weights; pass ``block=True`` to wait for full application
+        (e.g. before measuring)."""
         if not self.remote_workers:
             return
         ref = ray_tpu.put(self.local_worker.get_weights())
-        ray_tpu.get([w.set_weights.remote(ref) for w in self.remote_workers])
+        pending = [w.set_weights.remote(ref)
+                   for w in self.remote_workers]
+        if block:
+            ray_tpu.get(pending)
 
     def foreach_worker(self, fn: Callable[[RolloutWorker], Any],
                        local: bool = True) -> List[Any]:

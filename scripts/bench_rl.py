@@ -1,0 +1,81 @@
+"""RL-pipeline benchmark: decoupled PPO vs the legacy fleet.
+
+Runs the PPO section of ``bench.py`` (inline baseline, legacy
+sample_async fleet, decoupled Podracer pipeline, and both worker-count
+scaling curves — see docs/rl_pipeline.md) and prints ONE line of JSON
+(the ``make bench-transfer`` contract) with deltas against the newest
+``BENCH_r*.json`` artifact that carries PPO rows.
+
+The two numbers ISSUE 9 / ROADMAP item 2 care about:
+
+1. ``ppo_env_steps_per_sec_fleet`` — fleet sampling+training
+   throughput under the decoupled pipeline (vs the ≥50k v4-8 target
+   and the previous round's legacy number).
+2. ``ppo_scaling_curve`` — throughput vs env-actor count 1→4;
+   monotone non-decreasing = the anti-scaling is gone.
+
+Usage::
+
+    python scripts/bench_rl.py          # (make bench-rl)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if HERE not in sys.path:
+    sys.path.insert(0, HERE)
+
+PPO_KEYS = ("ppo_env_steps_per_sec_inline", "ppo_env_steps_per_sec_fleet",
+            "ppo_env_steps_per_sec_fleet_legacy",
+            "ppo_scaling_curve", "ppo_scaling_curve_legacy")
+
+
+def load_baseline() -> dict:
+    arts = sorted(
+        glob.glob(os.path.join(HERE, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    for path in reversed(arts):
+        try:
+            with open(path) as f:
+                details = (json.load(f).get("parsed") or {}) \
+                    .get("details") or {}
+        except Exception:  # noqa: BLE001 — artifact tails can truncate
+            continue
+        if any(k in details for k in PPO_KEYS):
+            base = {k: details[k] for k in PPO_KEYS if k in details}
+            base["baseline_round"] = int(
+                re.search(r"r(\d+)", os.path.basename(path)).group(1))
+            return base
+    return {}
+
+
+def main() -> None:
+    import bench
+
+    out = bench.bench_rllib_ppo()
+    base = load_baseline()
+    result = {"bench": "rl", **out}
+    if base:
+        result["baseline_round"] = base.get("baseline_round")
+        prev = base.get("ppo_env_steps_per_sec_fleet")
+        cur = out.get("ppo_env_steps_per_sec_fleet")
+        if prev and cur:
+            result["fleet_vs_baseline"] = round(cur / prev, 3)
+    curve = out.get("ppo_scaling_curve") or {}
+    vals = [curve[k] for k in sorted(curve, key=int)]
+    if vals:
+        result["scaling_monotone_nondecreasing"] = all(
+            b >= a * 0.98 for a, b in zip(vals, vals[1:]))
+        result["scaling_1_to_4"] = round(vals[-1] / vals[0], 3) \
+            if vals[0] else None
+    print(json.dumps(result, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

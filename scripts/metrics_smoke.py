@@ -55,6 +55,12 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_serve_queue_depth",
     "ray_tpu_serve_replicas",
     "ray_tpu_serve_ttft_seconds",
+    # RL pipeline series: only exported while a decoupled PPO job runs
+    # (inference actors / learner processes)
+    "ray_tpu_rl_inference_batch_occupancy",
+    "ray_tpu_rl_fragment_queue_depth",
+    "ray_tpu_rl_weight_sync_age_s",
+    "ray_tpu_rl_fragments_dropped_stale_total",
     "ray_tpu_serve_decode_step_seconds",
     # tracing series: need traced traffic (and retention/eviction need
     # the tail-sampler / ring pressure to actually fire)

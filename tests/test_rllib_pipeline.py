@@ -1,0 +1,308 @@
+"""Decoupled RL pipeline tests (ISSUE 9 / docs/rl_pipeline.md):
+batched-inference admission + padding buckets, fragment ordering and
+staleness-bound enforcement, learning-progress smoke, and a 2-node
+chaos case killing an env actor mid-rollout."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.ppo import PPOConfig, PPOPolicy
+from ray_tpu.rllib.env import (CartPole, CartPoleVector, RandomEnv,
+                               SyncVectorEnv, as_vector_env)
+from ray_tpu.rllib.inference import InferenceBatcher, inference_buckets
+
+
+# -- vectorized env plane ---------------------------------------------------
+
+def test_cartpole_vector_matches_scalar_semantics():
+    vec = CartPoleVector(3, {"max_episode_steps": 10, "seed": 0})
+    obs = vec.reset_all()
+    assert obs.shape == (3, 4)
+    seen_done = False
+    for _ in range(12):
+        obs, rew, term, trunc = vec.step(np.ones(3, np.int64))
+        assert obs.shape == (3, 4) and rew.shape == (3,)
+        if (term | trunc).any():
+            seen_done = True
+            # auto-reset: live obs rows are fresh-episode obs (small),
+            # final_obs holds the terminal state
+            done = term | trunc
+            assert np.all(np.abs(obs[done]) <= 0.05 + 1e-6)
+    assert seen_done  # 10-step truncation guarantees dones in 12 steps
+
+
+def test_sync_vector_env_fallback_autoresets():
+    vec = as_vector_env(RandomEnv, 2, {"episode_len": 3, "seed": 0})
+    assert isinstance(vec, SyncVectorEnv)
+    vec.reset_all()
+    dones = 0
+    for _ in range(7):
+        _, _, term, trunc = vec.step(np.zeros(2, np.int64))
+        dones += int((term | trunc).sum())
+    assert dones == 4  # 2 envs x 2 boundaries in 7 steps of len-3 episodes
+
+
+def test_as_vector_env_uses_native_cartpole():
+    vec = as_vector_env(CartPole, 4, {"seed": 0})
+    assert isinstance(vec, CartPoleVector)
+    vec2 = as_vector_env("CartPole-v1", 4, {"seed": 0})
+    assert isinstance(vec2, CartPoleVector)
+
+
+# -- batched inference admission -------------------------------------------
+
+def test_inference_buckets_are_powers_of_two():
+    assert inference_buckets(100) == (8, 16, 32, 64, 128)
+    assert inference_buckets(8) == (8,)
+
+
+def _policy(nobs=4):
+    env = CartPole({})
+    return PPOPolicy(env.observation_space, env.action_space,
+                     {"_device": "cpu", "seed": 0})
+
+
+def test_batcher_coalesces_concurrent_requests():
+    """K requests queued at one dispatch boundary become ONE padded XLA
+    call; per-request slices come back row-exact."""
+    batcher = InferenceBatcher(_policy(), max_rows=64, max_wait_s=0.02)
+    for _ in range(4):
+        batcher.register_client()
+    obs = [np.full((5, 4), i, np.float32) for i in range(4)]
+    futs = [batcher.submit(o) for o in obs]
+    outs = [f.result(timeout=10) for f in futs]
+    for i, (actions, extras, version) in enumerate(outs):
+        assert actions.shape == (5,)
+        assert extras["vf_preds"].shape == (5,)
+        assert extras["action_logp"].shape == (5,)
+        assert version == 0
+    stats = batcher.stats()
+    # 20 rows in >= 1 dispatch; the admission window makes 1 the norm
+    assert stats["rows"] == 20
+    assert stats["dispatches"] <= 2
+    batcher.stop()
+
+
+def test_batcher_no_recompile_within_bucket():
+    """Varying request sizes inside one bucket must produce ONE batch
+    shape (= one XLA trace); only a bucket change adds a shape."""
+    calls = []
+
+    class CountingPolicy:
+        def compute_actions(self, obs):
+            calls.append(obs.shape)
+            n = obs.shape[0]
+            return np.zeros(n, np.int64), {
+                "action_logp": np.zeros(n, np.float32),
+                "vf_preds": np.zeros(n, np.float32)}
+
+        def set_weights(self, w):
+            pass
+
+    batcher = InferenceBatcher(CountingPolicy(), max_rows=64,
+                               max_wait_s=0.0)
+    for rows in (3, 7, 5, 8, 2, 6):   # all inside the 8-bucket
+        batcher.submit(np.zeros((rows, 4), np.float32)).result(timeout=10)
+    assert set(calls) == {(8, 4)}
+    batcher.submit(np.zeros((9, 4), np.float32)).result(timeout=10)
+    assert set(calls) == {(8, 4), (16, 4)}
+    st = batcher.stats()
+    assert st["batch_shapes"] == [(8,), (16,)]
+    batcher.stop()
+
+
+def test_batcher_set_weights_versions_replies():
+    batcher = InferenceBatcher(_policy(), max_rows=16, max_wait_s=0.0)
+    _, _, v0 = batcher.submit(
+        np.zeros((2, 4), np.float32)).result(timeout=10)
+    assert v0 == 0
+    batcher.set_weights(_policy().get_weights(), 7)
+    _, _, v1 = batcher.submit(
+        np.zeros((2, 4), np.float32)).result(timeout=10)
+    assert v1 == 7
+    batcher.stop()
+
+
+def test_batcher_oversized_request_chunks():
+    batcher = InferenceBatcher(_policy(), max_rows=16, max_wait_s=0.0)
+    actions, extras, _ = batcher.submit(
+        np.zeros((40, 4), np.float32)).result(timeout=10)
+    assert actions.shape == (40,)
+    assert extras["vf_preds"].shape == (40,)
+    batcher.stop()
+
+
+def test_batcher_engine_error_fails_only_that_batch():
+    class FlakyPolicy:
+        def __init__(self):
+            self.fail_next = False
+
+        def compute_actions(self, obs):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("boom")
+            n = obs.shape[0]
+            return np.zeros(n, np.int64), {
+                "vf_preds": np.zeros(n, np.float32)}
+
+    pol = FlakyPolicy()
+    batcher = InferenceBatcher(pol, max_rows=16, max_wait_s=0.0)
+    pol.fail_next = True
+    with pytest.raises(RuntimeError, match="boom"):
+        batcher.submit(np.zeros((2, 4), np.float32)).result(timeout=10)
+    actions, _, _ = batcher.submit(
+        np.zeros((2, 4), np.float32)).result(timeout=10)
+    assert actions.shape == (2,)
+    batcher.stop()
+
+
+# -- pipeline: ordering, staleness, learning -------------------------------
+
+@pytest.mark.usefixtures("ray_start_regular")
+class TestDecoupledPipeline:
+    def _build(self, **rollouts):
+        config = (PPOConfig()
+                  .environment(CartPole,
+                               env_config={"max_episode_steps": 50})
+                  .rollouts(num_rollout_workers=2, decoupled=True,
+                            rollout_fragment_length=32,
+                            rl_envs_per_actor=8, **rollouts)
+                  .training(train_batch_size=512, sgd_minibatch_size=128,
+                            num_sgd_iter=2)
+                  .debugging(seed=0))
+        return config.build()
+
+    def test_fragments_ordered_and_versioned(self):
+        algo = self._build()
+        pipe = algo._pipeline
+        assert pipe is not None
+        for _ in range(3):
+            r = algo.train()
+            assert np.isfinite(r["total_loss"])
+        # per-actor fragment seqs advanced strictly (ordering held)
+        assert set(pipe._last_seq) == {0, 1}
+        assert all(seq >= 2 for seq in pipe._last_seq.values())
+        # weights published once per learner step as one broadcast
+        assert pipe.version == 1 + algo.iteration
+        st = pipe.stats()
+        infer = st["inference"][0]
+        assert infer["dispatches"] > 0
+        # padding buckets held: every dispatch shape is a power of two
+        assert all(s[0] & (s[0] - 1) == 0
+                   for s in infer["batch_shapes"])
+        assert r["num_env_steps_sampled_this_iter"] >= 512
+        # a fresh publish reaches the inference actors (the restore()
+        # path rides exactly this)
+        v = pipe.version
+        pipe.publish_weights(algo.workers.local_worker.get_weights())
+        assert pipe.version == v + 1
+        algo.stop()
+
+    def test_staleness_bound_drops_old_fragments(self):
+        algo = self._build()
+        pipe = algo._pipeline
+        algo.train()
+        # simulate a runaway learner: jump the published version far
+        # past anything the env actors' in-flight fragments carry; the
+        # publish hands inference actors the new version so FRESH
+        # fragments are admissible again
+        before = pipe.stale_dropped
+        pipe.version += 10
+        pipe.publish_weights(algo.workers.local_worker.get_weights())
+        r = algo.train()
+        assert pipe.stale_dropped > before
+        # yet the learner still trained: fragments collected after the
+        # publish carry the jumped version and pass the bound
+        assert np.isfinite(r["total_loss"])
+        assert r["num_env_steps_sampled_this_iter"] >= 512
+        algo.stop()
+
+    @pytest.mark.slow
+    def test_learning_progress_smoke(self):
+        """Opted in by `make chaos` (-m "slow or not slow"); tier-1
+        keeps the cheaper plumbing tests."""
+        config = (PPOConfig()
+                  .environment(CartPole,
+                               env_config={"max_episode_steps": 50})
+                  .rollouts(num_rollout_workers=2, decoupled=True,
+                            rollout_fragment_length=32,
+                            rl_envs_per_actor=8)
+                  .training(train_batch_size=512, sgd_minibatch_size=128,
+                            num_sgd_iter=4, lr=3e-4, entropy_coeff=0.01)
+                  .debugging(seed=0))
+        algo = config.build()
+        best = 0.0
+        for _ in range(10):
+            result = algo.train()
+            if np.isfinite(result["episode_reward_mean"]):
+                best = max(best, result["episode_reward_mean"])
+        algo.stop()
+        # random CartPole is ~22; the 50-step cap bounds episodes
+        assert best > 30.0, f"decoupled PPO failed to learn: best={best}"
+
+
+
+def test_decoupled_falls_back_for_multi_agent_and_recurrent():
+    """decoupled=True must quietly keep the classic paths for configs
+    the pipeline does not serve."""
+    config = (PPOConfig()
+              .environment(CartPole, env_config={"max_episode_steps": 20})
+              .rollouts(num_rollout_workers=0, decoupled=True)
+              .training(train_batch_size=64, sgd_minibatch_size=32,
+                        num_sgd_iter=1)
+              .debugging(seed=0))
+    algo = config.build()   # 0 workers -> no pipeline, local sampling
+    assert algo._pipeline is None
+    r = algo.train()
+    assert np.isfinite(r["total_loss"])
+    algo.stop()
+
+
+# -- chaos: SIGKILL an env actor mid-rollout -------------------------------
+
+@pytest.mark.slow
+@pytest.mark.failpoints
+def test_env_actor_killed_mid_rollout_two_nodes():
+    """2 raylets; one env actor SIGKILLs itself at its next
+    collect_fragment (failpoint `rllib.env_actor.collect`).  The learner
+    must keep finishing iterations on the survivor, replace the dead
+    actor in place, and recover full fleet throughput."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes()
+    try:
+        config = (PPOConfig()
+                  .environment(CartPole,
+                               env_config={"max_episode_steps": 50})
+                  .rollouts(num_rollout_workers=2, decoupled=True,
+                            rollout_fragment_length=32,
+                            rl_envs_per_actor=8)
+                  .training(train_batch_size=512,
+                            sgd_minibatch_size=128, num_sgd_iter=2)
+                  .debugging(seed=0))
+        algo = config.build()
+        pipe = algo._pipeline
+        algo.train()
+        # arm the kill inside ONE env actor of the fleet
+        ray_tpu.get(pipe.env_actors[0].arm_failpoint.remote(
+            "rllib.env_actor.collect", "kill", count=1), timeout=30)
+        for _ in range(3):
+            r = algo.train()
+            assert r["num_env_steps_sampled_this_iter"] >= 512
+            assert np.isfinite(r["total_loss"])
+        assert pipe.actors_recreated >= 1
+        # throughput recovered: the replacement actor answers and both
+        # slots produce fresh fragments
+        assert ray_tpu.get(pipe.env_actors[0].ping.remote(),
+                           timeout=60) == "ok"
+        seqs_before = dict(pipe._last_seq)
+        algo.train()
+        assert any(pipe._last_seq[s] > seqs_before.get(s, 0)
+                   for s in pipe._last_seq)
+        algo.stop()
+    finally:
+        c.shutdown()
