@@ -7,7 +7,7 @@
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
 .PHONY: all native check test chaos bench bench-transfer bench-serve \
-	bench-rl metrics-smoke tsan asan sanitize clean
+	bench-rl bench-controlplane metrics-smoke tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -39,6 +39,7 @@ chaos: native
 	  tests/test_failpoints.py tests/test_chaos.py \
 	  tests/test_object_transfer.py tests/test_serve_batching.py \
 	  tests/test_tracing.py tests/test_rllib_pipeline.py \
+	  tests/test_controlplane_scale.py \
 	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 
@@ -65,6 +66,12 @@ bench-serve: native
 # curves; one-line JSON delta vs the newest BENCH_r*.json PPO rows.
 bench-rl: native
 	JAX_PLATFORMS=cpu python scripts/bench_rl.py
+
+# Control-plane bench: actor-storm creation rate (many_actors row),
+# create+destroy churn, PG churn, and lease-grant p99 flatness 1 node
+# vs 4; one-line JSON delta vs the newest BENCH_r*.json rows.
+bench-controlplane: native
+	JAX_PLATFORMS=cpu python scripts/bench_controlplane.py
 
 # Boot a mini-cluster, scrape dashboard /metrics, and diff the exported
 # ray_tpu_* series list against scripts/metrics_golden.txt (catches

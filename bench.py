@@ -616,14 +616,16 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
         out["many_pgs_per_sec_4node"] = statistics.median(samples)
         out["vs_ref_many_pgs"] = out["many_pgs_per_sec_4node"] / 16.8
 
-        # many_actors: creation-to-ready rate.  A warmup wave first:
-        # the cold mode (pool prestart competing with the wave on one
-        # CPU) is a boot artifact, not the steady-state creation rate
-        warm = [A.remote() for _ in range(20)]
+        # many_actors: creation-to-ready rate.  A warmup wave first,
+        # sized LIKE the measured waves: the warm pool target is
+        # demand-driven (raylets size it from observed claim volume +
+        # lease backlog), so a 20-actor warmup would teach the pool to
+        # hold 20 when the waves need 100
+        warm = [A.remote() for _ in range(100)]
         ray_tpu.get([a.ping.remote() for a in warm], timeout=60)
         for a in warm:
             ray_tpu.kill(a)
-        time.sleep(3.0)
+        time.sleep(4.5)
         n_actors = 100
         samples = []
         for _ in range(3):
@@ -634,10 +636,12 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
             samples.append(n_actors / (time.perf_counter() - t0))
             for a in actors:
                 ray_tpu.kill(a)
-            # settle: reaping 100 actor workers + pool refill would
-            # otherwise compete with the next repeat / the broadcast
-            # row (the r03 many_pgs regression was this interference)
-            time.sleep(3.0)
+            # settle: reaping 100 actor workers + the demand-driven
+            # pool rebuild (~100 zygote forks, ~1.6 s of CPU here)
+            # must finish before the next repeat or the wave measures
+            # rebuild contention, not creation (the r03 many_pgs
+            # regression was exactly this class of interference)
+            time.sleep(4.5)
         out["many_actors_per_sec_4node"] = statistics.median(samples)
         out["vs_ref_many_actors"] = \
             out["many_actors_per_sec_4node"] / 600.4
@@ -686,6 +690,166 @@ def bench_cluster_scale(budget_s: float = 120.0) -> dict:
             try:
                 c.shutdown()
             except Exception:
+                pass
+    return out
+
+
+def _lease_grant_hist() -> "tuple | None":
+    """(boundaries, buckets) of ``ray_tpu_lease_grant_latency_s`` from
+    the live GCS metrics table (the raylets' queue-entry -> grant
+    histogram, merged across nodes)."""
+    import ray_tpu.core.worker as _cw
+
+    gw = _cw.global_worker_or_none()
+    if gw is None:
+        return None
+    for rec in gw.gcs_call("get_metrics", timeout=30):
+        if rec.get("name") == "ray_tpu_lease_grant_latency_s" \
+                and rec.get("type") == "histogram":
+            return (list(rec.get("boundaries") or []),
+                    list(rec.get("buckets") or []))
+    return None
+
+
+def _lease_grant_p99_ms(since: "tuple | None" = None) -> "float | None":
+    """p99 upper-bound (ms) of the lease-grant histogram, optionally
+    over the DELTA since a prior :func:`_lease_grant_hist` snapshot —
+    the warm-storm tail, not the cluster's cold-boot fork waits."""
+    cur = _lease_grant_hist()
+    if cur is None:
+        return None
+    bounds, buckets = cur
+    if since is not None and len(since[1]) == len(buckets):
+        buckets = [b - a for a, b in zip(since[1], buckets)]
+    total = sum(buckets)
+    if not total or not bounds:
+        return None
+    acc = 0
+    for i, n in enumerate(buckets):
+        acc += n
+        if acc >= 0.99 * total:
+            bound = bounds[i] if i < len(bounds) else bounds[-1] * 2
+            return round(bound * 1000, 3)
+    return None
+
+
+def bench_controlplane(budget_s: float = 240.0) -> dict:
+    """Control-plane scale-out section (ISSUE 10): actor-storm
+    create+destroy churn, placement-group churn, and the lease-grant
+    p99 at 1 node vs 4 nodes.  The flatness ratio is the scale-out
+    claim: batched registration + pipelined bring-up must not let the
+    grant tail grow with node count."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    out: dict = {}
+
+    def actor_cls():
+        @ray_tpu.remote(num_cpus=0.01)
+        class A:
+            def ping(self):
+                return 1
+        return A
+
+    def storm(A, n, waves, settle=0.0):
+        """create+ping+destroy cycles; returns actors/s THROUGH the
+        full cycle (kills included in the clock, settles excluded)."""
+        total = 0.0
+        for _ in range(waves):
+            t0 = time.perf_counter()
+            actors = [A.remote() for _ in range(n)]
+            ray_tpu.get([a.ping.remote() for a in actors],
+                        timeout=budget_s)
+            for a in actors:
+                ray_tpu.kill(a)
+            total += time.perf_counter() - t0
+            if settle:
+                time.sleep(settle)
+        return n * waves / total
+
+    # -- phase 1: single node (the p99 baseline) -----------------------
+    c = None
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+        c.connect()
+        A = actor_cls()
+        storm(A, 30, 1)          # warm pool + exercise the grant path
+        time.sleep(6.0)          # flush the warmup's grant latencies
+        h0 = _lease_grant_hist()
+        storm(A, 30, 2, settle=2.0)
+        time.sleep(6.0)          # one metrics_report_period_s flush
+        p99_1 = _lease_grant_p99_ms(since=h0)
+        if p99_1 is not None:
+            out["lease_grant_p99_ms_1node"] = p99_1
+    except Exception as e:  # noqa: BLE001 — report, keep benching
+        out["controlplane_error"] = f"1node: {type(e).__name__}: {e}"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        if c is not None:
+            try:
+                c.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- phase 2: 4 nodes (churn + p99 flatness) -----------------------
+    c = None
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+        for _ in range(3):
+            c.add_node(num_cpus=4)
+        c.connect()
+        c.wait_for_nodes()
+        # PG churn FIRST: PG cycles spawn no workers, but the actor
+        # storms below leave ~200 worker reaps + the demand-driven
+        # pool rebuild in their wake, which would tax whatever runs
+        # next (the r03 many_pgs "regression" was this interference)
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+        t0 = time.perf_counter()
+        cycles = 3
+        for _ in range(cycles):
+            pgs = [placement_group([{"CPU": 0.01}]) for _ in range(100)]
+            for pg in pgs:
+                pg.wait(30)
+            for pg in pgs:
+                remove_placement_group(pg)
+        out["pg_churn_per_sec_4node"] = round(
+            cycles * 100 / (time.perf_counter() - t0), 2)
+
+        A = actor_cls()
+        # warmup sized like the churn waves (demand-driven pool learns
+        # the wave size), then the p99 probe and the churn cycles
+        storm(A, 50, 1)
+        time.sleep(6.0)          # flush warmup grants before the delta
+        h0 = _lease_grant_hist()
+        # p99 probe: the IDENTICAL storm shape the 1-node phase ran
+        # (same offered load on 4x capacity — flatness is the claim)
+        storm(A, 30, 2, settle=2.0)
+        time.sleep(6.0)
+        p99_4 = _lease_grant_p99_ms(since=h0)
+        if p99_4 is not None:
+            out["lease_grant_p99_ms_4node"] = p99_4
+            p99_1 = out.get("lease_grant_p99_ms_1node")
+            if p99_1:
+                out["lease_p99_ratio_4v1"] = round(p99_4 / p99_1, 3)
+        # churn keeps kills + reaping IN the clock — the serve-replica
+        # / RL-fleet turnover shape, where creation storms overlap
+        # destruction storms
+        out["actor_churn_per_sec_4node"] = round(storm(A, 50, 4), 2)
+    except Exception as e:  # noqa: BLE001
+        out["controlplane_error"] = f"4node: {type(e).__name__}: {e}"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        if c is not None:
+            try:
+                c.shutdown()
+            except Exception:  # noqa: BLE001
                 pass
     return out
 
@@ -859,6 +1023,9 @@ SUMMARY_KEYS = (
     "pg_create_remove_per_sec",
     "many_tasks_per_sec_4node", "many_actors_per_sec_4node",
     "many_pgs_per_sec_4node", "broadcast_256mb_4node_s",
+    "actor_churn_per_sec_4node", "pg_churn_per_sec_4node",
+    "lease_grant_p99_ms_1node", "lease_grant_p99_ms_4node",
+    "lease_p99_ratio_4v1",
     "telemetry_overhead", "trace_overhead_pct",
     "ppo_env_steps_per_sec_inline", "ppo_env_steps_per_sec_fleet",
     "ppo_env_steps_per_sec_fleet_legacy",
@@ -868,7 +1035,7 @@ SUMMARY_KEYS = (
     # bench otherwise looks like a sparse-but-clean run
     "long_context_error", "long_context_128k_error",
     "runtime_bench_error", "cluster_scale_error",
-    "rllib_bench_error",
+    "rllib_bench_error", "controlplane_error",
 )
 
 
@@ -884,6 +1051,18 @@ def main() -> None:
         sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
                                     if a != "--serve"]
         bench_serve.main()
+        return
+    if "--controlplane" in sys.argv[1:]:
+        # control-plane microbench (actor storm churn, PG churn, lease
+        # p99 flatness + the many_actors row) with a one-line JSON
+        # delta — same entry `make bench-controlplane` uses
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_controlplane
+
+        sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
+                                    if a != "--controlplane"]
+        bench_controlplane.main()
         return
     if "--transfer" in sys.argv[1:]:
         # reduced transfer-plane microbench (broadcast + multi-client
@@ -906,6 +1085,7 @@ def main() -> None:
     if os.environ.get("RAY_TPU_BENCH_RUNTIME", "1") != "0":
         details.update(bench_runtime_tasks())
         details.update(bench_cluster_scale())
+        details.update(bench_controlplane())
         details.update(bench_rllib_ppo())
     try:
         details.update(bench_telemetry_overhead())

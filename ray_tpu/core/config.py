@@ -92,6 +92,26 @@ class Config:
     num_prestart_workers: int = -1
     #: Hard cap on workers a raylet will spawn (0 = 4 * num_cpus).
     max_workers_per_node: int = 0
+    #: Coalesce concurrent driver-side actor registrations into one
+    #: ``register_actor_batch`` RPC (idempotent, keyed on actor_id).
+    #: Off: one ``register_actor`` round trip per creation.
+    actor_register_batch: bool = True
+    #: Cap on actors per registration-batch RPC frame.
+    actor_register_batch_max: int = 256
+    #: Owner-side lease cache: park an idling leased worker keyed by
+    #: (raylet, resource shape, runtime-env hash) through its idle grace
+    #: so the next compatible scheduling key claims it WITHOUT a raylet
+    #: round trip (parity: reference lease reuse in
+    #: direct_task_transport).  Off: leases stay private to the
+    #: scheduling key that acquired them.
+    lease_cache_enabled: bool = True
+    #: Max workers parked in the owner-side lease cache at once; beyond
+    #: it an idling lease returns to the raylet immediately.
+    lease_cache_size: int = 32
+    #: Background warm-pool rebuild rate (spawns per 0.2 s reap tick,
+    #: per raylet) toward the demand-driven pool target while the lease
+    #: plane is quiet — the next actor wave then lands on warm forks.
+    warm_pool_rebuild_per_tick: int = 4
 
     # ---- fault tolerance -------------------------------------------------
     #: GCS table persistence backend: "" / "file" = session-dir pickle,

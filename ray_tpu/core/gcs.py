@@ -90,6 +90,10 @@ class ActorInfo:
     strategy_soft: bool = False
     env_hash: Optional[str] = None
     env_spawn: Optional[Dict[str, Any]] = None
+    # owner-reported raylet addresses of nodes already holding the
+    # creation args' objects: DEFAULT placement prefers them so the
+    # creation task's arg fetch is a local read, not a transfer
+    locality: Optional[List[Any]] = None
 
 
 @dataclass
@@ -146,6 +150,10 @@ class GcsServer:
         self._health_task: Optional[asyncio.Task] = None
         self._pg_retry_task: Optional[asyncio.Task] = None
         self._actor_creation_locks: Dict[ActorID, asyncio.Lock] = {}
+        # coalesced-registration accounting (debug_state surface; the
+        # batch-size histogram is the metrics-plane view of the same)
+        self._reg_batches = 0
+        self._reg_batch_actors = 0
         # node -> unresolved lease_worker_for_actor calls (burst spread)
         self._actor_lease_inflight: Dict[NodeID, int] = {}
         # actor_id -> NodeID charged above (held until actor_started /
@@ -349,6 +357,8 @@ class GcsServer:
         out["traces_retained"] = self._traces_retained
         out["traces_sampled_out"] = self._traces_sampled_out
         out["traces_evicted"] = self._traces_evicted
+        out["registration_batches"] = self._reg_batches
+        out["registration_batch_actors"] = self._reg_batch_actors
         return out
 
     # -- versioned resource broadcast (parity: ray_syncer.h:27-60 —
@@ -1115,35 +1125,40 @@ class GcsServer:
     # ------------------------------------------------------------------
     # actor manager (GcsActorManager + GcsActorScheduler)
     # ------------------------------------------------------------------
-    async def handle_register_actor(self, conn, data):
-        """Register + schedule an actor creation.
+    def _register_one_actor(self, conn, data
+                            ) -> Tuple[Dict[str, Any],
+                                       Optional[ActorInfo]]:
+        """Table mutation of one actor registration (shared by the
+        single and batched handlers).  Returns ``(reply, info)`` where
+        ``info`` is the freshly-registered actor the caller must
+        schedule, or ``None`` (replayed/existing registration — nothing
+        to schedule).  A name conflict raises ``ValueError``.
 
-        ``data``: actor_id, creation spec blob (pickled TaskSpec),
-        resources, name/namespace/detached, max_restarts, class_name.
+        Idempotent keyed on ``actor_id``: a replayed registration (a
+        retried batch whose first attempt executed but lost its reply)
+        converges on the existing directory entry instead of minting a
+        second creation task.
         """
-        # failpoint: GCS stalls/crashes mid-registration — the owner's
-        # register future must resolve with a typed error or the retry
-        # must converge on ONE directory entry (keyed on actor_id)
-        await _fp.afailpoint("gcs.register_actor.stall")
-        # traced registrations (the payload carried "trace", re-activated
-        # by rpc dispatch) get a gcs.register_actor hop span
-        _hop = _trace.start_span("gcs.register_actor")
         actor_id = ActorID(data["actor_id"])
+        prior = self.actors.get(actor_id)
+        if prior is not None:
+            # replay: re-subscribe the (possibly reconnected) owner and
+            # ack with the existing entry — never re-schedule
+            self.subscribers.setdefault(
+                f"actor:{actor_id.hex()}", set()).add(conn)
+            return ({"existing": False, "actor_id": actor_id.binary(),
+                     "subscribed": True}, None)
         name = data.get("name")
         namespace = data.get("namespace", "default")
         if name is not None:
             key = (namespace, name)
             existing_id = self.named_actors.get(key)
-            if existing_id is not None:
+            if existing_id is not None and existing_id != actor_id:
                 existing = self.actors.get(existing_id)
                 if existing is not None and existing.state != ACTOR_DEAD:
                     if data.get("get_if_exists"):
-                        if _hop is not None:
-                            _hop.end(outcome="existing")
-                        return {"existing": True,
-                                "actor_id": existing_id.binary()}
-                    if _hop is not None:
-                        _hop.end(status="error", outcome="name_conflict")
+                        return ({"existing": True,
+                                 "actor_id": existing_id.binary()}, None)
                     raise ValueError(
                         f"actor name {name!r} already taken in {namespace!r}")
             self.named_actors[key] = actor_id
@@ -1165,6 +1180,7 @@ class GcsServer:
             strategy_soft=bool(data.get("strategy_soft", False)),
             env_hash=data.get("env_hash"),
             env_spawn=data.get("env_spawn"),
+            locality=data.get("locality"),
         )
         self.actors[actor_id] = info
         self._schedule_persist()
@@ -1174,11 +1190,92 @@ class GcsServer:
         # PER ACTOR during creation storms
         self.subscribers.setdefault(
             f"actor:{actor_id.hex()}", set()).add(conn)
-        asyncio.get_running_loop().create_task(self._schedule_actor(info))
+        return ({"existing": False, "actor_id": actor_id.binary(),
+                 "subscribed": True}, info)
+
+    async def handle_register_actor(self, conn, data):
+        """Register + schedule an actor creation.
+
+        ``data``: actor_id, creation spec blob (pickled TaskSpec),
+        resources, name/namespace/detached, max_restarts, class_name.
+        """
+        # failpoint: GCS stalls/crashes mid-registration — the owner's
+        # register future must resolve with a typed error or the retry
+        # must converge on ONE directory entry (keyed on actor_id)
+        await _fp.afailpoint("gcs.register_actor.stall")
+        # traced registrations (the payload carried "trace", re-activated
+        # by rpc dispatch) get a gcs.register_actor hop span
+        _hop = _trace.start_span("gcs.register_actor")
+        try:
+            reply, info = self._register_one_actor(conn, data)
+        except ValueError:
+            if _hop is not None:
+                _hop.end(status="error", outcome="name_conflict")
+            raise
+        if info is not None:
+            self._spawn_schedule_task(info)
         if _hop is not None:
-            _hop.end(actor=actor_id.hex()[:12])
-        return {"existing": False, "actor_id": actor_id.binary(),
-                "subscribed": True}
+            _hop.end(outcome="existing" if reply.get("existing")
+                     else None, actor=ActorID(data["actor_id"]).hex()[:12])
+        return reply
+
+    async def handle_register_actor_batch(self, conn, data):
+        """Coalesced registration: one RPC registers a whole creation
+        burst, then the batch schedules as ONE pipelined bring-up
+        (node selection up front, lease fan-out grouped per raylet)
+        instead of N independent lease round trips.
+
+        Per-entry semantics match ``register_actor`` exactly — name
+        conflicts become per-entry ``{"error": ...}`` replies so one
+        bad entry cannot fail its batch-mates; replayed entries (the
+        idempotent-retry case) ack against the existing directory
+        entry without re-scheduling.
+        """
+        # failpoint: the batch is lost before ANY table mutation — the
+        # owner's idempotent retry (keyed on actor_id) must converge on
+        # exactly one directory entry per actor
+        if _fp.active() and await _fp.afailpoint(
+                "gcs.register_actor_batch.drop"):
+            return None
+        entries = data["actors"]
+        replies: List[Dict[str, Any]] = []
+        to_schedule: List[ActorInfo] = []
+        for entry in entries:
+            # per-entry trace carrier: a traced creation inside a batch
+            # still gets its gcs.register_actor hop span.  The context
+            # is reset after the entry so one traced creation cannot
+            # leak its attribution over batch-mates (or the shared
+            # scheduling task spawned below)
+            _hop = _tok = None
+            if _trace.enabled() and entry.get("trace") is not None:
+                _tok = _trace.set_current(_trace.ctx_of(entry["trace"]))
+                _hop = _trace.start_span("gcs.register_actor")
+            try:
+                try:
+                    reply, info = self._register_one_actor(conn, entry)
+                except ValueError as e:
+                    replies.append({"actor_id": entry["actor_id"],
+                                    "error": str(e)})
+                    if _hop is not None:
+                        _hop.end(status="error", outcome="name_conflict")
+                    continue
+                replies.append(reply)
+                if info is not None:
+                    to_schedule.append(info)
+                if _hop is not None:
+                    _hop.end(outcome="existing" if reply.get("existing")
+                             else None)
+            finally:
+                if _tok is not None:
+                    _trace.reset_current(_tok)
+        _tm.sched_registration_batch(len(entries))
+        self._reg_batches += 1
+        self._reg_batch_actors += len(entries)
+        if to_schedule:
+            t = asyncio.get_running_loop().create_task(
+                self._schedule_actor_batch(to_schedule))
+            t.add_done_callback(lambda t: t.exception())
+        return {"replies": replies}
 
     def _publish_actor(self, info: ActorInfo) -> None:
         # every published transition also reaches the durable table: the
@@ -1249,7 +1346,9 @@ class GcsServer:
                     node = self._pick_node(info.resources,
                                            strategy=info.strategy,
                                            strategy_node=info.strategy_node,
-                                           strategy_soft=info.strategy_soft)
+                                           strategy_soft=info.strategy_soft,
+                                           locality=getattr(
+                                               info, "locality", None))
                     if node is None:
                         await asyncio.sleep(0.2)  # wait for resources/nodes
                         continue
@@ -1280,7 +1379,8 @@ class GcsServer:
                          "env_spawn": info.env_spawn},
                         timeout=60.0,
                     )
-                except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError) as e:
+                except (rpc.ConnectionLost, rpc.RpcError, OSError,
+                        asyncio.TimeoutError) as e:
                     logger.warning("actor lease on %s failed: %s",
                                    node.node_id.hex()[:12], e)
                     self._release_actor_lease_charge(info.actor_id)
@@ -1290,30 +1390,130 @@ class GcsServer:
                     self._release_actor_lease_charge(info.actor_id)
                     await asyncio.sleep(0.1)
                     continue
-                if info.state == ACTOR_DEAD:
-                    # killed while the lease was in flight — don't
-                    # resurrect.  pg-bound workers are reaped by bundle
-                    # revocation; plain actors need an explicit kill or
-                    # the leased worker (and its resources) leak
-                    self._release_actor_lease_charge(info.actor_id)
-                    try:
-                        worker_conn = await self.pool.get(
-                            tuple(reply["worker_task_address"]))
-                        worker_conn.push(
-                            "kill_actor",
-                            {"actor_id": info.actor_id.binary()})
-                    except Exception:
-                        pass
-                    return
-                info.node_id = node.node_id
-                info.address = tuple(reply["worker_task_address"])
-                info.state = ACTOR_ALIVE
-                self._publish_actor(info)
+                await self._settle_actor_grant(info, node, reply)
                 return
             self._release_actor_lease_charge(info.actor_id)
             info.state = ACTOR_DEAD
             info.death_cause = "creation timed out: no feasible node"
             self._publish_actor(info)
+
+    def _spawn_schedule_task(self, info: ActorInfo) -> None:
+        t = asyncio.get_running_loop().create_task(
+            self._schedule_actor(info))
+        t.add_done_callback(lambda t: t.exception())
+
+    async def _schedule_actor_batch(self, infos: List[ActorInfo]) -> None:
+        """Pipelined bring-up of a registration batch: node selection
+        for every actor happens UP FRONT (in-flight lease charges
+        applied as assigned, so the spread logic sees its own batch),
+        then leases + creation pushes fan out as ONE
+        ``lease_workers_for_actors`` RPC per target raylet, all raylets
+        in parallel — instead of one awaited round trip per actor.
+
+        Anything the fast path cannot place (gang-bound, no feasible
+        node yet, mid-batch failures) falls back to the per-actor
+        retry loop ``_schedule_actor``, which owns the 120 s deadline
+        and all the slow-path edge cases.
+        """
+        by_node: Dict[NodeID, List[ActorInfo]] = {}
+        for info in infos:
+            if info.state == ACTOR_DEAD:
+                continue
+            if info.pg_id is not None:
+                # gang-bound: bundle placement has its own wait loop
+                self._spawn_schedule_task(info)
+                continue
+            node = self._pick_node(
+                info.resources, strategy=info.strategy,
+                strategy_node=info.strategy_node,
+                strategy_soft=info.strategy_soft,
+                locality=getattr(info, "locality", None))
+            if node is None:
+                self._spawn_schedule_task(info)  # waits for capacity
+                continue
+            self._charge_actor_lease(info.actor_id, node.node_id)
+            by_node.setdefault(node.node_id, []).append(info)
+        if not by_node:
+            return
+        await asyncio.gather(*(self._lease_actor_group(node_id, group)
+                               for node_id, group in by_node.items()))
+
+    async def _lease_actor_group(self, node_id: NodeID,
+                                 group: List[ActorInfo]) -> None:
+        """One batched lease+create RPC against one raylet; per-actor
+        failures re-enter the single-actor retry loop."""
+        node = self.nodes.get(node_id)
+
+        def _fallback(info: ActorInfo) -> None:
+            self._release_actor_lease_charge(info.actor_id)
+            if info.state != ACTOR_DEAD:
+                self._spawn_schedule_task(info)
+        if node is None or not node.alive:
+            for info in group:
+                _fallback(info)
+            return
+        try:
+            conn = await self.pool.get(node.raylet_address)
+            reply = await conn.call(
+                "lease_workers_for_actors",
+                {"actors": [
+                    {"actor_id": info.actor_id.binary(),
+                     "resources": info.resources,
+                     "spec_blob": info.creation_spec_blob,
+                     "placement_group_id": None,
+                     "bundle_index": -1,
+                     "env_hash": info.env_hash,
+                     "env_spawn": info.env_spawn}
+                    for info in group]},
+                timeout=120.0)
+            results = {bytes(r["actor_id"]): r
+                       for r in (reply or {}).get("results", [])}
+        except (rpc.ConnectionLost, rpc.RpcError, OSError,
+                asyncio.TimeoutError) as e:
+            # OSError included: a raylet that died inside the
+            # heartbeat-lag window refuses the CONNECT itself — the
+            # whole group must fall back, not strand PENDING with its
+            # lease charges leaked
+            logger.warning("batched actor lease on %s failed: %s",
+                           node_id.hex()[:12], e)
+            for info in group:
+                _fallback(info)
+            return
+        for info in group:
+            res = results.get(info.actor_id.binary())
+            if not res or not res.get("granted"):
+                _fallback(info)
+                continue
+            await self._settle_actor_grant(info, node, res)
+
+    async def _settle_actor_grant(self, info: ActorInfo,
+                                  node: "NodeInfo",
+                                  reply: Dict[str, Any]) -> None:
+        """Post-grant settle shared by the single and batched bring-up
+        paths.  Killed while the lease was in flight: don't resurrect
+        — reap the leased worker (pg-bound workers are reaped by
+        bundle revocation; plain actors need the explicit kill or the
+        worker and its resources leak).  Otherwise record placement
+        and publish ALIVE, deduped against the worker's own
+        ``actor_started`` announcement (usually first)."""
+        if info.state == ACTOR_DEAD:
+            self._release_actor_lease_charge(info.actor_id)
+            try:
+                worker_conn = await self.pool.get(
+                    tuple(reply["worker_task_address"]))
+                worker_conn.push(
+                    "kill_actor",
+                    {"actor_id": info.actor_id.binary()})
+            except Exception:
+                pass
+            return
+        addr = tuple(reply["worker_task_address"])
+        info.node_id = node.node_id
+        if info.state == ACTOR_ALIVE and info.address == addr:
+            return  # actor_started already announced this address
+        info.address = addr
+        info.state = ACTOR_ALIVE
+        self._publish_actor(info)
 
     def _charge_actor_lease(self, actor_id: ActorID,
                             node_id: NodeID) -> None:
@@ -1336,7 +1536,9 @@ class GcsServer:
                    required_node: Optional[NodeID] = None,
                    strategy: str = "DEFAULT",
                    strategy_node: Optional[str] = None,
-                   strategy_soft: bool = False) -> Optional[NodeInfo]:
+                   strategy_soft: bool = False,
+                   locality: Optional[List[str]] = None
+                   ) -> Optional[NodeInfo]:
         """Least-loaded feasible node (actors spread by default); load
         counts this GCS's own unresolved actor leases on top of the
         beat-reported queue so creation bursts fan out immediately.
@@ -1346,7 +1548,16 @@ class GcsServer:
         when it is gone/full), SPREAD ranks by live-actor count so
         sequentially created replicas fan across nodes instead of
         piling onto whichever node's beat-reported load looked lowest
-        (equal-load ties broke to the same node every time)."""
+        (equal-load ties broke to the same node every time).
+
+        ``locality``: raylet addresses of nodes already holding the
+        creation args' objects (owner-reported).  A DEFAULT-strategy
+        pick gives them a soft bonus on the load rank — the creation
+        task's arg fetch is then a local arena read instead of a
+        cross-node transfer — but load still wins once the holder
+        accrues charges, so a burst sharing one arg spreads.
+        SPREAD/NODE_AFFINITY ignore the hint: an explicit placement
+        intent beats a data-locality preference."""
         if strategy == "NODE_AFFINITY" and strategy_node and \
                 required_node is None:
             try:
@@ -1373,6 +1584,15 @@ class GcsServer:
             if required_node is not None and strategy_soft:
                 return self._pick_node(resources)
             return None
+        loc: set = set()
+        if locality and strategy == "DEFAULT":
+            # owner-reported raylet addresses of nodes holding the
+            # creation args: a SOFT tie-break bonus on the load rank,
+            # never a hard filter — a whole burst sharing one plasma
+            # arg must still spread once the holder accrues charges
+            # (a hard narrow collapsed fleets onto the arg's node)
+            loc = {tuple(a) for a in locality
+                   if isinstance(a, (list, tuple))}
         if strategy == "SPREAD":
             per_node: Dict[NodeID, int] = {}
             for other in self.actors.values():
@@ -1385,7 +1605,8 @@ class GcsServer:
                 n.load))
         return min(candidates,
                    key=lambda n: n.load + self._actor_lease_inflight.get(
-                       n.node_id, 0))
+                       n.node_id, 0)
+                   - (1 if tuple(n.raylet_address) in loc else 0))
 
     async def handle_actor_started(self, conn, data):
         """The actor worker reports in after executing its creation task."""

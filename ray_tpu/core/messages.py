@@ -108,6 +108,9 @@ register_schema("cancel_lease", token=str)
 register_schema("return_worker", worker_id=bytes)
 register_schema("lease_worker_for_actor", actor_id=bytes, resources=dict,
                 spec_blob=bytes)
+# batched bring-up: one RPC leases workers + pushes creation tasks for a
+# whole group of actors bound for this node (GCS -> raylet fan-out)
+register_schema("lease_workers_for_actors", actors=list)
 
 # task / actor execution
 register_schema("push_task", spec_blob=bytes)
@@ -119,6 +122,10 @@ register_schema("push_actor_tasks", specs_blob=bytes)
 register_schema("register_actor", actor_id=bytes, spec_blob=bytes,
                 resources=dict, job_id=bytes, strategy=Opt(str),
                 strategy_node=Opt(str), strategy_soft=Opt(bool))
+# coalesced registration: ``actors`` is a list of register_actor
+# payloads; idempotent keyed on each entry's actor_id so a retried
+# batch converges on ONE directory entry per actor
+register_schema("register_actor_batch", actors=list)
 register_schema("actor_started", actor_id=bytes, task_address=None)
 register_schema("kill_actor", actor_id=bytes)
 

@@ -200,6 +200,10 @@ class PendingLease:
     #: through grant->instant-return cycles, delaying real demand)
     token: Optional[str] = None
     conn: Optional[rpc.Connection] = None
+    #: True once this lease was evaluated with no idle worker available
+    #: (warm-pool MISS); grants with it still False count as HITS —
+    #: each lease contributes exactly one hit or one miss
+    pool_missed: bool = False
 
 
 class _InflightPull:
@@ -362,6 +366,19 @@ class Raylet:
         # the boot watermark when the storms stop
         self._actor_claims = 0.0
         self._actor_claims_ts = time.monotonic()
+        # decaying PEAK of the pending-lease backlog: demand feeds the
+        # warm-pool target, so a wave that queued behind cold spawns
+        # rebuilds enough warm forks for the NEXT wave of that size
+        self._backlog_demand = 0.0
+        self._backlog_demand_ts = time.monotonic()
+        # actor creation tasks currently executing on this node's
+        # workers: the warm-pool rebuild stays parked while >0 (spawn
+        # storms mid-wave steal the CPU the wave itself needs)
+        self._creating_actors = 0
+        # True while a lease batch enqueues: _maybe_schedule holds off
+        # so the whole wave lands in ONE scheduling pass (per-enqueue
+        # passes over a growing queue were O(n^2) in the batch size)
+        self._sched_suspended = False
         # log monitor state: file path -> (offset, pid)
         self._log_pids: Dict[str, int] = {}
         self._log_offsets: Dict[str, int] = {}
@@ -812,19 +829,23 @@ class Raylet:
             if self._pending_leases and not self._idle \
                     and not self._reclaim_timer_armed:
                 self._maybe_schedule()
-            # claims-driven pool rebuild, only while the lease plane is
+            # demand-driven pool rebuild, only while the lease plane is
             # QUIET (spawn storms during an active wave steal the CPU
-            # the wave itself needs) and gently (<=2 spawns per tick):
-            # the next actor wave then lands on warm forks.  Counted
-            # against PLAIN idle workers — idle env workers can't serve
-            # ordinary leases and must not suppress the rebuild.
+            # the wave itself needs) and rate-limited per tick
+            # (warm_pool_rebuild_per_tick): the next actor wave then
+            # lands on warm forks.  Counted against PLAIN idle workers —
+            # idle env workers can't serve ordinary leases and must not
+            # suppress the rebuild.
             if not self._pending_leases and not self._closing and \
+                    not self._creating_actors and \
                     now - getattr(self, "_last_lease_ts", 0.0) > 1.5:
                 idle_plain = sum(1 for w in self._idle
                                  if w.env_hash is None)
                 deficit = target - idle_plain - self._starting
                 bonus = max(0, target - self._max_workers)
-                for _ in range(min(2, deficit)):
+                per_tick = max(1, int(getattr(
+                    self.config, "warm_pool_rebuild_per_tick", 4)))
+                for _ in range(min(per_tick, deficit)):
                     if not self._start_worker(None, cap_bonus=bonus):
                         break
             await asyncio.sleep(0.2)
@@ -1027,9 +1048,17 @@ class Raylet:
         if getattr(self, "_zygote", None) is None:
             self._zygote = _ZygoteClient(self.session_dir)
         loop = asyncio.get_running_loop()
-        fut = loop.run_in_executor(
-            None, self._zygote.spawn, worker_args,
-            {"RAY_TPU_WORKER": "1"}, log_base)
+        zygote = self._zygote
+
+        def _fork():
+            # failpoint: the zygote fork fails — the raylet must fall
+            # back to a cold spawn and back off the fork path for a
+            # while, never wedge the lease that wanted the worker
+            _fp.failpoint("raylet.zygote.fork_fail")
+            return zygote.spawn(worker_args, {"RAY_TPU_WORKER": "1"},
+                                log_base)
+
+        fut = loop.run_in_executor(None, _fork)
 
         def _done(f):
             try:
@@ -1427,7 +1456,7 @@ class Raylet:
         """Grant queued leases — round-robin across clients, FIFO within
         each — while resources and workers allow; spill queued leases to
         other nodes as the cluster view evolves."""
-        if self._closing:
+        if self._closing or self._sched_suspended:
             return
         remaining: List[PendingLease] = []
         want_workers: List[Tuple[Optional[bytes], bool]] = []
@@ -1468,6 +1497,9 @@ class Raylet:
                                     exact_env_only=lease.env_spawn
                                     is not None)
             if worker is None:
+                if not lease.pool_missed:
+                    lease.pool_missed = True
+                    _tm.sched_warm_pool(False)
                 if lease.env_spawn is not None \
                         and lease.env_hash is not None:
                     # isolated env: the worker must be BORN under the
@@ -1493,6 +1525,8 @@ class Raylet:
                 continue
             self._take(lease.resources, lease.bundle)
             _tm.lease_granted(time.monotonic() - lease.enqueued_at)
+            if not lease.pool_missed:
+                _tm.sched_warm_pool(True)
             worker.leased = True
             worker.lease_resources = lease.resources
             worker.lease_bundle = lease.bundle
@@ -1557,9 +1591,11 @@ class Raylet:
         # background up to the prestart watermark (bounded by the pool
         # cap inside _start_worker) so the NEXT claims hit warm workers
         # (~4x creation rate vs cold boot on the lease critical path).
-        # Skipped while any lease is still waiting: demand-driven spawns
-        # own the remaining pool capacity.
-        if not remaining:
+        # Skipped while any lease is still waiting (demand-driven spawns
+        # own the remaining pool capacity) and while creation tasks are
+        # executing — mid-wave forks steal the CPU the wave needs; the
+        # reap loop's demand-driven rebuild restocks right after.
+        if not remaining and not self._creating_actors:
             refill = getattr(self, "_prestart_watermark", 0) \
                 - len(self._idle) - self._starting
             for _ in range(refill):
@@ -1608,6 +1644,7 @@ class Raylet:
         if grants or not remaining:
             # demand moved: future contention starts its backoff fresh
             self._reclaim_retry_delay = 0.03
+        self._note_backlog_demand(len(remaining))
 
     def _note_actor_claim(self) -> None:
         self._actor_claims = self._decayed_actor_claims() + 1.0
@@ -1620,13 +1657,31 @@ class Raylet:
         dt = time.monotonic() - self._actor_claims_ts
         return self._actor_claims * 0.5 ** (dt / 60.0)
 
+    def _note_backlog_demand(self, n: int) -> None:
+        """Track the decaying PEAK of the pending-lease backlog: the
+        demand signal that feeds the warm-pool target (a wave that
+        queued behind spawns sizes the pool for the next one)."""
+        if n > self._decayed_backlog_demand():
+            self._backlog_demand = float(n)
+            self._backlog_demand_ts = time.monotonic()
+
+    def _decayed_backlog_demand(self) -> float:
+        dt = time.monotonic() - self._backlog_demand_ts
+        return self._backlog_demand * 0.5 ** (dt / 60.0)
+
     def _pool_target(self) -> int:
-        """Idle-pool size to maintain: boot watermark plus the recent
-        actor-claim volume (claimed workers leave the pool for good, so
-        the NEXT wave should land on warm forks, not cold spawns)."""
+        """Idle-pool size to maintain: boot watermark plus DEMAND — the
+        larger of the recent actor-claim volume (claimed workers leave
+        the pool for good) and the recent pending-lease backlog peak
+        (leases that had to wait for spawns), decayed with a 60 s
+        half-life.  ``max`` not sum: an actor wave appears in both
+        signals, and doubling the pool doubles idle-process overhead
+        for nothing.  The NEXT wave of the same size then lands on
+        warm zygote forks with the fork cost off the critical path."""
         watermark = getattr(self, "_prestart_watermark", 0)
-        return watermark + min(int(self._decayed_actor_claims()),
-                               3 * self._max_workers)
+        demand = max(self._decayed_actor_claims(),
+                     self._decayed_backlog_demand())
+        return watermark + min(int(demand), 3 * self._max_workers)
 
     def _cull_idle_spare(self, predicate) -> bool:
         """Evict one idle worker matching ``predicate`` to free pool
@@ -1752,6 +1807,45 @@ class Raylet:
     async def handle_lease_worker_for_actor(self, conn, data):
         """GCS asks this node to host an actor: lease a worker, push the
         creation task to it, reply with its task-server address."""
+        return await self._lease_and_create_actor(conn, data)
+
+    async def handle_lease_workers_for_actors(self, conn, data):
+        """Batched actor bring-up (GCS pipelined fan-out): EVERY lease
+        in the batch enqueues before the first grant resolves — one
+        scheduling pass sees the whole wave, so worker spawns cover the
+        full deficit at once instead of trickling in per actor — then
+        the creation tasks push to their granted workers concurrently.
+        Per-actor results; one actor's failure (no grant, constructor
+        raised, worker died) never blocks its batch-mates."""
+        entries = data["actors"]
+
+        async def one(entry):
+            try:
+                res = await self._lease_and_create_actor(conn, entry)
+            except Exception as e:  # noqa: BLE001 — isolate per actor
+                res = {"granted": False,
+                       "reason": f"{type(e).__name__}: {e}"}
+            res["actor_id"] = entry["actor_id"]
+            return res
+
+        # Enqueue-all-then-schedule-once: every per-actor coroutine runs
+        # to its grant await (appending its PendingLease) while the
+        # scheduler is suspended, then ONE pass grants the whole wave —
+        # per-enqueue passes re-scanned a growing queue (O(n^2) lease
+        # evaluations, each an O(idle-pool) eligibility scan).
+        self._sched_suspended = True
+        try:
+            tasks = [asyncio.ensure_future(one(e)) for e in entries]
+            # one loop yield runs every task to its first real await
+            # (the lease future) — all enqueues land before the pass
+            await asyncio.sleep(0)
+        finally:
+            self._sched_suspended = False
+        self._maybe_schedule()
+        results = await asyncio.gather(*tasks)
+        return {"results": list(results)}
+
+    async def _lease_and_create_actor(self, conn, data):
         resources = dict(data.get("resources", {}))
         # the lease path resolves (and refuses missing) bundles itself, so
         # an unbound fallback to the node pool is impossible by design
@@ -1782,12 +1876,15 @@ class Raylet:
             payload.update(extra)
         except Exception:  # cache is best-effort; workers can self-fetch
             logger.debug("actor blob prefetch failed", exc_info=True)
+        self._creating_actors += 1
         try:
             result = await worker.conn.call(
                 "create_actor", payload, timeout=120.0)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             self._on_worker_dead(worker, f"actor creation failed: {e}")
             return {"granted": False, "reason": str(e)}
+        finally:
+            self._creating_actors -= 1
         if not result.get("ok"):
             # creation raised in user code: actor is dead on arrival
             self._release_lease_resources(worker)
@@ -1966,6 +2063,9 @@ class Raylet:
         out["inflight_pulls"] = len(self._inflight_pulls)
         out["workers"] = len(self.workers)
         out["idle_workers"] = len(self._idle)
+        out["starting_workers"] = self._starting
+        out["warm_pool_target"] = self._pool_target()
+        out["creating_actors"] = self._creating_actors
         out["spilled_objects"] = len(self._spilled)
         try:
             out["store"] = self.store.stats_ex()

@@ -122,6 +122,9 @@ IDEMPOTENT_METHODS = frozenset({
     # keyed / convergent mutations
     "register_node", "register_worker", "subscribe", "unsubscribe",
     "kv_put", "kv_del", "health_report", "actor_started",
+    # keyed on each entry's actor_id: a replayed batch returns the
+    # existing directory entries instead of re-registering
+    "register_actor_batch",
     "object_release", "return_worker", "cancel_lease", "cancel_task",
     # report_spans is deliberately NOT here: its handler appends, so a
     # retry-after-send would duplicate spans (flush loops drop instead)

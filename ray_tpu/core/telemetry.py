@@ -248,6 +248,44 @@ def task_dispatch_latency(seconds: float) -> None:
           _LAT_BOUNDS).observe_key(_EMPTY_KEY, seconds)
 
 
+_BATCH_BOUNDS = [1, 2, 4, 8, 16, 32, 64, 128, 256]
+
+
+def sched_registration_batch(n: int) -> None:
+    """One coalesced actor/PG registration batch landed at the GCS;
+    ``n`` is the actors it carried (1 = no coalescing happened)."""
+    if not enabled():
+        return
+    _hist("ray_tpu_sched_registration_batch_size",
+          "actors per coalesced register_actor_batch RPC at the GCS",
+          _BATCH_BOUNDS).observe_key(_EMPTY_KEY, n)
+
+
+_POOL_KEYS = {True: (("result", "hit"),), False: (("result", "miss"),)}
+
+
+def sched_warm_pool(hit: bool, n: int = 1) -> None:
+    """Raylet-side: a lease was served from the warm idle pool (hit) or
+    had to wait for a fresh worker spawn (miss)."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_sched_warm_pool_total",
+             "worker leases served from the warm pool (hit) vs waiting "
+             "on a spawn (miss)", ("result",)).inc_key(
+        _POOL_KEYS[hit], float(n))
+
+
+def sched_lease_cache(hit: bool, n: int = 1) -> None:
+    """Owner-side: a task claimed a cached compatible lease (hit) or
+    fell through to a raylet lease round trip (miss)."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_sched_lease_cache_total",
+             "owner-side lease-cache claims (hit) vs raylet lease "
+             "round trips (miss)", ("result",)).inc_key(
+        _POOL_KEYS[hit], float(n))
+
+
 # ---------------------------------------------------------------------------
 # GCS plane
 # ---------------------------------------------------------------------------

@@ -299,6 +299,21 @@ class CoreWorker:
         self._lease_states: Dict[Tuple, "_LeaseState"] = {}
         self._actor_states: Dict[ActorID, "_ActorSubmitState"] = {}
         self._lease_tokens = itertools.count(1)
+        # coalesced actor registration: creations buffered on the user
+        # thread, flushed as ONE register_actor_batch RPC per loop
+        # drain (idempotent keyed on actor_id, so the flush can retry
+        # a dropped batch without double-registering)
+        self._actor_reg_lock = threading.Lock()
+        self._actor_reg_buf: List[tuple] = []
+        self._actor_reg_scheduled = False
+        # owner-side lease cache: (raylet, resource shape, env hash) ->
+        # parked idle _LeasedWorkers any compatible scheduling key can
+        # claim without a raylet round trip; total size bounded by
+        # lease_cache_size, entries expire on their idle-grace timer
+        self._lease_cache: Dict[Tuple, List["_LeasedWorker"]] = {}
+        self._lease_cache_n = 0
+        self._lease_cache_hits = 0
+        self._lease_cache_misses = 0
         # head fault tolerance (driver): frozen while the local raylet is
         # unreachable; _reattach_raylet thaws it
         self._raylet_down = False
@@ -666,6 +681,9 @@ class CoreWorker:
             for wid, w in list(state.workers.items()):
                 if w.raylet == old_raylet:
                     del state.workers[wid]
+        # cached leases from the dead raylet are gone; return the rest
+        # (their grants predate the outage — start the thaw clean)
+        self._flush_lease_cache(drop_raylet=old_raylet)
         self._raylet_down = False
         self._raylet_gave_up = False  # a revived head restores service
         logger.info("reattached to raylet %s", raylet_addr)
@@ -1651,12 +1669,24 @@ class CoreWorker:
             if state.backlog and worker.inflight == 0 \
                     and self._worker_accepts(worker, state.backlog[0]):
                 self._dispatch_to_worker(state, worker)
+        # Phase 1.5 — claim compatible leases parked in the owner-side
+        # cache (same resource shape + runtime-env hash, possibly a
+        # DIFFERENT scheduling key) before paying raylet round trips:
+        # alternating functions then multiplex one held lease instead of
+        # churning grant/return cycles through the raylet.
+        while state.backlog:
+            worker = self._claim_cached_lease(state)
+            if worker is None:
+                break
+            self._dispatch_to_worker(state, worker)
         # Phase 2 — grow the fleet while there is queued work (the raylet
         # answers with local grants or spillback to other nodes).  Several
         # lease requests may be outstanding so fan-out ramps quickly.
         want = min(len(state.backlog), 8)
         while state.requesting < want:
             state.requesting += 1
+            self._lease_cache_misses += 1
+            _tm.sched_lease_cache(False)
             task = self._loop.create_task(self._request_lease(state))
             task.add_done_callback(lambda t: t.exception())
         # Phase 3 — pipeline further tasks onto busy workers up to the
@@ -1706,6 +1736,8 @@ class CoreWorker:
                     continue
                 if worker.contended:
                     self._return_lease_now(state, worker)
+                elif self._park_lease(state, worker):
+                    pass  # parked in the shared cache (expiry armed there)
                 elif worker.return_handle is None:
                     worker.return_handle = self._loop.call_later(
                         self.config.idle_worker_lease_timeout_s,
@@ -2047,6 +2079,105 @@ class CoreWorker:
         for state in states.values():
             self._pump_lease_queue(state)
 
+    # -- owner-side lease cache (park/claim/expire) --------------------
+    # A held lease is keyed by (granting raylet, resource shape,
+    # runtime-env hash): any scheduling key with a compatible shape
+    # multiplexes onto it instead of round-tripping the raylet per
+    # task burst (parity: reference direct_task_transport lease reuse,
+    # widened across function ids).  Only plain DEFAULT-strategy,
+    # non-gang keys participate — an explicit placement intent must
+    # keep its raylet round trip.
+
+    @staticmethod
+    def _cacheable_key(key: Tuple) -> bool:
+        # scheduling_key shape: (function_id, resources, strategy kind,
+        # strategy node, pg_id, bundle_index, env_hash)
+        return key[2] == "DEFAULT" and key[4] is None
+
+    def _park_lease(self, state: "_LeaseState",
+                    worker: "_LeasedWorker") -> bool:
+        if not getattr(self.config, "lease_cache_enabled", True):
+            return False
+        key = state.key
+        if not self._cacheable_key(key):
+            return False
+        if self._lease_cache_n >= int(getattr(self.config,
+                                              "lease_cache_size", 32)):
+            return False
+        if state.workers.pop(worker.worker_id, None) is None:
+            return False
+        if worker.return_handle is not None:
+            worker.return_handle.cancel()
+        ckey = (worker.raylet, key[1], key[6])
+        self._lease_cache.setdefault(ckey, []).append(worker)
+        self._lease_cache_n += 1
+        # the idle grace still bounds how long the lease is held: an
+        # unclaimed parked worker flows back to the raylet on expiry
+        worker.return_handle = self._loop.call_later(
+            self.config.idle_worker_lease_timeout_s,
+            lambda w=worker, k=ckey: self._expire_cached_lease(k, w))
+        return True
+
+    def _expire_cached_lease(self, ckey: Tuple,
+                             worker: "_LeasedWorker") -> None:
+        bucket = self._lease_cache.get(ckey)
+        if not bucket or worker not in bucket:
+            return  # claimed (or flushed) before the timer fired
+        bucket.remove(worker)
+        if not bucket:
+            del self._lease_cache[ckey]
+        self._lease_cache_n -= 1
+        worker.return_handle = None
+        task = self._loop.create_task(self._send_return_worker(worker))
+        task.add_done_callback(lambda t: t.exception())
+
+    def _claim_cached_lease(self, state: "_LeaseState"
+                            ) -> Optional["_LeasedWorker"]:
+        if self._lease_cache_n == 0 or not state.backlog:
+            return None
+        key = state.key
+        if not self._cacheable_key(key):
+            return None
+        shape, env_hash = key[1], key[6]
+        spec = state.backlog[0]
+        for ckey in list(self._lease_cache):
+            if ckey[1] != shape or ckey[2] != env_hash:
+                continue
+            bucket = self._lease_cache[ckey]
+            for i, worker in enumerate(bucket):
+                if not self._worker_accepts(worker, spec):
+                    continue  # max_calls budget spent for this function
+                bucket.pop(i)
+                if not bucket:
+                    del self._lease_cache[ckey]
+                self._lease_cache_n -= 1
+                if worker.return_handle is not None:
+                    worker.return_handle.cancel()
+                    worker.return_handle = None
+                state.workers[worker.worker_id] = worker
+                self._lease_cache_hits += 1
+                _tm.sched_lease_cache(True)
+                return worker
+        return None
+
+    def _flush_lease_cache(self, drop_raylet=None) -> None:
+        """Empty the cache: return every parked lease to its raylet
+        (``drop_raylet`` set = that raylet died; just forget its
+        leases, there is nothing to return them to)."""
+        for ckey in list(self._lease_cache):
+            bucket = self._lease_cache.pop(ckey)
+            for worker in bucket:
+                self._lease_cache_n -= 1
+                if worker.return_handle is not None:
+                    worker.return_handle.cancel()
+                    worker.return_handle = None
+                if drop_raylet is not None and \
+                        worker.raylet == drop_raylet:
+                    continue
+                task = self._loop.create_task(
+                    self._send_return_worker(worker))
+                task.add_done_callback(lambda t: t.exception())
+
     async def _return_lease(self, state: "_LeaseState",
                             worker: "_LeasedWorker") -> None:
         if worker.inflight > 0 or state.backlog:
@@ -2096,6 +2227,8 @@ class CoreWorker:
             for worker in list(state.workers.values()):
                 if worker.inflight == 0:
                     self._return_lease_now(state, worker)
+        # parked cache leases are idle by definition: give them back too
+        self._flush_lease_cache()
 
     def _handle_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
         if reply.get("system_error"):
@@ -2302,6 +2435,10 @@ class CoreWorker:
             # trace carrier: the GCS records its registration hop span
             # when the creation belongs to an active trace
             "trace": _trace.ctx_of(spec.trace_context),
+            # nodes already holding the creation args' plasma objects:
+            # the GCS prefers them for DEFAULT placement so the arg
+            # fetch is a local read instead of a transfer
+            "locality": self._arg_locality(spec),
         }
         # pin creation args for the actor's lifetime (restarts re-run the
         # creation task and need them)
@@ -2312,11 +2449,11 @@ class CoreWorker:
             # here, no name conflict is possible, and the reply carries
             # nothing the caller needs — so don't serialize creation
             # bursts on per-actor GCS round trips (measured 12 ms/actor
-            # with a busy GCS).  Method submission awaits the ack in
-            # _resolve_actor_address before querying actor state.
+            # with a busy GCS).  Concurrent creations coalesce into one
+            # register_actor_batch RPC.  Method submission awaits the
+            # ack in _resolve_actor_address before querying actor state.
             state = self._actor_state(actor_id)
-            fut = asyncio.run_coroutine_threadsafe(
-                self.gcs_conn.call("register_actor", payload), self._loop)
+            fut = self._register_actor_queued(payload)
             state.register_fut = fut
 
             def _log_failure(f, state=state):
@@ -2337,14 +2474,20 @@ class CoreWorker:
         # creation can deliver the auto-subscribed ALIVE push to
         # _on_gcs_push while this thread still waits on the reply — with
         # no state entry the address would be dropped and the first
-        # method call would sleep out the push-first grace.
+        # method call would sleep out the push-first grace.  Named
+        # creations ride the same coalescing flush (no added latency:
+        # the flush fires on the next loop drain) so concurrent named
+        # fleets batch too; this thread just blocks on ITS entry.
         state = self._actor_state(actor_id)
         try:
-            reply = self._run(self.gcs_conn.call("register_actor",
-                                                 payload))
+            reply = self._register_actor_queued(payload).result(180.0)
         except Exception:
             self._actor_states.pop(actor_id, None)
             raise
+        if reply.get("error"):
+            # per-entry failure inside a batch (name conflict)
+            self._actor_states.pop(actor_id, None)
+            raise ValueError(reply["error"])
         out_id = ActorID(reply["actor_id"])
         if reply.get("existing"):
             # reusing another registration's actor: our minted id (and
@@ -2353,6 +2496,123 @@ class CoreWorker:
         elif reply.get("subscribed"):
             state.subscribed = True
         return out_id
+
+    def _arg_locality(self, spec: TaskSpec) -> Optional[List[Any]]:
+        """Raylet addresses of nodes holding this spec's plasma ref
+        args (owner knowledge from the object directory) — the
+        locality hint the GCS actor scheduler prefers for DEFAULT
+        placement.  None when every arg is inline/unlocated."""
+        out: Optional[List[Any]] = None
+        for arg in spec.args:
+            oid = arg.object_id
+            if oid is None:
+                continue
+            ref = self.reference_counter.get(oid)
+            if ref is None or not ref.locations:
+                continue
+            if out is None:
+                out = []
+            for addr in ref.locations:
+                addr = list(addr)
+                if addr not in out:
+                    out.append(addr)
+            if len(out) >= 4:  # enough preference signal; bound the wire
+                break
+        return out
+
+    def _register_actor_queued(self, payload: Dict[str, Any]
+                               ) -> "concurrent.futures.Future":
+        """Queue one actor registration for the coalescing flush;
+        returns a future resolving to the actor's per-entry reply."""
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        if not getattr(self.config, "actor_register_batch", True):
+            rfut = asyncio.run_coroutine_threadsafe(
+                self.gcs_conn.call("register_actor", payload), self._loop)
+
+            def _chain(f):
+                if f.cancelled():
+                    fut.cancel()
+                elif f.exception() is not None:
+                    fut.set_exception(f.exception())
+                else:
+                    fut.set_result(f.result())
+            rfut.add_done_callback(_chain)
+            return fut
+        with self._actor_reg_lock:
+            self._actor_reg_buf.append((payload, fut))
+            scheduled = self._actor_reg_scheduled
+            self._actor_reg_scheduled = True
+        if not scheduled:
+            try:
+                self._loop.call_soon_threadsafe(self._spawn_reg_flush)
+            except RuntimeError:
+                # loop torn down: no flush will EVER run — fail the
+                # whole buffer, not just this caller's entry (batch-
+                # mates that skipped scheduling would otherwise hang)
+                with self._actor_reg_lock:
+                    stranded = self._actor_reg_buf
+                    self._actor_reg_buf = []
+                    self._actor_reg_scheduled = False
+                for _, sfut in stranded:
+                    if not sfut.done():
+                        sfut.set_exception(RayTpuError(
+                            "cannot register actor: the runtime is "
+                            "shut down"))
+        return fut
+
+    def _spawn_reg_flush(self) -> None:
+        task = self._loop.create_task(self._flush_actor_registrations())
+        task.add_done_callback(lambda t: t.exception())
+
+    async def _flush_actor_registrations(self) -> None:
+        """Drain the registration buffer as register_actor_batch RPCs.
+
+        Coalescing is purely opportunistic — the flush runs on the next
+        io-loop drain, so a lone creation pays no extra latency while a
+        tight creation loop (whose user thread outruns the loop)
+        batches naturally."""
+        with self._actor_reg_lock:
+            batch = self._actor_reg_buf
+            self._actor_reg_buf = []
+            self._actor_reg_scheduled = False
+        if not batch:
+            return
+        cap = max(1, int(getattr(self.config,
+                                 "actor_register_batch_max", 256)))
+        for i in range(0, len(batch), cap):
+            await self._send_actor_reg_batch(batch[i:i + cap])
+
+    async def _send_actor_reg_batch(self, batch: List[tuple]) -> None:
+        payloads = [p for p, _ in batch]
+        reply = None
+        err: Optional[BaseException] = None
+        for attempt in range(4):
+            if attempt:
+                # idempotent replay (GCS keys on actor_id): a dropped
+                # or failed batch re-sends whole and converges on one
+                # directory entry per actor
+                await asyncio.sleep(0.05 * 2 ** (attempt - 1))
+            try:
+                reply = await self.gcs_conn.call(
+                    "register_actor_batch", {"actors": payloads},
+                    timeout=60.0)
+                err = None
+            except (rpc.ConnectionLost, rpc.RpcError, OSError,
+                    asyncio.TimeoutError) as e:
+                err = e
+                reply = None
+            if isinstance(reply, dict) and "replies" in reply:
+                break
+        if not isinstance(reply, dict) or "replies" not in reply:
+            exc = err if err is not None else RayTpuError(
+                "register_actor_batch returned no replies")
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(exc)
+            return
+        for (_, fut), r in zip(batch, reply["replies"]):
+            if not fut.done():
+                fut.set_result(r)
 
     def _actor_state(self, actor_id: ActorID) -> "_ActorSubmitState":
         state = self._actor_states.get(actor_id)

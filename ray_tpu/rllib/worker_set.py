@@ -30,6 +30,9 @@ class WorkerSet:
                                           worker_index=0)
         self._remote_cls = ray_tpu.remote(RolloutWorker).options(
             num_cpus=float(config.get("num_cpus_per_worker", 1)))
+        # every creation issues up front without awaiting readiness:
+        # the whole fleet registers as one coalesced batch and brings
+        # up as one pipelined lease wave on the control plane
         self.remote_workers: List[Any] = []
         for i in range(int(config.get("num_rollout_workers", 0))):
             self.remote_workers.append(self._make_remote(i + 1))
@@ -66,12 +69,31 @@ class WorkerSet:
 
     def probe_and_recreate(self) -> int:
         """Replace dead remote workers (reference
-        ``WorkerSet.probe_unhealthy_workers``); returns replacements."""
+        ``WorkerSet.probe_unhealthy_workers``); returns replacements.
+
+        All probes fan out concurrently and resolve under ONE bounded
+        wait (was a serial 30 s-timeout get per worker, so a mostly-dead
+        fleet cost minutes); replacements are issued together so they
+        ride the batched registration path."""
+        if not self.remote_workers:
+            return 0
+        probes = [w.metrics.remote() for w in self.remote_workers]
+        try:
+            ready, _ = ray_tpu.wait(probes, num_returns=len(probes),
+                                    timeout=30)
+            ready_set = set(ready)
+        except Exception:  # noqa: BLE001 — treat as all-dead below
+            ready_set = set()
         replaced = 0
-        for i, w in enumerate(self.remote_workers):
-            try:
-                ray_tpu.get(w.metrics.remote(), timeout=30)
-            except Exception:
+        for i, ref in enumerate(probes):
+            ok = False
+            if ref in ready_set:
+                try:
+                    ray_tpu.get(ref, timeout=5)
+                    ok = True
+                except Exception:  # noqa: BLE001 — dead worker
+                    pass
+            if not ok:
                 self.remote_workers[i] = self._make_remote(i + 1)
                 replaced += 1
         if replaced:

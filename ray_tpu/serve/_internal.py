@@ -472,13 +472,17 @@ class ServeController:
                     self._drain(old, config)
                     changed = True
                     continue
-            while len(replicas) < target:
-                new = self._start_replica(name, dep, config)
-                if new is None:
-                    break
-                replicas.append(new)
-                versions.append(config.version)
-                changed = True
+            deficit = target - len(replicas)
+            if deficit > 0:
+                # fleet scale-up: ALL creations issue before any
+                # readiness wait, so the burst rides the control
+                # plane's batched registration + pipelined bring-up
+                # instead of serializing replica-by-replica
+                for new in self._start_replicas(name, dep, config,
+                                                deficit):
+                    replicas.append(new)
+                    versions.append(config.version)
+                    changed = True
             while len(replicas) > target:
                 old = replicas.pop()
                 versions.pop()
@@ -634,6 +638,12 @@ class ServeController:
 
     def _start_replica(self, name: str, dep: Dict[str, Any],
                        config: DeploymentConfig) -> Optional[Any]:
+        out = self._start_replicas(name, dep, config, 1)
+        return out[0] if out else None
+
+    def _create_replica(self, name: str, dep: Dict[str, Any],
+                        config: DeploymentConfig) -> Optional[Any]:
+        """Issue one replica creation WITHOUT waiting for readiness."""
         try:
             opts = dict(config.ray_actor_options or {})
             init_args, init_kwargs = dep["init"]
@@ -641,17 +651,65 @@ class ServeController:
             # own concurrency group so a saturated handle_request pool
             # cannot starve them (reference: replicas use a dedicated
             # control concurrency group — actor.py:65-83)
-            replica = ServeReplica.options(
+            return ServeReplica.options(
                 max_concurrency=max(4, config.max_concurrent_queries),
                 concurrency_groups={"control": 2},
                 **opts).remote(dep["blob"], init_args, init_kwargs,
                                config.user_config,
                                deployment_name=name,
                                batching=getattr(config, "batching", None))
-            ray_tpu.get(replica.ready.remote(), timeout=120)
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to start replica")
+            return None
+
+    def _start_replicas(self, name: str, dep: Dict[str, Any],
+                        config: DeploymentConfig, n: int) -> List[Any]:
+        """Start ``n`` replicas CONCURRENTLY: every creation is issued
+        up front (one coalesced registration batch + one pipelined
+        bring-up wave on the control plane), then readiness resolves
+        under a single bounded wait — was one blocking 120 s
+        ready-probe per replica, which made an N-replica scale-up N
+        serial actor creations end to end."""
+        started: List[Any] = []
+        for _ in range(max(0, n)):
+            replica = self._create_replica(name, dep, config)
+            if replica is None:
+                break
+            started.append(replica)
+        if not started:
+            return []
+        refs = [r.ready.remote() for r in started]
+        try:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=120.0)
+            ready_set = set(ready)
+        except Exception:  # noqa: BLE001 — fall back to per-replica
+            # probes below: a transient owner-side wait error must not
+            # read as "none ready" and kill already-healthy replicas
+            logger.exception("batched readiness wait failed; probing "
+                             "replicas individually")
+            ready_set = None
+        out: List[Any] = []
+        node_probes: List[Any] = []
+        for replica, ref in zip(started, refs):
+            ok = False
+            if ready_set is None or ref in ready_set:
+                try:
+                    ray_tpu.get(ref, timeout=30.0 if ready_set is None
+                                else 1.0)
+                    ok = True
+                except Exception:  # noqa: BLE001
+                    logger.exception("replica failed to become ready")
+            if not ok:
+                try:
+                    ray_tpu.kill(replica)
+                except Exception:  # noqa: BLE001
+                    pass
+                continue
             try:
-                self._replica_nodes[replica.actor_id.binary()] = \
-                    ray_tpu.get(replica.node_id.remote(), timeout=10)
+                node_probes.append(
+                    (replica.actor_id.binary(),
+                     replica.node_id.remote()))
             except Exception:  # noqa: BLE001 — locality is best-effort
                 pass
             # seed the metrics cache so a fresh replica isn't treated
@@ -659,10 +717,22 @@ class ServeController:
             self._replica_metrics.setdefault(
                 replica.actor_id.binary(),
                 {"inflight": 0, "queue_depth": 0, "total": 0})
-            return replica
-        except Exception:  # noqa: BLE001
-            logger.exception("failed to start replica")
-            return None
+            out.append(replica)
+        if node_probes:
+            # one bounded wait for ALL locality probes (best-effort)
+            probe_refs = [ref for _, ref in node_probes]
+            try:
+                ready, _ = ray_tpu.wait(probe_refs,
+                                        num_returns=len(probe_refs),
+                                        timeout=10.0)
+                ready_set = set(ready)
+                for key, ref in node_probes:
+                    if ref in ready_set:
+                        self._replica_nodes[key] = \
+                            ray_tpu.get(ref, timeout=1.0)
+            except Exception:  # noqa: BLE001 — locality is best-effort
+                pass
+        return out
 
 
 class Router:

@@ -39,6 +39,11 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_transfer_throughput_mbps",
     "ray_tpu_rpc_retries_total",
     "ray_tpu_rpc_deadline_exceeded_total",
+    # control-plane scheduler series: need actor/lease traffic (a quiet
+    # boot never registers a batch, grants a lease, or parks one)
+    "ray_tpu_sched_registration_batch_size",
+    "ray_tpu_sched_warm_pool_total",
+    "ray_tpu_sched_lease_cache_total",
     "ray_tpu_gcs_heartbeat_misses_total",
     "ray_tpu_gcs_node_deaths_total",
     "ray_tpu_task_events_dropped_total",
