@@ -7,7 +7,8 @@
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
 .PHONY: all native check test chaos bench bench-transfer bench-serve \
-	bench-rl bench-controlplane metrics-smoke tsan asan sanitize clean
+	bench-rl bench-controlplane bench-store metrics-smoke tsan asan \
+	sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -39,7 +40,7 @@ chaos: native
 	  tests/test_failpoints.py tests/test_chaos.py \
 	  tests/test_object_transfer.py tests/test_serve_batching.py \
 	  tests/test_tracing.py tests/test_rllib_pipeline.py \
-	  tests/test_controlplane_scale.py \
+	  tests/test_controlplane_scale.py tests/test_store_scale.py \
 	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 
@@ -72,6 +73,12 @@ bench-rl: native
 # vs 4; one-line JSON delta vs the newest BENCH_r*.json rows.
 bench-controlplane: native
 	JAX_PLATFORMS=cpu python scripts/bench_controlplane.py
+
+# Object-store microbench: 1/2/4/8-writer put-bandwidth sweep on the
+# sharded arena plus a larger-than-arena put/get round through the
+# spill tier; one-line JSON delta vs the newest BENCH_r*.json rows.
+bench-store: native
+	JAX_PLATFORMS=cpu python scripts/bench_store.py
 
 # Boot a mini-cluster, scrape dashboard /metrics, and diff the exported
 # ray_tpu_* series list against scripts/metrics_golden.txt (catches

@@ -470,6 +470,17 @@ def bench_runtime_tasks(budget_s: float = 60.0) -> dict:
             mc_gbps.append(4 * 2 * gbits / (time.perf_counter() - t0))
         out["put_gbps_multi_client"] = statistics.median(mc_gbps)
 
+        # writer-count sweep: aggregate put bandwidth as concurrent
+        # writers grow — THE curve the sharded store metadata exists
+        # for (a single metadata mutex makes it anti-scale; striped
+        # shards should hold aggregate bandwidth roughly flat)
+        putters += [Putter.remote(64) for _ in range(4)]
+        ray_tpu.get([p.put_big.remote(1) for p in putters[4:]],
+                    timeout=120)
+        settle(3.0)
+        out["put_gbps_by_writers"] = put_writer_sweep(
+            putters, gbits, reps=2, settle=settle)
+
         # -- placement groups -----------------------------------------
         settle()
         from ray_tpu.util.placement_group import (placement_group,
@@ -929,6 +940,84 @@ def bench_trace_overhead() -> dict:
     return out
 
 
+def put_writer_sweep(putters, gbits: float, reps: int, settle) -> dict:
+    """Aggregate put bandwidth at 1/2/4/8 concurrent writers: each
+    point is a median of ``reps`` timed rounds of 2 puts per writer.
+    Shared by the full harness and scripts/bench_store.py so the
+    ``put_gbps_by_writers`` row means the same thing from both."""
+    import ray_tpu
+
+    sweep = {}
+    for n in (1, 2, 4, 8):
+        samples = []
+        for i in range(reps):
+            if i:
+                settle(1.5)
+            t0 = time.perf_counter()
+            ray_tpu.get([p.put_big.remote(2) for p in putters[:n]],
+                        timeout=600)
+            samples.append(n * 2 * gbits / (time.perf_counter() - t0))
+        sweep[str(n)] = round(statistics.median(samples), 2)
+        settle(1.5)
+    return sweep
+
+
+def bench_store_spill() -> dict:
+    """Larger-than-arena put/get round: a working set ~2x the object
+    store rotates through the raylet's spill tier and restores
+    transparently on get — correctness (checksums) plus round-trip
+    bandwidth.  Runs on its own mini cluster so the deliberately tiny
+    arena can't bleed into other sections."""
+    import numpy as np
+
+    import ray_tpu
+
+    out: dict = {}
+    arena = 256 * 1024 * 1024
+    chunk = 32 * 1024 * 1024
+    n_objects = 16  # 512 MiB working set vs the 256 MiB arena
+    ray_tpu.init(_system_config={
+        "object_store_memory": arena,
+        "object_spill_threshold": 0.8,
+        "num_prestart_workers": 1,
+    })
+    try:
+        rng = np.random.default_rng(7)
+        payload = rng.integers(0, 255, chunk, dtype=np.uint8)
+        sums, refs = [], []
+        t0 = time.perf_counter()
+        for i in range(n_objects):
+            payload[:8] = i  # distinct objects, one allocation
+            refs.append(ray_tpu.put(payload))
+            sums.append(int(payload.sum()))
+        put_s = time.perf_counter() - t0
+        from ray_tpu.experimental.state import object_store_stats
+        try:
+            stats = object_store_stats()[0]
+        except Exception:  # noqa: BLE001 — accounting row is optional
+            stats = {}
+        t0 = time.perf_counter()
+        for i, ref in enumerate(refs):
+            got = ray_tpu.get(ref, timeout=120)
+            assert int(np.asarray(got).sum()) == sums[i], \
+                f"spill roundtrip corrupted object {i}"
+            del got
+        get_s = time.perf_counter() - t0
+        total_gbits = n_objects * chunk * 8 / 1e9
+        out["spill_put_gbps"] = round(total_gbits / put_s, 2)
+        out["spill_get_gbps"] = round(total_gbits / get_s, 2)
+        out["spill_roundtrip_gbps"] = round(
+            2 * total_gbits / (put_s + get_s), 2)
+        if isinstance(stats, dict) and stats.get("num_spilled"):
+            out["spill_objects_peak"] = stats["num_spilled"]
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
 #: every BASELINE.md row this harness measures -> the reference number
 #: (all rows get a ``vs_ref_<row>`` ratio so LOSING rows are visible in
 #: the artifact itself, not only by cross-reading BASELINE.md)
@@ -1020,6 +1109,7 @@ SUMMARY_KEYS = (
     "n_n_actor_calls_per_sec_async",
     "put_small_per_sec", "get_small_per_sec",
     "put_gbps_single_client", "put_gbps_multi_client",
+    "put_gbps_by_writers", "spill_roundtrip_gbps",
     "pg_create_remove_per_sec",
     "many_tasks_per_sec_4node", "many_actors_per_sec_4node",
     "many_pgs_per_sec_4node", "broadcast_256mb_4node_s",
@@ -1035,7 +1125,7 @@ SUMMARY_KEYS = (
     # bench otherwise looks like a sparse-but-clean run
     "long_context_error", "long_context_128k_error",
     "runtime_bench_error", "cluster_scale_error",
-    "rllib_bench_error", "controlplane_error",
+    "rllib_bench_error", "controlplane_error", "store_bench_error",
 )
 
 
@@ -1064,6 +1154,19 @@ def main() -> None:
                                     if a != "--controlplane"]
         bench_controlplane.main()
         return
+    if "--store" in sys.argv[1:]:
+        # object-store microbench (writer-count put sweep + the
+        # larger-than-arena spill/restore round) with a one-line JSON
+        # delta vs the newest BENCH_r*.json — same entry
+        # `make bench-store` uses
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_store
+
+        sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
+                                    if a != "--store"]
+        bench_store.main()
+        return
     if "--transfer" in sys.argv[1:]:
         # reduced transfer-plane microbench (broadcast + multi-client
         # put) with a one-line JSON delta vs the newest BENCH_r*.json —
@@ -1084,6 +1187,10 @@ def main() -> None:
         details["long_context_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("RAY_TPU_BENCH_RUNTIME", "1") != "0":
         details.update(bench_runtime_tasks())
+        try:
+            details.update(bench_store_spill())
+        except Exception as e:  # noqa: BLE001 — spill row must not
+            details["store_bench_error"] = f"{type(e).__name__}: {e}"
         details.update(bench_cluster_scale())
         details.update(bench_controlplane())
         details.update(bench_rllib_ppo())

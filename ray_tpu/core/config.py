@@ -70,7 +70,23 @@ class Config:
     #: restores on any node.  "" = local-directory spill only.
     object_spilling_uri: str = ""
     #: Start spilling primary copies when the store is this full.
+    #: Deprecated alias of ``object_spill_threshold`` (kept for older
+    #: configs; the new name wins when both are set).
     object_spilling_threshold: float = 0.8
+    #: Canonical spill-pressure knob: arena-used fraction above which
+    #: the raylet spills cold sealed primaries to the disk tier
+    #: (LRU by last pin; pinned/unsealed copies never spill).
+    #: < 0 = inherit ``object_spilling_threshold``.
+    object_spill_threshold: float = -1.0
+    #: Cap on bytes resident in the local spill tier (0 = unbounded).
+    #: At the cap the raylet stops spilling; creates then fail with
+    #: ObjectStoreFullError once eviction is also exhausted.
+    object_spill_max_bytes: int = 0
+    #: Metadata lock-stripe shards in the native store (0 = library
+    #: default, 16).  More shards = less create/seal/get contention
+    #: between concurrent writers, at a small cross-shard sweep cost
+    #: for stats/eviction scans.
+    store_metadata_shards: int = 16
 
     # ---- scheduling ------------------------------------------------------
     #: Hybrid policy: pack onto the local/first node until its utilization

@@ -206,7 +206,8 @@ register_schema("return_bundle", pg_id=bytes, bundle_index=int)
 # object plane: owner-side directory / recovery / borrow tracking
 register_schema("reconstruct_object", object_id=bytes)
 register_schema("get_object_locations", object_id=bytes)
-register_schema("object_spilled", object_id=bytes, uri=str)
+register_schema("object_spilled", object_id=bytes, uri=Opt(str),
+                node=Opt(list))
 register_schema("object_contains", object_id=bytes)
 register_schema("add_borrow", object_id=bytes, borrower=None)
 register_schema("remove_borrow", object_id=bytes, borrower=None)

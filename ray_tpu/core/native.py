@@ -94,6 +94,17 @@ def load() -> ctypes.CDLL:
             lib.rtpu_store_bucket_used.restype = u64
             lib.rtpu_store_bucket_used.argtypes = [ctypes.c_void_p, p_u64,
                                                    u64]
+            lib.rtpu_store_shard_contention.restype = u64
+            lib.rtpu_store_shard_contention.argtypes = [ctypes.c_void_p,
+                                                        p_u64, u64]
+            lib.rtpu_store_spill_candidates.restype = u64
+            lib.rtpu_store_spill_candidates.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, p_u64, u64, u64]
+            lib.rtpu_store_create_sharded.restype = ctypes.c_void_p
+            lib.rtpu_store_create_sharded.argtypes = [ctypes.c_char_p,
+                                                      u64, u64]
+            lib.rtpu_store_used.restype = u64
+            lib.rtpu_store_used.argtypes = [ctypes.c_void_p]
         except AttributeError:
             pass
 

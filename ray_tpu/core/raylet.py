@@ -53,6 +53,17 @@ logger = logging.getLogger(__name__)
 _probe_rng = random.Random(0x52545055)
 
 
+def _spill_write_failpoint() -> None:
+    """Shared chaos site for BOTH spill-tier writers (file and URI):
+    the blob write dies mid-flight."""
+    _fp.failpoint("raylet.spill.write_fail")
+
+
+def _restore_read_failpoint() -> None:
+    """Shared chaos site for BOTH spill-tier readers (file and URI)."""
+    _fp.failpoint("raylet.restore.read_fail")
+
+
 @dataclass
 class WorkerHandle:
     worker_id: WorkerID
@@ -297,11 +308,26 @@ class Raylet:
             "/dev/shm" if os.path.isdir("/dev/shm") else session_dir,
             f"rtpu_store_{self.node_id.hex()[:12]}",
         )
-        self.store = SharedMemoryStore(store_path, store_capacity)
+        self.store = SharedMemoryStore(
+            store_path, store_capacity,
+            shards=getattr(config, "store_metadata_shards", 0))
         self.store_capacity = store_capacity
         self._primary: Set[ObjectID] = set()  # pinned primaries
         self._owner_of: Dict[ObjectID, tuple] = {}  # id -> owner address tuple
-        self._spilled: Dict[ObjectID, str] = {}  # id -> file path
+        self._spilled: Dict[ObjectID, str] = {}  # id -> file path / uri
+        self._spilled_sizes: Dict[ObjectID, int] = {}  # id -> payload bytes
+        self._spill_bytes = 0  # bytes resident in the spill tier
+        self._spill_lock: Optional[asyncio.Lock] = None  # one sweep at a time
+        # restores whose blob read / arena write is in flight:
+        # id -> [active restore count, freed-mid-restore flag].
+        # handle_object_free must NOT store.delete these (the unsealed
+        # pin-0 entry would free instantly and the executor thread's
+        # write would scribble over whatever re-allocates the block);
+        # it sets the flag and the LAST restore's guard-exit deletes.
+        # Refcounted, not a bare flag: concurrent restores of one oid
+        # are reachable (pull_start's URI path races _make_local), and
+        # a second restore's exit must not strip the first's guard.
+        self._restoring: Dict[ObjectID, list] = {}
         self._spill_dir = config.object_spilling_directory or os.path.join(
             session_dir, "spill")
         os.makedirs(self._spill_dir, exist_ok=True)
@@ -1200,6 +1226,13 @@ class Raylet:
             except Exception:  # noqa: BLE001 — store may be closing
                 pass
         conn.context.pop("pull_offsets", None)
+        # close spill-file serves a dead puller left open (the fd pins
+        # the blob's inode against owner-free unlinks)
+        for fd, _size in conn.context.pop("spill_serves", {}).values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
         worker_id = conn.context.get("worker_id")
         if worker_id is not None:
             w = self.workers.get(worker_id)
@@ -1987,6 +2020,15 @@ class Raylet:
             _tm.set_gauge("ray_tpu_arena_bucket_free_bytes",
                           "free bytes parked in per-client slab buckets",
                           stats.get("bucket_free_bytes", 0), tags)
+        if "shard_contention" in stats:
+            _tm.set_gauge("ray_tpu_store_shard_contention_total",
+                          "cumulative contended metadata-shard lock "
+                          "acquisitions (striping health: near-zero "
+                          "means writers aren't colliding)",
+                          stats.get("shard_contention", 0), tags)
+        _tm.set_gauge("ray_tpu_store_spill_objects",
+                      "objects resident in the spill tier",
+                      len(self._spilled), tags)
 
     async def _metrics_flush_loop(self) -> None:
         """Batch registry deltas + spans to the GCS metrics/span tables
@@ -2067,6 +2109,7 @@ class Raylet:
         out["warm_pool_target"] = self._pool_target()
         out["creating_actors"] = self._creating_actors
         out["spilled_objects"] = len(self._spilled)
+        out["spill_bytes"] = self._spill_bytes
         try:
             out["store"] = self.store.stats_ex()
             out["store"]["bucket_occupancy"] = \
@@ -2199,7 +2242,7 @@ class Raylet:
                 (id(conn) >> 4) % 63 + 1  # 0 is the raylet's own bucket
         deadline = time.monotonic() + 30.0
         while True:
-            self._maybe_spill(size)
+            await self._maybe_spill(size)
             try:
                 offset, _ = self.store.alloc(object_id, size, hint)
                 return {"offset": offset, "size": size}
@@ -2266,7 +2309,10 @@ class Raylet:
         if self.store.contains(oid):
             return True
         if oid in self._spilled:
-            return self._restore_from_spill(oid)
+            if await self._restore_from_spill(oid):
+                return True
+            # unreadable/failed local restore: fall through to the
+            # owner's directory — other copies or a URI blob may exist
         if owner is None:
             owner = self._owner_of.get(oid)
         if owner is None:
@@ -2295,12 +2341,12 @@ class Raylet:
             if locs.get("spilled_uri"):
                 # external tier: restore directly, no matter which
                 # node spilled it (it may be dead — that's the point)
-                if self._restore_from_uri(oid, locs["spilled_uri"]):
+                if await self._restore_from_uri(oid, locs["spilled_uri"]):
                     return True
             if locs.get("spilled_on"):
                 node_addr = tuple(locs["spilled_on"])
                 if node_addr == my_addr:
-                    return self._restore_from_spill(oid)
+                    return await self._restore_from_spill(oid)
                 if await self._pull_object(oid, [node_addr], [],
                                            owner_conn):
                     return True
@@ -2420,7 +2466,7 @@ class Raylet:
                 pass
 
         try:
-            self._maybe_spill(size)
+            await self._maybe_spill(size)
             offset, view = self.store.alloc(oid, size)
         except ValueError:
             # concurrently produced on this node (e.g. a local worker
@@ -2677,7 +2723,26 @@ class Raylet:
         oid = ObjectID(data["object_id"])
         lease = self.store.lease(oid)
         if lease is None:
-            if oid in self._spilled and self._restore_from_spill(oid):
+            target = self._spilled.get(oid)
+            if target is not None and "://" not in target:
+                # local spill file: serve the chunk stream STRAIGHT
+                # from the blob — no arena allocation, no restore (a
+                # restore under pressure would evict/spill warm
+                # objects just to feed a remote reader).  The open fd
+                # guards the blob: an owner free may unlink the path
+                # mid-transfer, the inode survives until pull_end.
+                try:
+                    fd = os.open(target, os.O_RDONLY)
+                except OSError:
+                    return None
+                size = self._spilled_sizes.get(oid) or os.fstat(fd).st_size
+                serves = conn.context.setdefault("spill_serves", {})
+                stale = serves.pop(oid, None)
+                if stale is not None:  # duplicate start on this link
+                    os.close(stale[0])
+                serves[oid] = (fd, size)
+                return {"size": size, "spilled": True}
+            if target is not None and await self._restore_from_spill(oid):
                 lease = self.store.lease(oid)
         if lease is not None:
             leases = conn.context.setdefault("pull_leases", set())
@@ -2715,6 +2780,16 @@ class Raylet:
             await _fp.afailpoint("raylet.pull_chunk.serve")
         if start < 0 or n <= 0:
             return None
+        spill_serve = (conn.context.get("spill_serves") or {}).get(oid)
+        if spill_serve is not None:
+            fd, size = spill_serve
+            if start + n > size:
+                return None
+            # positioned read in the executor: a cold 5 MiB disk read
+            # must not stall every other RPC this raylet serves
+            payload = await asyncio.get_running_loop().run_in_executor(
+                None, os.pread, fd, n, start)
+            return payload if len(payload) == n else None
         entry = (conn.context.get("pull_offsets") or {}).get(oid)
         if entry is not None:
             offset, size = entry
@@ -2760,6 +2835,9 @@ class Raylet:
             leases.discard(oid)
             (conn.context.get("pull_offsets") or {}).pop(oid, None)
             self.store.release(oid)
+        serve = (conn.context.get("spill_serves") or {}).pop(oid, None)
+        if serve is not None:
+            os.close(serve[0])
         return True
 
     async def handle_object_release(self, conn, data):
@@ -2789,15 +2867,22 @@ class Raylet:
                 self.store.release(oid)
             target = self._spilled.pop(oid, None)
             if target:
-                try:
-                    if "://" in target:
-                        from ray_tpu.air import storage as air_storage
-                        air_storage.delete(target)
-                    else:
-                        os.unlink(target)
-                except Exception:  # noqa: BLE001 — best-effort cleanup
-                    pass
-            self.store.delete(oid)
+                self._spill_bytes -= self._spilled_sizes.pop(oid, 0)
+                # executor-side: a URI-tier delete is a network call
+                # that must not stall this event loop (local unlinks
+                # ride along for uniformity)
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._delete_spill_blob, target)
+            entry = self._restoring.get(oid)
+            if entry is not None:
+                # an executor thread is writing this object's arena
+                # block right now: deleting would free the unsealed
+                # pin-0 entry instantly and the write would scribble
+                # over whatever re-allocates it — flag the restores to
+                # complete the delete on the last guard-exit
+                entry[1] = True
+            else:
+                self.store.delete(oid)
             self._owner_of.pop(oid, None)
         return True
 
@@ -2815,116 +2900,329 @@ class Raylet:
             stats = self.store.stats()
         stats["num_primary"] = len(self._primary)
         stats["num_spilled"] = len(self._spilled)
+        stats["spill_bytes"] = self._spill_bytes
         return stats
 
     # ------------------------------------------------------------------
     # spilling (LocalObjectManager)
     # ------------------------------------------------------------------
-    def _maybe_spill(self, incoming: int) -> None:
-        # KNOWN LIMITATION (round-5 review): spill I/O runs on this
-        # event loop.  Bounded for the local-disk tier, but a SLOW
-        # object_spilling_uri backend (NFS, remote stores) can stall
-        # heartbeats/leases for the write's duration — operators should
-        # size the URI tier's latency accordingly.  Moving the write to
-        # a thread needs seal/evict bookkeeping to become two-phase;
-        # deferred rather than rushed (see docs/ROUND5.md).
-        stats = self.store.stats()
-        threshold = self.config.object_spilling_threshold * stats["capacity"]
-        if stats["used"] + incoming <= threshold:
+    async def _maybe_spill(self, incoming: int) -> None:
+        """Spill cold sealed primaries to the disk tier under arena
+        pressure.
+
+        Selection is LRU by LAST PIN from the native store's spill
+        queue (``spill_candidates`` with max_pins=1: the raylet's own
+        primary pin — a client-pinned or unsealed object can never be
+        picked).  Blob writes run in the executor with the object's
+        lease held and commit via rename, so a write that dies
+        mid-flight never leaves a half file claiming to be a valid
+        blob and the in-store copy survives every failure mode.  One
+        sweep runs at a time; concurrent creates ride their own retry
+        loop while it makes room."""
+        cfg = self.config
+        frac = getattr(cfg, "object_spill_threshold", -1.0)
+        if frac is None or frac < 0:
+            frac = cfg.object_spilling_threshold
+        threshold = frac * self.store_capacity
+        # lock-free pressure probe: this runs on EVERY create/pull
+        # allocation — stats() would sweep all shard mutexes (and
+        # inflate the contention counters) just to count objects
+        if self.store.used() + incoming <= threshold:
             return
-        need = stats["used"] + incoming - int(threshold)
-        # spill pinned primaries LRU-first; unpinned copies just evict
-        spill_uri = self.config.object_spilling_uri
+        if self._spill_lock is None:
+            self._spill_lock = asyncio.Lock()
+        async with self._spill_lock:
+            used = self.store.used()
+            if used + incoming <= threshold:
+                return  # the sweep we waited on already made room
+            await self._spill_sweep(used + incoming - int(threshold))
+
+    async def _spill_sweep(self, need: int) -> None:
+        cfg = self.config
+        spill_uri = cfg.object_spilling_uri
+        max_bytes = getattr(cfg, "object_spill_max_bytes", 0)
+        loop = asyncio.get_running_loop()
         spilled = 0
-        for oid in list(self._primary):
+        candidates = self.store.spill_candidates(max_ids=256, max_pins=1)
+        if candidates is None:
+            # stale .so without the spill queue: fall back to the old
+            # behavior — primaries in table order, sizes learned from
+            # the lease below (0 here skips only the pre-lease cap
+            # check; the post-lease one still applies)
+            candidates = [(o, 0) for o in list(self._primary)]
+        # owners whose commit RPC failed THIS sweep: skip their other
+        # objects instead of burning a timeout each — the sweep runs
+        # under _spill_lock, which concurrent creates wait on against
+        # their own 30 s deadline
+        dead_owners: set = set()
+        for oid, size in candidates:
             if spilled >= need:
+                break
+            if oid not in self._primary or oid in self._spilled:
+                continue  # secondary copies just evict; never re-spill
+            if self._owner_of.get(oid) in dead_owners:
+                continue  # unreachable owner: nothing to commit to
+            if max_bytes and self._spill_bytes + size > max_bytes:
+                logger.warning(
+                    "spill tier at object_spill_max_bytes cap (%d); "
+                    "arena pressure will surface as store-full", max_bytes)
                 break
             lease = self.store.lease(oid)
             if lease is None:
-                self._primary.discard(oid)
+                self._primary.discard(oid)  # raced away
                 continue
-            offset, size = lease
+            offset, lsize = lease
+            if max_bytes and self._spill_bytes + lsize > max_bytes:
+                self.store.release(oid)
+                break  # true size known only post-lease on the fallback
+            # snapshot the owner before the commit await: a concurrent
+            # free can pop _owner_of mid-RPC, and a None slipped into
+            # dead_owners would match every OWNERLESS later candidate
+            owner = self._owner_of.get(oid)
             try:
-                # failpoint: the spill tier write fails — the in-store
-                # primary must survive (pin kept) so readers see no loss
-                _fp.failpoint("raylet.spill.fail")
+                view = self.store.view(offset, lsize)
                 if spill_uri:
                     # external tier: the blob outlives this node, and
                     # the owner learns the URI so ANY node can restore
                     # (parity: reference external_storage.py)
                     from ray_tpu.air import storage as air_storage
                     uri = air_storage.join(spill_uri, oid.hex())
-                    air_storage.write_bytes(
-                        uri, bytes(self.store.view(offset, size)))
+                    await loop.run_in_executor(
+                        None, self._write_spill_uri, uri, view)
+                    # two-phase commit: the in-store copy is only
+                    # dropped once the OWNER has durably recorded the
+                    # blob — a fire-and-forget notify raced node death
+                    # (blob written, owner ignorant: the object was
+                    # unrestorable AND its blob leaked on free)
+                    if not await self._commit_spill_to_owner(oid,
+                                                             uri=uri):
+                        if owner is not None:
+                            dead_owners.add(owner)
+                        await loop.run_in_executor(
+                            None, self._delete_spill_blob, uri)
+                        self.store.release(oid)
+                        continue
                     self._spilled[oid] = uri
-                    self._notify_owner_spilled(oid, uri)
                 else:
                     path = os.path.join(self._spill_dir, oid.hex())
-                    with open(path, "wb") as f:
-                        f.write(self.store.view(offset, size))
+                    await loop.run_in_executor(
+                        None, self._write_spill_file, path, view)
+                    # local tier: the owner records the NODE so remote
+                    # pulls route here and stream from the spill file
+                    addr = getattr(self.server, "address", None)
+                    if addr and not await self._commit_spill_to_owner(
+                            oid, node=list(addr)):
+                        if owner is not None:
+                            dead_owners.add(owner)
+                        await loop.run_in_executor(
+                            None, self._delete_spill_blob, path)
+                        self.store.release(oid)
+                        continue
                     self._spilled[oid] = path
-            except Exception:  # noqa: BLE001 — spill tier down: keep the
-                # in-store copy (primary pin stays; finally drops only
-                # the lease taken above)
+            except Exception:  # noqa: BLE001 — spill tier down: keep
+                # the in-store copy (primary pin stays; only the lease
+                # taken above is dropped)
                 logger.exception("spill of %s failed; keeping in-store",
                                  oid.hex()[:12])
-                continue
-            finally:
                 self.store.release(oid)
+                continue
+            if not self.store.contains(oid):
+                # the owner freed the object while the blob was being
+                # written (our lease doomed the delete): registering the
+                # spill now would resurrect a freed object and leak its
+                # blob — discard and let the release complete the free
+                target = self._spilled.pop(oid, None)
+                if target is not None:
+                    await loop.run_in_executor(
+                        None, self._delete_spill_blob, target)
+                self.store.release(oid)
+                continue
+            self._spilled_sizes[oid] = lsize
+            self._spill_bytes += lsize
+            _tm.store_spilled(lsize)
+            self.store.release(oid)  # the lease taken above
             self._primary.discard(oid)
             self.store.release(oid)  # drop the primary pin
             self.store.delete(oid)
-            spilled += size
+            spilled += lsize
 
-    def _notify_owner_spilled(self, oid: ObjectID, uri: str) -> None:
-        """Fire-and-forget: tell the owner where the blob lives so the
-        object survives this node (restores anywhere)."""
+    def _write_spill_file(self, path: str, view) -> None:
+        """Executor-side blob write: tmp file + rename commit, so a
+        failure (or kill) mid-write never publishes a torn blob."""
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                half = len(view) // 2
+                f.write(view[:half])
+                # failpoint: the spill write dies mid-flight (chaos) —
+                # the half-written tmp must be discarded, never adopted
+                _spill_write_failpoint()
+                f.write(view[half:])
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _write_spill_uri(self, uri: str, view) -> None:
+        _spill_write_failpoint()
+        from ray_tpu.air import storage as air_storage
+        air_storage.write_bytes(uri, bytes(view))
+
+    async def _commit_spill_to_owner(self, oid: ObjectID,
+                                     uri: Optional[str] = None,
+                                     node: Optional[list] = None) -> bool:
+        """Record the blob's location with the owner — a URI (restores
+        anywhere, survives this node) or this node's address (local
+        spill file; pulls stream straight from it).  The sweep only
+        drops the in-store copy on True; an unowned object (no owner
+        recorded — e.g. a restored secondary) commits trivially."""
         owner = self._owner_of.get(oid)
         if owner is None:
-            return
+            return True
+        try:
+            conn = await self.pool.get((owner[1], owner[2]))
+            payload: Dict[str, Any] = {"object_id": oid.binary()}
+            if uri is not None:
+                payload["uri"] = uri
+            if node is not None:
+                payload["node"] = node
+            # short timeout: the sweep holds _spill_lock, which
+            # concurrent creates wait on against their own deadline —
+            # a black-holed owner must not stall the whole arena
+            await conn.call("object_spilled", payload, timeout=3.0)
+            return True
+        except Exception:  # noqa: BLE001 — owner unreachable: the
+            return False   # caller keeps the in-store copy
 
-        async def _tell():
-            try:
-                conn = await self.pool.get((owner[1], owner[2]))
-                await conn.call("object_spilled",
-                                {"object_id": oid.binary(), "uri": uri},
-                                timeout=10.0)
-            except Exception:  # noqa: BLE001 — best-effort; local
-                pass           # restore still works via self._spilled
+    def _delete_spill_blob(self, target: str) -> None:
+        try:
+            if "://" in target:
+                from ray_tpu.air import storage as air_storage
+                air_storage.delete(target)
+            else:
+                os.unlink(target)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
 
-        task = asyncio.get_running_loop().create_task(_tell())
-        task.add_done_callback(lambda t: t.exception())
-
-    def _restore_from_spill(self, oid: ObjectID) -> bool:
+    async def _restore_from_spill(self, oid: ObjectID) -> bool:
+        """Transparent restore: read the spilled blob back into the
+        arena and seal it (unpinned — a restored copy just evicts; its
+        blob stays in the tier until the owner frees the object)."""
         target = self._spilled.get(oid)
         if target is None:
             return False
         if "://" in target:
-            return self._restore_from_uri(oid, target)
-        if not os.path.exists(target):
-            return False
-        size = os.path.getsize(target)
+            return await self._restore_from_uri(oid, target)
         try:
-            view = self.store.create(oid, size)
-        except Exception:
+            size = os.path.getsize(target)
+        except OSError:
             return False
-        with open(target, "rb") as f:
-            f.readinto(view)
-        self.store.seal(oid)
-        return True
+        # guard entered before the FIRST await — see _finish_restore
+        self._restore_guard_enter(oid)
+        return await self._finish_restore(
+            oid, size, target,
+            lambda offset, view: self._read_spill_file(target, view))
 
-    def _restore_from_uri(self, oid: ObjectID, uri: str) -> bool:
+    async def _restore_from_uri(self, oid: ObjectID, uri: str) -> bool:
         """Restore a URI-spilled blob — works on ANY node, including
         ones that never held the object (the spiller may be dead)."""
+        loop = asyncio.get_running_loop()
+        # the guard must span the blob READ too: a free landing while
+        # the read runs deletes the (not-yet-existing) arena entry as a
+        # no-op — sealing the already-read bytes afterwards would
+        # resurrect the freed object as an undeletable zombie
+        self._restore_guard_enter(oid)
         try:
-            from ray_tpu.air import storage as air_storage
-            data = air_storage.read_bytes(uri)
+            data = await loop.run_in_executor(
+                None, self._read_spill_uri, uri)
         except Exception:  # noqa: BLE001 — missing/unreachable tier
+            if self._restore_guard_exit(oid):
+                self.store.delete(oid)
             return False
+        if self._restoring[oid][1]:
+            # freed during the read; its blob is already deleted
+            if self._restore_guard_exit(oid):
+                self.store.delete(oid)
+            return False
+        return await self._finish_restore(
+            oid, len(data), uri,
+            lambda offset, view: self.store.write_range(offset, data))
+
+    def _restore_guard_enter(self, oid: ObjectID) -> None:
+        ent = self._restoring.get(oid)
+        if ent is None:
+            ent = self._restoring[oid] = [0, False]
+        ent[0] += 1
+
+    def _restore_guard_exit(self, oid: ObjectID) -> bool:
+        """Drop one restore's guard; True when this was the LAST guard
+        out AND a free arrived mid-restore — the caller then completes
+        the deferred delete (earlier exiters must not: a sibling's
+        executor thread may still own the block)."""
+        ent = self._restoring[oid]
+        ent[0] -= 1
+        if ent[0] > 0:
+            return False
+        del self._restoring[oid]
+        return ent[1]
+
+    async def _finish_restore(self, oid: ObjectID, size: int,
+                              target: str, writer) -> bool:
+        """Allocate + executor-write + seal under the freed-mid-restore
+        discipline.  The caller has ALREADY entered ``_restoring[oid]``
+        (before its first await): handle_object_free must never
+        store.delete an oid whose arena block an executor thread may be
+        writing — it flags the entry instead and the last guard-exit
+        here completes the deferred delete.  Every path out drops the
+        guard exactly once."""
+        ok = False
         try:
-            view = self.store.create(oid, len(data))
-        except Exception:  # noqa: BLE001 — store full/exists
-            return self.store.contains(oid)
-        view[:] = data
-        self.store.seal(oid)
-        return True
+            try:
+                # restoring may itself need room: spill colder objects
+                # first so larger-than-arena working sets rotate through
+                await self._maybe_spill(size)
+                offset, view = self.store.alloc(oid, size)
+            except ValueError:
+                return self.store.contains(oid)  # concurrently restored
+            except Exception:  # noqa: BLE001 — full even after spilling
+                return False
+            loop = asyncio.get_running_loop()
+            try:
+                # GIL-releasing write off the event loop (restored
+                # blobs can be arena-sized)
+                await loop.run_in_executor(None, writer, offset, view)
+            except Exception:  # noqa: BLE001 — unreadable blob: drop
+                # the create so the id isn't stuck half-restored
+                logger.exception("restore of %s from %s failed",
+                                 oid.hex()[:12], target)
+                self.store.delete(oid)
+                return False
+            # seal before the guard drops: if a free raced in, the
+            # guard-exit below deletes the (briefly sealed) copy
+            self.store.seal(oid)
+            ok = True
+        finally:
+            if self._restore_guard_exit(oid):
+                # freed while the restore ran: complete the deferred
+                # delete now that no executor thread owns the block
+                self.store.delete(oid)
+                ok = False
+        if ok:
+            _tm.store_restored(size)
+            return True
+        return False
+
+    def _read_spill_file(self, path: str, view) -> None:
+        # failpoint: the restore read fails (chaos) — the caller must
+        # surface a miss, not a torn object
+        _restore_read_failpoint()
+        with open(path, "rb") as f:
+            f.readinto(view)
+
+    def _read_spill_uri(self, uri: str) -> bytes:
+        _restore_read_failpoint()
+        from ray_tpu.air import storage as air_storage
+        return air_storage.read_bytes(uri)

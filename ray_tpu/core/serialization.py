@@ -25,7 +25,8 @@ from __future__ import annotations
 import io
 import pickle
 import struct
-from typing import Any, Callable, List, Tuple
+import sys
+from typing import Any, Callable, List, Optional, Tuple
 
 import cloudpickle
 
@@ -129,6 +130,101 @@ _EMPTY_DICT_WIRE: Any = None
 _NONE_WIRE: Any = None
 
 
+# ---------------------------------------------------------------------------
+# zero-copy buffer fast path
+# ---------------------------------------------------------------------------
+
+def _rebuild_bytes(buf) -> bytes:
+    return bytes(buf)
+
+
+def _rebuild_bytearray(buf) -> bytearray:
+    return bytearray(buf)
+
+
+def _rebuild_jax_array(shape, dtype, buf):
+    import jax  # the putter had jax imported; readers reconstruct lazily
+    import numpy as _np
+
+    arr = _np.frombuffer(buf, dtype=dtype).reshape(shape)
+    return jax.numpy.asarray(arr)
+
+
+class _BufferWire:
+    """Pickles as ``rebuild(*args, <out-of-band buffer>)``: the payload
+    rides as a raw out-of-band buffer next to a few-byte meta pickle,
+    never through the pickle stream."""
+
+    __slots__ = ("rebuild", "args", "buf")
+
+    def __init__(self, rebuild: Callable, args: tuple, buf) -> None:
+        self.rebuild = rebuild
+        self.args = args
+        self.buf = buf
+
+    def __reduce__(self):
+        return (self.rebuild, (*self.args, pickle.PickleBuffer(self.buf)))
+
+
+def _serialize_buffer_fast(value: Any) -> Optional["SerializedObject"]:
+    """Zero-pickle-copy fast path for flat buffer values.
+
+    Large ``bytes``/``bytearray`` and contiguous numpy / single-device
+    CPU jax arrays serialize as a tiny handwritten meta pickle plus the
+    payload as an out-of-band buffer, so a plasma put's only copy of
+    the data is the final write into the writer's mapped slab — the
+    cloudpickle path copies ``bytes`` wholesale into the meta stream,
+    and jax arrays additionally densified through an intermediate host
+    array.  Returns None when the value doesn't qualify (caller falls
+    back to cloudpickle).  Flat buffers cannot contain ObjectRefs, so
+    skipping the ref-aware pickler is sound.
+    """
+    vt = type(value)
+    buffers: List = []
+    if vt is bytes or vt is bytearray:
+        if len(value) < _INBAND_LIMIT:
+            return None
+        rebuild = _rebuild_bytes if vt is bytes else _rebuild_bytearray
+        meta = pickle.dumps(_BufferWire(rebuild, (), value), protocol=5,
+                            buffer_callback=buffers.append)
+        return SerializedObject(meta, buffers, [])
+    np_mod = sys.modules.get("numpy")
+    if np_mod is not None and vt is np_mod.ndarray:
+        if (value.nbytes < _INBAND_LIMIT or value.dtype.hasobject
+                or not (value.flags["C_CONTIGUOUS"]
+                        or value.flags["F_CONTIGUOUS"])):
+            return None
+        # plain pickle (protocol 5): numpy's own reduce extracts the
+        # data buffer out-of-band; no CloudPickler / persistent_id
+        # traversal on a pure array
+        meta = pickle.dumps(value, protocol=5,
+                            buffer_callback=buffers.append)
+        return SerializedObject(meta, buffers, [])
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None and isinstance(value, jax_mod.Array):
+        try:
+            if getattr(value, "weak_type", False):
+                return None  # jnp.asarray would strengthen the type
+            devices = value.devices()
+            if len(devices) != 1 or next(iter(devices)).platform != "cpu":
+                return None  # sharded / device-resident: cloudpickle
+            np_view = np_mod.asarray(value) if np_mod is not None else None
+        except Exception:  # noqa: BLE001 — any layout oddity: fall back
+            return None
+        if (np_view is None or np_view.nbytes < _INBAND_LIMIT
+                or not np_view.flags["C_CONTIGUOUS"]):
+            return None
+        # ship the payload as raw uint8 (extended dtypes like bfloat16
+        # don't speak the buffer protocol) and reinterpret on rebuild
+        meta = pickle.dumps(
+            _BufferWire(_rebuild_jax_array,
+                        (np_view.shape, np_view.dtype),
+                        np_view.reshape(-1).view(np_mod.uint8)),
+            protocol=5, buffer_callback=buffers.append)
+        return SerializedObject(meta, buffers, [])
+    return None
+
+
 def serialize(value: Any) -> SerializedObject:
     """Serialize ``value``, extracting large buffers out-of-band and
     collecting any contained ObjectRefs."""
@@ -156,6 +252,9 @@ def serialize(value: Any) -> SerializedObject:
         # persistent_id traversal (~half the per-call serialize cost on
         # small-result actor storms)
         return SerializedObject(pickle.dumps(value, protocol=5), [], [])
+    fast = _serialize_buffer_fast(value)
+    if fast is not None:
+        return fast
     buffers: List = []
     contained: List = []
     sink = io.BytesIO()

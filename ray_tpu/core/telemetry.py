@@ -227,6 +227,28 @@ def transfer_pull_done(ok: bool, path: str, nbytes: int,
 
 
 # ---------------------------------------------------------------------------
+# object-store spill tier
+# ---------------------------------------------------------------------------
+
+def store_spilled(nbytes: int) -> None:
+    """One cold primary written to the spill tier."""
+    if not enabled():
+        return
+    _counter("ray_tpu_store_spilled_bytes_total",
+             "bytes spilled from the arena to the disk/URI tier"
+             ).inc_key(_EMPTY_KEY, float(nbytes))
+
+
+def store_restored(nbytes: int) -> None:
+    """One spilled blob transparently restored into the arena."""
+    if not enabled():
+        return
+    _counter("ray_tpu_store_restored_bytes_total",
+             "bytes restored from the spill tier into the arena"
+             ).inc_key(_EMPTY_KEY, float(nbytes))
+
+
+# ---------------------------------------------------------------------------
 # scheduler / lease plane
 # ---------------------------------------------------------------------------
 

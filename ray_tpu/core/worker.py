@@ -1197,6 +1197,12 @@ class CoreWorker:
         if ref_info.in_plasma and not self._shutdown:
             locations = set(ref_info.locations)
             spilled_uri = getattr(ref_info, "spilled_uri", None)
+            # the spilling node usually IS a seal-time location, but a
+            # free must reach its spill file even if the location was
+            # ever retracted — a leaked blob survives until node death
+            spilled_on = getattr(ref_info, "spilled_on", None)
+            if spilled_on:
+                locations.add(tuple(spilled_on))
             async def _free():
                 for node_addr in locations:
                     try:
@@ -1304,10 +1310,16 @@ class CoreWorker:
                 "pending": pending}
 
     async def handle_object_spilled(self, conn, data):
-        """A raylet spilled one of our objects to the external URI tier;
-        record it so restores survive that node's death."""
-        self.reference_counter.set_spilled_uri(
-            ObjectID(data["object_id"]), data["uri"])
+        """A raylet spilled one of our objects: to the external URI
+        tier (record the URI — restores survive that node's death) or
+        to its local disk tier (record the node — gets/pulls route
+        there and stream straight from the spill file)."""
+        object_id = ObjectID(data["object_id"])
+        if data.get("uri"):
+            self.reference_counter.set_spilled_uri(object_id, data["uri"])
+        if data.get("node"):
+            self.reference_counter.set_spilled(object_id,
+                                               tuple(data["node"]))
         return True
 
     async def handle_object_location_added(self, conn, data):

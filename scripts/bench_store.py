@@ -1,0 +1,151 @@
+"""Object-store microbench: writer-count put sweep + spill roundtrip.
+
+Runs the two object-plane rows this plane's work is gated on — the
+1/2/4/8-writer aggregate put-bandwidth sweep (``put_gbps_by_writers``,
+the curve the sharded store metadata exists for) and a put/get round
+over a working set ~2x the arena that rotates through the raylet's
+spill tier with transparent restore — then prints ONE line of JSON
+with the measured values and their delta against the repo baseline, so
+``make bench-store`` gives a sub-two-minute signal on store work
+without paying for the full benchmark harness.
+
+Baseline resolution: the newest parseable ``BENCH_r*.json`` artifact
+(the per-round records kept next to ``BASELINE.json``); rows missing
+there fall back to the seed reference numbers.
+
+Usage::
+
+    python scripts/bench_store.py [--mb 64] [--reps 2] [--skip-spill]
+                                  [--skip-sweep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# runnable as `python scripts/bench_store.py` (make bench-store)
+# without an installed package or PYTHONPATH
+if HERE not in sys.path:
+    sys.path.insert(0, HERE)
+
+#: seed-era fallbacks when no BENCH_r*.json artifact parses
+#: (put_gbps_multi_client is the 4-writer sweep point's ancestor row)
+FALLBACK_BASELINE = {
+    "put_gbps_single_client": 76.2,
+    "put_gbps_multi_client": 18.2,
+}
+
+
+def load_baseline() -> dict:
+    arts = sorted(
+        glob.glob(os.path.join(HERE, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    keys = set(FALLBACK_BASELINE) | {"put_gbps_by_writers",
+                                     "spill_roundtrip_gbps"}
+    for path in reversed(arts):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+            details = parsed.get("details") or {}
+        except Exception:  # noqa: BLE001 — artifact tails can truncate
+            continue
+        if any(k in details for k in keys):
+            base = dict(FALLBACK_BASELINE)
+            base.update({k: details[k] for k in keys if k in details})
+            base["baseline_round"] = int(
+                re.search(r"r(\d+)", os.path.basename(path)).group(1))
+            return base
+    return dict(FALLBACK_BASELINE)
+
+
+def bench_sweep(mb: int, reps: int) -> dict:
+    """1/2/4/8-writer aggregate put bandwidth on a default-size arena."""
+    import ray_tpu
+
+    out: dict = {}
+    ray_tpu.init()
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        class Putter:
+            """Per-client payload allocated ONCE outside the timed loop
+            (a fresh np.zeros per put would measure page faults)."""
+
+            def __init__(self, mb):
+                import numpy as _np
+                self.data = _np.ones(mb * 1024 * 1024, dtype=_np.uint8)
+
+            def put_big(self, n):
+                import ray_tpu as _rt
+                for _ in range(n):
+                    _rt.put(self.data)
+                return n
+
+        import bench as bench_mod
+
+        gbits = mb * 1024 * 1024 * 8 / 1e9
+        putters = [Putter.remote(mb) for _ in range(8)]
+        ray_tpu.get([p.put_big.remote(1) for p in putters], timeout=180)
+        time.sleep(3.0)
+        sweep = bench_mod.put_writer_sweep(putters, gbits, reps,
+                                           settle=time.sleep)
+        out["put_gbps_by_writers"] = sweep
+        out["put_gbps_single_client"] = sweep["1"]
+        out["put_gbps_multi_client"] = sweep["4"]
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001 — teardown must not eat results
+            pass
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mb", type=int, default=64,
+                    help="per-put object size in MiB")
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--skip-spill", action="store_true")
+    ap.add_argument("--skip-sweep", action="store_true")
+    args = ap.parse_args()
+
+    result: dict = {}
+    if not args.skip_sweep:
+        result.update(bench_sweep(args.mb, args.reps))
+    if not args.skip_spill:
+        import bench as bench_mod
+
+        result.update(bench_mod.bench_store_spill())
+
+    baseline = load_baseline()
+    delta = {}
+    for key, value in result.items():
+        base = baseline.get(key)
+        if not isinstance(base, (int, float)) or base <= 0:
+            continue
+        delta[f"vs_baseline_{key}"] = round(value / base, 2)
+    # the sweep's 4-writer point also rates against the multi-client row
+    sweep = result.get("put_gbps_by_writers") or {}
+    if "4" in sweep and isinstance(
+            baseline.get("put_gbps_multi_client"), (int, float)):
+        delta["vs_baseline_put_gbps_multi_client"] = round(
+            sweep["4"] / baseline["put_gbps_multi_client"], 2)
+    if "1" in sweep and sweep.get("1"):
+        delta["multi_over_single_4w"] = round(
+            sweep.get("4", 0) / sweep["1"], 2)
+    line = dict(result)
+    line.update(delta)
+    if "baseline_round" in baseline:
+        line["baseline_round"] = baseline["baseline_round"]
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

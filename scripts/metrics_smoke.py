@@ -48,6 +48,12 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_gcs_node_deaths_total",
     "ray_tpu_task_events_dropped_total",
     "ray_tpu_arena_doomed_objects",
+    # spill-tier series: counters need actual spill/restore traffic; the
+    # gauges ride the same stats_ex gate as the arena extras above
+    "ray_tpu_store_spilled_bytes_total",
+    "ray_tpu_store_restored_bytes_total",
+    "ray_tpu_store_spill_objects",
+    "ray_tpu_store_shard_contention_total",
     # profiler series: the sampler is off by default (profiler_enabled /
     # `ray-tpu profile` arm it), so a quiet boot exports none of them
     "ray_tpu_profiler_samples_total",

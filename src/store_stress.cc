@@ -27,6 +27,8 @@
 // C ABI of the components under test (object_store.cc / sched_core.cc)
 extern "C" {
 void* rtpu_store_create(const char* path, uint64_t capacity);
+void* rtpu_store_create_sharded(const char* path, uint64_t capacity,
+                                uint64_t num_shards);
 void rtpu_store_destroy(void* handle);
 int64_t rtpu_store_put(void* handle, const unsigned char* id, uint64_t size);
 int64_t rtpu_store_put_hint(void* handle, const unsigned char* id,
@@ -42,6 +44,11 @@ void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
                       uint64_t* num_objects);
 uint64_t rtpu_store_stats_ex(void* handle, uint64_t* out, uint64_t max);
 uint64_t rtpu_store_bucket_used(void* handle, uint64_t* out, uint64_t max);
+uint64_t rtpu_store_shard_contention(void* handle, uint64_t* out,
+                                     uint64_t max);
+uint64_t rtpu_store_spill_candidates(void* handle, unsigned char* out_ids,
+                                     uint64_t* out_sizes, uint64_t max_ids,
+                                     uint64_t max_pins);
 
 int rtpu_sched_pick_node(const double* node_avail, const int64_t* node_load,
                          int n_nodes, int n_res, const double* demand,
@@ -148,6 +155,182 @@ void SchedWorker(int seed, std::atomic<long>* ops_done) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// N-writer concurrent create/seal/get/delete mix: writers on DISTINCT
+// key ranges + distinct slab buckets (the production multi-client put
+// shape the sharded metadata exists for) racing writers COLLIDING on
+// one shared key range and one bucket (maximum shard/bucket contention).
+// Every thread balances its own pins/creates, so the post-join
+// accounting is deterministic: zero objects, zero used bytes, zero
+// doomed — any residue is a real leak in the sharded table or the
+// striped allocator.
+// ---------------------------------------------------------------------------
+
+constexpr int kMixDistinct = 6;   // writers with private key ranges
+constexpr int kMixColliders = 4;  // writers hammering ONE shared range
+constexpr int kMixKeysPer = 32;
+constexpr int kMixRounds = 4000;
+constexpr int kMixSharedBase = 100000;
+
+void MixWriter(void* store, int tid, bool collider,
+               std::atomic<long>* ops_done) {
+  std::mt19937 rng(7000 + tid);
+  unsigned char id[kIdSize];
+  const int base = collider ? kMixSharedBase
+                            : kMixSharedBase + 1000 * (tid + 1);
+  const uint64_t hint = collider ? 63 : static_cast<uint64_t>(tid);
+  for (int i = 0; i < kMixRounds; i++) {
+    FillId(id, base + static_cast<int>(rng() % kMixKeysPer));
+    uint64_t sz = 512 + rng() % 8192;
+    int64_t off = rtpu_store_put_hint(store, id, sz, hint);
+    if (off >= 0) {
+      rtpu_store_seal(store, id);
+      uint64_t offset = 0, size = 0;
+      if (rtpu_store_get(store, id, &offset, &size)) {
+        // size must round-trip for PRIVATE-range writers; a collider's
+        // object can legally be deleted + re-created at a different
+        // size by a sibling between our seal and get
+        if (!collider && size != sz) {
+          std::fprintf(stderr, "mix: get size %llu != put size %llu\n",
+                       (unsigned long long)size, (unsigned long long)sz);
+          std::abort();
+        }
+        if (rng() % 4 == 0) {
+          // doom while pinned: our pin defers the free to release
+          rtpu_store_delete(store, id);
+        }
+        rtpu_store_release(store, id);
+      }
+    }
+    // delete whether or not WE created it this round (colliders race
+    // each other's objects; a miss is fine)
+    rtpu_store_delete(store, id);
+    if (rng() % 64 == 0) {
+      uint64_t ex[13];
+      rtpu_store_stats_ex(store, ex, 13);
+      unsigned char cand_ids[8 * kIdSize];
+      uint64_t cand_sizes[8];
+      rtpu_store_spill_candidates(store, cand_ids, cand_sizes, 8, 0);
+    }
+    ops_done->fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+int RunWriterMix() {
+  char path[] = "/dev/shm/rtpu_mix_XXXXXX";
+  int fd = mkstemp(path);
+  if (fd >= 0) close(fd);
+  void* store = rtpu_store_create_sharded(path, 64ull << 20, 16);
+  if (store == nullptr) {
+    std::fprintf(stderr, "mix store create failed\n");
+    return 2;
+  }
+  std::atomic<long> ops{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kMixDistinct; t++) {
+    threads.emplace_back(MixWriter, store, t, false, &ops);
+  }
+  for (int t = 0; t < kMixColliders; t++) {
+    threads.emplace_back(MixWriter, store, kMixDistinct + t, true, &ops);
+  }
+  for (auto& th : threads) th.join();
+
+  // post-join sweep: colliders may leave each other's last round alive
+  unsigned char id[kIdSize];
+  for (int t = 0; t <= kMixDistinct; t++) {
+    int base = t == 0 ? kMixSharedBase : kMixSharedBase + 1000 * t;
+    for (int k = 0; k < kMixKeysPer; k++) {
+      FillId(id, base + k);
+      rtpu_store_delete(store, id);
+    }
+  }
+
+  // accounting must balance exactly: every pin was released, every
+  // create deleted, every doomed object reclaimed
+  uint64_t ex[13] = {0};
+  uint64_t n_ex = rtpu_store_stats_ex(store, ex, 13);
+  int rc = 0;
+  if (n_ex < 13) {
+    std::fprintf(stderr, "mix: stats_ex returned %llu values (<13)\n",
+                 (unsigned long long)n_ex);
+    rc = 4;
+  }
+  if (ex[0] != 0 || ex[2] != 0 || ex[3] != 0) {
+    std::fprintf(stderr,
+                 "mix: post-join leak used=%llu objects=%llu doomed=%llu\n",
+                 (unsigned long long)ex[0], (unsigned long long)ex[2],
+                 (unsigned long long)ex[3]);
+    rc = 4;
+  }
+  // the drained arena must still serve one big allocation: reclaim +
+  // cross-stripe coalescing have to reassemble the churned space
+  FillId(id, 999999);
+  if (rtpu_store_put_hint(store, id, 32ull << 20, 0) < 0) {
+    std::fprintf(stderr, "mix: post-drain big alloc failed (fragmented)\n");
+    rc = 4;
+  } else {
+    rtpu_store_delete(store, id);
+  }
+  uint64_t shard_cont[64] = {0};
+  uint64_t n_shards = rtpu_store_shard_contention(store, shard_cont, 64);
+  uint64_t cont_total = 0;
+  for (uint64_t s = 0; s < n_shards; s++) cont_total += shard_cont[s];
+  std::printf("mix ops=%ld shards=%llu shard_contention=%llu "
+              "alloc_contention=%llu\n",
+              ops.load(), (unsigned long long)n_shards,
+              (unsigned long long)cont_total, (unsigned long long)ex[11]);
+  rtpu_store_destroy(store);
+  std::remove(path);
+  return rc;
+}
+
+// Deterministic spill-queue semantics: candidates are sealed objects
+// with pin_count <= max_pins, ordered by LAST PIN (oldest first);
+// client-pinned and unsealed objects never appear.
+int CheckSpillCandidates() {
+  char path[] = "/dev/shm/rtpu_cand_XXXXXX";
+  int fd = mkstemp(path);
+  if (fd >= 0) close(fd);
+  void* store = rtpu_store_create_sharded(path, 4ull << 20, 8);
+  unsigned char a[kIdSize], b[kIdSize], c[kIdSize], u[kIdSize];
+  FillId(a, 1);
+  FillId(b, 2);
+  FillId(c, 3);
+  FillId(u, 4);
+  rtpu_store_put(store, a, 1024);
+  rtpu_store_seal(store, a);
+  rtpu_store_put(store, b, 1024);
+  rtpu_store_seal(store, b);
+  rtpu_store_put(store, c, 1024);
+  rtpu_store_seal(store, c);
+  rtpu_store_put(store, u, 1024);  // never sealed: never a candidate
+  uint64_t off = 0, sz = 0;
+  rtpu_store_get(store, a, &off, &sz);  // pin A, then release: A newest
+  rtpu_store_release(store, a);
+  rtpu_store_get(store, b, &off, &sz);  // pin B and HOLD
+  unsigned char ids[8 * kIdSize];
+  uint64_t sizes[8];
+  uint64_t n = rtpu_store_spill_candidates(store, ids, sizes, 8, 0);
+  int rc = 0;
+  // expect exactly C (oldest untouched) then A (re-pinned latest)
+  if (n != 2 || std::memcmp(ids, c, kIdSize) != 0 ||
+      std::memcmp(ids + kIdSize, a, kIdSize) != 0 || sizes[0] != 1024) {
+    std::fprintf(stderr, "spill candidates wrong (n=%llu)\n",
+                 (unsigned long long)n);
+    rc = 5;
+  }
+  rtpu_store_release(store, b);
+  n = rtpu_store_spill_candidates(store, ids, sizes, 8, 0);
+  if (n != 3 || std::memcmp(ids + 2 * kIdSize, b, kIdSize) != 0) {
+    std::fprintf(stderr, "released pin missing from candidates (n=%llu)\n",
+                 (unsigned long long)n);
+    rc = 5;
+  }
+  rtpu_store_destroy(store);
+  std::remove(path);
+  return rc;
+}
+
 }  // namespace
 
 int main() {
@@ -227,5 +410,8 @@ int main() {
               (unsigned long long)n_ex);
   rtpu_store_destroy(store);
   std::remove(path);
-  return 0;
+
+  int rc = RunWriterMix();
+  if (rc != 0) return rc;
+  return CheckSpillCandidates();
 }

@@ -1,0 +1,531 @@
+"""Multi-writer object-plane scaling + the spill/restore tier.
+
+Covers the sharded store metadata (lock-striped shards keyed by object
+id), the striped global allocator behind the per-client slab buckets,
+the LRU-by-last-pin spill queue, and the raylet's transparent
+spill/restore tier: eviction policy (pinned/unsealed never spill),
+restore on local get, pull-chunk streaming straight from the spill
+file, and spill-file cleanup on owner free.  Chaos: a spill write
+killed mid-flight plus the death of a raylet holding spilled objects
+(wired into ``make chaos``).
+"""
+
+import asyncio
+import os
+import shutil
+import tempfile
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import JobID, ObjectID, TaskID
+from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.core.raylet import Raylet
+
+
+def oid(i):
+    return ObjectID.for_put(TaskID.for_normal_task(JobID.from_int(1)), i)
+
+
+# ---------------------------------------------------------------------------
+# sharded metadata: correctness under threaded hammering
+# ---------------------------------------------------------------------------
+
+def test_sharded_store_threaded_hammering(tmp_path):
+    """8 writers (6 on private key ranges, 2 colliding on one shared
+    range) hammer create/seal/get/release/delete concurrently; the
+    post-join accounting must balance exactly — any residue is a leak
+    in the sharded table or the striped allocator."""
+    store = SharedMemoryStore(str(tmp_path / "arena"),
+                              64 * 1024 * 1024, shards=16)
+    try:
+        errors = []
+
+        def writer(tid, base, keys):
+            try:
+                rng = np.random.default_rng(tid)
+                for _ in range(400):
+                    o = oid(base + int(rng.integers(keys)))
+                    try:
+                        store.put_raw(o, b"v" * int(rng.integers(512, 8192)))
+                    except ValueError:
+                        pass  # collider raced us to this id
+                    lease = store.lease(o)
+                    if lease is not None:
+                        if rng.integers(4) == 0:
+                            store.delete(o)  # dooms under our pin
+                            assert not store.contains(o)
+                        store.release(o)
+                    store.delete(o)
+            except Exception as e:  # noqa: BLE001 — surface post-join
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer,
+                                    args=(t, 1000 * (t + 1), 24))
+                   for t in range(6)]
+        threads += [threading.Thread(target=writer, args=(10 + t, 50000, 24))
+                    for t in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        # sweep stragglers (colliders can leave the other's last round)
+        for base in [1000 * (t + 1) for t in range(6)] + [50000]:
+            for k in range(24):
+                store.delete(oid(base + k))
+        stats = store.stats_ex()
+        assert stats["num_objects"] == 0
+        assert stats["used"] == 0
+        assert stats["doomed_current"] == 0
+        assert stats["metadata_shards"] == 16
+        assert stats["alloc_stripes"] >= 1
+        # a big post-drain allocation still fits: the striped free
+        # lists coalesced back (no cross-stripe fragmentation)
+        big = oid(999999)
+        store.put_raw(big, b"z" * (32 * 1024 * 1024))
+        assert store.delete(big)
+    finally:
+        store.close()
+
+
+def test_doomed_delete_across_shards(tmp_path):
+    """Doomed-delete semantics hold per shard: a pinned delete dooms
+    (invisible to new gets, counted), the last release reclaims."""
+    store = SharedMemoryStore(str(tmp_path / "arena"),
+                              8 * 1024 * 1024, shards=8)
+    try:
+        ids = [oid(i) for i in range(1, 17)]  # spread over the 8 shards
+        for o in ids:
+            store.put_raw(o, b"x" * 1024)
+            assert store.lease(o) is not None  # pin
+        for o in ids:
+            assert not store.delete(o)  # pinned: dooms, not deletes
+            assert not store.contains(o)
+        assert store.stats_ex()["doomed_current"] == len(ids)
+        for o in ids:
+            store.release(o)  # last pin: deferred free lands
+        stats = store.stats_ex()
+        assert stats["doomed_current"] == 0
+        assert stats["num_objects"] == 0
+        assert stats["used"] == 0
+        assert stats["doomed_total"] >= len(ids)
+    finally:
+        store.close()
+
+
+def test_spill_candidates_lru_by_last_pin(tmp_path):
+    """The spill queue orders by LAST PIN and never surfaces unsealed
+    or client-pinned objects."""
+    store = SharedMemoryStore(str(tmp_path / "arena"),
+                              8 * 1024 * 1024, shards=8)
+    try:
+        a, b, c, unsealed = oid(1), oid(2), oid(3), oid(4)
+        for o in (a, b, c):
+            store.put_raw(o, b"x" * 2048)
+        store.create(unsealed, 2048)  # never sealed
+        # re-pin A: it becomes the newest; hold a pin on B
+        store.lease(a)
+        store.release(a)
+        assert store.lease(b) is not None
+        cands = [o for o, _sz in store.spill_candidates(max_pins=0)]
+        assert cands == [c, a]  # B pinned out, unsealed invisible
+        store.release(b)
+        cands = [o for o, _sz in store.spill_candidates(max_pins=0)]
+        assert cands == [c, a, b]
+        sizes = [sz for _o, sz in store.spill_candidates(max_pins=0)]
+        assert sizes == [2048, 2048, 2048]
+        store.delete(unsealed)
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# raylet spill tier (no cluster: drive the object-plane handlers directly)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def spill_raylet():
+    """A Raylet that never started its server/GCS link — just enough
+    state (store, spill dir, locks) to drive spill/restore directly."""
+    tmp = tempfile.mkdtemp(prefix="rtpu_spill_test_")
+    os.makedirs(os.path.join(tmp, "logs"), exist_ok=True)
+    config = Config()
+    config.object_store_memory = 32 * 1024 * 1024
+    config.object_spill_threshold = 0.5
+    r = Raylet(config, gcs_address=("127.0.0.1", 1), session_dir=tmp)
+    try:
+        yield r
+    finally:
+        r.store.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _put_primary(raylet, o, data):
+    """Create+seal through the handler path so the raylet takes its
+    primary pin (what a worker put looks like to the object plane)."""
+    conn = types.SimpleNamespace(context={})
+
+    async def put():
+        reply = await raylet.handle_object_create(conn, {
+            "object_id": o.binary(), "size": len(data)})
+        raylet.store.view(reply["offset"], len(data))[:] = data
+        await raylet.handle_object_seal(conn, {
+            "object_id": o.binary(), "owner_address": None})
+
+    asyncio.run(put())
+
+
+def test_spill_policy_and_eviction(spill_raylet):
+    """Under pressure: cold sealed primaries spill oldest-pin-first;
+    pinned and unsealed objects NEVER spill."""
+    r = spill_raylet
+    cold, warm, pinned = oid(1), oid(2), oid(3)
+    _put_primary(r, cold, b"c" * (6 * 1024 * 1024))
+    _put_primary(r, warm, b"w" * (6 * 1024 * 1024))
+    _put_primary(r, pinned, b"p" * (6 * 1024 * 1024))
+    unsealed = oid(4)
+    r.store.create(unsealed, 4 * 1024 * 1024)  # in-flight create
+    lease = r.store.lease(pinned)  # a client is reading this one
+    assert lease is not None
+    r.store.lease(warm)
+    r.store.release(warm)  # re-pin: warm is now newer than cold
+
+    asyncio.run(r._maybe_spill(10 * 1024 * 1024))
+
+    assert cold in r._spilled  # oldest pin went first
+    assert os.path.exists(r._spilled[cold])
+    assert pinned not in r._spilled  # client pin blocks spilling
+    assert unsealed not in r._spilled
+    assert r.store.contains(pinned)
+    assert r._spill_bytes == r._spilled_sizes[cold] + \
+        (r._spilled_sizes.get(warm, 0))
+    # debug surface
+    st = asyncio.run(r.handle_store_stats(None, {}))
+    assert st["num_spilled"] == len(r._spilled)
+    assert st["spill_bytes"] == r._spill_bytes
+    r.store.release(pinned)
+    r.store.delete(unsealed)
+
+
+def test_transparent_restore_on_local_get(spill_raylet):
+    """A spilled object restores byte-identical through the normal
+    object_get path — the reader never sees the tier."""
+    r = spill_raylet
+    data = bytes(np.random.default_rng(3).integers(
+        0, 255, 8 * 1024 * 1024, dtype=np.uint8))
+    o = oid(7)
+    _put_primary(r, o, data)
+    asyncio.run(r._maybe_spill(32 * 1024 * 1024))  # force it out
+    assert o in r._spilled and not r.store.contains(o)
+
+    conn = types.SimpleNamespace(context={})
+
+    async def get():
+        reply = await r.handle_object_get(conn, {
+            "object_ids": [o.binary()], "timeout": 10.0})
+        entry = reply[o.binary()]
+        assert entry is not None
+        got = bytes(r.store.view(entry["offset"], entry["size"]))
+        await r.handle_object_release(conn, {"object_ids": [o.binary()]})
+        return got
+
+    assert asyncio.run(get()) == data
+    # the blob stays in the tier until the owner frees (a restored
+    # copy is evictable; re-eviction must not need a re-spill)
+    assert os.path.exists(r._spilled[o])
+
+
+def test_pull_chunks_stream_from_spill_file(spill_raylet):
+    """A remote pull of a spilled object serves chunk reads straight
+    from the blob — no arena allocation, fd closed at pull_end."""
+    r = spill_raylet
+    data = bytes(np.random.default_rng(5).integers(
+        0, 255, 8 * 1024 * 1024, dtype=np.uint8))
+    o = oid(9)
+    _put_primary(r, o, data)
+    asyncio.run(r._maybe_spill(32 * 1024 * 1024))
+    assert o in r._spilled and not r.store.contains(o)
+    used_before = r.store.stats()["used"]
+    conn = types.SimpleNamespace(context={})
+
+    async def pull():
+        meta = await r.handle_object_pull_start(conn, {
+            "object_id": o.binary()})
+        assert meta["spilled"] and meta["size"] == len(data)
+        got = bytearray()
+        chunk = 1024 * 1024
+        for off in range(0, len(data), chunk):
+            n = min(chunk, len(data) - off)
+            payload = await r.handle_object_pull_chunk(conn, {
+                "object_id": o.binary(), "offset": off, "n": n})
+            got += payload
+        # over-read past the end is rejected, not garbage
+        assert await r.handle_object_pull_chunk(conn, {
+            "object_id": o.binary(), "offset": len(data) - 10,
+            "n": 1024}) is None
+        await r.handle_object_pull_end(conn, {"object_id": o.binary()})
+        return bytes(got)
+
+    assert asyncio.run(pull()) == data
+    assert conn.context.get("spill_serves") == {}  # fd closed
+    assert r.store.stats()["used"] == used_before  # never touched arena
+    # a vanished puller's fd is reclaimed by disconnect cleanup
+    conn2 = types.SimpleNamespace(context={})
+    asyncio.run(r.handle_object_pull_start(
+        conn2, {"object_id": o.binary()}))
+    assert o in conn2.context["spill_serves"]
+    r.on_disconnection(conn2)
+
+
+def test_spill_files_freed_on_owner_free(spill_raylet):
+    """The owner's free fan-out deletes spill blobs — nothing leaks in
+    the tier after every reference dies."""
+    r = spill_raylet
+    ids = [oid(20 + i) for i in range(3)]
+    for i, o in enumerate(ids):
+        _put_primary(r, o, bytes([i]) * (6 * 1024 * 1024))
+    asyncio.run(r._maybe_spill(32 * 1024 * 1024))
+    assert len(r._spilled) >= 2
+    spilled_paths = list(r._spilled.values())
+    assert all(os.path.exists(p) for p in spilled_paths)
+
+    async def free():
+        await r.handle_object_free(None, {
+            "object_ids": [o.binary() for o in ids]})
+
+    asyncio.run(free())
+    assert r._spilled == {}
+    assert r._spill_bytes == 0
+    assert not any(os.path.exists(p) for p in spilled_paths)
+    assert os.listdir(r._spill_dir) == []  # no leaked blobs or tmps
+
+
+def test_spill_write_failpoint_keeps_object(spill_raylet):
+    """A spill write that dies mid-flight publishes nothing: no torn
+    blob, no tmp leak, and the in-store copy survives."""
+    from ray_tpu.util import failpoint as fp
+
+    r = spill_raylet
+    o = oid(31)
+    data = b"s" * (8 * 1024 * 1024)
+    _put_primary(r, o, data)
+    fp.arm("raylet.spill.write_fail", "raise", count=1)
+    try:
+        asyncio.run(r._maybe_spill(32 * 1024 * 1024))
+    finally:
+        fp.disarm("raylet.spill.write_fail")
+    assert o not in r._spilled
+    assert r.store.contains(o)  # the primary survived the failed write
+    assert os.listdir(r._spill_dir) == []  # half-written tmp discarded
+    # with the failpoint gone the next sweep succeeds
+    asyncio.run(r._maybe_spill(32 * 1024 * 1024))
+    assert o in r._spilled
+
+
+def test_free_during_restore_defers_delete(spill_raylet):
+    """An owner free landing while a restore's arena write is in
+    flight must not free the block under the executor thread (it
+    would scribble over whatever re-allocates it): the free defers,
+    the restore reports a clean miss, nothing leaks."""
+    from ray_tpu.util import failpoint as fp
+
+    r = spill_raylet
+    o = oid(33)
+    _put_primary(r, o, b"q" * (8 * 1024 * 1024))
+    asyncio.run(r._maybe_spill(32 * 1024 * 1024))
+    assert o in r._spilled
+
+    async def main():
+        # hold the restore inside the executor while the free lands
+        fp.arm("raylet.restore.read_fail", "delay", count=1,
+               delay_s=0.5)
+        try:
+            task = asyncio.ensure_future(r._restore_from_spill(o))
+            await asyncio.sleep(0.1)
+            assert o in r._restoring
+            await r.handle_object_free(None, {"object_ids": [o.binary()]})
+            assert not await task  # freed mid-restore: clean miss
+        finally:
+            fp.disarm("raylet.restore.read_fail")
+
+    asyncio.run(main())
+    assert not r.store.contains(o)
+    assert r._restoring == {}
+    assert r._spilled == {}
+    assert r.store.stats()["num_objects"] == 0  # no leaked entry
+    assert os.listdir(r._spill_dir) == []
+
+
+def test_restore_read_failpoint_surfaces_miss(spill_raylet):
+    """A restore read failure yields a clean miss (no torn object in
+    the arena); the next attempt restores fine."""
+    from ray_tpu.util import failpoint as fp
+
+    r = spill_raylet
+    o = oid(32)
+    data = b"r" * (8 * 1024 * 1024)
+    _put_primary(r, o, data)
+    asyncio.run(r._maybe_spill(32 * 1024 * 1024))
+    assert o in r._spilled
+    fp.arm("raylet.restore.read_fail", "raise", count=1)
+    try:
+        assert not asyncio.run(r._restore_from_spill(o))
+    finally:
+        fp.disarm("raylet.restore.read_fail")
+    assert not r.store.contains(o)  # no half-restored object
+    assert asyncio.run(r._restore_from_spill(o))
+    lease = r.store.lease(o)
+    assert bytes(r.store.view(*lease)) == data
+    r.store.release(o)
+
+
+# ---------------------------------------------------------------------------
+# cluster level: remote pull + chaos
+# ---------------------------------------------------------------------------
+
+def test_remote_pull_restores_from_spill_node():
+    """An object spilled on node A transparently serves a pull from
+    the head node — streamed straight off A's spill file."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2},
+                _system_config={"num_prestart_workers": 2,
+                                "object_store_memory": 64 * 1024 * 1024,
+                                "object_spill_threshold": 0.6})
+    try:
+        c.add_node(num_cpus=2, resources={"a": 10})
+        c.connect()
+        c.wait_for_nodes(timeout=300)
+
+        @ray_tpu.remote(resources={"a": 1}, num_cpus=0)
+        class ProducerA:
+            """Owner stays alive on node A; its puts land in A's arena
+            and overflow A's spill tier."""
+
+            def fill(self, n, mb):
+                import numpy as _np
+                import ray_tpu as _rt
+                refs, sums = [], []
+                for i in range(n):
+                    arr = _np.full(mb * 1024 * 1024, i % 251,
+                                   dtype=_np.uint8)
+                    refs.append(_rt.put(arr))
+                    sums.append(int(arr.sum()))
+                return refs, sums
+
+        producer = ProducerA.remote()
+        refs, sums = ray_tpu.get(producer.fill.remote(5, 16), timeout=300)
+        # 80 MiB of primaries vs a 64 MiB arena: node A must have spilled
+        from ray_tpu.experimental.state import object_store_stats
+        deadline = time.monotonic() + 30
+        spilled = 0
+        while time.monotonic() < deadline:
+            spilled = sum(s.get("num_spilled", 0)
+                          for s in object_store_stats())
+            if spilled:
+                break
+            time.sleep(0.5)
+        assert spilled > 0, "nothing spilled on the producer node"
+        # head-node gets pull every object; spilled ones stream from
+        # A's blob files and restore byte-identical
+        for i, ref in enumerate(refs):
+            got = ray_tpu.get(ref, timeout=120)
+            assert int(np.asarray(got).sum()) == sums[i], f"object {i}"
+            del got
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        c.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.failpoints
+def test_spill_chaos_write_fail_then_node_death(tmp_path, monkeypatch):
+    """The ISSUE-11 chaos gauntlet: fill the arena past capacity with
+    the spill tier's writes randomly dying mid-flight, SIGKILL the
+    raylet holding the spilled objects, and prove every surviving
+    object restores byte-identical — with no leaked blobs after the
+    owner frees everything."""
+    from ray_tpu.util import failpoint as fp
+
+    tier = tmp_path / "spill-tier"
+    monkeypatch.setenv("RAY_TPU_OBJECT_SPILLING_URI", f"file://{tier}")
+    # every spawned raylet inherits the armed site: ~1 in 3 spill
+    # writes dies mid-flight (deterministic seed), forcing retries
+    monkeypatch.setenv("RAY_TPU_FAILPOINTS",
+                       "raylet.spill.write_fail=raise:prob=0.34,seed=11")
+    fp.reload_env()
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2},
+                _system_config={"num_prestart_workers": 2,
+                                "object_store_memory": 64 * 1024 * 1024,
+                                "object_spill_threshold": 0.6})
+    try:
+        victim = c.add_node(num_cpus=2, resources={"spillhost": 1.0})
+        c.connect()
+        c.wait_for_nodes(timeout=300)
+
+        @ray_tpu.remote(num_cpus=0.1, resources={"spillhost": 0.01},
+                        max_retries=0)
+        def produce(i, mb):
+            import numpy as _np
+            return _np.full(mb * 1024 * 1024, i % 251, dtype=_np.uint8)
+
+        # ~2x the victim's arena: spilling is mandatory, and with the
+        # write failpoint firing the sweep must retry through failures
+        refs = [produce.remote(i, 16) for i in range(8)]
+        expected = [int(np.full(16 * 1024 * 1024, i % 251,
+                                dtype=np.uint8).sum()) for i in range(8)]
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=300)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            blobs = list(tier.iterdir()) if tier.exists() else []
+            if len(blobs) >= 3:
+                break
+            time.sleep(0.5)
+        assert len(blobs) >= 3, (
+            f"expected >=3 URI-spilled blobs, found {len(blobs)}")
+        # failed mid-flight writes must not leak half-written tmps
+        assert not [b for b in blobs if b.name.endswith(".tmp")]
+
+        victim.kill()  # SIGKILL the raylet holding the spilled objects
+
+        restored = lost = 0
+        for i, ref in enumerate(refs):
+            try:
+                got = ray_tpu.get(ref, timeout=120)
+            except Exception:  # noqa: BLE001 — in-store-only copies
+                lost += 1      # died with the node (allowed)
+                continue
+            assert int(np.asarray(got).sum()) == expected[i], \
+                f"object {i} restored corrupt"
+            restored += 1
+            del got
+        # every object with a blob in the tier must have survived
+        assert restored >= len(blobs) - 1, (restored, len(blobs), lost)
+
+        # owner free fan-out reaches the URI tier: no leaked blobs
+        # (the get loop's variable still pins the last ref)
+        del refs, ref
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            left = list(tier.iterdir()) if tier.exists() else []
+            if not left:
+                break
+            time.sleep(0.5)
+        assert not left, f"leaked spill blobs after free: {left}"
+    finally:
+        monkeypatch.delenv("RAY_TPU_FAILPOINTS", raising=False)
+        fp.reload_env()
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        c.shutdown()
