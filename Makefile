@@ -7,8 +7,8 @@
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
 .PHONY: all native check test chaos bench bench-transfer bench-serve \
-	bench-rl bench-controlplane bench-store metrics-smoke tsan asan \
-	sanitize clean
+	bench-rl bench-controlplane bench-store bench-ha metrics-smoke \
+	tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -41,6 +41,7 @@ chaos: native
 	  tests/test_object_transfer.py tests/test_serve_batching.py \
 	  tests/test_tracing.py tests/test_rllib_pipeline.py \
 	  tests/test_controlplane_scale.py tests/test_store_scale.py \
+	  tests/test_gcs_ha.py \
 	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 
@@ -79,6 +80,13 @@ bench-controlplane: native
 # spill tier; one-line JSON delta vs the newest BENCH_r*.json rows.
 bench-store: native
 	JAX_PLATFORMS=cpu python scripts/bench_store.py
+
+# HA control-plane bench: SIGKILL the GCS mid-fleet-creation-storm
+# under serve load, measure kill -> all-actors-ALIVE reconvergence and
+# serve p99 through the outage (zero failed requests required);
+# one-line JSON delta vs the newest BENCH_r*.json rows (docs/ha.md).
+bench-ha: native
+	JAX_PLATFORMS=cpu python scripts/bench_ha.py
 
 # Boot a mini-cluster, scrape dashboard /metrics, and diff the exported
 # ray_tpu_* series list against scripts/metrics_golden.txt (catches

@@ -1154,6 +1154,18 @@ def main() -> None:
                                     if a != "--controlplane"]
         bench_controlplane.main()
         return
+    if "--ha" in sys.argv[1:]:
+        # HA control-plane bench (GCS SIGKILL mid-storm reconvergence
+        # time + serve p99 through the outage) with a one-line JSON
+        # delta — same entry `make bench-ha` uses
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_ha
+
+        sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
+                                    if a != "--ha"]
+        bench_ha.main()
+        return
     if "--store" in sys.argv[1:]:
         # object-store microbench (writer-count put sweep + the
         # larger-than-arena spill/restore round) with a one-line JSON

@@ -60,6 +60,62 @@ class NodeKiller:
         return list(self.killed)
 
 
+class HeadKiller:
+    """Kill-mid-storm tooling (docs/ha.md): SIGKILLs the HEAD node the
+    moment a driver-observable condition holds — e.g. "the GCS has
+    acked at least K registrations of my fleet" — so chaos tests and
+    ``bench_ha.py`` land the kill deterministically *inside* a
+    registration storm instead of sleeping and hoping.
+
+    The trigger runs on a watcher thread polling ``predicate()`` (any
+    callable; typically a closure over ``gcs_call("debug_state")`` or
+    ``list_actors``); the kill is a plain SIGKILL — no snapshot flush,
+    no goodbyes.  ``killed_at`` records the wall-clock kill time so the
+    caller can measure reconvergence (kill → all-actors-ALIVE)."""
+
+    def __init__(self, cluster, predicate, *,
+                 poll_interval_s: float = 0.01):
+        self.cluster = cluster
+        self.predicate = predicate
+        self.poll_interval_s = poll_interval_s
+        self.killed_at: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                fire = bool(self.predicate())
+            except Exception:  # noqa: BLE001 — mid-storm races are fine
+                fire = False
+            if fire:
+                head = self.cluster.head
+                if head is not None and head.proc.poll() is None:
+                    head.proc.kill()  # SIGKILL, mid-storm
+                    head.proc.wait(timeout=10)
+                self.killed_at = time.monotonic()
+                return
+            self._stop.wait(self.poll_interval_s)
+
+    def start(self) -> "HeadKiller":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="head-killer", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float = 30.0) -> float:
+        """Wait for the kill to have happened; returns the kill time."""
+        self._thread.join(timeout=timeout)
+        if self.killed_at is None:
+            raise TimeoutError("HeadKiller predicate never fired")
+        return self.killed_at
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
 def wait_for_condition(predicate, timeout: float = 30.0,
                        retry_interval_ms: float = 100.0) -> None:
     """Poll until predicate() is truthy (reference ``wait_for_condition``)."""

@@ -135,9 +135,34 @@ class Config:
     #: that survives losing the head host (parity: the reference's
     #: gcs_table_storage over Redis / in-memory store clients)
     gcs_table_storage: str = ""
+    #: Write-ahead log in front of the GCS table snapshot: table-
+    #: mutating handlers append a typed record and the reply is held
+    #: until the record is durable, so an acked mutation survives an
+    #: immediate head SIGKILL (the debounced snapshot alone loses the
+    #: debounce window).  Off: snapshot-only persistence (old behavior).
+    gcs_wal_enabled: bool = True
+    #: WAL durability policy: "fsync" = group-commit fsync before the
+    #: ack (survives host power loss); "write" = write(2) only (page
+    #: cache: survives process SIGKILL, cheaper on real disks).
+    gcs_wal_sync: str = "fsync"
+    #: Compact (fold the WAL into the snapshot + truncate) when the log
+    #: exceeds this many bytes, on top of the debounced snapshot cycle.
+    gcs_wal_compact_bytes: int = 8 * 1024 * 1024
+    #: Debounce window of the whole-table snapshot while the WAL is
+    #: healthy (the WAL carries ack durability, so the snapshot is just
+    #: the compaction base).  With the WAL off/degraded the GCS falls
+    #: back to a tight 0.2 s debounce.
+    gcs_snapshot_debounce_s: float = 2.0
     #: How long drivers (and actor workers) keep retrying to reconnect
     #: after the GCS/head dies before giving up (0 disables reconnect).
     gcs_client_reconnect_timeout_s: float = 60.0
+    #: First-retry delay of the GCS reconnect loops (worker
+    #: ``_reconnect_head``, raylet ``_try_gcs_reconnect``); grows
+    #: exponentially with full jitter so a fleet-wide head restart
+    #: doesn't stampede re-registration in lock-step.
+    gcs_reconnect_backoff_base_s: float = 0.2
+    #: Cap on the reconnect backoff delay.
+    gcs_reconnect_backoff_max_s: float = 5.0
     default_max_task_retries: int = 3
     default_max_actor_restarts: int = 0
     #: Period of raylet -> GCS health reports.

@@ -51,6 +51,10 @@ class NodeInfo:
     pending_demand: List[Dict[str, float]] = field(default_factory=list)
     # per-node reporter payload: cpu/mem + per-worker process stats
     stats: Dict[str, Any] = field(default_factory=dict)
+    # worker-process capacity the raylet advertised (-1 = unknown, old
+    # raylets); 0 = a dedicated control node that can NEVER host a
+    # worker — the actor scheduler must not strand leases there
+    max_workers: int = -1
 
 
 #: internal-KV key (default namespace) holding the standing
@@ -209,7 +213,8 @@ class GcsServer:
         # (reference: GcsTableStorage over Redis/in-memory store clients):
         # kv, functions, jobs, the FULL actor table, and placement groups
         # survive a GCS/head restart; nodes re-register live
-        from ray_tpu.core.table_storage import make_table_storage
+        from ray_tpu.core.table_storage import (InMemoryTableStorage,
+                                                make_table_storage)
         self.table_storage = make_table_storage(
             getattr(config, "gcs_table_storage", ""), snapshot_path)
         self._persist_handle: Optional[asyncio.TimerHandle] = None
@@ -217,26 +222,91 @@ class GcsServer:
         self._actors_to_revalidate: List[ActorInfo] = []
         #: actors restored mid-scheduling (PENDING/RESTARTING)
         self._actors_to_reschedule: List[ActorInfo] = []
+        # write-ahead log in front of the snapshot (docs/ha.md): table-
+        # mutating handlers append a typed record and hold the reply
+        # until it is durable, so an acked mutation survives a SIGKILL
+        # inside the snapshot debounce window.  Ephemeral (memory)
+        # clusters run without one.
+        self.wal = None
+        self._wal_degraded = False
+        #: last FAILED snapshot write (cooldown clock: a failing
+        #: backend must not retry size-triggered compaction
+        #: per-mutation)
+        self._persist_failed_ts = 0.0
+        if getattr(config, "gcs_wal_enabled", True) \
+                and not isinstance(self.table_storage,
+                                   InMemoryTableStorage):
+            from ray_tpu.core.wal import WriteAheadLog
+            wal_path = os.path.join(session_dir, "gcs_wal.log") \
+                if session_dir else (snapshot_path or "") + ".wal"
+            if wal_path and wal_path != ".wal":
+                self.wal = WriteAheadLog(
+                    wal_path,
+                    sync=getattr(config, "gcs_wal_sync", "fsync"))
+        #: restart-recovery / reconvergence accounting (served by
+        #: handle_recovery_state; duration finalized after the restored
+        #: actors were revalidated)
+        self._recovery: Dict[str, Any] = {
+            "restored": False, "wal_records_replayed": 0,
+            "wal_torn_tail_bytes": 0, "actors_recovered": 0,
+            "actors_revalidated": 0, "actors_rescheduled": 0,
+            "nodes_expected": 0, "complete": True, "duration_s": 0.0,
+        }
+        self._recovery_t0 = time.monotonic()
+        #: nodes known to the previous incarnation (WAL node records):
+        #: the reconvergence denominator — raylets re-register live,
+        #: this just tells recovery_state how many to expect
+        self._wal_nodes: Dict[bytes, Dict[str, Any]] = {}
         self._restore_snapshot()
 
     def _restore_snapshot(self) -> None:
+        """Recovery: load the snapshot, replay the WAL on top (typed
+        set-style records — replaying records the snapshot already
+        covers converges, see core/wal.py), then classify the restored
+        actors for revalidation/rescheduling."""
         snap = self.table_storage.load()
-        if snap is None:
-            return
-        self.kv = snap.get("kv", {})
-        self.functions = snap.get("functions", {})
-        self.jobs = snap.get("jobs", {})
-        self.job_counter = snap.get("job_counter", 0)
-        # full actor runtime state (not just detached): a reconnecting
-        # driver's handles must keep resolving after a head restart
-        for info in snap.get("actors", snap.get("detached_actors", [])):
-            self.actors[info.actor_id] = info
-            if info.name:
+        if snap is not None:
+            self.kv = snap.get("kv", {})
+            self.functions = snap.get("functions", {})
+            self.jobs = snap.get("jobs", {})
+            self.job_counter = snap.get("job_counter", 0)
+            # full actor runtime state (not just detached): a
+            # reconnecting driver's handles must keep resolving after a
+            # head restart
+            for info in snap.get("actors",
+                                 snap.get("detached_actors", [])):
+                self.actors[info.actor_id] = info
+            for pg_id, info in snap.get("placement_groups", {}).items():
+                self.placement_groups[pg_id] = info
+        n_wal = 0
+        if self.wal is not None:
+            try:
+                for _seq, rtype, data in self.wal.recover():
+                    try:
+                        self._wal_apply(rtype, data)
+                        n_wal += 1
+                    except Exception:  # noqa: BLE001 — skip a bad record
+                        logger.exception("WAL record %r failed to apply",
+                                         rtype)
+                self._recovery["wal_torn_tail_bytes"] = \
+                    self.wal.torn_tail_bytes
+            except Exception:  # noqa: BLE001 — recovery must not crash
+                logger.exception("WAL recovery failed; snapshot only")
+                self._wal_degrade("recovery failed")
+            _tm.gcs_wal_replayed(n_wal)
+        if snap is None and n_wal == 0:
+            return  # cold start
+        # classification AFTER replay, so WAL-recovered actors adopt
+        # the same restored-ALIVE liveness probes / reschedule paths as
+        # snapshot-recovered ones
+        self.named_actors = {}
+        for info in self.actors.values():
+            if info.name and info.state != ACTOR_DEAD:
                 self.named_actors[(info.namespace or "default",
                                    info.name)] = info.actor_id
             if info.state == ACTOR_ALIVE:
-                # the worker may have died with the head (or survived on a
-                # side node) — probed once the server is up
+                # the worker may have died with the head (or survived on
+                # a side node) — probed once the server is up
                 self._actors_to_revalidate.append(info)
             elif info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
                 # scheduling was in flight when the head died; nothing
@@ -246,25 +316,180 @@ class GcsServer:
         # placement groups: bundles stay committed on surviving raylets;
         # restoring the table keeps lookup/removal working after restart
         # (parity: reference GcsTableStorage persists the PG table too)
-        for pg_id, info in snap.get("placement_groups", {}).items():
+        for info in self.placement_groups.values():
             info.scheduling = False
             # retry_at is a monotonic timestamp from the previous boot —
             # meaningless now; reset so pending groups reschedule promptly
             info.retry_at = 0.0
             info.retry_backoff = 0.5
-            self.placement_groups[pg_id] = info
+        self._recovery.update(
+            restored=True, wal_records_replayed=n_wal,
+            actors_recovered=len(self.actors),
+            actors_revalidated=len(self._actors_to_revalidate),
+            actors_rescheduled=len(self._actors_to_reschedule),
+            nodes_expected=len(self._wal_nodes),
+            complete=not (self._actors_to_revalidate
+                          or self._actors_to_reschedule),
+            duration_s=round(time.monotonic() - self._recovery_t0, 3))
         logger.info(
-            "GCS restored from %s: %d kv namespaces, %d functions, "
-            "%d jobs, %d actors",
-            self.table_storage.describe(), len(self.kv),
+            "GCS restored from %s (+%d WAL records): %d kv namespaces, "
+            "%d functions, %d jobs, %d actors",
+            self.table_storage.describe(), n_wal, len(self.kv),
             len(self.functions), len(self.jobs), len(self.actors))
 
+    # -- write-ahead log (core/wal.py; docs/ha.md) ---------------------
+    def _wal_append(self, rtype: str, data: Any) -> None:
+        """Enqueue one typed mutation record.  WAL trouble degrades to
+        snapshot-only persistence — the mutation itself never fails."""
+        if self.wal is None:
+            return
+        try:
+            self.wal.append(rtype, data)
+            _tm.gcs_wal_append()
+        except Exception as e:  # noqa: BLE001 — durability degrades,
+            self._wal_degrade(e)  # availability stays
+        else:
+            if self.wal.size_bytes > int(getattr(
+                    self.config, "gcs_wal_compact_bytes", 8 << 20)) \
+                    and time.monotonic() - self._persist_failed_ts \
+                    >= 1.0:
+                # the cooldown matters when store() keeps FAILING (the
+                # log can't truncate, so the size check stays true):
+                # without it every mutation would retry a synchronous
+                # full-table snapshot inline in its handler, collapsing
+                # control-plane latency exactly while the storage
+                # backend is degraded.  Healthy compactions are
+                # untouched — success resets the clock.
+                self._compact_now()
+
+    async def _wal_flush(self) -> None:
+        """Await durability of every record appended so far — called by
+        mutating handlers right before their reply, sharing one
+        group-commit fsync per event-loop window."""
+        if self.wal is None:
+            return
+        fsyncs = self.wal.fsyncs
+        try:
+            await self.wal.flush()
+        except Exception as e:  # noqa: BLE001
+            self._wal_degrade(e)
+        else:
+            _tm.gcs_wal_fsync(self.wal.fsyncs - fsyncs)
+
+    def _wal_degrade(self, reason: Any) -> None:
+        """Disable the WAL after an append/flush failure: persistence
+        falls back to the tight snapshot debounce (0.2 s), counted and
+        surfaced so operators see the durability downgrade."""
+        if self.wal is None:
+            return
+        logger.error("GCS WAL degraded to snapshot-only persistence: %s",
+                     reason)
+        _tm.gcs_wal_append_failure()
+        self._emit_event("ERROR", "GCS_WAL_DEGRADED",
+                         f"WAL disabled, snapshot-only persistence: "
+                         f"{reason}")
+        try:
+            self.wal.close()
+        finally:
+            self.wal = None
+            self._wal_degraded = True
+
+    def _wal_actor(self, info: ActorInfo) -> None:
+        """Full-state actor record (idempotent on replay: last write
+        wins, the name index is rederived from state)."""
+        self._wal_append("actor", info)
+
+    def _wal_pg(self, pg: PlacementGroupInfo) -> None:
+        self._wal_append("pg", pg)
+
+    def _wal_apply(self, rtype: str, data: Any) -> None:
+        """Re-apply one replayed record to the in-memory tables.  Every
+        record is a full-value set (never a delta), so records the
+        snapshot already covers replay to the same state."""
+        if rtype == "kv_put":
+            ns, key, value, overwrite = data
+            d = self.kv.setdefault(ns, {})
+            if overwrite or key not in d:
+                d[key] = value
+        elif rtype == "kv_del":
+            ns, key = data
+            self.kv.get(ns, {}).pop(key, None)
+        elif rtype == "function":
+            fid, blob = data
+            self.functions[fid] = blob
+        elif rtype == "job":
+            jid, record, counter = data
+            self.jobs[JobID(jid)] = record
+            self.job_counter = max(self.job_counter, counter)
+        elif rtype == "actor":
+            self.actors[data.actor_id] = data
+        elif rtype == "pg":
+            if data.state == "REMOVED":
+                self.placement_groups.pop(data.pg_id, None)
+            else:
+                self.placement_groups[data.pg_id] = data
+        elif rtype == "node":
+            self._wal_nodes[data["node_id"]] = data
+        elif rtype == "node_dead":
+            self._wal_nodes.pop(data["node_id"], None)
+        else:
+            logger.warning("unknown WAL record type %r skipped", rtype)
+
+    def _persistence_health(self) -> Dict[str, Any]:
+        """Backend + WAL health for debug_state / ``ray-tpu status``."""
+        ts = self.table_storage
+        out: Dict[str, Any] = {
+            "backend": ts.describe(),
+            "persist_failures": ts.persist_failures,
+            "last_persist_age_s": round(
+                time.time() - ts.last_persist_ts, 3)
+            if ts.last_persist_ts else None,
+            "wal_degraded": self._wal_degraded,
+        }
+        if self.wal is not None:
+            out["wal"] = {
+                "path": self.wal.path,
+                "size_bytes": self.wal.size_bytes,
+                "appends": self.wal.appends,
+                "fsyncs": self.wal.fsyncs,
+                "truncations": self.wal.truncations,
+                "sync": self.wal.sync,
+            }
+        return out
+
+    async def handle_recovery_state(self, conn, data):
+        """Restart-recovery / reconvergence snapshot: what was restored
+        (snapshot + WAL replay), how many restored actors are still
+        being revalidated/rescheduled, and how many of the previous
+        incarnation's nodes have re-registered."""
+        out = dict(self._recovery)
+        out["nodes_reregistered"] = sum(
+            1 for nid in self._wal_nodes
+            if NodeID(nid) in self.nodes and self.nodes[NodeID(nid)].alive)
+        out["actors_alive"] = sum(1 for a in self.actors.values()
+                                  if a.state == ACTOR_ALIVE)
+        return out
+
     def _schedule_persist(self) -> None:
-        """Debounced snapshot write (coalesces mutation bursts)."""
+        """Debounced snapshot write (coalesces mutation bursts).  With
+        a healthy WAL the snapshot is only the compaction base, so the
+        debounce can stretch (``gcs_snapshot_debounce_s``); without one
+        it is the sole durability tier and stays tight."""
         if self._persist_handle is not None:
             return
+        delay = float(getattr(self.config,
+                              "gcs_snapshot_debounce_s", 2.0)) \
+            if self.wal is not None else 0.2
         loop = asyncio.get_running_loop()
-        self._persist_handle = loop.call_later(0.2, self._persist_now)
+        self._persist_handle = loop.call_later(delay, self._persist_now)
+
+    def _compact_now(self) -> None:
+        """WAL grew past gcs_wal_compact_bytes: fold it into the
+        snapshot immediately instead of waiting out the debounce."""
+        if self._persist_handle is not None:
+            self._persist_handle.cancel()
+            self._persist_handle = None
+        self._persist_now()
 
     def _persist_now(self) -> None:
         self._persist_handle = None
@@ -272,11 +497,34 @@ class GcsServer:
                   if a.state != ACTOR_DEAD]
         pgs = {pid: info for pid, info in self.placement_groups.items()
                if info.state != "REMOVED"}
-        self.table_storage.store({
+        ok = self.table_storage.store({
             "kv": self.kv, "functions": self.functions,
             "jobs": self.jobs, "job_counter": self.job_counter,
             "actors": actors,
             "placement_groups": pgs})
+        self._persist_failed_ts = 0.0 if ok else time.monotonic()
+        # no awaits since the table reads above: the snapshot is a
+        # consistent cut covering every WAL record appended so far, so
+        # the log truncates (compaction) — but only against a snapshot
+        # that actually landed
+        if ok and self.wal is not None:
+            try:
+                self.wal.truncate()
+                # the snapshot does NOT carry node membership (raylets
+                # re-register live), so re-seed the reconvergence
+                # denominator the truncate just erased: one record per
+                # live node.  Direct appends — no size re-check, no
+                # flush (membership is advisory; the next handler
+                # flush covers it).
+                for node in self.nodes.values():
+                    if node.alive:
+                        self.wal.append("node", {
+                            "node_id": node.node_id.binary(),
+                            "address": list(node.raylet_address),
+                            "resources": node.resources_total,
+                            "topology": node.topology})
+            except Exception as e:  # noqa: BLE001 — truncate/append
+                self._wal_degrade(e)  # trouble degrades, never raises
 
     async def _revalidate_restored_actors(self) -> None:
         """Probe actors restored ALIVE from the snapshot: a worker that
@@ -312,10 +560,19 @@ class GcsServer:
                 resched, self._actors_to_reschedule = \
                     self._actors_to_reschedule, []
                 for info in resched:
+                    if info.state == ACTOR_ALIVE:
+                        # the actor's worker survived the restart and
+                        # re-announced (actor_started) during the grace:
+                        # rescheduling now would mint a SECOND worker
+                        continue
                     t = asyncio.get_running_loop().create_task(
                         self._schedule_actor(info))
                     t.add_done_callback(lambda t: t.exception())
                 await self._revalidate_restored_actors()
+                self._recovery["complete"] = True
+                self._recovery["duration_s"] = round(
+                    time.monotonic() - self._recovery_t0, 3)
+                _tm.gcs_recovery_duration(self._recovery["duration_s"])
             t = asyncio.get_running_loop().create_task(_delayed_revalidate())
             t.add_done_callback(lambda t: t.exception())
         self._health_task = asyncio.get_running_loop().create_task(
@@ -359,6 +616,8 @@ class GcsServer:
         out["traces_evicted"] = self._traces_evicted
         out["registration_batches"] = self._reg_batches
         out["registration_batch_actors"] = self._reg_batch_actors
+        out["persistence"] = self._persistence_health()
+        out["recovery"] = dict(self._recovery)
         return out
 
     # -- versioned resource broadcast (parity: ray_syncer.h:27-60 —
@@ -403,6 +662,8 @@ class GcsServer:
                         "ray_tpu_gcs_subscriber_channels",
                         "live pubsub channels on the GCS hub",
                         len(self.subscribers))
+                    if self.wal is not None:
+                        _tm.gcs_wal_size(self.wal.size_bytes)
                     _tm.presample()
                     self._ingest_metrics(metrics_mod.flush_all())
                     spans = _tm.drain_spans("gcs")  # offset 0 by defn
@@ -448,6 +709,18 @@ class GcsServer:
             self._pg_retry_task.cancel()
         await self.server.stop()
         self.pool.close_all()
+        if self._persist_handle is not None:
+            self._persist_handle.cancel()
+            self._persist_handle = None
+        if self.wal is not None or self.table_storage.last_persist_ts:
+            # final snapshot so a graceful stop leaves a compact state
+            # (the WAL covers a SIGKILL; this covers tidy shutdowns)
+            try:
+                self._persist_now()
+            except Exception:  # noqa: BLE001 — shutdown best-effort
+                logger.exception("final GCS snapshot failed")
+        if self.wal is not None:
+            self.wal.close()
 
     # ------------------------------------------------------------------
     # pubsub hub
@@ -515,10 +788,19 @@ class GcsServer:
             resources_total=dict(data["resources"]),
             resources_available=dict(data["resources"]),
             topology=data.get("topology", {}),
+            max_workers=int(data.get("max_workers", -1)),
         )
         self.nodes[node_id] = info
         self._node_conns[node_id] = conn
         conn.context["node_id"] = node_id
+        # node record: raylets re-register LIVE after a restart (the
+        # node table itself is never restored), but the WAL-carried
+        # membership gives the recovery protocol its reconvergence
+        # denominator (recovery_state.nodes_expected)
+        self._wal_append("node", {"node_id": node_id.binary(),
+                                  "address": list(info.raylet_address),
+                                  "resources": info.resources_total,
+                                  "topology": info.topology})
         self.publish("nodes", {"event": "alive", "node_id": node_id.binary(),
                                "address": info.raylet_address})
         self._mark_sync_dirty(node_id)
@@ -637,6 +919,7 @@ class GcsServer:
         info.alive = False
         info.resources_available = {}
         self._node_conns.pop(node_id, None)
+        self._wal_append("node_dead", {"node_id": node_id.binary()})
         _tm.node_death()
         logger.warning("node %s dead: %s", node_id.hex()[:12], reason)
         self._mark_sync_dirty(node_id)
@@ -675,20 +958,30 @@ class GcsServer:
     # KV store (GcsInternalKVManager)
     # ------------------------------------------------------------------
     async def handle_kv_put(self, conn, data):
-        ns = self.kv.setdefault(data.get("namespace", ""), {})
-        self._schedule_persist()
+        ns_name = data.get("namespace", "")
+        ns = self.kv.setdefault(ns_name, {})
         existed = data["key"] in ns
-        if data.get("overwrite", True) or not existed:
+        overwrite = data.get("overwrite", True)
+        if overwrite or not existed:
             ns[data["key"]] = data["value"]
+            self._wal_append("kv_put", (ns_name, data["key"],
+                                        data["value"], overwrite))
+        self._schedule_persist()
+        await self._wal_flush()  # the ack promises durability
         return existed
 
     async def handle_kv_get(self, conn, data):
         return self.kv.get(data.get("namespace", ""), {}).get(data["key"])
 
     async def handle_kv_del(self, conn, data):
+        ns_name = data.get("namespace", "")
+        ns = self.kv.get(ns_name, {})
+        existed = ns.pop(data["key"], None) is not None
+        if existed:
+            self._wal_append("kv_del", (ns_name, data["key"]))
         self._schedule_persist()
-        ns = self.kv.get(data.get("namespace", ""), {})
-        return ns.pop(data["key"], None) is not None
+        await self._wal_flush()
+        return existed
 
     async def handle_kv_keys(self, conn, data):
         ns = self.kv.get(data.get("namespace", ""), {})
@@ -700,7 +993,9 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_register_function(self, conn, data):
         self.functions[data["function_id"]] = data["blob"]
+        self._wal_append("function", (data["function_id"], data["blob"]))
         self._schedule_persist()
+        await self._wal_flush()
         return True
 
     async def handle_get_function(self, conn, data):
@@ -709,13 +1004,21 @@ class GcsServer:
     # ------------------------------------------------------------------
     # jobs (GcsJobManager)
     # ------------------------------------------------------------------
+    def _wal_job(self, job_id: JobID) -> None:
+        job = self.jobs.get(job_id)
+        if job is not None:
+            self._wal_append("job", (job_id.binary(), dict(job),
+                                     self.job_counter))
+
     async def handle_register_job(self, conn, data):
         self.job_counter += 1
         job_id = JobID.from_int(self.job_counter)
-        self._schedule_persist()
         self.jobs[job_id] = {"start_time": time.time(),
                              "driver_address": data.get("driver_address"),
                              "alive": True}
+        self._wal_job(job_id)
+        self._schedule_persist()
+        await self._wal_flush()  # the id is live the moment we reply
         return {"job_id": job_id.binary()}
 
     async def handle_reattach_job(self, conn, data):
@@ -730,15 +1033,20 @@ class GcsServer:
             self.job_counter = max(self.job_counter, job_id.int_value())
         job["alive"] = True
         job["driver_address"] = data.get("driver_address")
+        self._wal_job(job_id)
         self._schedule_persist()
+        await self._wal_flush()
         return {"job_id": job_id.binary()}
 
     async def handle_job_finished(self, conn, data):
-        self._schedule_persist()
-        job = self.jobs.get(JobID(data["job_id"]))
+        job_id = JobID(data["job_id"])
+        job = self.jobs.get(job_id)
         if job:
             job["alive"] = False
             job["end_time"] = time.time()
+            self._wal_job(job_id)
+        self._schedule_persist()
+        await self._wal_flush()
         return True
 
     # ------------------------------------------------------------------
@@ -1183,6 +1491,11 @@ class GcsServer:
             locality=data.get("locality"),
         )
         self.actors[actor_id] = info
+        # typed WAL record BEFORE the reply can leave (the handler
+        # flushes): a registration acked into the snapshot debounce
+        # window must survive an immediate SIGKILL, or the PR-9 storm
+        # retry converges onto an entry that no longer exists
+        self._wal_actor(info)
         self._schedule_persist()
         # auto-subscribe the registering owner to the actor's channel:
         # its submitter needs the ALIVE address anyway, and the explicit
@@ -1217,6 +1530,7 @@ class GcsServer:
         if _hop is not None:
             _hop.end(outcome="existing" if reply.get("existing")
                      else None, actor=ActorID(data["actor_id"]).hex()[:12])
+        await self._wal_flush()  # ack promises a durable registration
         return reply
 
     async def handle_register_actor_batch(self, conn, data):
@@ -1275,12 +1589,18 @@ class GcsServer:
             t = asyncio.get_running_loop().create_task(
                 self._schedule_actor_batch(to_schedule))
             t.add_done_callback(lambda t: t.exception())
+        # ONE group-commit flush covers the whole batch's records: a
+        # registration storm pays one fsync per batch, not per actor
+        await self._wal_flush()
         return {"replies": replies}
 
     def _publish_actor(self, info: ActorInfo) -> None:
         # every published transition also reaches the durable table: the
         # snapshot persists the FULL actor table, so a detached-only gate
-        # would leave non-detached actors stale across a head restart
+        # would leave non-detached actors stale across a head restart.
+        # The WAL record is enqueued here (sync transition paths cannot
+        # await); client-facing handlers flush before replying
+        self._wal_actor(info)
         self._schedule_persist()
         channel = f"actor:{info.actor_id.hex()}"
         self.publish(channel, self._actor_message(info))
@@ -1312,6 +1632,14 @@ class GcsServer:
             deadline = time.monotonic() + 120.0
             while time.monotonic() < deadline:
                 if info.state == ACTOR_DEAD:
+                    return
+                if info.state == ACTOR_ALIVE:
+                    # a worker already announced (actor_started) —
+                    # e.g. one that survived a head restart and
+                    # re-registered while this reschedule task was
+                    # pending, or a lease whose reply was lost but
+                    # whose worker came up.  Leasing again would mint
+                    # a SECOND living copy of the actor.
                     return
                 pg = self.placement_groups.get(info.pg_id) \
                     if info.pg_id else None
@@ -1417,8 +1745,8 @@ class GcsServer:
         """
         by_node: Dict[NodeID, List[ActorInfo]] = {}
         for info in infos:
-            if info.state == ACTOR_DEAD:
-                continue
+            if info.state in (ACTOR_DEAD, ACTOR_ALIVE):
+                continue  # ALIVE: its worker already announced
             if info.pg_id is not None:
                 # gang-bound: bundle placement has its own wait loop
                 self._spawn_schedule_task(info)
@@ -1508,9 +1836,27 @@ class GcsServer:
                 pass
             return
         addr = tuple(reply["worker_task_address"])
-        info.node_id = node.node_id
         if info.state == ACTOR_ALIVE and info.address == addr:
+            info.node_id = node.node_id
             return  # actor_started already announced this address
+        if info.state == ACTOR_ALIVE and info.address is not None:
+            # the actor already has a DIFFERENT living worker (e.g. a
+            # pre-restart lease's worker re-announced while a recovery
+            # reschedule was in flight): this grant is surplus — reap
+            # it, or two processes run the actor and one leaks
+            self._release_actor_lease_charge(info.actor_id)
+            logger.warning(
+                "actor %s: surplus creation grant on %s reaped (already "
+                "alive at %s)", info.actor_id.hex()[:12],
+                node.node_id.hex()[:12], info.address)
+            try:
+                worker_conn = await self.pool.get(addr)
+                worker_conn.push("kill_actor",
+                                 {"actor_id": info.actor_id.binary()})
+            except Exception:  # noqa: BLE001 — best-effort reap
+                pass
+            return
+        info.node_id = node.node_id
         info.address = addr
         info.state = ACTOR_ALIVE
         self._publish_actor(info)
@@ -1575,6 +1921,11 @@ class GcsServer:
         for node in self.nodes.values():
             if not node.alive:
                 continue
+            if node.max_workers == 0 and required_node is None:
+                # dedicated control node (e.g. a 0-CPU HA head): it can
+                # never spawn a worker, so even a 0-resource actor
+                # would pend there forever
+                continue
             if required_node is not None and node.node_id != required_node:
                 continue
             if all(node.resources_available.get(k, 0.0) >= v
@@ -1621,12 +1972,14 @@ class GcsServer:
         info.address = tuple(data["task_address"])
         info.state = ACTOR_ALIVE
         self._publish_actor(info)
+        await self._wal_flush()
         return True
 
     async def handle_actor_creation_failed(self, conn, data):
         actor_id = ActorID(data["actor_id"])
         self._on_actor_worker_lost(actor_id, data.get("reason", "creation failed"),
                                    allow_restart=False)
+        await self._wal_flush()
         return True
 
     async def handle_get_actor(self, conn, data):
@@ -1664,6 +2017,7 @@ class GcsServer:
                 pass
         self._on_actor_worker_lost(actor_id, "killed via kill_actor",
                                    allow_restart=False)
+        await self._wal_flush()  # an acked kill must not resurrect
         return True
 
     def _on_actor_worker_lost(self, actor_id: ActorID, reason: str,
@@ -1710,8 +2064,10 @@ class GcsServer:
             name=data.get("name"),
         )
         self.placement_groups[pg.pg_id] = pg
+        self._wal_pg(pg)
         await self._schedule_pg(pg)
         self._schedule_persist()
+        await self._wal_flush()
         return {"state": pg.state}
 
     async def handle_placement_group_ready(self, conn, data):
@@ -1771,7 +2127,9 @@ class GcsServer:
                                            allow_restart=False)
         await self._return_bundles(pg, targets)
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": "REMOVED"})
+        self._wal_pg(pg)
         self._schedule_persist()
+        await self._wal_flush()
         return True
 
     async def _pg_retry_loop(self) -> None:
@@ -1823,6 +2181,7 @@ class GcsServer:
         pg.state = state
         self._wake_pg_waiters(pg.pg_id)
         self.publish(f"pg:{pg.pg_id.hex()}", {"state": state})
+        self._wal_pg(pg)
         self._schedule_persist()
 
     def _wake_pg_waiters(self, pg_id: PlacementGroupID) -> None:
@@ -1909,6 +2268,7 @@ class GcsServer:
                      {"state": pg.state,
                       "bundle_nodes": {i: n.binary()
                                        for i, n in pg.bundle_nodes.items()}})
+        self._wal_pg(pg)
         self._schedule_persist()
 
     def _plan_bundles(self, pg: PlacementGroupInfo

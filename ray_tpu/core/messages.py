@@ -167,6 +167,11 @@ register_schema("profiler_control", enabled=bool, hz=Opt(float),
 # introspection / state surface (payload-free or optional-only reads)
 register_schema("ping")
 register_schema("debug_state")          # served by both GCS and raylet
+# GCS restart-recovery snapshot: what the WAL/snapshot restored and how
+# far the live reconvergence (node re-registration, restored-actor
+# revalidation) has progressed — consumed by `ray-tpu status`, the HA
+# bench, and tests/test_gcs_ha.py
+register_schema("recovery_state")
 register_schema("get_nodes")
 register_schema("get_cluster_load")
 register_schema("get_cluster_stats")

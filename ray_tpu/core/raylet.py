@@ -428,6 +428,9 @@ class Raylet:
             "protocol_version": rpc.PROTOCOL_VERSION,
             "resources": self.resources_total,
             "topology": self.topology,
+            # worker capacity: a dedicated control node (0 CPUs → cap
+            # 0) must never be handed an actor lease it can't serve
+            "max_workers": self._max_workers,
         })
         # adopt the cluster-wide config decided by the head node
         self.config = Config.from_json(reply["config"])
@@ -585,10 +588,22 @@ class Raylet:
                 # the GCS may be RESTARTING (reference: raylets buffer
                 # through a GCS restart and re-register —
                 # test_gcs_fault_tolerance.py): reconnect + re-register
-                # with the same node id before giving up
-                if await self._try_gcs_reconnect():
-                    self._gcs_misses = 0
-                    continue
+                # with the same node id before giving up.  Attempts are
+                # gated by a jittered exponential backoff clock so a
+                # fleet-wide head restart doesn't stampede every raylet
+                # into synchronized once-per-beat re-registration.
+                now = time.monotonic()
+                if now >= getattr(self, "_gcs_reconnect_next", 0.0):
+                    self._gcs_reconnect_next = now + rpc.gcs_reconnect_delay(
+                        getattr(self, "_gcs_reconnect_attempts", 0),
+                        self.config)
+                    self._gcs_reconnect_attempts = getattr(
+                        self, "_gcs_reconnect_attempts", 0) + 1
+                    if await self._try_gcs_reconnect():
+                        self._gcs_misses = 0
+                        self._gcs_reconnect_attempts = 0
+                        self._gcs_reconnect_next = 0.0
+                        continue
                 if self._gcs_misses * self.config.health_report_period_s > \
                         self.config.health_timeout_s * 3:
                     # head is gone for good: tear down this node (workers
@@ -607,6 +622,7 @@ class Raylet:
                 "protocol_version": rpc.PROTOCOL_VERSION,
                 "resources": self.resources_total,
                 "topology": self.topology,
+                "max_workers": self._max_workers,
             }, timeout=5.0)
             if self.gcs_conn is not None:
                 self.gcs_conn.close()

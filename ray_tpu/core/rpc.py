@@ -184,6 +184,26 @@ class RetryPolicy:
 _retry_rng = random.Random(0x52504331)
 
 
+def gcs_reconnect_delay(attempt: int, config,
+                        rng: Optional[random.Random] = None) -> float:
+    """Jittered exponential backoff for the GCS reconnect loops (worker
+    ``_reconnect_head``, raylet ``_try_gcs_reconnect``).  Full jitter
+    (uniform over [half-base, current-ceiling]) instead of a fixed
+    sleep: when a whole fleet loses the head at once, decorrelated
+    delays keep the restarted GCS from eating every re-registration in
+    one synchronized stampede wave.
+
+    ``attempt`` is 0-based; the ceiling is
+    ``gcs_reconnect_backoff_base_s * 2**attempt`` capped at
+    ``gcs_reconnect_backoff_max_s``."""
+    base = max(0.01, float(getattr(config,
+                                   "gcs_reconnect_backoff_base_s", 0.2)))
+    cap = max(base, float(getattr(config,
+                                  "gcs_reconnect_backoff_max_s", 5.0)))
+    ceiling = min(cap, base * (2.0 ** max(0, attempt)))
+    return (rng or _retry_rng).uniform(base * 0.5, ceiling)
+
+
 async def call_with_retry(get_conn, method: str, data: Any = None, *,
                           policy: Optional[RetryPolicy] = None,
                           timeout: Optional[float] = None,

@@ -22,22 +22,47 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import time
 from typing import Any, Dict, Optional
 
 logger = logging.getLogger(__name__)
 
 
 class TableStorage:
-    """Interface: load the last snapshot, store a new one."""
+    """Interface: load the last snapshot, store a new one.
+
+    ``store`` returns True on success — the GCS only truncates its
+    write-ahead log against a snapshot that actually landed; failures
+    are also counted (``ray_tpu_gcs_persist_failures_total``) and
+    surfaced through ``debug_state``/``ray-tpu status`` instead of
+    being a log line nobody reads.
+    """
+
+    #: wall-clock time of the last successful store (0 = never)
+    last_persist_ts: float = 0.0
+    #: store() failures since boot (mirrors the metrics counter)
+    persist_failures: int = 0
 
     def load(self) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
 
-    def store(self, snapshot: Dict[str, Any]) -> None:
+    def store(self, snapshot: Dict[str, Any]) -> bool:
         raise NotImplementedError
 
     def describe(self) -> str:
         return type(self).__name__
+
+    def _stored_ok(self) -> bool:
+        self.last_persist_ts = time.time()
+        return True
+
+    def _store_failed(self, e: BaseException) -> bool:
+        self.persist_failures += 1
+        logger.warning("GCS table persistence failed on %s: %s",
+                       self.describe(), e)
+        from ray_tpu.core import telemetry as _tm
+        _tm.gcs_persist_failure(type(self).__name__)
+        return False
 
 
 class InMemoryTableStorage(TableStorage):
@@ -47,8 +72,8 @@ class InMemoryTableStorage(TableStorage):
     def load(self) -> Optional[Dict[str, Any]]:
         return None
 
-    def store(self, snapshot: Dict[str, Any]) -> None:
-        pass
+    def store(self, snapshot: Dict[str, Any]) -> bool:
+        return True
 
 
 class FileTableStorage(TableStorage):
@@ -67,13 +92,14 @@ class FileTableStorage(TableStorage):
             logger.warning("GCS snapshot unreadable (%s); cold start", e)
             return None
 
-    def store(self, snapshot: Dict[str, Any]) -> None:
+    def store(self, snapshot: Dict[str, Any]) -> bool:
         # single atomic-write implementation lives in air.storage
         from ray_tpu.air.storage import FileStorage as _FS
         try:
             _FS().write_bytes(self.path, pickle.dumps(snapshot))
         except OSError as e:
-            logger.warning("GCS snapshot write failed: %s", e)
+            return self._store_failed(e)
+        return self._stored_ok()
 
     def describe(self) -> str:
         return f"file:{self.path}"
@@ -98,11 +124,12 @@ class URITableStorage(TableStorage):
                            e)
             return None
 
-    def store(self, snapshot: Dict[str, Any]) -> None:
+    def store(self, snapshot: Dict[str, Any]) -> bool:
         try:
             self._storage.write_bytes(self.uri, pickle.dumps(snapshot))
         except Exception as e:  # noqa: BLE001
-            logger.warning("GCS table storage write failed: %s", e)
+            return self._store_failed(e)
+        return self._stored_ok()
 
     def describe(self) -> str:
         return self.uri

@@ -360,6 +360,77 @@ def task_events_dropped(job_id: Optional[str], n: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# GCS persistence / HA plane (core/wal.py + table_storage.py)
+# ---------------------------------------------------------------------------
+
+def gcs_persist_failure(backend: str) -> None:
+    """One failed ``TableStorage.store()`` — the snapshot that should
+    have landed didn't; the WAL (if healthy) still covers the acked
+    mutations, but the compaction base is stale."""
+    if not enabled():
+        return
+    _counter("ray_tpu_gcs_persist_failures_total",
+             "GCS table snapshot writes that failed (by backend)",
+             ("backend",)).inc_key((("backend", backend),))
+
+
+def gcs_wal_append(n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_gcs_wal_appends_total",
+             "typed mutation records appended to the GCS write-ahead "
+             "log").inc_key(_EMPTY_KEY, float(n))
+
+
+def gcs_wal_fsync(n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_gcs_wal_fsyncs_total",
+             "group-commit fsync rounds of the GCS write-ahead log "
+             "(many acked mutations share one round)"
+             ).inc_key(_EMPTY_KEY, float(n))
+
+
+def gcs_wal_append_failure(n: int = 1) -> None:
+    """A WAL append/flush failed: the GCS degraded to snapshot-only
+    persistence (tight debounce) rather than failing the mutation."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_gcs_wal_append_failures_total",
+             "failed WAL appends/flushes (the GCS degrades to "
+             "snapshot-only persistence)").inc_key(_EMPTY_KEY, float(n))
+
+
+def gcs_wal_replayed(n: int) -> None:
+    """Records replayed from the WAL at GCS startup (restart recovery)."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_gcs_wal_replayed_records_total",
+             "WAL records replayed on top of the snapshot at GCS "
+             "startup").inc_key(_EMPTY_KEY, float(n))
+
+
+def gcs_wal_size(nbytes: int) -> None:
+    if not enabled():
+        return
+    _gauge("ray_tpu_gcs_wal_size_bytes",
+           "current byte size of the GCS write-ahead log (drops to the "
+           "header size at each compaction)").set_key(
+        _EMPTY_KEY, float(nbytes))
+
+
+def gcs_recovery_duration(seconds: float) -> None:
+    """Head-restart recovery duration: snapshot load + WAL replay +
+    restored-actor revalidation, measured once per recovery."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_gcs_recovery_duration_s",
+           "duration of the last GCS restart recovery (snapshot load + "
+           "WAL replay + restored-actor revalidation)").set_key(
+        _EMPTY_KEY, float(seconds))
+
+
+# ---------------------------------------------------------------------------
 # profiling plane (core/profiler.py / GCS profile ring)
 # ---------------------------------------------------------------------------
 

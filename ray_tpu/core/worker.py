@@ -583,13 +583,19 @@ class CoreWorker:
     async def _reconnect_head(self) -> None:
         deadline = time.monotonic() + \
             self.config.gcs_client_reconnect_timeout_s
+        attempt = 0
         try:
             while not self._shutdown and time.monotonic() < deadline:
                 try:
                     conn = await rpc.connect(self.gcs_address,
                                              handler=self.task_server)
                 except OSError:
-                    await asyncio.sleep(0.5)
+                    # jittered exponential backoff (capped): a fleet of
+                    # workers losing the head together must not hammer
+                    # the restarting GCS in synchronized 0.5 s waves
+                    await asyncio.sleep(rpc.gcs_reconnect_delay(
+                        attempt, self.config))
+                    attempt += 1
                     continue
                 try:
                     await self._resume_head_session(conn)
@@ -597,7 +603,9 @@ class CoreWorker:
                     logger.info("head session resume failed (%s); retrying",
                                 e)
                     conn.close()
-                    await asyncio.sleep(0.5)
+                    await asyncio.sleep(rpc.gcs_reconnect_delay(
+                        attempt, self.config))
+                    attempt += 1
                     continue
                 logger.info("reconnected to GCS at %s", self.gcs_address)
                 return
@@ -2598,12 +2606,22 @@ class CoreWorker:
         payloads = [p for p, _ in batch]
         reply = None
         err: Optional[BaseException] = None
-        for attempt in range(4):
+        # retry budget spans a HEAD RESTART: the reconnect loop swaps
+        # self.gcs_conn underneath us, registration is idempotent keyed
+        # on actor_id (the restarted GCS replays acked entries from its
+        # WAL), so a storm interrupted by a GCS SIGKILL converges on
+        # exactly one directory entry per actor instead of failing the
+        # whole fleet after a fixed 4-attempt ~0.4 s window
+        deadline = time.monotonic() + max(
+            5.0, self.config.gcs_client_reconnect_timeout_s)
+        attempt = 0
+        while True:
             if attempt:
                 # idempotent replay (GCS keys on actor_id): a dropped
                 # or failed batch re-sends whole and converges on one
                 # directory entry per actor
-                await asyncio.sleep(0.05 * 2 ** (attempt - 1))
+                await asyncio.sleep(rpc.gcs_reconnect_delay(
+                    attempt - 1, self.config))
             try:
                 reply = await self.gcs_conn.call(
                     "register_actor_batch", {"actors": payloads},
@@ -2613,7 +2631,16 @@ class CoreWorker:
                     asyncio.TimeoutError) as e:
                 err = e
                 reply = None
+                if isinstance(e, rpc.RpcError) and not isinstance(
+                        e, rpc.RpcDeadlineExceeded) and attempt >= 3:
+                    # a handler-raised error (not transport trouble)
+                    # that survived several replays is deterministic —
+                    # fail fast instead of burning the reconnect budget
+                    break
             if isinstance(reply, dict) and "replies" in reply:
+                break
+            attempt += 1
+            if self._shutdown or time.monotonic() >= deadline:
                 break
         if not isinstance(reply, dict) or "replies" not in reply:
             exc = err if err is not None else RayTpuError(

@@ -208,6 +208,7 @@ def cmd_status(args) -> None:
     print(f"rpc: {retries:g} retries, {deadlines:g} deadline-exceeded, "
           f"{misses:g} heartbeat misses")
     print(f"transfers: {pulls:g} pulls, {tbytes/2**20:.1f} MiB moved")
+    _print_persistence_section(gcs_dbg)
     if drops:
         print(f"WARNING: {drops} task events dropped by the GCS ring "
               f"buffer (per-job: {gcs_dbg.get('task_event_drops')})")
@@ -227,6 +228,39 @@ def cmd_status(args) -> None:
             print(analyze_mod.summary_line(result))
     except Exception:  # noqa: BLE001 — status must survive a quiet GCS
         pass
+
+
+def _print_persistence_section(gcs_dbg: dict) -> None:
+    """GCS durability health: storage backend, snapshot freshness, WAL
+    size/appends, degradation, and (after a head restart) how the
+    recovery went — all from the GCS ``debug_state`` persistence/
+    recovery blocks (docs/ha.md)."""
+    health = gcs_dbg.get("persistence")
+    if not health:
+        return  # pre-HA GCS
+    line = f"persistence: {health.get('backend', '?')}"
+    age = health.get("last_persist_age_s")
+    if age is not None:
+        line += f"  last snapshot {age:.1f}s ago"
+    wal = health.get("wal")
+    if wal:
+        line += (f"  wal {wal.get('size_bytes', 0)/2**10:.1f} KiB "
+                 f"({wal.get('appends', 0)} appends, "
+                 f"{wal.get('fsyncs', 0)} fsyncs, {wal.get('sync')})")
+    elif health.get("wal_degraded"):
+        line += "  WAL DEGRADED (snapshot-only)"
+    else:
+        line += "  wal off"
+    if health.get("persist_failures"):
+        line += f"  WARNING: {health['persist_failures']} persist failures"
+    print(line)
+    rec = gcs_dbg.get("recovery") or {}
+    if rec.get("restored"):
+        print(f"recovery: {rec.get('actors_recovered', 0)} actors "
+              f"(+{rec.get('wal_records_replayed', 0)} WAL records) "
+              f"restored in {rec.get('duration_s', 0):.2f}s"
+              + ("" if rec.get("complete")
+                 else "  [reconvergence in progress]"))
 
 
 def _print_serve_section(w) -> None:

@@ -18,6 +18,14 @@ These rules check agreement between *places that must not drift apart*:
   appear in ``scripts/metrics_golden.txt``, the exporter catalogue that
   dashboards and the metrics smoke test key on.  A name typo'd or added
   without updating the catalogue ships a series nobody scrapes.
+* ``persist-conformance`` — a GCS handler that mutates a persisted
+  table (kv, jobs, functions, actors, named actors, placement groups,
+  node membership) without reaching the WAL / snapshot scheduler is a
+  durability hole: the mutation is acked to the client and silently
+  lost on the next head restart.  Mutation and persistence are both
+  resolved transitively through same-class helper calls, so
+  ``handle_register_actor → _register_one_actor → _schedule_persist``
+  conforms without annotations.
 * ``trace-propagation`` — RPC call sites on the serve request path and
   in the worker's submit-path functions must forward the distributed
   trace context (a ``trace`` payload key or a spec blob); a site that
@@ -41,8 +49,8 @@ from ray_tpu.tools.check.findings import Finding, parse_catalogue
 
 __all__ = ["ProjectConfig", "check_rpc_conformance",
            "check_failpoint_registry", "check_metric_drift",
-           "check_trace_propagation", "collect_metric_names",
-           "parse_catalogue", "PROJECT_RULES"]
+           "check_trace_propagation", "check_persist_conformance",
+           "collect_metric_names", "parse_catalogue", "PROJECT_RULES"]
 
 
 @dataclass
@@ -70,6 +78,16 @@ class ProjectConfig:
     trace_worker_funcs: Tuple[str, ...] = (
         "_request_lease_chain", "_push_task", "_push_task_batch",
         "create_actor", "_start_single_push", "_send_actor_batch")
+    #: persist-conformance scope: the GCS service file, its persisted
+    #: table attributes, and the calls that count as reaching the
+    #: durable tier (WAL append or snapshot schedule)
+    persist_service_file: str = "ray_tpu/core/gcs.py"
+    persist_tables: Tuple[str, ...] = (
+        "kv", "jobs", "job_counter", "functions", "actors",
+        "named_actors", "placement_groups", "nodes")
+    persist_calls: Tuple[str, ...] = (
+        "_schedule_persist", "_persist_now", "_wal_append", "_wal_flush",
+        "_wal_actor", "_wal_pg", "_wal_job")
 
     def read(self, rel: str) -> Optional[str]:
         try:
@@ -411,6 +429,146 @@ def check_trace_propagation(contexts: List[ModuleContext],
 
 
 # ---------------------------------------------------------------------------
+# persist-conformance
+# ---------------------------------------------------------------------------
+
+#: method names whose call on a table attribute mutates it
+_MUTATING_METHODS = {
+    "pop", "popitem", "setdefault", "update", "clear", "append",
+    "extend", "insert", "add", "discard", "remove",
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.<attr>`` -> attr (one level only)."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _table_of_target(node: ast.AST, tables: Set[str]) -> Optional[str]:
+    """The persisted table a store/del target touches:
+    ``self.kv[...] = / del self.actors[...] / self.job_counter += 1``."""
+    # unwrap one subscript layer: self.kv[ns][k] = v roots at self.kv
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    attr = _self_attr(node)
+    return attr if attr in tables else None
+
+
+class _PersistVisitor(ast.NodeVisitor):
+    """Per-function facts for the conformance fixed point: which
+    persisted tables it mutates directly, whether it calls a persist
+    entry point, and which same-class helpers it invokes."""
+
+    def __init__(self, tables: Set[str], persist_calls: Set[str]):
+        self.tables = tables
+        self.persist_calls = persist_calls
+        self.mutates: Set[str] = set()
+        self.persists = False
+        self.calls: Set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            tbl = _table_of_target(t, self.tables)
+            if tbl is not None:
+                self.mutates.add(tbl)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        tbl = _table_of_target(node.target, self.tables)
+        if tbl is not None:
+            self.mutates.add(tbl)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            tbl = _table_of_target(t, self.tables)
+            if tbl is not None:
+                self.mutates.add(tbl)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            attr = node.func.attr
+            if attr in self.persist_calls and (
+                    _self_attr(recv) is not None
+                    or isinstance(recv, ast.Name)):
+                # self._schedule_persist() / self.wal.append-style
+                # helpers — receiver shape is deliberately loose: the
+                # names are project-specific enough not to collide
+                self.persists = True
+            tbl = _self_attr(recv)
+            if tbl in self.tables and attr in _MUTATING_METHODS:
+                self.mutates.add(tbl)
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                self.calls.add(attr)  # self.<helper>(...)
+        self.generic_visit(node)
+
+
+def check_persist_conformance(contexts: List[ModuleContext],
+                              cfg: ProjectConfig) -> List[Finding]:
+    """Every ``handle_*`` coroutine of the GCS service that mutates a
+    persisted table — directly or through a helper it calls — must
+    reach the durable tier (a WAL append / flush or the snapshot
+    scheduler) on the same call graph.  A handler that doesn't acks a
+    mutation the next head restart silently forgets."""
+    rule = "persist-conformance"
+    findings: List[Finding] = []
+    ctx = next((c for c in contexts
+                if c.path == cfg.persist_service_file), None)
+    if ctx is None:
+        return findings  # service file outside this scan's scope
+    tables = set(cfg.persist_tables)
+    persist_calls = set(cfg.persist_calls)
+    facts: Dict[str, _PersistVisitor] = {}
+    lines: Dict[str, int] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            v = _PersistVisitor(tables, persist_calls)
+            for stmt in node.body:
+                v.visit(stmt)
+            facts[node.name] = v
+            lines.setdefault(node.name, node.lineno)
+
+    def _closure(seed: Set[str]) -> Set[str]:
+        """Methods in ``seed`` plus every method that (transitively)
+        calls one of them."""
+        out = set(seed)
+        changed = True
+        while changed:
+            changed = False
+            for name, v in facts.items():
+                if name not in out and v.calls & out:
+                    out.add(name)
+                    changed = True
+        return out
+
+    mutating = _closure({n for n, v in facts.items() if v.mutates})
+    persisting = _closure({n for n, v in facts.items() if v.persists})
+    for name in sorted(facts):
+        if not name.startswith("handle_"):
+            continue
+        if name in mutating and name not in persisting:
+            direct = facts[name].mutates
+            via = sorted(facts[name].calls & mutating)
+            what = ", ".join(sorted(direct)) if direct else \
+                f"via {', '.join(via)}"
+            findings.append(Finding(
+                path=ctx.path, line=lines[name], rule=rule,
+                symbol=name,
+                message=f"GCS handler {name} mutates persisted "
+                        f"table(s) ({what}) without appending to the "
+                        f"WAL / scheduling a snapshot: the acked "
+                        f"mutation is lost on the next head restart "
+                        f"(call self._wal_append/_wal_flush or "
+                        f"self._schedule_persist)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # metric-drift
 # ---------------------------------------------------------------------------
 
@@ -472,4 +630,5 @@ PROJECT_RULES = {
     "failpoint-registry": check_failpoint_registry,
     "metric-drift": check_metric_drift,
     "trace-propagation": check_trace_propagation,
+    "persist-conformance": check_persist_conformance,
 }

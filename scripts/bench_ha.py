@@ -1,0 +1,238 @@
+"""HA control-plane bench: head-restart reconvergence + headless serve.
+
+Measures the ISSUE-12 headline properties on a live virtual cluster
+(0-CPU control head + 2 worker nodes, the dedicated-head HA topology):
+
+- ``ha_reconverge_s`` — SIGKILL the GCS mid-fleet-creation-storm
+  (``HeadKiller`` fires on the registration counter), restart it, and
+  time kill → every actor of the fleet ALIVE exactly once (WAL replay +
+  idempotent registration retries + worker re-announce).
+- ``ha_serve_p99_ms_outage`` / ``ha_serve_p99_ms_steady`` — p99 of a
+  closed-loop serve load THROUGH the outage window vs steady state
+  (routers/replicas hold their state; requests never touch the GCS).
+- ``ha_failed_requests`` — must be 0: zero failed in-flight client
+  requests across kill + recovery.
+- ``ha_wal_replayed_records`` — how much acked state the restarted GCS
+  replayed from the write-ahead log.
+
+Prints ONE line of JSON with the measured values and (where a baseline
+row exists in the newest ``BENCH_r*.json``) the delta — time rows
+improve when they SHRINK, so their delta is ``baseline / value``.
+
+Usage::
+
+    python scripts/bench_ha.py [--actors N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# runnable as `python scripts/bench_ha.py` from a fresh checkout
+if HERE not in sys.path:
+    sys.path.insert(0, HERE)
+
+#: new rows — no seed baseline exists before this round lands one
+FALLBACK_BASELINE: dict = {
+    "ha_reconverge_s": None,
+    "ha_serve_p99_ms_outage": None,
+    "ha_serve_p99_ms_steady": None,
+}
+
+#: rows that improve when they shrink (delta = baseline / value)
+LOWER_IS_BETTER = {"ha_reconverge_s", "ha_serve_p99_ms_outage",
+                   "ha_serve_p99_ms_steady"}
+
+
+def load_baseline() -> dict:
+    arts = sorted(
+        glob.glob(os.path.join(HERE, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)))
+    for path in reversed(arts):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+            details = parsed.get("details") or {}
+        except Exception:  # noqa: BLE001 — artifact tails can truncate
+            continue
+        if any(k in details for k in FALLBACK_BASELINE):
+            base = {k: v for k, v in FALLBACK_BASELINE.items()
+                    if v is not None}
+            base.update({k: details[k] for k in FALLBACK_BASELINE
+                         if k in details})
+            base["baseline_round"] = int(
+                re.search(r"r(\d+)", os.path.basename(path)).group(1))
+            return base
+    return {k: v for k, v in FALLBACK_BASELINE.items() if v is not None}
+
+
+class _Load(threading.Thread):
+    """Closed-loop serve load recording (start_ts, latency, ok)."""
+
+    def __init__(self, handle, stop_evt):
+        super().__init__(name="bench-ha-load", daemon=True)
+        self.handle = handle
+        self.stop_evt = stop_evt
+        self.samples = []  # (start_monotonic, latency_s, ok)
+
+    def run(self):
+        import ray_tpu
+
+        i = 0
+        while not self.stop_evt.is_set():
+            t0 = time.monotonic()
+            try:
+                out = ray_tpu.get(self.handle.remote({"i": i}), timeout=30)
+                ok = out == {"i": i}
+            except Exception:  # noqa: BLE001 — counted, not raised
+                ok = False
+            self.samples.append((t0, time.monotonic() - t0, ok))
+            i += 1
+            time.sleep(0.02)
+
+
+def _p99_ms(latencies) -> float:
+    xs = sorted(latencies)
+    if not xs:
+        return 0.0
+    return round(xs[min(len(xs) - 1, int(len(xs) * 0.99))] * 1000, 1)
+
+
+def bench(n_actors: int) -> dict:
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._test_utils import HeadKiller, wait_for_condition
+    from ray_tpu.cluster_utils import Cluster
+    import ray_tpu.core.worker as core_worker
+
+    out: dict = {}
+    c = None
+    try:
+        c = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+        for _ in range(2):
+            c.add_node(num_cpus=3)
+        c.connect()
+        c.wait_for_nodes()
+        gw = core_worker.global_worker()
+
+        @serve.deployment(num_replicas=2, max_concurrent_queries=8,
+                          ray_actor_options={
+                              "scheduling_strategy": "SPREAD"})
+        def echo(payload=None):
+            return payload
+
+        handle = serve.run(echo.bind())
+        ray_tpu.get(handle.remote({"i": -1}), timeout=60)
+        stop_evt = threading.Event()
+        load = _Load(handle, stop_evt)
+        load.start()
+        time.sleep(2.0)  # steady-state window before the fault
+
+        @ray_tpu.remote(num_cpus=0.01, max_restarts=3)
+        class F:
+            def __init__(self, i):
+                self.i = i
+
+            def ping(self):
+                return self.i
+
+        base = gw.gcs_call("debug_state")["registration_batch_actors"]
+
+        def mid_storm():
+            dbg = gw.gcs_call("debug_state")
+            return dbg["registration_batch_actors"] - base >= \
+                max(2, n_actors // 4)
+
+        killer = HeadKiller(c, mid_storm).start()
+        actors = [F.remote(i) for i in range(n_actors)]
+        t_kill = killer.join(timeout=120)
+        c.restart_head(wait_s=120.0)
+
+        ours = {a.actor_id.binary() for a in actors}
+
+        def all_alive():
+            listed = [a for a in gw.gcs_call("list_actors")
+                      if a["actor_id"] in ours]
+            return len(listed) == n_actors and \
+                all(a["state"] == "ALIVE" for a in listed)
+        wait_for_condition(all_alive, timeout=180)
+        # every handle actually answers (directory AND workers agree)
+        pings = ray_tpu.get([a.ping.remote() for a in actors],
+                            timeout=180)
+        assert pings == list(range(n_actors))
+        t_conv = time.monotonic()
+        out["ha_reconverge_s"] = round(t_conv - t_kill, 2)
+
+        time.sleep(2.0)  # post-recovery steady tail
+        stop_evt.set()
+        load.join(timeout=30)
+        outage = [(lat, ok) for t0, lat, ok in load.samples
+                  if t_kill <= t0 <= t_conv]
+        steady = [(lat, ok) for t0, lat, ok in load.samples
+                  if t0 < t_kill or t0 > t_conv]
+        out["ha_serve_p99_ms_outage"] = _p99_ms(
+            [lat for lat, _ok in outage])
+        out["ha_serve_p99_ms_steady"] = _p99_ms(
+            [lat for lat, _ok in steady])
+        out["ha_failed_requests"] = sum(
+            1 for _t0, _lat, ok in load.samples if not ok)
+        out["ha_requests_through_outage"] = len(outage)
+        rec = gw.gcs_call("recovery_state")
+        out["ha_wal_replayed_records"] = rec.get(
+            "wal_records_replayed", 0)
+        out["ha_recovery_complete"] = bool(rec.get("complete"))
+    except Exception as e:  # noqa: BLE001 — always report what we have
+        out["ha_bench_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            from ray_tpu import serve as _serve
+            _serve.shutdown()
+        except Exception:  # noqa: BLE001 — controller may be mid-restart
+            pass
+        try:
+            import ray_tpu as _rt
+            _rt.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        if c is not None:
+            try:
+                c.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--actors", type=int, default=24,
+                    help="fleet size of the creation storm")
+    args = ap.parse_args()
+
+    result = bench(args.actors)
+    baseline = load_baseline()
+    delta = {}
+    for key, value in result.items():
+        base = baseline.get(key)
+        if not isinstance(base, (int, float)) or base <= 0 \
+                or not isinstance(value, (int, float)) or value <= 0:
+            continue
+        ratio = base / value if key in LOWER_IS_BETTER else value / base
+        delta[f"vs_baseline_{key}"] = round(ratio, 2)
+    line = dict(result)
+    line.update(delta)
+    if "baseline_round" in baseline:
+        line["baseline_round"] = baseline["baseline_round"]
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,16 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_sched_lease_cache_total",
     "ray_tpu_gcs_heartbeat_misses_total",
     "ray_tpu_gcs_node_deaths_total",
+    # HA persistence plane: failure counters need failures, replay /
+    # recovery series need a head restart, and the WAL series are
+    # absent entirely on ephemeral (memory-storage) clusters
+    "ray_tpu_gcs_persist_failures_total",
+    "ray_tpu_gcs_wal_appends_total",
+    "ray_tpu_gcs_wal_fsyncs_total",
+    "ray_tpu_gcs_wal_append_failures_total",
+    "ray_tpu_gcs_wal_replayed_records_total",
+    "ray_tpu_gcs_wal_size_bytes",
+    "ray_tpu_gcs_recovery_duration_s",
     "ray_tpu_task_events_dropped_total",
     "ray_tpu_arena_doomed_objects",
     # spill-tier series: counters need actual spill/restore traffic; the

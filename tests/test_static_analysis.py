@@ -18,7 +18,8 @@ from ray_tpu.tools.check.findings import (
 )
 from ray_tpu.tools.check.project import (
     ProjectConfig, check_failpoint_registry, check_metric_drift,
-    check_rpc_conformance, check_trace_propagation,
+    check_persist_conformance, check_rpc_conformance,
+    check_trace_propagation,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -438,6 +439,90 @@ def test_metric_drift_sees_keyword_name(fixture_project):
     ]
     findings = check_metric_drift(contexts, fixture_project)
     assert [f.symbol for f in findings] == ["ray_tpu_kw_series"]
+
+
+# ---------------------------------------------------------------------------
+# persist-conformance
+# ---------------------------------------------------------------------------
+
+def _persist_cfg(fixture_project):
+    import dataclasses
+
+    return dataclasses.replace(fixture_project,
+                               persist_service_file="gcs.py")
+
+
+def test_persist_conformance_flags_unpersisted_mutations(fixture_project):
+    """A handler mutating a persisted table without reaching the WAL /
+    snapshot scheduler is flagged — directly or through a helper."""
+    cfg = _persist_cfg(fixture_project)
+    contexts = [_ctx("""
+        class Gcs:
+            async def handle_kv_put(self, conn, data):
+                ns = self.kv.setdefault(data.get("namespace", ""), {})
+                ns[data["key"]] = data["value"]
+                return True
+
+            async def handle_register_actor(self, conn, data):
+                reply, info = self._register_one_actor(conn, data)
+                return reply
+
+            def _register_one_actor(self, conn, data):
+                self.actors[data["actor_id"]] = data
+                return {}, None
+
+            async def handle_kv_get(self, conn, data):
+                return self.kv.get(data["key"])
+    """, path="gcs.py")]
+    findings = check_persist_conformance(contexts, cfg)
+    assert sorted(f.symbol for f in findings) == \
+        ["handle_kv_put", "handle_register_actor"]
+    assert all(f.rule == "persist-conformance" for f in findings)
+
+
+def test_persist_conformance_clean_via_wal_and_helpers(fixture_project):
+    """WAL appends, snapshot scheduling, and transitive persistence
+    through helpers all conform; reads and non-persisted attributes
+    never trip the rule."""
+    cfg = _persist_cfg(fixture_project)
+    contexts = [_ctx("""
+        class Gcs:
+            async def handle_kv_put(self, conn, data):
+                self.kv[data["key"]] = data["value"]
+                self._wal_append("kv_put", data)
+                self._schedule_persist()
+                await self._wal_flush()
+                return True
+
+            async def handle_register_actor(self, conn, data):
+                reply, info = self._register_one_actor(conn, data)
+                await self._wal_flush()
+                return reply
+
+            def _register_one_actor(self, conn, data):
+                self.actors[data["actor_id"]] = data
+                self._schedule_persist()
+                return {}, None
+
+            async def handle_subscribe(self, conn, data):
+                self.subscribers.setdefault(data["channel"], set())
+                return True
+
+            async def handle_get_actor(self, conn, data):
+                return self.actors.get(data["actor_id"])
+    """, path="gcs.py")]
+    assert check_persist_conformance(contexts, cfg) == []
+
+
+def test_persist_conformance_out_of_scope_file_skipped(fixture_project):
+    """The rule only fires on the configured GCS service file."""
+    cfg = _persist_cfg(fixture_project)
+    contexts = [_ctx("""
+        class NotGcs:
+            async def handle_kv_put(self, conn, data):
+                self.kv[data["key"]] = data["value"]
+    """, path="other.py")]
+    assert check_persist_conformance(contexts, cfg) == []
 
 
 # ---------------------------------------------------------------------------
