@@ -7,8 +7,8 @@
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
 .PHONY: all native check test chaos bench bench-transfer bench-serve \
-	bench-rl bench-controlplane bench-store bench-ha metrics-smoke \
-	tsan asan sanitize clean
+	bench-rl bench-controlplane bench-store bench-ha bench-data \
+	metrics-smoke tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -41,7 +41,7 @@ chaos: native
 	  tests/test_object_transfer.py tests/test_serve_batching.py \
 	  tests/test_tracing.py tests/test_rllib_pipeline.py \
 	  tests/test_controlplane_scale.py tests/test_store_scale.py \
-	  tests/test_gcs_ha.py \
+	  tests/test_gcs_ha.py tests/test_data_streaming.py \
 	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 
@@ -80,6 +80,14 @@ bench-controlplane: native
 # spill tier; one-line JSON delta vs the newest BENCH_r*.json rows.
 bench-store: native
 	JAX_PLATFORMS=cpu python scripts/bench_store.py
+
+# Streaming data-plane bench: ingest-overlapped GPT-2-style train loop
+# (iter_batches(streaming=True), dataset ~1.5x the arena) vs the
+# materialize-then-train baseline; reports tokens/s both ways, their
+# ratio, the streaming ingest gap %, and peak arena fraction; one-line
+# JSON delta vs the newest BENCH_r*.json rows (docs/data.md).
+bench-data: native
+	JAX_PLATFORMS=cpu python scripts/bench_data.py
 
 # HA control-plane bench: SIGKILL the GCS mid-fleet-creation-storm
 # under serve load, measure kill -> all-actors-ALIVE reconvergence and

@@ -1,209 +1,111 @@
-"""User-style verification driver (see .claude/skills/verify)."""
+"""End-to-end verify driver for the streaming data plane (PR 12)."""
 import os
-import time
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 import jax  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
+
+import csv  # noqa: E402
+import time  # noqa: E402
 
 import numpy as np  # noqa: E402
 
 import ray_tpu  # noqa: E402
+from ray_tpu import data as rd  # noqa: E402
+from ray_tpu.data.context import DataContext  # noqa: E402
+
+t0 = time.time()
+ray_tpu.init(num_cpus=4, _system_config={
+    "object_store_memory": 96 * 1024 * 1024,
+    "object_spill_threshold": 0.8,
+    "object_spill_ahead_watermark": 0.5,
+})
+print(f"init {time.time()-t0:.1f}s")
+
+# -- real files on disk, streamed lazily -------------------------------
+datadir = os.path.join(os.path.dirname(__file__), "_verify_csv")
+os.makedirs(datadir, exist_ok=True)
+n_files, rows_per = 12, 500
+for i in range(n_files):
+    with open(os.path.join(datadir, f"part-{i:03d}.csv"), "w",
+              newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["uid", "value"])
+        for r in range(rows_per):
+            w.writerow([i * rows_per + r, (i * rows_per + r) % 97])
+
+ds = rd.read_csv(datadir).map_batches(
+    lambda b: {"uid": b["uid"], "value2": b["value"] * 2})
+
+# streaming iteration: lazy reads + fused map, bounded window
+t0 = time.time()
+uids = []
+for batch in ds.iter_batches(batch_size=256, streaming=True):
+    uids.extend(int(u) for u in batch["uid"])
+assert sorted(uids) == list(range(n_files * rows_per)), "stream lost rows"
+print(f"streamed {len(uids)} rows from {n_files} csv files "
+      f"in {time.time()-t0:.1f}s")
+
+# streaming shuffle riding the spill tier
+big = rd.Dataset([ray_tpu.put({"v": np.arange(i * 1_000_000,
+                                              (i + 1) * 1_000_000)})
+                  for i in range(10)])  # 80 MB vs 96 MB arena, spills
+t0 = time.time()
+total = 0
+count = 0
+first = None
+for batch in big.streaming_shuffle(seed=5).iter_batches(
+        batch_size=None, streaming=True):
+    arr = np.asarray(batch["v"])
+    if first is None:
+        first = arr[:5].tolist()
+    total += int(arr.sum())
+    count += len(arr)
+n = 10 * 1_000_000
+assert count == n and total == n * (n - 1) // 2, "shuffle corrupted data"
+print(f"streaming shuffle {count} rows ok in {time.time()-t0:.1f}s, "
+      f"head={first}")
+
+# trainer ingest: per-rank streaming shards inside real gang actors
+from ray_tpu.train import JaxTrainer, ScalingConfig, session  # noqa: E402
+
+DataContext.get_current().streaming_train_ingest = True
 
 
-def t(label, t0):
-    print(f"  [{time.perf_counter() - t0:6.2f}s] {label}")
+def loop(config):
+    import jax.numpy as jnp
+
+    shard = session.get_dataset_shard("train")
+    seen = 0
+    s = 0.0
+    for b in shard.iter_batches(batch_size=64):
+        s += float(jnp.asarray(b["id"], dtype=jnp.float32).sum())
+        seen += int(b["id"].shape[0])
+    session.report({"rows": seen, "sum": s,
+                    "rank": session.get_world_rank()})
 
 
-start = time.perf_counter()
-ray_tpu.init(num_cpus=4)
-t("init", start)
+t0 = time.time()
+trainer = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=2),
+                     datasets={"train": rd.range(4096, parallelism=8)})
+result = trainer.fit()
+assert result.error is None, result.error
+rows = sum(m["rows"] for m in result.metrics_history)
+print(f"trainer streaming ingest: rank-0 consumed {rows} rows "
+      f"in {time.time()-t0:.1f}s (fit)")
 
+# store state after the shuffle (spill-ahead watermark 0.5)
+from ray_tpu.experimental.state import object_store_stats  # noqa: E402
+stats = object_store_stats()[0]
+print("store:", {k: stats.get(k) for k in
+                 ("used", "capacity", "num_spilled", "spill_bytes")})
 
-@ray_tpu.remote
-def square(x):
-    return x * x
-
-
-@ray_tpu.remote
-def total(*parts):
-    return sum(parts)
-
-
-# chained tasks across two remote functions (lease return/reuse); refs
-# passed as top-level args resolve before execution (nested refs don't,
-# matching the reference's semantics)
-s0 = time.perf_counter()
-parts = [square.remote(i) for i in range(20)]
-assert ray_tpu.get(total.remote(*parts)) == sum(i * i for i in range(20))
-t("chained tasks", s0)
-
-s0 = time.perf_counter()
-assert ray_tpu.get(square.remote(9)) == 81
-t("single warm task (<0.1s expected)", s0)
-
-
-@ray_tpu.remote
-class Counter:
-    def __init__(self):
-        self.values = []
-
-    def add(self, v):
-        self.values.append(v)
-        return len(self.values)
-
-    def all(self):
-        return self.values
-
-
-# >4 actors on 4 CPUs; ordered calls
-s0 = time.perf_counter()
-actors = [Counter.remote() for _ in range(8)]
-for a in actors:
-    for i in range(5):
-        a.add.remote(i)
-assert all(ray_tpu.get(a.all.remote()) == [0, 1, 2, 3, 4] for a in actors)
-t("8 actors, ordered calls", s0)
-
-# PR 5: arm the continuous profiler cluster-wide, run a busy named task
-# and a 3-task chain under it (profile + analyzer checked further down,
-# after the flush loops have had time to land the window)
-s0 = time.perf_counter()
-from ray_tpu.core.worker import global_worker  # noqa: E402
-
-_w = global_worker()
-_reply = _w.gcs_call("profiler_control",
-                     {"enabled": True, "hz": 100.0, "duration_s": 6.0})
-assert _reply["nodes_applied"] >= 1, _reply
-
-
-@ray_tpu.remote
-def busy_loop(seconds):
-    end = time.time() + seconds
-    while time.time() < end:
-        sum(range(2500))
-    return True
-
-
-@ray_tpu.remote
-def chain_step(x):
-    time.sleep(0.3)
-    return x + 1
-
-
-# busy task first, chain strictly after — the chain must be the job's
-# last-finishing work for the critical-path assertion below
-assert ray_tpu.get(busy_loop.remote(1.5), timeout=60)
-_chain = chain_step.remote(chain_step.remote(chain_step.remote(0)))
-assert ray_tpu.get(_chain, timeout=60) == 3
-t("profiler armed + busy/chain tasks", s0)
-
-# analyzer check runs NOW, while the chain is still the job's last-
-# finishing work — later stages (shuffle/tune/serve) would rightly
-# steal the critical path
-s0 = time.perf_counter()
-from ray_tpu.experimental.state import analyze as analyze_mod  # noqa: E402
-
-_job = _w.job_id.hex()
-_result, _deadline = {}, time.time() + 25
-while time.time() < _deadline:
-    _result = analyze_mod.analyze_job(_job)
-    _tail = _result.get("critical_path", [])[-3:]
-    if not _result.get("error") and len(_tail) == 3 and all(
-            "chain_step" in (seg["name"] or "") for seg in _tail):
-        break
-    time.sleep(0.5)
-assert len(_result.get("critical_path", [])) >= 3, _result
-_tail = _result["critical_path"][-3:]
-assert all("chain_step" in (seg["name"] or "") for seg in _tail), _tail
-for seg in _tail:
-    assert seg["total"] >= 0.28, seg  # each link runs a 0.3s body
-_covered = _result["critical_path_s"] + _result["lead_in_s"]
-assert abs(_covered - _result["makespan_s"]) <= max(
-    0.05, 0.1 * _result["makespan_s"]), _result
-print(analyze_mod.summary_line(_result))
-t("analyze: 3-task chain critical path telescopes to makespan", s0)
-
-# data pipeline with all-to-all shuffle over the object plane
-s0 = time.perf_counter()
-import ray_tpu.data  # noqa: E402
-ds = ray_tpu.data.range(200, parallelism=8).map(
-    lambda r: {"id": r["id"] * 2})
-ds = ds.random_shuffle(seed=7)
-vals = sorted(r["id"] for r in ds.take_all())
-assert vals == [2 * i for i in range(200)], vals[:5]
-t("data shuffle", s0)
-
-# tune with a scheduler
-s0 = time.perf_counter()
-from ray_tpu import tune  # noqa: E402
-
-
-def objective(config):
-    for i in range(5):
-        tune.report(score=config["lr"] * (i + 1))
-
-
-analysis = tune.run(
-    objective,
-    config={"lr": tune.grid_search([0.1, 0.2, 0.4])},
-    scheduler=tune.schedulers.AsyncHyperBandScheduler(
-        metric="score", mode="max", max_t=5),
-)
-best = analysis.get_best_result("score", "max")
-assert best.metrics["score"] >= 1.0, best.metrics
-t("tune.run grid + ASHA", s0)
-
-# serve + real HTTP
-s0 = time.perf_counter()
-from ray_tpu import serve  # noqa: E402
-
-
-@serve.deployment
-def greeter(payload):
-    return {"hello": (payload or {}).get("name", "world")}
-
-
-serve.run(greeter.bind())
-from ray_tpu.serve.http_proxy import start_proxy  # noqa: E402
-host, port = start_proxy(port=0)
-import json  # noqa: E402
-import urllib.request  # noqa: E402
-req = urllib.request.Request(
-    f"http://{host}:{port}/greeter",
-    data=json.dumps({"name": "tpu"}).encode(),
-    headers={"content-type": "application/json"})
-with urllib.request.urlopen(req, timeout=30) as resp:
-    body = resp.read().decode()
-assert "tpu" in body, body
-t("serve + HTTP", s0)
-
-# PR 5: merged profile carries frames attributed to the named remote
-# function; the analyzer's critical path telescopes to the makespan
-s0 = time.perf_counter()
-from ray_tpu.core import profiler as profiler_mod  # noqa: E402
-
-_deadline = time.time() + 20
-_prof, _attributed = {}, []
-while time.time() < _deadline:
-    _prof = _w.gcs_call("get_profile", {})
-    _attributed = [r for r in _prof["records"]
-                   if "busy_loop" in (r.get("task") or "")]
-    if _attributed:
-        break
-    time.sleep(0.5)
-assert _attributed, "no samples attributed to busy_loop"
-_collapsed = profiler_mod.to_collapsed(_prof["records"])
-assert "task:__main__.busy_loop" in _collapsed
-_sc = profiler_mod.to_speedscope(_prof["records"])
-assert _sc["profiles"][0]["weights"], "speedscope profile empty"
-t(f"profile merged ({_prof['total_samples']} samples, "
-  f"{len(_prof['sources'])} procs, busy_loop attributed)", s0)
-
-_w.gcs_call("profiler_control", {"enabled": False})
-
-s0 = time.perf_counter()
+t0 = time.time()
 ray_tpu.shutdown()
-t("shutdown (<1s expected)", s0)
-print(f"VERIFY OK in {time.perf_counter() - start:.1f}s")
+print(f"shutdown {time.time()-t0:.1f}s")
+
+import shutil  # noqa: E402
+shutil.rmtree(datadir, ignore_errors=True)
+print("VERIFY OK")

@@ -1120,6 +1120,9 @@ SUMMARY_KEYS = (
     "ppo_env_steps_per_sec_inline", "ppo_env_steps_per_sec_fleet",
     "ppo_env_steps_per_sec_fleet_legacy",
     "ppo_scaling_curve", "ppo_scaling_curve_legacy",
+    "data_stream_tokens_per_sec", "data_materialize_tokens_per_sec",
+    "data_stream_over_materialize", "data_ingest_gap_pct",
+    "data_peak_arena_frac_stream",
     "regressions_vs_prev", "vs_prev_round",
     # failure signals MUST reach the driver-captured line: a partial
     # bench otherwise looks like a sparse-but-clean run
@@ -1178,6 +1181,18 @@ def main() -> None:
         sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
                                     if a != "--store"]
         bench_store.main()
+        return
+    if "--data" in sys.argv[1:]:
+        # streaming data-plane bench (ingest-overlapped train loop vs
+        # materialize-then-train over a dataset larger than the arena)
+        # with a one-line JSON delta — same entry `make bench-data` uses
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_data
+
+        sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
+                                    if a != "--data"]
+        bench_data.main()
         return
     if "--transfer" in sys.argv[1:]:
         # reduced transfer-plane microbench (broadcast + multi-client

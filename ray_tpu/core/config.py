@@ -87,6 +87,13 @@ class Config:
     #: between concurrent writers, at a small cross-shard sweep cost
     #: for stats/eviction scans.
     store_metadata_shards: int = 16
+    #: Async spill-AHEAD watermark (arena-used fraction): above it the
+    #: raylet's background tick spills cold sealed primaries toward the
+    #: watermark OFF the create path, so a streaming shuffle (or any
+    #: bursty producer) doesn't pay spill latency inside ``put()`` when
+    #: pressure later crosses ``object_spill_threshold``.  0 disables
+    #: (spilling then happens only reactively, on the create path).
+    object_spill_ahead_watermark: float = 0.0
 
     # ---- scheduling ------------------------------------------------------
     #: Hybrid policy: pack onto the local/first node until its utilization
@@ -128,6 +135,14 @@ class Config:
     #: per raylet) toward the demand-driven pool target while the lease
     #: plane is quiet — the next actor wave then lands on warm forks.
     warm_pool_rebuild_per_tick: int = 4
+    #: Owner-side locality lease routing (parity: the reference's
+    #: LocalityAwareLeasePolicy): a DEFAULT-strategy task whose plasma
+    #: args are known to live on another node sends its FIRST lease
+    #: request to that node's raylet, so the task runs next to its data
+    #: (the streaming data plane's map tasks depend on this).  Soft:
+    #: the target can still spill the lease back; an unreachable target
+    #: falls back to the local route.
+    task_locality_enabled: bool = True
 
     # ---- fault tolerance -------------------------------------------------
     #: GCS table persistence backend: "" / "file" = session-dir pickle,

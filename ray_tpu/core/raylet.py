@@ -318,6 +318,7 @@ class Raylet:
         self._spilled_sizes: Dict[ObjectID, int] = {}  # id -> payload bytes
         self._spill_bytes = 0  # bytes resident in the spill tier
         self._spill_lock: Optional[asyncio.Lock] = None  # one sweep at a time
+        self._spill_ahead_running = False  # one background sweep at a time
         # restores whose blob read / arena write is in flight:
         # id -> [active restore count, freed-mid-restore flag].
         # handle_object_free must NOT store.delete these (the unsealed
@@ -890,7 +891,44 @@ class Raylet:
                 for _ in range(min(per_tick, deficit)):
                     if not self._start_worker(None, cap_bonus=bonus):
                         break
+            self._maybe_spill_ahead()
             await asyncio.sleep(0.2)
+
+    def _maybe_spill_ahead(self) -> None:
+        """Async spill-AHEAD (ROADMAP item 2 remainder): when arena use
+        crosses ``object_spill_ahead_watermark`` — a line BELOW the
+        create-path spill threshold — kick one background sweep that
+        spills cold sealed primaries back toward the watermark, off the
+        critical path.  A later pressure burst (streaming shuffle
+        intermediates, bursty puts) then finds headroom instead of
+        paying blob-write latency inside ``put()``.  One sweep at a
+        time; it shares ``_spill_lock`` with the reactive path, so the
+        two can never double-spill."""
+        wm = float(getattr(self.config, "object_spill_ahead_watermark",
+                           0.0) or 0.0)
+        if wm <= 0 or self._closing or self._spill_ahead_running:
+            return
+        target = wm * self.store_capacity
+        if self.store.used() <= target:
+            return
+        self._spill_ahead_running = True
+        task = asyncio.get_running_loop().create_task(
+            self._spill_ahead_sweep(target))
+        task.add_done_callback(lambda t: t.exception())
+
+    async def _spill_ahead_sweep(self, target: float) -> None:
+        try:
+            if self._spill_lock is None:
+                self._spill_lock = asyncio.Lock()
+            async with self._spill_lock:
+                used = self.store.used()
+                if used > target:
+                    await self._spill_sweep(int(used - target))
+        except Exception:  # noqa: BLE001 — ahead-of-time work only;
+            # the reactive create-path sweep still guards correctness
+            logger.exception("spill-ahead sweep failed")
+        finally:
+            self._spill_ahead_running = False
 
     # ------------------------------------------------------------------
     # worker pool
@@ -2267,6 +2305,23 @@ class Raylet:
             except ObjectStoreFullError:
                 if time.monotonic() > deadline:
                     raise
+                # fragmentation relief, gated on its signature: the
+                # alloc failed although accounting says the object FITS
+                # below the pressure threshold — long-lived primaries
+                # can checkerboard the striped arena (one block pinning
+                # each stripe's region start) until no free run fits
+                # ``size`` even with half the arena free.  Spilling is
+                # the only block *mover*, so force a small sweep — the
+                # spilled primary's region opens and the retry lands.
+                # Above the threshold this is genuine pressure: the
+                # _maybe_spill at the top of the loop already sweeps,
+                # and in-flight writers sealing is the usual cure.
+                frac = getattr(self.config, "object_spill_threshold",
+                               -1.0)
+                if frac is None or frac < 0:
+                    frac = self.config.object_spilling_threshold
+                if self.store.used() + size <= frac * self.store_capacity:
+                    await self._spill_for_fragmentation(size)
                 await asyncio.sleep(0.05)
 
     async def handle_object_seal(self, conn, data):
@@ -2952,6 +3007,19 @@ class Raylet:
             if used + incoming <= threshold:
                 return  # the sweep we waited on already made room
             await self._spill_sweep(used + incoming - int(threshold))
+
+    async def _spill_for_fragmentation(self, need: int) -> None:
+        """An allocation failed while accounting says there is room:
+        the free space exists but no single run fits (fragmentation —
+        long-lived primaries pinning stripe-region starts).  Spill
+        ``need`` bytes of the coldest primaries regardless of the
+        pressure threshold; a spilled block's region becomes one
+        contiguous free run.  Shares ``_spill_lock`` with the pressure
+        sweeps, so at most one sweep runs at a time."""
+        if self._spill_lock is None:
+            self._spill_lock = asyncio.Lock()
+        async with self._spill_lock:
+            await self._spill_sweep(need)
 
     async def _spill_sweep(self, need: int) -> None:
         cfg = self.config

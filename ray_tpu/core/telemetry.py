@@ -612,6 +612,77 @@ def rl_fragments_dropped_stale(n: int = 1) -> None:
 
 
 # ---------------------------------------------------------------------------
+# streaming data plane (data/streaming.py — docs/data.md)
+# ---------------------------------------------------------------------------
+
+_REASON_KEYS = {"consumer": (("reason", "consumer"),),
+                "arena": (("reason", "arena"),)}
+_HIT_KEYS = {True: (("result", "hit"),), False: (("result", "miss"),)}
+
+
+def data_blocks_in_flight(depth: int) -> None:
+    """Streaming executor window occupancy: blocks executing or
+    produced-but-unconsumed, sampled at every admission round."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_data_blocks_in_flight",
+           "streaming-dataset blocks in flight (executing + ready, "
+           "bounded by streaming_block_budget)").set_key(
+        _EMPTY_KEY, float(depth))
+
+
+def data_backpressure_stall(reason: str, n: int = 1) -> None:
+    """One producer-side admission stall (``reason``: consumer lag or
+    local arena pressure above streaming_arena_watermark)."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_data_backpressure_stalls_total",
+             "streaming-ingest admission stalls, by backpressure signal",
+             ("reason",)).inc_key(_REASON_KEYS[reason], float(n))
+
+
+def data_blocks_produced(n: int = 1) -> None:
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_data_blocks_produced_total",
+             "blocks produced by streaming dataset execution"
+             ).inc_key(_EMPTY_KEY, float(n))
+
+
+def data_prefetch(hit: bool, n: int = 1) -> None:
+    """Shard-iterator prefetch accounting: the consumer asked for the
+    next batch and it was already assembled (hit) or it had to wait
+    (miss) — hit/(hit+miss) is the prefetch hit ratio."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_data_prefetch_total",
+             "streaming-shard batch requests served from the prefetch "
+             "queue (hit) vs waiting on assembly (miss)",
+             ("result",)).inc_key(_HIT_KEYS[hit], float(n))
+
+
+def data_shuffle_spilled(nbytes: int) -> None:
+    """Arena bytes the local spill tier absorbed during one streaming
+    shuffle (its intermediate working set beyond the arena)."""
+    if not enabled() or nbytes <= 0:
+        return
+    _counter("ray_tpu_data_shuffle_spilled_bytes_total",
+             "bytes spilled to the disk tier by streaming-shuffle "
+             "intermediates").inc_key(_EMPTY_KEY, float(nbytes))
+
+
+def sched_locality_lease(n: int = 1) -> None:
+    """Owner-side: one worker-lease request routed to a remote raylet
+    because the head task's plasma args live there (task locality)."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_sched_locality_leases_total",
+             "lease requests routed to the raylet holding the task's "
+             "plasma args (owner-side locality)").inc_key(
+        _EMPTY_KEY, float(n))
+
+
+# ---------------------------------------------------------------------------
 # distributed tracing plane (core/tracing.py / GCS trace ring)
 # ---------------------------------------------------------------------------
 

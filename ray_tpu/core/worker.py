@@ -299,6 +299,9 @@ class CoreWorker:
         self._lease_states: Dict[Tuple, "_LeaseState"] = {}
         self._actor_states: Dict[ActorID, "_ActorSubmitState"] = {}
         self._lease_tokens = itertools.count(1)
+        # node-id -> raylet-address snapshot for locality lease routing
+        self._node_addr_cache: Optional[Dict[str, tuple]] = None
+        self._node_addr_cache_ts = 0.0
         # coalesced actor registration: creations buffered on the user
         # thread, flushed as ONE register_actor_batch RPC per loop
         # drain (idempotent keyed on actor_id, so the flush can retry
@@ -1817,15 +1820,110 @@ class CoreWorker:
 
     async def _request_lease(self, state: "_LeaseState") -> None:
         """One lease acquisition (follows spillback redirects); holds one
-        ``state.requesting`` slot for its whole lifetime."""
+        ``state.requesting`` slot for its whole lifetime.
+
+        The FIRST hop is locality-routed (parity: the reference's
+        LocalityAwareLeasePolicy): when the head task's plasma args
+        live on another node — or it carries an explicit soft
+        NODE_AFFINITY target — the lease request goes straight to that
+        node's raylet, so map tasks land where their input block lives
+        instead of pulling it across the wire.  An unreachable target
+        falls back to the plain local-raylet route before any task
+        retry budget is touched."""
         token = f"{self.worker_id.hex()[:12]}:{next(self._lease_tokens)}"
         try:
-            await self._request_lease_chain(state, self.raylet_address,
-                                            token)
+            start = self.raylet_address
+            hint = await self._locality_lease_target(state)
+            if hint is not None:
+                try:
+                    # bounded reachability precheck: a dead hinted node
+                    # must cost ~2 s once, not a full connect timeout
+                    # on the lease path
+                    await asyncio.wait_for(self._pool.get(hint),
+                                           timeout=2.0)
+                except (rpc.ConnectionLost, rpc.RpcError, OSError,
+                        asyncio.TimeoutError):
+                    self._pool.invalidate(hint)
+                    hint = None
+            if hint is not None:
+                start = hint
+                _tm.sched_locality_lease()
+            await self._request_lease_chain(state, start, token)
         finally:
             state.requesting -= 1
             state.inflight_requests.pop(token, None)
             self._pump_lease_queue(state)
+
+    async def _locality_lease_target(self, state: "_LeaseState"
+                                     ) -> Optional[rpc.Address]:
+        """Remote raylet the head-of-backlog task should lease from,
+        or None for the default local route.  Two sources, both soft:
+        an explicit NODE_AFFINITY strategy naming another node (the
+        streaming data plane pins shard maps this way), else — gated by
+        ``task_locality_enabled`` — the owner's object directory: the
+        first known location of the task's plasma args (skipped when
+        any arg is already local, or for TPU tasks, whose device
+        placement beats data locality)."""
+        spec = state.backlog[0] if state.backlog else None
+        if spec is None:
+            return None
+        strat = spec.scheduling_strategy
+        if strat.placement_group_id is not None:
+            return None
+        if strat.kind == "NODE_AFFINITY":
+            if not strat.node_id_hex \
+                    or strat.node_id_hex == self.node_id.hex():
+                return None
+            return await self._raylet_addr_for_node(strat.node_id_hex)
+        if strat.kind != "DEFAULT" \
+                or not getattr(self.config, "task_locality_enabled", True):
+            return None
+        if spec.resources.get("TPU"):
+            return None
+        locs = self._arg_locality(spec)
+        if not locs:
+            return None
+        local = tuple(self.raylet_address)
+        best = None
+        for addr in locs:
+            t = tuple(addr)
+            if t == local:
+                return None  # an arg already lives here: stay local
+            if best is None:
+                best = t
+        return best
+
+    async def _raylet_addr_for_node(self, node_hex: str
+                                    ) -> Optional[rpc.Address]:
+        """node id (hex) -> raylet address, from a cached GCS node-table
+        snapshot (refreshed at most every 5 s; misses on a fresh node
+        just take the default route until the next refresh)."""
+        cache = self._node_addr_cache
+        now = time.monotonic()
+        if cache is None or now - self._node_addr_cache_ts > 5.0:
+            try:
+                nodes = await self.gcs_conn.call("get_nodes", {},
+                                                 timeout=2.0)
+            except Exception:  # noqa: BLE001 — locality is best-effort:
+                # keep serving the stale snapshot (the target raylet
+                # precheck guards against dead entries) and back off
+                # the refresh so a head outage costs ONE bounded probe
+                # per window, not one per lease request
+                self._node_addr_cache_ts = now
+                if cache is None:
+                    return None
+            else:
+                cache = {}
+                for n in nodes:
+                    if n.get("alive") and n.get("address"):
+                        cache[NodeID(n["node_id"]).hex()] = \
+                            tuple(n["address"])
+                self._node_addr_cache = cache
+                self._node_addr_cache_ts = now
+        addr = cache.get(node_hex)
+        if addr is None or addr == tuple(self.raylet_address):
+            return None
+        return addr
 
     async def _request_lease_chain(self, state: "_LeaseState",
                                    raylet_address: rpc.Address,
@@ -1841,7 +1939,13 @@ class CoreWorker:
             reply = await conn.call("request_worker_lease", {
                 "resources": spec.resources,
                 "job_id": self.job_id.binary() if self.job_id else None,
-                "strategy": strat.kind,
+                # SOFT node affinity grants like DEFAULT: the owner
+                # already routed this request to the preferred node,
+                # and a saturated/infeasible target must keep spillback
+                # (a hard NODE_AFFINITY pins and may queue forever)
+                "strategy": "DEFAULT"
+                if strat.kind == "NODE_AFFINITY" and strat.soft
+                else strat.kind,
                 "placement_group_id":
                     strat.placement_group_id.binary()
                     if strat.placement_group_id else None,

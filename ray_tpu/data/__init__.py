@@ -8,6 +8,11 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
 from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import ActorPoolStrategy, Dataset, GroupedDataset  # noqa: F401
 from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
+from ray_tpu.data.streaming import (  # noqa: F401
+    StreamingExecutor,
+    StreamingShuffle,
+    StreamShard,
+)
 from ray_tpu.data.read_api import (  # noqa: F401
     from_arrow,
     from_huggingface,

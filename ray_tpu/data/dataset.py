@@ -189,30 +189,99 @@ def _groupby_map(block: Block, key, n_reducers: int, stages) -> List[Block]:
     return parts[0] if n_reducers == 1 else parts
 
 
-class Dataset:
-    """Distributed data pipeline (parity: reference ``data/dataset.py``)."""
+def resolve_input(inp: Any) -> "ray_tpu.ObjectRef":
+    """One stream input -> block ref: invoke a lazy factory (submitting
+    its read task now), pass a ref through.  THE shared resolution
+    idiom — the batch cache and the streaming admission paths must
+    never diverge on what counts as a factory."""
+    if callable(inp) and not isinstance(inp, ray_tpu.ObjectRef):
+        return inp()
+    return inp
 
-    def __init__(self, blocks: List[ray_tpu.ObjectRef],
+
+class _InputBlocks:
+    """Shared lazy input list: refs or factories (zero-arg callables
+    submitting the producing read task).  Resolution is cached and
+    SHARED across every Dataset derived from the same source, so a
+    ``ds.map(f)`` and its parent never double-submit read tasks."""
+
+    __slots__ = ("inputs", "refs")
+
+    def __init__(self, inputs: List[Any]):
+        self.inputs = list(inputs)
+        self.refs: Optional[List[ray_tpu.ObjectRef]] = None
+
+    def resolve(self) -> List[ray_tpu.ObjectRef]:
+        if self.refs is None:
+            self.refs = [resolve_input(b) for b in self.inputs]
+        return self.refs
+
+
+class Dataset:
+    """Distributed data pipeline (parity: reference ``data/dataset.py``).
+
+    Blocks may be sealed ObjectRefs or *factories* (zero-arg callables
+    submitting the producing read task on demand — ``read_api`` creates
+    these).  Batch execution resolves every factory up front (the old
+    behavior); the streaming engine (``data/streaming.py``) admits them
+    lazily inside its bounded in-flight window, so reads never
+    front-load the arena.
+    """
+
+    def __init__(self, blocks: Union[List[Any], _InputBlocks],
                  stages: Optional[List[Stage]] = None,
                  metadata: Optional[List[Optional[BlockMetadata]]] = None,
-                 stats: Optional[List[ray_tpu.ObjectRef]] = None):
-        self._blocks = list(blocks)
+                 stats: Optional[List[ray_tpu.ObjectRef]] = None,
+                 shuffle: Optional[Dict[str, Any]] = None):
+        self._source = blocks if isinstance(blocks, _InputBlocks) \
+            else _InputBlocks(blocks)
         self._stages: List[Stage] = list(stages or [])
         self._metadata = metadata if metadata and not self._stages else None
         # per-block stats refs from the materialize() that produced these
         # blocks (each resolves to a list of per-stage dicts)
         self._stats_refs = stats
+        # pending streaming_shuffle marker ({"seed", "num_blocks"});
+        # batch consumption resolves it through the eager random_shuffle
+        self._shuffle = shuffle
+
+    @property
+    def _inputs(self) -> List[Any]:
+        return self._source.inputs
+
+    def _stream_inputs(self) -> List[Any]:
+        """Inputs for the streaming engine: the RESOLVED refs when a
+        batch consumer already submitted the reads (never re-read a
+        file the cache holds), else the lazy factories."""
+        return self._source.refs if self._source.refs is not None \
+            else self._source.inputs
+
+    @property
+    def _blocks(self) -> List[ray_tpu.ObjectRef]:
+        """Resolved block refs: factories are submitted (all at once —
+        the batch path's semantics) and cached on first access."""
+        return self._source.resolve()
 
     # ------------------------------------------------------------------
     # plan & execution
     # ------------------------------------------------------------------
     def _with_stage(self, name: str, fn: Callable[[Block], Block]) -> "Dataset":
-        return Dataset(self._blocks, self._stages + [(name, fn)])
+        if self._shuffle is not None:
+            raise ValueError(
+                "cannot add transforms after streaming_shuffle(); apply "
+                "them before the shuffle (they fuse into its map side)")
+        return Dataset(self._source, self._stages + [(name, fn)])
 
     def materialize(self) -> "Dataset":
         """Execute pending fused stages, one task per block (parity:
         ``ExecutionPlan.execute`` plan.py:295); per-stage wall/rows/bytes
         are recorded and surfaced by ``stats()``."""
+        if self._shuffle is not None:
+            # batch consumption of a streaming_shuffle marker: the eager
+            # all-to-all shuffle computes the same result set
+            plain = Dataset(self._source, self._stages)
+            return plain.random_shuffle(
+                seed=self._shuffle.get("seed"),
+                num_blocks=self._shuffle.get("num_blocks")).materialize()
         if not self._stages:
             return self
         pairs = [_fused_map_stats.remote(b, self._stages)
@@ -528,7 +597,7 @@ class Dataset:
     # consumption
     # ------------------------------------------------------------------
     def num_blocks(self) -> int:
-        return len(self._blocks)
+        return len(self._inputs)
 
     # -- writes (reference Dataset.write_csv/json/parquet/numpy) -------
     def _write_blocks(self, path: str, writer, extension: str) -> List[str]:
@@ -619,9 +688,37 @@ class Dataset:
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
                      drop_last: bool = False,
-                     prefetch_blocks: int = 1) -> Iterator[Any]:
+                     prefetch_blocks: int = 1,
+                     streaming: bool = False,
+                     prefetch_batches: Optional[int] = None
+                     ) -> Iterator[Any]:
         """Stream batches; prefetches the next block's get while the
-        current one is consumed (parity: dataset.py iter_batches)."""
+        current one is consumed (parity: dataset.py iter_batches).
+
+        ``streaming=True`` executes through the pull-based streaming
+        engine instead (docs/data.md): reads + fused maps are admitted
+        lazily inside a bounded in-flight window with backpressure, so
+        iterating a dataset larger than the arena never front-loads it;
+        ``prefetch_batches`` (default ``streaming_prefetch_batches``)
+        assembles batches ahead of the consumer on a prefetch thread."""
+        if streaming:
+            from ray_tpu.data import streaming as _streaming
+
+            return _streaming.maybe_prefetch(
+                _streaming.iter_batches_over_blocks(
+                    self._stream_block_iter(),
+                    batch_size=batch_size, batch_format=batch_format,
+                    drop_last=drop_last),
+                prefetch_batches)
+        if self._shuffle is not None:
+            return self.materialize().iter_batches(
+                batch_size=batch_size, batch_format=batch_format,
+                drop_last=drop_last, prefetch_blocks=prefetch_blocks)
+        return self._iter_batches_batchmode(batch_size, batch_format,
+                                            drop_last, prefetch_blocks)
+
+    def _iter_batches_batchmode(self, batch_size, batch_format, drop_last,
+                                prefetch_blocks) -> Iterator[Any]:
         blocks = self._executed_blocks()
         carry: Optional[Block] = None
         it = iter(blocks)
@@ -640,7 +737,9 @@ class Dataset:
             n = acc.num_rows()
             bs = batch_size or n
             start = 0
-            while n - start >= bs:
+            # `bs and`: an EMPTY block with batch_size=None yields bs=0
+            # and the unguarded comparison (0 - 0 >= 0) looped forever
+            while bs and n - start >= bs:
                 yield BlockAccessor(acc.slice(start, start + bs)).to_batch(
                     batch_format)
                 start += bs
@@ -755,6 +854,66 @@ class Dataset:
         r = self._agg(lambda a: np.std(a, ddof=1), on)
         return None if r is None else r.item()
 
+    # streaming execution (data/streaming.py — docs/data.md) ----------
+    def _stream_block_iter(self):
+        """Block stream of this dataset's plan under the streaming
+        engine (reads admitted lazily, bounded in-flight window)."""
+        from ray_tpu.data import streaming as _streaming
+
+        if self._shuffle is not None:
+            return _streaming.StreamingShuffle(
+                self._stream_inputs(), self._stages,
+                seed=self._shuffle.get("seed"),
+                num_reducers=self._shuffle.get("num_blocks")
+                or self.num_blocks() or 1).iter_blocks()
+        return _streaming.StreamingExecutor(
+            self._stream_inputs(), self._stages).iter_blocks()
+
+    def streaming_shuffle(self, *, seed: Optional[int] = None,
+                          num_blocks: Optional[int] = None) -> "Dataset":
+        """Mark a full random shuffle to run inside the streaming
+        engine: the partition side streams with the bounded in-flight
+        budget, intermediates ride the raylet's spill tier past the
+        arena, and reduce outputs are pulled lazily by the consumer.
+        Batch consumption (``count``/``materialize``/...) resolves the
+        marker through the eager ``random_shuffle`` — same result set,
+        different execution discipline."""
+        return Dataset(self._source, self._stages,
+                       shuffle={"seed": seed, "num_blocks": num_blocks})
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints: Optional[List[Any]] = None
+                        ) -> List[Any]:
+        """Split into ``n`` per-rank :class:`StreamShard` iterators
+        (parity: reference ``Dataset.streaming_split``).  Shards
+        partition blocks round-robin and are picklable: each rank's
+        shard submits its own read/map tasks when consumed, so block
+        production is owned by (and node-local to) the consumer, and
+        its ``iter_batches`` prefetches the next batch while the
+        current step runs.  A pending ``streaming_shuffle`` shuffles
+        within each shard.  ``locality_hints`` optionally pins shard i's
+        map tasks to a node (hex node id) with a soft affinity.
+
+        Streaming split is block-granular: ``equal=True`` (exact row
+        balance, which needs a barrier) is not supported — use
+        ``split(n, equal=True)`` for the materializing path."""
+        from ray_tpu.data.streaming import StreamShard
+
+        if equal:
+            raise ValueError(
+                "streaming_split is block-granular; use "
+                "split(n, equal=True) for exact row balance")
+        if locality_hints is not None and len(locality_hints) != n:
+            raise ValueError("locality_hints must have one entry per shard")
+        parts: List[List[Any]] = [[] for _ in range(n)]
+        for i, inp in enumerate(self._stream_inputs()):
+            parts[i % n].append(inp)
+        return [
+            StreamShard(parts[i], self._stages, shuffle=self._shuffle,
+                        locality_node=(locality_hints[i]
+                                       if locality_hints else None))
+            for i in range(n)]
+
     # pipeline --------------------------------------------------------
     def window(self, *, blocks_per_window: int = 10) -> "DatasetPipeline":
         from ray_tpu.data.dataset_pipeline import DatasetPipeline
@@ -768,8 +927,13 @@ class Dataset:
         from ray_tpu.data.dataset_pipeline import DatasetPipeline
 
         ds = self.materialize()
-        return DatasetPipeline([ds] * times if times else None,
-                               infinite_source=None if times else ds)
+        if times:
+            # fresh per-epoch views (shared blocks, private stage
+            # state): per-window transforms applied while consuming one
+            # epoch can never stack into the next
+            return DatasetPipeline([Dataset(ds._source)
+                                    for _ in range(times)])
+        return DatasetPipeline(None, infinite_source=ds)
 
     def __repr__(self) -> str:
         return (f"Dataset(num_blocks={self.num_blocks()}, "

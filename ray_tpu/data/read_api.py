@@ -16,6 +16,23 @@ import numpy as np
 import ray_tpu
 from ray_tpu.data.block import Block, build_block
 from ray_tpu.data.dataset import Dataset
+from ray_tpu.util import failpoint as _fp
+
+
+def _read_failpoint() -> None:
+    """Shared fault-injection site of every file/partition read task
+    (``data.read.fail`` — docs/fault_injection.md): ``kill`` here dies
+    mid-read and rides the task-retry machinery like any worker crash
+    (the chaos tests assert exactly-once block production); ``raise``
+    surfaces as a task error to the consumer (fail-fast)."""
+    _fp.failpoint("data.read.fail")
+
+
+def _lazy(task, *args):
+    """Lazy read input: the task is submitted only when the consumer's
+    window (or a batch consumer) reaches this block — the streaming
+    engine's pull handle (see ``data/streaming.py``)."""
+    return lambda: task.remote(*args)
 
 
 def _expand_paths(paths: Union[str, List[str]], suffix: str) -> List[str]:
@@ -37,6 +54,7 @@ def _expand_paths(paths: Union[str, List[str]], suffix: str) -> List[str]:
 @ray_tpu.remote
 def _read_csv_file(path: str, kwargs: Dict[str, Any]) -> Block:
     import pandas as pd
+    _read_failpoint()
 
     df = pd.read_csv(path, **kwargs)
     return {str(c): df[c].to_numpy() for c in df.columns}
@@ -44,6 +62,7 @@ def _read_csv_file(path: str, kwargs: Dict[str, Any]) -> Block:
 
 @ray_tpu.remote
 def _read_json_file(path: str) -> Block:
+    _read_failpoint()
     rows = []
     with open(path) as f:
         for line in f:
@@ -55,6 +74,7 @@ def _read_json_file(path: str) -> Block:
 
 @ray_tpu.remote
 def _read_numpy_file(path: str) -> Block:
+    _read_failpoint()
     return {"data": np.load(path)}
 
 
@@ -64,11 +84,14 @@ def _read_parquet_file(path: str, kwargs: Dict[str, Any]) -> Block:
     # block travels the object plane with out-of-band buffers (zero-copy)
     import pyarrow.parquet as pq
 
+    _read_failpoint()
+
     return pq.read_table(path, **kwargs)
 
 
 @ray_tpu.remote
 def _range_block(start: int, stop: int, tensor_shape: Optional[tuple]) -> Block:
+    _read_failpoint()
     arr = np.arange(start, stop)
     if tensor_shape:
         arr = np.stack([np.full(tensor_shape, i) for i in arr])
@@ -80,8 +103,8 @@ _py_range = __import__("builtins").range
 
 def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
     parallelism = max(1, min(parallelism, n or 1))
-    per = (n + parallelism - 1) // parallelism
-    blocks = [_range_block.remote(s, min(s + per, n), None)
+    per = max(1, (n + parallelism - 1) // parallelism)
+    blocks = [_lazy(_range_block, s, min(s + per, n), None)
               for s in _py_range(0, n, per)]
     return Dataset(blocks)
 
@@ -89,8 +112,8 @@ def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
 def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = 8
                  ) -> Dataset:
     parallelism = max(1, min(parallelism, n or 1))
-    per = (n + parallelism - 1) // parallelism
-    blocks = [_range_block.remote(s, min(s + per, n), shape)
+    per = max(1, (n + parallelism - 1) // parallelism)
+    blocks = [_lazy(_range_block, s, min(s + per, n), shape)
               for s in _py_range(0, n, per)]
     return Dataset(blocks)
 
@@ -98,7 +121,7 @@ def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = 8
 def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
     items = list(items)
     parallelism = max(1, min(parallelism, len(items) or 1))
-    per = (len(items) + parallelism - 1) // parallelism
+    per = max(1, (len(items) + parallelism - 1) // parallelism)
     blocks = [ray_tpu.put(build_block(items[i:i + per]))
               for i in _py_range(0, len(items), per)]
     return Dataset(blocks)
@@ -122,26 +145,27 @@ def from_pandas(dfs) -> Dataset:
 
 def read_csv(paths: Union[str, List[str]], **kwargs) -> Dataset:
     files = _expand_paths(paths, ".csv")
-    return Dataset([_read_csv_file.remote(p, kwargs) for p in files])
+    return Dataset([_lazy(_read_csv_file, p, kwargs) for p in files])
 
 
 def read_json(paths: Union[str, List[str]], **kwargs) -> Dataset:
     files = _expand_paths(paths, ".json")
-    return Dataset([_read_json_file.remote(p) for p in files])
+    return Dataset([_lazy(_read_json_file, p) for p in files])
 
 
 def read_numpy(paths: Union[str, List[str]], **kwargs) -> Dataset:
     files = _expand_paths(paths, ".npy")
-    return Dataset([_read_numpy_file.remote(p) for p in files])
+    return Dataset([_lazy(_read_numpy_file, p) for p in files])
 
 
 def read_parquet(paths: Union[str, List[str]], **kwargs) -> Dataset:
     files = _expand_paths(paths, ".parquet")
-    return Dataset([_read_parquet_file.remote(p, kwargs) for p in files])
+    return Dataset([_lazy(_read_parquet_file, p, kwargs) for p in files])
 
 
 @ray_tpu.remote
 def _read_text_file(path: str, encoding: str, drop_empty: bool) -> Block:
+    _read_failpoint()
     with open(path, encoding=encoding) as f:
         lines = [ln.rstrip("\r\n") for ln in f]
     if drop_empty:
@@ -153,7 +177,7 @@ def read_text(paths: Union[str, List[str]], *, encoding: str = "utf-8",
               drop_empty_lines: bool = False) -> Dataset:
     """One row per line (reference ``read_text``)."""
     files = _expand_paths(paths, ".txt")
-    return Dataset([_read_text_file.remote(p, encoding, drop_empty_lines)
+    return Dataset([_lazy(_read_text_file, p, encoding, drop_empty_lines)
                     for p in files])
 
 
@@ -290,7 +314,7 @@ def read_tfrecords(paths: Union[str, List[str]], **kwargs) -> Dataset:
     """TFRecord files of tf.train.Example protos → one row per record
     (parity: ``read_tfrecords``)."""
     files = _expand_paths(paths, ".tfrecords")
-    return Dataset([_read_tfrecord_file.remote(p) for p in files])
+    return Dataset([_lazy(_read_tfrecord_file, p) for p in files])
 
 
 @ray_tpu.remote
@@ -310,7 +334,7 @@ def read_images(paths: Union[str, List[str]], *, size=None, mode=None,
     """Image files → rows of {"image": HWC array, "path"} (parity:
     ``read_images`` / image_datasource.py)."""
     files = _expand_paths(paths, "")
-    return Dataset([_read_image_file.remote(p, size, mode) for p in files])
+    return Dataset([_lazy(_read_image_file, p, size, mode) for p in files])
 
 
 def from_huggingface(dataset) -> Dataset:

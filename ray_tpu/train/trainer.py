@@ -194,12 +194,29 @@ class JaxTrainer:
             group.shutdown()
 
     def _shard_datasets(self) -> Optional[List[Any]]:
+        """Per-rank dataset shards.  ray_tpu Datasets shard through
+        ``streaming_split`` when streaming ingest is on (DataContext.
+        streaming_train_ingest): each rank gets a picklable StreamShard
+        whose read/map tasks are submitted BY that rank as it iterates
+        — blocks are produced node-local to their consumer, admission
+        is bounded by the streaming budget, and the shard's prefetch
+        thread assembles the next batch while the step runs (docs/
+        data.md).  Off (default), the old materialize-then-split path."""
         if not self.datasets:
             return None
         n = self.scaling_config.num_workers
+        try:
+            from ray_tpu.data.context import DataContext
+            streaming_ingest = bool(
+                DataContext.get_current().streaming_train_ingest)
+        except Exception:  # noqa: BLE001 — data layer absent/stubbed
+            streaming_ingest = False
         shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, dataset in self.datasets.items():
-            if hasattr(dataset, "shard"):  # huggingface datasets API
+            if streaming_ingest and callable(
+                    getattr(dataset, "streaming_split", None)):
+                parts = dataset.streaming_split(n)
+            elif hasattr(dataset, "shard"):  # huggingface datasets API
                 parts = [dataset.shard(num_shards=n, index=i)
                          for i in range(n)]
             elif callable(getattr(dataset, "split", None)):

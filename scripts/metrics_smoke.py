@@ -64,6 +64,14 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_store_restored_bytes_total",
     "ray_tpu_store_spill_objects",
     "ray_tpu_store_shard_contention_total",
+    # streaming data plane: series only appear once a streaming dataset
+    # executes (and locality routing needs multi-node block placement)
+    "ray_tpu_data_blocks_in_flight",
+    "ray_tpu_data_backpressure_stalls_total",
+    "ray_tpu_data_blocks_produced_total",
+    "ray_tpu_data_prefetch_total",
+    "ray_tpu_data_shuffle_spilled_bytes_total",
+    "ray_tpu_sched_locality_leases_total",
     # profiler series: the sampler is off by default (profiler_enabled /
     # `ray-tpu profile` arm it), so a quiet boot exports none of them
     "ray_tpu_profiler_samples_total",

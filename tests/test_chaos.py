@@ -14,6 +14,11 @@ from ray_tpu.cluster_utils import Cluster
 
 @ray_tpu.remote(max_retries=5)
 def chunk_sum(seed, n):
+    # floor on task duration: on a warm host the whole fan-out used to
+    # finish before the killer's first interval elapsed, and the test
+    # failed with "chaos did not actually kill any node" — the kills
+    # must land MID-flight to test anything
+    time.sleep(0.2)
     rng = np.random.default_rng(seed)
     data = rng.integers(0, 100, size=n)
     return int(data.sum())
@@ -33,7 +38,7 @@ def test_tasks_survive_node_kills(chaos_cluster):
         0, 100, size=20_000).sum()) for s in range(24)]
     expected = sum(rng_sums)
 
-    killer = NodeKiller(chaos_cluster, kill_interval_s=0.8,
+    killer = NodeKiller(chaos_cluster, kill_interval_s=0.25,
                         max_kills=2, seed=7).start()
     try:
         parts = [chunk_sum.remote(s, 20_000) for s in range(24)]

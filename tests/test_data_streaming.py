@@ -1,0 +1,537 @@
+"""Streaming data plane semantics (ray_tpu/data/streaming.py — docs/data.md).
+
+Covers the issue's contract: bounded in-flight budget under a slow
+consumer, backpressure release on consumption, arena-pressure stalls,
+locality hints reaching the scheduler (2-node), shuffle-spill roundtrip
+byte-identity, ordered vs unordered iteration, empty/single-block
+datasets, pipeline repeat/split laziness, async spill-ahead, trainer
+streaming ingest, and the exactly-once chaos cases (map worker SIGKILL
+mid-stream) wired into ``make chaos``.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.streaming import StreamingExecutor, _ArenaProbe
+
+
+def _mk_inputs(n_blocks, rows_per_block):
+    """Plain ref inputs: one table block per ref."""
+    return [ray_tpu.put({"id": np.arange(i * rows_per_block,
+                                         (i + 1) * rows_per_block)})
+            for i in range(n_blocks)]
+
+
+def _ids_of(block):
+    return list(np.asarray(block["id"]).tolist())
+
+
+# ---------------------------------------------------------------------------
+# executor semantics (shared module cluster)
+# ---------------------------------------------------------------------------
+def test_bounded_in_flight_budget(ray_start_regular):
+    """A slow consumer must cap the window at the budget — blocks
+    executing + produced-but-unconsumed never exceed it."""
+    inputs = _mk_inputs(12, 4)
+    ex = StreamingExecutor(inputs, [("x2", lambda b: {"id": b["id"] * 2})],
+                           budget=3)
+    seen = []
+    for ref, meta in ex.iter_blocks():
+        time.sleep(0.05)  # slow consumer: the producer must stall
+        seen.extend(_ids_of(ray_tpu.get(ref)))
+    assert ex.max_observed_in_flight <= 3
+    assert sorted(seen) == [2 * i for i in range(48)]
+    # the ready queue filled while the consumer slept: consumer-lag
+    # backpressure must have been observed at least once
+    assert ex.stall_counts["consumer"] >= 1
+
+
+def test_backpressure_releases_on_consumption(ray_start_regular):
+    """Despite stalls, consumption drains the whole dataset — every
+    block is produced exactly once and admission resumes after each
+    pop."""
+    inputs = _mk_inputs(10, 8)
+    ex = StreamingExecutor(inputs, [("id", lambda b: b)], budget=2)
+    blocks = list(ex.iter_blocks())
+    assert len(blocks) == 10
+    ids = []
+    for ref, meta in blocks:
+        ids.extend(_ids_of(ray_tpu.get(ref)))
+        assert meta is not None and meta["rows"] == 8
+    assert sorted(ids) == list(range(80))
+
+
+def test_arena_pressure_stalls_admission(ray_start_regular, monkeypatch):
+    """Above the arena watermark the executor keeps exactly ONE block
+    in flight (progress guaranteed, arena protected); pressure
+    relief resumes full-window admission."""
+    calls = {"n": 0}
+
+    def fake_fraction(self):
+        calls["n"] += 1
+        return 0.99 if calls["n"] < 6 else 0.0
+
+    monkeypatch.setattr(_ArenaProbe, "used_fraction", fake_fraction)
+    monkeypatch.setattr(_ArenaProbe, "__init__",
+                        lambda self, interval_s: None)
+    inputs = _mk_inputs(8, 4)
+    ex = StreamingExecutor(inputs, [("id", lambda b: b)], budget=4)
+    it = ex.iter_blocks()
+    first = next(it)  # under pressure: only the guaranteed block ran
+    assert ex.stall_counts["arena"] >= 1
+    rest = list(it)
+    assert len(rest) == 7  # relief: the window reopened and drained
+    ids = []
+    for ref, _ in [first] + rest:
+        ids.extend(_ids_of(ray_tpu.get(ref)))
+    assert sorted(ids) == list(range(32))
+
+
+def test_ordered_vs_unordered_iteration(ray_start_regular):
+    ds = rd.range(64, parallelism=8).map_batches(
+        lambda b: {"id": b["id"]})
+    ordered = []
+    for b in ds.iter_batches(batch_size=8, streaming=True,
+                             prefetch_batches=0):
+        ordered.extend(b["id"].tolist())
+    assert ordered == list(range(64))  # input order preserved
+    ctx = DataContext.get_current()
+    ctx.streaming_preserve_order = False
+    try:
+        unordered = []
+        for b in ds.iter_batches(batch_size=8, streaming=True,
+                                 prefetch_batches=0):
+            unordered.extend(b["id"].tolist())
+    finally:
+        ctx.streaming_preserve_order = True
+    assert sorted(unordered) == list(range(64))
+
+
+def test_empty_and_single_block(ray_start_regular):
+    assert list(rd.from_items([]).iter_batches(streaming=True)) == []
+    assert rd.range(0).count() == 0
+    assert list(rd.range(0).iter_batches(streaming=True)) == []
+    single = rd.range(5, parallelism=1)
+    got = []
+    for b in single.iter_batches(batch_size=2, streaming=True):
+        got.extend(b["id"].tolist())
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_streaming_split_covers_all_rows(ray_start_regular):
+    """Shards partition blocks disjointly; each shard's iterator
+    produces its partition exactly once (consumed here in-process,
+    as a train rank would)."""
+    import cloudpickle
+
+    ds = rd.range(60, parallelism=6).map(lambda r: {"id": r["id"] + 100})
+    shards = ds.streaming_split(3)
+    assert len(shards) == 3
+    # shards must survive the pickle hop to a train worker
+    shards = [cloudpickle.loads(cloudpickle.dumps(s)) for s in shards]
+    per_shard = []
+    for s in shards:
+        ids = []
+        for b in s.iter_batches(batch_size=7):
+            ids.extend(b["id"].tolist())
+        per_shard.append(ids)
+    flat = [i for ids in per_shard for i in ids]
+    assert sorted(flat) == list(range(100, 160))
+    assert all(ids for ids in per_shard)
+    with pytest.raises(ValueError):
+        ds.streaming_split(2, equal=True)
+
+
+def test_streaming_shuffle_permutes_and_matches_eager(ray_start_regular):
+    ds = rd.range(80, parallelism=8)
+    sh = ds.streaming_shuffle(seed=11)
+    got = []
+    for b in sh.iter_batches(batch_size=16, streaming=True,
+                             prefetch_batches=0):
+        got.extend(b["id"].tolist())
+    assert sorted(got) == list(range(80))
+    assert got != list(range(80))  # actually shuffled
+    # batch-mode consumption of the same marker resolves eagerly
+    assert sh.count() == 80
+    # transforms must be applied BEFORE the shuffle marker
+    with pytest.raises(ValueError):
+        sh.map(lambda r: r)
+
+
+def test_prefetch_iterator_overlaps(ray_start_regular):
+    """The shard prefetch thread assembles batches ahead: with a slow
+    consumer every batch is already waiting when asked for."""
+    ds = rd.range(40, parallelism=4)
+    got = []
+    it = ds.iter_batches(batch_size=10, streaming=True, prefetch_batches=2)
+    time.sleep(0.5)  # let the prefetch thread fill its queue
+    for b in it:
+        got.extend(b["id"].tolist())
+        time.sleep(0.02)
+    assert sorted(got) == list(range(40))
+
+
+def test_duplicate_input_refs_stream_once_each(ray_start_regular):
+    """ds.union(ds) carries each block ref twice; the stage-free
+    streaming path must yield BOTH occurrences (duplicate refs share
+    one watch entry — they used to collapse and hang ordered mode)."""
+    ds = rd.range(20, parallelism=2)
+    both = ds.union(ds)
+    got = []
+    for b in both.iter_batches(batch_size=10, streaming=True,
+                               prefetch_batches=0):
+        got.extend(b["id"].tolist())
+    assert sorted(got) == sorted(list(range(20)) * 2)
+
+
+def test_streaming_reuses_resolved_reads(ray_start_regular):
+    """A batch consumer resolves the read factories; a later streaming
+    pass must reuse those refs, not re-submit every read task."""
+    ds = rd.range(30, parallelism=3)
+    assert ds.count() == 30  # batch path resolves + caches
+    refs_before = list(ds._source.refs)
+    got = []
+    for b in ds.iter_batches(batch_size=10, streaming=True,
+                             prefetch_batches=0):
+        got.extend(b["id"].tolist())
+    assert sorted(got) == list(range(30))
+    assert ds._source.refs == refs_before  # same refs, no re-read
+
+
+def test_prefetch_error_then_stopiteration(ray_start_regular):
+    """A consumer that catches a forwarded iterator error and calls
+    next() again must see StopIteration, never hang."""
+    from ray_tpu.data.streaming import _PrefetchIterator
+
+    def boom():
+        yield {"id": np.arange(3)}
+        raise RuntimeError("source died")
+
+    it = _PrefetchIterator(boom(), depth=2)
+    assert next(it)["id"].tolist() == [0, 1, 2]
+    with pytest.raises(RuntimeError):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+# ---------------------------------------------------------------------------
+# pipeline regression fixes (satellite)
+# ---------------------------------------------------------------------------
+def test_pipeline_repeat_no_transform_stacking(ray_start_regular):
+    """Per-window transforms applied while consuming epoch 1 must not
+    stack into epoch 2 — each epoch sees fresh window views."""
+    pipe = rd.range(10, parallelism=2).repeat(3).map(
+        lambda r: {"id": r["id"] + 1})
+    vals = [r["id"] for r in pipe.iter_rows()]
+    assert len(vals) == 30
+    # +1 applied exactly once per epoch (stacking would give +2/+3)
+    assert sorted(set(vals)) == list(range(1, 11))
+    assert sorted(vals) == sorted(list(range(1, 11)) * 3)
+
+
+def test_pipeline_infinite_repeat_multi_window(ray_start_regular):
+    """repeat(None) of a multi-window pipeline cycles forever (it used
+    to silently yield NOTHING for >1 window)."""
+    pipe = rd.range(8, parallelism=2).window(blocks_per_window=1).repeat()
+    rows = []
+    for r in pipe.iter_rows():
+        rows.append(r["id"])
+        if len(rows) >= 20:
+            break
+    assert len(rows) == 20  # kept producing past one epoch
+
+
+def test_pipeline_split_is_lazy(ray_start_regular):
+    """split() must advance the parent one window at a time, on demand
+    (it used to materialize every window of every shard up front)."""
+    applied = []
+
+    def tag(ds):
+        applied.append(1)
+        return ds
+
+    pipe = rd.range(40, parallelism=4).window(
+        blocks_per_window=1).foreach_window(tag)
+    shards = pipe.split(2)
+    assert applied == []  # nothing consumed yet -> nothing executed
+    iters = [s.iter_datasets() for s in shards]
+    next(iters[0])
+    assert len(applied) == 1  # exactly one window materialized
+    next(iters[1])
+    assert len(applied) == 1  # shard 1 read it from the buffer
+    next(iters[0])
+    assert len(applied) == 2
+
+
+def test_split_shard_repeat_yields_every_epoch(ray_start_regular):
+    """repeat() after a lazy split() must still produce k epochs (the
+    source-driven pipeline used to silently no-op the repeat)."""
+    # 4 blocks of 3 rows -> 2 windows of 2 blocks; a 2-way split gives
+    # each shard one block (3 rows) per window = 6 rows per epoch
+    pipe = rd.range(12, parallelism=4).window(blocks_per_window=2)
+    shard = pipe.split(2)[0].repeat(3)
+    rows = [int(r["id"]) for r in shard.iter_rows()]
+    assert len(rows) == 18
+    epoch = rows[:6]
+    assert rows == epoch * 3  # 3 identical epochs
+
+
+def test_foreach_window_lazy_per_epoch(ray_start_regular):
+    """foreach_window runs when the consumer reaches the window — once
+    per window per epoch, never eagerly."""
+    count = {"n": 0}
+
+    def bump(ds):
+        count["n"] += 1
+        return ds
+
+    pipe = rd.range(6, parallelism=2).window(
+        blocks_per_window=1).foreach_window(bump).repeat(2)
+    assert count["n"] == 0
+    total = sum(1 for _ in pipe.iter_rows())
+    assert total == 12
+    assert count["n"] == 4  # 2 windows x 2 epochs
+
+
+# ---------------------------------------------------------------------------
+# multi-node: locality + spill (own clusters; slow set / make chaos)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_locality_hints_reach_scheduler():
+    """A DEFAULT-strategy task whose plasma arg lives on node B must
+    lease (and execute) on node B — the owner routes its lease request
+    to the raylet named by the arg's location."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core import worker as worker_mod
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes()
+    try:
+        my_node = ray_tpu.get_runtime_context().get_node_id()
+        from ray_tpu.experimental.state.api import list_nodes
+        other = [n for n in list_nodes()
+                 if n["state"] == "ALIVE" and n["node_id"] != my_node]
+        assert other, "second node missing"
+        node_b = other[0]["node_id"]
+
+        @ray_tpu.remote(num_returns=2)
+        def make_block():
+            import numpy as _np
+
+            import ray_tpu as _rt
+            return (_rt.get_runtime_context().get_node_id(),
+                    {"data": _np.ones(512 * 1024, dtype=_np.uint8)})
+
+        # explicit soft NODE_AFFINITY task routing (the shard-pin path).
+        # The big block ref is never get() on the driver — a get would
+        # pull a local copy and locality would (correctly) stay local.
+        node_ref, ref = make_block.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node_b, soft=True)).remote()
+        produced = ray_tpu.get(node_ref, timeout=60)
+        assert produced == node_b, "node-affinity task ran off-target"
+
+        # wait for the owner to learn the block's location
+        core = worker_mod.global_worker()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            info = core.reference_counter.get(ref.id())
+            if info is not None and info.locations:
+                break
+            time.sleep(0.1)
+        info = core.reference_counter.get(ref.id())
+        assert info is not None and info.locations
+
+        @ray_tpu.remote
+        def where(block):
+            import ray_tpu as _rt
+            return _rt.get_runtime_context().get_node_id()
+
+        # DEFAULT strategy: locality must route the map task to node B
+        ran_on = ray_tpu.get(where.remote(ref), timeout=60)
+        assert ran_on == node_b, (
+            f"map task ran on {ran_on}, input block lives on {node_b}")
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.slow
+def test_shuffle_spill_roundtrip_byte_identical(shutdown_only):
+    """Streaming shuffle whose working set exceeds the arena: the
+    intermediates ride the spill tier (spill-ahead keeps it off the
+    create path) and every row survives byte-identically."""
+    arena = 64 * 1024 * 1024
+    ray_tpu.init(num_cpus=2, _system_config={
+        "object_store_memory": arena,
+        "object_spill_threshold": 0.8,
+        "object_spill_ahead_watermark": 0.5,
+        "num_prestart_workers": 1,
+    })
+    rows_per_block, n_blocks = 2_000_000, 6  # 6 x 16 MiB > 0.8 * arena
+    blocks = []
+    rng_base = 0
+    for i in range(n_blocks):
+        blocks.append(ray_tpu.put({
+            "v": np.arange(rng_base, rng_base + rows_per_block,
+                           dtype=np.int64)}))
+        rng_base += rows_per_block
+    ds = rd.Dataset(blocks).streaming_shuffle(seed=3, num_blocks=n_blocks)
+    csum = 0
+    total_rows = 0
+    mins, maxs = [], []
+    for b in ds.iter_batches(batch_size=None, streaming=True,
+                             prefetch_batches=0):
+        arr = np.asarray(b["v"])
+        csum += int(arr.sum())
+        total_rows += len(arr)
+        mins.append(int(arr.min()))
+        maxs.append(int(arr.max()))
+    n = n_blocks * rows_per_block
+    assert total_rows == n
+    assert csum == n * (n - 1) // 2  # exact content preserved
+    # the put phase crossed the spill threshold (96 MiB of live refs vs
+    # the 51 MiB line) and the input refs are still held, so their
+    # spilled entries must be resident in the tier
+    from ray_tpu.experimental.state import object_store_stats
+    stats = object_store_stats()[0]
+    assert stats.get("num_spilled", 0) > 0, stats
+
+
+@pytest.mark.slow
+def test_async_spill_ahead_off_create_path(shutdown_only):
+    """Crossing object_spill_ahead_watermark (but NOT the create-path
+    threshold) must trigger background spilling within a tick."""
+    arena = 32 * 1024 * 1024
+    ray_tpu.init(num_cpus=1, _system_config={
+        "object_store_memory": arena,
+        "object_spill_threshold": 0.95,
+        "object_spill_ahead_watermark": 0.4,
+        "num_prestart_workers": 0,
+    })
+    refs = [ray_tpu.put(np.ones(6 * 1024 * 1024, dtype=np.uint8))
+            for _ in range(3)]  # ~18 MiB = 56% used: above 0.4, below 0.95
+    from ray_tpu.experimental.state import object_store_stats
+    deadline = time.monotonic() + 15
+    spilled = 0
+    while time.monotonic() < deadline:
+        stats = object_store_stats()[0]
+        spilled = stats.get("num_spilled", 0)
+        if spilled:
+            break
+        time.sleep(0.3)
+    assert spilled > 0, "spill-ahead never ran despite crossing watermark"
+    # spilled objects restore transparently, byte-identical
+    for ref in refs:
+        arr = np.asarray(ray_tpu.get(ref, timeout=60))
+        assert arr.sum() == 6 * 1024 * 1024
+
+
+@pytest.mark.slow
+def test_trainer_streaming_ingest(shutdown_only):
+    """JaxTrainer shards a ray Dataset via streaming_split: each rank
+    consumes a disjoint partition through its prefetching shard
+    iterator and the union covers the dataset exactly once."""
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    from ray_tpu.train import JaxTrainer, ScalingConfig, session
+
+    ctx = DataContext.get_current()
+    ctx.streaming_train_ingest = True
+    try:
+        def loop(config):
+            shard = session.get_dataset_shard("train")
+            ids = []
+            for b in shard.iter_batches(batch_size=8):
+                ids.extend(int(x) for x in b["id"])
+            session.report({"ids": ids,
+                            "rank": session.get_world_rank()})
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            datasets={"train": rd.range(64, parallelism=8)})
+        result = trainer.fit()
+        assert result.error is None, result.error
+        by_rank = {}
+        for m in result.metrics_history:
+            by_rank[m.get("rank")] = m["ids"]
+        all_ids = [i for ids in by_rank.values() for i in ids]
+        # rank 0's metrics reach history; collect both via the report
+        # stream when present, else at least assert rank coverage
+        if len(by_rank) == 2:
+            assert sorted(all_ids) == list(range(64))
+        else:
+            assert sorted(set(all_ids)) == sorted(all_ids)
+            assert len(all_ids) == 32  # one rank's disjoint half
+    finally:
+        ctx.streaming_train_ingest = False
+
+
+# ---------------------------------------------------------------------------
+# chaos: exactly-once under injected faults (make chaos)
+# ---------------------------------------------------------------------------
+@pytest.mark.failpoints
+@pytest.mark.slow
+def test_chaos_map_worker_sigkill_exactly_once():
+    """SIGKILL a map worker mid-stream (data.block.transform_fail=kill):
+    the epoch completes and every block lands exactly once — the
+    retried task regenerates the same return objects, never a dup."""
+    from ray_tpu.util import failpoint as fp
+
+    # skip=3: a worker SIGKILLs itself on its 4th map task (count=1 is
+    # per process, so each replacement worker also dies once mid-run —
+    # sustained churn, not a single blip); the task retry budget rides
+    # through it and the output multiset must still be exact
+    os.environ["RAY_TPU_FAILPOINTS"] = \
+        "data.block.transform_fail=kill:count=1,skip=3"
+    fp.reload_env()
+    try:
+        ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024,
+                     _system_config={"default_max_task_retries": 8})
+        ds = rd.range(48, parallelism=12).map_batches(
+            lambda b: {"id": b["id"] * 3})
+        got = []
+        for b in ds.iter_batches(batch_size=8, streaming=True,
+                                 prefetch_batches=0):
+            got.extend(b["id"].tolist())
+        assert sorted(got) == [3 * i for i in range(48)], (
+            "blocks lost or duplicated across the worker kill")
+    finally:
+        os.environ.pop("RAY_TPU_FAILPOINTS", None)
+        fp.reload_env()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.failpoints
+@pytest.mark.slow
+def test_chaos_read_worker_sigkill_exactly_once():
+    """Same discipline on the read side (data.read.fail=kill): a read
+    task's worker dies mid-read; the lazy factory's task retries and
+    the stream still yields every block exactly once."""
+    from ray_tpu.util import failpoint as fp
+
+    os.environ["RAY_TPU_FAILPOINTS"] = "data.read.fail=kill:count=1,skip=3"
+    fp.reload_env()
+    try:
+        ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024,
+                     _system_config={"default_max_task_retries": 8})
+        got = []
+        for b in rd.range(48, parallelism=12).iter_batches(
+                batch_size=8, streaming=True, prefetch_batches=0):
+            got.extend(b["id"].tolist())
+        assert sorted(got) == list(range(48))
+    finally:
+        os.environ.pop("RAY_TPU_FAILPOINTS", None)
+        fp.reload_env()
+        ray_tpu.shutdown()

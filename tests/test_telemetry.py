@@ -344,13 +344,29 @@ def test_timeline_contains_transfer_spans():
         c.connect()
         c.wait_for_nodes(timeout=120.0)
 
-        @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+        @ray_tpu.remote(num_cpus=1)
         def fetch(refs):
             return ray_tpu.get(refs[0]).nbytes
 
+        # pin the fetchers to the NON-driver node so a cross-node pull
+        # is guaranteed (SPREAD sometimes kept all four local — a stale
+        # load view — and the test flaked with zero transfers; task
+        # NODE_AFFINITY routes to the named node's raylet now)
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+        my_node = ray_tpu.get_runtime_context().get_node_id()
+        other = [n for n in state.list_nodes()
+                 if n["state"] == "ALIVE" and n["node_id"] != my_node]
+        assert other, "second node missing"
+        pin = NodeAffinitySchedulingStrategy(node_id=other[0]["node_id"],
+                                             soft=True)
+
         blob = ray_tpu.put(np.ones(8 * 1024 * 1024, np.uint8))
-        sizes = ray_tpu.get([fetch.remote([blob]) for _ in range(4)],
-                            timeout=120)
+        sizes = ray_tpu.get(
+            [fetch.options(scheduling_strategy=pin).remote([blob])
+             for _ in range(4)],
+            timeout=120)
         assert all(s == 8 * 1024 * 1024 for s in sizes)
 
         # the puller raylet flushes its span within ~2 flush periods
