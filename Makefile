@@ -7,8 +7,8 @@
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
 .PHONY: all native check test chaos bench bench-transfer bench-serve \
-	bench-rl bench-controlplane bench-store bench-ha bench-data \
-	metrics-smoke tsan asan sanitize clean
+	bench-serve-sharded bench-rl bench-controlplane bench-store \
+	bench-ha bench-data metrics-smoke tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -39,6 +39,7 @@ chaos: native
 	PYTHONHASHSEED=0 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_failpoints.py tests/test_chaos.py \
 	  tests/test_object_transfer.py tests/test_serve_batching.py \
+	  tests/test_serve_sharded.py \
 	  tests/test_tracing.py tests/test_rllib_pipeline.py \
 	  tests/test_controlplane_scale.py tests/test_store_scale.py \
 	  tests/test_gcs_ha.py tests/test_data_streaming.py \
@@ -62,6 +63,14 @@ bench-transfer: native
 # off; one-line JSON delta vs the newest BENCH_r*.json serve rows.
 bench-serve: native
 	JAX_PLATFORMS=cpu python scripts/bench_serve.py
+
+# Sharded-serving bench: gang-replica QPS/chip vs single-chip at equal
+# per-chip batch, decode-step latency vs shard count 1/2/4, KV page
+# occupancy, and prefill/decode disaggregation (short-request p99
+# under a long-prompt barrage, unified vs disaggregated); one-line
+# JSON delta vs the newest BENCH_r*.json rows (docs/serving.md).
+bench-serve-sharded: native
+	JAX_PLATFORMS=cpu python scripts/bench_serve_sharded.py
 
 # RL-pipeline bench: decoupled PPO (env actors + centralized batched
 # inference) vs the legacy fleet, with both worker-count scaling

@@ -1145,6 +1145,19 @@ def main() -> None:
                                     if a != "--serve"]
         bench_serve.main()
         return
+    if "--serve-sharded" in sys.argv[1:]:
+        # sharded-serving bench (gang QPS/chip vs single chip, step
+        # latency vs shard count, KV paging, prefill/decode
+        # disaggregation) with a one-line JSON delta — same entry
+        # `make bench-serve-sharded` uses
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_serve_sharded
+
+        sys.argv = [sys.argv[0]] + [a for a in sys.argv[1:]
+                                    if a != "--serve-sharded"]
+        bench_serve_sharded.main()
+        return
     if "--controlplane" in sys.argv[1:]:
         # control-plane microbench (actor storm churn, PG churn, lease
         # p99 flatness + the many_actors row) with a one-line JSON

@@ -46,6 +46,7 @@ logger = logging.getLogger(__name__)
 
 _init_lock = threading.Lock()
 _head_proc: Optional[subprocess.Popen] = None
+_head_supervisor = None
 _owns_head = False
 
 
@@ -126,6 +127,21 @@ def init(address: Optional[str] = None, *,
                 config, session_dir, res or None,
                 die_with_parent=node_mod.safe_die_with_parent())
             _owns_head = True
+            if getattr(config, "gcs_auto_respawn", False):
+                # monitor the head: an unexpected GCS death respawns it
+                # on the same port/session and the HA recovery path
+                # (snapshot + WAL replay, client reconnect) takes over
+                from ray_tpu.core.supervisor import HeadSupervisor
+
+                def _swap_head(proc, _handshake):
+                    global _head_proc
+                    _head_proc = proc
+
+                global _head_supervisor
+                _head_supervisor = HeadSupervisor(
+                    config, session_dir, res or None, _head_proc,
+                    gcs_port=handshake["gcs_address"][1],
+                    on_respawn=_swap_head)
         else:
             host, port = address.rsplit(":", 1)
             handshake = _discover_via_gcs((host, int(port)))
@@ -200,8 +216,11 @@ def connection_info() -> Dict[str, Any]:
 
 
 def shutdown() -> None:
-    global _head_proc, _owns_head
+    global _head_proc, _head_supervisor, _owns_head
     with _init_lock:
+        if _head_supervisor is not None:
+            _head_supervisor.stop()  # intentional: never respawn now
+            _head_supervisor = None
         from ray_tpu.util import client as client_mod
         client_mod.disconnect()
         # retire any serve router poll thread bound to this cluster
@@ -255,11 +274,14 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
     return out[0] if single else out
 
 
-def put(value: Any) -> ObjectRef:
+def put(value: Any, *, _force_plasma: bool = False) -> ObjectRef:
+    """``_force_plasma`` (internal) places the object in the shm arena
+    even when small enough for the in-process store — the serve plane's
+    KV pages need arena residency (spill tier, cross-replica pulls)."""
     client = _client_or_none()
     if client is not None:
         return client.put(value)
-    return _worker_mod.global_worker().put(value)
+    return _worker_mod.global_worker().put(value, force_plasma=_force_plasma)
 
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,

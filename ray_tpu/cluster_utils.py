@@ -56,6 +56,23 @@ class Cluster:
         if connect:
             self.connect()
 
+    def supervise_head(self):
+        """Arm a :class:`ray_tpu.core.supervisor.HeadSupervisor` over
+        this cluster's head, as ``init()``-owned clusters get by
+        default: an unexpected head (GCS) death respawns it in place
+        on the same port and the PR-11 recovery path reconverges —
+        the restart the test harness used to perform by hand."""
+        from ray_tpu.core.supervisor import HeadSupervisor
+
+        def _swap(proc, handshake):
+            self.head = ClusterNode(proc, handshake)
+
+        self._supervisor = HeadSupervisor(
+            self.config, self.session_dir, self._head_resources,
+            self.head.proc, gcs_port=self.gcs_address[1],
+            on_respawn=_swap)
+        return self._supervisor
+
     def restart_head(self, wait_s: float = 15.0) -> None:
         """Kill and respawn the head (GCS + head raylet) in place,
         rebinding the SAME GCS port so surviving side-node raylets
@@ -65,13 +82,25 @@ class Cluster:
         import time as _time
 
         gcs_port = self.gcs_address[1]
-        self.head.kill()
-        # the port releases when the process dies; rebind it explicitly
-        proc, handshake = node_mod.spawn_head(
-            self.config, self.session_dir, self._head_resources,
-            gcs_port=gcs_port,
-            die_with_parent=node_mod.safe_die_with_parent())
-        self.head = ClusterNode(proc, handshake)
+        # an armed supervisor must not race this EXPLICIT restart with
+        # its own spawn_head on the same port
+        sup = getattr(self, "_supervisor", None)
+        if sup is not None:
+            sup.suspend()
+        try:
+            self.head.kill()
+            # the port releases when the process dies; rebind it
+            # explicitly
+            proc, handshake = node_mod.spawn_head(
+                self.config, self.session_dir, self._head_resources,
+                gcs_port=gcs_port,
+                die_with_parent=node_mod.safe_die_with_parent())
+            self.head = ClusterNode(proc, handshake)
+            if sup is not None:
+                sup.attach(proc)
+        finally:
+            if sup is not None:
+                sup.resume()
         # wait for the side raylets to re-register
         deadline = _time.monotonic() + wait_s
         import asyncio
@@ -171,6 +200,9 @@ class Cluster:
     def shutdown(self) -> None:
         import ray_tpu
 
+        if getattr(self, "_supervisor", None) is not None:
+            self._supervisor.stop()
+            self._supervisor = None
         ray_tpu.shutdown()
         for node in self.worker_nodes:
             node.terminate()

@@ -277,6 +277,31 @@ class Config:
     #: Attempts for a serve request whose replica died mid-flight
     #: (router re-assigns to a healthy replica between attempts).
     serve_request_retries: int = 3
+    #: Gang bring-up budget for a sharded (num_shards > 1) replica: all
+    #: shards of the gang must report ready within this window or the
+    #: whole gang is killed and retried (all-or-nothing readiness).
+    serve_gang_ready_timeout_s: float = 120.0
+    #: Route KV pages to the plasma (arena) path regardless of size —
+    #: paged KV must live in the shared arena to survive replica
+    #: migration and ride the spill tier.  False = place by size like
+    #: any other object (small pages then stay in the owner's
+    #: in-process store).
+    serve_kv_pages_in_arena: bool = True
+    #: Default page-table budget per replica (pages); a request whose
+    #: page demand would exceed it stays queued until eviction frees
+    #: pages.  Overridable per deployment via batching.kv_max_pages.
+    serve_kv_max_pages: int = 4096
+
+    # ---- head supervision (core/supervisor.py) ---------------------------
+    #: Driver-side monitor for an init()-owned head: when the head
+    #: process (GCS + head raylet) dies unexpectedly, respawn it on the
+    #: same GCS port and session dir so the PR-11 recovery path
+    #: (snapshot+WAL replay, client reconnect backoff) takes over.
+    #: Previously only the test harness performed this restart.
+    gcs_auto_respawn: bool = True
+    #: Max automatic head respawns per driver session (a crash-looping
+    #: GCS must not burn the host forever); 0 = unlimited.
+    gcs_respawn_max: int = 3
 
     # ---- continuous profiling (core/profiler.py) -------------------------
     #: Start every process's sampling profiler at boot (always-on mode).

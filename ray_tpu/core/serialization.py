@@ -205,10 +205,24 @@ def _serialize_buffer_fast(value: Any) -> Optional["SerializedObject"]:
         try:
             if getattr(value, "weak_type", False):
                 return None  # jnp.asarray would strengthen the type
+            if np_mod is None:
+                return None
             devices = value.devices()
-            if len(devices) != 1 or next(iter(devices)).platform != "cpu":
-                return None  # sharded / device-resident: cloudpickle
-            np_view = np_mod.asarray(value) if np_mod is not None else None
+            if len(devices) == 1 \
+                    and next(iter(devices)).platform == "cpu":
+                # single-device CPU: np.asarray aliases the XLA host
+                # buffer — zero copies before the arena write
+                np_view = np_mod.asarray(value)
+            elif getattr(value, "is_fully_addressable", False):
+                # DEVICE (non-CPU) or multi-shard arrays: one DMA/
+                # gather into a host staging array that then rides
+                # out-of-band, instead of the old cloudpickle fallback
+                # (device_get + a second wholesale copy into the pickle
+                # stream).  KV pages and weight shards take this path.
+                np_view = np_mod.ascontiguousarray(
+                    jax_mod.device_get(value))
+            else:
+                return None  # multi-host shards not visible here
         except Exception:  # noqa: BLE001 — any layout oddity: fall back
             return None
         if (np_view is None or np_view.nbytes < _INBAND_LIMIT

@@ -565,6 +565,73 @@ def serve_replicas(deployment: str, n: int) -> None:
            ("deployment",)).set_key(_dkey(deployment), float(n))
 
 
+# -- sharded serving (serve/sharded.py, serve/kv_cache.py) ------------------
+
+def serve_kv_pages(deployment: str, active: int, allocated_total: int,
+                   freed_total: int) -> None:
+    """Paged-KV accounting for one deployment, aggregated across its
+    replicas each controller reconcile tick.  ``active`` pages are
+    pinned arena objects; allocated == freed once a deployment drains
+    (the chaos suite's no-leak invariant)."""
+    if not enabled():
+        return
+    key = _dkey(deployment)
+    _gauge("ray_tpu_serve_kv_pages_active",
+           "live (pinned) KV cache pages in the object-store arena, "
+           "per deployment", ("deployment",)).set_key(key, float(active))
+    _gauge("ray_tpu_serve_kv_pages_allocated_total",
+           "KV cache pages allocated since deployment start",
+           ("deployment",)).set_key(key, float(allocated_total))
+    _gauge("ray_tpu_serve_kv_pages_freed_total",
+           "KV cache pages freed since deployment start",
+           ("deployment",)).set_key(key, float(freed_total))
+
+
+def serve_kv_occupancy(deployment: str, frac: float) -> None:
+    """Fraction of the replica page budget (kv_max_pages) in use —
+    the continuous batcher's admission signal for paged KV."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_serve_kv_page_occupancy",
+           "fraction of the per-replica KV page budget in use",
+           ("deployment",)).set_key(_dkey(deployment), float(frac))
+
+
+def serve_gang_bringup(deployment: str, seconds: float, shards: int) -> None:
+    """Wall time from first gang-member creation to all-shards-ready
+    for one sharded replica (rides the batched registration +
+    pipelined bring-up plane; regressions here multiply into every
+    gang respawn after a shard death)."""
+    if not enabled():
+        return
+    _hist("ray_tpu_serve_gang_bringup_seconds",
+          "sharded-replica gang bring-up latency (create -> all ready)",
+          _LAT_BOUNDS, ("deployment",)).observe_key(
+        _dkey(deployment), seconds)
+    _gauge("ray_tpu_serve_gang_shards",
+           "shards per gang replica of the deployment",
+           ("deployment",)).set_key(_dkey(deployment), float(shards))
+
+
+def serve_gang_death(deployment: str) -> None:
+    """One gang torn down because a shard died (all-or-nothing
+    readiness: the controller respawns the whole gang)."""
+    if not enabled():
+        return
+    _counter("ray_tpu_serve_gang_deaths_total",
+             "sharded-replica gangs killed by a shard death",
+             ("deployment",)).inc_key(_dkey(deployment))
+
+
+def gcs_respawn() -> None:
+    """The head supervisor respawned a died GCS/head process."""
+    if not enabled():
+        return
+    _counter("ray_tpu_gcs_respawns_total",
+             "automatic head (GCS) respawns by the driver-side "
+             "supervisor").inc_key(_EMPTY_KEY)
+
+
 # ---------------------------------------------------------------------------
 # RL pipeline (rllib decoupled acting/learning — docs/rl_pipeline.md)
 # ---------------------------------------------------------------------------

@@ -815,7 +815,11 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # put / get / wait
     # ------------------------------------------------------------------
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, *, force_plasma: bool = False) -> ObjectRef:
+        """``force_plasma`` routes the object to the shared-memory arena
+        even below ``max_direct_call_object_size`` — used by the serve
+        plane's paged KV cache, whose pages must live in the arena
+        (spillable, migratable between replicas) regardless of size."""
         object_id = self._next_put_id()
         ser = serialize(value)
         self.reference_counter.add_owned(object_id)
@@ -824,7 +828,8 @@ class CoreWorker:
         self.reference_counter.set_contained(
             object_id, [r.id() for r in ser.contained_refs])
         ref = ObjectRef(object_id, self.address)
-        if ser.total_size() <= self.config.max_direct_call_object_size:
+        if not force_plasma and \
+                ser.total_size() <= self.config.max_direct_call_object_size:
             self._publish(object_id, ser.to_bytes())
         else:
             self._run(self._put_plasma(object_id, ser))

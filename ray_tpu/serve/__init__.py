@@ -155,8 +155,27 @@ class DeploymentHandle:
                **kwargs) -> ray_tpu.ObjectRef:
         """Fast path: one dispatch to a routed replica; the returned ref
         errors if that replica dies mid-request (use :meth:`call`, or
-        the HTTP ingress, for transparent retry-on-death)."""
+        the HTTP ingress, for transparent retry-on-death).
+
+        On a disaggregated deployment the request CHAINS: the prompt
+        pass dispatches to a prefill replica, and the decode dispatch
+        takes the prefill ref as its argument — still non-blocking,
+        with KV pages travelling between the tiers as object refs."""
         router = _get_router()
+        prefill_name = router.prefill_for(self._name) \
+            if self._method in ("", "__call__") else None
+        if prefill_name is not None:
+            pre_replica, pre_key = router.assign(prefill_name)
+            pre_ref = pre_replica.handle_request.remote(
+                "__prefill__", args, kwargs, deadline_s=_deadline_s,
+                request_id=_request_id)
+            _slot_waiter.add(router, pre_key, pre_ref)
+            replica, key = router.assign(self._name)
+            ref = replica.handle_request.remote(
+                "__decode__", (pre_ref,), {}, deadline_s=_deadline_s,
+                request_id=_request_id)
+            _slot_waiter.add(router, key, ref)
+            return ref
         replica, key = router.assign(self._name)
         ref = replica.handle_request.remote(
             self._method, args, kwargs, deadline_s=_deadline_s,
@@ -173,20 +192,45 @@ class DeploymentHandle:
         from ray_tpu.core.config import get_config
         from ray_tpu.core.exceptions import (ActorDiedError,
                                              WorkerCrashedError)
+        from ray_tpu.serve.batching import RequestPrefillLost
 
         attempts = max(1, int(getattr(get_config(),
                                       "serve_request_retries", 3)))
         router = _get_router()
+        prefill_name = router.prefill_for(self._name) \
+            if self._method in ("", "__call__") else None
         exclude: List[bytes] = []
+        pre_exclude: List[bytes] = []
         last_err: Optional[BaseException] = None
         for _ in range(attempts):
+            method, call_args = self._method, args
+            pre_ref = None
+            if prefill_name is not None:
+                pre_replica, pre_key = router.assign(
+                    prefill_name, exclude=tuple(pre_exclude))
+                pre_ref = pre_replica.handle_request.remote(
+                    "__prefill__", args, kwargs,
+                    deadline_s=_deadline_s)
+                _slot_waiter.add(router, pre_key, pre_ref)
+                method, call_args = "__decode__", (pre_ref,)
             replica, key = router.assign(self._name,
                                          exclude=tuple(exclude))
             ref = replica.handle_request.remote(
-                self._method, args, kwargs, deadline_s=_deadline_s)
+                method, call_args, {} if pre_ref is not None else kwargs,
+                deadline_s=_deadline_s)
             try:
                 return ray_tpu.get(ref, timeout=timeout)
+            except RequestPrefillLost as e:
+                # the prefill result was lost (replica death OR a lost
+                # page object); the decode replica is healthy — exclude
+                # the prefill pick for this request's retries only (a
+                # genuinely dead replica leaves the routing table when
+                # the controller reaps it)
+                last_err = e
+                pre_exclude.append(pre_key[1])
             except (ActorDiedError, WorkerCrashedError) as e:
+                # the decode pick died mid-request; exclude it so the
+                # retry lands on a survivor
                 last_err = e
                 exclude.append(key[1])
                 router.mark_dead(key)
@@ -221,6 +265,8 @@ class Deployment:
                 autoscaling_config: Optional[Dict[str, Any]] = None,
                 batching: Optional[Dict[str, Any]] = None,
                 max_queued_requests: Optional[int] = None,
+                num_shards: Optional[int] = None,
+                prefill_replicas: Optional[int] = None,
                 **_ignored) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=num_replicas if num_replicas is not None
@@ -241,6 +287,11 @@ class Deployment:
             max_queued_requests=max_queued_requests
             if max_queued_requests is not None
             else self.config.max_queued_requests,
+            num_shards=num_shards if num_shards is not None
+            else self.config.num_shards,
+            prefill_replicas=prefill_replicas
+            if prefill_replicas is not None
+            else self.config.prefill_replicas,
         )
         return Deployment(self._target, name or self.name, cfg)
 
@@ -283,6 +334,8 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
                autoscaling_config: Optional[Dict[str, Any]] = None,
                batching: Optional[Dict[str, Any]] = None,
                max_queued_requests: int = -1,
+               num_shards: int = 1,
+               prefill_replicas: int = 0,
                **_ignored):
     """``@serve.deployment`` decorator (parity: serve/api.py).
 
@@ -291,6 +344,13 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
     implement the decode-engine protocol; requests then share an
     in-flight autoregressive batch.  ``max_queued_requests``: ingress
     backlog cap before 429 shedding (-1 = global knob, 0 = unbounded).
+
+    ``num_shards > 1`` makes every replica a GANG of tensor-parallel
+    shard workers (the class must implement the sharded-engine
+    protocol — ``shard_step``/``combine`` + ``rank``/``world`` kwargs;
+    see docs/serving.md).  ``prefill_replicas > 0`` disaggregates the
+    prompt pass onto a dedicated prefill tier that streams finished KV
+    pages to the decode replicas as object refs.
     """
 
     def wrap(target):
@@ -302,6 +362,8 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
             autoscaling_config=autoscaling_config,
             batching=batching,
             max_queued_requests=max_queued_requests,
+            num_shards=num_shards,
+            prefill_replicas=prefill_replicas,
         )
         return Deployment(target, name or target.__name__, cfg)
 
@@ -336,6 +398,33 @@ def status() -> Dict[str, Any]:
 
 def get_deployment_handle(name: str, *_a, **_k) -> DeploymentHandle:
     return DeploymentHandle(name)
+
+
+def warmup(name: str, dataset: Any, *, batch_size: int = 32,
+           method: str = "__call__", max_batches: int = 0,
+           timeout_s: float = 300.0) -> int:
+    """Stream a warmup/eval ``Dataset`` through every routed replica of
+    the deployment (``iter_batches(streaming=True)`` on the replica —
+    the corpus never materializes into the arena).  One parallel
+    fan-out, one bounded wait; returns total batches consumed."""
+    router = _get_router()
+    deadline = time.monotonic() + timeout_s
+    while not router.known(name):
+        if time.monotonic() > deadline:
+            raise KeyError(f"no deployment named {name!r}")
+        time.sleep(0.05)
+    replicas = router.replicas_of(name)
+    if not replicas:
+        return 0
+    refs = [r.warm_up.remote(dataset, batch_size, method, max_batches)
+            for r in replicas]
+    ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                            timeout=max(1.0,
+                                        deadline - time.monotonic()))
+    total = 0
+    for ref in ready:
+        total += int(ray_tpu.get(ref, timeout=30))
+    return total
 
 
 # ----------------------------------------------------------------------

@@ -73,6 +73,21 @@ class DeploymentConfig:
     #: proxy); -1 = the global ``serve_proxy_queue_limit`` knob,
     #: 0 = unbounded (shedding off)
     max_queued_requests: int = -1
+    #: shards per replica: > 1 turns each replica into a GANG (rank 0 =
+    #: the routed ServeReplica, ranks 1..N-1 = ShardGangWorker actors
+    #: running the model via the sharded-engine protocol; see
+    #: serve/sharded.py).  Created all-or-nothing in one registration
+    #: batch, killed all-or-nothing on any member death.
+    num_shards: int = 1
+    #: prefill/decode disaggregation: > 0 adds that many PREFILL
+    #: replicas (an internal ``<name>--prefill`` deployment); the router
+    #: sends each request's prompt pass there first, and the prefill
+    #: replica streams finished KV pages to the decode replica as
+    #: object refs over the transfer plane.
+    prefill_replicas: int = 0
+    #: internal role marker ("" = decode/unified, "prefill" = the
+    #: prompt-pass tier of a disaggregated deployment)
+    role: str = ""
 
 
 @ray_tpu.remote
@@ -82,12 +97,22 @@ class ServeReplica:
     def __init__(self, pickled_callable: bytes, init_args: tuple,
                  init_kwargs: dict, user_config: Any = None,
                  deployment_name: str = "",
-                 batching: Optional[Dict[str, Any]] = None):
-        target = cloudpickle.loads(pickled_callable)
-        if isinstance(target, type):
-            self._callable = target(*init_args, **init_kwargs)
+                 batching: Optional[Dict[str, Any]] = None,
+                 num_shards: int = 1,
+                 prefill_cfg: Optional[Dict[str, Any]] = None):
+        if num_shards > 1:
+            # rank 0 of a gang: the engine wrapper fans each decode
+            # step out over the shard workers the controller attaches
+            from ray_tpu.serve.sharded import ShardedEngine
+            self._callable = ShardedEngine(
+                pickled_callable, init_args, init_kwargs, num_shards,
+                deployment_name)
         else:
-            self._callable = target
+            target = cloudpickle.loads(pickled_callable)
+            if isinstance(target, type):
+                self._callable = target(*init_args, **init_kwargs)
+            else:
+                self._callable = target
         self._deployment = deployment_name
         self._inflight = 0
         self._total = 0
@@ -101,6 +126,11 @@ class ServeReplica:
             self._batcher = ContinuousBatcher(
                 self._callable, BatchingConfig.from_dict(batching),
                 deployment_name)
+        # prefill tier: no decode loop — the prompt pass runs on the
+        # handler thread and finished pages export as refs
+        self._prefill_cfg = prefill_cfg
+        self._prefill_table = None
+        self._prefill_seq = 0
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -125,18 +155,31 @@ class ServeReplica:
             self._total += 1
         try:
             if self._batcher is not None \
-                    and method_name in ("", "__call__"):
+                    and method_name in ("", "__call__", "__decode__"):
                 from ray_tpu.serve.batching import ReplicaOverloaded
                 payload = args[0] if args else kwargs.get("payload")
+                prefilled = None
+                if method_name == "__decode__":
+                    # disaggregated decode: the payload is a prefill
+                    # replica's export (possibly still a ref) — pull
+                    # the KV pages over the transfer plane HERE, on
+                    # the handler thread, never on the decode loop
+                    prefilled = self._resolve_prefilled(payload)
+                    payload = None
                 try:
                     result = self._batcher(payload, deadline_s=deadline_s,
                                            request_id=request_id,
-                                           stream=stream)
+                                           stream=stream,
+                                           prefilled=prefilled)
                 except ReplicaOverloaded:
                     with self._lock:
                         self._shed += 1
                     _tm.serve_request_shed(self._deployment, "replica")
                     raise
+            elif method_name == "__prefill__":
+                result = self._do_prefill(
+                    args[0] if args else kwargs.get("payload"),
+                    request_id)
             else:
                 target = self._callable
                 if method_name and method_name != "__call__":
@@ -159,6 +202,97 @@ class ServeReplica:
         finally:
             with self._lock:
                 self._inflight -= 1
+
+    # -- prefill/decode disaggregation ---------------------------------
+    def _kv_prefill_table(self):
+        if self._prefill_table is None:
+            from ray_tpu.serve.kv_cache import KVPageTable
+            cfg = self._prefill_cfg or {}
+            self._prefill_table = KVPageTable(
+                int(cfg.get("kv_page_tokens") or 16),
+                int(cfg.get("kv_max_pages") or 0),
+                self._deployment,
+                kv_payload=getattr(self._callable, "kv_page_payload",
+                                   None))
+        return self._prefill_table
+
+    def _do_prefill(self, payload: Any,
+                    request_id: Optional[str]) -> Dict[str, Any]:
+        """The prompt pass on a prefill replica: parse, run the
+        engine's prefill, seal finished KV pages into the arena, and
+        export the page REFS (plus decode metadata) — the decode gang
+        adopts the pages without re-prefilling."""
+        engine = self._callable
+        state = engine.begin_request(payload)
+        state.setdefault("max_new_tokens", 16)
+        prefill = getattr(engine, "prefill", None)
+        if prefill is not None:
+            state = prefill(state) or state
+        table = self._kv_prefill_table()
+        with self._lock:
+            self._prefill_seq += 1
+            rid = request_id or f"prefill-{self._prefill_seq}"
+            rid = f"{rid}#{self._prefill_seq}"  # retries never collide
+        table.begin(rid, list(state.get("tokens") or [0]))
+        export = table.handoff(rid)
+        export["meta"] = {
+            k: state[k] for k in ("prompt_len", "max_new_tokens")
+            if k in state}
+        return export
+
+    @staticmethod
+    def _resolve_prefilled(payload: Any) -> Dict[str, Any]:
+        from ray_tpu.core.exceptions import (ActorDiedError,
+                                             ObjectLostError,
+                                             WorkerCrashedError)
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.serve.batching import RequestPrefillLost
+        from ray_tpu.serve.kv_cache import resolve_export
+
+        try:
+            if isinstance(payload, ObjectRef):
+                payload = ray_tpu.get(payload, timeout=60)
+            tokens = resolve_export(payload)
+        except (ActorDiedError, WorkerCrashedError,
+                ObjectLostError) as e:
+            # the PREFILL tier died under us; surface a typed,
+            # retryable error so the router re-runs the prompt pass —
+            # this decode replica is healthy and must not be marked
+            # dead for the prefill tier's failure
+            raise RequestPrefillLost(str(e)) from e
+        return {"export": payload, "tokens": tokens,
+                "meta": payload.get("meta") or {}}
+
+    def warm_up(self, dataset: Any, batch_size: int = 32,
+                method: str = "__call__", max_batches: int = 0) -> int:
+        """Feed a warmup/eval corpus through this replica via the
+        STREAMING data plane: ``iter_batches(streaming=True)`` admits
+        reads lazily inside the bounded in-flight window, so a corpus
+        larger than the arena never materializes into it (ROADMAP item
+        3 remainder).  The deployment may define ``warmup_batch(batch)``
+        to control what one batch exercises; otherwise each batch is
+        passed to the handler as a payload.  Returns batches consumed."""
+        engine = self._callable
+        fn = getattr(engine, "warmup_batch", None)
+        n = 0
+        for batch in dataset.iter_batches(batch_size=batch_size,
+                                          streaming=True):
+            if fn is not None:
+                fn(batch)
+            elif method and method != "__call__":
+                getattr(engine, method)(batch)
+            else:
+                engine(batch)
+            n += 1
+            if max_batches and n >= max_batches:
+                break
+        return n
+
+    @ray_tpu.method(concurrency_group="control")
+    def attach_shards(self, shard_handles: List[Any]) -> bool:
+        """Hand the gang's rank 1..N-1 actor handles to the sharded
+        engine (controller-side, after all-or-nothing readiness)."""
+        return self._callable.attach(shard_handles)
 
     @ray_tpu.method(concurrency_group="control")
     def cancel_request(self, request_id: str) -> bool:
@@ -190,6 +324,19 @@ class ServeReplica:
             # self._shed in handle_request (it would double-count)
             out["batch_steps"] = s["steps"]
             out["step_shapes"] = s["step_shapes"]
+            out["step_p50_ms"] = s["step_p50_ms"]
+            out["step_p99_ms"] = s["step_p99_ms"]
+            # paged-KV accounting rides the same poll (controller
+            # aggregates into the ray_tpu_serve_kv_* gauges)
+            for k, v in s.items():
+                if k.startswith("kv_"):
+                    out[k] = v
+        if self._prefill_table is not None:
+            for k, v in self._prefill_table.stats().items():
+                out[f"prefill_{k}"] = v
+        from ray_tpu.serve.sharded import ShardedEngine
+        if isinstance(self._callable, ShardedEngine):
+            out.update(self._callable.gang_stats())
         return out
 
     @ray_tpu.method(concurrency_group="control")
@@ -228,6 +375,9 @@ class ServeController:
         self._stop = False
         # replicas removed from routing, awaiting drain: (handle, deadline)
         self._draining: List[Tuple[Any, float, float]] = []
+        # gang membership: rank0 actor_id -> [ShardGangWorker handles];
+        # killed with rank0 (all-or-nothing), respawned as a unit
+        self._gangs: Dict[bytes, List[Any]] = {}
         # actor_id -> node hex, for locality-aware routing (reference
         # replica_scheduler's node-locality ranking)
         self._replica_nodes: Dict[bytes, Optional[str]] = {}
@@ -240,9 +390,26 @@ class ServeController:
         self._thread.start()
 
     # -- API ----------------------------------------------------------
+    PREFILL_SUFFIX = "--prefill"
+
     def deploy(self, name: str, pickled_callable: bytes, init_args: tuple,
                init_kwargs: dict, config: DeploymentConfig) -> int:
-        """Returns the assigned version (monotonic per deployment)."""
+        """Returns the assigned version (monotonic per deployment).
+
+        ``prefill_replicas > 0`` also (re)registers the internal
+        ``<name>--prefill`` deployment: same engine, no decode loop —
+        its replicas run the prompt pass and export KV pages by ref.
+        """
+        if config.prefill_replicas > 0:
+            if config.batching is None:
+                raise ValueError(
+                    "prefill/decode disaggregation requires a "
+                    "continuous-batching deployment (batching=...)")
+            # disaggregation moves state between replicas, so the KV
+            # must be paged; default the page size on if unset
+            config.batching = dict(config.batching)
+            if not config.batching.get("kv_page_tokens"):
+                config.batching["kv_page_tokens"] = 16
         with self._lock:
             prev = self._deployments.get(name)
             config.version = (prev["config"].version + 1) if prev else 0
@@ -254,19 +421,51 @@ class ServeController:
                 "replica_versions": prev.get("replica_versions", [])
                 if prev else [],
             }
-            return config.version
+        if config.prefill_replicas > 0:
+            pconfig = DeploymentConfig(
+                num_replicas=config.prefill_replicas,
+                max_concurrent_queries=config.max_concurrent_queries,
+                ray_actor_options=dict(config.ray_actor_options or {}),
+                graceful_shutdown_timeout_s=(
+                    config.graceful_shutdown_timeout_s),
+                # a model that needs a gang to decode needs one to
+                # prefill too: the tier inherits the shard layout
+                num_shards=config.num_shards,
+                role="prefill")
+            self.deploy(name + self.PREFILL_SUFFIX, pickled_callable,
+                        init_args, init_kwargs, pconfig)
+        elif config.role == "":
+            # prefill tier removed on redeploy without disaggregation
+            with self._lock:
+                had = name + self.PREFILL_SUFFIX in self._deployments
+            if had:
+                self.delete_deployment(name + self.PREFILL_SUFFIX)
+        return config.version
+
+    def _kill_replica(self, replica: Any) -> None:
+        """Kill a replica AND its gang members (all-or-nothing)."""
+        try:
+            ray_tpu.kill(replica)
+        except Exception:  # noqa: BLE001
+            pass
+        members = self._gangs.pop(replica.actor_id.binary(), [])
+        for m in members:
+            try:
+                ray_tpu.kill(m)
+            except Exception:  # noqa: BLE001
+                pass
 
     def delete_deployment(self, name: str) -> bool:
         with self._lock:
             dep = self._deployments.pop(name, None)
             self._scale_state.pop(name, None)
+            has_prefill = name + self.PREFILL_SUFFIX in self._deployments
         if dep:
             for r in dep["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
+                self._kill_replica(r)
             self._bump_routing()
+        if has_prefill:
+            self.delete_deployment(name + self.PREFILL_SUFFIX)
         return True
 
     def get_routing_table(self, known_version: int = -1,
@@ -299,6 +498,14 @@ class ServeController:
                     "max_queued_requests":
                         getattr(cfg, "max_queued_requests", -1)
                         if cfg else -1,
+                    "num_shards": getattr(cfg, "num_shards", 1)
+                        if cfg else 1,
+                    # disaggregation: the router runs the prompt pass
+                    # against this deployment first
+                    "prefill":
+                        (name + self.PREFILL_SUFFIX)
+                        if cfg and getattr(cfg, "prefill_replicas", 0) > 0
+                        else None,
                 }
         return {"version": self._routing_version, "table": table}
 
@@ -310,6 +517,11 @@ class ServeController:
         # ALSO a blocked handle_request thread (counted in inflight),
         # so summing would double-count the backlog
         return max(int(m.get("inflight", 0)), int(m.get("queue_depth", 0)))
+
+    def get_gang_members(self, rank0_actor_id: bytes) -> List[Any]:
+        """Shard-worker handles of the gang fronted by ``rank0``
+        (introspection/chaos tooling)."""
+        return list(self._gangs.get(rank0_actor_id, []))
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         def _m(r) -> Dict[str, Any]:
@@ -334,7 +546,16 @@ class ServeController:
                             for r in dep["replicas"]] or [0.0]),
                        "stale_replicas": sum(
                            1 for v in dep["replica_versions"]
-                           if v != dep["config"].version)}
+                           if v != dep["config"].version),
+                       "num_shards": getattr(dep["config"], "num_shards",
+                                             1),
+                       "role": getattr(dep["config"], "role", ""),
+                       # live KV pages across replicas (decode tables +
+                       # a prefill replica's handoff table)
+                       "kv_pages_active": sum(
+                           int(_m(r).get("kv_pages_active", 0))
+                           + int(_m(r).get("prefill_kv_pages_active", 0))
+                           for r in dep["replicas"])}
                 for name, dep in self._deployments.items()
             }
 
@@ -345,20 +566,14 @@ class ServeController:
             self._deployments.clear()
         for dep in deps:
             for r in dep["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
+                self._kill_replica(r)
         # replicas still draining die with the app too (under the lock:
         # the control loop may be appending concurrently)
         with self._lock:
             draining = list(self._draining)
             self._draining = []
         for replica, _, _ in draining:
-            try:
-                ray_tpu.kill(replica)
-            except Exception:  # noqa: BLE001
-                pass
+            self._kill_replica(replica)
         return True
 
     # -- reconciliation ------------------------------------------------
@@ -440,6 +655,26 @@ class ServeController:
             _tm.serve_queue_depth(name, sum(
                 int((self._replica_metrics.get(r.actor_id.binary()) or {})
                     .get("queue_depth", 0)) for r in replicas))
+            metrics = [self._replica_metrics.get(r.actor_id.binary()) or {}
+                       for r in replicas]
+            # paged-KV accounting (decode tables + prefill tables both
+            # count; a prefill replica reports prefill_kv_* keys)
+            if any("kv_pages_active" in m or "prefill_kv_pages_active"
+                   in m for m in metrics):
+                _tm.serve_kv_pages(
+                    name,
+                    sum(int(m.get("kv_pages_active", 0))
+                        + int(m.get("prefill_kv_pages_active", 0))
+                        for m in metrics),
+                    sum(int(m.get("kv_pages_allocated_total", 0))
+                        + int(m.get("prefill_kv_pages_allocated_total",
+                                    0)) for m in metrics),
+                    sum(int(m.get("kv_pages_freed_total", 0))
+                        + int(m.get("prefill_kv_pages_handed_off_total",
+                                    0)) for m in metrics))
+                _tm.serve_kv_occupancy(name, max(
+                    [float(m.get("kv_occupancy", 0.0))
+                     for m in metrics] or [0.0]))
 
     def _reconcile_once(self) -> bool:
         changed = False
@@ -456,8 +691,13 @@ class ServeController:
             dead = [i for i, r in enumerate(replicas)
                     if self._known_dead(r)]
             for i in reversed(dead):
-                replicas.pop(i)
+                gone = replicas.pop(i)
                 versions.pop(i)
+                # a dead rank 0 takes its gang with it (all-or-nothing):
+                # reap surviving shard workers before the respawn below
+                if gone.actor_id.binary() in self._gangs:
+                    _tm.serve_gang_death(name)
+                    self._kill_replica(gone)
                 changed = True
             # rolling update: replace one stale replica at a time
             stale = [i for i, v in enumerate(versions)
@@ -522,10 +762,7 @@ class ServeController:
         with self._lock:
             if self._stop:
                 # shutdown already swept _draining; kill directly
-                try:
-                    ray_tpu.kill(replica)
-                except Exception:  # noqa: BLE001
-                    pass
+                self._kill_replica(replica)
                 return
             self._draining.append((replica, deadline, now + 0.5))
 
@@ -576,10 +813,7 @@ class ServeController:
                     m.get("inflight", 0) == 0
                     and m.get("queue_depth", 0) == 0)
             if done:
-                try:
-                    ray_tpu.kill(replica)
-                except Exception:  # noqa: BLE001
-                    pass
+                self._kill_replica(replica)
             else:
                 still.append((replica, deadline, not_before))
         with self._lock:
@@ -642,46 +876,99 @@ class ServeController:
         return out[0] if out else None
 
     def _create_replica(self, name: str, dep: Dict[str, Any],
-                        config: DeploymentConfig) -> Optional[Any]:
-        """Issue one replica creation WITHOUT waiting for readiness."""
+                        config: DeploymentConfig
+                        ) -> Optional[Dict[str, Any]]:
+        """Issue one replica creation WITHOUT waiting for readiness.
+
+        ``num_shards > 1`` issues the WHOLE gang here — rank 0 plus
+        every ShardGangWorker — before any wait, so one gang's creation
+        coalesces into one registration batch + one pipelined bring-up
+        wave (PR 9), with SPREAD placing shards across nodes."""
         try:
             opts = dict(config.ray_actor_options or {})
             init_args, init_kwargs = dep["init"]
+            num_shards = max(1, int(getattr(config, "num_shards", 1)))
+            prefill_cfg = None
+            if getattr(config, "role", "") == "prefill" \
+                    and name.endswith(self.PREFILL_SUFFIX):
+                # page geometry comes from the decode deployment so
+                # both tiers seal interchangeable pages
+                with self._lock:
+                    base = self._deployments.get(
+                        name[:-len(self.PREFILL_SUFFIX)])
+                b = (base["config"].batching or {}) if base else {}
+                prefill_cfg = {
+                    "kv_page_tokens": b.get("kv_page_tokens") or 16,
+                    "kv_max_pages": b.get("kv_max_pages") or 0}
+            members: List[Any] = []
+            if num_shards > 1:
+                from ray_tpu.serve.sharded import ShardGangWorker
+                mopts = {k: v for k, v in opts.items()
+                         if k in ("num_cpus", "num_tpus", "num_gpus",
+                                  "resources", "runtime_env",
+                                  "scheduling_strategy")}
+                # shards spread across nodes unless the deployment
+                # pinned its own placement (PR-6 SPREAD/NODE_AFFINITY)
+                mopts.setdefault("scheduling_strategy", "SPREAD")
+                for rank in range(1, num_shards):
+                    members.append(ShardGangWorker.options(
+                        max_concurrency=4,
+                        concurrency_groups={"control": 2},
+                        **mopts).remote(
+                            dep["blob"], init_args, init_kwargs,
+                            rank, num_shards, name))
             # control methods (health/metrics/reconfigure) run in their
             # own concurrency group so a saturated handle_request pool
             # cannot starve them (reference: replicas use a dedicated
             # control concurrency group — actor.py:65-83)
-            return ServeReplica.options(
+            handle = ServeReplica.options(
                 max_concurrency=max(4, config.max_concurrent_queries),
                 concurrency_groups={"control": 2},
                 **opts).remote(dep["blob"], init_args, init_kwargs,
                                config.user_config,
                                deployment_name=name,
-                               batching=getattr(config, "batching", None))
+                               batching=getattr(config, "batching", None),
+                               num_shards=num_shards,
+                               prefill_cfg=prefill_cfg)
+            return {"handle": handle, "members": members,
+                    "t0": time.monotonic()}
         except Exception:  # noqa: BLE001
             logger.exception("failed to start replica")
             return None
 
     def _start_replicas(self, name: str, dep: Dict[str, Any],
                         config: DeploymentConfig, n: int) -> List[Any]:
-        """Start ``n`` replicas CONCURRENTLY: every creation is issued
-        up front (one coalesced registration batch + one pipelined
-        bring-up wave on the control plane), then readiness resolves
-        under a single bounded wait — was one blocking 120 s
-        ready-probe per replica, which made an N-replica scale-up N
-        serial actor creations end to end."""
-        started: List[Any] = []
+        """Start ``n`` replicas CONCURRENTLY: every creation (including
+        every gang member) is issued up front (one coalesced
+        registration batch + one pipelined bring-up wave on the control
+        plane), then readiness resolves under a single bounded wait —
+        was one blocking 120 s ready-probe per replica, which made an
+        N-replica scale-up N serial actor creations end to end.
+
+        Gangs are all-or-nothing: a gang with ANY member failing
+        readiness is killed whole (and retried by the next reconcile
+        tick); a healthy gang is attached (rank 0 learns its shard
+        handles) before it is routed."""
+        started: List[Dict[str, Any]] = []
         for _ in range(max(0, n)):
-            replica = self._create_replica(name, dep, config)
-            if replica is None:
+            gang = self._create_replica(name, dep, config)
+            if gang is None:
                 break
-            started.append(replica)
+            started.append(gang)
         if not started:
             return []
-        refs = [r.ready.remote() for r in started]
+        num_shards = max(1, int(getattr(config, "num_shards", 1)))
+        gang_refs: List[List[Any]] = []
+        for gang in started:
+            gang_refs.append([gang["handle"].ready.remote()]
+                             + [m.ready.remote()
+                                for m in gang["members"]])
+        all_refs = [r for refs in gang_refs for r in refs]
+        timeout = 120.0 if num_shards == 1 else float(
+            _serve_knob("serve_gang_ready_timeout_s", 120.0))
         try:
-            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
-                                    timeout=120.0)
+            ready, _ = ray_tpu.wait(all_refs, num_returns=len(all_refs),
+                                    timeout=timeout)
             ready_set = set(ready)
         except Exception:  # noqa: BLE001 — fall back to per-replica
             # probes below: a transient owner-side wait error must not
@@ -691,21 +978,44 @@ class ServeController:
             ready_set = None
         out: List[Any] = []
         node_probes: List[Any] = []
-        for replica, ref in zip(started, refs):
-            ok = False
-            if ready_set is None or ref in ready_set:
+        for gang, refs in zip(started, gang_refs):
+            replica = gang["handle"]
+            ok = True
+            for ref in refs:
+                if ready_set is not None and ref not in ready_set:
+                    ok = False
+                    break
                 try:
                     ray_tpu.get(ref, timeout=30.0 if ready_set is None
                                 else 1.0)
-                    ok = True
                 except Exception:  # noqa: BLE001
-                    logger.exception("replica failed to become ready")
+                    logger.exception("gang member failed to become ready")
+                    ok = False
+                    break
+            if ok and gang["members"]:
+                try:
+                    ray_tpu.get(replica.attach_shards.remote(
+                        gang["members"]), timeout=30.0)
+                except Exception:  # noqa: BLE001 — rank 0 died between
+                    logger.exception("gang attach failed")  # ready and
+                    ok = False  # attach: retry the whole gang
             if not ok:
+                # all-or-nothing: one bad member kills the gang
                 try:
                     ray_tpu.kill(replica)
                 except Exception:  # noqa: BLE001
                     pass
+                for m in gang["members"]:
+                    try:
+                        ray_tpu.kill(m)
+                    except Exception:  # noqa: BLE001
+                        pass
                 continue
+            if gang["members"]:
+                self._gangs[replica.actor_id.binary()] = \
+                    list(gang["members"])
+                _tm.serve_gang_bringup(
+                    name, time.monotonic() - gang["t0"], num_shards)
             try:
                 node_probes.append(
                     (replica.actor_id.binary(),
@@ -810,6 +1120,20 @@ class Router:
     def known(self, deployment: str) -> bool:
         with self._lock:
             return deployment in self._table
+
+    def prefill_for(self, deployment: str) -> Optional[str]:
+        """Name of the deployment's prefill tier, or None (unified)."""
+        with self._lock:
+            entry = self._table.get(deployment) or {}
+        return entry.get("prefill")
+
+    def replicas_of(self, deployment: str) -> List[Any]:
+        """Snapshot of the deployment's routed replica handles (for
+        whole-set fan-outs like ``serve.warmup`` — request dispatch
+        goes through ``assign`` instead)."""
+        with self._lock:
+            entry = self._table.get(deployment) or {}
+            return list(entry.get("replicas") or [])
 
     def _try_assign(self, deployment: str,
                     exclude: Tuple[bytes, ...] = ()):

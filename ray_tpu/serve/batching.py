@@ -61,7 +61,8 @@ from ray_tpu.core import tracing as _trace
 
 __all__ = [
     "BatchingConfig", "ContinuousBatcher", "ReplicaOverloaded",
-    "RequestCancelled", "RequestDeadlineExceeded", "default_buckets",
+    "RequestCancelled", "RequestDeadlineExceeded", "RequestPrefillLost",
+    "default_buckets",
 ]
 
 
@@ -95,6 +96,14 @@ class RequestDeadlineExceeded(Exception):
 class RequestCancelled(Exception):
     """The client cancelled (or abandoned) the request; its batch slot
     was reclaimed at the step boundary."""
+
+
+class RequestPrefillLost(Exception):
+    """The prefill tier's result (KV pages) became unavailable before
+    the decode replica could adopt it — typically the prefill replica
+    died mid-handoff.  Retryable: the router re-runs the prompt pass on
+    a surviving prefill replica; the DECODE replica is healthy and must
+    NOT be marked dead."""
 
 
 def default_buckets(max_seq_len: int, cap: int = 8) -> Tuple[int, ...]:
@@ -131,6 +140,13 @@ class BatchingConfig:
     default_deadline_s: float = 30.0
     #: Retry-After hint attached to shed responses
     shed_retry_after_s: float = 1.0
+    #: paged KV cache: tokens per page (0 = paged KV off — requests
+    #: keep no arena-resident state, the pre-PR behavior)
+    kv_page_tokens: int = 0
+    #: page budget per replica; admission holds a request queued while
+    #: its worst-case page demand exceeds the free budget (0 = the
+    #: ``serve_kv_max_pages`` knob)
+    kv_max_pages: int = 0
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         buckets = tuple(sorted(self.bucket_lens)) or default_buckets(
@@ -169,6 +185,10 @@ class _Request:
     #: wall-clock submit stamp (spans use wall time; enqueued_at stays
     #: monotonic for deadlines)
     t0_wall: float = 0.0
+    #: prefilled paged state from a prefill replica (``{"export": ...,
+    #: "tokens": [...], "meta": {...}}``): admission adopts the pages
+    #: and skips begin_request/prefill entirely
+    prefilled: Optional[Dict[str, Any]] = None
     #: live decode span (admission -> finish) of a traced request
     decode_span: Optional[Any] = None
     #: per-step spans already recorded (capped; see _STEP_SPAN_CAP)
@@ -194,7 +214,7 @@ class ContinuousBatcher:
     _STEP_SPAN_CAP = 64
 
     def __init__(self, engine: Any, config: BatchingConfig,
-                 deployment: str = ""):
+                 deployment: str = "", kv_table: Any = None):
         self._engine = engine
         self._cfg = config
         self._deployment = deployment
@@ -208,6 +228,22 @@ class ContinuousBatcher:
         self._active = 0
         self._stop = False
         self._next_id = 0
+        # paged KV cache (kv_cache.py): request state lives as arena
+        # pages; admission reserves pages, eviction frees them
+        self._kv = kv_table
+        if self._kv is None and config.kv_page_tokens > 0:
+            from ray_tpu.serve._internal import _serve_knob
+            from ray_tpu.serve.kv_cache import KVPageTable
+
+            self._kv = KVPageTable(
+                config.kv_page_tokens,
+                config.kv_max_pages
+                or int(_serve_knob("serve_kv_max_pages", 4096)),
+                deployment,
+                kv_payload=getattr(engine, "kv_page_payload", None))
+        #: requests admitted this pass, awaiting (possibly expensive)
+        #: prefill + paging OUTSIDE the lock on the decode thread
+        self._newly_admitted: List[Tuple[int, _Request]] = []
         # stats the replica exports for routing/autoscaling/tests
         self._steps = 0
         self._step_shapes: set = set()
@@ -215,6 +251,7 @@ class ContinuousBatcher:
         self._completed = 0
         self._occupancy_sum = 0.0
         self._latencies_ms: List[float] = []  # bounded ring, p99 source
+        self._step_ms: List[float] = []  # decode-step durations (ring)
         self._thread = threading.Thread(
             target=self._run, name="rtpu-serve-batcher", daemon=True)
         self._thread.start()
@@ -222,12 +259,16 @@ class ContinuousBatcher:
     # -- submit side -------------------------------------------------------
     def submit(self, payload: Any, *, deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
-               stream: bool = False) -> Future:
+               stream: bool = False,
+               prefilled: Optional[Dict[str, Any]] = None) -> Future:
         """Enqueue one request; returns a Future resolving to the
         engine's ``finish_request`` value.  Sheds when the queue is
         full.  The request joins the in-flight batch at the next step
         boundary with a free slot.  A request submitted under an active
-        trace context gets queue-wait / decode / per-step spans."""
+        trace context gets queue-wait / decode / per-step spans.
+        ``prefilled`` carries an adopted paged state (tokens already
+        materialized by the handler thread) — admission then skips
+        ``begin_request``/``prefill``."""
         now = time.monotonic()
         budget = self._cfg.default_deadline_s if deadline_s is None \
             else deadline_s
@@ -247,7 +288,8 @@ class ContinuousBatcher:
             req = _Request(payload=payload, future=fut,
                            deadline=now + budget, request_id=request_id,
                            enqueued_at=now, stream=stream, trace=trace,
-                           t0_wall=time.time() if trace or stream else 0.0)
+                           t0_wall=time.time() if trace or stream else 0.0,
+                           prefilled=prefilled)
             self._queue.append(req)
             self._by_id[request_id] = req
             self._wake.notify()
@@ -255,10 +297,12 @@ class ContinuousBatcher:
 
     def __call__(self, payload: Any, *, deadline_s: Optional[float] = None,
                  request_id: Optional[str] = None,
-                 stream: bool = False) -> Any:
+                 stream: bool = False,
+                 prefilled: Optional[Dict[str, Any]] = None) -> Any:
         """Blocking submit — what the replica's request handler calls."""
         fut = self.submit(payload, deadline_s=deadline_s,
-                          request_id=request_id, stream=stream)
+                          request_id=request_id, stream=stream,
+                          prefilled=prefilled)
         return fut.result()
 
     def cancel(self, request_id: str) -> bool:
@@ -287,15 +331,24 @@ class ContinuousBatcher:
                 self._finish_locked(
                     req, error=RuntimeError("replica shutting down"))
             self._queue.clear()
+        if self._kv is not None:
+            self._kv.release_all()  # belt-and-braces: zero leaked pages
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
+        kv = self._kv.stats() if self._kv is not None else {}
         with self._lock:
             lat = sorted(self._latencies_ms)
             p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat \
                 else 0.0
             p50 = lat[len(lat) // 2] if lat else 0.0
+            sms = sorted(self._step_ms)
             return {
+                **kv,
+                "step_p50_ms": sms[len(sms) // 2] if sms else 0.0,
+                "step_p99_ms":
+                    sms[min(len(sms) - 1, int(len(sms) * 0.99))]
+                    if sms else 0.0,
                 "queue_depth": len(self._queue),
                 "active": self._active,
                 "steps": self._steps,
@@ -318,6 +371,10 @@ class ContinuousBatcher:
     def _finish_locked(self, req: _Request, *, value: Any = None,
                        error: Optional[BaseException] = None) -> None:
         self._by_id.pop(req.request_id, None)
+        if self._kv is not None:
+            # single funnel: every completed/evicted/cancelled request
+            # frees its KV pages exactly once (the no-leak invariant)
+            self._kv.release(req.request_id)
         if req.decode_span is not None:
             # trace-span append only — the metrics registry (its own
             # locks) is never touched under self._lock
@@ -339,44 +396,73 @@ class ContinuousBatcher:
 
     def _admit_locked(self, now: float) -> None:
         """Step boundary: free finished/cancelled/expired slots already
-        handled; pull queued requests into free slots."""
+        handled; pull queued requests into free slots.  Paged-KV
+        admission is budget-gated: a request whose worst-case page
+        demand exceeds the free budget stays queued (FIFO — nothing
+        behind it jumps ahead) until eviction frees pages.  Expensive
+        per-request work (engine ``prefill``, page sealing) is deferred
+        to the decode thread OUTSIDE the lock via ``_newly_admitted``
+        so submitters never block behind it."""
         if not self._queue:
             return
         for i, slot in enumerate(self._slots):
             if slot is not None or not self._queue:
                 continue
-            req = self._queue.pop(0)
+            req = self._queue[0]
             if req.cancelled:
+                self._queue.pop(0)
                 self._finish_locked(
                     req, error=RequestCancelled(req.request_id))
                 continue
             if now > req.deadline:
+                self._queue.pop(0)
                 self._finish_locked(
                     req, error=RequestDeadlineExceeded(
                         f"request {req.request_id} expired in queue"))
                 continue
-            try:
-                state = self._engine.begin_request(req.payload)
-            except Exception as e:  # noqa: BLE001 — bad payload: that
-                self._finish_locked(req, error=e)  # request only
-                continue
+            if req.state is None:
+                try:
+                    if req.prefilled is not None:
+                        # pages sealed by a prefill replica; tokens were
+                        # materialized on the handler thread
+                        meta = dict(req.prefilled.get("meta") or {})
+                        state = dict(meta)
+                        state["tokens"] = list(req.prefilled["tokens"])
+                        state.setdefault(
+                            "prompt_len", len(state["tokens"]))
+                    else:
+                        state = self._engine.begin_request(req.payload)
+                except Exception as e:  # noqa: BLE001 — bad payload:
+                    self._queue.pop(0)  # that request only
+                    self._finish_locked(req, error=e)
+                    continue
+                state.setdefault("max_new_tokens", 16)
+                tokens = list(state.get("tokens") or [0])
+                cap = self._cfg.max_seq_len
+                if len(tokens) >= cap:
+                    tokens = tokens[:cap - 1]
+                state["tokens"] = tokens
+                req.state = state  # parsed once; reused if re-gated
+            if self._kv is not None:
+                need = min(len(req.state["tokens"])
+                           + int(req.state["max_new_tokens"]),
+                           self._cfg.max_seq_len)
+                # reservation is atomic at admission: two admissions in
+                # one boundary can't both pass a stale budget check
+                if not self._kv.reserve(req.request_id, need):
+                    break  # budget-gated: wait for eviction to free pages
+            self._queue.pop(0)
             if req.trace is not None:
                 admit_wall = time.time()
                 _trace.record("batch.queue", req.t0_wall, admit_wall,
                               parent=req.trace, slot=i)
                 req.decode_span = _trace.start_span(
                     "batch.decode", parent=req.trace, slot=i)
-            state.setdefault("max_new_tokens", 16)
-            tokens = list(state.get("tokens") or [0])
-            cap = self._cfg.max_seq_len
-            if len(tokens) >= cap:
-                tokens = tokens[:cap - 1]
-            state["tokens"] = tokens
-            req.state = state
             req.slot = i
             req.generated = 0
             self._slots[i] = req
             self._active += 1
+            self._newly_admitted.append((i, req))
 
     def _evict_locked(self, now: float) -> None:
         for i, req in enumerate(self._slots):
@@ -413,6 +499,34 @@ class ContinuousBatcher:
         if req is not None:
             self._finish_locked(req, value=value, error=error)
 
+    def _prepare_admitted(self, i: int, req: _Request) -> None:
+        """Post-admission work on the decode thread, OUTSIDE the lock:
+        engine ``prefill`` (the expensive prompt pass — in a unified
+        deployment this is exactly what stalls the step loop behind a
+        long prompt; disaggregation moves it to a prefill replica) and
+        KV page registration/sealing."""
+        try:
+            need = min(len(req.state["tokens"])
+                       + int(req.state["max_new_tokens"]),
+                       self._cfg.max_seq_len)
+            if req.prefilled is not None:
+                if self._kv is not None:
+                    self._kv.adopt(req.request_id,
+                                   req.prefilled.get("export") or {},
+                                   req.state["tokens"],
+                                   reserve_tokens=need)
+            else:
+                prefill = getattr(self._engine, "prefill", None)
+                if prefill is not None:
+                    req.state = prefill(req.state) or req.state
+                if self._kv is not None:
+                    self._kv.begin(req.request_id, req.state["tokens"],
+                                   reserve_tokens=need)
+        except Exception as e:  # noqa: BLE001 — that request only
+            with self._lock:
+                if self._slots[req.slot] is req:
+                    self._release_slot_locked(req.slot, error=e)
+
     def _run(self) -> None:
         import numpy as np
 
@@ -431,10 +545,21 @@ class ContinuousBatcher:
                 now = time.monotonic()
                 self._evict_locked(now)
                 self._admit_locked(now)
+                admitted = self._newly_admitted
+                self._newly_admitted = []
                 if self._active == 0:
                     # idle: park until a submit/cancel/stop wakes us
                     self._wake.wait(timeout=0.1)
                     continue
+            # prefill + page sealing for fresh admissions runs with the
+            # lock RELEASED: submitters/cancels never queue behind a
+            # long prompt's prefill (the decode loop itself does stall
+            # — the unified-mode cost disaggregation removes)
+            for i, req in admitted:
+                self._prepare_admitted(i, req)
+            with self._lock:
+                if self._active == 0:
+                    continue  # every admission failed in prepare
                 # snapshot the batch under the lock; run the step outside
                 batch: List[Tuple[int, _Request]] = [
                     (i, r) for i, r in enumerate(self._slots)
@@ -468,8 +593,15 @@ class ContinuousBatcher:
                 continue
             step_t1 = time.time()
             _tm.serve_decode_step(self._deployment, step_t1 - step_t0)
+            # local ring too: replica metrics expose step p50/p99 so a
+            # bench/operator can see decode-step latency directly (the
+            # gang fan-out's whole cost lives here)
+            self._step_ms.append((step_t1 - step_t0) * 1e3)
+            if len(self._step_ms) > 512:
+                del self._step_ms[:-512]
             next_tokens = np.asarray(next_tokens).reshape(-1)
             ttfts: List[float] = []  # emitted outside the lock
+            kv_appends: List[Tuple[str, int]] = []  # paged outside too
             with self._lock:
                 self._steps += 1
                 self._step_shapes.add((B, bucket))
@@ -478,6 +610,8 @@ class ContinuousBatcher:
                         continue  # cancelled during the step
                     tok = int(next_tokens[i])
                     req.state["tokens"].append(tok)
+                    if self._kv is not None:
+                        kv_appends.append((req.request_id, tok))
                     req.generated += 1
                     if req.generated == 1 and req.stream:
                         # time-to-first-token: what a streaming client
@@ -501,6 +635,12 @@ class ContinuousBatcher:
                         self._release_slot_locked(i, value=value)
             for ttft in ttfts:
                 _tm.serve_ttft_observed(self._deployment, ttft)
+            if kv_appends:
+                # page sealing (an arena put per page_tokens tokens)
+                # happens off the lock; a request released during the
+                # step is a no-op append
+                for rid, tok in kv_appends:
+                    self._kv.append(rid, tok)
 
 
 def bucketize(lengths: Sequence[int], buckets: Sequence[int]) -> List[int]:

@@ -270,12 +270,19 @@ class HTTPProxy:
                                              WorkerCrashedError)
         from ray_tpu.serve.batching import (ReplicaOverloaded,
                                             RequestCancelled,
-                                            RequestDeadlineExceeded)
+                                            RequestDeadlineExceeded,
+                                            RequestPrefillLost)
 
         rid = f"http-{self._rid_prefix}-{next(self._rid)}"
         attempts = max(1, int(_knob("serve_request_retries", 3)))
         deadline = time.monotonic() + deadline_s
         exclude: list = []
+        pre_exclude: list = []
+        # disaggregated deployment: chain prompt pass -> decode, KV
+        # pages travelling between the tiers as refs (never through
+        # the proxy)
+        prefill_name = router.prefill_for(name) \
+            if method_name == "__call__" else None
         last_death: Optional[BaseException] = None
         root_ctx = tspan.ctx() if tspan is not None else None
         for attempt in range(attempts):
@@ -289,6 +296,30 @@ class HTTPProxy:
             dstatus = "error"
             dtags: Dict[str, Any] = {}
             try:
+                # PREFILL tier first: its assign may wait for capacity,
+                # and waiting must not pin a decode slot (a saturated
+                # prefill tier would otherwise make the decode tier
+                # look full while doing no decode work)
+                pre_key = None
+                pre_ref = None
+                if prefill_name is not None:
+                    try:
+                        pre_replica, pre_key = await router.assign_async(
+                            prefill_name,
+                            timeout_s=max(0.05,
+                                          deadline - time.monotonic()),
+                            exclude=tuple(pre_exclude))
+                    except (KeyError, RuntimeError) as e:
+                        dstatus = "no_replica"
+                        await self._write_json(writer, 503,
+                                               {"error": str(e)})
+                        return "error", attempt + 1
+                    with _trace.use_ctx(dctx):
+                        pre_ref = pre_replica.handle_request.remote(
+                            "__prefill__", args, {},
+                            deadline_s=max(0.05,
+                                           deadline - time.monotonic()),
+                            request_id=rid)
                 aspan = _trace.start_span("router.assign", parent=dctx)
                 # "error" until the assign SUCCEEDS: the finally must
                 # not touch `key` (unbound) when e.g. a CancelledError
@@ -315,6 +346,10 @@ class HTTPProxy:
                                            {"error": str(e)})
                     return "error", attempt + 1
                 finally:
+                    if astatus != "ok" and pre_key is not None:
+                        # the prefill slot was taken above; its result
+                        # is abandoned with the failed decode assign
+                        router.release(pre_key)
                     if aspan is not None:
                         aspan.end(status=astatus, **(
                             {"replica": key[1].hex()[:12]}
@@ -325,21 +360,41 @@ class HTTPProxy:
                 # replica's exec/batch spans under it) join this
                 # attempt's subtree
                 with _trace.use_ctx(dctx):
-                    ref = replica.handle_request.remote(
-                        method_name, args, {},
-                        deadline_s=max(0.05, deadline - time.monotonic()),
-                        request_id=rid, stream=stream)
+                    if pre_ref is not None:
+                        ref = replica.handle_request.remote(
+                            "__decode__", (pre_ref,), {},
+                            deadline_s=max(0.05,
+                                           deadline - time.monotonic()),
+                            request_id=rid, stream=stream)
+                    else:
+                        ref = replica.handle_request.remote(
+                            method_name, args, {},
+                            deadline_s=max(0.05,
+                                           deadline - time.monotonic()),
+                            request_id=rid, stream=stream)
                 try:
                     result = await self._await_or_disconnect(
                         ref, reader, replica, rid)
                 except (ActorDiedError, WorkerCrashedError) as e:
-                    # replica died mid-request: exclude it and
-                    # re-dispatch — the client gets an answer from a
-                    # surviving replica
+                    # the DECODE pick died mid-request (a prefill death
+                    # arrives as RequestPrefillLost below, never this):
+                    # exclude it and re-dispatch — the client gets an
+                    # answer from a surviving replica
                     last_death = e
                     exclude.append(key[1])
                     router.mark_dead(key)
                     dstatus = "replica_died"
+                    continue
+                except RequestPrefillLost as e:
+                    # the prefill result was lost (replica death OR a
+                    # lost page object): exclude the pick for THIS
+                    # request's retries but don't mark it dead — the
+                    # replica may be healthy (a dead one leaves the
+                    # table when the controller reaps it)
+                    last_death = e
+                    if pre_key is not None:
+                        pre_exclude.append(pre_key[1])
+                    dstatus = "prefill_lost"
                     continue
                 except ReplicaOverloaded as e:
                     dstatus = "shed"
@@ -377,6 +432,8 @@ class HTTPProxy:
                     return "error", attempt + 1
                 finally:
                     router.release(key)
+                    if pre_key is not None:
+                        router.release(pre_key)
                 dstatus = "ok"
                 if stream and isinstance(result, (list, tuple)):
                     await self._write_stream(writer, result)

@@ -1,0 +1,318 @@
+"""Paged KV cache in the object-store arena.
+
+The vLLM/PagedAttention insight applied to this runtime's object plane:
+a decoding request's KV state is not one monolithic padded buffer but a
+list of **fixed-size pages**, each a sealed object in the PR-10 sharded
+shm arena.  The :class:`KVPageTable` maps ``request_id -> page list``
+and the continuous batcher admits/evicts requests by allocating/freeing
+pages against a budget instead of re-padding a cache tensor:
+
+- **Admission** reserves pages for the request's worst-case length; a
+  request whose demand exceeds the free budget stays queued until
+  eviction frees pages (no monolithic-cache re-pad, no OOM).
+- **Full pages seal into the arena** (``put(_force_plasma=True)``), so
+  they are ordinary objects: cold pages ride the PR-10 spill tier under
+  arena pressure and restore transparently on the next pull.
+- **Migration / prefill handoff** is by reference, not by copy:
+  :meth:`handoff` exports the page refs (the prefill->decode protocol
+  and replica migration both ride the PR-2 transfer plane when the
+  adopting replica materializes them).
+- **Accounting is airtight**: every page allocated is eventually freed
+  or handed off, and every adopted page is eventually dropped — the
+  chaos suite asserts ``active == 0`` after a drain (no leaked pages).
+
+A page's value is ``{"t": int32[<=page_tokens] token ids, "kv":
+optional engine payload}``.  Token ids make a page self-describing (an
+adopting replica rebuilds decode state from pages alone — for the toy
+engine the KV is recomputable from tokens; for a real engine ``kv``
+carries the actual K/V blocks via the engine's ``kv_page_payload``
+hook).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["KVPageTable", "KVPagesExhausted", "resolve_export"]
+
+
+class KVPagesExhausted(Exception):
+    """The table's page budget cannot cover the request (admission-time
+    signal; the batcher keeps the request queued instead of raising to
+    the client)."""
+
+
+def _default_put(value: Any):
+    import ray_tpu
+    from ray_tpu.serve._internal import _serve_knob
+
+    return ray_tpu.put(
+        value, _force_plasma=bool(_serve_knob("serve_kv_pages_in_arena",
+                                              True)))
+
+
+def _default_free(refs: List[Any]) -> None:
+    import ray_tpu
+
+    try:
+        ray_tpu.free(refs)
+    except Exception:  # noqa: BLE001 — refcounting frees on drop anyway
+        pass
+
+
+class _Entry:
+    __slots__ = ("pages", "tail", "reserved", "adopted",
+                 "adopted_pages")
+
+    def __init__(self, reserved: int, adopted: bool = False):
+        self.pages: List[Any] = []     # sealed page ObjectRefs, in order
+        self.tail: List[int] = []      # tokens not yet sealed into a page
+        self.reserved = reserved       # admission-time worst-case pages
+        self.adopted = adopted         # entry began from a handoff
+        #: first ``adopted_pages`` of ``pages`` are BORROWED (sealed by
+        #: another table); pages sealed here after adoption are owned
+        self.adopted_pages = 0
+
+
+class KVPageTable:
+    """Per-replica page table: request -> page refs + mutable tail.
+
+    The working token list stays with the engine (the decode hot path
+    never re-reads the arena); the table is the *durable* paged copy,
+    updated incrementally — a full page seals exactly once.
+    """
+
+    def __init__(self, page_tokens: int, max_pages: int,
+                 deployment: str = "",
+                 kv_payload: Optional[Callable[[List[int]], Any]] = None,
+                 put: Optional[Callable[[Any], Any]] = None,
+                 free: Optional[Callable[[List[Any]], None]] = None):
+        if page_tokens <= 0:
+            raise ValueError("page_tokens must be positive")
+        self.page_tokens = int(page_tokens)
+        self.max_pages = int(max_pages)
+        self._deployment = deployment
+        self._kv_payload = kv_payload
+        self._put = put or _default_put
+        self._free = free or _default_free
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        # cumulative accounting (the no-leak invariant's raw series)
+        self.allocated_total = 0
+        self.freed_total = 0
+        self.handed_off_total = 0
+        self.adopted_total = 0
+        self.dropped_total = 0  # adopted borrows released (not owned)
+        self.peak_reserved = 0  # high-water mark of the page budget
+
+    # -- admission ---------------------------------------------------------
+    def pages_for(self, n_tokens: int) -> int:
+        return max(1, -(-int(n_tokens) // self.page_tokens))
+
+    def can_admit(self, n_tokens: int) -> bool:
+        """True when the worst-case page demand fits the free budget."""
+        if self.max_pages <= 0:
+            return True
+        with self._lock:
+            return self._reserved_locked() + self.pages_for(n_tokens) \
+                <= self.max_pages
+
+    def reserve(self, request_id: str, n_tokens: int) -> bool:
+        """Atomically reserve the request's worst-case page demand at
+        ADMISSION time (before any page is sealed) — the batcher gates
+        on this so two same-boundary admissions cannot both pass a
+        stale budget check.  Idempotent; False = over budget (keep the
+        request queued).  ``release`` drops the reservation."""
+        with self._lock:
+            if request_id in self._entries:
+                return True
+            reserved = self.pages_for(n_tokens)
+            total = self._reserved_locked() + reserved
+            if self.max_pages > 0 and total > self.max_pages:
+                return False
+            self._entries[request_id] = _Entry(reserved)
+            self.peak_reserved = max(self.peak_reserved, total)
+            return True
+
+    def _reserved_locked(self) -> int:
+        return sum(e.reserved for e in self._entries.values())
+
+    def begin(self, request_id: str, tokens: List[int],
+              reserve_tokens: Optional[int] = None) -> int:
+        """Page the request's prompt (under a prior :meth:`reserve`, or
+        reserving here for standalone use — the prefill tier); full
+        pages seal into the arena immediately.  Returns pages sealed."""
+        reserved = self.pages_for(reserve_tokens
+                                  if reserve_tokens is not None
+                                  else len(tokens))
+        with self._lock:
+            entry = self._entries.get(request_id)
+            if entry is not None and (entry.pages or entry.tail):
+                raise ValueError(f"request {request_id} already paged")
+            if entry is None:
+                if self.max_pages > 0 and \
+                        self._reserved_locked() + reserved \
+                        > self.max_pages:
+                    raise KVPagesExhausted(
+                        f"{reserved} pages over budget {self.max_pages}")
+                entry = self._entries[request_id] = _Entry(reserved)
+                self.peak_reserved = max(self.peak_reserved,
+                                         self._reserved_locked())
+            entry.tail = list(tokens)
+            chunks = self._take_full_chunks_locked(entry)
+        for chunk in chunks:
+            self._seal_chunk(request_id, chunk)
+        return len(chunks)
+
+    def append(self, request_id: str, token: int) -> None:
+        with self._lock:
+            entry = self._entries.get(request_id)
+            if entry is None:
+                return  # released concurrently (eviction raced the step)
+            entry.tail.append(int(token))
+            chunks = self._take_full_chunks_locked(entry)
+        for chunk in chunks:
+            self._seal_chunk(request_id, chunk)
+
+    def _take_full_chunks_locked(self, entry: _Entry) -> List[List[int]]:
+        chunks: List[List[int]] = []
+        while len(entry.tail) >= self.page_tokens:
+            chunks.append(entry.tail[:self.page_tokens])
+            entry.tail = entry.tail[self.page_tokens:]
+        return chunks
+
+    def _seal_chunk(self, request_id: str, chunk: List[int]) -> None:
+        """Seal one full page OUTSIDE the lock (the put is an arena
+        RPC), then attach it to the entry — unless the request was
+        released mid-seal (cancel racing the decode step), in which
+        case the orphan page frees immediately so nothing leaks."""
+        page = {"t": np.asarray(chunk, dtype=np.int32), "kv": None}
+        if self._kv_payload is not None:
+            try:
+                page["kv"] = self._kv_payload(chunk)
+            except Exception:  # noqa: BLE001 — payload is optional
+                page["kv"] = None
+        ref = self._put(page)
+        with self._lock:
+            self.allocated_total += 1
+            entry = self._entries.get(request_id)
+            if entry is not None:
+                entry.pages.append(ref)
+                return
+            self.freed_total += 1
+        self._free([ref])
+
+    # -- release / handoff / adoption --------------------------------------
+    def release(self, request_id: str) -> int:
+        """Free the request's pages (eviction, completion, cancel).
+        Owned pages — including ones sealed HERE after an adoption
+        (decode-generated tokens on a prefilled request) — free eagerly
+        and count into ``freed_total``; borrowed (adopted) pages just
+        drop their borrow (the owner's refcount frees the blob) and
+        count into ``dropped_total`` — keeping the per-table invariant
+        ``allocated == freed + handed_off`` exact.  Returns pages
+        released either way."""
+        with self._lock:
+            entry = self._entries.pop(request_id, None)
+        if entry is None:
+            return 0
+        n = len(entry.pages)
+        borrowed = min(entry.adopted_pages, n)
+        owned = entry.pages[borrowed:]
+        if owned:
+            self._free(owned)
+        entry.pages = []
+        with self._lock:
+            self.dropped_total += borrowed
+            self.freed_total += len(owned)
+        return n
+
+    def handoff(self, request_id: str) -> Dict[str, Any]:
+        """Export the request's paged state for another replica (the
+        prefill->decode protocol): page REFS plus the unsealed tail —
+        no KV bytes travel in the reply.  The entry leaves this table
+        un-freed; the export's refs keep the pages alive until the
+        adopter drops them."""
+        with self._lock:
+            entry = self._entries.pop(request_id, None)
+        if entry is None:
+            raise KeyError(request_id)
+        with self._lock:
+            self.handed_off_total += len(entry.pages)
+        return {"pages": list(entry.pages), "tail": list(entry.tail),
+                "page_tokens": self.page_tokens}
+
+    def adopt(self, request_id: str, export: Dict[str, Any],
+              tokens: List[int], reserve_tokens: Optional[int] = None
+              ) -> None:
+        """Register a request whose pages were sealed elsewhere (the
+        decode side of a handoff, or cross-replica migration).  The
+        SAME arena objects back the request — cache survives migration.
+        ``tokens`` is the already-materialized token list (the adopter
+        pulled pages via :func:`resolve_export` on its handler thread,
+        off the decode loop)."""
+        reserved = self.pages_for(
+            reserve_tokens if reserve_tokens is not None else len(tokens))
+        with self._lock:
+            entry = self._entries.get(request_id)
+            if entry is not None and (entry.pages or entry.tail):
+                raise ValueError(f"request {request_id} already paged")
+            if entry is None:
+                entry = self._entries[request_id] = _Entry(reserved)
+            entry.adopted = True
+            self.adopted_total += len(export.get("pages") or [])
+            entry.pages = list(export.get("pages") or [])
+            entry.adopted_pages = len(entry.pages)
+            entry.tail = list(export.get("tail") or [])
+
+    def release_all(self) -> int:
+        n = 0
+        with self._lock:
+            ids = list(self._entries)
+        for rid in ids:
+            n += self.release(rid)
+        return n
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            active = sum(len(e.pages) for e in self._entries.values())
+            reserved = self._reserved_locked()
+            return {
+                "kv_page_tokens": self.page_tokens,
+                "kv_max_pages": self.max_pages,
+                "kv_pages_active": active,
+                "kv_pages_reserved": reserved,
+                "kv_requests_active": len(self._entries),
+                "kv_pages_allocated_total": self.allocated_total,
+                "kv_pages_freed_total": self.freed_total,
+                "kv_pages_handed_off_total": self.handed_off_total,
+                "kv_pages_adopted_total": self.adopted_total,
+                "kv_pages_dropped_total": self.dropped_total,
+                "kv_occupancy": (reserved / self.max_pages)
+                if self.max_pages > 0 else 0.0,
+                "kv_pages_peak": self.peak_reserved,
+                "kv_occupancy_peak": (self.peak_reserved / self.max_pages)
+                if self.max_pages > 0 else 0.0,
+            }
+
+
+def resolve_export(export: Dict[str, Any],
+                   get: Optional[Callable] = None) -> List[int]:
+    """Materialize an exported paged state back into the full token
+    list: pulls each page (transfer plane / spill restore as needed)
+    and concatenates with the tail.  Runs on the adopter's request
+    handler thread — never on the decode loop."""
+    if get is None:
+        import ray_tpu
+        get = lambda refs: ray_tpu.get(refs, timeout=60)  # noqa: E731
+    tokens: List[int] = []
+    pages = list(export.get("pages") or [])
+    if pages:
+        for page in get(pages):
+            tokens.extend(int(t) for t in np.asarray(page["t"]).tolist())
+    tokens.extend(int(t) for t in (export.get("tail") or []))
+    return tokens

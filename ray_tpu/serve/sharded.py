@@ -1,0 +1,251 @@
+"""Gang-scheduled sharded replicas: one serve replica = N shard workers.
+
+A deployment with ``num_shards > 1`` no longer maps a replica onto one
+actor but onto a **gang**: rank 0 is an ordinary :class:`ServeReplica`
+(it fronts the router, owns the continuous batcher and the KV page
+table) and ranks 1..N-1 are :class:`ShardGangWorker` actors, each
+holding one tensor-parallel shard of the model (the engine's
+``shard_step``/``combine`` gang protocol; ``toy_decoder.ToyDecoderShard``
+is the reference).  The controller creates every member of the gang
+before waiting on any of them, so a gang's bring-up rides ONE batched
+registration + one pipelined bring-up wave on the control plane (PR 9),
+and members are placed with SPREAD so shards land on distinct nodes
+when the cluster has them.
+
+Decode data path (per step): rank 0 puts the step inputs once and
+passes the ref to every shard — the PR-2 transfer plane turns the
+1->N fan-out into a broadcast, and concurrent pullers chain off each
+other instead of hammering rank 0.  Rank 0 computes its own slice
+while the remote slices are in flight, then gathers and combines.
+
+All-or-nothing fault model: any shard death kills the WHOLE gang.
+Rank 0 exits the moment a fan-out sees ``ActorDiedError`` (or its
+background monitor does, for idle gangs); the router observes a dead
+replica, retries in-flight requests against surviving replicas, and
+the controller reaps the remaining members and respawns a fresh gang.
+KV pages owned by the dead rank 0 are freed by owner-death cleanup —
+no leak.
+
+Chaos hook: the ``serve.shard.step_fail`` failpoint sits in
+``shard_step`` so a test can SIGKILL exactly one shard mid-request
+(``make chaos`` does; zero client requests may fail).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
+from ray_tpu.util import failpoint as _fp
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ShardGangWorker", "ShardedEngine", "GangShardDied"]
+
+
+class GangShardDied(Exception):
+    """A gang member died; the whole gang is going down with it."""
+
+
+def _build_engine(pickled_callable: bytes, init_args: tuple,
+                  init_kwargs: dict, rank: int, world: int) -> Any:
+    """Instantiate one rank's engine shard.  The sharded-engine
+    protocol: the deployment target accepts ``rank``/``world`` kwargs
+    and exposes ``shard_step`` (every rank) + ``combine`` (rank 0)."""
+    target = cloudpickle.loads(pickled_callable)
+    if not isinstance(target, type):
+        raise TypeError("num_shards > 1 requires a class deployment "
+                        "implementing the sharded-engine protocol")
+    return target(*init_args, **{**init_kwargs,
+                                 "rank": rank, "world": world})
+
+
+@ray_tpu.remote
+class ShardGangWorker:
+    """Rank >= 1 of a gang: holds one model shard, answers
+    ``shard_step`` fan-outs from rank 0."""
+
+    def __init__(self, pickled_callable: bytes, init_args: tuple,
+                 init_kwargs: dict, rank: int, world: int,
+                 deployment: str = ""):
+        self._deployment = deployment
+        self.rank = rank
+        self.world = world
+        self._engine = _build_engine(pickled_callable, init_args,
+                                     init_kwargs, rank, world)
+
+    def shard_step(self, step_inputs) -> Any:
+        """One decode step's slice.  ``step_inputs`` arrives as an
+        ObjectRef argument (resolved by the worker — the broadcast
+        path), carrying ``(tokens, lengths, active)``."""
+        _fp.failpoint("serve.shard.step_fail")
+        tokens, lengths, active = step_inputs
+        return self._engine.shard_step(tokens, lengths, active)
+
+    @ray_tpu.method(concurrency_group="control")
+    def ping(self) -> int:
+        return self.rank
+
+    @ray_tpu.method(concurrency_group="control")
+    def ready(self) -> bool:
+        return True
+
+    @ray_tpu.method(concurrency_group="control")
+    def node_id(self) -> Optional[str]:
+        try:
+            return ray_tpu.get_runtime_context().get_node_id()
+        except Exception:  # noqa: BLE001 — placement introspection only
+            return None
+
+    @ray_tpu.method(concurrency_group="control")
+    def arm_failpoint(self, name: str, action: str = "raise",
+                      **options) -> bool:
+        """Arm a failpoint in THIS shard only (chaos tooling)."""
+        _fp.arm(name, action, **options)
+        return True
+
+
+class ShardedEngine:
+    """Rank 0's engine wrapper: presents the ordinary continuous-
+    batching engine protocol to the batcher while fanning each step
+    out over the gang.
+
+    ``begin_request``/``finish_request``/``prefill``/``kv_page_payload``
+    and the token attributes delegate to the local rank-0 shard; only
+    ``step`` is distributed.
+    """
+
+    #: seconds between background liveness sweeps over the gang (an
+    #: idle gang must still honor all-or-nothing: a dead shard kills
+    #: rank 0 even with no request in flight)
+    _MONITOR_PERIOD_S = 1.0
+
+    def __init__(self, pickled_callable: bytes, init_args: tuple,
+                 init_kwargs: dict, num_shards: int, deployment: str = ""):
+        self._deployment = deployment
+        self.num_shards = int(num_shards)
+        self._local = _build_engine(pickled_callable, init_args,
+                                    init_kwargs, 0, self.num_shards)
+        self._shards: List[Any] = []       # rank-ordered, ranks 1..N-1
+        self._attached = threading.Event()
+        self._stop = threading.Event()
+        self._steps = 0
+
+    # -- delegation to the rank-0 shard ------------------------------------
+    @property
+    def eos_token(self):
+        return getattr(self._local, "eos_token", None)
+
+    @property
+    def pad_token(self):
+        return getattr(self._local, "pad_token", 0)
+
+    def begin_request(self, payload: Any) -> Dict[str, Any]:
+        return self._local.begin_request(payload)
+
+    def finish_request(self, state: Dict[str, Any]) -> Any:
+        return self._local.finish_request(state)
+
+    def __getattr__(self, name: str):
+        # optional protocol hooks (prefill, kv_page_payload, ...) come
+        # from the local shard; missing ones stay missing so hasattr
+        # checks in the batcher behave as for a plain engine
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._local, name)
+
+    # -- gang lifecycle ----------------------------------------------------
+    def attach(self, shard_handles: List[Any]) -> bool:
+        """Controller hands over the rank 1..N-1 actor handles once the
+        whole gang reported ready (all-or-nothing bring-up)."""
+        if len(shard_handles) != self.num_shards - 1:
+            raise ValueError(
+                f"gang of {self.num_shards} needs {self.num_shards - 1} "
+                f"shard workers, got {len(shard_handles)}")
+        self._shards = list(shard_handles)
+        self._attached.set()
+        if self._shards:
+            threading.Thread(target=self._monitor,
+                             name="rtpu-gang-monitor", daemon=True).start()
+        return True
+
+    def shard_ids(self) -> List[bytes]:
+        return [h.actor_id.binary() for h in self._shards]
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _gang_suicide(self, why: str) -> None:
+        """All-or-nothing: take rank 0 (and with it the whole replica)
+        down NOW.  The router sees an ActorDiedError and retries the
+        in-flight requests elsewhere; the controller reaps the gang and
+        respawns it."""
+        logger.error("gang member died (%s): killing rank 0 of %s",
+                     why, self._deployment or "<deployment>")
+        os._exit(1)
+
+    def _monitor(self) -> None:
+        """Liveness sweep so an IDLE gang still honors all-or-nothing
+        (a busy gang discovers death faster, on the step fan-out)."""
+        while not self._stop.wait(self._MONITOR_PERIOD_S):
+            try:
+                refs = [h.ping.remote() for h in self._shards]
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=10.0)
+                for ref in ready:
+                    ray_tpu.get(ref, timeout=5.0)
+            except (ActorDiedError, WorkerCrashedError) as e:
+                if not self._stop.is_set():
+                    self._gang_suicide(f"monitor: {type(e).__name__}")
+            except Exception:  # noqa: BLE001 — transient (teardown,
+                pass  # slow node): the next sweep or fan-out decides
+
+    #: step inputs at or above this many bytes broadcast as ONE arena
+    #: object (each shard pulls the same ref — the PR-2 transfer plane
+    #: turns the 1->N fan-out into a broadcast tree); smaller inputs
+    #: inline straight into the task specs, skipping the put + resolve
+    #: round trip that would dominate a small-batch step
+    _BROADCAST_MIN_BYTES = 64 * 1024
+
+    def _step_payload(self, tokens, lengths, active):
+        try:
+            nbytes = (tokens.nbytes + lengths.nbytes + active.nbytes)
+        except AttributeError:
+            nbytes = self._BROADCAST_MIN_BYTES
+        payload = (tokens, lengths, active)
+        if nbytes >= self._BROADCAST_MIN_BYTES:
+            return ray_tpu.put(payload)
+        return payload
+
+    # -- the distributed step ----------------------------------------------
+    def step(self, tokens, lengths, active):
+        """One decode step over the gang: broadcast inputs (by ref for
+        large batches, inline for small ones), run the local slice
+        while remote slices compute, gather, combine."""
+        if not self._attached.is_set():
+            # bring-up race: the controller routes only after attach,
+            # but a direct handle could beat it — wait briefly
+            if not self._attached.wait(timeout=30.0):
+                raise RuntimeError("gang shards never attached")
+        payload = self._step_payload(tokens, lengths, active)
+        try:
+            remote = [h.shard_step.remote(payload)
+                      for h in self._shards]
+            local = self._local.shard_step(tokens, lengths, active)
+            parts = [local] + list(ray_tpu.get(remote, timeout=60.0))
+        except (ActorDiedError, WorkerCrashedError) as e:
+            self._gang_suicide(f"step: {type(e).__name__}")
+            raise  # unreachable (suicide) — keeps control flow explicit
+        self._steps += 1
+        return self._local.combine(parts, active)
+
+    def gang_stats(self) -> Dict[str, Any]:
+        return {"num_shards": self.num_shards,
+                "gang_steps": self._steps,
+                "attached": self._attached.is_set()}

@@ -64,6 +64,16 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_store_restored_bytes_total",
     "ray_tpu_store_spill_objects",
     "ray_tpu_store_shard_contention_total",
+    # sharded serving plane: KV/gang series need a sharded or paged
+    # deployment serving traffic; gcs_respawns needs a head death
+    "ray_tpu_serve_kv_pages_active",
+    "ray_tpu_serve_kv_pages_allocated_total",
+    "ray_tpu_serve_kv_pages_freed_total",
+    "ray_tpu_serve_kv_page_occupancy",
+    "ray_tpu_serve_gang_bringup_seconds",
+    "ray_tpu_serve_gang_shards",
+    "ray_tpu_serve_gang_deaths_total",
+    "ray_tpu_gcs_respawns_total",
     # streaming data plane: series only appear once a streaming dataset
     # executes (and locality routing needs multi-node block placement)
     "ray_tpu_data_blocks_in_flight",

@@ -354,6 +354,51 @@ def test_acked_mutation_survives_immediate_sigkill():
         c.shutdown()
 
 
+def test_head_supervisor_auto_respawns_gcs():
+    """ROADMAP item 4 remainder (ISSUE 14 satellite): the head
+    SUPERVISOR — not the test harness — restarts a died GCS.  SIGKILL
+    the head; the armed HeadSupervisor respawns it on the same port and
+    PR-11 recovery takes over: durable kv restores, the driver
+    reconnects, actors keep answering."""
+    from ray_tpu.cluster_utils import Cluster
+
+    # 0-CPU head: actors live on the side node and survive the head
+    # SIGKILL (the PR-11 headless topology) — what dies and comes back
+    # is only the control plane
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    try:
+        c.add_node(num_cpus=2)
+        c.connect()
+        c.wait_for_nodes()
+        sup = c.supervise_head()
+        gw = _gw()
+        gw.gcs_call("kv_put", {"key": "sup-durable", "value": b"v",
+                               "namespace": "t"})
+
+        @ray_tpu.remote(max_restarts=3)
+        class Pinger:
+            def ping(self):
+                return "pong"
+
+        a = Pinger.options(lifetime="detached", name="sup-pinger").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+        c.head.kill()  # unexpected death — nobody calls restart_head
+        wait_for_condition(lambda: sup.respawns >= 1, timeout=60)
+        assert c.head.proc.poll() is None  # a LIVE respawned head
+
+        def recovered():
+            return gw.gcs_call("kv_get", {"key": "sup-durable",
+                                          "namespace": "t"}) == b"v"
+        wait_for_condition(recovered, timeout=60)
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+        # intentional shutdown must NOT trigger another respawn
+        sup.stop()
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # headless serving: the serve plane answers while the head is down
 # ---------------------------------------------------------------------------

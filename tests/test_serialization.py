@@ -74,3 +74,70 @@ def test_multiple_buffers():
 def test_corrupt_magic_rejected():
     with pytest.raises(ValueError):
         deserialize(b"XXXXXXXX" + b"\x00" * 100)
+
+
+# ---------------------------------------------------------------------------
+# jax-array fast path (ISSUE 14 satellite: zero-copy put from device
+# buffers — sharded/committed arrays no longer densify through the
+# cloudpickle stream)
+# ---------------------------------------------------------------------------
+def _fast_path_used(value):
+    """The buffer fast path produces exactly one out-of-band buffer
+    and a tiny meta pickle; the cloudpickle fallback inlines the data."""
+    from ray_tpu.core.serialization import _serialize_buffer_fast
+
+    return _serialize_buffer_fast(value)
+
+
+def test_jax_cpu_array_fast_path_intact():
+    import jax.numpy as jnp
+
+    arr = jnp.arange(4096, dtype=jnp.float32).reshape(64, 64)
+    ser = _fast_path_used(arr)
+    assert ser is not None and len(ser.buffers) == 1
+    out = roundtrip(arr)
+    assert np.array_equal(np.asarray(out), np.asarray(arr))
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+
+
+def test_jax_sharded_array_takes_fast_path():
+    """A multi-device (committed) array — the conftest 8-CPU-device
+    mesh stands in for TPU chips — rides the fast path: one gather,
+    payload out-of-band, roundtrip equality."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    mesh = build_mesh(MeshConfig(tp=-1))
+    arr = jnp.arange(64 * 64, dtype=jnp.float32).reshape(64, 64)
+    sharded = jax.device_put(arr, NamedSharding(mesh, P(None, "tp")))
+    assert len(sharded.devices()) > 1  # genuinely multi-device
+    ser = _fast_path_used(sharded)
+    assert ser is not None and len(ser.buffers) == 1, \
+        "sharded array fell back to cloudpickle"
+    out = roundtrip(sharded)
+    assert np.array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_jax_bfloat16_sharded_roundtrip():
+    """Extended dtypes (no buffer protocol) still roundtrip through
+    the uint8 reinterpret on the device branch."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    mesh = build_mesh(MeshConfig(tp=-1))
+    arr = jnp.arange(1024, dtype=jnp.bfloat16).reshape(8, 128)
+    sharded = jax.device_put(arr, NamedSharding(mesh, P(None, "tp")))
+    out = roundtrip(sharded)
+    assert out.dtype == arr.dtype
+    assert np.array_equal(np.asarray(out, dtype=np.float32),
+                          np.asarray(arr, dtype=np.float32))

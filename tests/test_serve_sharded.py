@@ -1,0 +1,428 @@
+"""Sharded serving plane tests (ISSUE 14 / ROADMAP item 1): gang
+replicas over the batched bring-up plane, paged KV cache in the arena,
+prefill/decode disaggregation, streaming warmup, and the shard-SIGKILL
+chaos case (in ``make chaos``)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.batching import BatchingConfig, ContinuousBatcher
+from ray_tpu.serve.kv_cache import KVPageTable, resolve_export
+from ray_tpu.serve.toy_decoder import (ToyDecoder, ToyDecoderShard,
+                                       make_prompt)
+
+
+# ---------------------------------------------------------------------------
+# unit tests (no cluster)
+# ---------------------------------------------------------------------------
+class _FakeStore:
+    """In-memory stand-in for the arena: put/free/get by token."""
+
+    def __init__(self):
+        self.objects = {}
+        self.next = 0
+
+    def put(self, value):
+        key = self.next
+        self.next += 1
+        self.objects[key] = value
+        return key
+
+    def free(self, refs):
+        for r in refs:
+            self.objects.pop(r, None)
+
+    def get(self, refs):
+        return [self.objects[r] for r in refs]
+
+
+def test_kv_page_table_accounting():
+    """Pages seal per page_tokens, free on release, and the allocated/
+    freed/handed-off/adopted ledgers balance (the no-leak invariant)."""
+    store = _FakeStore()
+    t = KVPageTable(4, 8, "t", put=store.put, free=store.free)
+    t.begin("r1", list(range(9)))          # 2 full pages + tail [8]
+    assert len(store.objects) == 2
+    assert np.asarray(store.objects[0]["t"]).tolist() == [0, 1, 2, 3]
+    for tok in (9, 10, 11):                # tail fills -> third page
+        t.append("r1", tok)
+    assert len(store.objects) == 3
+    # handoff exports refs without freeing; adoption reuses the SAME
+    # objects (cache survives migration); release drops the borrow
+    export = t.handoff("r1")
+    tokens = resolve_export(export, get=store.get)
+    assert tokens == list(range(12))
+    t2 = KVPageTable(4, 8, "t2", put=store.put, free=store.free)
+    t2.adopt("r1", export, tokens)
+    assert t2.stats()["kv_pages_active"] == 3
+    # decode-generated tokens seal OWNED pages on the adopted entry
+    for tok in (20, 21, 22, 23):
+        t2.append("r1", tok)
+    assert t2.stats()["kv_pages_active"] == 4
+    assert t2.release("r1") == 4
+    s2 = t2.stats()
+    assert s2["kv_pages_active"] == 0
+    # adopted borrows count as DROPPED, never freed; the page sealed
+    # here frees for real — the adopter's own allocated == freed
+    # invariant stays exact
+    assert s2["kv_pages_dropped_total"] == 3
+    assert s2["kv_pages_freed_total"] == 1
+    assert s2["kv_pages_allocated_total"] == 1
+    assert t.stats()["kv_pages_active"] == 0
+    assert t.stats()["kv_pages_handed_off_total"] == 3
+    # owned pages free through the store
+    t.begin("r2", list(range(8)))
+    assert t.release("r2") == 2
+    assert len(store.objects) == 3  # only the handed-off pages remain
+    s = t.stats()
+    assert s["kv_pages_allocated_total"] == \
+        s["kv_pages_freed_total"] + s["kv_pages_handed_off_total"]
+
+
+def test_kv_budget_gates_admission():
+    """A request whose worst-case page demand exceeds the free budget
+    stays QUEUED (not shed, not failed) until eviction frees pages —
+    admission by page pinning instead of cache re-padding."""
+    store = _FakeStore()
+    eng = ToyDecoder()
+    # budget of 3 pages x 8 tokens: one request (4 prompt + 12 new =
+    # 2 pages) fits; two concurrent do not
+    table = KVPageTable(8, 3, "t", put=store.put, free=store.free,
+                        kv_payload=eng.kv_page_payload)
+    cfg = BatchingConfig(max_batch_size=4, max_seq_len=32,
+                         kv_page_tokens=8, kv_max_pages=3)
+    b = ContinuousBatcher(eng, cfg, "t", kv_table=table)
+    try:
+        f1 = b.submit({"prompt": make_prompt(0, 4),
+                       "max_new_tokens": 12}, deadline_s=30.0)
+        f2 = b.submit({"prompt": make_prompt(1, 4),
+                       "max_new_tokens": 12}, deadline_s=30.0)
+        out1 = f1.result(timeout=30)
+        out2 = f2.result(timeout=30)
+        assert out1["tokens"] and out2["tokens"]
+        # both ran despite the budget; the table drained clean
+        deadline = time.monotonic() + 5
+        while table.stats()["kv_pages_active"] and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        s = table.stats()
+        assert s["kv_pages_active"] == 0
+        assert s["kv_pages_allocated_total"] >= 2
+        assert s["kv_pages_allocated_total"] == s["kv_pages_freed_total"]
+        assert not store.objects  # nothing leaked in the arena stand-in
+    finally:
+        b.stop()
+
+
+def test_sharded_toy_decoder_matches_unsharded():
+    """Column-sharded gang math is byte-identical to the single-chip
+    engine: same greedy tokens for every prompt, at world 2 and 4."""
+    ref = ToyDecoder()
+    for world in (2, 4):
+        shards = [ToyDecoderShard(rank=r, world=world)
+                  for r in range(world)]
+        for i in range(4):
+            payload = {"prompt": make_prompt(i), "max_new_tokens": 10}
+            expect = ref.generate_unbatched(dict(payload))
+            state = shards[0].begin_request(dict(payload))
+            while True:
+                seq = state["tokens"]
+                bucket = next(b for b in (8, 16, 32, 64)
+                              if len(seq) + 1 <= b)
+                tokens = np.full((1, bucket), 0, dtype=np.int32)
+                tokens[0, :len(seq)] = seq
+                lengths = np.asarray([len(seq)], dtype=np.int32)
+                active = np.asarray([True])
+                parts = [s.shard_step(tokens, lengths, active)
+                         for s in shards]
+                nxt = int(np.asarray(
+                    shards[0].combine(parts, active))[0])
+                seq.append(nxt)
+                if nxt == ref.eos_token or \
+                        len(seq) - state["prompt_len"] >= 10:
+                    break
+            got = shards[0].finish_request(state)
+            assert got["tokens"] == expect["tokens"], (world, i)
+
+
+# ---------------------------------------------------------------------------
+# multi-node mini-cluster
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def sharded_cluster():
+    """Head + 2 worker nodes so gangs and transfers actually cross
+    raylet boundaries."""
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    for _ in range(2):
+        c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _serve_cleanup(request):
+    yield
+    if "sharded_cluster" in request.fixturenames:
+        serve.shutdown()
+
+
+BATCHING = {"max_batch_size": 4, "max_seq_len": 64,
+            "kv_page_tokens": 8, "kv_max_pages": 64}
+
+
+def _reference_outputs(prompts, max_new=10):
+    ref = ToyDecoder()
+    return [ref.generate_unbatched({"prompt": list(p),
+                                    "max_new_tokens": max_new})
+            for p in prompts]
+
+
+def _wait_kv_drained(name, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        info = serve.status().get(name)
+        if info is not None and info.get("kv_pages_active", 0) == 0:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_gang_deployment_serves(sharded_cluster, world):
+    """A num_shards=2 (and 4) toy-decoder deployment serves correctly
+    behind the existing router: byte-identical outputs, gang bookkept
+    by the controller, zero live KV pages after the drain."""
+    name = f"gang{world}"
+    dep = serve.deployment(
+        name=name, max_concurrent_queries=32,
+        batching=dict(BATCHING), num_shards=world)(ToyDecoderShard)
+    handle = serve.run(dep.bind())
+    prompts = [make_prompt(i) for i in range(5)]
+    expect = _reference_outputs(prompts)
+    for p, e in zip(prompts, expect):
+        out = handle.call({"prompt": list(p), "max_new_tokens": 10},
+                          timeout=60)
+        assert out["tokens"] == e["tokens"]
+    info = serve.status()[name]
+    assert info["num_shards"] == world
+    assert info["num_replicas"] == 1
+    # the gang exists: rank0 reports attached shards and gang steps
+    from ray_tpu.serve._internal import CONTROLLER_NAME
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    table = ray_tpu.get(
+        controller.get_routing_table.remote(-1, 1.0), timeout=30)
+    entry = table["table"][name]
+    assert entry["num_shards"] == world
+    m = ray_tpu.get(entry["replicas"][0].metrics.remote(), timeout=30)
+    assert m["num_shards"] == world and m["attached"]
+    assert m["gang_steps"] > 0
+    assert m["kv_pages_allocated_total"] > 0
+    assert _wait_kv_drained(name), "leaked KV pages after drain"
+    serve.delete(name)
+
+
+def test_gang_http_and_proxy(sharded_cluster):
+    """The HTTP ingress path works unchanged over a gang replica."""
+    import json
+    import urllib.request
+
+    from ray_tpu.serve.http_proxy import start_proxy
+
+    dep = serve.deployment(
+        name="gang_http", max_concurrent_queries=32,
+        batching=dict(BATCHING), num_shards=2)(ToyDecoderShard)
+    serve.run(dep.bind())
+    host, port = start_proxy()
+    payload = {"prompt": make_prompt(3), "max_new_tokens": 8}
+    req = urllib.request.Request(
+        f"http://{host}:{port}/gang_http",
+        data=json.dumps(payload).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        body = json.loads(resp.read())
+    expect = _reference_outputs([payload["prompt"]], 8)[0]
+    assert body["result"]["tokens"] == expect["tokens"]
+    serve.delete("gang_http")
+
+
+def test_prefill_decode_disaggregation(sharded_cluster):
+    """prefill_replicas=1 splits the prompt pass onto a prefill tier:
+    outputs stay byte-identical, pages stream decode-ward as refs
+    (prefill hands off exactly what decode adopts), nothing leaks."""
+    dep = serve.deployment(
+        name="disagg", max_concurrent_queries=32,
+        batching=dict(BATCHING), prefill_replicas=1)(ToyDecoder)
+    handle = serve.run(dep.bind())
+    prompts = [make_prompt(i, 12) for i in range(4)]
+    expect = _reference_outputs(prompts)
+    for p, e in zip(prompts, expect):
+        out = handle.call({"prompt": list(p), "max_new_tokens": 10},
+                          timeout=60)
+        assert out["tokens"] == e["tokens"]
+    st = serve.status()
+    assert "disagg--prefill" in st
+    assert st["disagg--prefill"]["role"] == "prefill"
+    # page flow: prefill handed off pages, decode adopted them
+    from ray_tpu.serve._internal import CONTROLLER_NAME
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    table = ray_tpu.get(
+        controller.get_routing_table.remote(-1, 1.0), timeout=30)
+    pre = ray_tpu.get(table["table"]["disagg--prefill"]["replicas"][0]
+                      .metrics.remote(), timeout=30)
+    dec = ray_tpu.get(table["table"]["disagg"]["replicas"][0]
+                      .metrics.remote(), timeout=30)
+    assert pre["prefill_kv_pages_handed_off_total"] > 0
+    assert dec["kv_pages_adopted_total"] == \
+        pre["prefill_kv_pages_handed_off_total"]
+    assert _wait_kv_drained("disagg")
+    assert _wait_kv_drained("disagg--prefill")
+    serve.delete("disagg")
+
+
+def test_prefill_death_spares_decode_replica(sharded_cluster):
+    """A dead PREFILL replica must not poison the healthy decode
+    replica: requests recover once the controller respawns the prefill
+    tier, and the decode replica is never replaced (it was never
+    marked dead)."""
+    dep = serve.deployment(
+        name="pd_ft", max_concurrent_queries=32,
+        batching=dict(BATCHING), prefill_replicas=1)(ToyDecoder)
+    handle = serve.run(dep.bind())
+    payload = {"prompt": make_prompt(1, 8), "max_new_tokens": 6}
+    expect = _reference_outputs([payload["prompt"]], 6)[0]
+    assert handle.call(dict(payload), timeout=60)["tokens"] == \
+        expect["tokens"]
+    from ray_tpu.serve._internal import CONTROLLER_NAME
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    table = ray_tpu.get(
+        controller.get_routing_table.remote(-1, 1.0), timeout=30)
+    decode_id = table["table"]["pd_ft"]["replicas"][0].actor_id.binary()
+    ray_tpu.kill(table["table"]["pd_ft--prefill"]["replicas"][0])
+    # recover: the prefill tier respawns; client-level retry rides out
+    # the window; the decode replica must survive untouched
+    deadline = time.monotonic() + 60
+    ok = False
+    while time.monotonic() < deadline:
+        try:
+            out = handle.call(dict(payload), timeout=30)
+            ok = out["tokens"] == expect["tokens"]
+            break
+        except Exception:  # noqa: BLE001 — respawn window
+            time.sleep(0.5)
+    assert ok, "requests never recovered after prefill replica death"
+    table = ray_tpu.get(
+        controller.get_routing_table.remote(-1, 1.0), timeout=30)
+    now_id = table["table"]["pd_ft"]["replicas"][0].actor_id.binary()
+    assert now_id == decode_id, \
+        "healthy decode replica was replaced after a prefill death"
+    serve.delete("pd_ft")
+
+
+def test_serve_warmup_streaming(sharded_cluster):
+    """serve.warmup streams a Dataset through the replicas via
+    iter_batches(streaming=True) — the corpus reaches the engine
+    batch by batch instead of materializing in the arena."""
+    import ray_tpu.data as rdata
+
+    class Recorder:
+        def __init__(self):
+            self.rows = 0
+
+        def warmup_batch(self, batch):
+            # numpy batch format: {column -> array}
+            n = len(next(iter(batch.values())))
+            self.rows += n
+            return n
+
+        def __call__(self, payload):
+            return self.rows
+
+    dep = serve.deployment(name="warm", num_replicas=1)(Recorder)
+    handle = serve.run(dep.bind())
+    ds = rdata.range(64, parallelism=4)
+    batches = serve.warmup("warm", ds, batch_size=16)
+    assert batches == 4
+    # the replica saw every row, streamed
+    assert handle.call(None, timeout=30) == 64
+    serve.delete("warm")
+
+
+@pytest.mark.failpoints
+def test_gang_chaos_shard_sigkill(sharded_cluster):
+    """Chaos acceptance: SIGKILL one shard mid-request.  The whole
+    gang dies (all-or-nothing), the router retries onto the surviving
+    replica — ZERO failed client requests — the controller respawns a
+    fresh gang, and no KV page leaks."""
+    dep = serve.deployment(
+        name="chaos_gang", max_concurrent_queries=32,
+        batching=dict(BATCHING), num_shards=2,
+        num_replicas=2)(ToyDecoderShard)
+    handle = serve.run(dep.bind())
+
+    from ray_tpu.serve._internal import CONTROLLER_NAME
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    table = ray_tpu.get(
+        controller.get_routing_table.remote(-1, 1.0), timeout=30)
+    replicas = table["table"]["chaos_gang"]["replicas"]
+    assert len(replicas) == 2
+    rank0_ids = {r.actor_id.binary() for r in replicas}
+    # arm the kill in ONE shard of ONE gang: the 3rd step it serves
+    # dies mid-request (requests are in flight by then)
+    victim_rank0 = replicas[0]
+    shard_ids = ray_tpu.get(victim_rank0.metrics.remote(), timeout=30)
+    gang_members = ray_tpu.get(
+        controller.get_gang_members.remote(
+            victim_rank0.actor_id.binary()), timeout=30)
+    assert len(gang_members) == 1
+    ray_tpu.get(gang_members[0].arm_failpoint.remote(
+        "serve.shard.step_fail", "kill", count=1, skip=2), timeout=30)
+
+    prompts = [make_prompt(i) for i in range(12)]
+    expect = _reference_outputs(prompts)
+    results: dict = {}
+    errors: list = []
+
+    def client(idx):
+        try:
+            results[idx] = handle.call(
+                {"prompt": list(prompts[idx]), "max_new_tokens": 10},
+                timeout=120)
+        except Exception as e:  # noqa: BLE001 — the assertion below
+            errors.append((idx, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors, f"client requests failed: {errors}"
+    for i, e in enumerate(expect):
+        assert results[i]["tokens"] == e["tokens"], i
+
+    # the gang respawned: back to 2 replicas, at least one rank0 is new
+    deadline = time.monotonic() + 120
+    respawned = False
+    while time.monotonic() < deadline:
+        table = ray_tpu.get(
+            controller.get_routing_table.remote(-1, 1.0), timeout=30)
+        now_ids = {r.actor_id.binary()
+                   for r in table["table"]["chaos_gang"]["replicas"]}
+        if len(now_ids) == 2 and now_ids != rank0_ids:
+            respawned = True
+            break
+        time.sleep(0.5)
+    assert respawned, "gang did not respawn after shard SIGKILL"
+    assert _wait_kv_drained("chaos_gang", timeout=30), \
+        "leaked KV pages after gang death"
+    del shard_ids
+    serve.delete("chaos_gang")
