@@ -8,7 +8,8 @@
 #   make asan   — AddressSanitizer+UBSan build + run
 .PHONY: all native check test chaos bench bench-transfer bench-serve \
 	bench-serve-sharded bench-rl bench-controlplane bench-store \
-	bench-ha bench-data metrics-smoke tsan asan sanitize clean
+	bench-ha bench-data metrics-smoke metrics-history-smoke tsan asan \
+	sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -43,6 +44,7 @@ chaos: native
 	  tests/test_tracing.py tests/test_rllib_pipeline.py \
 	  tests/test_controlplane_scale.py tests/test_store_scale.py \
 	  tests/test_gcs_ha.py tests/test_data_streaming.py \
+	  tests/test_metrics_history.py \
 	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 
@@ -110,6 +112,12 @@ bench-ha: native
 # accidental metric renames; update deliberately with --update).
 metrics-smoke: native
 	JAX_PLATFORMS=cpu python scripts/metrics_smoke.py
+
+# Boot a mini-cluster, wait two history sample intervals, assert
+# /api/timeseries returns >=2 points for a traffic-independent series
+# and /healthz verdicts ok (docs/observability.md).
+metrics-history-smoke: native
+	JAX_PLATFORMS=cpu python scripts/metrics_history_smoke.py
 
 build/store_stress_tsan: $(SAN_SRCS)
 	@mkdir -p build

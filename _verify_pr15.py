@@ -1,0 +1,143 @@
+"""PR-15 verification driver: the cluster health plane, end to end.
+
+User-style: boots a real cluster, runs tenant work, serves an
+SLO-missing deployment, and consumes the health plane exactly the way
+an operator would — /api/timeseries, /api/alerts, /healthz over real
+HTTP, plus `ray-tpu top --once --jobs` and `ray-tpu alerts` frames.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import json  # noqa: E402
+import time  # noqa: E402
+import urllib.request  # noqa: E402
+
+t0 = time.perf_counter()
+
+
+def step(msg):
+    print(f"[{time.perf_counter() - t0:6.2f}s] {msg}", flush=True)
+
+
+import ray_tpu  # noqa: E402
+
+ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024,
+             _system_config={
+                 "metrics_report_period_s": 0.5,
+                 "metrics_history_interval_s": 0.5,
+                 "serve_slo_latency_s": 0.001,
+             })
+step("init done")
+
+import ray_tpu.core.worker as cw  # noqa: E402
+
+gw = cw.global_worker()
+job = gw.job_id.hex()
+
+
+@ray_tpu.remote
+def work(i):
+    t = time.time()
+    while time.time() - t < 0.005:
+        pass
+    return i * 2
+
+
+assert ray_tpu.get([work.remote(i) for i in range(16)],
+                   timeout=60) == [i * 2 for i in range(16)]
+ref = ray_tpu.put(bytes(1_500_000))
+step("tenant work done (16 tasks + 1.5MB put)")
+
+# serve an SLO-missing deployment and barrage it
+from ray_tpu import serve  # noqa: E402
+
+
+@serve.deployment
+def slow(x):
+    time.sleep(0.02)
+    return x
+
+
+handle = serve.run(slow.bind())
+assert ray_tpu.get([handle.remote(i) for i in range(25)],
+                   timeout=120) == list(range(25))
+step("serve barrage done (25 SLO-missing requests)")
+
+# health plane over real HTTP
+from ray_tpu.dashboard import Dashboard  # noqa: E402
+
+dash = Dashboard(port=0)
+url = dash.start()
+
+
+def get(path):
+    try:
+        with urllib.request.urlopen(url + path, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+deadline = time.time() + 30
+while time.time() < deadline:
+    _, alerts = get("/api/alerts")
+    if any(a["rule"] == "ServeSLOBurnRate" for a in alerts["firing"]):
+        break
+    time.sleep(0.5)
+assert any(a["rule"] == "ServeSLOBurnRate" for a in alerts["firing"]), \
+    alerts
+step(f"burn alert FIRING (value="
+     f"{alerts['firing'][0]['value']:.1f}x budget)")
+
+code, verdict = get("/healthz")
+assert code == 503 and verdict["status"] == "critical", (code, verdict)
+step(f"/healthz verdicts {verdict['status']} (503) while critical fires")
+
+_, rows = get("/api/timeseries?series=serve:p99_latency_s")
+assert rows and len(rows[0]["points"]) >= 1, rows
+step(f"/api/timeseries serve:p99={rows[0]['points'][-1][1] * 1e3:.1f}ms "
+     f"({len(rows[0]['points'])} points)")
+_, rows = get("/api/timeseries?series=cluster:alive_nodes")
+assert rows and len(rows[0]["points"]) >= 2 \
+    and rows[0]["points"][-1][1] == 1, rows
+step(f"/api/timeseries cluster:alive_nodes has "
+     f"{len(rows[0]['points'])} history points")
+
+# per-job attribution reached the table
+recs = gw.gcs_call("get_metrics", {})
+by = {}
+for r in recs:
+    if r["name"].startswith("ray_tpu_job_") \
+            and r.get("tags", {}).get("job") == job:
+        by[r["name"]] = by.get(r["name"], 0) + r.get("value", 0)
+assert by.get("ray_tpu_job_tasks_total", 0) >= 16, by
+assert by.get("ray_tpu_job_submitted_bytes_total", 0) >= 1_500_000, by
+assert by.get("ray_tpu_job_arena_bytes", 0) >= 1_500_000, by
+step(f"per-job attribution: {by['ray_tpu_job_tasks_total']:.0f} tasks, "
+     f"{by['ray_tpu_job_cpu_seconds_total']:.2f} cpu-s, "
+     f"{by['ray_tpu_job_arena_bytes'] / 1e6:.1f}MB arena for job {job}")
+
+# operator CLI frames (in-process, same cluster)
+from ray_tpu.scripts import cli  # noqa: E402
+
+frame = "\n".join(cli._render_top(gw, jobs=True))
+assert "health:" in frame and job in frame \
+    and "ServeSLOBurnRate" in frame, frame
+print("---- ray-tpu top --once --jobs ----")
+print(frame)
+print("-----------------------------------")
+step("top frame renders gauges + sparklines + jobs table")
+
+dash.stop()
+serve.shutdown()
+del ref
+t_sd = time.perf_counter()
+ray_tpu.shutdown()
+step(f"shutdown in {time.perf_counter() - t_sd:.2f}s")
+print("PR-15 VERIFY: OK")

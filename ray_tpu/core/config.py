@@ -240,6 +240,26 @@ class Config:
     #: GCS-side ring of transfer/RPC spans served to ``timeline()``.
     telemetry_spans_table_size: int = 20000
 
+    # ---- metrics history + alerting (core/metrics_history.py) ------------
+    #: Period of the GCS history sampler: each tick folds the merged
+    #: metrics table into per-series ring buffers (counters as deltas)
+    #: and re-evaluates recording + alert rules.
+    metrics_history_interval_s: float = 2.0
+    #: History retention window.  Ring capacity per series is
+    #: ``window / interval`` points — the memory bound is
+    #: ``series x capacity`` points, evictions are counted
+    #: (``ray_tpu_metrics_history_evicted_total``).
+    metrics_history_window_s: float = 300.0
+    #: Master switch for the history/alert plane (the GCS loop is a
+    #: no-op when off; ``/api/timeseries`` and ``ray-tpu alerts`` then
+    #: serve empty views).
+    metrics_history_enabled: bool = True
+    #: Error budget of the serve SLO burn-rate alert: the fraction of
+    #: requests allowed over ``serve_slo_latency_s``.  Burn rate =
+    #: observed miss fraction / budget; the built-in rule fires when it
+    #: sustains above 1.0.
+    serve_slo_error_budget: float = 0.01
+
     # ---- distributed tracing (core/tracing.py) ---------------------------
     #: Master switch for the native request-scoped tracing plane.  Off:
     #: no trace context is ever born, every hop short-circuits on its

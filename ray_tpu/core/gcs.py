@@ -61,6 +61,11 @@ class NodeInfo:
 #: ``autoscaler.sdk.request_resources`` bundles as a JSON list
 RESOURCE_REQUEST_KV_KEY = "__autoscaler_resource_request"
 
+#: internal-KV key (namespace ``_internal``) holding the JSON firing
+#: alert set — rewritten on every transition so a restarted GCS can
+#: re-seed its evaluator (docs/observability.md)
+ALERTS_FIRING_KV_KEY = "alerts_firing"
+
 ACTOR_PENDING = "PENDING_CREATION"
 ACTOR_ALIVE = "ALIVE"
 ACTOR_RESTARTING = "RESTARTING"
@@ -258,6 +263,29 @@ class GcsServer:
         #: this just tells recovery_state how many to expect
         self._wal_nodes: Dict[bytes, Dict[str, Any]] = {}
         self._restore_snapshot()
+        # metrics history + alert evaluator (core/metrics_history.py):
+        # constructed AFTER the restore so a firing set persisted by
+        # the previous incarnation (internal KV) seeds the evaluator —
+        # a firing alert survives a head SIGKILL as re-firing-or-
+        # resolved, never silently lost
+        from ray_tpu.core.metrics_history import MetricsHistory
+        restored_firing = None
+        try:
+            raw = self.kv.get("_internal", {}).get(ALERTS_FIRING_KV_KEY)
+            if raw:
+                import json as _json
+                restored_firing = _json.loads(raw.decode())
+        except Exception:  # noqa: BLE001 — corrupt state: start clean
+            logger.exception("restored alert state unreadable; ignored")
+        self._history = MetricsHistory(
+            interval_s=getattr(config, "metrics_history_interval_s", 2.0),
+            window_s=getattr(config, "metrics_history_window_s", 300.0),
+            slo_latency_s=getattr(config, "serve_slo_latency_s", 0.0),
+            slo_error_budget=getattr(config, "serve_slo_error_budget",
+                                     0.01),
+            restored_firing=restored_firing)
+        self._history_evicted_reported = 0
+        self._history_task: Optional[asyncio.Task] = None
 
     def _restore_snapshot(self) -> None:
         """Recovery: load the snapshot, replay the WAL on top (typed
@@ -587,6 +615,10 @@ class GcsServer:
         self._metrics_task = asyncio.get_running_loop().create_task(
             self._metrics_flush_loop()
         )
+        if getattr(self.config, "metrics_history_enabled", True):
+            self._history_task = asyncio.get_running_loop().create_task(
+                self._history_loop()
+            )
         # always-on profiling mode: the GCS process samples itself too
         _prof.maybe_start_from_config()
         if getattr(self.config, "event_stats", True):
@@ -618,6 +650,7 @@ class GcsServer:
         out["registration_batch_actors"] = self._reg_batch_actors
         out["persistence"] = self._persistence_health()
         out["recovery"] = dict(self._recovery)
+        out["history"] = self._history.stats()
         return out
 
     # -- versioned resource broadcast (parity: ray_syncer.h:27-60 —
@@ -657,6 +690,11 @@ class GcsServer:
                     and not _trace.pending():
                 continue
             try:
+                if self._history_task is None:
+                    # history plane off: stale-gauge pruning still has
+                    # to happen somewhere periodic (it used to live in
+                    # the read handler)
+                    self._sweep_stale_metrics()
                 if _tm.enabled():
                     _tm.set_gauge(
                         "ray_tpu_gcs_subscriber_channels",
@@ -701,6 +739,8 @@ class GcsServer:
             self._sync_task.cancel()
         if getattr(self, "_metrics_task", None):
             self._metrics_task.cancel()
+        if getattr(self, "_history_task", None):
+            self._history_task.cancel()
         if getattr(self, "_loop_monitor", None) is not None:
             self._loop_monitor.stop()
         if self._health_task:
@@ -1135,16 +1175,125 @@ class GcsServer:
         self._ingest_metrics(data.get("records", []))
         return True
 
-    async def handle_get_metrics(self, conn, data):
+    def _sweep_stale_metrics(self) -> None:
+        """Periodic stale-gauge pruning (a dead process's last value
+        must age out of the export).  Lives on the history tick — NOT
+        in the read handler, which used to delete entries mid-iteration
+        and would race the history sampler reading the same table."""
         now = time.monotonic()
-        out = []
         for key, rec in list(self._metrics.items()):
             if rec["type"] == "gauge" and \
                     now - rec.get("_ts", now) > self._GAUGE_STALE_S:
-                del self._metrics[key]  # dead process's last value
-                continue
-            out.append({k: v for k, v in rec.items() if k != "_ts"})
+                del self._metrics[key]
+
+    async def handle_get_metrics(self, conn, data):
+        # side-effect free (stale pruning happens in the periodic
+        # sweep): a read RPC must never mutate the table other readers
+        # and the history sampler iterate
+        return [{k: v for k, v in rec.items() if k != "_ts"}
+                for rec in self._metrics.values()]
+
+    # ------------------------------------------------------------------
+    # metrics history + alerting (core/metrics_history.py)
+    # ------------------------------------------------------------------
+    async def _history_loop(self) -> None:
+        """Sample tick of the cluster health plane: prune stale gauges,
+        fold the merged table into the history rings, re-evaluate
+        recording + alert rules, publish transitions, persist the
+        firing set.  A failed sample tick (failpoint
+        ``gcs.metrics_history.sample_fail``) skips the fold only — the
+        evaluator still runs, so alerting survives ingest trouble."""
+        hist = self._history
+        while True:
+            await asyncio.sleep(hist.interval_s)
+            now = time.time()
+            try:
+                self._sweep_stale_metrics()
+                try:
+                    if _fp.failpoint("gcs.metrics_history.sample_fail"):
+                        raise _fp.FailpointError(
+                            "gcs.metrics_history.sample_fail")
+                    hist.sample(self._metrics, now=now)
+                    # tick-local cluster gauges: these must not depend
+                    # on any process's flush loop being alive
+                    hist.observe("cluster:alive_nodes", sum(
+                        1 for n in self.nodes.values() if n.alive), now)
+                    hist.observe("cluster:actors_alive", sum(
+                        1 for a in self.actors.values()
+                        if a.state == ACTOR_ALIVE), now)
+                except Exception:  # noqa: BLE001 — skip, never wedge
+                    hist.sample_failures += 1
+                    _tm.history_sample_failure()
+                transitions = hist.evaluate(now=now)
+                st = hist.stats()
+                _tm.history_stats(
+                    st["points"], st["series"],
+                    hist.evicted_total - self._history_evicted_reported)
+                self._history_evicted_reported = hist.evicted_total
+                _tm.alerts_stats(st["alerts_firing"], len(transitions))
+                if transitions:
+                    self._on_alert_transitions(transitions)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("metrics history tick failed")
+
+    def _on_alert_transitions(self, transitions) -> None:
+        """Publish each transition on the ``alerts`` channel + event
+        log, then persist the new firing set so it survives a head
+        restart (the WAL record rides the next handler group-commit)."""
+        import json as _json
+
+        for t in transitions:
+            self.publish("alerts", t)
+            sev = "INFO" if t["to"] == "resolved" else (
+                "ERROR" if t["severity"] == "critical" else "WARNING")
+            tag_txt = " ".join(f"{k}={v}"
+                               for k, v in sorted(t["tags"].items()))
+            self._emit_event(
+                sev, "ALERT_" + t["to"].upper(),
+                f"alert {t['rule']} {t['from']} -> {t['to']}"
+                + (f" ({tag_txt})" if tag_txt else "")
+                + (f" value={t['value']:.4g}"
+                   if t.get("value") is not None else ""),
+                rule=t["rule"], **t["tags"])
+        blob = _json.dumps(self._history.export_firing()).encode()
+        self.kv.setdefault("_internal", {})[ALERTS_FIRING_KV_KEY] = blob
+        self._wal_append("kv_put", ("_internal", ALERTS_FIRING_KV_KEY,
+                                    blob, True))
+        self._schedule_persist()
+
+    async def handle_get_timeseries(self, conn, data):
+        data = data or {}
+        return self._history.query(
+            series=data.get("series"), since=data.get("since"),
+            limit=int(data.get("limit") or 200))
+
+    async def handle_get_alerts(self, conn, data):
+        out = self._history.alerts_view()
+        out["stats"] = self._history.stats()
         return out
+
+    async def handle_healthz(self, conn, data):
+        """One-word cluster verdict for probes: ``ok`` (nothing
+        firing), ``degraded`` (warnings firing or persistence
+        degraded), ``critical`` (a critical alert is firing)."""
+        firing = self._history.firing()
+        critical = [a["rule"] for a in firing
+                    if a["severity"] == "critical"]
+        degraded = bool(firing) or self._wal_degraded \
+            or self.table_storage.persist_failures > 0
+        status = "critical" if critical else (
+            "degraded" if degraded else "ok")
+        return {
+            "ok": not critical,
+            "status": status,
+            "firing": [a["rule"] for a in firing],
+            "alive_nodes": sum(1 for n in self.nodes.values()
+                               if n.alive),
+            "wal_degraded": self._wal_degraded,
+            "persist_failures": self.table_storage.persist_failures,
+        }
 
     async def handle_report_spans(self, conn, data):
         self._spans.extend(data.get("spans", []))

@@ -151,6 +151,12 @@ register_schema("clock_sync")
 register_schema("get_metrics")
 register_schema("get_spans", cat=Opt(str), limit=Opt(int))
 
+# metrics history + alerting plane (core/metrics_history.py)
+register_schema("get_timeseries", series=Opt(str), since=Opt(float),
+                limit=Opt(int))
+register_schema("get_alerts")
+register_schema("healthz")
+
 # distributed tracing plane (core/tracing.py -> GCS trace ring)
 register_schema("report_trace_spans", spans=list)
 register_schema("get_trace", trace_id=str)

@@ -313,6 +313,9 @@ class Raylet:
             shards=getattr(config, "store_metadata_shards", 0))
         self.store_capacity = store_capacity
         self._primary: Set[ObjectID] = set()  # pinned primaries
+        # jobs whose arena-bytes gauge was non-zero last flush (zeroed
+        # once their primaries drain — see _sample_job_arena_bytes)
+        self._job_arena_reported: Set[str] = set()
         self._owner_of: Dict[ObjectID, tuple] = {}  # id -> owner address tuple
         self._spilled: Dict[ObjectID, str] = {}  # id -> file path / uri
         self._spilled_sizes: Dict[ObjectID, int] = {}  # id -> payload bytes
@@ -2057,6 +2060,17 @@ class Raylet:
         _tm.set_gauge("ray_tpu_arena_num_objects",
                       "objects resident in the arena",
                       stats.get("num_objects", 0), tags)
+        cap = stats.get("capacity", 0)
+        _tm.set_gauge("ray_tpu_arena_capacity_bytes",
+                      "object-store arena capacity", cap, tags)
+        if cap:
+            # the arena-pressure signal the history plane's recording
+            # rule (cluster:arena_occupancy) and the ArenaPressure
+            # alert subscribe to
+            _tm.set_gauge("ray_tpu_arena_occupancy_fraction",
+                          "arena bytes used / capacity",
+                          stats.get("used", 0) / cap, tags)
+        self._sample_job_arena_bytes(tags)
         if "reuse_hits" in stats:
             hits = stats["reuse_hits"]
             misses = stats.get("reuse_misses", 0)
@@ -2083,6 +2097,44 @@ class Raylet:
         _tm.set_gauge("ray_tpu_store_spill_objects",
                       "objects resident in the spill tier",
                       len(self._spilled), tags)
+
+    #: primaries sampled per flush for the per-job arena rollup (the
+    #: gauge is approximate on nodes holding more; the cap bounds the
+    #: lease/release work a flush tick can do)
+    _JOB_ARENA_SAMPLE_CAP = 4096
+
+    def _sample_job_arena_bytes(self, tags) -> None:
+        """Per-job arena occupancy: sum primary-copy sizes by the job
+        embedded in each ObjectID.  Jobs reported last tick but gone
+        now are zeroed so their gauges age out instead of flushing a
+        stale value forever."""
+        per_job: Dict[str, int] = {}
+        primaries = list(self._primary)
+        truncated = len(primaries) > self._JOB_ARENA_SAMPLE_CAP
+        for oid in primaries[:self._JOB_ARENA_SAMPLE_CAP]:
+            lease = self.store.lease(oid)
+            if lease is None:
+                continue
+            _, size = lease
+            self.store.release(oid)
+            job = oid.job_id().hex()
+            per_job[job] = per_job.get(job, 0) + size
+        if truncated:
+            # a truncated sweep can MISS a job that still holds bytes:
+            # zeroing it would flap the gauge between truth and 0 as
+            # set order churns — keep last values (approximate but
+            # monotone-consistent) until the node drains below the cap
+            self._job_arena_reported |= {j for j, n in per_job.items()
+                                         if n}
+        else:
+            for job in self._job_arena_reported - set(per_job):
+                per_job[job] = 0  # drained: age the gauge out via 0
+            self._job_arena_reported = {j for j, n in per_job.items()
+                                        if n}
+        for job, nbytes in per_job.items():
+            _tm.set_gauge("ray_tpu_job_arena_bytes",
+                          "arena bytes held by primary copies, by "
+                          "owning job", nbytes, dict(tags, job=job))
 
     async def _metrics_flush_loop(self) -> None:
         """Batch registry deltas + spans to the GCS metrics/span tables
@@ -3124,6 +3176,9 @@ class Raylet:
             self._spilled_sizes[oid] = lsize
             self._spill_bytes += lsize
             _tm.store_spilled(lsize)
+            # per-job attribution: the owner job rides inside the id
+            # (ObjectID -> TaskID -> JobID lineage encoding)
+            _tm.job_spilled_bytes(oid.job_id().hex(), lsize)
             self.store.release(oid)  # the lease taken above
             self._primary.discard(oid)
             self.store.release(oid)  # drop the primary pin

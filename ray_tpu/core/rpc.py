@@ -118,7 +118,7 @@ IDEMPOTENT_METHODS = frozenset({
     "get_cluster_stats", "list_events", "object_contains", "list_workers",
     "list_objects", "stack_traces", "list_placement_groups",
     "get_object_locations", "object_pull_chunk", "clock_sync", "get_spans",
-    "get_trace", "list_traces",
+    "get_trace", "list_traces", "get_timeseries", "get_alerts", "healthz",
     # keyed / convergent mutations
     "register_node", "register_worker", "subscribe", "unsubscribe",
     "kv_put", "kv_del", "health_report", "actor_started",

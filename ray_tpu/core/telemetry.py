@@ -360,6 +360,106 @@ def task_events_dropped(job_id: Optional[str], n: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# per-job attribution (tenancy accounting — docs/observability.md):
+# counters tagged by job hex so consumption rolls up per tenant in the
+# GCS table and `ray-tpu top --jobs`.  Jobs are few (the tagset cap
+# guards runaways), and every helper is one cached-key counter inc.
+# ---------------------------------------------------------------------------
+
+_job_keys: Dict[str, Tuple] = {}
+
+
+def _jobkey(job: Optional[str]) -> Tuple:
+    job = job or "unknown"
+    key = _job_keys.get(job)
+    if key is None:
+        key = _job_keys[job] = (("job", job),)
+    return key
+
+
+def job_task_finished(job: Optional[str], exec_seconds: float) -> None:
+    """Executor-side: one task body finished; ``exec_seconds`` is body
+    wall time (arg fetch and env setup excluded — same split the
+    analyzer's exec phase uses)."""
+    if not enabled():
+        return
+    key = _jobkey(job)
+    _counter("ray_tpu_job_tasks_total",
+             "task bodies executed, by owning job",
+             ("job",)).inc_key(key)
+    if exec_seconds > 0:
+        _counter("ray_tpu_job_cpu_seconds_total",
+                 "task-body execution seconds, by owning job",
+                 ("job",)).inc_key(key, float(exec_seconds))
+
+
+def job_submitted_bytes(job: Optional[str], nbytes: int) -> None:
+    """Owner-side: bytes serialized into the object plane by put()."""
+    if not enabled() or nbytes <= 0:
+        return
+    _counter("ray_tpu_job_submitted_bytes_total",
+             "bytes put() into the object plane, by owning job",
+             ("job",)).inc_key(_jobkey(job), float(nbytes))
+
+
+def job_spilled_bytes(job: Optional[str], nbytes: int) -> None:
+    """Raylet-side: one primary spilled; the job is derived from the
+    ObjectID's embedded lineage (ObjectID -> TaskID -> JobID)."""
+    if not enabled() or nbytes <= 0:
+        return
+    _counter("ray_tpu_job_spilled_bytes_total",
+             "bytes spilled to the disk/URI tier, by owning job",
+             ("job",)).inc_key(_jobkey(job), float(nbytes))
+
+
+# ---------------------------------------------------------------------------
+# metrics history + alerting plane (core/metrics_history.py; GCS-side)
+# ---------------------------------------------------------------------------
+
+def history_stats(points: int, series: int, evicted_delta: int) -> None:
+    """Ring accounting exported each sample tick: resident points,
+    live series, and evictions since the last tick (the memory-bound
+    proof: points <= series x window/interval, overflow is counted)."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_metrics_history_points",
+           "time-series points resident in the GCS history rings"
+           ).set_key(_EMPTY_KEY, float(points))
+    _gauge("ray_tpu_metrics_history_series",
+           "series (incl. derived signals) with a live history ring"
+           ).set_key(_EMPTY_KEY, float(series))
+    if evicted_delta > 0:
+        _counter("ray_tpu_metrics_history_evicted_total",
+                 "history points evicted by the per-series ring cap "
+                 "(window_s / interval_s points per series)"
+                 ).inc_key(_EMPTY_KEY, float(evicted_delta))
+
+
+def history_sample_failure() -> None:
+    """One sample tick skipped (failpoint / ingest error): the ring
+    misses a point but the evaluator keeps running."""
+    if not enabled():
+        return
+    _counter("ray_tpu_metrics_history_sample_failures_total",
+             "history sample ticks that failed and were skipped "
+             "(the alert evaluator keeps running)"
+             ).inc_key(_EMPTY_KEY)
+
+
+def alerts_stats(firing: int, transitions: int) -> None:
+    if not enabled():
+        return
+    _gauge("ray_tpu_alerts_firing",
+           "alert rule instances currently in state firing"
+           ).set_key(_EMPTY_KEY, float(firing))
+    if transitions > 0:
+        _counter("ray_tpu_alerts_transitions_total",
+                 "alert state transitions (pending->firing, "
+                 "firing->resolved, restored re-fires)"
+                 ).inc_key(_EMPTY_KEY, float(transitions))
+
+
+# ---------------------------------------------------------------------------
 # GCS persistence / HA plane (core/wal.py + table_storage.py)
 # ---------------------------------------------------------------------------
 

@@ -822,6 +822,9 @@ class CoreWorker:
         (spillable, migratable between replicas) regardless of size."""
         object_id = self._next_put_id()
         ser = serialize(value)
+        _tm.job_submitted_bytes(
+            self.job_id.hex() if self.job_id else None,
+            ser.total_size())
         self.reference_counter.add_owned(object_id)
         # refs nested inside the stored value stay alive for the stored
         # object's lifetime — any later reader must be able to borrow
@@ -4055,6 +4058,11 @@ class CoreWorker:
                                 attempt=spec.attempt_number,
                                 job=spec.job_id.hex() if spec.job_id
                                 else None)
+                # per-job attribution: body seconds + task count roll
+                # up by tenant (ray_tpu_job_* series, `top --jobs`)
+                _tm.job_task_finished(
+                    spec.job_id.hex() if spec.job_id else None,
+                    time.time() - exec_t0)
             if trace_token is not None:
                 _trace.reset_current(trace_token)
             if espan is not None:

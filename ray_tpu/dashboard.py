@@ -261,6 +261,51 @@ class Dashboard:
         job = request.query.get("job")
         return self._json(await self._state(analyze_mod.analyze_job, job))
 
+    async def handle_timeseries(self, request):
+        """History-ring contents (``?series=<name|prefix*>``,
+        ``?since=<epoch s>``, ``?limit=``) from the GCS metrics-history
+        plane — counters serve per-tick deltas, gauges raw values,
+        derived recording-rule signals their computed points."""
+        from ray_tpu.core import worker as worker_mod
+
+        series = request.query.get("series")
+        since = request.query.get("since")
+        limit = request.query.get("limit")
+
+        def fetch():
+            core = worker_mod.global_worker()
+            return core.gcs_call("get_timeseries", {
+                "series": series,
+                "since": float(since) if since else None,
+                "limit": int(limit) if limit else None})
+        return self._json(await self._state(fetch))
+
+    async def handle_alerts(self, request):
+        """Firing + recently-resolved alerts and the rule table."""
+        from ray_tpu.core import worker as worker_mod
+
+        def fetch():
+            core = worker_mod.global_worker()
+            return core.gcs_call("get_alerts", {})
+        return self._json(await self._state(fetch))
+
+    async def handle_healthz(self, request):
+        """Cluster verdict: 200 ok/degraded, 503 critical — wired for
+        load-balancer / k8s probes."""
+        from ray_tpu.core import worker as worker_mod
+
+        def fetch():
+            core = worker_mod.global_worker()
+            return core.gcs_call("healthz", {})
+        try:
+            verdict = await self._state(fetch)
+        except Exception:  # noqa: BLE001 — GCS unreachable IS critical
+            return web.json_response(
+                {"ok": False, "status": "unreachable"}, status=503)
+        return web.json_response(
+            json.loads(json.dumps(verdict, default=str)),
+            status=200 if verdict.get("ok") else 503)
+
     async def handle_metrics(self, request):
         from ray_tpu.core import worker as worker_mod
 
@@ -352,6 +397,9 @@ class Dashboard:
         app.router.add_get("/profile", self.handle_profile)
         app.router.add_get("/api/analyze", self.handle_analyze)
         app.router.add_get("/api/traces", self.handle_traces)
+        app.router.add_get("/api/timeseries", self.handle_timeseries)
+        app.router.add_get("/api/alerts", self.handle_alerts)
+        app.router.add_get("/healthz", self.handle_healthz)
         app.router.add_get("/metrics", self.handle_metrics)
         try:
             from ray_tpu.job.job_head import add_job_routes

@@ -301,6 +301,186 @@ def _print_serve_section(w) -> None:
         print(line)
 
 
+_SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(points, width: int = 16) -> str:
+    """History points -> a fixed-width unicode sparkline (newest
+    right); flat series render as a flat bar, not noise."""
+    vals = [p[1] for p in points][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_SPARK_BARS[min(7, int((v - lo) / span * 7.999))]
+                   for v in vals)
+
+
+def _gauge_by_tag(records, name: str, tag: str) -> Dict[str, float]:
+    """Latest value of a gauge per distinct value of one tag."""
+    out: Dict[str, float] = {}
+    for r in records:
+        if r["name"] == name:
+            out[r.get("tags", {}).get(tag, "?")] = r.get("value", 0)
+    return out
+
+
+def _fmt_since(ts: float) -> str:
+    age = max(0.0, time.time() - ts)
+    if age < 90:
+        return f"{age:.0f}s"
+    if age < 5400:
+        return f"{age / 60:.0f}m"
+    return f"{age / 3600:.1f}h"
+
+
+def _render_top(w, jobs: bool = False) -> list:
+    """One frame of ``ray-tpu top``: health verdict, firing alerts,
+    per-node gauges, derived-signal sparklines — and with ``jobs``,
+    the per-tenant attribution table."""
+    lines = []
+    verdict = w.gcs_call("healthz", {})
+    records = w.gcs_call("get_metrics", {})
+    lines.append(
+        f"health: {verdict.get('status', '?')}  "
+        f"nodes alive: {verdict.get('alive_nodes', 0)}  "
+        f"firing alerts: {len(verdict.get('firing', []))}"
+        + (f" ({', '.join(verdict['firing'])})"
+           if verdict.get("firing") else ""))
+    # per-node gauges (node-tagged series from the raylet flush loops)
+    used = _gauge_by_tag(records, "ray_tpu_arena_used_bytes", "node")
+    cap = _gauge_by_tag(records, "ray_tpu_arena_capacity_bytes", "node")
+    workers = _gauge_by_tag(records, "ray_tpu_workers_total", "node")
+    idle = _gauge_by_tag(records, "ray_tpu_workers_idle", "node")
+    leases = _gauge_by_tag(records, "ray_tpu_sched_pending_leases",
+                           "node")
+    pulls = _gauge_by_tag(records, "ray_tpu_transfer_inflight_pulls",
+                          "node")
+    if used:
+        lines.append("")
+        lines.append(f"{'node':<14}{'arena':>18}{'occ':>6}"
+                     f"{'workers':>9}{'leases':>8}{'pulls':>7}")
+        for node in sorted(used):
+            c = cap.get(node, 0) or 1
+            lines.append(
+                f"{node:<14}"
+                f"{used[node] / 2**20:>8.1f}/{c / 2**20:<6.0f}MiB"
+                f"{used[node] / c:>6.0%}"
+                f"{workers.get(node, 0):>5.0f}"
+                f"({idle.get(node, 0):.0f})"
+                f"{leases.get(node, 0):>8.0f}"
+                f"{pulls.get(node, 0):>7.0f}")
+    # derived signals + history sparklines from the health plane
+    rows = []
+    for prefix in ("cluster:", "serve:", "gcs:"):
+        rows.extend(w.gcs_call("get_timeseries",
+                               {"series": prefix + "*", "limit": 50}))
+    if rows:
+        lines.append("")
+        lines.append(f"{'signal':<34}{'now':>12}  history")
+        for row in rows:
+            if not row["points"]:
+                continue
+            tags = ",".join(f"{k}={v}"
+                            for k, v in sorted(row["tags"].items()))
+            label = row["name"] + (f"[{tags}]" if tags else "")
+            lines.append(f"{label:<34}{row['points'][-1][1]:>12.4g}  "
+                         f"{_sparkline(row['points'])}")
+    if jobs:
+        lines.append("")
+        lines.extend(_render_jobs(records))
+    return lines
+
+
+def _render_jobs(records) -> list:
+    """Per-job attribution rollup from the ``ray_tpu_job_*`` series
+    (tasks, cpu-seconds, submitted/spilled bytes, arena bytes)."""
+    cols = {"ray_tpu_job_tasks_total": "tasks",
+            "ray_tpu_job_cpu_seconds_total": "cpu_s",
+            "ray_tpu_job_submitted_bytes_total": "submitted",
+            "ray_tpu_job_spilled_bytes_total": "spilled",
+            "ray_tpu_job_arena_bytes": "arena"}
+    per_job: Dict[str, Dict[str, float]] = {}
+    for r in records:
+        col = cols.get(r["name"])
+        if col is None:
+            continue
+        job = r.get("tags", {}).get("job", "unknown")
+        row = per_job.setdefault(job, {})
+        # arena gauges are per (node, job): sum across nodes
+        row[col] = row.get(col, 0.0) + r.get("value", 0)
+    out = [f"{'job':<14}{'tasks':>8}{'cpu-s':>9}{'submitted':>11}"
+           f"{'spilled':>9}{'arena':>9}"]
+    if not per_job:
+        out.append("  (no per-job series yet — run some tasks)")
+        return out
+    for job in sorted(per_job,
+                      key=lambda j: -per_job[j].get("cpu_s", 0)):
+        row = per_job[job]
+        out.append(
+            f"{job:<14}{row.get('tasks', 0):>8.0f}"
+            f"{row.get('cpu_s', 0):>9.2f}"
+            f"{row.get('submitted', 0) / 2**20:>10.1f}M"
+            f"{row.get('spilled', 0) / 2**20:>8.1f}M"
+            f"{row.get('arena', 0) / 2**20:>8.1f}M")
+    return out
+
+
+def cmd_top(args) -> None:
+    """Live refreshing cluster view: per-node arena/lease/worker
+    gauges plus history-derived rates with sparkline columns, all off
+    the GCS health plane (``--jobs`` adds per-tenant attribution;
+    ``--once`` prints a single frame for scripts/tests)."""
+    _connect(args)
+    from ray_tpu.core.worker import global_worker
+    w = global_worker()
+    try:
+        while True:
+            lines = _render_top(w, jobs=args.jobs)
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print("\n".join(lines), flush=True)
+            if args.once:
+                return
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_alerts(args) -> None:
+    """Firing + recently-resolved alerts from the GCS health table
+    (rule, value, since; ``--json`` for the raw view)."""
+    _connect(args)
+    from ray_tpu.core.worker import global_worker
+    view = global_worker().gcs_call("get_alerts", {})
+    if args.json:
+        print(json.dumps(view, indent=2, default=str))
+        return
+    firing = view.get("firing", [])
+    if firing:
+        print("FIRING:")
+        for a in firing:
+            tags = ",".join(f"{k}={v}"
+                            for k, v in sorted(a["tags"].items()))
+            val = f"{a['value']:.4g}" if a.get("value") is not None \
+                else "?"
+            print(f"  [{a['severity']:>8}] {a['rule']}"
+                  + (f"[{tags}]" if tags else "")
+                  + f"  value={val}  since {_fmt_since(a['since'])} ago"
+                  + ("  (restored)" if a.get("restored") else ""))
+    else:
+        print("no alerts firing")
+    resolved = view.get("resolved", [])
+    if resolved:
+        print("recently resolved:")
+        for a in resolved[-args.limit:]:
+            tags = ",".join(f"{k}={v}"
+                            for k, v in sorted(a["tags"].items()))
+            print(f"  {a['rule']}" + (f"[{tags}]" if tags else "")
+                  + f"  resolved {_fmt_since(a['resolved_at'])} ago "
+                  f"(fired {_fmt_since(a['since'])} ago)")
+
+
 def cmd_events(args) -> None:
     _connect(args)
     from ray_tpu.experimental.state import api as state
@@ -744,6 +924,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("status", help="cluster resource summary")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "top", help="live cluster view: per-node gauges + "
+                    "history-derived rates with sparklines")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    sp.add_argument("--once", action="store_true",
+                    help="print one frame and exit (for scripts)")
+    sp.add_argument("--jobs", action="store_true",
+                    help="add the per-job attribution table")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser(
+        "alerts", help="firing + recently-resolved alerts")
+    sp.add_argument("--limit", type=int, default=10,
+                    help="recently-resolved rows to show (default 10)")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_alerts)
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("resource", choices=[

@@ -604,6 +604,40 @@ def collect_metric_names(
     return names
 
 
+#: rule constructors whose string kwargs reference metric series:
+#: RecordingRule reads a raw series (``source``) and DEFINES a derived
+#: signal (``name``); AlertRule reads either (``signal`` / ``source``)
+_RULE_CONSTRUCTORS = {"RecordingRule", "AlertRule"}
+
+
+def _collect_rule_series_refs(
+        contexts: List[ModuleContext]
+) -> Tuple[List[Tuple[str, str, str, int]], Set[str]]:
+    """(kwarg, series, path, line) for every literal series referenced
+    by a RecordingRule/AlertRule constructor, plus the set of derived
+    signal names those RecordingRule calls define."""
+    refs: List[Tuple[str, str, str, int]] = []
+    defined: Set[str] = set()
+    for ctx in contexts:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None or d.split(".")[-1] not in _RULE_CONSTRUCTORS:
+                continue
+            ctor = d.split(".")[-1]
+            kwargs = {kw.arg: kw.value.value for kw in node.keywords
+                      if kw.arg and isinstance(kw.value, ast.Constant)
+                      and isinstance(kw.value.value, str)}
+            if ctor == "RecordingRule" and kwargs.get("name"):
+                defined.add(kwargs["name"])
+            for key in ("source", "signal"):
+                val = kwargs.get(key)
+                if val:
+                    refs.append((key, val, ctx.path, node.lineno))
+    return refs, defined
+
+
 def check_metric_drift(contexts: List[ModuleContext],
                        cfg: ProjectConfig) -> List[Finding]:
     rule = "metric-drift"
@@ -621,6 +655,37 @@ def check_metric_drift(contexts: List[ModuleContext],
                         f"{cfg.metrics_golden}: dashboards and the "
                         f"metrics smoke test won't see it (add it, "
                         f"or run scripts/metrics_smoke.py --update)"))
+    # recording/alert rules must reference series that exist: a raw
+    # ``ray_tpu_*`` reference must be in the golden catalogue, and a
+    # derived-signal reference must be defined by some RecordingRule
+    # (resolved against the whole tree, so path-restricted scans don't
+    # flood false unknown-signal findings)
+    refs, defined_all = _collect_rule_series_refs(contexts)
+    if any(not series.startswith("ray_tpu_")
+           and series not in defined_all
+           for _kwarg, series, _path, _line in refs):
+        # a derived-signal ref the scanned files don't define: resolve
+        # against the whole tree before flagging (path-restricted runs
+        # must not flood false unknown-signal findings) — the reparse
+        # is skipped entirely when every ref resolves locally
+        _, defined_all = _collect_rule_series_refs(
+            _tree_contexts(contexts, cfg))
+    for kwarg, series, path, line in refs:
+        if series.startswith("ray_tpu_"):
+            if series not in golden:
+                findings.append(Finding(
+                    path=path, line=line, rule=rule,
+                    symbol=f"rule.{series}",
+                    message=f"rule {kwarg}={series!r} references a "
+                            f"series missing from {cfg.metrics_golden}"
+                            f": the rule would evaluate a series no "
+                            f"producer constructs"))
+        elif series not in defined_all:
+            findings.append(Finding(
+                path=path, line=line, rule=rule,
+                symbol=f"rule.{series}",
+                message=f"rule {kwarg}={series!r} references a derived "
+                        f"signal no RecordingRule defines"))
     return findings
 
 

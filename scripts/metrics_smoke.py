@@ -107,6 +107,19 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_trace_retained_total",
     "ray_tpu_trace_sampled_out_total",
     "ray_tpu_trace_evicted_total",
+    # per-job attribution: counters need task/put/spill traffic, the
+    # arena gauge needs plasma-resident primaries
+    "ray_tpu_job_tasks_total",
+    "ray_tpu_job_cpu_seconds_total",
+    "ray_tpu_job_submitted_bytes_total",
+    "ray_tpu_job_spilled_bytes_total",
+    "ray_tpu_job_arena_bytes",
+    # history/alert plane: evictions need the ring to wrap a full
+    # window, sample failures need the failpoint, transitions need an
+    # alert to actually fire
+    "ray_tpu_metrics_history_evicted_total",
+    "ray_tpu_metrics_history_sample_failures_total",
+    "ray_tpu_alerts_transitions_total",
 }
 
 

@@ -54,6 +54,25 @@ def test_cli_start_status_list_stop(cli_cluster):
     assert "SIGTERM" in out.stdout or "already gone" in out.stdout
 
 
+def test_cli_top_and_alerts(cli_cluster):
+    """`ray-tpu top --once` renders the health plane's frame and
+    `ray-tpu alerts` the (quiet) alert table through the real CLI."""
+    addr, env = cli_cluster
+    out = _run("top", "--once", "--jobs", "--address", addr,
+               env_extra=env)
+    assert out.returncode == 0, out.stderr
+    assert "health:" in out.stdout
+    assert "job" in out.stdout  # the --jobs attribution table header
+    out = _run("alerts", "--address", addr, env_extra=env)
+    assert out.returncode == 0, out.stderr
+    assert "no alerts firing" in out.stdout or "FIRING" in out.stdout
+    out = _run("alerts", "--json", "--address", addr, env_extra=env)
+    assert out.returncode == 0, out.stderr
+    view = json.loads(out.stdout)
+    assert {r["name"] for r in view["rules"]} >= {
+        "ServeSLOBurnRate", "ArenaPressure"}
+
+
 def test_cli_memory_and_summary(cli_cluster):
     addr, env = cli_cluster
     out = _run("memory", "--address", addr, env_extra=env)

@@ -441,6 +441,44 @@ def test_metric_drift_sees_keyword_name(fixture_project):
     assert [f.symbol for f in findings] == ["ray_tpu_kw_series"]
 
 
+def test_metric_drift_flags_rule_series_refs(fixture_project):
+    """Recording/alert rule definitions must reference series that
+    exist: raw ray_tpu_* refs resolve against the golden catalogue,
+    derived-signal refs against RecordingRule definitions."""
+    contexts = [
+        _ctx("""
+            RULES = [
+                RecordingRule(name="derived:ok",
+                              source="ray_tpu_known_total", fn="rate"),
+                RecordingRule(name="derived:bad",
+                              source="ray_tpu_missing_total", fn="rate"),
+                AlertRule(name="A", signal="derived:ok"),
+                AlertRule(name="B", signal="derived:undefined"),
+                AlertRule(name="C", kind="slo_burn",
+                          source="ray_tpu_known_total"),
+            ]
+        """, path="rules.py"),
+    ]
+    findings = check_metric_drift(contexts, fixture_project)
+    assert sorted(f.symbol for f in findings) == [
+        "rule.derived:undefined", "rule.ray_tpu_missing_total"]
+
+
+def test_metric_drift_rule_refs_clean_fixture(fixture_project):
+    """Rules whose every reference resolves produce no findings."""
+    contexts = [
+        _ctx("""
+            RULES = [
+                RecordingRule(name="derived:sig",
+                              source="ray_tpu_known_total", fn="rate"),
+                AlertRule(name="A", signal="derived:sig",
+                          threshold=1.0),
+            ]
+        """, path="rules.py"),
+    ]
+    assert check_metric_drift(contexts, fixture_project) == []
+
+
 # ---------------------------------------------------------------------------
 # persist-conformance
 # ---------------------------------------------------------------------------
