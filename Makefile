@@ -30,6 +30,20 @@ check:
 test: native check
 	python -m pytest tests/ -q
 
+# The long-running training/learning regressions that tier-1 slow-marks
+# to stay inside its time budget: full RL algorithm runs, example
+# walkthroughs, DDP/HF trainer convergence, the node-kill campaigns,
+# and the heaviest eight-node cases.  Run nightly / before a release.
+test-heavy: native
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_chaos.py tests/test_rllib_extras.py \
+	  tests/test_rllib_algorithms.py tests/test_rllib_zoo.py \
+	  tests/test_rllib_meta.py tests/test_examples.py \
+	  tests/test_train.py tests/test_train_frameworks.py \
+	  tests/test_tune.py tests/test_cluster_scale.py \
+	  -q -m "slow or not slow" \
+	  -p no:cacheprovider -p no:randomly
+
 # Deterministic chaos: failpoint-injection suite + node-kill suite +
 # mid-transfer source-kill suite with fixed seeds (failpoint sites seed
 # per-site; NodeKiller seeds in-test; PYTHONHASHSEED pins dict/hash
@@ -45,6 +59,8 @@ chaos: native
 	  tests/test_controlplane_scale.py tests/test_store_scale.py \
 	  tests/test_gcs_ha.py tests/test_data_streaming.py \
 	  tests/test_metrics_history.py \
+	  tests/test_node_drain.py tests/test_autoscaler_monitor.py \
+	  tests/test_fair_queue.py tests/test_autoscaler_chaos.py \
 	  -q -m "slow or not slow" \
 	  -p no:cacheprovider -p no:randomly
 

@@ -1,4 +1,5 @@
-"""End-to-end verify driver for the streaming data plane (PR 12)."""
+"""End-to-end verify driver: core surface + the PR-16 quota/autoscaler
+planes, user-style over a real cluster."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -7,105 +8,172 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-import csv  # noqa: E402
+import faulthandler  # noqa: E402
+import json  # noqa: E402
 import time  # noqa: E402
+import urllib.request  # noqa: E402
+
+faulthandler.dump_traceback_later(180)
 
 import numpy as np  # noqa: E402
 
 import ray_tpu  # noqa: E402
-from ray_tpu import data as rd  # noqa: E402
-from ray_tpu.data.context import DataContext  # noqa: E402
+import ray_tpu.core.worker as core_worker  # noqa: E402
 
 t0 = time.time()
-ray_tpu.init(num_cpus=4, _system_config={
-    "object_store_memory": 96 * 1024 * 1024,
-    "object_spill_threshold": 0.8,
-    "object_spill_ahead_watermark": 0.5,
-})
-print(f"init {time.time()-t0:.1f}s")
-
-# -- real files on disk, streamed lazily -------------------------------
-datadir = os.path.join(os.path.dirname(__file__), "_verify_csv")
-os.makedirs(datadir, exist_ok=True)
-n_files, rows_per = 12, 500
-for i in range(n_files):
-    with open(os.path.join(datadir, f"part-{i:03d}.csv"), "w",
-              newline="") as f:
-        w = csv.writer(f)
-        w.writerow(["uid", "value"])
-        for r in range(rows_per):
-            w.writerow([i * rows_per + r, (i * rows_per + r) % 97])
-
-ds = rd.read_csv(datadir).map_batches(
-    lambda b: {"uid": b["uid"], "value2": b["value"] * 2})
-
-# streaming iteration: lazy reads + fused map, bounded window
-t0 = time.time()
-uids = []
-for batch in ds.iter_batches(batch_size=256, streaming=True):
-    uids.extend(int(u) for u in batch["uid"])
-assert sorted(uids) == list(range(n_files * rows_per)), "stream lost rows"
-print(f"streamed {len(uids)} rows from {n_files} csv files "
-      f"in {time.time()-t0:.1f}s")
-
-# streaming shuffle riding the spill tier
-big = rd.Dataset([ray_tpu.put({"v": np.arange(i * 1_000_000,
-                                              (i + 1) * 1_000_000)})
-                  for i in range(10)])  # 80 MB vs 96 MB arena, spills
-t0 = time.time()
-total = 0
-count = 0
-first = None
-for batch in big.streaming_shuffle(seed=5).iter_batches(
-        batch_size=None, streaming=True):
-    arr = np.asarray(batch["v"])
-    if first is None:
-        first = arr[:5].tolist()
-    total += int(arr.sum())
-    count += len(arr)
-n = 10 * 1_000_000
-assert count == n and total == n * (n - 1) // 2, "shuffle corrupted data"
-print(f"streaming shuffle {count} rows ok in {time.time()-t0:.1f}s, "
-      f"head={first}")
-
-# trainer ingest: per-rank streaming shards inside real gang actors
-from ray_tpu.train import JaxTrainer, ScalingConfig, session  # noqa: E402
-
-DataContext.get_current().streaming_train_ingest = True
+ray_tpu.init(num_cpus=4)
+print(f"init {time.time()-t0:.2f}s")
 
 
-def loop(config):
-    import jax.numpy as jnp
+# chained tasks across two remote functions
+@ray_tpu.remote
+def double(x):
+    return x * 2
 
-    shard = session.get_dataset_shard("train")
-    seen = 0
-    s = 0.0
-    for b in shard.iter_batches(batch_size=64):
-        s += float(jnp.asarray(b["id"], dtype=jnp.float32).sum())
-        seen += int(b["id"].shape[0])
-    session.report({"rows": seen, "sum": s,
-                    "rank": session.get_world_rank()})
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
 
 
 t0 = time.time()
-trainer = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=2),
-                     datasets={"train": rd.range(4096, parallelism=8)})
-result = trainer.fit()
-assert result.error is None, result.error
-rows = sum(m["rows"] for m in result.metrics_history)
-print(f"trainer streaming ingest: rank-0 consumed {rows} rows "
-      f"in {time.time()-t0:.1f}s (fit)")
+first = ray_tpu.get(double.remote(21))
+print(f"first task {time.time()-t0:.2f}s ->", first)
+t0 = time.time()
+out = ray_tpu.get(add.remote(double.remote(3), double.remote(4)))
+assert out == 14, out
+for i in range(20):
+    assert ray_tpu.get(double.remote(i)) == 2 * i
+print(f"22 chained tasks {time.time()-t0:.2f}s")
 
-# store state after the shuffle (spill-ahead watermark 0.5)
-from ray_tpu.experimental.state import object_store_stats  # noqa: E402
-stats = object_store_stats()[0]
-print("store:", {k: stats.get(k) for k in
-                 ("used", "capacity", "num_spilled", "spill_bytes")})
+
+# >4 actors on 4 CPUs, ordered calls
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+
+t0 = time.time()
+actors = [Counter.remote() for _ in range(8)]
+for a in actors:
+    assert ray_tpu.get([a.inc.remote() for _ in range(3)]) == [1, 2, 3]
+print(f"8 actors x3 ordered calls {time.time()-t0:.2f}s")
+
+# --- PR 16: per-job quota throttling over the real lease plane --------
+gw = core_worker.global_worker_or_none()
+job = gw.job_id.hex()
+assert gw.gcs_call("set_job_quota", {
+    "job": job,
+    "quota": {"weight": 1.0, "limits": {"CPU": 1}, "mode": "queue"},
+}) is True
+time.sleep(0.6)
+
+
+@ray_tpu.remote(num_cpus=1)
+def slot(i):
+    time.sleep(0.1)
+    return i
+
+
+t0 = time.time()
+assert ray_tpu.get([slot.remote(i) for i in range(6)]) == list(range(6))
+dur = time.time() - t0
+assert dur > 0.55, f"quota did not serialize: {dur:.2f}s"  # 6x0.1 serial
+throttled = []
+deadline = time.time() + 30  # default metrics report period is slow
+while time.time() < deadline and not throttled:
+    recs = gw.gcs_call("get_metrics", {})
+    throttled = [r for r in recs
+                 if r["name"] == "ray_tpu_sched_quota_throttled_total"
+                 and r.get("tags", {}).get("job") == job
+                 and r.get("value", 0) > 0]
+    time.sleep(0.5)
+assert throttled, "throttle gauge never reported"
+print(f"quota serialized 6 tasks in {dur:.2f}s, "
+      f"throttled={throttled[0]['value']}")
+assert gw.gcs_call("set_job_quota", {"job": job, "quota": None}) is True
+t0 = time.time()
+assert ray_tpu.get([slot.remote(i) for i in range(8)]) == list(range(8))
+par = time.time() - t0
+assert par < 0.55, f"quota removal did not restore overlap: {par:.2f}s"
+print(f"quota removed, 8 tasks in {par:.2f}s (parallel again)")
+
+# --- PR 16: autoscaler monitor persists its decision in the KV plane --
+from ray_tpu.autoscaler import (MockProvider, NodeTypeConfig,  # noqa: E402
+                                StandardAutoscaler)
+from ray_tpu.autoscaler.monitor import AutoscalerMonitor  # noqa: E402
+from ray_tpu.core.gcs import AUTOSCALER_DECISION_KV_KEY  # noqa: E402
+from ray_tpu.autoscaler.policy import PolicyConfig, ScalingPolicy  # noqa: E402
+
+mon = AutoscalerMonitor(
+    StandardAutoscaler(MockProvider(),
+                       {"cpu4": NodeTypeConfig(resources={"CPU": 4},
+                                               max_workers=2)},
+                       max_workers=2),
+    policy=ScalingPolicy(PolicyConfig(up_for_s=0.0)),
+    update_interval_s=0.2)
+mon.run_once()
+decision = gw.gcs_call("kv_get", {"key": AUTOSCALER_DECISION_KV_KEY})
+assert decision, decision
+print("autoscaler decision persisted:", str(decision)[:72], "...")
+
+# data pipeline with all-to-all shuffle
+import ray_tpu.data as rdata  # noqa: E402
+
+ds = rdata.range(200, parallelism=8).random_shuffle()
+vals = sorted(r["id"] for r in ds.take_all())
+assert vals == list(range(200))
+print("data shuffle ok")
+
+# tune with a scheduler
+from ray_tpu import tune  # noqa: E402
+
+
+def trainable(config):
+    for i in range(3):
+        tune.report({"score": config["lr"] * (i + 1)})
+
+
+analysis = tune.run(trainable,
+                    config={"lr": tune.grid_search([0.1, 0.2, 0.4])},
+                    scheduler=tune.schedulers.AsyncHyperBandScheduler(
+                        metric="score", mode="max"),
+                    verbose=0)
+best = analysis.get_best_result(metric="score", mode="max")
+assert best.config["lr"] == 0.4, best.config
+print("tune ok, best lr", best.config["lr"])
+
+# serve + real HTTP proxy
+from ray_tpu import serve  # noqa: E402
+from ray_tpu.serve.http_proxy import start_proxy  # noqa: E402
+
+
+@serve.deployment
+def classify(x):
+    return {"label": int(np.asarray(x["value"]).sum() % 3)}
+
+
+handle = serve.run(classify.bind())
+assert ray_tpu.get(handle.remote({"value": [1, 2, 3]}),
+                   timeout=30)["label"] == 0
+host, port = start_proxy()
+url = f"http://{host}:{port}/classify"
+req = urllib.request.Request(
+    url, data=json.dumps({"value": [1, 2, 4]}).encode(),
+    headers={"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=30) as resp:
+    body = json.loads(resp.read())
+assert body["result"]["label"] == 1, body
+print("serve + http ok:", body)
 
 t0 = time.time()
 ray_tpu.shutdown()
-print(f"shutdown {time.time()-t0:.1f}s")
-
-import shutil  # noqa: E402
-shutil.rmtree(datadir, ignore_errors=True)
+dt = time.time() - t0
+print(f"shutdown {dt:.2f}s")
+assert dt < 5.0, "head did not exit cleanly"
 print("VERIFY OK")

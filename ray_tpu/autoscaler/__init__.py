@@ -1,17 +1,36 @@
-"""Autoscaler (reference ``python/ray/autoscaler/``)."""
+"""Autoscaler (reference ``python/ray/autoscaler/``).
 
-from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
-    LoadMetrics,
-    StandardAutoscaler,
-)
-from ray_tpu.autoscaler.monitor import Monitor  # noqa: F401
-from ray_tpu.autoscaler.node_provider import (  # noqa: F401
-    FakeMultiNodeProvider,
-    MockProvider,
-    NodeProvider,
-)
-from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
-    NodeTypeConfig,
-    ResourceDemandScheduler,
-)
-from ray_tpu.autoscaler.sdk import request_resources  # noqa: F401
+Exports resolve lazily (PEP 562): the core GCS imports the pure
+``fair_queue`` state machine from this package, and an eager package
+init would close an import cycle through ``sdk`` -> ``core.gcs``.
+"""
+
+_EXPORTS = {
+    "LoadMetrics": "ray_tpu.autoscaler.autoscaler",
+    "StandardAutoscaler": "ray_tpu.autoscaler.autoscaler",
+    "Monitor": "ray_tpu.autoscaler.monitor",
+    "AutoscalerMonitor": "ray_tpu.autoscaler.monitor",
+    "ScalingPolicy": "ray_tpu.autoscaler.policy",
+    "FakeMultiNodeProvider": "ray_tpu.autoscaler.node_provider",
+    "MockProvider": "ray_tpu.autoscaler.node_provider",
+    "NodeProvider": "ray_tpu.autoscaler.node_provider",
+    "NodeTypeConfig": "ray_tpu.autoscaler.resource_demand_scheduler",
+    "ResourceDemandScheduler":
+        "ray_tpu.autoscaler.resource_demand_scheduler",
+    "request_resources": "ray_tpu.autoscaler.sdk",
+    "FairQueue": "ray_tpu.autoscaler.fair_queue",
+    "JobQuota": "ray_tpu.autoscaler.fair_queue",
+    "QuotaExceeded": "ray_tpu.autoscaler.fair_queue",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

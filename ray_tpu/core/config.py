@@ -184,6 +184,11 @@ class Config:
     health_report_period_s: float = 1.0
     #: GCS declares a node dead after this long without a report.
     health_timeout_s: float = 10.0
+    #: Wall-clock budget for one graceful node drain (the raylet-side
+    #: object/spill migration leg).  0 disables the graceful protocol:
+    #: drain_node falls back to immediate removal (pre-autoscaler
+    #: semantics, used by crash-simulation tests).
+    drain_timeout_s: float = 60.0
     #: Max attempts to reconstruct a lost object through lineage.
     max_lineage_reconstruction_depth: int = 100
 

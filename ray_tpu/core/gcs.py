@@ -30,6 +30,9 @@ from ray_tpu.core import telemetry as _tm
 from ray_tpu.core import tracing as _trace
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.autoscaler.fair_queue import (
+    NODE_ACTIVE, NODE_DEAD, NODE_DRAINED, NODE_DRAINING, JobQuota,
+    validate_transition)
 from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
@@ -55,11 +58,21 @@ class NodeInfo:
     # raylets); 0 = a dedicated control node that can NEVER host a
     # worker — the actor scheduler must not strand leases there
     max_workers: int = -1
+    # lifecycle state (docs/autoscaler.md): ACTIVE | DRAINING | DRAINED
+    # | DEAD.  DRAINING/DRAINED nodes keep alive=True (the raylet still
+    # serves in-flight work and object pulls) but take no new leases
+    state: str = NODE_ACTIVE
+    drain_reason: str = ""
 
 
 #: internal-KV key (default namespace) holding the standing
 #: ``autoscaler.sdk.request_resources`` bundles as a JSON list
 RESOURCE_REQUEST_KV_KEY = "__autoscaler_resource_request"
+
+#: internal-KV key (default namespace) holding the autoscaler monitor's
+#: last decision as JSON ({action, detail, ts}) — surfaced by
+#: ``ray-tpu nodes`` so operators see why the fleet last moved
+AUTOSCALER_DECISION_KV_KEY = "__autoscaler_last_decision"
 
 #: internal-KV key (namespace ``_internal``) holding the JSON firing
 #: alert set — rewritten on every transition so a restarted GCS can
@@ -152,6 +165,21 @@ class GcsServer:
         self.functions: Dict[str, bytes] = {}  # function_id -> pickled blob
         self.job_counter = 0
         self.jobs: Dict[JobID, Dict[str, Any]] = {}
+        # per-job scheduling quotas (job key -> JobQuota dict), WAL- and
+        # snapshot-covered so fair-queue weights survive a head SIGKILL
+        self.quotas: Dict[str, Dict[str, Any]] = {}
+        # per-node lease tables: node hex -> {job: {resource: in-flight}}
+        # — heartbeat-reported ground truth, WAL'd on change so a GCS
+        # restart mid-drain restores in-flight quota accounting
+        self.lease_tables: Dict[str, Dict[str, Dict[str, float]]] = {}
+        # durable drain-state map (node_id binary -> {state, reason}):
+        # the node table itself is rebuilt by live re-registration, but
+        # a DRAINING/DRAINED verdict must survive a GCS SIGKILL so the
+        # re-registering raylet resumes in the right lifecycle state
+        self._node_states: Dict[bytes, Dict[str, Any]] = {}
+        # node ids with a drain protocol currently executing (in-memory
+        # only: a restarted GCS may re-enter a WAL-restored DRAINING)
+        self._drains_inflight: set = set()
         # pubsub: channel -> set of connections
         self.subscribers: Dict[str, set] = {}
         # node connections (raylet registration conns) for death detection
@@ -298,6 +326,9 @@ class GcsServer:
             self.functions = snap.get("functions", {})
             self.jobs = snap.get("jobs", {})
             self.job_counter = snap.get("job_counter", 0)
+            self.quotas = snap.get("quotas", {})
+            self.lease_tables = snap.get("lease_tables", {})
+            self._node_states = snap.get("node_states", {})
             # full actor runtime state (not just detached): a
             # reconnecting driver's handles must keep resolving after a
             # head restart
@@ -460,6 +491,30 @@ class GcsServer:
             self._wal_nodes[data["node_id"]] = data
         elif rtype == "node_dead":
             self._wal_nodes.pop(data["node_id"], None)
+            self._node_states.pop(data["node_id"], None)
+            # a dead node's lease accounting dies with it — without
+            # this, replay resurrects quota charges for capacity that
+            # no longer exists (mirror of _mark_node_dead)
+            self.lease_tables.pop(data["node_id"].hex(), None)
+        elif rtype == "node_state":
+            nid, state, reason = data
+            if state in (NODE_DRAINING, NODE_DRAINED):
+                self._node_states[nid] = {"state": state,
+                                          "reason": reason}
+            else:  # back to ACTIVE (drain aborted) or released
+                self._node_states.pop(nid, None)
+        elif rtype == "quota":
+            job, quota = data
+            if quota is None:
+                self.quotas.pop(job, None)
+            else:
+                self.quotas[job] = quota
+        elif rtype == "lease_table":
+            node_hex, usage = data
+            if usage:
+                self.lease_tables[node_hex] = usage
+            else:
+                self.lease_tables.pop(node_hex, None)
         else:
             logger.warning("unknown WAL record type %r skipped", rtype)
 
@@ -529,7 +584,10 @@ class GcsServer:
             "kv": self.kv, "functions": self.functions,
             "jobs": self.jobs, "job_counter": self.job_counter,
             "actors": actors,
-            "placement_groups": pgs})
+            "placement_groups": pgs,
+            "quotas": self.quotas,
+            "lease_tables": self.lease_tables,
+            "node_states": self._node_states})
         self._persist_failed_ts = 0.0 if ok else time.monotonic()
         # no awaits since the table reads above: the snapshot is a
         # consistent cut covering every WAL record appended so far, so
@@ -668,6 +726,7 @@ class GcsServer:
             "resources_available": info.resources_available,
             "topology": info.topology,
             "load": info.load,
+            "state": info.state,
         }
 
     async def _metrics_flush_loop(self) -> None:
@@ -830,6 +889,13 @@ class GcsServer:
             topology=data.get("topology", {}),
             max_workers=int(data.get("max_workers", -1)),
         )
+        # a node re-registering after a GCS restart resumes the
+        # lifecycle state the WAL/snapshot recorded for it — a drain
+        # verdict is durable, registration must not silently reactivate
+        durable = self._node_states.get(node_id.binary())
+        if durable:
+            info.state = durable.get("state", NODE_ACTIVE)
+            info.drain_reason = durable.get("reason", "")
         self.nodes[node_id] = info
         self._node_conns[node_id] = conn
         conn.context["node_id"] = node_id
@@ -858,7 +924,8 @@ class GcsServer:
                         "duration_s": remaining}
             else:
                 self._profiler_state = None
-        return {"config": self.config.to_json(), "profiler": prof}
+        return {"config": self.config.to_json(), "profiler": prof,
+                "state": info.state, "quotas": dict(self.quotas)}
 
     async def handle_health_report(self, conn, data):
         # failpoint: a stalled/failed heartbeat ack — raylets must ride
@@ -874,8 +941,28 @@ class GcsServer:
         info.pending_demand = list(data.get("pending_demand", []))
         if data.get("node_stats"):
             info.stats = data["node_stats"]
+        if "lease_usage" in data:
+            # per-job in-flight resource ledger (the raylet's fair-queue
+            # ground truth).  WAL'd only on change: the heartbeat path
+            # is hot, and replaying the last-known table is enough for a
+            # restarted GCS to restore quota accounting exactly-once —
+            # the next beat re-reports and converges any tail loss.
+            usage = {j: u for j, u in
+                     (data.get("lease_usage") or {}).items() if u}
+            node_hex = node_id.hex()
+            if usage != self.lease_tables.get(node_hex, {}):
+                if usage:
+                    self.lease_tables[node_hex] = usage
+                else:
+                    self.lease_tables.pop(node_hex, None)
+                self._wal_append("lease_table", (node_hex, usage))
+                self._schedule_persist()
         self._mark_sync_dirty(node_id)
-        return {"acked": True}
+        # piggyback the quota table + lifecycle verdict on the ack: a
+        # raylet that missed the drain RPC (or re-registered against a
+        # restarted GCS) self-corrects within one beat
+        return {"acked": True, "state": info.state,
+                "quotas": dict(self.quotas)}
 
     async def handle_get_cluster_load(self, conn, data):
         """Aggregate view for the autoscaler (parity: the monitor reading
@@ -888,6 +975,7 @@ class GcsServer:
         return {
             "nodes": [
                 {"node_id": n.node_id.hex(), "alive": n.alive,
+                 "state": n.state,
                  "resources_total": n.resources_total,
                  "resources_available": n.resources_available,
                  "load": n.load}
@@ -922,6 +1010,8 @@ class GcsServer:
                 "node_id": n.node_id.binary(),
                 "address": n.raylet_address,
                 "alive": n.alive,
+                "state": n.state,
+                "drain_reason": n.drain_reason,
                 "resources_total": n.resources_total,
                 "resources_available": n.resources_available,
                 "topology": n.topology,
@@ -931,10 +1021,127 @@ class GcsServer:
             for n in self.nodes.values()
         ]
 
+    def _set_node_state(self, info: NodeInfo, new_state: str,
+                        reason: str = "") -> None:
+        """One lifecycle transition: validated against the matrix,
+        WAL'd (durable across a GCS SIGKILL), broadcast on both the
+        nodes channel and the versioned resource view."""
+        validate_transition(info.state, new_state)
+        info.state = new_state
+        info.drain_reason = reason
+        nid = info.node_id.binary()
+        if new_state in (NODE_DRAINING, NODE_DRAINED):
+            self._node_states[nid] = {"state": new_state,
+                                      "reason": reason}
+        else:
+            self._node_states.pop(nid, None)
+        self._wal_append("node_state", (nid, new_state, reason))
+        self._schedule_persist()
+        self._mark_sync_dirty(info.node_id)
+        _tm.node_drain_transition(new_state)
+        self._emit_event(
+            "INFO", "NODE_STATE",
+            f"node {info.node_id.hex()[:12]} -> {new_state}"
+            + (f": {reason}" if reason else ""),
+            node_id=info.node_id.hex(), state=new_state)
+        self.publish("nodes", {"event": "state", "node_id": nid,
+                               "state": new_state})
+
     async def handle_drain_node(self, conn, data):
+        """Graceful node drain (docs/autoscaler.md):
+
+        ACTIVE -> DRAINING (durable)  — the raylet stops taking leases
+          -> raylet ``drain`` RPC     — sealed primaries + spill blobs
+                                        migrate to ACTIVE peers
+        -> DRAINED (durable, success) — safe to terminate, or
+        -> ACTIVE  (abort on failure) — the node keeps serving.
+
+        ``force=True`` keeps the PR-≤15 semantics (immediate removal,
+        used for crash simulation and last-resort eviction)."""
         node_id = NodeID(data["node_id"])
-        self._mark_node_dead(node_id, data.get("reason", "drained"))
+        reason = data.get("reason", "drained")
+        info = self.nodes.get(node_id)
+        if data.get("force") or info is None or not info.alive \
+                or self.config.drain_timeout_s <= 0:
+            self._mark_node_dead(node_id, reason)
+            return {"drained": True, "forced": True}
+        if info.state == NODE_DRAINED:
+            return {"drained": True, "migrated": 0}  # idempotent retry
+        if node_id in self._drains_inflight:
+            return {"drained": False, "error": "drain in progress"}
+        if info.state == NODE_ACTIVE:
+            self._set_node_state(info, NODE_DRAINING, reason)
+            await self._wal_flush()  # verdict durable before migrating
+        # else: WAL-restored DRAINING after a GCS restart — re-enter
+        self._drains_inflight.add(node_id)
+        try:
+            peers = [{"node_id": n.node_id.binary(),
+                      "address": list(n.raylet_address)}
+                     for n in self.nodes.values()
+                     if n.alive and n.state == NODE_ACTIVE
+                     and n.node_id != node_id]
+            reply: Dict[str, Any] = {}
+            err = None
+            try:
+                # failpoint: the migration leg fails — the drain must
+                # ABORT and the node must return to ACTIVE, still
+                # serving (acceptance: an aborted migration leaves the
+                # node in service, never half-drained)
+                _fp.failpoint("gcs.node_drain.migrate_fail")
+                node_conn = self._node_conns.get(node_id)
+                if node_conn is None:
+                    raise RuntimeError("no raylet connection")
+                reply = await node_conn.call(
+                    "drain", {"peers": peers, "reason": reason},
+                    timeout=self.config.drain_timeout_s) or {}
+                if not reply.get("ok"):
+                    raise RuntimeError(
+                        reply.get("error", "raylet drain failed"))
+            except Exception as e:  # noqa: BLE001 — abort the drain
+                err = str(e) or type(e).__name__
+            if err is not None:
+                if info.alive and info.state == NODE_DRAINING:
+                    self._set_node_state(info, NODE_ACTIVE,
+                                         f"drain aborted: {err}")
+                    await self._wal_flush()
+                logger.warning("drain of node %s aborted: %s",
+                               node_id.hex()[:12], err)
+                return {"drained": False, "error": err}
+            if not info.alive:  # died mid-migration
+                return {"drained": False, "error": "node died mid-drain"}
+            self._set_node_state(info, NODE_DRAINED, reason)
+            await self._wal_flush()
+            return {"drained": True,
+                    "migrated": reply.get("migrated", 0),
+                    "spill_handed_off": reply.get("spill_handed_off", 0)}
+        finally:
+            self._drains_inflight.discard(node_id)
+
+    # ------------------------------------------------------------------
+    # per-job quota table (fair-queue weights + in-flight ceilings)
+    # ------------------------------------------------------------------
+    async def handle_set_job_quota(self, conn, data):
+        """Install/update/remove one job's scheduling quota.  The table
+        is WAL- and snapshot-covered; raylets learn within one beat via
+        the health-report ack (plus an immediate pubsub nudge)."""
+        job = data["job"]
+        quota = data.get("quota")
+        if quota is None:
+            self.quotas.pop(job, None)
+        else:
+            # normalize through JobQuota so malformed payloads fail
+            # here, at the API boundary, not inside a raylet
+            self.quotas[job] = JobQuota.from_dict(quota).to_dict()
+        self._wal_append("quota", (job, self.quotas.get(job)))
+        self._schedule_persist()
+        await self._wal_flush()
+        self.publish("quotas", {"quotas": dict(self.quotas)})
         return True
+
+    async def handle_get_job_quotas(self, conn, data):
+        return {"quotas": dict(self.quotas),
+                "lease_tables": {n: dict(t)
+                                 for n, t in self.lease_tables.items()}}
 
     def _emit_event(self, severity: str, label: str, message: str,
                     **fields: Any) -> None:
@@ -957,8 +1164,13 @@ class GcsServer:
         if info is None or not info.alive:
             return
         info.alive = False
+        info.state = NODE_DEAD
         info.resources_available = {}
         self._node_conns.pop(node_id, None)
+        # the node_dead record also clears any durable drain verdict
+        # and lease table on replay (_wal_apply) — mirror in memory
+        self._node_states.pop(node_id.binary(), None)
+        self.lease_tables.pop(node_id.hex(), None)
         self._wal_append("node_dead", {"node_id": node_id.binary()})
         _tm.node_death()
         logger.warning("node %s dead: %s", node_id.hex()[:12], reason)
@@ -2068,7 +2280,10 @@ class GcsServer:
                 required_node = None
         candidates = []
         for node in self.nodes.values():
-            if not node.alive:
+            if not node.alive or node.state != NODE_ACTIVE:
+                # DRAINING/DRAINED nodes finish what they hold but take
+                # no new placements — even a hard NODE_AFFINITY pin
+                # pends (the drain either completes or aborts shortly)
                 continue
             if node.max_workers == 0 and required_node is None:
                 # dedicated control node (e.g. a 0-CPU HA head): it can
@@ -2429,7 +2644,8 @@ class GcsServer:
         policy/bundle_scheduling_policy.cc).  Nodes in the same TPU slice
         sort adjacently so PACKed gangs land on one ICI domain.
         """
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values()
+                 if n.alive and n.state == NODE_ACTIVE]
         if not alive:
             return None
         alive.sort(key=lambda n: (n.topology.get("slice", ""),

@@ -197,7 +197,18 @@ register_schema("kv_keys", prefix=Opt(str), namespace=Opt(str))
 
 # job / node lifecycle
 register_schema("job_finished", job_id=bytes)
-register_schema("drain_node", node_id=bytes, reason=Opt(str))
+register_schema("drain_node", node_id=bytes, reason=Opt(str),
+                force=Opt(bool))
+# graceful drain (GCS -> raylet): migrate sealed primaries + spill
+# blobs to the listed ACTIVE peers, then stop taking leases for good
+register_schema("drain", peers=list, reason=Opt(str))
+# drain migration (raylet -> peer raylet): pull this object from me (or
+# my spill tier) and become its primary holder before I release
+register_schema("adopt_object", object_id=bytes, owner=Opt(list),
+                source=Opt(list), size=Opt(int), spilled=Opt(bool))
+# per-job scheduling quotas (weights + in-flight ceilings)
+register_schema("set_job_quota", job=str, quota=Opt(dict))
+register_schema("get_job_quotas")
 
 # actor lifecycle (beyond registration)
 register_schema("actor_creation_failed", actor_id=bytes, reason=Opt(str))

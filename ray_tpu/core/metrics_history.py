@@ -70,7 +70,7 @@ class RecordingRule:
 
     name: str            # derived series, e.g. "serve:p99_latency_s"
     source: str          # ray_tpu_* series the rule reads
-    fn: str              # rate | quantile | sum | max | avg
+    fn: str              # rate | quantile | sum | max | avg | burn
     window_s: float = 60.0
     q: float = 0.99
     #: tag keys preserved in the derived series (one derived ring per
@@ -126,6 +126,13 @@ def default_recording_rules(interval_s: float) -> List[RecordingRule]:
         RecordingRule(name="serve:queue_depth",
                       source="ray_tpu_serve_queue_depth", fn="sum",
                       group_by=("deployment",)),
+        # burn rate as a first-class series: the EXACT input the
+        # ServeSLOBurnRate alert compares against 1.0, exposed through
+        # get_timeseries so the autoscaler can scale up at burn ~0.5 —
+        # before the alert's threshold is ever reached
+        RecordingRule(name="serve:slo_burn_rate",
+                      source="ray_tpu_serve_request_latency_s",
+                      fn="burn", window_s=w, group_by=("deployment",)),
         # -- control-plane health --------------------------------------
         RecordingRule(name="gcs:heartbeat_miss_rate",
                       source="ray_tpu_gcs_heartbeat_misses_total",
@@ -503,6 +510,14 @@ class MetricsHistory:
                 elif rule.fn == "quantile":
                     value = self.quantile(rule.source, rule.q, now,
                                           rule.window_s, group or None)
+                elif rule.fn == "burn":
+                    if self.slo_latency_s <= 0:
+                        continue
+                    miss = self.fraction_over(
+                        rule.source, self.slo_latency_s, now,
+                        rule.window_s, group or None)
+                    value = (None if miss is None
+                             else miss / self.slo_error_budget)
                 else:
                     value = self.latest(rule.source, rule.fn,
                                         group or None, now=now)

@@ -45,6 +45,9 @@ from ray_tpu.core.config import Config
 from ray_tpu.core.exceptions import ObjectStoreFullError
 from ray_tpu.core.ids import NodeID, ObjectID, PlacementGroupID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.autoscaler.fair_queue import (NODE_ACTIVE, NODE_DRAINED,
+                                           NODE_DRAINING, FairQueue,
+                                           JobQuota, QuotaExceeded)
 from ray_tpu.util import failpoint as _fp
 
 logger = logging.getLogger(__name__)
@@ -92,6 +95,9 @@ class WorkerHandle:
     #: assignment backing ray.get_gpu_ids)
     lease_tpu_ids: List[int] = field(default_factory=list)
     lease_tpu_share: float = 0.0
+    #: fair-queue job key charged for this lease's in-flight usage —
+    #: releases and reconciliation settle against it
+    lease_job_key: Optional[str] = None
     is_actor: bool = False
     #: connection of the client holding the current lease (reclaim pushes)
     owner_conn: Optional[rpc.Connection] = None
@@ -215,6 +221,12 @@ class PendingLease:
     #: (warm-pool MISS); grants with it still False count as HITS —
     #: each lease contributes exactly one hit or one miss
     pool_missed: bool = False
+    #: fair-queue sub-queue this lease is charged to (job id hex, or a
+    #: per-connection key for job-less leases)
+    job_key: str = ""
+    #: worker picked by the scheduling pass's fits() probe, consumed by
+    #: the grant commit in the same pass (never survives across passes)
+    granted_worker: Optional[WorkerHandle] = None
 
 
 class _InflightPull:
@@ -361,7 +373,19 @@ class Raylet:
         self._starting_env: Dict[str, int] = {}
         self._env_spawn_hash: Dict[str, str] = {}
         self._env_broken: Dict[str, str] = {}
-        self._pending_leases: List[PendingLease] = []
+        # weighted-fair lease queue with per-job quotas (pure math in
+        # ray_tpu/autoscaler/fair_queue.py; this class feeds it events).
+        # Job-less leases key by connection, so multi-client round-robin
+        # degenerates to the pre-quota behavior.
+        self._fair = FairQueue(resources_of=lambda lease: lease.resources)
+        # quota keys installed from the GCS table (health-ack piggyback
+        # + "quotas" pubsub); tracked so removals propagate
+        self._gcs_quota_jobs: Set[str] = set()
+        # node lifecycle (docs/autoscaler.md): while True this raylet
+        # grants nothing — new lease requests spill to ACTIVE peers and
+        # the drain protocol migrates the object plane before release
+        self._draining = False
+        self._drain_task: Optional[asyncio.Task] = None
         self._register_waiters: List[asyncio.Future] = []
         # cluster profiling window state (profiler_control): kept so
         # workers that register MID-window join it via the register
@@ -438,6 +462,11 @@ class Raylet:
         })
         # adopt the cluster-wide config decided by the head node
         self.config = Config.from_json(reply["config"])
+        # adopt the durable lifecycle verdict + quota table: a raylet
+        # re-registering after a GCS restart mid-drain resumes DRAINING
+        # instead of silently re-opening its lease plane
+        self._apply_gcs_state(reply.get("state"))
+        self._apply_quotas(reply.get("quotas"))
         # join an in-progress cluster profiling window (node added
         # mid-`ray-tpu profile`)
         prof = reply.get("profiler")
@@ -465,6 +494,12 @@ class Raylet:
         self.gcs_conn.set_push_handler(self._on_gcs_push)
         await self.gcs_conn.call("subscribe", {"channel": "resource_view"})
         self._view_subscribed = True
+        # quota updates push immediately; the health-report ack
+        # re-carries the full table each beat as the catch-up path
+        try:
+            await self.gcs_conn.call("subscribe", {"channel": "quotas"})
+        except (rpc.ConnectionLost, rpc.RpcError, asyncio.TimeoutError):
+            pass
         if getattr(self.config, "event_stats", True):
             from ray_tpu.util.event_stats import HandlerStats, LoopMonitor
             self.server.handler_stats = HandlerStats()
@@ -518,6 +553,9 @@ class Raylet:
         self.store.close()
 
     def _on_gcs_push(self, channel: str, data: Any) -> None:
+        if channel == "quotas":
+            self._apply_quotas(data.get("quotas"))
+            return
         if channel != "resource_view":
             return
         version = data.get("version", 0)
@@ -548,14 +586,22 @@ class Raylet:
     async def _health_loop(self) -> None:
         while not self._closing:
             try:
+                # re-anchor the fair queue's advisory in-flight ledger
+                # on ground truth (live leases) each beat: dropped
+                # accounting updates (raylet.quota.account_drop, crash
+                # paths) converge instead of wedging a job forever
+                self._fair.reconcile(self._lease_usage_truth())
                 reply = await self.gcs_conn.call("health_report", {
                     "node_id": self.node_id.binary(),
                     "resources_available": self.resources_available,
-                    "load": len(self._pending_leases),
+                    "load": self._fair.pending_count(),
                     # queued resource shapes drive autoscaling (parity:
                     # resource_load_by_shape in the reference's syncer)
                     "pending_demand": [lease.resources for lease in
-                                       self._pending_leases[:100]],
+                                       self._fair.pending()[:100]],
+                    # per-job in-flight usage: the GCS WALs it per node
+                    # so quota accounting survives a head SIGKILL
+                    "lease_usage": self._fair.export_usage(),
                     # per-node reporter payload (parity:
                     # dashboard/modules/reporter) — node cpu/mem plus
                     # per-worker cpu%/rss
@@ -564,6 +610,8 @@ class Raylet:
                 if not reply.get("acked"):
                     logger.error("GCS rejected health report; exiting raylet")
                     break
+                self._apply_gcs_state(reply.get("state"))
+                self._apply_quotas(reply.get("quotas"))
                 if not self._view_subscribed:
                     # a re-register's subscribe failed: retry every beat
                     # (without the subscription the view would freeze on
@@ -641,12 +689,246 @@ class Raylet:
             except (rpc.ConnectionLost, rpc.RpcError,
                     asyncio.TimeoutError):
                 pass  # the health loop retries each beat
+            # resume the durable lifecycle verdict: a GCS restart
+            # mid-drain must not re-open a DRAINING node's lease plane
+            self._apply_gcs_state(reply.get("state"))
+            self._apply_quotas(reply.get("quotas"))
             logger.info("raylet %s re-registered with restarted GCS",
                         self.node_id.hex()[:12])
             return bool(reply)
         except (rpc.ConnectionLost, rpc.RpcError, OSError,
                 asyncio.TimeoutError):
             return False
+
+    # ------------------------------------------------------------------
+    # node lifecycle + quota plane (docs/autoscaler.md)
+    # ------------------------------------------------------------------
+    def _lease_usage_truth(self) -> Dict[str, Dict[str, float]]:
+        """Per-job in-flight resources from the LIVE lease table (the
+        granted workers themselves) — the ground truth the fair queue's
+        advisory ledger reconciles against."""
+        truth: Dict[str, Dict[str, float]] = {}
+        for w in self.workers.values():
+            if w.leased and w.lease_job_key:
+                usage = truth.setdefault(w.lease_job_key, {})
+                for k, v in w.lease_resources.items():
+                    usage[k] = usage.get(k, 0.0) + v
+        return truth
+
+    def _apply_gcs_state(self, state: Optional[str]) -> None:
+        """Adopt the GCS's durable lifecycle verdict for this node.
+        DRAINING/DRAINED closes the lease plane (a head restart
+        mid-drain re-delivers the verdict here); ACTIVE re-opens it —
+        the GCS aborted the drain, so any still-running local drain is
+        cancelled and queued leases get scheduled again."""
+        if state is None:
+            return
+        if state in (NODE_DRAINING, NODE_DRAINED):
+            if not self._draining:
+                logger.info("raylet %s entering %s (GCS verdict)",
+                            self.node_id.hex()[:12], state)
+                self._draining = True
+        elif self._draining:
+            task, self._drain_task = self._drain_task, None
+            if task is not None and not task.done():
+                task.cancel()
+            self._draining = False
+            logger.info("raylet %s back to ACTIVE (drain aborted)",
+                        self.node_id.hex()[:12])
+            self._maybe_schedule()
+
+    def _apply_quotas(self, quotas: Optional[Dict[str, Any]]) -> None:
+        """Install the GCS quota table (full-state replace: jobs gone
+        from the table lose their local quota too)."""
+        if quotas is None:
+            return
+        fresh: Set[str] = set()
+        for job, q in quotas.items():
+            try:
+                self._fair.set_quota(job, JobQuota.from_dict(q))
+            except Exception:  # noqa: BLE001 — one bad row, not all
+                continue
+            fresh.add(job)
+        for job in self._gcs_quota_jobs - fresh:
+            self._fair.remove_quota(job)
+        self._gcs_quota_jobs = fresh
+
+    async def handle_drain(self, conn, data):
+        """GCS-driven graceful drain (docs/autoscaler.md): quiesce the
+        lease plane, migrate every pinned primary + local spill blob to
+        an ACTIVE peer, and reply ok only when NOTHING on this node is
+        the last copy of anything.  Any failure replies not-ok — the
+        GCS aborts the drain and this node goes back to serving with
+        its object plane untouched (the success path is the only one
+        that releases pins)."""
+        peers = [p for p in data.get("peers", [])
+                 if bytes(p["node_id"]) != self.node_id.binary()]
+        task = self._drain_task
+        if task is None:
+            self._draining = True
+            task = self._drain_task = asyncio.ensure_future(
+                self._drain_impl(peers))
+        try:
+            # shield: a dropped GCS connection mid-drain must not kill
+            # the migration — the GCS retry coalesces onto this task
+            result = await asyncio.shield(task)
+        except asyncio.CancelledError:
+            if not task.cancelled():
+                # the HANDLER was cancelled (connection torn down),
+                # not the drain — shield kept the migration running
+                raise
+            # cancelled by _apply_gcs_state (GCS-side abort): the node
+            # is already back to ACTIVE there
+            return {"ok": False, "error": "drain cancelled"}
+        except Exception as e:  # noqa: BLE001 — abort, stay serving
+            result = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if not result.get("ok"):
+            self._draining = False
+            self._drain_task = None
+            self._maybe_schedule()
+        return result
+
+    def _respill_queued(self) -> Optional[str]:
+        """Move every queued lease to an ACTIVE peer; returns an error
+        string when one cannot move (pinned demand, or no feasible
+        peer) — the drain must abort so the request is served HERE."""
+        for lease in self._fair.pending():
+            if lease.future.done():
+                self._fair.remove(lease)
+                continue
+            spill = None
+            if lease.bundle is None:
+                spill = self._pick_spillback(lease.resources,
+                                             lease.request,
+                                             force_remote=True)
+            if spill is None:
+                return ("queued lease %s cannot move to a peer"
+                        % (lease.resources,))
+            self._fair.remove(lease)
+            lease.future.set_result({"spillback": spill})
+        return None
+
+    async def _drain_impl(self, peers: List[Dict[str, Any]]
+                          ) -> Dict[str, Any]:
+        # 1) actors pin their host: their in-memory state can't migrate
+        actors = sum(1 for w in self.workers.values() if w.is_actor)
+        if actors:
+            return {"ok": False,
+                    "error": f"{actors} actor(s) hosted on node"}
+        # 2) wait (bounded) for in-flight task leases to come home,
+        #    nudging owners to cut their idle-lease grace short
+        deadline = time.monotonic() + max(
+            1.0, 0.4 * getattr(self.config, "drain_timeout_s", 60.0))
+        while any(w.leased for w in self.workers.values()):
+            for w in list(self.workers.values()):
+                c = w.owner_conn
+                if w.leased and c is not None and not c.closed:
+                    c.push("reclaim_idle", {})
+            if time.monotonic() > deadline:
+                n = sum(1 for w in self.workers.values() if w.leased)
+                return {"ok": False,
+                        "error": f"{n} lease(s) still in flight"}
+            await asyncio.sleep(0.05)
+        # 3) queued leases move to peers (or the drain aborts)
+        err = self._respill_queued()
+        if err is not None:
+            return {"ok": False, "error": err}
+        # 4) object migration: every pinned primary and every local
+        #    spill blob gets adopted (pulled + re-pinned) by a peer
+        #    BEFORE this node drops anything.  URI-spilled blobs
+        #    already outlive this node — the owner holds the URI.
+        to_move: List[Tuple[ObjectID, bool]] = \
+            [(oid, False) for oid in self._primary]
+        to_move += [(oid, True) for oid, target in self._spilled.items()
+                    if "://" not in target and oid not in self._primary]
+        if to_move and not peers:
+            return {"ok": False,
+                    "error": "no ACTIVE peers to adopt objects"}
+        migrated = spill_handed_off = 0
+        rr = 0
+        for oid, spilled in to_move:
+            adopted = None
+            for attempt in range(len(peers)):
+                peer = peers[(rr + attempt) % len(peers)]
+                try:
+                    pconn = await self.pool.get(tuple(peer["address"]))
+                    owner = self._owner_of.get(oid)
+                    reply = await pconn.call("adopt_object", {
+                        "object_id": oid.binary(),
+                        "owner": list(owner) if owner else None,
+                        "source": list(self.server.address),
+                        "spilled": spilled,
+                    }, timeout=30.0)
+                except (rpc.ConnectionLost, rpc.RpcError,
+                        asyncio.TimeoutError, OSError):
+                    continue
+                if reply and reply.get("ok"):
+                    adopted = reply
+                    break
+            rr += 1
+            if adopted is None:
+                return {"ok": False,
+                        "error": f"migration of {oid.hex()[:12]} failed"}
+            # byte-identity guard: the adopted copy must be the size we
+            # hold (content equality rides the pull protocol's chunking)
+            expect = self._spilled_sizes.get(oid)
+            if expect is None:
+                lease = self.store.lease(oid)
+                if lease is not None:
+                    expect = lease[1]
+                    self.store.release(oid)
+            if expect is not None and adopted.get("size") != expect:
+                return {"ok": False,
+                        "error": f"adopted copy of {oid.hex()[:12]} is "
+                                 f"{adopted.get('size')} bytes, "
+                                 f"expected {expect}"}
+            # hand-off complete: drop OUR claim.  The arena copy left
+            # behind is a plain evictable secondary on a node about to
+            # terminate; the spill blob is deleted outright.
+            if spilled:
+                target = self._spilled.pop(oid, None)
+                self._spill_bytes -= self._spilled_sizes.pop(oid, 0)
+                if target is not None:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._delete_spill_blob, target)
+                spill_handed_off += 1
+            else:
+                self._primary.discard(oid)
+                self.store.release(oid)
+                migrated += 1
+        # 5) leases that arrived during the migration: move or abort
+        err = self._respill_queued()
+        if err is not None:
+            return {"ok": False, "error": err}
+        logger.info("raylet %s drained: %d primaries migrated, %d "
+                    "spill blobs handed off", self.node_id.hex()[:12],
+                    migrated, spill_handed_off)
+        return {"ok": True, "migrated": migrated,
+                "spill_handed_off": spill_handed_off}
+
+    async def handle_adopt_object(self, conn, data):
+        """Drain-migration target (peer side): pull the object — via
+        the owner's directory when it has one, so the transfer chains
+        like any broadcast pull, else straight from the draining source
+        — and pin it as OUR primary before the drainer releases."""
+        oid = ObjectID(data["object_id"])
+        owner = tuple(data["owner"]) if data.get("owner") else None
+        ok = self.store.contains(oid)
+        if not ok and owner is not None:
+            ok = await self._make_local(oid, owner,
+                                        time.monotonic() + 25.0)
+        if not ok and data.get("source"):
+            src = tuple(data["source"])
+            ok = await self._pull_object(oid, [src], [], None)
+        if not ok:
+            return {"ok": False, "error": "pull failed"}
+        lease = self.store.lease(oid)
+        if lease is None:
+            return {"ok": False, "error": "adopted copy vanished"}
+        size = lease[1]
+        self.store.release(oid)
+        self._mark_primary(oid, owner)
+        return {"ok": True, "size": size}
 
     # ------------------------------------------------------------------
     # memory monitor + worker killing policy (parity:
@@ -872,7 +1154,7 @@ class Raylet:
             # safety re-kick: if demand is queued with nothing idle and
             # no retry timer armed (e.g. _maybe_schedule ran without a
             # loop), rescan so waiting leases can't stall indefinitely
-            if self._pending_leases and not self._idle \
+            if self._fair.pending_count() and not self._idle \
                     and not self._reclaim_timer_armed:
                 self._maybe_schedule()
             # demand-driven pool rebuild, only while the lease plane is
@@ -882,7 +1164,7 @@ class Raylet:
             # lands on warm forks.  Counted against PLAIN idle workers —
             # idle env workers can't serve ordinary leases and must not
             # suppress the rebuild.
-            if not self._pending_leases and not self._closing and \
+            if not self._fair.pending_count() and not self._closing and \
                     not self._creating_actors and \
                     now - getattr(self, "_last_lease_ts", 0.0) > 1.5:
                 idle_plain = sum(1 for w in self._idle
@@ -1382,8 +1664,18 @@ class Raylet:
             if bundle is None:
                 return {"error": "placement group bundle not on this node"}
         job_id_bin = data.get("job_id")
+        job_key = job_id_bin.hex() if job_id_bin else f"conn-{id(conn):x}"
 
-        if not self._fits(resources, bundle):
+        if self._draining:
+            # a draining node takes no new work: hand the request to an
+            # ACTIVE peer outright.  Pinned demand (placement groups /
+            # NODE_AFFINITY) queues — the drain's re-spill pass aborts
+            # the drain if it cannot move, so the request never fails.
+            spill = self._pick_spillback(resources, data,
+                                         force_remote=True)
+            if spill is not None:
+                return {"spillback": spill}
+        elif not self._fits(resources, bundle):
             spill = self._pick_spillback(resources, data)
             if spill is not None:
                 return {"spillback": spill}
@@ -1394,13 +1686,19 @@ class Raylet:
                     "(waiting for new nodes)", resources)
         self._last_lease_ts = time.monotonic()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending_leases.append(PendingLease(
+        lease = PendingLease(
             request=data, future=fut, job_id_bin=job_id_bin,
             resources=resources, bundle=bundle,
             env_hash=data.get("env_hash"),
             env_spawn=data.get("env_spawn"),
             retriable=bool(data.get("retriable", True)),
-            token=data.get("token"), conn=conn))
+            token=data.get("token"), conn=conn, job_key=job_key)
+        try:
+            self._fair.push(lease, job_key)
+        except QuotaExceeded as e:
+            # reject-mode tenant past its in-flight ceiling: bounce at
+            # admission (the queue-mode alternative parks instead)
+            return {"error": str(e), "quota_rejected": True}
         self._maybe_schedule()
         # traced lease (the owner forwarded its head task's context):
         # the queue-wait-until-grant hop joins the request's trace tree
@@ -1425,9 +1723,11 @@ class Raylet:
         request so a later grant doesn't churn a worker through a
         grant->instant-return cycle while real demand waits."""
         token = data.get("token")
-        for i, lease in enumerate(self._pending_leases):
-            if lease.token == token and lease.token is not None:
-                del self._pending_leases[i]
+        if token is None:
+            return False
+        for lease in self._fair.pending():
+            if lease.token == token:
+                self._fair.remove(lease)
                 if not lease.future.done():
                     lease.future.set_result({"canceled": True})
                 return True
@@ -1452,7 +1752,8 @@ class Raylet:
 
     def _feasible_anywhere(self, resources: Dict[str, float]) -> bool:
         for node in self._cluster_view:
-            if not node.get("alive"):
+            if not node.get("alive") \
+                    or node.get("state", NODE_ACTIVE) != NODE_ACTIVE:
                 continue
             total = node.get("resources_total", {})
             if all(total.get(k, 0.0) >= v for k, v in resources.items()):
@@ -1461,14 +1762,21 @@ class Raylet:
                    for k, v in resources.items())
 
     def _pick_spillback(self, resources: Dict[str, float],
-                        data: Dict[str, Any]) -> Optional[rpc.Address]:
+                        data: Dict[str, Any],
+                        force_remote: bool = False
+                        ) -> Optional[rpc.Address]:
         """Hybrid policy: if local is saturated, hand the lease to the
-        least-loaded remote node that can run it *now*."""
+        least-loaded remote node that can run it *now*.  With
+        ``force_remote`` (this node is draining) staying local is not
+        an option: any ACTIVE peer that could EVER run the shape takes
+        it — the lease may queue there, but it never strands on a node
+        about to release."""
         strategy = data.get("strategy", "DEFAULT")
         if strategy == "NODE_AFFINITY" or data.get("placement_group_id"):
             return None  # pinned to this node
         remotes = [n for n in self._cluster_view
                    if n.get("alive")
+                   and n.get("state", NODE_ACTIVE) == NODE_ACTIVE
                    and bytes(n["node_id"]) != self.node_id.binary()]
         if not remotes:
             return None
@@ -1501,6 +1809,24 @@ class Raylet:
         def charge(node) -> None:
             key = bytes(node["node_id"])
             pressure[key] = (decayed_count(key) + 1.0, now)
+
+        if force_remote:
+            # feasible-by-TOTAL, least charged load: instant
+            # availability is the wrong bar when the alternative is a
+            # lease stranded on a draining node
+            best = None
+            best_load = None
+            for node in remotes:
+                total = node.get("resources_total", {})
+                if all(total.get(k, 0.0) >= v
+                       for k, v in resources.items()):
+                    load = charged_load(node)
+                    if best is None or load < best_load:
+                        best, best_load = node, load
+            if best is None:
+                return None
+            charge(best)
+            return tuple(best["address"])
 
         try:
             # the hybrid/spread decision runs in the native scheduling
@@ -1543,41 +1869,47 @@ class Raylet:
         return tuple(best["address"])
 
     def _maybe_schedule(self) -> None:
-        """Grant queued leases — round-robin across clients, FIFO within
-        each — while resources and workers allow; spill queued leases to
-        other nodes as the cluster view evolves."""
+        """Grant queued leases in weighted deficit-round-robin order —
+        per-job sub-queues with quota ceilings (FairQueue); job-less
+        leases key by client connection, so the multi-client interleave
+        degenerates to the pre-quota round-robin.  Spills queued leases
+        to other nodes as the cluster view evolves."""
         if self._closing or self._sched_suspended:
             return
-        remaining: List[PendingLease] = []
-        want_workers: List[Tuple[Optional[bytes], bool]] = []
-        grants: List[Tuple[PendingLease, WorkerHandle]] = []
-        # Round-robin the queue across CLIENTS (FIFO within each): pure
-        # FIFO handed every free worker to whichever client enqueued
-        # first, serializing whole clients behind each other — the
-        # middle of the clients-vs-throughput curve collapsed because
-        # client B's burst only started when client A's fully drained.
-        pending = self._pending_leases
-        if len({id(lease.conn) for lease in pending}) > 1:
-            from itertools import chain, zip_longest
-            by_conn: Dict[int, List[PendingLease]] = {}
-            for lease in pending:  # dicts preserve insertion order
-                by_conn.setdefault(id(lease.conn), []).append(lease)
-            pending = [lease for lease in chain.from_iterable(
-                zip_longest(*by_conn.values())) if lease is not None]
-        for lease in pending:
+        # pre-pass: drop settled futures; re-evaluate spillback for
+        # leases this node can't fit (e.g. demand for a resource this
+        # node will never have) — and, while draining, for EVERY lease
+        for lease in self._fair.pending():
             if lease.future.done():
+                self._fair.remove(lease)
                 continue
-            if not self._fits(lease.resources, lease.bundle):
-                # re-evaluate spillback against the latest cluster view
-                # (e.g. demand for a resource this node will never have)
+            if self._draining or not self._fits(lease.resources,
+                                                lease.bundle):
                 if lease.bundle is None:
-                    spill = self._pick_spillback(lease.resources,
-                                                 lease.request)
+                    spill = self._pick_spillback(
+                        lease.resources, lease.request,
+                        force_remote=self._draining)
                     if spill is not None:
+                        self._fair.remove(lease)
                         lease.future.set_result({"spillback": spill})
-                        continue
-                remaining.append(lease)
-                continue
+        if self._draining:
+            # a draining node grants nothing: leases that could not
+            # spill stay queued — the drain either re-spills them
+            # before DRAINED or aborts back to ACTIVE and re-runs this
+            self._note_backlog_demand(self._fair.pending_count())
+            return
+        want_workers: List[Tuple[Optional[bytes], bool, int]] = []
+        wanted: Set[int] = set()  # fits() may probe one lease per round
+        errors: Dict[int, Tuple[PendingLease, str]] = {}
+
+        def fits(lease: PendingLease) -> bool:
+            """Feasibility probe for one grant attempt: resources AND a
+            worker.  On success the popped worker rides the lease to
+            the commit loop below (same synchronous pass — nothing can
+            interleave)."""
+            if id(lease) in errors \
+                    or not self._fits(lease.resources, lease.bundle):
+                return False
             needs_tpu = lease.resources.get("TPU", 0) > 0
             # isolated envs live in the worker's interpreter itself, so
             # only a worker born under that env can serve the lease —
@@ -1595,24 +1927,33 @@ class Raylet:
                     # isolated env: the worker must be BORN under the
                     # env's interpreter/container — spawn dedicated
                     if needs_tpu:
-                        lease.future.set_result({"error":
+                        errors[id(lease)] = (lease,
                             "isolated runtime envs (venv/conda/"
                             "container/py_executable) cannot lease "
                             "TPUs; use the in-process pip env for "
-                            "TPU tasks"})
-                        continue
-                    err = self._env_broken.get(lease.env_hash)
-                    if err is not None:
-                        lease.future.set_result({"error": err})
-                        continue
-                    remaining.append(lease)
-                    if self._starting_env.get(lease.env_hash, 0) == 0:
+                            "TPU tasks")
+                    elif self._env_broken.get(lease.env_hash) is not None:
+                        errors[id(lease)] = (
+                            lease, self._env_broken[lease.env_hash])
+                    elif self._starting_env.get(lease.env_hash, 0) == 0:
                         self._start_env_worker(lease)
-                    continue
-                remaining.append(lease)
-                want_workers.append((lease.job_id_bin, needs_tpu,
-                                     id(lease.conn)))
-                continue
+                    return False
+                if id(lease) not in wanted:
+                    wanted.add(id(lease))
+                    want_workers.append((lease.job_id_bin, needs_tpu,
+                                         id(lease.conn)))
+                return False
+            lease.granted_worker = worker
+            return True
+
+        fair_grants = self._fair.grant_order(fits)
+        for lease, err in errors.values():
+            self._fair.remove(lease)
+            if not lease.future.done():
+                lease.future.set_result({"error": err})
+        grants: List[Tuple[PendingLease, WorkerHandle]] = []
+        for job_key, lease in fair_grants:
+            worker, lease.granted_worker = lease.granted_worker, None
             self._take(lease.resources, lease.bundle)
             _tm.lease_granted(time.monotonic() - lease.enqueued_at)
             if not lease.pool_missed:
@@ -1623,12 +1964,13 @@ class Raylet:
             worker.lease_retriable = lease.retriable
             worker.lease_granted_at = time.monotonic()
             worker.lease_token = lease.token
+            worker.lease_job_key = job_key
             worker.owner_conn = lease.conn
             if lease.env_hash is not None:
                 worker.env_hash = lease.env_hash
             self._assign_tpu_ids(worker, lease.resources.get("TPU", 0.0))
             grants.append((lease, worker))
-        self._pending_leases = remaining
+        remaining = self._fair.pending()
         # Grants resolve AFTER the pass so each reply can carry an exact
         # contention signal: demand is still queued, so the owner should
         # hand the worker back the moment it idles instead of holding it
@@ -1724,7 +2066,7 @@ class Raylet:
                     self._reclaim_timer_armed = False
                     self._reclaim_retry_delay = min(
                         0.5, self._reclaim_retry_delay * 1.6)
-                    if not self._closing and self._pending_leases:
+                    if not self._closing and self._fair.pending_count():
                         self._maybe_schedule()
                 try:
                     asyncio.get_running_loop().call_later(delay, _retry)
@@ -1877,6 +2219,16 @@ class Raylet:
     def _release_lease_resources(self, worker: WorkerHandle) -> None:
         if worker.leased:
             self._give(worker.lease_resources, worker.lease_bundle)
+            # settle the fair queue's in-flight quota charge.  The
+            # failpoint models a dropped accounting update (chaos): the
+            # ledger drifts until the health beat's reconcile re-anchors
+            # it on the live lease table — a drop throttles a job for at
+            # most one beat, never forever.
+            if worker.lease_job_key is not None and \
+                    not _fp.failpoint("raylet.quota.account_drop"):
+                self._fair.release(worker.lease_job_key,
+                                   worker.lease_resources)
+            worker.lease_job_key = None
             worker.leased = False
             worker.lease_token = None
             worker.owner_conn = None
@@ -2040,7 +2392,12 @@ class Raylet:
         tags = {"node": self.node_id.hex()[:12]}
         _tm.set_gauge("ray_tpu_sched_pending_leases",
                       "worker-lease requests queued on the raylet",
-                      len(self._pending_leases), tags)
+                      self._fair.pending_count(), tags)
+        for job, n in self._fair.throttled_total.items():
+            _tm.set_gauge("ray_tpu_sched_quota_throttled_total",
+                          "lease grants skipped or rejected by the "
+                          "job's quota ceiling (cumulative)",
+                          n, {**tags, "job": job})
         _tm.set_gauge("ray_tpu_transfer_inflight_pulls",
                       "object transfers currently being received",
                       len(self._inflight_pulls), tags)
@@ -2207,7 +2564,9 @@ class Raylet:
         surface."""
         mon = getattr(self, "_loop_monitor", None)
         out = mon.snapshot() if mon is not None else {}
-        out["pending_leases"] = len(self._pending_leases)
+        out["pending_leases"] = self._fair.pending_count()
+        out["draining"] = self._draining
+        out["fair_queue"] = self._fair.snapshot()
         out["inflight_pulls"] = len(self._inflight_pulls)
         out["workers"] = len(self.workers)
         out["idle_workers"] = len(self._idle)
@@ -2312,10 +2671,12 @@ class Raylet:
                 self._on_worker_dead(worker, "placement group bundle returned")
         # queued leases against the bundle can never be granted now — fail
         # them instead of leaving their futures pending forever
-        for lease in self._pending_leases:
-            if lease.bundle == key and not lease.future.done():
-                lease.future.set_result(
-                    {"error": "placement group bundle removed"})
+        for lease in self._fair.pending():
+            if lease.bundle == key:
+                self._fair.remove(lease)
+                if not lease.future.done():
+                    lease.future.set_result(
+                        {"error": "placement group bundle removed"})
         self._maybe_schedule()
         return True
 

@@ -349,6 +349,45 @@ def node_death() -> None:
              "nodes the GCS declared dead").inc_key(_EMPTY_KEY)
 
 
+def autoscaler_decision(action: str) -> None:
+    """One AutoscalerMonitor policy verdict (scale_up | allow_down |
+    hold), counted per tick."""
+    if not enabled():
+        return
+    _counter("ray_tpu_autoscaler_decisions_total",
+             "scaling-policy decisions emitted by the autoscaler "
+             "monitor", ("action",)).inc_key((("action", action),))
+
+
+def autoscaler_launch_failure() -> None:
+    """A provider node launch failed (or the launch_fail failpoint
+    fired); the monitor backs off exponentially and retries."""
+    if not enabled():
+        return
+    _counter("ray_tpu_autoscaler_launch_failures_total",
+             "node provider launches that failed (retried with "
+             "backoff)").inc_key(_EMPTY_KEY)
+
+
+def autoscaler_target_nodes(n: int) -> None:
+    if not enabled():
+        return
+    _gauge("ray_tpu_autoscaler_target_nodes",
+           "worker nodes the autoscaler currently maintains "
+           "(provider view)").set_key(_EMPTY_KEY, float(n))
+
+
+def node_drain_transition(state: str) -> None:
+    """One node lifecycle transition (docs/autoscaler.md drain
+    protocol): DRAINING (drain started), DRAINED (migration complete),
+    ACTIVE (drain aborted, node returned to service)."""
+    if not enabled():
+        return
+    _counter("ray_tpu_gcs_node_drain_transitions_total",
+             "node lifecycle transitions driven by the drain protocol",
+             ("state",)).inc_key((("state", state),))
+
+
 def task_events_dropped(job_id: Optional[str], n: int) -> None:
     if not enabled() or n <= 0:
         return

@@ -388,13 +388,19 @@ def _render_top(w, jobs: bool = False) -> list:
                          f"{_sparkline(row['points'])}")
     if jobs:
         lines.append("")
-        lines.extend(_render_jobs(records))
+        try:
+            qview = w.gcs_call("get_job_quotas", {}) or {}
+        except Exception:  # noqa: BLE001 — pre-quota GCS
+            qview = {}
+        lines.extend(_render_jobs(records, qview.get("quotas"),
+                                  qview.get("lease_tables")))
     return lines
 
 
-def _render_jobs(records) -> list:
-    """Per-job attribution rollup from the ``ray_tpu_job_*`` series
-    (tasks, cpu-seconds, submitted/spilled bytes, arena bytes)."""
+def _render_jobs(records, quotas=None, lease_tables=None) -> list:
+    """Per-job attribution rollup: the ``ray_tpu_job_*`` series plus
+    the fair-queue view (quota weight, in-flight CPU the lease tables
+    attribute to the job, leases throttled behind its weight)."""
     cols = {"ray_tpu_job_tasks_total": "tasks",
             "ray_tpu_job_cpu_seconds_total": "cpu_s",
             "ray_tpu_job_submitted_bytes_total": "submitted",
@@ -404,25 +410,45 @@ def _render_jobs(records) -> list:
     for r in records:
         col = cols.get(r["name"])
         if col is None:
+            if r["name"] == "ray_tpu_sched_quota_throttled_total":
+                job = r.get("tags", {}).get("job", "unknown")
+                row = per_job.setdefault(job, {})
+                row["throttled"] = row.get("throttled", 0.0) \
+                    + r.get("value", 0)
             continue
         job = r.get("tags", {}).get("job", "unknown")
         row = per_job.setdefault(job, {})
         # arena gauges are per (node, job): sum across nodes
         row[col] = row.get(col, 0.0) + r.get("value", 0)
+    quotas = quotas or {}
+    for job in quotas:
+        per_job.setdefault(job, {})
+    # in-flight usage: the per-node lease tables, summed across nodes
+    for table in (lease_tables or {}).values():
+        for job, usage in (table or {}).items():
+            row = per_job.setdefault(job, {})
+            row["in_use"] = row.get("in_use", 0.0) \
+                + float((usage or {}).get("CPU", 0.0))
     out = [f"{'job':<14}{'tasks':>8}{'cpu-s':>9}{'submitted':>11}"
-           f"{'spilled':>9}{'arena':>9}"]
+           f"{'spilled':>9}{'arena':>9}{'wt':>5}{'in-use':>8}"
+           f"{'thrtl':>7}"]
     if not per_job:
         out.append("  (no per-job series yet — run some tasks)")
         return out
     for job in sorted(per_job,
                       key=lambda j: -per_job[j].get("cpu_s", 0)):
         row = per_job[job]
+        q = quotas.get(job) or {}
+        wt = f"{float(q.get('weight', 1.0)):g}" if q else "-"
         out.append(
             f"{job:<14}{row.get('tasks', 0):>8.0f}"
             f"{row.get('cpu_s', 0):>9.2f}"
             f"{row.get('submitted', 0) / 2**20:>10.1f}M"
             f"{row.get('spilled', 0) / 2**20:>8.1f}M"
-            f"{row.get('arena', 0) / 2**20:>8.1f}M")
+            f"{row.get('arena', 0) / 2**20:>8.1f}M"
+            f"{wt:>5}"
+            f"{row.get('in_use', 0.0):>8.1f}"
+            f"{row.get('throttled', 0):>7.0f}")
     return out
 
 
@@ -479,6 +505,66 @@ def cmd_alerts(args) -> None:
             print(f"  {a['rule']}" + (f"[{tags}]" if tags else "")
                   + f"  resolved {_fmt_since(a['resolved_at'])} ago "
                   f"(fired {_fmt_since(a['since'])} ago)")
+
+
+def cmd_nodes(args) -> None:
+    """Node lifecycle table: ACTIVE/DRAINING/DRAINED/DEAD state per
+    node (the drain protocol's view, docs/autoscaler.md) plus the
+    autoscaler monitor's last recorded decision."""
+    _connect(args)
+    from ray_tpu.core.worker import global_worker
+    w = global_worker()
+    nodes = w.gcs_call("get_nodes", {}) or []
+    if args.json:
+        for n in nodes:
+            n["node_id"] = n["node_id"].hex()
+        print(json.dumps(nodes, indent=2, default=str))
+        return
+    print(f"{'node':<14}{'state':<10}{'alive':<7}{'cpu':>10}"
+          f"{'tpu':>8}{'load':>6}  reason")
+    for n in sorted(nodes, key=lambda n: n["node_id"]):
+        total = n.get("resources_total", {})
+        avail = n.get("resources_available", {})
+        cpu = f"{avail.get('CPU', 0):g}/{total.get('CPU', 0):g}"
+        tpu = f"{avail.get('TPU', 0):g}/{total.get('TPU', 0):g}" \
+            if total.get("TPU") else "-"
+        print(f"{n['node_id'].hex()[:12]:<14}"
+              f"{n.get('state', 'ACTIVE'):<10}"
+              f"{'yes' if n.get('alive') else 'no':<7}"
+              f"{cpu:>10}{tpu:>8}"
+              f"{n.get('load', 0):>6}  "
+              f"{n.get('drain_reason') or ''}")
+    # the autoscaler monitor's last decision (internal KV record)
+    try:
+        from ray_tpu.core.gcs import AUTOSCALER_DECISION_KV_KEY
+        raw = w.gcs_call("kv_get",
+                         {"key": AUTOSCALER_DECISION_KV_KEY})
+    except Exception:  # noqa: BLE001 — pre-autoscaler GCS
+        raw = None
+    if raw:
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        try:
+            d = json.loads(raw)
+        except ValueError:
+            d = None
+        if d:
+            line = (f"autoscaler: {d.get('action', '?')}"
+                    + (" (urgent)" if d.get("urgent") else ""))
+            if d.get("reason"):
+                line += f"  [{d['reason']}]"
+            launched = d.get("launched") or {}
+            if launched:
+                line += "  launched " + ", ".join(
+                    f"{v}x{k}" for k, v in sorted(launched.items()))
+            if d.get("terminated"):
+                line += f"  terminated {len(d['terminated'])}"
+            if d.get("ts") is not None:
+                line += f"  workers={d.get('num_workers', '?')}"
+            print(line)
+    else:
+        print("autoscaler: no decision recorded "
+              "(monitor not running)")
 
 
 def cmd_events(args) -> None:
@@ -944,6 +1030,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_alerts)
+
+    sp = sub.add_parser(
+        "nodes", help="node lifecycle states (ACTIVE/DRAINING/DRAINED)"
+                      " + last autoscaler decision")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_nodes)
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("resource", choices=[

@@ -27,6 +27,7 @@ don't thrash replica churn.
 
 from __future__ import annotations
 
+import json
 import logging
 import random
 import threading
@@ -386,6 +387,8 @@ class ServeController:
         self._replica_metrics: Dict[bytes, Dict[str, Any]] = {}
         # name -> autoscaler hysteresis state
         self._scale_state: Dict[str, Dict[str, Any]] = {}
+        # last published shaped-capacity request (JSON key, change-gated)
+        self._last_capacity_request: Optional[str] = None
         self._thread = threading.Thread(target=self._control_loop, daemon=True)
         self._thread.start()
 
@@ -678,11 +681,13 @@ class ServeController:
 
     def _reconcile_once(self) -> bool:
         changed = False
+        capacity_bundles: List[Dict[str, float]] = []
         with self._lock:
             items = list(self._deployments.items())
         for name, dep in items:
             config: DeploymentConfig = dep["config"]
             target = self._autoscaled_target(name, dep, config)
+            capacity_bundles.extend(self._replica_bundles(config, target))
             replicas: List[Any] = dep["replicas"]
             versions: List[int] = dep["replica_versions"]
             # dead replicas leave the set immediately (their requests
@@ -728,7 +733,48 @@ class ServeController:
                 versions.pop()
                 self._drain(old, config)
                 changed = True
+        self._update_capacity_request(capacity_bundles)
         return changed
+
+    @staticmethod
+    def _replica_bundles(config: DeploymentConfig,
+                         target: int) -> List[Dict[str, float]]:
+        """Chip-shaped capacity for one deployment at its current
+        target: one bundle PER GANG MEMBER (``target x num_shards``),
+        each the per-shard resource shape — the autoscaler must be
+        asked for shards-worth of chips, not replica counts, or a
+        TPU-gang scale-up would be satisfied by chip-less CPU nodes."""
+        opts = config.ray_actor_options or {}
+        shape: Dict[str, float] = {
+            str(k): float(v)
+            for k, v in (opts.get("resources") or {}).items() if v}
+        shape["CPU"] = float(opts.get("num_cpus") or 1)
+        if opts.get("num_tpus"):
+            shape["TPU"] = float(opts["num_tpus"])
+        elif opts.get("num_gpus"):  # TPU-first alias (remote_function.py)
+            shape["TPU"] = float(opts["num_gpus"])
+        num_shards = max(1, int(getattr(config, "num_shards", 1)))
+        return [dict(shape) for _ in range(max(0, target) * num_shards)]
+
+    def _update_capacity_request(self,
+                                 bundles: List[Dict[str, float]]) -> None:
+        """Publish the standing shaped-capacity request
+        (``autoscaler.sdk.request_resources``) when it changed: the
+        node autoscaler then scales the fleet so every gang member's
+        chips would fit BEFORE replica creation needs them, and holds
+        that floor while the deployment exists (cleared when the last
+        deployment is deleted).  Writes only on change — the KV put is
+        WAL-backed and this runs every reconcile tick."""
+        key = json.dumps(sorted(bundles, key=json.dumps), sort_keys=True)
+        if key == self._last_capacity_request:
+            return
+        try:
+            from ray_tpu.autoscaler.sdk import request_resources
+            request_resources(bundles=bundles)
+            self._last_capacity_request = key
+        except Exception:  # noqa: BLE001 — capacity hints must never
+            logger.exception("capacity request update failed")  # kill
+            # the control loop; retried next tick (key not cached)
 
     def _known_dead(self, replica: Any) -> bool:
         """True when the last metrics poll found the replica's actor

@@ -84,7 +84,8 @@ class ProjectConfig:
     persist_service_file: str = "ray_tpu/core/gcs.py"
     persist_tables: Tuple[str, ...] = (
         "kv", "jobs", "job_counter", "functions", "actors",
-        "named_actors", "placement_groups", "nodes")
+        "named_actors", "placement_groups", "nodes",
+        "quotas", "lease_tables", "_node_states")
     persist_calls: Tuple[str, ...] = (
         "_schedule_persist", "_persist_now", "_wal_append", "_wal_flush",
         "_wal_actor", "_wal_pg", "_wal_job")

@@ -46,6 +46,14 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_sched_lease_cache_total",
     "ray_tpu_gcs_heartbeat_misses_total",
     "ray_tpu_gcs_node_deaths_total",
+    # autoscaler / drain plane: decision counters need a running
+    # AutoscalerMonitor, drain transitions need a drain_node call, and
+    # the throttle gauge needs a quota actually deferring leases
+    "ray_tpu_gcs_node_drain_transitions_total",
+    "ray_tpu_sched_quota_throttled_total",
+    "ray_tpu_autoscaler_decisions_total",
+    "ray_tpu_autoscaler_launch_failures_total",
+    "ray_tpu_autoscaler_target_nodes",
     # HA persistence plane: failure counters need failures, replay /
     # recovery series need a head restart, and the WAL series are
     # absent entirely on ephemeral (memory-storage) clusters

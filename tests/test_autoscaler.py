@@ -275,7 +275,7 @@ def test_request_resources_scales_up_and_holds():
             "standing request did not launch a node"
 
         # idle_timeout is 1s, but the standing request pins the node
-        time.sleep(3.0)
+        time.sleep(2.0)
         assert provider.non_terminated_nodes({}), \
             "standing request did not hold the node"
 

@@ -1,0 +1,177 @@
+"""The closed-loop chaos scenario (ISSUE 16 headline, in ``make
+chaos``): mixed serve + train load while the AutoscalerMonitor runs
+against real local raylets (FakeMultiNodeProvider), with failpoints
+firing.  Nodes join (signal-driven scale-up, first launch FAILS via
+``autoscaler.provider.launch_fail`` and must retry through backoff)
+and leave (drain-gated scale-down).  Pass criteria: zero failed client
+requests across the churn, zero lost objects across the drain, the
+serve SLO alert never fires, and the greedy quota'd tenant is
+measurably throttled while everything still completes."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.core.worker as core_worker
+from ray_tpu._test_utils import wait_for_condition
+from ray_tpu.autoscaler import (FakeMultiNodeProvider, NodeTypeConfig,
+                                StandardAutoscaler)
+from ray_tpu.autoscaler.monitor import AutoscalerMonitor
+from ray_tpu.autoscaler.policy import PolicyConfig, ScalingPolicy
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import failpoint as fp
+
+SEED = 1234
+MB = 1024 * 1024
+
+
+@pytest.mark.slow
+@pytest.mark.failpoints
+def test_closed_loop_scale_drain_quota_chaos():
+    from ray_tpu import serve
+
+    c = Cluster(initialize_head=True,
+                head_node_args={"num_cpus": 2,
+                                "resources": {"train": 4}},
+                _system_config={
+                    "object_store_memory": 96 * MB,
+                    "metrics_report_period_s": 0.25,
+                    "metrics_history_interval_s": 0.5,
+                    "health_report_period_s": 0.5,
+                })
+    monitor = None
+    try:
+        c.connect()
+        gw = core_worker.global_worker_or_none()
+        job = gw.job_id.hex()
+
+        # -- serve plane: one replica on the head, request stream ------
+        @serve.deployment
+        def echo(x):
+            time.sleep(0.005)  # comfortably inside the SLO
+            return x
+
+        handle = serve.run(echo.bind())
+        failures, successes = [], [0]
+        stop = threading.Event()
+
+        def client():
+            i = 0
+            while not stop.is_set():
+                try:
+                    if ray_tpu.get(handle.remote(i), timeout=60) != i:
+                        failures.append(("wrong_answer", i))
+                    successes[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    failures.append((repr(e), i))
+                i += 1
+                time.sleep(0.02)
+
+        client_thread = threading.Thread(target=client, daemon=True)
+        client_thread.start()
+
+        # -- greedy tenant: quota'd to 1 in-flight train slot ----------
+        assert gw.gcs_call("set_job_quota", {
+            "job": job,
+            "quota": {"weight": 1.0, "limits": {"train": 1},
+                      "mode": "queue"},
+        }) is True
+        time.sleep(1.2)  # one beat: raylets install the quota
+
+        # -- the closed loop, with a failing first launch --------------
+        provider = FakeMultiNodeProvider(
+            c, {"worker": {"resources": {"CPU": 2, "pin": 1}}})
+        asc = StandardAutoscaler(
+            provider,
+            {"worker": NodeTypeConfig(resources={"CPU": 2, "pin": 1},
+                                      max_workers=2)},
+            max_workers=2, idle_timeout_s=2.0)
+        policy = ScalingPolicy(PolicyConfig(up_for_s=1.0, down_for_s=4.0))
+        fp.arm("autoscaler.provider.launch_fail", "raise", count=1,
+               seed=SEED)
+        monitor = AutoscalerMonitor(asc, policy=policy,
+                                    update_interval_s=0.5,
+                                    launch_backoff_s=0.5)
+        monitor.start()
+
+        # -- load burst: quota'd train tasks + CPU pressure ------------
+        @ray_tpu.remote(resources={"train": 1}, num_cpus=0)
+        def train_step(i):
+            time.sleep(0.2)
+            return i
+
+        @ray_tpu.remote(num_cpus=1)
+        def cpu_task(i):
+            time.sleep(0.3)
+            return i
+
+        train_refs = [train_step.remote(i) for i in range(10)]
+        cpu_refs = [cpu_task.remote(i) for i in range(10)]
+
+        # sustained pending-lease pressure -> scale_up; the FIRST
+        # launch fails (failpoint) and the retry lands a real raylet
+        wait_for_condition(
+            lambda: provider.non_terminated_nodes({}), timeout=120)
+        assert monitor.launch_failures >= 1
+        assert fp.fire_count("autoscaler.provider.launch_fail") == 1
+        c.wait_for_nodes()
+
+        # park an object on the autoscaled node: the later scale-down
+        # drain must migrate it out before releasing the node
+        @ray_tpu.remote(resources={"pin": 1}, num_cpus=0)
+        def park():
+            return np.full(1_000_000, 7.25)  # 8MB, plasma-sized
+
+        parked = park.remote()
+        assert ray_tpu.get(parked, timeout=120)[0] == 7.25
+
+        # the greedy tenant completes (throttled, never starved)
+        assert ray_tpu.get(train_refs, timeout=180) == list(range(10))
+        assert ray_tpu.get(cpu_refs, timeout=180) == list(range(10))
+
+        def throttled():
+            recs = gw.gcs_call("get_metrics", {})
+            return any(
+                r["name"] == "ray_tpu_sched_quota_throttled_total"
+                and r.get("tags", {}).get("job") == job
+                and r.get("value", 0) > 0 for r in recs)
+        wait_for_condition(throttled, timeout=60)
+
+        # -- churn down: quiet signals -> drain -> terminate -----------
+        wait_for_condition(
+            lambda: not provider.non_terminated_nodes({}), timeout=180)
+        assert monitor.drains_completed >= 1
+
+        # zero lost objects: the parked bytes survived the drain +
+        # node release, byte-identical
+        arr = ray_tpu.get(parked, timeout=120)
+        assert arr.shape == (1_000_000,) and np.all(arr == 7.25)
+
+        # -- verdicts --------------------------------------------------
+        stop.set()
+        client_thread.join(timeout=30)
+        assert not failures, failures[:5]
+        assert successes[0] > 50, successes[0]
+
+        # the serve SLO alert NEVER fired: capacity always landed first
+        alerts = gw.gcs_call("get_alerts", {})
+        burn = [a for a in alerts["firing"] + alerts["resolved"]
+                if a["rule"] == "ServeSLOBurnRate"]
+        assert burn == [], burn
+
+        decisions = gw.gcs_call("debug_state", {})
+        assert decisions  # GCS alive through the whole scenario
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        fp.disarm_all()
+        try:
+            from ray_tpu import serve as _s
+            _s.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+        c.shutdown()

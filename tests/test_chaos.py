@@ -7,6 +7,9 @@ import time
 import numpy as np
 import pytest  # noqa: F401 — chaos_cluster fixture from conftest
 
+# whole-file slow: node-kill campaigns run minutes; `make chaos` opts back in
+pytestmark = pytest.mark.slow
+
 import ray_tpu
 from ray_tpu._test_utils import NodeKiller, wait_for_condition
 from ray_tpu.cluster_utils import Cluster

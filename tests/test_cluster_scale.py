@@ -50,6 +50,7 @@ def test_tasks_spread_across_eight_nodes(eight_node_cluster):
     assert len(set(results)) >= 4, set(results)
 
 
+@pytest.mark.slow  # heaviest case in this file; tier-1 budget
 def test_many_actors_eight_nodes(eight_node_cluster):
     c, _ = eight_node_cluster
 
@@ -66,6 +67,7 @@ def test_many_actors_eight_nodes(eight_node_cluster):
         ray_tpu.kill(a)
 
 
+@pytest.mark.slow  # heaviest case in this file; tier-1 budget
 def test_chaos_node_kills_at_scale(eight_node_cluster):
     """SIGKILL two side nodes while a retriable task wave runs; every
     task still completes via retry on surviving nodes."""

@@ -7,6 +7,9 @@ import sys
 
 import pytest
 
+# whole-file slow: end-to-end example walkthroughs
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

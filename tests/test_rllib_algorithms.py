@@ -4,6 +4,9 @@ rllib/algorithms/{dqn,impala,appo}/tests/, utils/replay_buffers/tests)."""
 import numpy as np
 import pytest
 
+# whole-file slow: full algorithm training runs
+pytestmark = pytest.mark.slow
+
 import ray_tpu
 from ray_tpu.rllib import CartPole, Pendulum, RandomEnv, SampleBatch
 from ray_tpu.rllib.algorithms.dqn import DQNConfig

@@ -7,6 +7,9 @@ import threading
 import numpy as np
 import pytest
 
+# whole-file slow: client-server + LSTM training loops dominate tier-1
+pytestmark = pytest.mark.slow
+
 import ray_tpu
 from ray_tpu.rllib import CartPole
 from ray_tpu.rllib.algorithms import PGConfig, PPOConfig

@@ -7,7 +7,9 @@ import pytest
 
 import ray_tpu
 
-pytestmark = pytest.mark.usefixtures("ray_start_regular")
+pytestmark = [pytest.mark.usefixtures("ray_start_regular"),
+              # whole-file slow: meta-RL training loops
+              pytest.mark.slow]
 
 
 def test_maml_adapts_across_tasks():

@@ -5,6 +5,9 @@ reference rllib/algorithms/*/tests/, rllib/offline/estimators/tests)."""
 import numpy as np
 import pytest
 
+# whole-file slow: per-algorithm learning runs
+pytestmark = pytest.mark.slow
+
 import ray_tpu
 from ray_tpu.rllib import CartPole, Pendulum, SampleBatch
 from ray_tpu.rllib.algorithms import (A2CConfig, A3CConfig, ARSConfig,

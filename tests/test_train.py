@@ -121,6 +121,7 @@ def test_jax_training_loop_on_workers():
     assert result.metrics["final_loss"] < 0.1
 
 
+@pytest.mark.slow  # heaviest case in this file; tier-1 budget
 def test_torch_trainer_ddp_gloo():
     """TorchTrainer: gloo process group across gang actors; allreduce
     averages gradients like DDP (parity model: reference
@@ -150,6 +151,7 @@ def test_torch_trainer_ddp_gloo():
     assert result.metrics["avg0"] == expected
 
 
+@pytest.mark.slow  # heaviest case in this file; tier-1 budget
 def test_rl_trainer_bridge():
     """RLTrainer runs an RLlib algorithm under the Train fit contract
     (parity model: reference train/rl tests)."""

@@ -16,6 +16,7 @@ def _cluster():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow  # heaviest case in this file; tier-1 budget
 def test_huggingface_trainer_finetunes_tiny_model(tmp_path):
     import datasets as hf_datasets
 

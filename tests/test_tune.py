@@ -177,6 +177,7 @@ def test_tpe_search_converges_better_than_random():
     assert sum(history[-10:]) < sum(history[:10])
 
 
+@pytest.mark.slow  # heaviest case in this file; tier-1 budget
 def test_tpe_with_tuner():
     from ray_tpu.tune.search import TPESearch
 
@@ -202,6 +203,7 @@ def test_gated_searchers_raise_with_guidance():
         tune.HyperOptSearch()
 
 
+@pytest.mark.slow  # heaviest case in this file; tier-1 budget
 def test_bohb_converges_and_uses_rung_observations():
     """BOHB: HyperBandForBOHB feeds rung results to the searcher, whose
     model-based suggestions find the optimum faster than chance (parity
