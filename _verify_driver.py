@@ -1,5 +1,6 @@
-"""End-to-end verify driver: core surface + the PR-16 quota/autoscaler
-planes, user-style over a real cluster."""
+"""End-to-end verify driver: core surface + the PR-17 serving-economics
+planes (prefix cache, multiplexing, slot steering), user-style over a
+real cluster."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -15,10 +16,7 @@ import urllib.request  # noqa: E402
 
 faulthandler.dump_traceback_later(180)
 
-import numpy as np  # noqa: E402
-
 import ray_tpu  # noqa: E402
-import ray_tpu.core.worker as core_worker  # noqa: E402
 
 t0 = time.time()
 ray_tpu.init(num_cpus=4)
@@ -64,64 +62,6 @@ for a in actors:
     assert ray_tpu.get([a.inc.remote() for _ in range(3)]) == [1, 2, 3]
 print(f"8 actors x3 ordered calls {time.time()-t0:.2f}s")
 
-# --- PR 16: per-job quota throttling over the real lease plane --------
-gw = core_worker.global_worker_or_none()
-job = gw.job_id.hex()
-assert gw.gcs_call("set_job_quota", {
-    "job": job,
-    "quota": {"weight": 1.0, "limits": {"CPU": 1}, "mode": "queue"},
-}) is True
-time.sleep(0.6)
-
-
-@ray_tpu.remote(num_cpus=1)
-def slot(i):
-    time.sleep(0.1)
-    return i
-
-
-t0 = time.time()
-assert ray_tpu.get([slot.remote(i) for i in range(6)]) == list(range(6))
-dur = time.time() - t0
-assert dur > 0.55, f"quota did not serialize: {dur:.2f}s"  # 6x0.1 serial
-throttled = []
-deadline = time.time() + 30  # default metrics report period is slow
-while time.time() < deadline and not throttled:
-    recs = gw.gcs_call("get_metrics", {})
-    throttled = [r for r in recs
-                 if r["name"] == "ray_tpu_sched_quota_throttled_total"
-                 and r.get("tags", {}).get("job") == job
-                 and r.get("value", 0) > 0]
-    time.sleep(0.5)
-assert throttled, "throttle gauge never reported"
-print(f"quota serialized 6 tasks in {dur:.2f}s, "
-      f"throttled={throttled[0]['value']}")
-assert gw.gcs_call("set_job_quota", {"job": job, "quota": None}) is True
-t0 = time.time()
-assert ray_tpu.get([slot.remote(i) for i in range(8)]) == list(range(8))
-par = time.time() - t0
-assert par < 0.55, f"quota removal did not restore overlap: {par:.2f}s"
-print(f"quota removed, 8 tasks in {par:.2f}s (parallel again)")
-
-# --- PR 16: autoscaler monitor persists its decision in the KV plane --
-from ray_tpu.autoscaler import (MockProvider, NodeTypeConfig,  # noqa: E402
-                                StandardAutoscaler)
-from ray_tpu.autoscaler.monitor import AutoscalerMonitor  # noqa: E402
-from ray_tpu.core.gcs import AUTOSCALER_DECISION_KV_KEY  # noqa: E402
-from ray_tpu.autoscaler.policy import PolicyConfig, ScalingPolicy  # noqa: E402
-
-mon = AutoscalerMonitor(
-    StandardAutoscaler(MockProvider(),
-                       {"cpu4": NodeTypeConfig(resources={"CPU": 4},
-                                               max_workers=2)},
-                       max_workers=2),
-    policy=ScalingPolicy(PolicyConfig(up_for_s=0.0)),
-    update_interval_s=0.2)
-mon.run_once()
-decision = gw.gcs_call("kv_get", {"key": AUTOSCALER_DECISION_KV_KEY})
-assert decision, decision
-print("autoscaler decision persisted:", str(decision)[:72], "...")
-
 # data pipeline with all-to-all shuffle
 import ray_tpu.data as rdata  # noqa: E402
 
@@ -130,47 +70,84 @@ vals = sorted(r["id"] for r in ds.take_all())
 assert vals == list(range(200))
 print("data shuffle ok")
 
-# tune with a scheduler
-from ray_tpu import tune  # noqa: E402
-
-
-def trainable(config):
-    for i in range(3):
-        tune.report({"score": config["lr"] * (i + 1)})
-
-
-analysis = tune.run(trainable,
-                    config={"lr": tune.grid_search([0.1, 0.2, 0.4])},
-                    scheduler=tune.schedulers.AsyncHyperBandScheduler(
-                        metric="score", mode="max"),
-                    verbose=0)
-best = analysis.get_best_result(metric="score", mode="max")
-assert best.config["lr"] == 0.4, best.config
-print("tune ok, best lr", best.config["lr"])
-
-# serve + real HTTP proxy
+# --- PR 17: prefix-cache deployment over real HTTP --------------------
 from ray_tpu import serve  # noqa: E402
+from ray_tpu.serve._internal import CONTROLLER_NAME  # noqa: E402
 from ray_tpu.serve.http_proxy import start_proxy  # noqa: E402
+from ray_tpu.serve.toy_decoder import ToyDecoder, make_prompt  # noqa: E402
 
-
-@serve.deployment
-def classify(x):
-    return {"label": int(np.asarray(x["value"]).sum() % 3)}
-
-
-handle = serve.run(classify.bind())
-assert ray_tpu.get(handle.remote({"value": [1, 2, 3]}),
-                   timeout=30)["label"] == 0
+pfx = serve.deployment(
+    name="pfx", max_concurrent_queries=16,
+    batching={"max_batch_size": 8, "max_seq_len": 64,
+              "kv_page_tokens": 8, "kv_max_pages": 64,
+              "prefix_cache_pages": 16})(ToyDecoder)
+serve.run(pfx.bind())
 host, port = start_proxy()
-url = f"http://{host}:{port}/classify"
-req = urllib.request.Request(
-    url, data=json.dumps({"value": [1, 2, 4]}).encode(),
-    headers={"Content-Type": "application/json"})
-with urllib.request.urlopen(req, timeout=30) as resp:
-    body = json.loads(resp.read())
-assert body["result"]["label"] == 1, body
-print("serve + http ok:", body)
 
+
+def http_call(name, payload):
+    req = urllib.request.Request(
+        f"http://{host}:{port}/{name}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())["result"]
+
+
+prefix = make_prompt(3, 16)
+ref = ToyDecoder()
+lat = []
+for i in range(8):
+    p = {"prompt": prefix + make_prompt(50 + i, 4), "max_new_tokens": 6}
+    t0 = time.time()
+    out = http_call("pfx", p)
+    lat.append(time.time() - t0)
+    assert out["tokens"] == ref.generate_unbatched(dict(p))["tokens"], i
+controller = ray_tpu.get_actor(CONTROLLER_NAME)
+table = ray_tpu.get(controller.get_routing_table.remote(-1, 1.0),
+                    timeout=30)
+rm = ray_tpu.get(
+    table["table"]["pfx"]["replicas"][0].metrics.remote(), timeout=30)
+hits = rm["kv_prefix_hits_total"] + rm["kv_prefix_partial_total"]
+print(f"prefix over HTTP: first {lat[0]*1e3:.0f}ms last {lat[-1]*1e3:.0f}ms"
+      f" hits+partial={hits} cached={rm['kv_prefix_pages_cached']}")
+assert hits >= 7, "prefix cache did not engage over the serve path"
+assert rm["kv_prefix_pages_cached"] >= 2
+assert rm["kv_pages_allocated_total"] == (
+    rm["kv_pages_freed_total"] + rm["kv_pages_handed_off_total"]
+    + rm["kv_prefix_pages_cached"]), "KV ledger leak"
+# slot surface is live in the routing table (cross-gang steering signal)
+slots = table["table"]["pfx"].get("replica_slots")
+assert slots and slots[0] is not None and int(slots[0]) >= 1, slots
+print("replica_slots in routing table:", slots)
+
+# --- PR 17: model multiplexing via handle AND HTTP model routing ------
+mux = serve.deployment(
+    name="mux", max_concurrent_queries=16,
+    batching={"max_batch_size": 8, "max_seq_len": 64,
+              "kv_page_tokens": 8, "kv_max_pages": 64},
+    multiplexed_models={f"m{i}": {"seed": i} for i in range(3)},
+    multiplex_max_resident=2)(ToyDecoder)
+mh = serve.run(mux.bind())
+for i in range(3):
+    p = {"prompt": list(make_prompt(i, 6)), "max_new_tokens": 6,
+         "model": f"m{i}"}
+    expect = ToyDecoder(seed=i).generate_unbatched(
+        {"prompt": list(make_prompt(i, 6)), "max_new_tokens": 6})
+    assert mh.call(dict(p), timeout=60)["tokens"] == expect["tokens"], i
+    assert http_call("mux", p)["tokens"] == expect["tokens"], i
+table = ray_tpu.get(controller.get_routing_table.remote(-1, 1.0),
+                    timeout=30)
+mm = ray_tpu.get(
+    table["table"]["mux"]["replicas"][0].metrics.remote(), timeout=30)
+print(f"mux: models={mm['mux_models_total']} swaps={mm['mux_swaps_total']}"
+      f" resident={mm['mux_resident_models']}")
+assert mm["mux_models_total"] == 3
+assert mm["mux_swaps_total"] >= 3
+assert len(mm["mux_resident_models"]) <= 2
+
+serve.delete("pfx")
+serve.delete("mux")
 t0 = time.time()
 ray_tpu.shutdown()
 dt = time.time() - t0

@@ -762,6 +762,68 @@ def serve_gang_death(deployment: str) -> None:
              ("deployment",)).inc_key(_dkey(deployment))
 
 
+# -- serving economics (prefix cache / multiplexing / cross-gang) -----------
+
+_PREFIX_KEYS: Dict[Tuple[str, str], Tuple] = {}
+
+#: swap = engine build + weight restore by arena ref; sub-ms for toys,
+#: seconds for real checkpoints — bounds span both
+_SWAP_BOUNDS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0]
+
+
+def serve_prefix_cache(deployment: str, result: str) -> None:
+    """One prefix-cache lookup at request admission (``result``:
+    hit|partial|miss).  The hit ratio is the headline serving-economics
+    number — every hit token is prefill compute NOT spent."""
+    if not enabled():
+        return
+    key = _PREFIX_KEYS.get((deployment, result))
+    if key is None:
+        key = _PREFIX_KEYS[(deployment, result)] = (
+            ("deployment", deployment), ("result", result))
+    _counter("ray_tpu_serve_prefix_cache_total",
+             "KV prefix-cache lookups by outcome (hit|partial|miss)",
+             ("deployment", "result")).inc_key(key)
+
+
+def serve_prefix_pages_shared(deployment: str, n: int) -> None:
+    """Sealed KV pages currently held by the prefix cache across the
+    deployment's replicas (each possibly adopted by many requests —
+    the sharing that converts HBM into throughput)."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_serve_prefix_pages_shared",
+           "KV pages resident in the prefix cache, per deployment",
+           ("deployment",)).set_key(_dkey(deployment), float(n))
+
+
+def serve_mux_swap(deployment: str, seconds: float) -> None:
+    """One model weight swap on a multiplexed replica (cache miss in
+    the resident set).  The histogram prices misses; the router's
+    model-resident steering keeps the rate low in steady state."""
+    if not enabled():
+        return
+    _counter("ray_tpu_serve_mux_swaps_total",
+             "model weight swaps on multiplexed replicas",
+             ("deployment",)).inc_key(_dkey(deployment))
+    _hist("ray_tpu_serve_mux_swap_seconds",
+          "latency of one multiplexed model swap (build + load by ref)",
+          _SWAP_BOUNDS, ("deployment",)).observe_key(
+        _dkey(deployment), seconds)
+
+
+def serve_xgang_steered(deployment: str) -> None:
+    """One request steered by next-step-boundary slot availability —
+    the router narrowed its candidate set to replicas with a free batch
+    slot (cross-gang continuous batching in effect)."""
+    if not enabled():
+        return
+    _counter("ray_tpu_serve_xgang_steered_total",
+             "requests steered to a gang with a free batch slot",
+             ("deployment",)).inc_key(_dkey(deployment))
+
+
 def gcs_respawn() -> None:
     """The head supervisor respawned a died GCS/head process."""
     if not enabled():

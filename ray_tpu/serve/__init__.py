@@ -192,13 +192,19 @@ class DeploymentHandle:
         from ray_tpu.core.config import get_config
         from ray_tpu.core.exceptions import (ActorDiedError,
                                              WorkerCrashedError)
-        from ray_tpu.serve.batching import RequestPrefillLost
+        from ray_tpu.serve.batching import (ModelSwapFailed,
+                                            RequestPrefillLost)
 
         attempts = max(1, int(getattr(get_config(),
                                       "serve_request_retries", 3)))
         router = _get_router()
         prefill_name = router.prefill_for(self._name) \
             if self._method in ("", "__call__") else None
+        # multiplexed deployments: steer toward a replica where the
+        # request's model is already resident (no weight swap)
+        model: Optional[str] = None
+        if args and isinstance(args[0], dict) and args[0].get("model"):
+            model = str(args[0]["model"])
         exclude: List[bytes] = []
         pre_exclude: List[bytes] = []
         last_err: Optional[BaseException] = None
@@ -214,7 +220,8 @@ class DeploymentHandle:
                 _slot_waiter.add(router, pre_key, pre_ref)
                 method, call_args = "__decode__", (pre_ref,)
             replica, key = router.assign(self._name,
-                                         exclude=tuple(exclude))
+                                         exclude=tuple(exclude),
+                                         model=model)
             ref = replica.handle_request.remote(
                 method, call_args, {} if pre_ref is not None else kwargs,
                 deadline_s=_deadline_s)
@@ -228,6 +235,12 @@ class DeploymentHandle:
                 # the controller reaps it)
                 last_err = e
                 pre_exclude.append(pre_key[1])
+            except ModelSwapFailed as e:
+                # the replica couldn't make the model resident: exclude
+                # the pick and retry elsewhere WITHOUT marking it dead
+                # (its already-resident models keep serving)
+                last_err = e
+                exclude.append(key[1])
             except (ActorDiedError, WorkerCrashedError) as e:
                 # the decode pick died mid-request; exclude it so the
                 # retry lands on a survivor
@@ -267,6 +280,8 @@ class Deployment:
                 max_queued_requests: Optional[int] = None,
                 num_shards: Optional[int] = None,
                 prefill_replicas: Optional[int] = None,
+                multiplexed_models: Optional[Dict[str, Any]] = None,
+                multiplex_max_resident: Optional[int] = None,
                 **_ignored) -> "Deployment":
         cfg = DeploymentConfig(
             num_replicas=num_replicas if num_replicas is not None
@@ -292,6 +307,12 @@ class Deployment:
             prefill_replicas=prefill_replicas
             if prefill_replicas is not None
             else self.config.prefill_replicas,
+            multiplexed_models=multiplexed_models
+            if multiplexed_models is not None
+            else self.config.multiplexed_models,
+            multiplex_max_resident=multiplex_max_resident
+            if multiplex_max_resident is not None
+            else self.config.multiplex_max_resident,
         )
         return Deployment(self._target, name or self.name, cfg)
 
@@ -336,6 +357,8 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
                max_queued_requests: int = -1,
                num_shards: int = 1,
                prefill_replicas: int = 0,
+               multiplexed_models: Optional[Dict[str, Any]] = None,
+               multiplex_max_resident: int = 0,
                **_ignored):
     """``@serve.deployment`` decorator (parity: serve/api.py).
 
@@ -351,6 +374,13 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
     see docs/serving.md).  ``prefill_replicas > 0`` disaggregates the
     prompt pass onto a dedicated prefill tier that streams finished KV
     pages to the decode replicas as object refs.
+
+    ``multiplexed_models`` hosts N models per replica: a dict of
+    model-id -> init-kwarg overrides for the engine factory (first key
+    is the default model).  Requests pick a model with a ``"model"``
+    field in their payload; weights swap by arena ref with an
+    LRU-bounded resident set (``multiplex_max_resident``, 0 =
+    unbounded).  Requires ``batching``; see docs/serving.md.
     """
 
     def wrap(target):
@@ -364,6 +394,8 @@ def deployment(func_or_class: Any = None, *, name: Optional[str] = None,
             max_queued_requests=max_queued_requests,
             num_shards=num_shards,
             prefill_replicas=prefill_replicas,
+            multiplexed_models=multiplexed_models,
+            multiplex_max_resident=multiplex_max_resident,
         )
         return Deployment(target, name or target.__name__, cfg)
 

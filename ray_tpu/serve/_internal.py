@@ -89,6 +89,16 @@ class DeploymentConfig:
     #: internal role marker ("" = decode/unified, "prefill" = the
     #: prompt-pass tier of a disaggregated deployment)
     role: str = ""
+    #: model multiplexing (serve/multiplex.py): map of model id ->
+    #: engine init-kwarg overrides; each replica hosts ALL listed
+    #: models behind one batcher, swapping weights by arena ref on
+    #: demand.  The first model is the default; requests pick theirs
+    #: with a ``"model"`` payload field.  None = off.
+    multiplexed_models: Optional[Dict[str, Any]] = None
+    #: LRU bound on models resident per replica (0 = all resident);
+    #: an evicted model's weights stay sealed in the arena and reload
+    #: by ref on the next request.
+    multiplex_max_resident: int = 0
 
 
 @ray_tpu.remote
@@ -100,13 +110,22 @@ class ServeReplica:
                  deployment_name: str = "",
                  batching: Optional[Dict[str, Any]] = None,
                  num_shards: int = 1,
-                 prefill_cfg: Optional[Dict[str, Any]] = None):
+                 prefill_cfg: Optional[Dict[str, Any]] = None,
+                 multiplexed: Optional[Dict[str, Any]] = None,
+                 multiplex_max_resident: int = 0):
         if num_shards > 1:
             # rank 0 of a gang: the engine wrapper fans each decode
             # step out over the shard workers the controller attaches
             from ray_tpu.serve.sharded import ShardedEngine
             self._callable = ShardedEngine(
                 pickled_callable, init_args, init_kwargs, num_shards,
+                deployment_name)
+        elif multiplexed:
+            # N models behind one batcher, swapped by arena ref
+            from ray_tpu.serve.multiplex import MultiplexEngine
+            self._callable = MultiplexEngine(
+                cloudpickle.loads(pickled_callable), init_args,
+                init_kwargs, multiplexed, multiplex_max_resident,
                 deployment_name)
         else:
             target = cloudpickle.loads(pickled_callable)
@@ -327,11 +346,18 @@ class ServeReplica:
             out["step_shapes"] = s["step_shapes"]
             out["step_p50_ms"] = s["step_p50_ms"]
             out["step_p99_ms"] = s["step_p99_ms"]
+            # step-boundary slot availability: the router's cross-gang
+            # continuous-batching signal (replica_slots in the table)
+            out["slots_free"] = s.get("slots_free", 0)
+            out["max_batch_size"] = s.get("max_batch_size", 0)
             # paged-KV accounting rides the same poll (controller
             # aggregates into the ray_tpu_serve_kv_* gauges)
             for k, v in s.items():
                 if k.startswith("kv_"):
                     out[k] = v
+        mux = getattr(self._callable, "mux_stats", None)
+        if mux is not None:
+            out.update(mux())
         if self._prefill_table is not None:
             for k, v in self._prefill_table.stats().items():
                 out[f"prefill_{k}"] = v
@@ -403,6 +429,19 @@ class ServeController:
         ``<name>--prefill`` deployment: same engine, no decode loop —
         its replicas run the prompt pass and export KV pages by ref.
         """
+        if getattr(config, "multiplexed_models", None):
+            if config.num_shards > 1:
+                raise ValueError(
+                    "multiplexed_models does not combine with gang "
+                    "replicas (num_shards > 1) yet")
+            if config.prefill_replicas > 0:
+                raise ValueError(
+                    "multiplexed_models does not combine with "
+                    "prefill/decode disaggregation yet")
+            if config.batching is None:
+                raise ValueError(
+                    "multiplexed_models requires a continuous-batching "
+                    "deployment (batching=...)")
         if config.prefill_replicas > 0:
             if config.batching is None:
                 raise ValueError(
@@ -496,6 +535,20 @@ class ServeController:
                     "replica_depths": [
                         self._depth_of(r.actor_id.binary())
                         for r in replicas],
+                    # step-boundary slot availability per replica (None
+                    # = not a batched replica / no report yet): the
+                    # router steers to gangs with a free slot at the
+                    # next boundary — cross-gang continuous batching
+                    "replica_slots": [
+                        self._slots_of(r.actor_id.binary())
+                        for r in replicas],
+                    # resident model set per replica (multiplexing):
+                    # the router prefers a replica where the request's
+                    # model is already swapped in
+                    "replica_models": [
+                        (self._replica_metrics.get(r.actor_id.binary())
+                         or {}).get("mux_resident_models")
+                        for r in replicas],
                     "max_concurrent_queries":
                         cfg.max_concurrent_queries if cfg else 100,
                     "max_queued_requests":
@@ -520,6 +573,12 @@ class ServeController:
         # ALSO a blocked handle_request thread (counted in inflight),
         # so summing would double-count the backlog
         return max(int(m.get("inflight", 0)), int(m.get("queue_depth", 0)))
+
+    def _slots_of(self, key: bytes) -> Optional[int]:
+        m = self._replica_metrics.get(key)
+        if not m or "slots_free" not in m:
+            return None
+        return int(m["slots_free"])
 
     def get_gang_members(self, rank0_actor_id: bytes) -> List[Any]:
         """Shard-worker handles of the gang fronted by ``rank0``
@@ -678,6 +737,12 @@ class ServeController:
                 _tm.serve_kv_occupancy(name, max(
                     [float(m.get("kv_occupancy", 0.0))
                      for m in metrics] or [0.0]))
+            # prefix-cache residency (pages the chain table holds for
+            # reuse across requests, summed over replicas)
+            if any("kv_prefix_pages_cached" in m for m in metrics):
+                _tm.serve_prefix_pages_shared(name, sum(
+                    int(m.get("kv_prefix_pages_cached", 0))
+                    for m in metrics))
 
     def _reconcile_once(self) -> bool:
         changed = False
@@ -975,7 +1040,11 @@ class ServeController:
                                deployment_name=name,
                                batching=getattr(config, "batching", None),
                                num_shards=num_shards,
-                               prefill_cfg=prefill_cfg)
+                               prefill_cfg=prefill_cfg,
+                               multiplexed=getattr(
+                                   config, "multiplexed_models", None),
+                               multiplex_max_resident=getattr(
+                                   config, "multiplex_max_resident", 0))
             return {"handle": handle, "members": members,
                     "t0": time.monotonic()}
         except Exception:  # noqa: BLE001
@@ -1182,11 +1251,20 @@ class Router:
             return list(entry.get("replicas") or [])
 
     def _try_assign(self, deployment: str,
-                    exclude: Tuple[bytes, ...] = ()):
+                    exclude: Tuple[bytes, ...] = (),
+                    model: Optional[str] = None):
         """One nonblocking pick; returns (replica, key), None when no
         assignable replica exists right now, or raises KeyError for a
-        deployment the table doesn't know."""
+        deployment the table doesn't know.
+
+        Steering order within the eligible set: replicas whose batch
+        has a FREE SLOT at the next step boundary first (cross-gang
+        continuous batching — the deployment's gangs act as one logical
+        batch surface), then replicas where the request's ``model`` is
+        already resident (multiplexing — avoid a weight swap), then
+        locality, then power-of-two-choices on estimated depth."""
         _fp.failpoint("serve.router.assign")
+        steered = False
         with self._lock:
             entry = self._table.get(deployment)
             if entry is None:
@@ -1197,6 +1275,8 @@ class Router:
             n = len(replicas)
             nodes = entry.get("replica_nodes") or [None] * n
             depths = entry.get("replica_depths") or [0] * n
+            slots = entry.get("replica_slots") or [None] * n
+            res_models = entry.get("replica_models") or [None] * n
             cap = entry["max_concurrent_queries"]
             skip = set(exclude) | self._dead
 
@@ -1211,12 +1291,31 @@ class Router:
                             0) < cap]
             if not eligible:
                 return None
-            # locality first: exhaust same-node replicas before
+            group = eligible
+            # cross-gang slot steering: the controller-reported free
+            # slots minus this router's own undispatched in-flight is
+            # the best local estimate of next-boundary availability
+            open_slots = [
+                i for i in group
+                if slots[i] is None
+                or int(slots[i]) - self._inflight.get(
+                    (deployment, replicas[i].actor_id.binary()), 0) > 0]
+            if open_slots and len(open_slots) < len(group):
+                group = open_slots
+                steered = True
+            # model-resident steering (multiplexed deployments): prefer
+            # a replica that serves the model without a swap
+            if model:
+                warm = [i for i in group
+                        if res_models[i] and model in res_models[i]]
+                if warm:
+                    group = warm
+            # locality next: exhaust same-node replicas before
             # crossing nodes (each group scored independently)
-            local = [i for i in eligible
+            local = [i for i in group
                      if self._local_node is not None
                      and nodes[i] == self._local_node]
-            group = local or eligible
+            group = local or group
             if len(group) == 1:
                 idx = group[0]
             else:
@@ -1235,10 +1334,14 @@ class Router:
             r = replicas[idx]
             key = (deployment, r.actor_id.binary())
             self._inflight[key] = self._inflight.get(key, 0) + 1
-            return (r, key)
+        if steered:
+            # metric export outside the lock (registry has its own)
+            _tm.serve_xgang_steered(deployment)
+        return (r, key)
 
     def assign(self, deployment: str, timeout_s: float = 30.0,
-               exclude: Tuple[bytes, ...] = ()):
+               exclude: Tuple[bytes, ...] = (),
+               model: Optional[str] = None):
         """Pick a replica (blocking).  Unknown deployments fail fast
         (one short grace for table propagation); known deployments with
         no assignable replica yet wait for one."""
@@ -1246,7 +1349,7 @@ class Router:
         grace = time.monotonic() + 1.0
         while time.monotonic() < deadline:
             try:
-                picked = self._try_assign(deployment, exclude)
+                picked = self._try_assign(deployment, exclude, model)
             except KeyError:
                 if time.monotonic() > grace:
                     raise KeyError(
@@ -1260,7 +1363,8 @@ class Router:
             f"no available replica for deployment {deployment!r}")
 
     async def assign_async(self, deployment: str, timeout_s: float = 30.0,
-                           exclude: Tuple[bytes, ...] = ()):
+                           exclude: Tuple[bytes, ...] = (),
+                           model: Optional[str] = None):
         """``assign`` for event-loop callers (the ingress proxy): same
         semantics, polling with ``asyncio.sleep`` so the loop keeps
         serving other connections while this one waits for capacity."""
@@ -1270,7 +1374,7 @@ class Router:
         grace = time.monotonic() + 1.0
         while time.monotonic() < deadline:
             try:
-                picked = self._try_assign(deployment, exclude)
+                picked = self._try_assign(deployment, exclude, model)
             except KeyError:
                 if time.monotonic() > grace:
                     raise KeyError(

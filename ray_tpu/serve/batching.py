@@ -60,9 +60,9 @@ from ray_tpu.core import telemetry as _tm
 from ray_tpu.core import tracing as _trace
 
 __all__ = [
-    "BatchingConfig", "ContinuousBatcher", "ReplicaOverloaded",
-    "RequestCancelled", "RequestDeadlineExceeded", "RequestPrefillLost",
-    "default_buckets",
+    "BatchingConfig", "ContinuousBatcher", "ModelSwapFailed",
+    "ReplicaOverloaded", "RequestCancelled", "RequestDeadlineExceeded",
+    "RequestPrefillLost", "default_buckets",
 ]
 
 
@@ -106,6 +106,24 @@ class RequestPrefillLost(Exception):
     NOT be marked dead."""
 
 
+class ModelSwapFailed(Exception):
+    """A multiplexed replica failed to page in the requested model's
+    weights (arena ref lost, build error, injected fault).  Retryable:
+    the router EXCLUDES this replica pick and tries another — the
+    replica itself is healthy (its resident models keep serving) and
+    must NOT be marked dead."""
+
+    def __init__(self, deployment: str = "", model: str = ""):
+        super().__init__(
+            f"model {model!r} swap failed on deployment {deployment!r}")
+        self.deployment = deployment
+        self.model = model
+
+    def __reduce__(self):
+        # structured fields survive the task-error pickle round trip
+        return (type(self), (self.deployment, self.model))
+
+
 def default_buckets(max_seq_len: int, cap: int = 8) -> Tuple[int, ...]:
     """Powers of two up to ``max_seq_len`` (inclusive, rounded up),
     keeping at most ``cap`` buckets — each bucket is one XLA compile, so
@@ -147,6 +165,12 @@ class BatchingConfig:
     #: its worst-case page demand exceeds the free budget (0 = the
     #: ``serve_kv_max_pages`` knob)
     kv_max_pages: int = 0
+    #: shared prompt-PREFIX page cache (kv_cache.py chain table): cap on
+    #: cached pages per replica, over and above ``kv_max_pages``.  0 =
+    #: off.  Requires ``kv_page_tokens > 0``; a request whose prompt
+    #: extends a cached chain adopts those pages and prefills only the
+    #: tail (``state["prefix_len"]`` tells the engine how much to skip).
+    prefix_cache_pages: int = 0
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         buckets = tuple(sorted(self.bucket_lens)) or default_buckets(
@@ -240,7 +264,11 @@ class ContinuousBatcher:
                 config.kv_max_pages
                 or int(_serve_knob("serve_kv_max_pages", 4096)),
                 deployment,
-                kv_payload=getattr(engine, "kv_page_payload", None))
+                kv_payload=getattr(engine, "kv_page_payload", None),
+                prefix_cache_pages=config.prefix_cache_pages)
+        #: multiplexing engine (serve/multiplex.py): step() takes a
+        #: per-slot model-id vector so one batch mixes models
+        self._mux = bool(getattr(engine, "multiplexed", False))
         #: requests admitted this pass, awaiting (possibly expensive)
         #: prefill + paging OUTSIDE the lock on the decode thread
         self._newly_admitted: List[Tuple[int, _Request]] = []
@@ -345,6 +373,13 @@ class ContinuousBatcher:
             sms = sorted(self._step_ms)
             return {
                 **kv,
+                # step-boundary slot availability: what the router's
+                # cross-gang steering keys on (queued requests will
+                # take free slots first, so they count against it)
+                "slots_free": max(
+                    0, self._cfg.max_batch_size - self._active
+                    - len(self._queue)),
+                "max_batch_size": self._cfg.max_batch_size,
                 "step_p50_ms": sms[len(sms) // 2] if sms else 0.0,
                 "step_p99_ms":
                     sms[min(len(sms) - 1, int(len(sms) * 0.99))]
@@ -517,11 +552,24 @@ class ContinuousBatcher:
                                    reserve_tokens=need)
             else:
                 prefill = getattr(self._engine, "prefill", None)
-                if prefill is not None:
-                    req.state = prefill(req.state) or req.state
-                if self._kv is not None:
-                    self._kv.begin(req.request_id, req.state["tokens"],
-                                   reserve_tokens=need)
+                if self._kv is not None and self._kv.prefix_enabled:
+                    # prefix path: page FIRST so the chain match tells
+                    # the engine how many prompt tokens it can skip
+                    # (adopted pages already hold their KV)
+                    matched = self._kv.begin(
+                        req.request_id, req.state["tokens"],
+                        reserve_tokens=need,
+                        model=str(req.state.get("model") or ""))
+                    req.state["prefix_len"] = int(matched)
+                    if prefill is not None:
+                        req.state = prefill(req.state) or req.state
+                else:
+                    if prefill is not None:
+                        req.state = prefill(req.state) or req.state
+                    if self._kv is not None:
+                        self._kv.begin(req.request_id,
+                                       req.state["tokens"],
+                                       reserve_tokens=need)
         except Exception as e:  # noqa: BLE001 — that request only
             with self._lock:
                 if self._slots[req.slot] is req:
@@ -570,11 +618,15 @@ class ContinuousBatcher:
                 tokens = np.full((B, bucket), pad, dtype=np.int32)
                 lengths = np.zeros((B,), dtype=np.int32)
                 active = np.zeros((B,), dtype=bool)
+                models: Optional[List[Any]] = [None] * B \
+                    if self._mux else None
                 for i, r in batch:
                     seq = r.state["tokens"]
                     tokens[i, :len(seq)] = seq
                     lengths[i] = len(seq)
                     active[i] = True
+                    if models is not None:
+                        models[i] = r.state.get("model")
                 occupancy = len(batch) / B
                 self._occupancy_sum += occupancy
             # metric export stays OUTSIDE the lock: the registry takes
@@ -582,7 +634,12 @@ class ContinuousBatcher:
             _tm.serve_batch_occupancy(self._deployment, occupancy)
             step_t0 = time.time()
             try:
-                next_tokens = self._engine.step(tokens, lengths, active)
+                if models is not None:
+                    next_tokens = self._engine.step(
+                        tokens, lengths, active, models)
+                else:
+                    next_tokens = self._engine.step(
+                        tokens, lengths, active)
             except Exception as e:  # noqa: BLE001 — a broken step fails
                 # the whole in-flight batch (callers see the error);
                 # queued requests stay queued for the next pass

@@ -268,12 +268,18 @@ class HTTPProxy:
         every return path has written the HTTP response."""
         from ray_tpu.core.exceptions import (ActorDiedError, TaskError,
                                              WorkerCrashedError)
-        from ray_tpu.serve.batching import (ReplicaOverloaded,
+        from ray_tpu.serve.batching import (ModelSwapFailed,
+                                            ReplicaOverloaded,
                                             RequestCancelled,
                                             RequestDeadlineExceeded,
                                             RequestPrefillLost)
 
         rid = f"http-{self._rid_prefix}-{next(self._rid)}"
+        # multiplexed deployments: the request's model id steers the
+        # router toward replicas where the model is already resident
+        model: Optional[str] = None
+        if args and isinstance(args[0], dict) and args[0].get("model"):
+            model = str(args[0]["model"])
         attempts = max(1, int(_knob("serve_request_retries", 3)))
         deadline = time.monotonic() + deadline_s
         exclude: list = []
@@ -329,7 +335,7 @@ class HTTPProxy:
                     replica, key = await router.assign_async(
                         name,
                         timeout_s=max(0.05, deadline - time.monotonic()),
-                        exclude=tuple(exclude))
+                        exclude=tuple(exclude), model=model)
                     astatus = "ok"
                 except KeyError as e:
                     astatus = dstatus = "unknown_deployment"
@@ -395,6 +401,14 @@ class HTTPProxy:
                     if pre_key is not None:
                         pre_exclude.append(pre_key[1])
                     dstatus = "prefill_lost"
+                    continue
+                except ModelSwapFailed as e:
+                    # the replica couldn't make the model resident:
+                    # exclude the pick and retry elsewhere — do NOT
+                    # mark it dead, its resident models keep serving
+                    last_death = e
+                    exclude.append(key[1])
+                    dstatus = "model_swap_failed"
                     continue
                 except ReplicaOverloaded as e:
                     dstatus = "shed"

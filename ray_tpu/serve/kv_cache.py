@@ -20,6 +20,17 @@ pages against a budget instead of re-padding a cache tensor:
 - **Accounting is airtight**: every page allocated is eventually freed
   or handed off, and every adopted page is eventually dropped — the
   chaos suite asserts ``active == 0`` after a drain (no leaked pages).
+- **Prefix caching** (``prefix_cache_pages > 0``): sealed prompt pages
+  are also registered in a per-table prefix-chain table keyed by the
+  cumulative hash of ``(model, token chunks)``.  A later request whose
+  prompt extends a cached chain adopts those pages by ref (pinned while
+  in use) and the engine prefills only the tail — copy-on-write at the
+  mutable tail page, which is per-request and never shared.  Cache
+  ownership is explicit: donated pages belong to the CACHE (the entry
+  holds a borrow, released through the same funnel as handoff borrows),
+  so the ledger invariant survives sharing; unpinned chains evict LRU
+  leaf-first and a drain flush restores ``allocated == freed +
+  handed_off`` exactly.
 
 A page's value is ``{"t": int32[<=page_tokens] token ids, "kv":
 optional engine payload}``.  Token ids make a page self-describing (an
@@ -31,10 +42,13 @@ hook).
 
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
+
+from ray_tpu.util import failpoint as _fp
 
 __all__ = ["KVPageTable", "KVPagesExhausted", "resolve_export"]
 
@@ -65,7 +79,7 @@ def _default_free(refs: List[Any]) -> None:
 
 class _Entry:
     __slots__ = ("pages", "tail", "reserved", "adopted",
-                 "adopted_pages")
+                 "adopted_pages", "borrowed_idx", "prefix_keys")
 
     def __init__(self, reserved: int, adopted: bool = False):
         self.pages: List[Any] = []     # sealed page ObjectRefs, in order
@@ -75,6 +89,27 @@ class _Entry:
         #: first ``adopted_pages`` of ``pages`` are BORROWED (sealed by
         #: another table); pages sealed here after adoption are owned
         self.adopted_pages = 0
+        #: page indices borrowed from THIS table's prefix cache (matched
+        #: chain pages + donated prompt pages) — the cache owns those
+        #: blobs; release drops the borrow instead of freeing
+        self.borrowed_idx: Set[int] = set()
+        #: prefix-chain keys this entry pins (unpinned on release)
+        self.prefix_keys: List[str] = []
+
+
+class _PrefixNode:
+    """One cached prompt page: the chain key it lives under commits to
+    the model id and every token up to the page's end, so a key match
+    IS a prefix match."""
+
+    __slots__ = ("ref", "parent", "children", "pins", "last_used")
+
+    def __init__(self, ref: Any, parent: Optional[str]):
+        self.ref = ref                   # sealed page ObjectRef (owned)
+        self.parent = parent             # parent chain key (None = root)
+        self.children: Set[str] = set()  # extending chain keys
+        self.pins = 0                    # live entries borrowing this page
+        self.last_used = 0               # LRU tick (monotonic counter)
 
 
 class KVPageTable:
@@ -89,11 +124,13 @@ class KVPageTable:
                  deployment: str = "",
                  kv_payload: Optional[Callable[[List[int]], Any]] = None,
                  put: Optional[Callable[[Any], Any]] = None,
-                 free: Optional[Callable[[List[Any]], None]] = None):
+                 free: Optional[Callable[[List[Any]], None]] = None,
+                 prefix_cache_pages: int = 0):
         if page_tokens <= 0:
             raise ValueError("page_tokens must be positive")
         self.page_tokens = int(page_tokens)
         self.max_pages = int(max_pages)
+        self.prefix_cache_pages = int(prefix_cache_pages)
         self._deployment = deployment
         self._kv_payload = kv_payload
         self._put = put or _default_put
@@ -107,6 +144,20 @@ class KVPageTable:
         self.adopted_total = 0
         self.dropped_total = 0  # adopted borrows released (not owned)
         self.peak_reserved = 0  # high-water mark of the page budget
+        # prefix-chain cache (chain key -> node); budget is SEPARATE
+        # from max_pages: resident <= max_pages + prefix_cache_pages
+        self._prefix: Dict[str, _PrefixNode] = {}
+        self._prefix_tick = 0
+        self.prefix_hits_total = 0
+        self.prefix_partial_total = 0
+        self.prefix_misses_total = 0
+        self.prefix_evicted_total = 0
+        self.prefix_inserted_total = 0
+        self.prefix_tokens_matched_total = 0
+
+    @property
+    def prefix_enabled(self) -> bool:
+        return self.prefix_cache_pages > 0
 
     # -- admission ---------------------------------------------------------
     def pages_for(self, n_tokens: int) -> int:
@@ -141,13 +192,37 @@ class KVPageTable:
         return sum(e.reserved for e in self._entries.values())
 
     def begin(self, request_id: str, tokens: List[int],
-              reserve_tokens: Optional[int] = None) -> int:
+              reserve_tokens: Optional[int] = None,
+              model: str = "") -> int:
         """Page the request's prompt (under a prior :meth:`reserve`, or
         reserving here for standalone use — the prefill tier); full
-        pages seal into the arena immediately.  Returns pages sealed."""
+        pages seal into the arena immediately.
+
+        With the prefix cache enabled, the prompt's full-page chunks are
+        first matched against the chain table: the longest cached chain
+        is adopted by ref (pinned, borrowed — the cache keeps ownership)
+        and only the remainder seals fresh; freshly sealed PROMPT pages
+        are donated into the cache under their chain keys (ownership
+        transfers to the cache, the entry keeps a borrow).  Returns the
+        number of prompt tokens covered by adopted pages — the engine
+        can skip prefill for exactly that many (``state["prefix_len"]``).
+        """
+        tokens = list(tokens)
         reserved = self.pages_for(reserve_tokens
                                   if reserve_tokens is not None
                                   else len(tokens))
+        chain: List[Tuple[str, List[int]]] = []
+        adopt_ok = True
+        if self.prefix_enabled:
+            chain = self._chain_of(model, tokens)
+            if chain:
+                try:
+                    _fp.failpoint("serve.kv_prefix.adopt_fail")
+                except Exception:  # noqa: BLE001 — adoption is an
+                    # optimization; fall back to a cold full prefill
+                    # (never a wrong answer)
+                    adopt_ok = False
+        result = None  # hit | partial | miss (chain non-empty only)
         with self._lock:
             entry = self._entries.get(request_id)
             if entry is not None and (entry.pages or entry.tail):
@@ -161,11 +236,69 @@ class KVPageTable:
                 entry = self._entries[request_id] = _Entry(reserved)
                 self.peak_reserved = max(self.peak_reserved,
                                          self._reserved_locked())
-            entry.tail = list(tokens)
+            matched = 0
+            if chain and adopt_ok:
+                self._prefix_tick += 1
+                for key, _chunk in chain:
+                    node = self._prefix.get(key)
+                    if node is None:
+                        break
+                    node.pins += 1
+                    node.last_used = self._prefix_tick
+                    entry.pages.append(node.ref)
+                    entry.borrowed_idx.add(len(entry.pages) - 1)
+                    entry.prefix_keys.append(key)
+                    matched += 1
+            matched_tokens = matched * self.page_tokens
+            if chain:
+                if matched == len(chain):
+                    result = "hit"
+                    self.prefix_hits_total += 1
+                elif matched > 0:
+                    result = "partial"
+                    self.prefix_partial_total += 1
+                else:
+                    result = "miss"
+                    self.prefix_misses_total += 1
+                self.prefix_tokens_matched_total += matched_tokens
+            entry.tail = tokens[matched_tokens:]
             chunks = self._take_full_chunks_locked(entry)
-        for chunk in chunks:
-            self._seal_chunk(request_id, chunk)
-        return len(chunks)
+        if result is not None:
+            self._emit_prefix_result(result)
+        for j, chunk in enumerate(chunks):
+            idx = matched + j
+            donate_key = chain[idx][0] if idx < len(chain) else None
+            parent_key = chain[idx - 1][0] if donate_key and idx > 0 \
+                else None
+            self._seal_chunk(request_id, chunk, donate_key=donate_key,
+                             parent_key=parent_key)
+        return matched_tokens
+
+    def _chain_of(self, model: str,
+                  tokens: List[int]) -> List[Tuple[str, List[int]]]:
+        """Cumulative chunk-hash chain over the prompt's FULL pages.
+        Each key hashes the previous key + the chunk's tokens (root is
+        salted with the model id), so equal keys imply byte-equal
+        ``(model, prefix)`` — collision odds are blake2b-128's."""
+        out: List[Tuple[str, List[int]]] = []
+        prev = "m:" + str(model or "")
+        n = (len(tokens) // self.page_tokens) * self.page_tokens
+        for i in range(0, n, self.page_tokens):
+            chunk = [int(t) for t in tokens[i:i + self.page_tokens]]
+            h = hashlib.blake2b(digest_size=16)
+            h.update(prev.encode())
+            h.update(np.asarray(chunk, dtype=np.int64).tobytes())
+            prev = h.hexdigest()
+            out.append((prev, chunk))
+        return out
+
+    def _emit_prefix_result(self, result: str) -> None:
+        try:
+            from ray_tpu.core import telemetry as _tm
+
+            _tm.serve_prefix_cache(self._deployment, result)
+        except Exception:  # noqa: BLE001 — stats must not fail serving
+            pass
 
     def append(self, request_id: str, token: int) -> None:
         with self._lock:
@@ -184,11 +317,19 @@ class KVPageTable:
             entry.tail = entry.tail[self.page_tokens:]
         return chunks
 
-    def _seal_chunk(self, request_id: str, chunk: List[int]) -> None:
+    def _seal_chunk(self, request_id: str, chunk: List[int],
+                    donate_key: Optional[str] = None,
+                    parent_key: Optional[str] = None) -> None:
         """Seal one full page OUTSIDE the lock (the put is an arena
         RPC), then attach it to the entry — unless the request was
         released mid-seal (cancel racing the decode step), in which
-        case the orphan page frees immediately so nothing leaks."""
+        case the orphan page frees immediately so nothing leaks.
+
+        ``donate_key`` registers the page in the prefix cache under its
+        chain key: ownership moves to the cache and the entry's hold
+        becomes a borrow.  If another request donated the same chain
+        key first (a same-prompt race), the entry simply keeps its
+        duplicate page as owned."""
         page = {"t": np.asarray(chunk, dtype=np.int32), "kv": None}
         if self._kv_payload is not None:
             try:
@@ -196,14 +337,58 @@ class KVPageTable:
             except Exception:  # noqa: BLE001 — payload is optional
                 page["kv"] = None
         ref = self._put(page)
+        to_free: List[Any] = []
         with self._lock:
             self.allocated_total += 1
             entry = self._entries.get(request_id)
-            if entry is not None:
+            if entry is None:
+                self.freed_total += 1
+                to_free = [ref]
+            else:
                 entry.pages.append(ref)
-                return
+                if donate_key is not None and self.prefix_enabled \
+                        and donate_key not in self._prefix:
+                    node = _PrefixNode(ref, parent_key)
+                    node.pins = 1
+                    self._prefix_tick += 1
+                    node.last_used = self._prefix_tick
+                    self._prefix[donate_key] = node
+                    parent = self._prefix.get(parent_key) \
+                        if parent_key else None
+                    if parent is not None:
+                        parent.children.add(donate_key)
+                    entry.borrowed_idx.add(len(entry.pages) - 1)
+                    entry.prefix_keys.append(donate_key)
+                    self.prefix_inserted_total += 1
+                    to_free = self._evict_prefix_locked()
+        if to_free:
+            self._free(to_free)
+
+    def _evict_prefix_locked(self) -> List[Any]:
+        """LRU-evict unpinned LEAF chains while over the cache budget
+        (a pinned child keeps its parent non-leaf, so in-use chains are
+        never broken).  Returns the evicted refs for the caller to free
+        outside the lock; each eviction counts into ``freed_total`` —
+        the cache is the owner."""
+        evicted: List[Any] = []
+        while len(self._prefix) > self.prefix_cache_pages:
+            best_key, best_node = None, None
+            for key, node in self._prefix.items():
+                if node.pins > 0 or node.children:
+                    continue
+                if best_node is None or node.last_used < best_node.last_used:
+                    best_key, best_node = key, node
+            if best_key is None:
+                break  # everything pinned or interior — stop, don't spin
+            del self._prefix[best_key]
+            parent = self._prefix.get(best_node.parent) \
+                if best_node.parent else None
+            if parent is not None:
+                parent.children.discard(best_key)
+            evicted.append(best_node.ref)
+            self.prefix_evicted_total += 1
             self.freed_total += 1
-        self._free([ref])
+        return evicted
 
     # -- release / handoff / adoption --------------------------------------
     def release(self, request_id: str) -> int:
@@ -220,14 +405,25 @@ class KVPageTable:
         if entry is None:
             return 0
         n = len(entry.pages)
-        borrowed = min(entry.adopted_pages, n)
-        owned = entry.pages[borrowed:]
+        owned = [p for j, p in enumerate(entry.pages)
+                 if j >= entry.adopted_pages
+                 and j not in entry.borrowed_idx]
+        borrowed = n - len(owned)
         if owned:
             self._free(owned)
         entry.pages = []
+        evict: List[Any] = []
         with self._lock:
             self.dropped_total += borrowed
             self.freed_total += len(owned)
+            for key in entry.prefix_keys:
+                node = self._prefix.get(key)
+                if node is not None and node.pins > 0:
+                    node.pins -= 1
+            if entry.prefix_keys:
+                evict = self._evict_prefix_locked()
+        if evict:
+            self._free(evict)
         return n
 
     def handoff(self, request_id: str) -> Dict[str, Any]:
@@ -241,7 +437,18 @@ class KVPageTable:
         if entry is None:
             raise KeyError(request_id)
         with self._lock:
-            self.handed_off_total += len(entry.pages)
+            owned = sum(1 for j in range(len(entry.pages))
+                        if j >= entry.adopted_pages
+                        and j not in entry.borrowed_idx)
+            self.handed_off_total += owned
+            # prefix borrows leave as drops: the cache stays the owner
+            # (the export's refs stay valid while the chain is cached;
+            # a later eviction surfaces as a retryable resolve failure)
+            self.dropped_total += len(entry.pages) - owned
+            for key in entry.prefix_keys:
+                node = self._prefix.get(key)
+                if node is not None and node.pins > 0:
+                    node.pins -= 1
         return {"pages": list(entry.pages), "tail": list(entry.tail),
                 "page_tokens": self.page_tokens}
 
@@ -274,14 +481,48 @@ class KVPageTable:
             ids = list(self._entries)
         for rid in ids:
             n += self.release(rid)
+        self.flush_prefix()
         return n
+
+    def flush_prefix(self) -> int:
+        """Free every UNPINNED cached prefix page (drain/shutdown):
+        with all entries released this empties the cache and restores
+        ``allocated == freed + handed_off`` exactly.  Pinned chains
+        (still borrowed by a live entry) survive."""
+        with self._lock:
+            refs = [node.ref for node in self._prefix.values()
+                    if node.pins == 0]
+            survivors = {k: v for k, v in self._prefix.items()
+                         if v.pins > 0}
+            for node in survivors.values():
+                node.children &= set(survivors)
+            self._prefix = survivors
+            self.freed_total += len(refs)
+        if refs:
+            self._free(refs)
+        return len(refs)
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             active = sum(len(e.pages) for e in self._entries.values())
             reserved = self._reserved_locked()
-            return {
+            out: Dict[str, Any] = {}
+            if self.prefix_enabled:
+                out = {
+                    "kv_prefix_pages_cached": len(self._prefix),
+                    "kv_prefix_pages_shared": sum(
+                        1 for v in self._prefix.values() if v.pins > 0),
+                    "kv_prefix_hits_total": self.prefix_hits_total,
+                    "kv_prefix_partial_total": self.prefix_partial_total,
+                    "kv_prefix_misses_total": self.prefix_misses_total,
+                    "kv_prefix_evicted_total": self.prefix_evicted_total,
+                    "kv_prefix_inserted_total":
+                        self.prefix_inserted_total,
+                    "kv_prefix_tokens_matched_total":
+                        self.prefix_tokens_matched_total,
+                }
+            out.update({
                 "kv_page_tokens": self.page_tokens,
                 "kv_max_pages": self.max_pages,
                 "kv_pages_active": active,
@@ -297,7 +538,8 @@ class KVPageTable:
                 "kv_pages_peak": self.peak_reserved,
                 "kv_occupancy_peak": (self.peak_reserved / self.max_pages)
                 if self.max_pages > 0 else 0.0,
-            }
+            })
+            return out
 
 
 def resolve_export(export: Dict[str, Any],

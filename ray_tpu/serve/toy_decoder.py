@@ -44,7 +44,6 @@ class ToyDecoder:
 
     def __init__(self, dim: int = 32, step_delay_s: float = 0.0,
                  seed: int = 0, prefill_delay_per_token_s: float = 0.0):
-        import jax
         import jax.numpy as jnp
         import numpy as np
 
@@ -52,14 +51,26 @@ class ToyDecoder:
         self.step_delay_s = float(step_delay_s)
         self.prefill_delay_per_token_s = float(prefill_delay_per_token_s)
         rng = np.random.default_rng(seed)
-        self._embed = jnp.asarray(
-            rng.normal(size=(self.vocab_size, dim)).astype("float32"))
-        self._w1 = jnp.asarray(
-            rng.normal(size=(dim, dim)).astype("float32") / dim ** 0.5)
-        self._w2 = jnp.asarray(
-            rng.normal(size=(dim, self.vocab_size)).astype("float32")
-            / dim ** 0.5)
         self.trace_count = 0  # python side effect: fires once per compile
+        self._install_weights(
+            jnp.asarray(
+                rng.normal(size=(self.vocab_size, dim)).astype("float32")),
+            jnp.asarray(
+                rng.normal(size=(dim, dim)).astype("float32")
+                / dim ** 0.5),
+            jnp.asarray(
+                rng.normal(size=(dim, self.vocab_size)).astype("float32")
+                / dim ** 0.5))
+
+    def _install_weights(self, embed, w1, w2) -> None:
+        """(Re)bind the weights and rebuild the jitted step: the traced
+        program captures the arrays as constants, so a weight swap must
+        re-jit — mutating ``self._embed`` alone would keep serving the
+        OLD model from the compiled cache."""
+        import jax
+        import jax.numpy as jnp
+
+        self._embed, self._w1, self._w2 = embed, w1, w2
 
         def _step(tokens, lengths, active):
             self.trace_count += 1  # traced, not executed, per shape
@@ -79,6 +90,23 @@ class ToyDecoder:
 
         self._jstep = jax.jit(_step)
 
+    # -- model-multiplexing hooks (serve/multiplex.py) ---------------------
+    def export_weights(self) -> Dict[str, Any]:
+        """Snapshot the full weight set as host arrays — what the
+        multiplexer seals into the arena so an evicted model reloads by
+        ref instead of re-initializing."""
+        import numpy as np
+
+        return {"embed": np.asarray(self._embed),
+                "w1": np.asarray(self._w1), "w2": np.asarray(self._w2)}
+
+    def load_weights(self, weights: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+
+        self._install_weights(jnp.asarray(weights["embed"]),
+                              jnp.asarray(weights["w1"]),
+                              jnp.asarray(weights["w2"]))
+
     # -- engine protocol ---------------------------------------------------
     def begin_request(self, payload: Any) -> Dict[str, Any]:
         if isinstance(payload, dict):
@@ -94,11 +122,15 @@ class ToyDecoder:
     def prefill(self, state: Dict[str, Any]) -> Dict[str, Any]:
         """The prompt pass.  The toy model recomputes from tokens so
         there is no tensor state to build — only the COST is modeled
-        (per prompt token), which is what the disaggregation bench
-        measures."""
+        (per prompt token), which is what the disaggregation and
+        prefix-cache benches measure.  ``state["prefix_len"]`` (set by
+        the batcher after a prefix-chain match) is the number of prompt
+        tokens whose KV pages were adopted from the cache — their
+        prefill cost is skipped."""
         if self.prefill_delay_per_token_s > 0:
-            time.sleep(self.prefill_delay_per_token_s
-                       * len(state.get("tokens") or ()))
+            skip = int(state.get("prefix_len") or 0)
+            charged = max(0, len(state.get("tokens") or ()) - skip)
+            time.sleep(self.prefill_delay_per_token_s * charged)
         return state
 
     def kv_page_payload(self, tokens: List[int]):

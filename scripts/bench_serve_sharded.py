@@ -18,6 +18,18 @@ Drives the full ingress path over gang-scheduled sharded replicas
    with ``prefill_replicas=1`` the prompt pass moves off the loop and
    short-request p99 stays at its no-barrage baseline.
 
+Plus the ISSUE 17 serving-economics scenarios:
+
+4. **Shared-system-prompt barrage** (KV prefix caching) — identical
+   long prefix + unique tails, prefix cache off vs on: a cache hit
+   adopts the sealed prefix pages by ref and prefills only the tail.
+   Gates: ``serve_prefix_ttft_ratio <= 0.5`` (cached TTFT vs cold) and
+   ``serve_prefix_qps_uplift >= 1.5`` (QPS/chip, same chip count).
+5. **Many-model multiplexing** — N=4 models through ONE multiplexed
+   replica (1 chip) vs one-deployment-per-model (4 chips), identical
+   paced open-loop load.  Gate: ``serve_mux_goodput_uplift >= 2``
+   (aggregate goodput per chip).
+
 Also reports KV page occupancy from the replica page tables.  Prints
 ONE line of JSON (the ``make bench-transfer`` contract) with deltas
 against the newest ``BENCH_r*.json`` carrying these rows.
@@ -47,7 +59,9 @@ if HERE not in sys.path:
 
 KEYS = ("serve_sharded_qps_per_chip_ratio",
         "serve_sharded_step_p50_ratio_4v1",
-        "serve_disagg_p99_short_ms", "serve_unified_p99_short_ms")
+        "serve_disagg_p99_short_ms", "serve_unified_p99_short_ms",
+        "serve_prefix_ttft_ratio", "serve_prefix_qps_uplift",
+        "serve_mux_goodput_uplift")
 
 
 def load_baseline() -> dict:
@@ -117,6 +131,39 @@ def closed_loop(url: str, payload_fn, workers: int,
             "p99_ms": lats[min(len(lats) - 1, int(len(lats) * 0.99))]
             * 1e3 if lats else 0.0,
             "completed": len(lats), "errors": errors[0]}
+
+
+def open_loop(url_fn, payload_fn, rate_qps: float, duration_s: float,
+              slo_s: float) -> dict:
+    """Paced open-loop load: requests fire on schedule regardless of
+    completions (each in its own thread), so a slow target accumulates
+    latency instead of silently throttling the offered rate — goodput
+    is answers within the SLO over what was OFFERED."""
+    results: list = []
+    lock = threading.Lock()
+
+    def one(j):
+        status, lat = _post(url_fn(j), payload_fn(j))
+        with lock:
+            results.append((status, lat))
+
+    n = max(1, int(rate_qps * duration_s))
+    threads = []
+    t0 = time.perf_counter()
+    for j in range(n):
+        target = t0 + j / rate_qps
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        th = threading.Thread(target=one, args=(j,))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=120)
+    good = sum(1 for s, lat in results if s == 200 and lat <= slo_s)
+    return {"offered": n, "good": good,
+            "errors": sum(1 for s, _ in results if s != 200),
+            "goodput_qps": good / max(duration_s, 0.001)}
 
 
 def bench(duration_s: float, step_delay_ms: float) -> dict:
@@ -255,6 +302,131 @@ def bench(duration_s: float, step_delay_ms: float) -> dict:
         out["serve_disagg_p99_ratio"] = round(
             out["serve_disagg_p99_short_ms"]
             / max(out["serve_unified_p99_short_ms"], 0.1), 3)
+
+        # -- 4) shared-system-prompt barrage (KV prefix caching) -------
+        # Identical 48-token prefix (3 sealed pages at 16 tok/page) +
+        # unique 4-token tails; prefill cost is charged per UNCACHED
+        # token, so a hit pays the tail only.  max_new_tokens=1 makes
+        # request latency ~= TTFT.  Same chip count both ways (1
+        # replica), so the QPS ratio is QPS/chip directly.
+        prefix = make_prompt(7, 48)
+        pf_ms_per_tok = 3.0
+
+        def prefix_payload(i, k):
+            return {"prompt": prefix + make_prompt(1000 + i * 131 + k, 4),
+                    "max_new_tokens": 1}
+
+        pf_rows = {}
+        for mode, extra_kv in (("off", {}),
+                               ("on", {"prefix_cache_pages": 64})):
+            name = f"prefix_{mode}"
+            dep = serve.deployment(
+                name=name, max_concurrent_queries=256,
+                batching={"max_batch_size": 8, "max_seq_len": 64,
+                          "max_queue_len": 512, **kv,
+                          **extra_kv})(ToyDecoder)
+            dep.deploy(step_delay_s=delay,
+                       prefill_delay_per_token_s=pf_ms_per_tok / 1e3)
+            # warm: compile the buckets AND seed the prefix chain so
+            # the measured window is all hits, not the first donation
+            st, _ = _post(f"{base}/{name}", prefix_payload(0, 0))
+            assert st == 200, f"warmup {name} failed ({st})"
+            pf_rows[mode] = closed_loop(
+                f"{base}/{name}", prefix_payload,
+                workers=4, duration_s=duration_s)
+            table = ray_tpu.get(
+                controller.get_routing_table.remote(-1, 1.0), timeout=30)
+            m = ray_tpu.get(
+                table["table"][name]["replicas"][0].metrics.remote(),
+                timeout=30)
+            if mode == "on":
+                out["serve_prefix_hits"] = int(
+                    m.get("kv_prefix_hits_total", 0))
+                out["serve_prefix_misses"] = int(
+                    m.get("kv_prefix_misses_total", 0))
+                out["serve_prefix_tokens_matched"] = int(
+                    m.get("kv_prefix_tokens_matched_total", 0))
+                out["serve_prefix_pages_cached"] = int(
+                    m.get("kv_prefix_pages_cached", 0))
+            serve.delete(name)
+            out[f"serve_prefix_{mode}_ttft_p50_ms"] = round(
+                pf_rows[mode]["p50_ms"], 1)
+            out[f"serve_prefix_{mode}_qps"] = round(pf_rows[mode]["qps"], 1)
+        out["serve_prefix_ttft_ratio"] = round(
+            pf_rows["on"]["p50_ms"] / max(pf_rows["off"]["p50_ms"], 0.1), 3)
+        out["serve_prefix_qps_uplift"] = round(
+            pf_rows["on"]["qps"] / max(pf_rows["off"]["qps"], 0.1), 3)
+        out["serve_prefix_gate_ok"] = bool(
+            out["serve_prefix_ttft_ratio"] <= 0.5
+            and out["serve_prefix_qps_uplift"] >= 1.5)
+
+        # -- 5) many-model multiplexing --------------------------------
+        # Same paced open-loop load (round-robin over 4 models) against
+        # ONE multiplexed replica (1 chip) and against 4 per-model
+        # deployments (4 chips).  Both absorb the offered rate, so the
+        # per-chip goodput ratio is ~the chip-count ratio — the
+        # consolidation IS the economics.
+        n_models = 4
+        models = {f"m{i}": {"seed": i} for i in range(n_models)}
+        mux_dep = serve.deployment(
+            name="muxdemo", max_concurrent_queries=256,
+            batching={"max_batch_size": 8, "max_seq_len": 64,
+                      "max_queue_len": 512, **kv},
+            multiplexed_models=models,
+            multiplex_max_resident=n_models)(ToyDecoder)
+        mux_dep.deploy(step_delay_s=delay)
+        for i in range(n_models):
+            serve.deployment(
+                name=f"solo_m{i}", max_concurrent_queries=256,
+                batching={"max_batch_size": 8, "max_seq_len": 64,
+                          "max_queue_len": 512, **kv})(ToyDecoder) \
+                .deploy(step_delay_s=delay, seed=i)
+
+        def mux_payload(j):
+            return {"prompt": make_prompt(j * 17, 6),
+                    "max_new_tokens": 8, "model": f"m{j % n_models}"}
+
+        def solo_payload(j):
+            return {"prompt": make_prompt(j * 17, 6),
+                    "max_new_tokens": 8}
+
+        # warm every model/deployment (bucket compiles + mux residency)
+        for i in range(n_models):
+            st, _ = _post(f"{base}/muxdemo", mux_payload(i))
+            assert st == 200, f"warmup muxdemo m{i} failed ({st})"
+            st, _ = _post(f"{base}/solo_m{i}", solo_payload(i))
+            assert st == 200, f"warmup solo_m{i} failed ({st})"
+        # offered rate sits under the mux replica's capacity (a mixed
+        # batch pays one masked sub-step per DISTINCT model, ~4x the
+        # per-step cost here), so both layouts absorb the load and the
+        # uplift measures pure chip consolidation, not saturation
+        rate, slo_s = 12.0, 1.0
+        mux_row = open_loop(lambda j: f"{base}/muxdemo", mux_payload,
+                            rate, duration_s, slo_s)
+        solo_row = open_loop(
+            lambda j: f"{base}/solo_m{j % n_models}", solo_payload,
+            rate, duration_s, slo_s)
+        table = ray_tpu.get(
+            controller.get_routing_table.remote(-1, 1.0), timeout=30)
+        mm = ray_tpu.get(
+            table["table"]["muxdemo"]["replicas"][0].metrics.remote(),
+            timeout=30)
+        out["serve_mux_swaps"] = int(mm.get("mux_swaps_total", 0))
+        out["serve_mux_goodput_qps"] = round(mux_row["goodput_qps"], 1)
+        out["serve_permodel_goodput_qps"] = round(
+            solo_row["goodput_qps"], 1)
+        out["serve_mux_errors"] = int(mux_row["errors"])
+        # per-chip: mux consolidates N models onto 1 replica chip; the
+        # per-model layout burns one chip per model
+        mux_per_chip = mux_row["goodput_qps"] / 1.0
+        solo_per_chip = solo_row["goodput_qps"] / float(n_models)
+        out["serve_mux_goodput_uplift"] = round(
+            mux_per_chip / max(solo_per_chip, 0.1), 3)
+        out["serve_mux_gate_ok"] = bool(
+            out["serve_mux_goodput_uplift"] >= 2.0)
+        serve.delete("muxdemo")
+        for i in range(n_models):
+            serve.delete(f"solo_m{i}")
     finally:
         try:
             serve.shutdown()

@@ -81,6 +81,13 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_serve_gang_bringup_seconds",
     "ray_tpu_serve_gang_shards",
     "ray_tpu_serve_gang_deaths_total",
+    # serving economics: prefix-cache / multiplex / steering series need
+    # a prefix-enabled or multiplexed deployment actually serving
+    "ray_tpu_serve_prefix_cache_total",
+    "ray_tpu_serve_prefix_pages_shared",
+    "ray_tpu_serve_mux_swaps_total",
+    "ray_tpu_serve_mux_swap_seconds",
+    "ray_tpu_serve_xgang_steered_total",
     "ray_tpu_gcs_respawns_total",
     # streaming data plane: series only appear once a streaming dataset
     # executes (and locality routing needs multi-node block placement)
