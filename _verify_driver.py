@@ -1,6 +1,6 @@
-"""End-to-end verify driver: core surface + the PR-17 serving-economics
-planes (prefix cache, multiplexing, slot steering), user-style over a
-real cluster."""
+"""End-to-end verify driver: core surface + the PR-18 device-plane
+observability (XLA compile accounting, step phase split, MFU/goodput,
+gang straggler naming), user-style over a real cluster."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -14,7 +14,7 @@ import json  # noqa: E402
 import time  # noqa: E402
 import urllib.request  # noqa: E402
 
-faulthandler.dump_traceback_later(180)
+faulthandler.dump_traceback_later(240)
 
 import ray_tpu  # noqa: E402
 
@@ -70,18 +70,20 @@ vals = sorted(r["id"] for r in ds.take_all())
 assert vals == list(range(200))
 print("data shuffle ok")
 
-# --- PR 17: prefix-cache deployment over real HTTP --------------------
+# --- PR 18: device-plane telemetry on a live serve deployment ---------
 from ray_tpu import serve  # noqa: E402
 from ray_tpu.serve._internal import CONTROLLER_NAME  # noqa: E402
 from ray_tpu.serve.http_proxy import start_proxy  # noqa: E402
-from ray_tpu.serve.toy_decoder import ToyDecoder, make_prompt  # noqa: E402
+from ray_tpu.serve.toy_decoder import (ToyDecoder, ToyDecoderShard,  # noqa: E402
+                                       make_prompt)
 
-pfx = serve.deployment(
-    name="pfx", max_concurrent_queries=16,
-    batching={"max_batch_size": 8, "max_seq_len": 64,
-              "kv_page_tokens": 8, "kv_max_pages": 64,
-              "prefix_cache_pages": 16})(ToyDecoder)
-serve.run(pfx.bind())
+BATCHING = {"max_batch_size": 4, "max_seq_len": 64,
+            "kv_page_tokens": 8, "kv_max_pages": 64}
+
+gen = serve.deployment(
+    name="gen", max_concurrent_queries=16,
+    batching=dict(BATCHING))(ToyDecoder)
+serve.run(gen.bind())
 host, port = start_proxy()
 
 
@@ -94,60 +96,105 @@ def http_call(name, payload):
         return json.loads(resp.read())["result"]
 
 
-prefix = make_prompt(3, 16)
 ref = ToyDecoder()
-lat = []
-for i in range(8):
-    p = {"prompt": prefix + make_prompt(50 + i, 4), "max_new_tokens": 6}
-    t0 = time.time()
-    out = http_call("pfx", p)
-    lat.append(time.time() - t0)
+for i in range(6):
+    p = {"prompt": list(make_prompt(i, 4 + i)), "max_new_tokens": 6}
+    out = http_call("gen", p)
     assert out["tokens"] == ref.generate_unbatched(dict(p))["tokens"], i
+
 controller = ray_tpu.get_actor(CONTROLLER_NAME)
 table = ray_tpu.get(controller.get_routing_table.remote(-1, 1.0),
                     timeout=30)
-rm = ray_tpu.get(
-    table["table"]["pfx"]["replicas"][0].metrics.remote(), timeout=30)
-hits = rm["kv_prefix_hits_total"] + rm["kv_prefix_partial_total"]
-print(f"prefix over HTTP: first {lat[0]*1e3:.0f}ms last {lat[-1]*1e3:.0f}ms"
-      f" hits+partial={hits} cached={rm['kv_prefix_pages_cached']}")
-assert hits >= 7, "prefix cache did not engage over the serve path"
-assert rm["kv_prefix_pages_cached"] >= 2
-assert rm["kv_pages_allocated_total"] == (
-    rm["kv_pages_freed_total"] + rm["kv_pages_handed_off_total"]
-    + rm["kv_prefix_pages_cached"]), "KV ledger leak"
-# slot surface is live in the routing table (cross-gang steering signal)
-slots = table["table"]["pfx"].get("replica_slots")
-assert slots and slots[0] is not None and int(slots[0]) >= 1, slots
-print("replica_slots in routing table:", slots)
+replica = table["table"]["gen"]["replicas"][0]
+m = ray_tpu.get(replica.metrics.remote(), timeout=30)
+# compile accounting: the decoder jits its bucketed step fns behind
+# instrument_step; after traffic the replica reports nonzero compiles
+assert m["compiles"] >= 1, m["compiles"]
+# phase split telescopes the batcher loop and yields a device fraction
+assert set(m["phase_s"]) == {"data_wait", "host", "device", "sync"}, m
+assert 0.0 < m["device_frac"] <= 1.0, m["device_frac"]
+assert m["goodput_per_s"] > 0.0, m
+steady = m["compiles"]
+print(f"serve device plane: compiles={m['compiles']} "
+      f"device_frac={m['device_frac']:.2f} "
+      f"goodput={m['goodput_per_s']:.1f}/s")
 
-# --- PR 17: model multiplexing via handle AND HTTP model routing ------
-mux = serve.deployment(
-    name="mux", max_concurrent_queries=16,
-    batching={"max_batch_size": 8, "max_seq_len": 64,
-              "kv_page_tokens": 8, "kv_max_pages": 64},
-    multiplexed_models={f"m{i}": {"seed": i} for i in range(3)},
-    multiplex_max_resident=2)(ToyDecoder)
-mh = serve.run(mux.bind())
-for i in range(3):
-    p = {"prompt": list(make_prompt(i, 6)), "max_new_tokens": 6,
-         "model": f"m{i}"}
-    expect = ToyDecoder(seed=i).generate_unbatched(
-        {"prompt": list(make_prompt(i, 6)), "max_new_tokens": 6})
-    assert mh.call(dict(p), timeout=60)["tokens"] == expect["tokens"], i
-    assert http_call("mux", p)["tokens"] == expect["tokens"], i
+# steady state: same padding buckets, more traffic -> ZERO new compiles
+for i in range(6):
+    p = {"prompt": list(make_prompt(i, 4 + i)), "max_new_tokens": 6}
+    http_call("gen", p)
+m2 = ray_tpu.get(replica.metrics.remote(), timeout=30)
+assert m2["compiles"] == steady, (steady, m2["compiles"])
+print("steady-state compiles stable at", steady)
+
+# --- PR 18: gang straggler over the real sharded path -----------------
+import ray_tpu.core.worker as core_worker  # noqa: E402
+from ray_tpu._test_utils import wait_for_condition  # noqa: E402
+
+skew = serve.deployment(
+    name="skew_gang", max_concurrent_queries=32,
+    batching=dict(BATCHING), num_shards=2)(ToyDecoderShard)
+sh = serve.run(skew.bind())
 table = ray_tpu.get(controller.get_routing_table.remote(-1, 1.0),
                     timeout=30)
-mm = ray_tpu.get(
-    table["table"]["mux"]["replicas"][0].metrics.remote(), timeout=30)
-print(f"mux: models={mm['mux_models_total']} swaps={mm['mux_swaps_total']}"
-      f" resident={mm['mux_resident_models']}")
-assert mm["mux_models_total"] == 3
-assert mm["mux_swaps_total"] >= 3
-assert len(mm["mux_resident_models"]) <= 2
+rank0 = table["table"]["skew_gang"]["replicas"][0]
+members = ray_tpu.get(
+    controller.get_gang_members.remote(rank0.actor_id.binary()),
+    timeout=30)
+assert len(members) == 1
+ray_tpu.get(members[0].arm_failpoint.remote(
+    "device.step.slow_rank", "delay", delay_s=0.08, count=-1), timeout=30)
 
-serve.delete("pfx")
-serve.delete("mux")
+for i in range(4):
+    p = {"prompt": list(make_prompt(i)), "max_new_tokens": 8}
+    out = sh.call(dict(p), timeout=120)
+    assert out["tokens"] == ref.generate_unbatched(dict(p))["tokens"], i
+
+gm = ray_tpu.get(rank0.metrics.remote(), timeout=30)
+assert gm["rank_skew_s"] > 0.05, gm
+assert gm["straggler_rank"] == 1, gm
+print(f"gang skew named rank {gm['straggler_rank']} "
+      f"(skew {gm['rank_skew_s']*1e3:.0f}ms)")
+
+gw = core_worker.global_worker_or_none()
+assert gw is not None
+
+
+def skew_gauge_named():
+    recs = gw.gcs_call("get_metrics", {})
+    return any(r["name"] == "ray_tpu_gang_rank_skew_seconds"
+               and r.get("tags", {}).get("straggler") == "1"
+               and r.get("value", 0) > 0.05 for r in recs)
+
+
+wait_for_condition(skew_gauge_named, timeout=60)
+print("skew gauge published with straggler tag")
+
+# --- PR 18: device families on a real /metrics scrape -----------------
+from ray_tpu.dashboard import Dashboard  # noqa: E402
+
+dash = Dashboard(port=0)
+url = dash.start()
+try:
+    want = {"ray_tpu_xla_compiles_total", "ray_tpu_xla_compile_seconds",
+            "ray_tpu_step_phase_seconds", "ray_tpu_step_goodput_per_s",
+            "ray_tpu_serve_decode_device_frac",
+            "ray_tpu_gang_rank_skew_seconds"}
+
+    def scrape_has_device_families():
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        got = {ln.split()[2] for ln in text.splitlines()
+               if ln.startswith("# TYPE ")}
+        return want <= got
+
+    wait_for_condition(scrape_has_device_families, timeout=60)
+    print("device-plane families present in /metrics scrape")
+finally:
+    dash.stop()
+
+serve.delete("gen")
+serve.delete("skew_gang")
 t0 = time.time()
 ray_tpu.shutdown()
 dt = time.time() - t0

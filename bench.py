@@ -23,20 +23,11 @@ import time
 
 
 def peak_flops_per_chip() -> float:
-    """Best-effort peak bf16 FLOPs for the attached chip."""
-    import jax
+    """Best-effort peak bf16 FLOPs for the attached chip (the MFU
+    denominator — one table, owned by the device-telemetry plane)."""
+    from ray_tpu.core.device_telemetry import peak_flops_per_chip as p
 
-    kind = jax.devices()[0].device_kind.lower()
-    table = {
-        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
-        "v4": 275e12,
-        "v5p": 459e12,
-        "v6 lite": 918e12, "v6e": 918e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12  # assume v5e-class
+    return p()
 
 
 def bench_gpt2() -> dict:
@@ -72,6 +63,8 @@ def bench_gpt2() -> dict:
     # default, see ops/fused.py)
     logits_dtype = jnp.bfloat16 if on_accel else None
 
+    from ray_tpu.core import device_telemetry as _dt
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
@@ -79,6 +72,8 @@ def bench_gpt2() -> dict:
                               head_logits_dtype=logits_dtype))(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
+
+    step = _dt.instrument_step(step, name="bench.gpt2.step")
 
     # warmup + compile; float() is a device->host transfer — the only
     # reliable barrier through remote-dispatch backends, where
@@ -93,9 +88,24 @@ def bench_gpt2() -> dict:
     float(loss)
     elapsed = time.perf_counter() - t0
 
+    # phase-attribution pass: a few per-step-synced steps through the
+    # StepMonitor bracket.  Kept OUT of the throughput loop above —
+    # the per-step float(loss) barrier defeats pipelining, so device
+    # fractions come from here while tokens/sec keeps its own loop
+    flops_per_token = cfg.flops_per_token()
+    mon = _dt.StepMonitor("train", name="bench.gpt2",
+                          flops_per_token=flops_per_token)
+    for _ in range(5 if on_accel else 2):
+        span = mon.step()
+        params, opt_state, loss = step(params, opt_state, tokens)
+        span.dispatched()
+        float(loss)  # the reliable barrier (see warmup note)
+        span.device_done()
+        span.done(tokens=float(batch * seq))
+    dev = mon.stats()
+
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * n_steps / elapsed
-    flops_per_token = cfg.flops_per_token()
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
     return {
         "tokens_per_sec_per_chip": tokens_per_sec,
@@ -107,6 +117,13 @@ def bench_gpt2() -> dict:
         "seq": seq,
         "model": "gpt2-124M" if on_accel else "gpt2-tiny(cpu-fallback)",
         "steps_per_sec": n_steps / elapsed,
+        # device-plane attribution (monitored pass; steady state after
+        # warmup, so compiles stays at the warmup count — 1)
+        "train_device_frac": round(dev["device_frac"], 3),
+        "train_data_wait_frac": round(dev["data_wait_frac"], 3),
+        "train_step_phase_s": {k: round(v, 4)
+                               for k, v in dev["phase_s"].items()},
+        "xla_compiles": _dt.compile_count("bench.gpt2.step"),
     }
 
 
@@ -242,6 +259,11 @@ for label, workers, nenvs, mode, secs in [
                 [list(s) for s in infer.get("batch_shapes", [])],
             "fragments_dropped_stale": stats.get("stale_dropped", 0),
             "weights_version": stats.get("weights_version", 0),
+            "inference_device_frac":
+                round(infer.get("device_frac", 0.0), 3),
+            "inference_data_wait_frac":
+                round(infer.get("data_wait_frac", 0.0), 3),
+            "inference_xla_compiles": infer.get("compiles", 0),
         }
     algo.stop()
 
@@ -1101,7 +1123,9 @@ def annotate_vs_prev(details: dict) -> None:
 #: summary line — the driver records only a 2000-char tail of stdout,
 #: which truncated r04's full 3.5 kB details line into "parsed": null
 SUMMARY_KEYS = (
-    "mfu", "tokens_per_sec_per_chip", "long_context_attn_fwd_bwd_ms",
+    "mfu", "tokens_per_sec_per_chip",
+    "train_device_frac", "train_data_wait_frac", "xla_compiles",
+    "long_context_attn_fwd_bwd_ms",
     "long_context_128k_attn_fwd_bwd_ms",
     "tasks_per_sec_sync", "tasks_per_sec_async",
     "multi_client_tasks_per_sec_async",

@@ -24,6 +24,8 @@ def train_loop(config):
     from ray_tpu.models import GPT2, GPT2Config
     from ray_tpu.models.gpt2 import loss_fn
 
+    from ray_tpu.core import device_telemetry
+
     cfg = (GPT2Config.tiny(dtype=jnp.float32)
            if config["model"] == "tiny" else GPT2Config.gpt2_small())
     model = GPT2(cfg)
@@ -40,11 +42,22 @@ def train_loop(config):
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    # device-plane wiring: compile telemetry on the jitted step, MFU /
+    # phase attribution via the session's step monitor (rides the
+    # result rows back to the driver as the "device" sibling key)
+    step = device_telemetry.instrument_step(step, name="train_gpt2.step")
+    mon = session.step_monitor()
+    mon.flops_per_token = cfg.flops_per_token()
+
     for i in range(config["steps"]):
         tokens = jax.random.randint(
             jax.random.PRNGKey(i), (config["batch"], seq), 0,
             cfg.vocab_size)
+        span = mon.step()
         params, opt_state, loss = step(params, opt_state, tokens)
+        span.dispatched()
+        span.device_done(loss)
+        span.done(tokens=float(tokens.size))
         if i % 10 == 0 or i == config["steps"] - 1:
             ckpt = Checkpoint.from_pytree(params) \
                 if session.get_world_rank() == 0 else None

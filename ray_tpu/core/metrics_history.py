@@ -140,6 +140,21 @@ def default_recording_rules(interval_s: float) -> List[RecordingRule]:
         RecordingRule(name="gcs:persist_failure_rate",
                       source="ray_tpu_gcs_persist_failures_total",
                       fn="rate", window_s=w),
+        # -- device plane (PR 18) --------------------------------------
+        # compile rate as a series: the RecompileStorm alert's input
+        # (threshold alerts read gauges/derived series, not counters) —
+        # steady state is 0; warmup shows one burst then decays
+        RecordingRule(name="device:compile_rate",
+                      source="ray_tpu_xla_compiles_total",
+                      fn="rate", window_s=w),
+        RecordingRule(name="train:mfu",
+                      source="ray_tpu_train_mfu", fn="max"),
+        RecordingRule(name="train:step_data_wait_frac",
+                      source="ray_tpu_train_step_data_wait_frac",
+                      fn="max"),
+        RecordingRule(name="serve:decode_device_frac",
+                      source="ray_tpu_serve_decode_device_frac",
+                      fn="max", group_by=("deployment",)),
     ]
 
 
@@ -186,6 +201,32 @@ def default_alert_rules(interval_s: float) -> List[AlertRule]:
                   description="GCS table snapshot writes are failing: "
                               "durability is degraded to the WAL (or "
                               "nothing)"),
+        # -- device plane (PR 18) --------------------------------------
+        # steady-state steps must not compile: a sustained compile rate
+        # means shapes keep missing the padding buckets (a shape leak),
+        # collapsing device throughput while host metrics look healthy.
+        # for_s spans two ticks (the ServeSLOBurnRate fires-within-
+        # three-ticks discipline); resolves once shapes stabilize.
+        AlertRule(name="RecompileStorm",
+                  signal="device:compile_rate", op=">",
+                  threshold=0.5, for_s=2 * interval_s,
+                  resolve_for_s=2 * interval_s, severity="warning",
+                  description="XLA keeps compiling during steady-state "
+                              "stepping: input shapes are leaking past "
+                              "the padding buckets and every retrace "
+                              "stalls the device"),
+        # persistent rank skew gates every gang step on the slowest
+        # member; group_by includes the straggler tag so the alert
+        # NAMES the slow rank
+        AlertRule(name="GangStraggler",
+                  signal="ray_tpu_gang_rank_skew_seconds", op=">",
+                  threshold=0.05, for_s=2 * interval_s,
+                  resolve_for_s=2 * interval_s, severity="warning",
+                  group_by=("deployment", "straggler"),
+                  description="one rank of a sharded gang is "
+                              "persistently slower than its peers; "
+                              "every decode step waits for it (the "
+                              "straggler tag names the rank)"),
     ]
 
 

@@ -880,6 +880,129 @@ def rl_fragments_dropped_stale(n: int = 1) -> None:
 
 
 # ---------------------------------------------------------------------------
+# device plane (core/device_telemetry.py — XLA compiles, step phases,
+# MFU/goodput, gang rank skew; docs/observability.md "device plane")
+# ---------------------------------------------------------------------------
+
+#: compile cost spans four orders of magnitude: a toy-decoder bucket
+#: retrace is ~10 ms on CPU, a pod-scale train step graph is minutes
+_COMPILE_BOUNDS = [0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   15.0, 60.0]
+_compile_keys: Dict[Tuple[str, str], Tuple] = {}
+_fn_keys: Dict[str, Tuple] = {}
+_phase_keys: Dict[Tuple[str, str], Tuple] = {}
+_plane_keys: Dict[str, Tuple] = {}
+
+
+def _fnkey(fn: str) -> Tuple:
+    key = _fn_keys.get(fn)
+    if key is None:
+        key = _fn_keys[fn] = (("fn", fn),)
+    return key
+
+
+def xla_compile(fn: str, reason: str, seconds: float) -> None:
+    """One detected XLA compilation of a jitted step entry point
+    (``reason``: first | shape_miss).  Steady-state steps must never
+    land here — the RecompileStorm alert rides the rate of this
+    counter."""
+    if not enabled():
+        return
+    key = _compile_keys.get((fn, reason))
+    if key is None:
+        key = _compile_keys[(fn, reason)] = (("fn", fn),
+                                             ("reason", reason))
+    _counter("ray_tpu_xla_compiles_total",
+             "XLA compilations detected at instrumented step entry "
+             "points, by function and trigger (first | shape_miss)",
+             ("fn", "reason")).inc_key(key)
+    _hist("ray_tpu_xla_compile_seconds",
+          "wall seconds of one detected compilation (traced call incl. "
+          "first execution)", _COMPILE_BOUNDS,
+          ("fn",)).observe_key(_fnkey(fn), seconds)
+
+
+def step_phase(plane: str, phase: str, seconds: float) -> None:
+    """One step's time in one phase of the device-step ladder
+    (``data_wait`` / ``host`` / ``device`` / ``sync``); the four
+    observations of a step sum to its wall time."""
+    if not enabled():
+        return
+    key = _phase_keys.get((plane, phase))
+    if key is None:
+        key = _phase_keys[(plane, phase)] = (("plane", plane),
+                                             ("phase", phase))
+    _hist("ray_tpu_step_phase_seconds",
+          "per-step wall time split over the data_wait/host/device/sync "
+          "phase ladder, by workload plane",
+          _STEP_BOUNDS, ("plane", "phase")).observe_key(key, seconds)
+
+
+def _planekey(plane: str) -> Tuple:
+    key = _plane_keys.get(plane)
+    if key is None:
+        key = _plane_keys[plane] = (("plane", plane),)
+    return key
+
+
+def step_goodput(plane: str, per_s: float) -> None:
+    """Rolling goodput of the instrumented step loop: tokens/s for
+    train+serve, rows/s for RL inference — the numerator of MFU."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_step_goodput_per_s",
+           "tokens-or-requests per second through the instrumented "
+           "step loop, by workload plane",
+           ("plane",)).set_key(_planekey(plane), per_s)
+
+
+def train_step_quality(mfu: float, data_wait_frac: float) -> None:
+    """Train-plane step efficiency: model FLOPs utilization and the
+    fraction of step wall time spent waiting on input data (the
+    starved-accelerator signal the autoscaler and `ray-tpu top` read
+    via the train:mfu / train:step_data_wait_frac recording rules)."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_train_mfu",
+           "rolling model-FLOPs utilization of the train step loop"
+           ).set_key(_EMPTY_KEY, mfu)
+    _gauge("ray_tpu_train_step_data_wait_frac",
+           "fraction of train step wall time spent waiting for input "
+           "data (prefetch handoff)").set_key(_EMPTY_KEY, data_wait_frac)
+
+
+def serve_decode_device_frac(deployment: str, frac: float) -> None:
+    """Fraction of decode-step wall time the device was computing
+    (vs host dispatch/sync): low values mean the chip is starved by
+    host-side batching work."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_serve_decode_device_frac",
+           "device-compute fraction of decode-step wall time per "
+           "deployment", ("deployment",)).set_key(_dkey(deployment), frac)
+
+
+_skew_keys: Dict[Tuple[str, str], Tuple] = {}
+
+
+def gang_rank_skew(deployment: str, skew_s: float, straggler: int) -> None:
+    """Gang-level rank skew: max minus min mean per-rank step duration
+    over the rolling step window, tagged with the slowest rank so the
+    GangStraggler alert names it."""
+    if not enabled():
+        return
+    tag = (deployment, str(int(straggler)))
+    key = _skew_keys.get(tag)
+    if key is None:
+        key = _skew_keys[tag] = (("deployment", deployment),
+                                 ("straggler", tag[1]))
+    _gauge("ray_tpu_gang_rank_skew_seconds",
+           "spread (max-min) of mean per-rank step duration over a "
+           "gang's step window, tagged with the straggling rank",
+           ("deployment", "straggler")).set_key(key, skew_s)
+
+
+# ---------------------------------------------------------------------------
 # streaming data plane (data/streaming.py — docs/data.md)
 # ---------------------------------------------------------------------------
 

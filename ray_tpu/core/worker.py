@@ -55,6 +55,7 @@ def _spec_dumps(obj) -> bytes:
     except Exception:  # e.g. an exotic strategy payload — keep working
         return cloudpickle.dumps(obj)
 
+from ray_tpu.core import device_telemetry as _dt
 from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
@@ -3962,6 +3963,11 @@ class CoreWorker:
             # body start: env setup + network arg pulls above belong to
             # the analyzer's 'fetch' phase, not 'exec'
             exec_t0 = time.time()
+            # device-seconds attribution: StepMonitors accumulate this
+            # thread's device-compute time; the body-interval delta
+            # rides the task_exec span so the analyzer can split exec
+            # into exec_host / exec_device
+            dev_s0 = _dt.device_seconds()
             fn = self._resolve_callable(spec)
             # native trace context: the executor span becomes the body's
             # ambient parent, so nested submissions / serve batcher
@@ -4057,7 +4063,9 @@ class CoreWorker:
                                 task_id=spec.task_id.hex(),
                                 attempt=spec.attempt_number,
                                 job=spec.job_id.hex() if spec.job_id
-                                else None)
+                                else None,
+                                device_s=round(
+                                    _dt.device_seconds() - dev_s0, 6))
                 # per-job attribution: body seconds + task count roll
                 # up by tenant (ray_tpu_job_* series, `top --jobs`)
                 _tm.job_task_finished(

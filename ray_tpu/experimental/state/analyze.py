@@ -39,8 +39,12 @@ _TERMINAL = ("FINISHED", "FAILED")
 
 #: phase display order (critical path and totals tables).  ``gap`` is
 #: path-only: time between the critical dependency finishing and this
-#: task being submitted (driver think time / submit latency).
-PHASES = ("gap", "sched", "fetch", "exec", "reply")
+#: task being submitted (driver think time / submit latency).  The
+#: body interval splits into host vs device time when the executor's
+#: ``task_exec`` span carries a ``device_s`` attribution (accumulated
+#: by device-plane StepMonitors on the executing thread); without one,
+#: the whole body reads as ``exec_host``.
+PHASES = ("gap", "sched", "fetch", "exec_host", "exec_device", "reply")
 
 
 def _core():
@@ -51,7 +55,7 @@ def _core():
 # task table reconstruction
 # ---------------------------------------------------------------------------
 
-def _fetch(job: Optional[str], limit: int) -> Tuple[list, list]:
+def _fetch(job: Optional[str], limit: int) -> Tuple[list, list, list]:
     core = _core()
     events = core.gcs_call("get_task_events",
                            {"limit": limit, "job_id": job})
@@ -60,7 +64,11 @@ def _fetch(job: Optional[str], limit: int) -> Tuple[list, list]:
                               {"cat": "task_exec", "limit": limit})
     except Exception:  # noqa: BLE001 — pre-telemetry GCS: events only
         spans = []
-    return events, spans
+    try:
+        gang = core.gcs_call("get_spans", {"cat": "gang", "limit": 256})
+    except Exception:  # noqa: BLE001 — pre-telemetry GCS
+        gang = []
+    return events, spans, gang
 
 
 def _latest_job(events: List[Dict[str, Any]]) -> Optional[str]:
@@ -87,7 +95,7 @@ def build_tasks(events: List[Dict[str, Any]],
                 "task_id": ev["task_id"], "attempt": ev.get("attempt", 0),
                 "name": ev.get("name"), "state": None,
                 "pending": None, "running": None, "finished": None,
-                "exec_start": None, "exec_end": None,
+                "exec_start": None, "exec_end": None, "device_s": 0.0,
                 "deps": [], "parent": None,
                 "worker_id": ev.get("worker_id"),
             }
@@ -119,6 +127,10 @@ def build_tasks(events: List[Dict[str, Any]],
         if t is not None:
             t["exec_start"] = span.get("start")
             t["exec_end"] = span.get("end")
+            try:
+                t["device_s"] = max(0.0, float(args.get("device_s") or 0))
+            except (TypeError, ValueError):
+                t["device_s"] = 0.0
     return tasks
 
 
@@ -161,14 +173,20 @@ def _phases(t: Dict[str, Any], anchor: Optional[float]
             cursor = ex0
         end_exec = min(max(ex1, cursor), finished)
         if end_exec > cursor:
-            out["exec"] = end_exec - cursor
+            # body interval: the span's device_s attribution (clamped
+            # to the interval — clock correction can shave the span)
+            # is device time; the rest ran python
+            body = end_exec - cursor
+            device = min(max(0.0, t.get("device_s", 0.0)), body)
+            out["exec_device"] = device
+            out["exec_host"] = body - device
             cursor = end_exec
         if finished > cursor:
             out["reply"] = finished - cursor
     elif finished > cursor:
         # no executor span (telemetry off / span ring rotated): the
-        # whole RUNNING->FINISHED interval counts as exec
-        out["exec"] = finished - cursor
+        # whole RUNNING->FINISHED interval counts as host exec
+        out["exec_host"] = finished - cursor
     return out
 
 
@@ -230,7 +248,7 @@ def analyze_job(job: Optional[str] = None,
             "get_task_events", {"limit": 1000}))
         if job is None:
             return {"job": None, "n_tasks": 0, "error": "no task events"}
-    events, spans = _fetch(job, limit)
+    events, spans, gang_spans = _fetch(job, limit)
     tasks = build_tasks(events, spans)
     done = [t for t in tasks.values() if t.get("finished") is not None]
     if not done:
@@ -262,11 +280,23 @@ def analyze_job(job: Optional[str] = None,
         per_task.append({"task_id": t["task_id"], "name": t["name"],
                          "phases": ph})
     top: Dict[str, List[Tuple[str, float]]] = {}
-    for phase in ("exec", "sched", "fetch"):
+    for phase in ("exec_host", "exec_device", "sched", "fetch"):
         agg: Dict[str, float] = defaultdict(float)
         for row in per_task:
             agg[row["name"] or "?"] += row["phases"][phase]
         top[phase] = sorted(agg.items(), key=lambda kv: -kv[1])[:5]
+    # gang straggler annotations (sharded.py records one span per
+    # straggler change): newest span per deployment
+    stragglers: Dict[str, Dict[str, Any]] = {}
+    for span in gang_spans:
+        args = span.get("args") or {}
+        dep = args.get("deployment") or "?"
+        cur = stragglers.get(dep)
+        if cur is None or span.get("end", 0.0) > cur["at"]:
+            stragglers[dep] = {"deployment": dep,
+                               "rank": args.get("rank"),
+                               "skew_s": args.get("skew_s", 0.0),
+                               "at": span.get("end", 0.0)}
     return {
         "job": job,
         "n_tasks": len({t["task_id"] for t in done}),
@@ -279,6 +309,8 @@ def analyze_job(job: Optional[str] = None,
         "skew_s": skew,
         "phase_totals": totals,
         "top": top,
+        "stragglers": sorted(stragglers.values(),
+                             key=lambda s: -float(s["skew_s"] or 0)),
     }
 
 
@@ -316,11 +348,15 @@ def format_report(result: Dict[str, Any]) -> str:
     lines.append("  " + "  ".join(
         f"{p}={totals[p]:.3f}s ({_pct(totals[p], busy)})"
         for p in PHASES))
-    for phase in ("exec", "sched", "fetch"):
+    for phase in ("exec_host", "exec_device", "sched", "fetch"):
         rows = [r for r in result["top"][phase] if r[1] > 0]
         if rows:
             lines.append(f"top {phase} offenders: " + ", ".join(
                 f"{name} {secs:.3f}s" for name, secs in rows))
+    for s in result.get("stragglers") or []:
+        lines.append(
+            f"gang straggler: {s['deployment']} rank {s['rank']} "
+            f"(+{float(s['skew_s'] or 0) * 1e3:.1f}ms per step)")
     return "\n".join(lines)
 
 

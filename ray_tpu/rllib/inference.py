@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_tpu.core import device_telemetry as _dt
 from ray_tpu.core import telemetry as _tm
 
 __all__ = ["InferenceActor", "InferenceBatcher", "inference_buckets"]
@@ -93,6 +94,9 @@ class InferenceBatcher:
         self._rows_total = 0
         self._occupancy_sum = 0.0
         self._batch_shapes: set = set()
+        # device-plane attribution: data_wait = queue idle + straggler
+        # window, device = the bucketed forward, sync = the scatter
+        self._monitor = _dt.StepMonitor("rl", name="rl.inference")
         self._thread = threading.Thread(
             target=self._run, name="rtpu-rl-infer", daemon=True)
         self._thread.start()
@@ -144,6 +148,7 @@ class InferenceBatcher:
             self._queue.clear()
 
     def stats(self) -> Dict[str, Any]:
+        dev = self._monitor.stats()   # own lock: take outside ours
         with self._lock:
             return {
                 "dispatches": self._dispatches,
@@ -154,6 +159,11 @@ class InferenceBatcher:
                 "queue_depth": len(self._queue),
                 "weights_version": self._version,
                 "clients": self._clients,
+                "device_frac": dev["device_frac"],
+                "data_wait_frac": dev["data_wait_frac"],
+                "goodput_per_s": dev["goodput_per_s"],
+                "phase_s": dev["phase_s"],
+                "compiles": _dt.compile_count(),
             }
 
     # -- dispatch loop -------------------------------------------------
@@ -178,6 +188,7 @@ class InferenceBatcher:
 
     def _run(self) -> None:
         while True:
+            t_iter = time.time()
             with self._lock:
                 while not self._queue and not self._stop:
                     self._wake.wait(timeout=0.1)
@@ -201,10 +212,12 @@ class InferenceBatcher:
                 age = time.monotonic() - self._synced_at
             if not batch:
                 continue
-            self._dispatch(batch, version, age)
+            self._dispatch(batch, version, age,
+                           data_wait_s=time.time() - t_iter)
 
     def _dispatch(self, batch: List[_Pending], version: int,
-                  age: float) -> None:
+                  age: float, data_wait_s: float = 0.0) -> None:
+        span = self._monitor.step(data_wait_s=data_wait_s)
         rows = sum(p.rows for p in batch)
         obs = np.concatenate([p.obs for p in batch], axis=0) \
             if len(batch) > 1 else batch[0].obs
@@ -215,6 +228,7 @@ class InferenceBatcher:
         else:
             padded = obs
         padded_rows = padded.shape[0]
+        span.dispatched()
         try:
             if padded.shape[0] > self._max_rows:
                 # oversized single request: chunk at the largest bucket
@@ -243,6 +257,7 @@ class InferenceBatcher:
                 if not p.future.done():
                     p.future.set_exception(e)
             return
+        span.device_done(actions)
         occupancy = rows / max(1, padded_rows)
         with self._lock:
             self._dispatches += 1
@@ -261,6 +276,7 @@ class InferenceBatcher:
                 (np.asarray(actions)[sl],
                  {k: np.asarray(v)[sl] for k, v in extras.items()},
                  version))
+        span.done(tokens=float(rows), requests=float(len(batch)))
 
     def _forward(self, obs: np.ndarray):
         return self._policy.compute_actions(obs)

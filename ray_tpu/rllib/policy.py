@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ray_tpu.core import device_telemetry as _dt
 from ray_tpu.rllib.env import Box, Discrete
 from ray_tpu.rllib.models import Categorical, DiagGaussian, FCNet
 from ray_tpu.rllib.postprocessing import compute_gae
@@ -150,9 +151,12 @@ class JaxPolicy:
                 _, vf, _ = model.apply(params, obs[:, None], (c, h))
                 return vf[:, 0]
 
-            self._act_rnn = _act_rnn
-            self._act_rnn_greedy = _act_rnn_greedy
-            self._values_rnn = _values_rnn
+            self._act_rnn = _dt.instrument_step(
+                _act_rnn, name="jax_policy.act_rnn")
+            self._act_rnn_greedy = _dt.instrument_step(
+                _act_rnn_greedy, name="jax_policy.act_rnn_greedy")
+            self._values_rnn = _dt.instrument_step(
+                _values_rnn, name="jax_policy.values_rnn")
             #: set by the sampler before postprocess_trajectory so the
             #: truncation bootstrap evaluates V(s_last | carry)
             self._bootstrap_state: Optional[Tuple] = None
@@ -180,12 +184,17 @@ class JaxPolicy:
                 _, vf = model.apply(params, obs)
                 return vf
 
-            self._act = _act
-            self._act_greedy = _act_greedy
-            self._values = _values
-        self._update = jax.jit(self._update_impl)
-        self._grads = jax.jit(self._grads_impl)
-        self._apply = jax.jit(self._apply_impl)
+            self._act = _dt.instrument_step(_act, name="jax_policy.act")
+            self._act_greedy = _dt.instrument_step(
+                _act_greedy, name="jax_policy.act_greedy")
+            self._values = _dt.instrument_step(
+                _values, name="jax_policy.values")
+        self._update = _dt.instrument_step(
+            jax.jit(self._update_impl), name="jax_policy.update")
+        self._grads = _dt.instrument_step(
+            jax.jit(self._grads_impl), name="jax_policy.grads")
+        self._apply = _dt.instrument_step(
+            jax.jit(self._apply_impl), name="jax_policy.apply")
 
     def _on_device(self):
         if self._device is None:

@@ -355,6 +355,12 @@ class ServeReplica:
             for k, v in s.items():
                 if k.startswith("kv_"):
                     out[k] = v
+            # device-plane attribution (StepMonitor + compile counts):
+            # bench + `ray-tpu top` read these off the replica poll
+            for k in ("mfu", "goodput_per_s", "device_frac",
+                      "data_wait_frac", "phase_s", "compiles"):
+                if k in s:
+                    out[k] = s[k]
         mux = getattr(self._callable, "mux_stats", None)
         if mux is not None:
             out.update(mux())
@@ -743,6 +749,15 @@ class ServeController:
                 _tm.serve_prefix_pages_shared(name, sum(
                     int(m.get("kv_prefix_pages_cached", 0))
                     for m in metrics))
+            # gang straggler skew: each gang replica's rank-0 reports
+            # per-rank step means; publish the WORST gang's skew with
+            # the straggling rank in the tag (the GangStraggler alert
+            # groups by it, so the alert names the rank)
+            gangs = [m for m in metrics if "rank_skew_s" in m]
+            if gangs:
+                worst = max(gangs, key=lambda m: float(m["rank_skew_s"]))
+                _tm.gang_rank_skew(name, float(worst["rank_skew_s"]),
+                                   int(worst.get("straggler_rank", 0)))
 
     def _reconcile_once(self) -> bool:
         changed = False

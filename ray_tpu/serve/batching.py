@@ -56,6 +56,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu.core import device_telemetry as _dt
 from ray_tpu.core import telemetry as _tm
 from ray_tpu.core import tracing as _trace
 
@@ -280,6 +281,16 @@ class ContinuousBatcher:
         self._occupancy_sum = 0.0
         self._latencies_ms: List[float] = []  # bounded ring, p99 source
         self._step_ms: List[float] = []  # decode-step durations (ring)
+        # device-plane step attribution: phase ladder + goodput/MFU
+        # (engine-declared FLOPs-per-token; 0 = goodput only)
+        fpt = getattr(engine, "flops_per_token", 0.0)
+        self._monitor = _dt.StepMonitor(
+            "serve", name=f"serve.{deployment or 'batcher'}",
+            deployment=deployment,
+            flops_per_token=float(fpt() if callable(fpt) else fpt or 0.0))
+        #: idle seconds since the last decode step (the decode loop
+        #: parked waiting for admissions) — the serve plane's data_wait
+        self._idle_wait_s = 0.0
         self._thread = threading.Thread(
             target=self._run, name="rtpu-serve-batcher", daemon=True)
         self._thread.start()
@@ -365,6 +376,10 @@ class ContinuousBatcher:
     # -- stats -------------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         kv = self._kv.stats() if self._kv is not None else {}
+        # device-plane step attribution (outside self._lock: the
+        # monitor owns its own lock); compile count is process-global —
+        # steady-state steps must keep it flat (one per bucket, warmup)
+        dev = self._monitor.stats()
         with self._lock:
             lat = sorted(self._latencies_ms)
             p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat \
@@ -394,6 +409,12 @@ class ContinuousBatcher:
                 if self._steps else 0.0,
                 "p50_ms": p50,
                 "p99_ms": p99,
+                "mfu": dev["mfu"],
+                "goodput_per_s": dev["goodput_per_s"],
+                "device_frac": dev["device_frac"],
+                "data_wait_frac": dev["data_wait_frac"],
+                "phase_s": dev["phase_s"],
+                "compiles": _dt.compile_count(),
             }
 
     # -- decode loop -------------------------------------------------------
@@ -596,8 +617,11 @@ class ContinuousBatcher:
                 admitted = self._newly_admitted
                 self._newly_admitted = []
                 if self._active == 0:
-                    # idle: park until a submit/cancel/stop wakes us
+                    # idle: park until a submit/cancel/stop wakes us;
+                    # the parked time is the next step's data_wait
+                    t_park = time.time()
                     self._wake.wait(timeout=0.1)
+                    self._idle_wait_s += time.time() - t_park
                     continue
             # prefill + page sealing for fresh admissions runs with the
             # lock RELEASED: submitters/cancels never queue behind a
@@ -632,6 +656,8 @@ class ContinuousBatcher:
             # metric export stays OUTSIDE the lock: the registry takes
             # its own locks and must not serialize submit()/cancel()
             _tm.serve_batch_occupancy(self._deployment, occupancy)
+            span = self._monitor.step(data_wait_s=self._idle_wait_s)
+            self._idle_wait_s = 0.0
             step_t0 = time.time()
             try:
                 if models is not None:
@@ -648,6 +674,10 @@ class ContinuousBatcher:
                         if self._slots[i] is not None:
                             self._release_slot_locked(i, error=e)
                 continue
+            # host dispatch ended when step() returned; device compute
+            # ends when the result is materialized (block_until_ready)
+            span.dispatched()
+            span.device_done(next_tokens)
             step_t1 = time.time()
             _tm.serve_decode_step(self._deployment, step_t1 - step_t0)
             # local ring too: replica metrics expose step p50/p99 so a
@@ -698,6 +728,9 @@ class ContinuousBatcher:
                 # step is a no-op append
                 for rid, tok in kv_appends:
                     self._kv.append(rid, tok)
+            # sync phase: result scatter + ttft export + page sealing
+            # (one generated token per active slot this step)
+            span.done(tokens=float(len(batch)), requests=float(len(batch)))
 
 
 def bucketize(lengths: Sequence[int], buckets: Sequence[int]) -> List[int]:

@@ -36,11 +36,14 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
 import ray_tpu
+from ray_tpu.core import device_telemetry as _dt
+from ray_tpu.core import telemetry as _tm
 from ray_tpu.core.exceptions import ActorDiedError, WorkerCrashedError
 from ray_tpu.util import failpoint as _fp
 
@@ -85,6 +88,9 @@ class ShardGangWorker:
         ObjectRef argument (resolved by the worker — the broadcast
         path), carrying ``(tokens, lengths, active)``."""
         _fp.failpoint("serve.shard.step_fail")
+        # straggler injection: arm with action=delay on ONE rank (via
+        # this shard's arm_failpoint) to slow exactly that rank's steps
+        _fp.failpoint("device.step.slow_rank")
         tokens, lengths, active = step_inputs
         return self._engine.shard_step(tokens, lengths, active)
 
@@ -136,6 +142,14 @@ class ShardedEngine:
         self._attached = threading.Event()
         self._stop = threading.Event()
         self._steps = 0
+        # straggler detection: rank 0 records every rank's duration per
+        # step (its own slice's compute; each remote rank's submit-to-
+        # arrival) — skew + argmax rank ride gang_stats() to the
+        # controller, which publishes ray_tpu_gang_rank_skew_seconds
+        self._skew = _dt.RankSkewWindow(self.num_shards)
+        #: trace-annotation throttle: spans only when the straggling
+        #: rank changes or skew first crosses the warn threshold
+        self._last_straggler: Optional[int] = None
 
     # -- delegation to the rank-0 shard ------------------------------------
     @property
@@ -234,18 +248,68 @@ class ShardedEngine:
             if not self._attached.wait(timeout=30.0):
                 raise RuntimeError("gang shards never attached")
         payload = self._step_payload(tokens, lengths, active)
+        durations: Dict[int, float] = {}
         try:
+            submit = time.time()
             remote = [h.shard_step.remote(payload)
                       for h in self._shards]
+            t0 = time.time()
+            # rank 0's slice runs under the same LOGICAL site as the
+            # remote ranks' shard_step (arming is per-process: a gang
+            # member arms exactly one of the two, never both)
+            _fp.failpoint("device.step.slow_rank")  # rtpu-check: disable=failpoint-registry
             local = self._local.shard_step(tokens, lengths, active)
-            parts = [local] + list(ray_tpu.get(remote, timeout=60.0))
+            durations[0] = time.time() - t0
+            # incremental gather: each remote rank's duration is its
+            # submit-to-arrival wall time (compute + queue + transfer —
+            # exactly what rank 0 waits on, which is what skew means)
+            pending = {ref: rank + 1 for rank, ref in enumerate(remote)}
+            parts_by_rank: Dict[int, Any] = {0: local}
+            deadline = submit + 60.0
+            while pending:
+                ready, _ = ray_tpu.wait(
+                    list(pending), num_returns=1,
+                    timeout=max(0.0, deadline - time.time()))
+                if not ready:
+                    raise TimeoutError("gang step gather timed out")
+                ref = ready[0]
+                rank = pending.pop(ref)
+                parts_by_rank[rank] = ray_tpu.get(ref, timeout=5.0)
+                durations[rank] = time.time() - submit
+            parts = [parts_by_rank[r] for r in range(self.num_shards)]
         except (ActorDiedError, WorkerCrashedError) as e:
             self._gang_suicide(f"step: {type(e).__name__}")
             raise  # unreachable (suicide) — keeps control flow explicit
         self._steps += 1
+        self._record_skew(durations)
         return self._local.combine(parts, active)
 
+    #: skew above this much of a step's slowest rank is worth a trace
+    #: span (the alert threshold lives in metrics_history; this only
+    #: gates trace-tree annotation so healthy gangs stay span-free)
+    _SKEW_SPAN_MIN_S = 0.05
+
+    def _record_skew(self, durations: Dict[int, float]) -> None:
+        self._skew.record(durations)
+        snap = self._skew.snapshot()
+        straggler = snap["straggler"]
+        if (snap["skew_s"] >= self._SKEW_SPAN_MIN_S
+                and straggler is not None
+                and straggler != self._last_straggler):
+            # annotate the trace tree once per straggler change, with a
+            # span covering the straggling rank's portion of this step
+            now = time.time()
+            _tm.record_span(
+                "gang", "straggler", now - snap["skew_s"], now,
+                deployment=self._deployment, rank=straggler,
+                skew_s=round(snap["skew_s"], 6))
+        self._last_straggler = straggler
+
     def gang_stats(self) -> Dict[str, Any]:
+        snap = self._skew.snapshot()
         return {"num_shards": self.num_shards,
                 "gang_steps": self._steps,
-                "attached": self._attached.is_set()}
+                "attached": self._attached.is_set(),
+                "rank_step_s": snap["rank_step_s"],
+                "rank_skew_s": snap["skew_s"],
+                "straggler_rank": snap["straggler"]}

@@ -21,6 +21,8 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.core import device_telemetry as _dt
+
 __all__ = ["ToyDecoder", "ToyDecoderShard", "make_prompt"]
 
 
@@ -88,7 +90,11 @@ class ToyDecoder:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return jnp.where(active, nxt, self.pad_token)
 
-        self._jstep = jax.jit(_step)
+        # the compile-accounting wrapper is rebuilt WITH the jit, so its
+        # seen-signature set tracks exactly this executable cache (a
+        # weight swap's re-trace counts as a fresh "first" compile)
+        self._jstep = _dt.instrument_step(jax.jit(_step),
+                                          name="toy_decoder.step")
 
     # -- model-multiplexing hooks (serve/multiplex.py) ---------------------
     def export_weights(self) -> Dict[str, Any]:
@@ -279,7 +285,8 @@ class ToyDecoderShard(ToyDecoder):
             self.shard_trace_count += 1  # fires once per compile
             return matmul(_pooled(tokens, lengths), self._w1_local)
 
-        self._jshard = jax.jit(_shard_step)
+        self._jshard = _dt.instrument_step(jax.jit(_shard_step),
+                                           name="toy_decoder.shard_step")
 
         def _combine(h, active):
             logits = h @ self._w2
@@ -287,7 +294,8 @@ class ToyDecoderShard(ToyDecoder):
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return jnp.where(active, nxt, self.pad_token)
 
-        self._jcombine = jax.jit(_combine)
+        self._jcombine = _dt.instrument_step(jax.jit(_combine),
+                                             name="toy_decoder.combine")
 
     # -- gang protocol -----------------------------------------------------
     def shard_step(self, tokens, lengths, active):

@@ -30,6 +30,13 @@ These rules check agreement between *places that must not drift apart*:
   in the worker's submit-path functions must forward the distributed
   trace context (a ``trace`` payload key or a spec blob); a site that
   drops it silently truncates every assembled trace at that hop.
+* ``step-instrumentation`` — engine classes exposing a compiled step
+  entry point (``step`` / ``shard_step`` / ``decode_step`` /
+  ``train_step`` / ``compute_actions``) must wrap every ``jax.jit``
+  they bind to an attribute in ``device_telemetry.instrument_step``;
+  an unwrapped jit's compiles never reach the device plane, so a
+  recompile storm in that engine is invisible to the RecompileStorm
+  alert.
 
 All checks are static (AST + text); nothing here imports runtime
 modules, so the analyzer runs in CI without booting a cluster.
@@ -50,6 +57,7 @@ from ray_tpu.tools.check.findings import Finding, parse_catalogue
 __all__ = ["ProjectConfig", "check_rpc_conformance",
            "check_failpoint_registry", "check_metric_drift",
            "check_trace_propagation", "check_persist_conformance",
+           "check_step_instrumentation",
            "collect_metric_names", "parse_catalogue", "PROJECT_RULES"]
 
 
@@ -89,6 +97,14 @@ class ProjectConfig:
     persist_calls: Tuple[str, ...] = (
         "_schedule_persist", "_persist_now", "_wal_append", "_wal_flush",
         "_wal_actor", "_wal_pg", "_wal_job")
+    #: step-instrumentation scope: classes exposing one of these
+    #: compiled step entry points must route every ``jax.jit`` they
+    #: bind to an attribute through a device-telemetry wrapper, or the
+    #: engine's compiles are invisible to the device plane
+    step_entry_points: Tuple[str, ...] = (
+        "step", "shard_step", "decode_step", "train_step",
+        "compute_actions")
+    device_wrapper_names: Tuple[str, ...] = ("instrument_step",)
 
     def read(self, rel: str) -> Optional[str]:
         try:
@@ -690,6 +706,76 @@ def check_metric_drift(contexts: List[ModuleContext],
     return findings
 
 
+# ---------------------------------------------------------------------------
+# step-instrumentation
+# ---------------------------------------------------------------------------
+
+def check_step_instrumentation(contexts: List[ModuleContext],
+                               cfg: ProjectConfig) -> List[Finding]:
+    """An engine class exposing a compiled step entry point (``step``,
+    ``shard_step``, ``decode_step``, ``train_step``,
+    ``compute_actions``) must route every ``jax.jit`` it binds to an
+    attribute through the device-telemetry wrapper
+    (``device_telemetry.instrument_step``).  An unwrapped jit is a
+    blind spot: its compiles never reach
+    ``ray_tpu_xla_compiles_total``, so a recompile storm in that engine
+    is invisible to the RecompileStorm alert."""
+    rule = "step-instrumentation"
+    findings: List[Finding] = []
+
+    def _is_jit_call(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        d = _dotted(node.func)
+        if d is None:
+            return False
+        # catch local aliases too: `from jax import jit as _jit`, pjit
+        return d.split(".")[-1].lstrip("_") in ("jit", "pjit")
+
+    def _is_wrapped(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        d = _dotted(value.func)
+        return d is not None and \
+            d.split(".")[-1] in cfg.device_wrapper_names
+
+    for ctx in contexts:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {n.name for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if not methods & set(cfg.step_entry_points):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                attr_targets = [
+                    t for t in node.targets
+                    if isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"]
+                if not attr_targets:
+                    continue
+                if _is_wrapped(node.value):
+                    continue
+                if not any(_is_jit_call(n)
+                           for n in ast.walk(node.value)):
+                    continue
+                attr = attr_targets[0].attr
+                findings.append(Finding(
+                    path=ctx.path, line=node.lineno, rule=rule,
+                    symbol=f"{cls.name}.{attr}",
+                    message=f"{cls.name} binds self.{attr} to a "
+                            f"jax.jit without device_telemetry."
+                            f"instrument_step: its compiles are "
+                            f"invisible to the device plane (wrap the "
+                            f"jit, or suppress if this callable never "
+                            f"serves a step entry point)"))
+    return findings
+
+
 #: rule name -> cross-file checker
 PROJECT_RULES = {
     "rpc-conformance": check_rpc_conformance,
@@ -697,4 +783,5 @@ PROJECT_RULES = {
     "metric-drift": check_metric_drift,
     "trace-propagation": check_trace_propagation,
     "persist-conformance": check_persist_conformance,
+    "step-instrumentation": check_step_instrumentation,
 }

@@ -13,6 +13,7 @@ import queue
 import threading
 from typing import Any, Dict, Optional
 
+from ray_tpu.core import device_telemetry as _dt
 from ray_tpu.train.checkpoint import Checkpoint
 
 _session: Optional["_TrainSession"] = None
@@ -29,12 +30,23 @@ class _TrainSession:
         self.result_queue: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        # device-plane attribution for this rank's train loop; loops
+        # opt in via session.step_monitor() step brackets (zero-step
+        # monitors stay silent: no gauges, empty device stats)
+        self.step_monitor = _dt.StepMonitor(
+            "train", name=f"train.rank{world_rank}")
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
-        self.result_queue.put({"metrics": dict(metrics),
+        row: Dict[str, Any] = {"metrics": dict(metrics),
                                "checkpoint": checkpoint,
-                               "rank": self.world_rank})
+                               "rank": self.world_rank}
+        # device stats ride as a SIBLING of metrics so result consumers
+        # comparing metrics dicts are unaffected
+        dev = self.step_monitor.stats()
+        if dev["steps"]:
+            row["device"] = dev
+        self.result_queue.put(row)
 
 
 def _set_session(session: Optional[_TrainSession]) -> None:
@@ -80,3 +92,22 @@ def get_dataset_shard(name: str = "train") -> Any:
 def get_checkpoint() -> Optional[Checkpoint]:
     session = _get_session()
     return getattr(session, "resume_checkpoint", None)
+
+
+def step_monitor() -> "_dt.StepMonitor":
+    """This rank's device-plane step monitor.  A train loop brackets
+    each step with it to light up MFU / phase attribution::
+
+        mon = session.step_monitor()
+        mon.flops_per_token = cfg.flops_per_token()
+        for batch in shard.iter_batches(...):
+            span = mon.step(data_wait_s=wait)
+            loss, state = jstep(state, batch)   # dispatch
+            span.dispatched()
+            span.device_done(loss)              # block_until_ready
+            span.done(tokens=batch_tokens)
+
+    Unbracketed loops keep working — the monitor just reports zero
+    steps and exports nothing.
+    """
+    return _get_session().step_monitor

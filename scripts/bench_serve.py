@@ -233,6 +233,17 @@ def bench(duration_s: float, workers: int, step_delay_ms: float,
         m = ray_tpu.get(table["table"]["decoder"]["replicas"][0]
                         .metrics.remote(), timeout=30)
         out["serve_batch_occupancy"] = round(m["batch_occupancy"], 3)
+        # device-plane attribution (PR 18): fraction of step wall time
+        # on-device, data-wait starvation, and the compile count — in
+        # steady state compiles stays at warmup's one-per-bucket level
+        out["serve_decode_device_frac"] = round(
+            m.get("device_frac", 0.0), 3)
+        out["serve_decode_data_wait_frac"] = round(
+            m.get("data_wait_frac", 0.0), 3)
+        out["serve_xla_compiles"] = int(m.get("compiles", 0))
+        phase = m.get("phase_s") or {}
+        out["serve_step_phase_s"] = {
+            k: round(float(v), 4) for k, v in phase.items()}
 
         # -- 2) 2x-overload goodput: shedding on vs off ----------------
         capacity = b["qps"]

@@ -135,6 +135,17 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_metrics_history_evicted_total",
     "ray_tpu_metrics_history_sample_failures_total",
     "ray_tpu_alerts_transitions_total",
+    # device plane: compile/step/skew series need a jitted engine
+    # actually stepping (serve batcher, train loop, RL inference); a
+    # quiet boot compiles nothing and runs no steps
+    "ray_tpu_xla_compiles_total",
+    "ray_tpu_xla_compile_seconds",
+    "ray_tpu_step_phase_seconds",
+    "ray_tpu_step_goodput_per_s",
+    "ray_tpu_train_mfu",
+    "ray_tpu_train_step_data_wait_frac",
+    "ray_tpu_serve_decode_device_frac",
+    "ray_tpu_gang_rank_skew_seconds",
 }
 
 

@@ -7,7 +7,11 @@ Plus the ISSUE 17 serving-economics layer: KV prefix caching (chain
 reuse, COW tail, leaf-LRU eviction, adopt-failpoint fallback, ledger
 closure), model multiplexing (mixed-model batches, LRU residency,
 typed swap failure), cross-gang slot steering, and the
-prefix-shared-pages replica-SIGKILL chaos case."""
+prefix-shared-pages replica-SIGKILL chaos case.
+
+Plus the ISSUE 18 device-plane case: the `device.step.slow_rank`
+failpoint on one shard makes the gang's skew window name the injected
+rank (replica metrics, skew gauge tags, gang trace span)."""
 
 import threading
 import time
@@ -769,6 +773,74 @@ def test_gang_chaos_shard_sigkill(sharded_cluster):
         "leaked KV pages after gang death"
     del shard_ids
     serve.delete("chaos_gang")
+
+
+@pytest.mark.failpoints
+def test_gang_straggler_failpoint_names_injected_rank(sharded_cluster):
+    """Device-plane acceptance (ISSUE 18): arm `device.step.slow_rank`
+    on ONE shard of a 2-shard gang.  Answers stay correct (the gather
+    waits for the slow rank), rank 0's skew window NAMES the injected
+    rank in the replica metrics, the published
+    ray_tpu_gang_rank_skew_seconds gauge carries it in the straggler
+    tag, and the trace plane gets a gang/straggler span."""
+    import ray_tpu.core.worker as core_worker
+    from ray_tpu._test_utils import wait_for_condition
+    from ray_tpu.experimental.state import api as state
+
+    dep = serve.deployment(
+        name="skew_gang", max_concurrent_queries=32,
+        batching=dict(BATCHING), num_shards=2)(ToyDecoderShard)
+    handle = serve.run(dep.bind())
+
+    from ray_tpu.serve._internal import CONTROLLER_NAME
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    table = ray_tpu.get(
+        controller.get_routing_table.remote(-1, 1.0), timeout=30)
+    rank0 = table["table"]["skew_gang"]["replicas"][0]
+    members = ray_tpu.get(
+        controller.get_gang_members.remote(rank0.actor_id.binary()),
+        timeout=30)
+    assert len(members) == 1           # ranks 1..N-1; here: rank 1
+    ray_tpu.get(members[0].arm_failpoint.remote(
+        "device.step.slow_rank", "delay", delay_s=0.08, count=-1),
+        timeout=30)
+
+    prompts = [make_prompt(i) for i in range(4)]
+    expect = _reference_outputs(prompts, 8)
+    for p, e in zip(prompts, expect):
+        out = handle.call({"prompt": list(p), "max_new_tokens": 8},
+                          timeout=120)
+        assert out["tokens"] == e["tokens"]  # slow, never wrong
+
+    m = ray_tpu.get(rank0.metrics.remote(), timeout=30)
+    assert m["rank_skew_s"] > 0.05, m
+    assert m["straggler_rank"] == 1, m
+    assert m["rank_step_s"][1] > m["rank_step_s"][0]
+
+    # the controller's replica poll publishes the skew gauge with the
+    # straggling rank in its tags (the GangStraggler alert's group key)
+    gw = core_worker.global_worker_or_none()
+    assert gw is not None
+
+    def skew_gauge_named():
+        recs = gw.gcs_call("get_metrics", {})
+        return any(
+            r["name"] == "ray_tpu_gang_rank_skew_seconds"
+            and r.get("tags", {}).get("deployment") == "skew_gang"
+            and r.get("tags", {}).get("straggler") == "1"
+            and r.get("value", 0) > 0.05
+            for r in recs)
+    wait_for_condition(skew_gauge_named, timeout=60)
+
+    # the annotation `ray-tpu analyze` reads: a gang-category span
+    # naming the rank (emitted once when the straggler was identified)
+    def gang_span_named():
+        spans = state.list_spans(cat="gang")
+        return any(int(s.get("args", {}).get("rank", -1)) == 1
+                   and s.get("args", {}).get("deployment") == "skew_gang"
+                   for s in spans)
+    wait_for_condition(gang_span_named, timeout=60)
+    serve.delete("skew_gang")
 
 
 @pytest.mark.failpoints

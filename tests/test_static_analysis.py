@@ -19,7 +19,7 @@ from ray_tpu.tools.check.findings import (
 from ray_tpu.tools.check.project import (
     ProjectConfig, check_failpoint_registry, check_metric_drift,
     check_persist_conformance, check_rpc_conformance,
-    check_trace_propagation,
+    check_step_instrumentation, check_trace_propagation,
 )
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -561,6 +561,66 @@ def test_persist_conformance_out_of_scope_file_skipped(fixture_project):
                 self.kv[data["key"]] = data["value"]
     """, path="other.py")]
     assert check_persist_conformance(contexts, cfg) == []
+
+
+# ---------------------------------------------------------------------------
+# step-instrumentation
+# ---------------------------------------------------------------------------
+
+def test_step_instrumentation_flags_bare_jit(fixture_project):
+    """An engine class with a step entry point binding a bare jax.jit
+    to an attribute is a device-plane blind spot — flagged, whether the
+    jit is direct, aliased, or nested inside a wrapper expression."""
+    contexts = [
+        _ctx("""
+            import jax
+            from jax import jit as _jit
+
+            class Engine:
+                def __init__(self, fn):
+                    self._step = jax.jit(fn)               # line 7
+                    self._decode = _jit(fn, donate_argnums=(0,))
+                    self._chained = functools.partial(jax.jit(fn), 1)
+
+                def decode_step(self, tokens):
+                    return self._step(tokens)
+        """, path="engine.py"),
+    ]
+    findings = check_step_instrumentation(contexts, fixture_project)
+    assert sorted(f.symbol for f in findings) == [
+        "Engine._chained", "Engine._decode", "Engine._step"]
+    assert all(f.rule == "step-instrumentation" for f in findings)
+    assert findings[0].line == 7
+
+
+def test_step_instrumentation_clean_fixtures(fixture_project):
+    """Wrapped jits conform; classes without a step entry point and
+    non-jit attribute binds are out of scope."""
+    contexts = [
+        _ctx("""
+            import jax
+            from ray_tpu.core import device_telemetry as _dt
+
+            class Engine:
+                def __init__(self, fn):
+                    self._step = _dt.instrument_step(
+                        jax.jit(fn), name="engine.step")
+                    self._wrapped = _dt.instrument_step(
+                        jax.jit(fn, donate_argnums=(0,)), name="w")
+                    self._plain = fn          # not a jit: fine
+
+                def step(self, tokens):
+                    return self._step(tokens)
+
+            class NotAnEngine:
+                def __init__(self, fn):
+                    self._fn = jax.jit(fn)    # no step entry point
+
+                def run(self, x):
+                    return self._fn(x)
+        """, path="engine.py"),
+    ]
+    assert check_step_instrumentation(contexts, fixture_project) == []
 
 
 # ---------------------------------------------------------------------------
