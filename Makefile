@@ -6,7 +6,7 @@
 #   make tsan   — ThreadSanitizer build of the concurrency stress
 #                 harness (src/store_stress.cc) + run
 #   make asan   — AddressSanitizer+UBSan build + run
-.PHONY: all native check test chaos bench bench-transfer bench-serve \
+.PHONY: all native check check-fast test chaos bench bench-transfer bench-serve \
 	bench-serve-sharded bench-rl bench-controlplane bench-store \
 	bench-ha bench-data metrics-smoke metrics-history-smoke tsan asan \
 	sanitize clean
@@ -27,8 +27,18 @@ native:
 check:
 	python -m ray_tpu.tools.check
 
-test: native check
-	python -m pytest tests/ -q
+# Pre-commit-speed variant: only git-modified modules plus their direct
+# dependents (resolved through the module graph) are scanned; the
+# summary cache makes a one-file edit sub-second.  Whole-tree
+# registries (handlers, IDEMPOTENT_METHODS, metrics golden) still come
+# from the full index, so scoping never hides cross-file findings.
+check-fast:
+	python -m ray_tpu.tools.check --changed-only
+
+# Tier-1: fast static preamble, then the suite under a wall-clock
+# budget (conftest.pytest_sessionfinish fails a green-but-slow run).
+test: native check-fast
+	RTPU_TIER1_BUDGET_S=870 python -m pytest tests/ -q
 
 # The long-running training/learning regressions that tier-1 slow-marks
 # to stay inside its time budget: full RL algorithm runs, example

@@ -191,6 +191,10 @@ class GcsServer:
         # batch-size histogram is the metrics-plane view of the same)
         self._reg_batches = 0
         self._reg_batch_actors = 0
+        # source -> (seq, replies) ack cache: a retried batch whose ack
+        # was lost re-serves the first pass's replies instead of
+        # re-running (and re-counting) the whole batch
+        self._reg_batch_acks: Dict[str, Any] = {}
         # node -> unresolved lease_worker_for_actor calls (burst spread)
         self._actor_lease_inflight: Dict[NodeID, int] = {}
         # actor_id -> NodeID charged above (held until actor_started /
@@ -198,6 +202,12 @@ class GcsServer:
         self._actor_lease_charges: Dict[ActorID, NodeID] = {}
         self._task_events: List[Dict[str, Any]] = []  # state API ring buffer
         self._tasks_finished_total = 0  # monotonic (metrics counter)
+        # per-source replay high-water marks: report_task_events and
+        # report_metrics are retried on lost acks (IDEMPOTENT_METHODS),
+        # and their folds accumulate — a replayed flush must be dropped,
+        # not re-applied (exactly-once at the fold, like the WAL dedup)
+        self._task_event_seq: Dict[str, int] = {}
+        self._metric_seq: Dict[str, int] = {}
         # ring-buffer overflow accounting (satellite: silent event loss):
         # job hex -> events evicted unread, plus burst-logging state
         self._task_event_drops: Dict[str, int] = {}
@@ -1305,6 +1315,16 @@ class GcsServer:
     # task events (state API feed; parity: TaskEventBuffer -> GCS)
     # ------------------------------------------------------------------
     async def handle_report_task_events(self, conn, data):
+        seq = data.get("seq")
+        if seq is not None:
+            # the pool re-sends this method after a timed-out ack
+            # (IDEMPOTENT_METHODS), but extend/counter folds below do
+            # NOT converge on replay — drop any batch at or below the
+            # reporting worker's high-water flush seq
+            src = data.get("source") or ""
+            if self._task_event_seq.get(src, -1) >= seq:
+                return True
+            self._task_event_seq[src] = seq
         self._task_events.extend(data["events"])
         # monotonic counter for the metrics surface: the ring buffer
         # rotates, so counting FINISHED entries in it is not a counter
@@ -1384,6 +1404,15 @@ class GcsServer:
     _GAUGE_STALE_S = 120.0
 
     async def handle_report_metrics(self, conn, data):
+        seq = data.get("seq")
+        if seq is not None:
+            # counters/histograms ACCUMULATE in _ingest_metrics, so a
+            # replayed flush (retry after a lost ack) double-counts —
+            # drop batches at or below the source's high-water seq
+            src = data.get("source") or ""
+            if self._metric_seq.get(src, -1) >= seq:
+                return True
+            self._metric_seq[src] = seq
         self._ingest_metrics(data.get("records", []))
         return True
 
@@ -1912,6 +1941,17 @@ class GcsServer:
         if _fp.active() and await _fp.afailpoint(
                 "gcs.register_actor_batch.drop"):
             return None
+        seq = data.get("seq")
+        src = data.get("source") or ""
+        if seq is not None:
+            cached = self._reg_batch_acks.get(src)
+            if cached is not None and cached[0] == seq:
+                # replayed batch (the sender retries on a lost ack):
+                # each entry is a keyed upsert already, but re-running
+                # would double-count the batch telemetry and re-spawn
+                # the scheduling task — re-serve the first pass's
+                # replies verbatim
+                return {"replies": cached[1]}
         entries = data["actors"]
         replies: List[Dict[str, Any]] = []
         to_schedule: List[ActorInfo] = []
@@ -1953,6 +1993,8 @@ class GcsServer:
         # ONE group-commit flush covers the whole batch's records: a
         # registration storm pays one fsync per batch, not per actor
         await self._wal_flush()
+        if seq is not None:
+            self._reg_batch_acks[src] = (seq, replies)
         return {"replies": replies}
 
     def _publish_actor(self, info: ActorInfo) -> None:

@@ -438,6 +438,8 @@ class Raylet:
         self._log_offsets: Dict[str, int] = {}
         self._tasks: List[asyncio.Task] = []
         self._closing = False
+        # monotonic metrics-flush seq (the GCS drops replayed flushes)
+        self._metrics_report_seq = 0
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1466,8 +1468,19 @@ class Raylet:
             return {"node_id": self.node_id.binary(),
                     "config": self.config.to_json(),
                     "profiler": self._profiler_handoff()}
+        wid = WorkerID(data["worker_id"])
+        existing = self.workers.get(wid)
+        if existing is not None and existing.conn is conn:
+            # replayed registration (the pool retries register_worker
+            # after a lost ack): the first delivery already adopted the
+            # spawn handle, decremented _starting, and pooled the
+            # worker — pooling it into _idle AGAIN would double-lease
+            # it, so just re-serve the ack
+            return {"node_id": self.node_id.binary(),
+                    "config": self.config.to_json(),
+                    "profiler": self._profiler_handoff()}
         worker = WorkerHandle(
-            worker_id=WorkerID(data["worker_id"]),
+            worker_id=wid,
             pid=data["pid"],
             job_id_bin=data.get("job_id"),
             conn=conn,
@@ -2532,8 +2545,11 @@ class Raylet:
                     spans = _tm.drain_spans(source)
                 profile = _prof.drain()
                 if records:
+                    self._metrics_report_seq += 1
                     await conn.call("report_metrics",
-                                    {"records": records}, timeout=2.0)
+                                    {"records": records, "source": source,
+                                     "seq": self._metrics_report_seq},
+                                    timeout=2.0)
                 if spans:
                     await conn.call("report_spans", {"spans": spans},
                                     timeout=2.0)
@@ -3219,12 +3235,19 @@ class Raylet:
                     fd = os.open(target, os.O_RDONLY)
                 except OSError:
                     return None
-                size = self._spilled_sizes.get(oid) or os.fstat(fd).st_size
-                serves = conn.context.setdefault("spill_serves", {})
-                stale = serves.pop(oid, None)
-                if stale is not None:  # duplicate start on this link
-                    os.close(stale[0])
-                serves[oid] = (fd, size)
+                try:
+                    size = self._spilled_sizes.get(oid) \
+                        or os.fstat(fd).st_size
+                    serves = conn.context.setdefault("spill_serves", {})
+                    stale = serves.pop(oid, None)
+                    if stale is not None:  # duplicate start on this link
+                        os.close(stale[0])
+                    serves[oid] = (fd, size)
+                except BaseException:
+                    # fstat on a truncated blob (or a bad stale fd) must
+                    # not leak the fresh fd until process exit
+                    os.close(fd)
+                    raise
                 return {"size": size, "spilled": True}
             if target is not None and await self._restore_from_spill(oid):
                 lease = self.store.lease(oid)

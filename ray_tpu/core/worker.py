@@ -333,6 +333,12 @@ class CoreWorker:
         self.task_address: Optional[rpc.Address] = None
         self._shutdown = False
         self._task_events: List[tuple] = []  # raw task-state tuples, formatted at flush
+        # monotonic flush seqs: the GCS folds these reports into
+        # accumulating tables, so a retried delivery must carry the SAME
+        # seq as its first attempt for the replay guard to drop it
+        self._task_event_report_seq = 0
+        self._metrics_report_seq = 0
+        self._reg_batch_seq = 0
         # task_id bin -> submit monotonic time (dispatch-latency metric)
         self._dispatch_ts: Dict[bytes, float] = {}
         self._lease_tpu_ids: List[int] = []
@@ -2717,6 +2723,12 @@ class CoreWorker:
 
     async def _send_actor_reg_batch(self, batch: List[tuple]) -> None:
         payloads = [p for p, _ in batch]
+        # one payload dict for the whole retry loop: every replay of
+        # this batch carries the SAME seq, so the GCS ack cache can
+        # re-serve the first pass's replies instead of re-counting
+        self._reg_batch_seq += 1
+        request = {"actors": payloads, "source": self._worker_id_hex,
+                   "seq": self._reg_batch_seq}
         reply = None
         err: Optional[BaseException] = None
         # retry budget spans a HEAD RESTART: the reconnect loop swaps
@@ -2737,8 +2749,7 @@ class CoreWorker:
                     attempt - 1, self.config))
             try:
                 reply = await self.gcs_conn.call(
-                    "register_actor_batch", {"actors": payloads},
-                    timeout=60.0)
+                    "register_actor_batch", request, timeout=60.0)
                 err = None
             except (rpc.ConnectionLost, rpc.RpcError, OSError,
                     asyncio.TimeoutError) as e:
@@ -3394,10 +3405,13 @@ class CoreWorker:
             await asyncio.sleep(1.0)
             if self._task_events and self.gcs_conn and not self.gcs_conn.closed:
                 batch, self._task_events = self._task_events, []
+                self._task_event_report_seq += 1
                 try:
                     await self.gcs_conn.call(
                         "report_task_events",
-                        {"events": self._format_task_events(batch)})
+                        {"events": self._format_task_events(batch),
+                         "source": self._worker_id_hex,
+                         "seq": self._task_event_report_seq})
                 except (rpc.ConnectionLost, rpc.RpcError):
                     pass
 
@@ -3454,8 +3468,11 @@ class CoreWorker:
                     spans = _tm.drain_spans(source)
                 profile = _prof.drain()
                 if records:
+                    self._metrics_report_seq += 1
                     await conn.call("report_metrics",
-                                    {"records": records}, timeout=2.0)
+                                    {"records": records, "source": source,
+                                     "seq": self._metrics_report_seq},
+                                    timeout=2.0)
                 if spans:
                     await conn.call("report_spans", {"spans": spans},
                                     timeout=2.0)

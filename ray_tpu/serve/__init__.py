@@ -48,6 +48,10 @@ def _get_router() -> Router:
         if _router is None or _router_core is not core:
             if _router is not None:
                 _router.stop()  # retire the stale cluster's poll thread
+            # lazy-init double-checked lock: the blocking bootstrap RPC
+            # runs at most once per cluster, and every waiter NEEDS the
+            # router it produces — serializing them is the point
+            # rtpu-check: disable=lock-order-cycle
             _router = Router(start())
             _router_core = core
         return _router

@@ -273,6 +273,13 @@ class ContinuousBatcher:
         #: requests admitted this pass, awaiting (possibly expensive)
         #: prefill + paging OUTSIDE the lock on the decode thread
         self._newly_admitted: List[Tuple[int, _Request]] = []
+        #: request_ids whose KV pages await freeing — _finish_locked
+        #: only RECORDS the release; _drain_kv_releases performs it
+        #: with the lock dropped, because page freeing reaches
+        #: ray_tpu.free (a blocking client RPC on the arena path) and
+        #: holding self._lock across that round trip would stall every
+        #: submit()/cancel() behind the network
+        self._kv_release_pending: List[str] = []
         # stats the replica exports for routing/autoscaling/tests
         self._steps = 0
         self._step_shapes: set = set()
@@ -357,6 +364,7 @@ class ContinuousBatcher:
                 self._queue.remove(req)
                 self._finish_locked(req, error=RequestCancelled(request_id))
             self._wake.notify()
+        self._drain_kv_releases()
         return True
 
     def stop(self) -> None:
@@ -370,6 +378,7 @@ class ContinuousBatcher:
                 self._finish_locked(
                     req, error=RuntimeError("replica shutting down"))
             self._queue.clear()
+        self._drain_kv_releases()
         if self._kv is not None:
             self._kv.release_all()  # belt-and-braces: zero leaked pages
 
@@ -429,8 +438,11 @@ class ContinuousBatcher:
         self._by_id.pop(req.request_id, None)
         if self._kv is not None:
             # single funnel: every completed/evicted/cancelled request
-            # frees its KV pages exactly once (the no-leak invariant)
-            self._kv.release(req.request_id)
+            # frees its KV pages exactly once (the no-leak invariant).
+            # The free itself is DEFERRED past the lock drop — it can
+            # block on ray_tpu.free — so every caller that exits
+            # self._lock after finishing requests must drain
+            self._kv_release_pending.append(req.request_id)
         if req.decode_span is not None:
             # trace-span append only — the metrics registry (its own
             # locks) is never touched under self._lock
@@ -449,6 +461,20 @@ class ContinuousBatcher:
             del self._latencies_ms[:-512]
         self._completed += 1
         req.future.set_result(value)
+
+    def _drain_kv_releases(self) -> None:
+        """Free KV pages recorded by ``_finish_locked`` — called with
+        ``self._lock`` RELEASED (the free path can issue a blocking
+        ``ray_tpu.free``).  Draining promptly after the finishing lock
+        section keeps the page budget honest for the next admission
+        boundary."""
+        if self._kv is None:
+            return
+        with self._lock:
+            pending, self._kv_release_pending = \
+                self._kv_release_pending, []
+        for rid in pending:
+            self._kv.release(rid)
 
     def _admit_locked(self, now: float) -> None:
         """Step boundary: free finished/cancelled/expired slots already
@@ -595,6 +621,7 @@ class ContinuousBatcher:
             with self._lock:
                 if self._slots[req.slot] is req:
                     self._release_slot_locked(req.slot, error=e)
+            self._drain_kv_releases()
 
     def _run(self) -> None:
         import numpy as np
@@ -604,16 +631,24 @@ class ContinuousBatcher:
         eos = getattr(self._engine, "eos_token", None)
         while True:
             with self._lock:
-                if self._stop:
+                stopping = self._stop
+                if stopping:
                     for i in range(B):
                         if self._slots[i] is not None:
                             self._release_slot_locked(
                                 i, error=RuntimeError(
                                     "replica shutting down"))
-                    return
-                now = time.monotonic()
-                self._evict_locked(now)
-                self._admit_locked(now)
+                else:
+                    self._evict_locked(time.monotonic())
+            # page frees from evictions run with the lock RELEASED
+            # (they can reach a blocking ray_tpu.free); draining
+            # between evict and admit keeps the freed budget visible
+            # to THIS boundary's admissions
+            self._drain_kv_releases()
+            if stopping:
+                return
+            with self._lock:
+                self._admit_locked(time.monotonic())
                 admitted = self._newly_admitted
                 self._newly_admitted = []
                 if self._active == 0:
@@ -673,6 +708,7 @@ class ContinuousBatcher:
                     for i, _ in batch:
                         if self._slots[i] is not None:
                             self._release_slot_locked(i, error=e)
+                self._drain_kv_releases()
                 continue
             # host dispatch ended when step() returned; device compute
             # ends when the result is materialized (block_until_ready)
@@ -720,6 +756,7 @@ class ContinuousBatcher:
                             self._release_slot_locked(i, error=e)
                             continue
                         self._release_slot_locked(i, value=value)
+            self._drain_kv_releases()
             for ttft in ttfts:
                 _tm.serve_ttft_observed(self._deployment, ttft)
             if kv_appends:

@@ -115,6 +115,9 @@ class HTTPProxy:
         # hop through the executor on first touch)
         from ray_tpu import serve
         with self._router_lock:
+            # same lazy-init shape as serve._get_router: the one-time
+            # bootstrap RPC is exactly what the waiters are waiting for
+            # rtpu-check: disable=lock-order-cycle
             return serve._get_router()
 
     # -- connection handling ----------------------------------------------

@@ -104,6 +104,10 @@ class MultiplexEngine:
                 raise ModelSwapFailed(self._deployment, model) from e
             t0 = time.perf_counter()
             try:
+                # model swaps are deliberately serialized under _lock:
+                # a concurrent second swap of the same (or an LRU-racy
+                # other) model would double-load weights over the arena
+                # rtpu-check: disable=lock-order-cycle
                 eng = self._swap_in_locked(model)
             except ModelSwapFailed:
                 raise

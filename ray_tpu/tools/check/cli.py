@@ -6,26 +6,42 @@ Usage::
     python -m ray_tpu.tools.check --list-rules
     python -m ray_tpu.tools.check --select async-blocking,metric-drift
     python -m ray_tpu.tools.check --update-baseline
+    python -m ray_tpu.tools.check --changed-only  # pre-commit speed
+    python -m ray_tpu.tools.check --json          # machine-readable
 
 Exit status: 0 clean (every finding suppressed inline or baselined),
 1 when new findings exist, 2 on usage/internal error.  Findings print
 as ``file:line rule message`` so CI output is click-through-able.
+
+The interprocedural rules (and the whole-tree registries the older
+cross-file rules consult) run off per-module summaries cached under
+``build/rtpu-check-summaries.json``, keyed by file content hash — a
+warm run re-summarizes only edited modules.  ``--changed-only``
+narrows the *scan scope* to git-changed files plus their direct
+importers; the registries still see the whole tree through the cache,
+so a scoped run reports the same truths as a full one, just only for
+the files you touched.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import subprocess
 import sys
-from typing import Iterable, List, Optional
+from typing import Iterable, List, Optional, Set
 
 from ray_tpu.tools.check.astrules import ASYNC_RULES, ModuleContext, \
     parse_module
 from ray_tpu.tools.check.findings import Finding, Suppressions, \
     load_baseline, merge_baseline, split_new_findings
+from ray_tpu.tools.check.ipa import SummaryCache, default_cache_path, \
+    index_for
+from ray_tpu.tools.check.iparules import IPA_RULES
 from ray_tpu.tools.check.project import PROJECT_RULES, ProjectConfig
 
-ALL_RULES = {**ASYNC_RULES, **PROJECT_RULES}
+ALL_RULES = {**ASYNC_RULES, **PROJECT_RULES, **IPA_RULES}
 
 #: default baseline location (checked in; starts empty)
 BASELINE_REL = os.path.join("ray_tpu", "tools", "check", "baseline.txt")
@@ -90,7 +106,7 @@ def run_rules(contexts: List[ModuleContext], cfg: ProjectConfig,
         if name in selected:
             for ctx in contexts:
                 findings.extend(rule(ctx))
-    for name, rule in PROJECT_RULES.items():
+    for name, rule in {**PROJECT_RULES, **IPA_RULES}.items():
         if name in selected:
             findings.extend(rule(contexts, cfg))
     by_path = {ctx.path: ctx.suppressions for ctx in contexts}
@@ -108,6 +124,26 @@ def run_rules(contexts: List[ModuleContext], cfg: ProjectConfig,
             if not suppressions_for(f.path).covers(f.line, f.rule)]
     kept.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
     return kept
+
+
+def changed_files(root: str) -> List[str]:
+    """Repo-relative ``ray_tpu/**.py`` paths touched in the working
+    tree (unstaged + staged + untracked), for ``--changed-only``."""
+    out: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode != 0:
+            continue
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("ray_tpu/") and line.endswith(".py"):
+                out.add(line)
+    return sorted(out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -128,27 +164,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="update the baseline from current findings "
                          "(out-of-scope entries and '# why' comments "
                          "are preserved)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON document on stdout")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scan only git-changed files plus their "
+                         "direct importers (pre-commit mode)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the summary cache")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for name in sorted(ALL_RULES):
-            kind = "per-file" if name in ASYNC_RULES else "cross-file"
+            kind = "per-file" if name in ASYNC_RULES else (
+                "interprocedural" if name in IPA_RULES else "cross-file")
             print(f"{name:24s} [{kind}]")
         return 0
 
     root = os.path.abspath(args.root or _repo_root())
-    paths = args.paths or [os.path.join(root, "ray_tpu")]
     baseline_path = args.baseline or os.path.join(root, BASELINE_REL)
     select = ([r.strip() for r in args.select.split(",") if r.strip()]
               if args.select else None)
+    cache = None if args.no_cache else SummaryCache(
+        default_cache_path(root))
+    cfg = ProjectConfig(root=root)
     try:
+        # one index per run: the interprocedural rules, the whole-tree
+        # registries, and --changed-only dependent resolution all read
+        # from it (warm modules come straight from the summary cache)
+        index = index_for([], cfg, cache=cache)
+        if args.changed_only:
+            changed = [p for p in changed_files(root)
+                       if os.path.isfile(os.path.join(root, p))]
+            scope = set(changed) | index.dependents(changed)
+            paths = sorted(os.path.join(root, p) for p in scope
+                           if os.path.isfile(os.path.join(root, p)))
+            if not paths:
+                if cache is not None:
+                    cache.save()
+                if args.as_json:
+                    print(json.dumps({"findings": [], "files": 0,
+                                      "baselined": 0}))
+                else:
+                    print("rtpu-check: clean (0 changed files)")
+                return 0
+        else:
+            paths = args.paths or [os.path.join(root, "ray_tpu")]
         files = discover_files(paths)
         contexts = parse_files(files, root)
-        findings = run_rules(contexts, ProjectConfig(root=root), select)
+        findings = run_rules(contexts, cfg, select)
     except (FileNotFoundError, SyntaxError, ValueError) as e:
         print(f"rtpu-check: error: {e}", file=sys.stderr)
         return 2
+    finally:
+        if cache is not None:
+            cache.save()
 
     if args.update_baseline:
         content = merge_baseline(
@@ -164,9 +234,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     baseline = set() if args.no_baseline else load_baseline(baseline_path)
     new, baselined = split_new_findings(findings, baseline)
+    n_files = len(files)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [{"path": f.path, "line": f.line,
+                          "rule": f.rule, "symbol": f.symbol,
+                          "message": f.message, "key": f.key}
+                         for f in new],
+            "files": n_files, "baselined": len(baselined)},
+            indent=2, sort_keys=True))
+        return 1 if new else 0
     for f in new:
         print(f.render())
-    n_files = len(files)
     if new:
         print(f"rtpu-check: {len(new)} finding(s) in {n_files} file(s)"
               + (f" (+{len(baselined)} baselined)" if baselined else ""),

@@ -1,0 +1,534 @@
+"""Interprocedural rules: lock-order-cycle, resource-lifecycle,
+retry-safety.
+
+These consume the :mod:`ray_tpu.tools.check.ipa` project index (module
+graph + call graph + per-function summaries) instead of single-file
+ASTs.  Each shares the ``rule(contexts, cfg)`` signature of the other
+cross-file rules so the CLI, the baseline machinery, and inline
+suppressions all work unchanged; the index itself is built once per
+run (and per test fixture) and memoized on the config object.
+
+Findings that involve a call path print a **witness chain** —
+``a.py:Cls.meth:12 -> b.py:helper:40 -> c.py:target:7`` — so the
+report shows *how* the analyzer got from the lock/retry site to the
+hazard, not just that it exists.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.tools.check.astrules import ModuleContext
+from ray_tpu.tools.check.findings import Finding
+from ray_tpu.tools.check.ipa import FuncSummary, ProjectIndex, \
+    RESOURCE_SPECS, index_for
+from ray_tpu.tools.check.project import ProjectConfig, _collect_idempotent
+
+__all__ = ["IPA_RULES", "check_lock_order",
+           "check_resource_lifecycle", "check_retry_safety"]
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+#: RPC call-site kinds that block the calling thread until the remote
+#: side replies (``start_call`` returns a pending handle — not blocking)
+_BLOCKING_RPC_KINDS = {"call", "retry", "client"}
+
+
+def _chain_str(idx: ProjectIndex,
+               chain: Optional[List[Tuple[str, int]]]) -> str:
+    return idx.render_chain(chain) if chain else "<direct>"
+
+
+def check_lock_order(contexts: List[ModuleContext],
+                     cfg: ProjectConfig) -> List[Finding]:
+    """Global lock-acquisition order + blocking-RPC-under-lock.
+
+    An edge A -> B means some thread holds A while acquiring B (either
+    in one function, or because a function holding A calls — possibly
+    through several hops — a function that acquires B).  A cycle in
+    that graph is a deadlock waiting for the right interleaving; a
+    plain (non-reentrant) Lock reached again while already held is a
+    self-deadlock with no interleaving needed at all.  Separately, any
+    blocking RPC issued while a threading lock is held serializes every
+    other thread touching that lock behind a network round trip — the
+    exact stall shape PR 18's straggler detector keeps attributing to
+    "slow peers" that are really a lock convoy."""
+    rule = "lock-order-cycle"
+    findings: List[Finding] = []
+    idx = index_for(contexts, cfg)
+    trans_locks = idx.transitive_locks()
+    trans_rpc = idx.transitive_rpcs()
+
+    #: (A, B) -> (anchor path, anchor line, witness string)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    reported_reacquire: Set[Tuple[str, str]] = set()
+    reported_rpc: Set[Tuple[str, str, str]] = set()
+
+    def want_lock(lock: str):
+        def w(fid: str) -> Optional[int]:
+            fs = idx.functions[fid]
+            path = fid.partition("::")[0]
+            for ref, line, _held in fs.acquires:
+                if idx.lock_id(path, ref) == lock:
+                    return line
+            return None
+        return w
+
+    def want_client(mark: str):
+        def w(fid: str) -> Optional[int]:
+            for m, kind, line, _held, _idem in idx.functions[fid].rpcs:
+                if kind == "client" and m == mark:
+                    return line
+            return None
+        return w
+
+    for fid, fs in sorted(idx.functions.items()):
+        path = fid.partition("::")[0]
+
+        # intra-function edges + plain-Lock reacquisition
+        for ref, line, held in fs.acquires:
+            b = idx.lock_id(path, ref)
+            for href in held:
+                a = idx.lock_id(path, href)
+                if a == b:
+                    if idx.lock_kind(a) == "lock" \
+                            and (path, a) not in reported_reacquire:
+                        reported_reacquire.add((path, a))
+                        findings.append(Finding(
+                            path=path, line=line, rule=rule,
+                            symbol=f"reacquire.{fs.qual}",
+                            message=f"{fs.qual} acquires non-reentrant "
+                                    f"lock {a} while already holding it"
+                                    f": self-deadlock (witness: "
+                                    f"{path}:{fs.qual}:{line})"))
+                    continue
+                edges.setdefault((a, b), (
+                    path, line,
+                    f"{path}:{fs.qual}:{line}"))
+
+        # interprocedural edges: holding A at a call site whose callee
+        # transitively acquires B
+        for kind, x, y, line, held in fs.calls:
+            if not held:
+                continue
+            callee = idx.resolve_call(path, fs, kind, x, y)
+            if callee is None:
+                continue
+            callee_locks = trans_locks.get(callee, set())
+            if not callee_locks:
+                pass
+            for b in sorted(callee_locks):
+                for href in held:
+                    a = idx.lock_id(path, href)
+                    if a == b:
+                        if idx.lock_kind(a) == "lock" \
+                                and (path, a) not in reported_reacquire:
+                            chain = idx.find_chain(callee, want_lock(a))
+                            reported_reacquire.add((path, a))
+                            findings.append(Finding(
+                                path=path, line=line, rule=rule,
+                                symbol=f"reacquire.{fs.qual}",
+                                message=f"{fs.qual} holds non-reentrant "
+                                        f"lock {a} across a call that "
+                                        f"re-acquires it: self-deadlock "
+                                        f"(witness: {path}:{fs.qual}:"
+                                        f"{line} -> "
+                                        f"{_chain_str(idx, chain)})"))
+                        continue
+                    if (a, b) in edges:
+                        continue
+                    chain = idx.find_chain(callee, want_lock(b))
+                    edges[(a, b)] = (
+                        path, line,
+                        f"{path}:{fs.qual}:{line} -> "
+                        f"{_chain_str(idx, chain)}")
+
+        # blocking RPC while a threading lock is held — direct sites.
+        # Async functions are out of scope here: an awaited RPC parks
+        # the coroutine, and holding a threading lock across any await
+        # is already the per-file await-under-lock rule's finding.
+        if not fs.is_async:
+            for method, rkind, line, held, _idem in fs.rpcs:
+                if not held or rkind not in _BLOCKING_RPC_KINDS:
+                    continue
+                a = idx.lock_id(path, held[-1])
+                key = (path, fs.qual, method)
+                if key in reported_rpc:
+                    continue
+                reported_rpc.add(key)
+                findings.append(Finding(
+                    path=path, line=line, rule=rule,
+                    symbol=f"rpc-under-lock.{fs.qual}.{method}",
+                    message=f"{fs.qual} issues blocking RPC "
+                            f"{method!r} while holding {a}: every "
+                            f"thread touching that lock stalls behind "
+                            f"the network round trip (witness: "
+                            f"{path}:{fs.qual}:{line})"))
+
+        # ... and call sites under lock whose sync callees reach a
+        # blocking client entry point (ray_tpu.get/put/free/wait)
+        for kind, x, y, line, held in fs.calls:
+            if not held or fs.is_async:
+                continue
+            callee = idx.resolve_call(path, fs, kind, x, y)
+            if callee is None or idx.functions[callee].is_async:
+                continue
+            for mark in sorted(trans_rpc.get(callee, set())):
+                a = idx.lock_id(path, held[-1])
+                key = (path, fs.qual, mark)
+                if key in reported_rpc:
+                    continue
+                reported_rpc.add(key)
+                chain = idx.find_chain(callee, want_client(mark),
+                                       sync_only=True)
+                findings.append(Finding(
+                    path=path, line=line, rule=rule,
+                    symbol=f"rpc-under-lock.{fs.qual}.{mark}",
+                    message=f"{fs.qual} holds {a} across a call that "
+                            f"reaches blocking client RPC {mark}: "
+                            f"every thread touching that lock stalls "
+                            f"behind the round trip (witness: "
+                            f"{path}:{fs.qual}:{line} -> "
+                            f"{_chain_str(idx, chain)})"))
+
+    # cycle detection over the order graph (self-edges excluded above)
+    findings.extend(_lock_cycles(idx, edges))
+    return findings
+
+
+def _lock_cycles(idx: ProjectIndex,
+                 edges: Dict[Tuple[str, str], Tuple[str, int, str]]
+                 ) -> List[Finding]:
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    for succs in graph.values():
+        succs.sort()
+
+    sccs = _tarjan(graph)
+    findings: List[Finding] = []
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        members = sorted(scc)
+        # one shortest witness cycle through the smallest lock id
+        cycle = _cycle_through(graph, set(scc), members[0])
+        if cycle is None:  # pragma: no cover - SCC guarantees a cycle
+            continue
+        parts = []
+        anchor = None
+        for i in range(len(cycle) - 1):
+            a, b = cycle[i], cycle[i + 1]
+            path, line, witness = edges[(a, b)]
+            if anchor is None:
+                anchor = (path, line)
+            parts.append(f"[{a} -> {b}] {witness}")
+        order = " -> ".join(cycle)
+        findings.append(Finding(
+            path=anchor[0], line=anchor[1], rule="lock-order-cycle",
+            symbol="cycle." + "|".join(members),
+            message=f"lock-order cycle {order}: two threads taking "
+                    f"these locks in opposite orders deadlock; "
+                    f"witness chains: " + "; ".join(parts)))
+    return findings
+
+
+def _tarjan(graph: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC (the lock graph is small, but recursion
+    limits are not a failure mode an analyzer should have)."""
+    index_of: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(graph):
+        if root in index_of:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index_of[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recurse = False
+            succs = graph.get(node, [])
+            for i in range(pi, len(succs)):
+                succ = succs[i]
+                if succ not in index_of:
+                    work[-1] = (node, i + 1)
+                    work.append((succ, 0))
+                    recurse = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index_of[succ])
+            if recurse:
+                continue
+            if low[node] == index_of[node]:
+                scc: List[str] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return sccs
+
+
+def _cycle_through(graph: Dict[str, List[str]], scc: Set[str],
+                   start: str) -> Optional[List[str]]:
+    """Shortest cycle from ``start`` back to itself inside ``scc``."""
+    parents: Dict[str, str] = {}
+    queue = [start]
+    seen = {start}
+    while queue:
+        cur = queue.pop(0)
+        for succ in graph.get(cur, []):
+            if succ not in scc:
+                continue
+            if succ == start:
+                # parent chain runs cur -> ... -> start; walking it up
+                # and reversing yields start -> ... -> cur, then close
+                # the loop with the succ edge back to start
+                path = [cur]
+                node = cur
+                while node in parents:
+                    node = parents[node]
+                    path.append(node)
+                path.reverse()
+                return path + [start] if path[0] == start else None
+            if succ not in seen:
+                seen.add(succ)
+                parents[succ] = cur
+                queue.append(succ)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# resource-lifecycle
+# ---------------------------------------------------------------------------
+
+_LEAK_KIND_MSG = {
+    "exit": "is not released on every exit path",
+    "exception": "leaks if this raises (no try/finally protects it)",
+    "unassigned": "is acquired but never bound — nothing can release it",
+}
+
+
+def check_resource_lifecycle(contexts: List[ModuleContext],
+                             cfg: ProjectConfig) -> List[Finding]:
+    """Every acquisition in the resource-spec table (arena pins,
+    spill/restore fds, KV page reservations, armed failpoints) must
+    reach its release — or a recognized ownership escape (returned,
+    stored into a table, handed to an owning call) — on all paths,
+    including the exception edge for the strict pairs.  The leak sites
+    themselves were computed path-sensitively at summarize time; this
+    rule renders them with the spec's consequence text."""
+    rule = "resource-lifecycle"
+    findings: List[Finding] = []
+    idx = index_for(contexts, cfg)
+    specs = {s.name: s for s in RESOURCE_SPECS}
+    for path in sorted(idx.modules):
+        ms = idx.modules[path]
+        for qual in sorted(ms.functions):
+            fs = ms.functions[qual]
+            leaks = fs.res_leaks
+            if not leaks:
+                continue
+            has_release = _has_release_site(fs)
+            for spec_name, token, acq_line, leak_line, kind in leaks:
+                spec = specs.get(spec_name)
+                if spec is None:
+                    continue
+                if spec.paired_only and not has_release:
+                    continue
+                detail = _LEAK_KIND_MSG.get(kind, kind)
+                findings.append(Finding(
+                    path=path, line=acq_line, rule=rule,
+                    symbol=f"{spec_name}.{qual}.{token}",
+                    message=f"{qual} acquires {spec_name} {token!r} "
+                            f"(line {acq_line}) that {detail} "
+                            f"(leak edge at line {leak_line}): "
+                            f"{spec.hint}"))
+    return findings
+
+
+def _has_release_site(fs: FuncSummary) -> bool:
+    """Whether the function body mentions any release call at all —
+    the gate for ``paired_only`` specs (arm-only helpers are fine;
+    arm-then-forget-to-disarm-on-error is the bug)."""
+    for kind, x, y, _line, _held in fs.calls:
+        tail = y or (x.split(".")[-1] if kind == "dotted" else x)
+        if tail in ("disarm", "disarm_all", "reload_env"):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# retry-safety
+# ---------------------------------------------------------------------------
+
+def _retried_call_sites(idx: ProjectIndex
+                        ) -> List[Tuple[str, str, str, int]]:
+    """(method, path, qual, line) for every call site on a retrying
+    path: literal ``call_with_retry`` sites, ``idempotent=True`` call
+    sites, and literal-method calls through a wrapper that forwards its
+    method parameter into ``call_with_retry``."""
+    sites: List[Tuple[str, str, str, int]] = []
+    for fid, fs in sorted(idx.functions.items()):
+        path = fid.partition("::")[0]
+        for method, kind, line, _held, idem in fs.rpcs:
+            if kind == "retry" or idem == "true":
+                sites.append((method, path, fs.qual, line))
+        for kind, x, y, line, _held in fs.calls:
+            callee = idx.resolve_call(path, fs, kind, x, y)
+            if callee is None:
+                continue
+            cs = idx.functions[callee]
+            if cs.retry_forward_param < 0:
+                continue
+            arg_index = cs.retry_forward_param - (1 if cs.cls else 0)
+            for item in fs.call_lit_args.get(str(line), ()):
+                i, _, value = item.partition(":")
+                if i == str(arg_index):
+                    sites.append((value, path, fs.qual, line))
+                    break
+    return sites
+
+
+def _handler_closure(idx: ProjectIndex, root_fid: str,
+                     skip_names: Set[str] = frozenset()) -> List[str]:
+    """``root_fid`` plus same-module functions reachable from it — the
+    scope in which a handler's mutations live.  ``skip_names`` prunes
+    the persist funnel (``_wal_append`` and friends): the WAL is an
+    append-only operation log shared by every handler, and its replay
+    semantics are the handler's own — charging its internal appends to
+    each caller would flag every persisting handler, idempotent or
+    not."""
+    mod = root_fid.partition("::")[0]
+    out = [root_fid]
+    seen = {root_fid}
+    queue = [root_fid]
+    while queue:
+        cur = queue.pop(0)
+        for callee, _line in idx.callees(cur):
+            if callee in seen or callee.partition("::")[0] != mod:
+                continue
+            if idx.functions[callee].name in skip_names:
+                continue
+            seen.add(callee)
+            out.append(callee)
+            queue.append(callee)
+    return out
+
+
+def check_retry_safety(contexts: List[ModuleContext],
+                       cfg: ProjectConfig) -> List[Finding]:
+    """Retry-after-send discipline, both directions.
+
+    Outbound: a method reached via a retrying call path
+    (``call_with_retry`` / ``idempotent=True`` / a retry-forwarding
+    wrapper) must be in ``IDEMPOTENT_METHODS`` — or its ``handle_*``
+    must not mutate persisted GCS tables, because a retried delivery
+    replays the mutation after a head restart recovers the first copy.
+
+    Inbound: every ``IDEMPOTENT_METHODS`` entry licenses the pool to
+    re-send after a timeout, so its handler must *converge* on replay:
+    keyed upsert / replay guard, not blind append/extend/increment.
+    The guard shape the rule recognizes is a keyed early exit —
+    ``if self.<seen-state> ... <compare>: return`` — before the
+    mutation."""
+    rule = "retry-safety"
+    findings: List[Finding] = []
+    idx = index_for(contexts, cfg)
+    idempotent, idem_line = _collect_idempotent(cfg)
+    handlers = idx.all_handlers()
+    tables = set(cfg.persist_tables)
+
+    # outbound: retried but neither idempotent nor mutation-free
+    seen_out: Set[Tuple[str, int, str]] = set()
+    for method, path, qual, line in _retried_call_sites(idx):
+        if method.startswith("_") or method in idempotent:
+            continue
+        for hpath, hqual, _hline in handlers.get(method, ()):
+            if hpath != cfg.persist_service_file:
+                continue  # persisted tables live in the GCS service
+            root = f"{hpath}::{hqual}"
+            mutated: Set[str] = set()
+            for fid in _handler_closure(idx, root,
+                                        set(cfg.persist_calls)):
+                mutated |= idx.functions[fid].writes_attrs & tables
+            if not mutated:
+                continue
+            key = (path, line, method)
+            if key in seen_out:
+                continue
+            seen_out.add(key)
+            findings.append(Finding(
+                path=path, line=line, rule=rule,
+                symbol=f"retry.{qual}.{method}",
+                message=f"{qual} retries {method!r} (witness: "
+                        f"{path}:{qual}:{line}) but it is not in "
+                        f"IDEMPOTENT_METHODS and handle_{method} "
+                        f"mutates persisted table(s) "
+                        f"{', '.join(sorted(mutated))}: a replayed "
+                        f"delivery double-applies the mutation"))
+
+    # inbound: IDEMPOTENT entries whose handlers do not converge
+    seen_conv: Set[Tuple[str, int]] = set()
+    for method in sorted(idempotent):
+        for hpath, hqual, hline in handlers.get(method, ()):
+            root = f"{hpath}::{hqual}"
+            root_fs = idx.functions.get(root)
+            if root_fs is None:
+                continue
+            if root_fs.has_replay_guard:
+                continue
+            for fid in _handler_closure(idx, root,
+                                        set(cfg.persist_calls)):
+                fs = idx.functions[fid]
+                if fs.has_replay_guard:
+                    continue
+                for attr, op, line in fs.blind_ops:
+                    key = (fid.partition("::")[0], line)
+                    if key in seen_conv:
+                        continue
+                    seen_conv.add(key)
+                    chain = idx.find_chain(
+                        root, lambda f, want=fid: line if f == want
+                        else None)
+                    opname = "+=" if op == "aug" else f".{op}()"
+                    findings.append(Finding(
+                        path=hpath, line=line if fid == root else hline,
+                        rule=rule,
+                        symbol=f"converge.{method}.{attr}",
+                        message=f"IDEMPOTENT_METHODS (rpc.py:"
+                                f"{idem_line}) lists {method!r}, so "
+                                f"the pool re-sends it after timeouts "
+                                f"— but handle_{method} blind-applies "
+                                f"self.{attr}{opname} (witness: "
+                                f"{_chain_str(idx, chain)}): a "
+                                f"replayed delivery double-counts; "
+                                f"add a keyed replay guard "
+                                f"(per-source seq) or a keyed upsert"))
+    return findings
+
+
+#: rule name -> checker, merged into the cross-file rule table
+IPA_RULES = {
+    "lock-order-cycle": check_lock_order,
+    "resource-lifecycle": check_resource_lifecycle,
+    "retry-safety": check_retry_safety,
+}

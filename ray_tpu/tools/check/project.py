@@ -50,8 +50,7 @@ import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
-from ray_tpu.tools.check.astrules import ModuleContext, _dotted, \
-    parse_module
+from ray_tpu.tools.check.astrules import ModuleContext, _dotted
 from ray_tpu.tools.check.findings import Finding, parse_catalogue
 
 __all__ = ["ProjectConfig", "check_rpc_conformance",
@@ -105,6 +104,10 @@ class ProjectConfig:
         "step", "shard_step", "decode_step", "train_step",
         "compute_actions")
     device_wrapper_names: Tuple[str, ...] = ("instrument_step",)
+    #: memoized ProjectIndex for this run — set lazily by
+    #: ``ipa.index_for`` (the CLI pre-populates it with the disk-cached
+    #: index so rules and registries share one build)
+    ipa_index: Optional[object] = None
 
     def read(self, rel: str) -> Optional[str]:
         try:
@@ -160,12 +163,24 @@ def _collect_idempotent(cfg: ProjectConfig) -> Tuple[Set[str], int]:
     return set(), 0
 
 
+def _walked(ctx: ModuleContext) -> List[ast.AST]:
+    """Every AST node of the module, walked once and cached on the
+    context: eight cross-file collectors each iterate every node of
+    every module, and re-walking ~200 trees per collector dominated
+    the warm-cache runtime."""
+    nodes = ctx.__dict__.get("_walked_nodes")
+    if nodes is None:
+        nodes = list(ast.walk(ctx.tree))
+        ctx.__dict__["_walked_nodes"] = nodes
+    return nodes
+
+
 def _collect_handlers(
         contexts: List[ModuleContext]
 ) -> Dict[str, List[Tuple[str, int]]]:
     handlers: Dict[str, List[Tuple[str, int]]] = {}
     for ctx in contexts:
-        for node in ast.walk(ctx.tree):
+        for node in _walked(ctx):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node.name.startswith("handle_"):
                 handlers.setdefault(node.name[len("handle_"):], []).append(
@@ -181,7 +196,7 @@ def _collect_client_calls(
     ``conn.start_call("m", ...)``, ``call_with_retry(get_conn, "m")``."""
     calls: List[Tuple[str, str, int]] = []
     for ctx in contexts:
-        for node in ast.walk(ctx.tree):
+        for node in _walked(ctx):
             if not isinstance(node, ast.Call):
                 continue
             method: Optional[str] = None
@@ -198,35 +213,6 @@ def _collect_client_calls(
     return calls
 
 
-def _tree_contexts(contexts: List[ModuleContext],
-                   cfg: ProjectConfig) -> List[ModuleContext]:
-    """``contexts`` plus a parse of every ``ray_tpu/`` module the scan
-    scope left out.  The handler registry must reflect the whole tree
-    even on a path-restricted run — otherwise scanning one file floods
-    false "no service defines handle_X" findings (and could poison the
-    baseline via ``--update-baseline``)."""
-    seen = {ctx.path for ctx in contexts}
-    extra: List[ModuleContext] = []
-    pkg = os.path.join(cfg.root, "ray_tpu")
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = sorted(d for d in dirnames
-                             if d != "__pycache__"
-                             and not d.startswith("."))
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            full = os.path.join(dirpath, fn)
-            rel = os.path.relpath(full, cfg.root).replace(os.sep, "/")
-            if rel in seen:
-                continue
-            try:
-                with open(full, encoding="utf-8") as f:
-                    extra.append(parse_module(rel, f.read()))
-            except (OSError, SyntaxError):
-                continue
-    return contexts + extra
-
-
 def check_rpc_conformance(contexts: List[ModuleContext],
                           cfg: ProjectConfig) -> List[Finding]:
     rule = "rpc-conformance"
@@ -234,8 +220,14 @@ def check_rpc_conformance(contexts: List[ModuleContext],
     schemas = _collect_schemas(cfg)
     idempotent, idem_line = _collect_idempotent(cfg)
     # registry questions ("does a handler exist?") consult the whole
-    # tree; findings are only emitted for the scanned contexts
-    handlers_all = _collect_handlers(_tree_contexts(contexts, cfg))
+    # tree — via the summary index, which serves unchanged modules from
+    # the on-disk cache instead of re-parsing them — while findings are
+    # only emitted for the scanned contexts.  Without the whole-tree
+    # view, scanning one file floods false "no service defines
+    # handle_X" findings (and could poison the baseline via
+    # ``--update-baseline``).
+    from ray_tpu.tools.check.ipa import index_for
+    handlers_all = index_for(contexts, cfg).all_handlers()
     handlers = _collect_handlers(contexts)
     core_files = set(cfg.core_service_files)
 
@@ -308,7 +300,7 @@ def check_failpoint_registry(contexts: List[ModuleContext],
     documented = set(re.findall(r"`([^`\n]+)`", doc))
     sites: Dict[str, List[Tuple[str, int]]] = {}
     for ctx in contexts:
-        for node in ast.walk(ctx.tree):
+        for node in _walked(ctx):
             if not isinstance(node, ast.Call):
                 continue
             d = _dotted(node.func)
@@ -398,7 +390,7 @@ def check_trace_propagation(contexts: List[ModuleContext],
         is_worker = ctx.path == cfg.trace_worker_file
         if not in_serve and not is_worker:
             continue
-        for fnode in ast.walk(ctx.tree):
+        for fnode in _walked(ctx):
             if not isinstance(fnode, (ast.FunctionDef,
                                       ast.AsyncFunctionDef)):
                 continue
@@ -542,7 +534,7 @@ def check_persist_conformance(contexts: List[ModuleContext],
     persist_calls = set(cfg.persist_calls)
     facts: Dict[str, _PersistVisitor] = {}
     lines: Dict[str, int] = {}
-    for node in ast.walk(ctx.tree):
+    for node in _walked(ctx):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             v = _PersistVisitor(tables, persist_calls)
             for stmt in node.body:
@@ -601,7 +593,7 @@ def collect_metric_names(
     catalogue is exactly the set of names the code constructs."""
     names: Dict[str, List[Tuple[str, int]]] = {}
     for ctx in contexts:
-        for node in ast.walk(ctx.tree):
+        for node in _walked(ctx):
             if not isinstance(node, ast.Call):
                 continue
             d = _dotted(node.func)
@@ -636,7 +628,7 @@ def _collect_rule_series_refs(
     refs: List[Tuple[str, str, str, int]] = []
     defined: Set[str] = set()
     for ctx in contexts:
-        for node in ast.walk(ctx.tree):
+        for node in _walked(ctx):
             if not isinstance(node, ast.Call):
                 continue
             d = _dotted(node.func)
@@ -683,10 +675,10 @@ def check_metric_drift(contexts: List[ModuleContext],
            for _kwarg, series, _path, _line in refs):
         # a derived-signal ref the scanned files don't define: resolve
         # against the whole tree before flagging (path-restricted runs
-        # must not flood false unknown-signal findings) — the reparse
-        # is skipped entirely when every ref resolves locally
-        _, defined_all = _collect_rule_series_refs(
-            _tree_contexts(contexts, cfg))
+        # must not flood false unknown-signal findings) — the index
+        # serves this from cached summaries, no reparse
+        from ray_tpu.tools.check.ipa import index_for
+        defined_all = defined_all | index_for(contexts, cfg).all_signals()
     for kwarg, series, path, line in refs:
         if series.startswith("ray_tpu_"):
             if series not in golden:
@@ -740,7 +732,7 @@ def check_step_instrumentation(contexts: List[ModuleContext],
             d.split(".")[-1] in cfg.device_wrapper_names
 
     for ctx in contexts:
-        for cls in ast.walk(ctx.tree):
+        for cls in _walked(ctx):
             if not isinstance(cls, ast.ClassDef):
                 continue
             methods = {n.name for n in cls.body

@@ -354,6 +354,7 @@ def start_flusher(period_s: float = 5.0) -> None:
 
     def loop():
         from ray_tpu.core import worker as worker_mod
+        seq = 0
         while True:
             time.sleep(period_s)
             try:
@@ -362,7 +363,11 @@ def start_flusher(period_s: float = 5.0) -> None:
                     continue
                 records = flush_all()
                 if records:
-                    core.gcs_call("report_metrics", {"records": records})
+                    seq += 1
+                    core.gcs_call("report_metrics", {
+                        "records": records,
+                        "source": f"flusher-{core.worker_id.hex()[:8]}",
+                        "seq": seq})
             except Exception:
                 pass
 

@@ -1,0 +1,78 @@
+"""CI finding-count report for rtpu-check.
+
+Runs the analyzer (as a subprocess — this script never imports the
+runtime, so it works in the leanest CI image) and prints one
+Prometheus-style text line per rule::
+
+    ray_tpu_check_findings_total{rule="lock-order-cycle"} 0
+
+Every known rule is printed, zeros included, so finding-count drift is
+visible in CI logs next to the bench deltas: a rule creeping from 0 is
+a diff in the log even when the run still exits 0 via the baseline.
+Baselined findings COUNT here (``--no-baseline``) — the report tracks
+total debt, the exit code tracks new debt.
+
+    python scripts/check_report.py              # report; exit 0 always
+    python scripts/check_report.py --strict     # exit 1 if any findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def _run_check(extra_args):
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.check", *extra_args],
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+    return proc
+
+
+def rule_names():
+    proc = _run_check(["--list-rules"])
+    if proc.returncode != 0:
+        raise RuntimeError(f"--list-rules failed: {proc.stderr}")
+    return sorted(line.split()[0] for line in proc.stdout.splitlines()
+                  if line.strip())
+
+
+def collect_counts():
+    """(counts-by-rule, files-scanned).  ``--no-baseline`` makes the
+    report count total findings, not just un-baselined ones."""
+    proc = _run_check(["--json", "--no-baseline"])
+    if proc.returncode not in (0, 1):
+        raise RuntimeError(
+            f"rtpu-check failed (rc={proc.returncode}): {proc.stderr}")
+    doc = json.loads(proc.stdout)
+    counts = {}
+    for f in doc.get("findings", []):
+        counts[f["rule"]] = counts.get(f["rule"], 0) + 1
+    return counts, doc.get("files", 0)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="emit ray_tpu_check_findings_total{rule} for CI logs")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any rule has findings")
+    args = ap.parse_args(argv)
+
+    counts, files = collect_counts()
+    for rule in rule_names():
+        print(f'ray_tpu_check_findings_total{{rule="{rule}"}} '
+              f"{counts.get(rule, 0)}")
+    total = sum(counts.values())
+    print(f"# rtpu-check: {total} finding(s) across {files} file(s)",
+          file=sys.stderr)
+    return 1 if (args.strict and total) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,9 @@ backend initialization.
 """
 
 import os
+import time
+
+_SESSION_START = time.monotonic()
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -81,3 +84,22 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip_slow)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Tier-1 wall-clock budget gate: ``make test`` exports
+    RTPU_TIER1_BUDGET_S (870), and a green run that still blew the
+    budget fails here — time regressions surface as a red CI run with
+    an actionable message instead of an eventual rc=124 timeout."""
+    budget = os.environ.get("RTPU_TIER1_BUDGET_S")
+    if not budget:
+        return
+    elapsed = time.monotonic() - _SESSION_START
+    if elapsed > float(budget) and session.exitstatus == 0:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        if tr is not None:
+            tr.write_line(
+                f"ERROR: tier-1 suite took {elapsed:.1f}s, over the "
+                f"{budget}s budget — audit with --durations=25 and "
+                f"slow-mark the offenders", red=True)
+        session.exitstatus = 1

@@ -182,6 +182,7 @@ def test_llama_kv_cache_decode_matches_full():
                                atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow  # ~10 s XLA compile; tier-1 budget headroom
 def test_resnet_forward_backward():
     cfg = ResNetConfig.resnet18(num_classes=10, dtype=jnp.float32)
     model = ResNet(cfg)
@@ -306,6 +307,7 @@ def test_vit_forward_backward_and_learns():
     assert losses[-1] < losses[0] * 0.7
 
 
+@pytest.mark.slow  # three full remat recompiles; tier-1 budget headroom
 def test_gpt2_remat_policies_match_baseline():
     """remat='full'/'dots' must be numerically identical to storing
     activations (same loss and same grads) — it only changes WHEN

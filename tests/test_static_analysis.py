@@ -3,6 +3,7 @@ and one clean fixture, plus suppression/baseline semantics and a
 whole-tree run asserting the checked-in tree is at zero unsuppressed
 findings."""
 
+import dataclasses
 import os
 import textwrap
 
@@ -15,6 +16,10 @@ from ray_tpu.tools.check.astrules import (
 )
 from ray_tpu.tools.check.findings import (
     Finding, Suppressions, load_baseline, split_new_findings,
+)
+from ray_tpu.tools.check.ipa import ProjectIndex, SummaryCache, index_for
+from ray_tpu.tools.check.iparules import (
+    check_lock_order, check_resource_lifecycle, check_retry_safety,
 )
 from ray_tpu.tools.check.project import (
     ProjectConfig, check_failpoint_registry, check_metric_drift,
@@ -832,3 +837,526 @@ def test_update_baseline_preserves_out_of_scope_and_comments(tmp_path,
     assert "elsewhere.py::metric-drift::ray_tpu_debt  # traffic-only" in text
     assert "cancellation-swallow" not in text
     assert check_cli.main(args + ["--no-baseline"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+def _ipa_cfg():
+    """A fresh config per test — the project index is memoized on the
+    config object, so reuse would leak one test's contexts into the
+    next.  The nonexistent root keeps the on-disk tree out of the
+    index: only the fixture contexts are analyzed."""
+    return ProjectConfig(root="/nonexistent-ipa-fixture")
+
+
+def test_lock_order_cycle_single_module():
+    findings = check_lock_order([_ctx("""
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def one():
+            with A:
+                with B:
+                    pass
+
+        def two():
+            with B:
+                with A:
+                    pass
+    """, path="ray_tpu/locks.py")], _ipa_cfg())
+    assert _rules(findings) == ["lock-order-cycle"]
+    f = findings[0]
+    assert f.symbol == "cycle.ray_tpu/locks.py::A|ray_tpu/locks.py::B"
+    assert "witness chains:" in f.message
+    assert "ray_tpu/locks.py:one:" in f.message
+    assert "ray_tpu/locks.py:two:" in f.message
+
+
+def test_lock_order_cycle_interprocedural():
+    """The opposite-order edge only exists through a cross-module call:
+    alpha holds LA and calls into beta (which takes LB), beta holds LB
+    and calls back into alpha (which takes LA)."""
+    contexts = [
+        _ctx("""
+            import threading
+            from ray_tpu.beta import grab_b
+
+            LA = threading.Lock()
+
+            def a_then_b():
+                with LA:
+                    grab_b()
+
+            def grab_a():
+                with LA:
+                    pass
+        """, path="ray_tpu/alpha.py"),
+        _ctx("""
+            import threading
+            from ray_tpu.alpha import grab_a
+
+            LB = threading.Lock()
+
+            def b_then_a():
+                with LB:
+                    grab_a()
+
+            def grab_b():
+                with LB:
+                    pass
+        """, path="ray_tpu/beta.py"),
+    ]
+    findings = check_lock_order(contexts, _ipa_cfg())
+    assert [f.symbol for f in findings] == [
+        "cycle.ray_tpu/alpha.py::LA|ray_tpu/beta.py::LB"]
+    # each edge's witness crosses the call: holder -> chain to acquirer
+    assert "ray_tpu/alpha.py:a_then_b:9 -> ray_tpu/beta.py:grab_b:12" \
+        in findings[0].message
+
+
+def test_lock_order_reacquire_direct_self_deadlock():
+    findings = check_lock_order([_ctx("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def direct(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """, path="svc.py")], _ipa_cfg())
+    assert [f.symbol for f in findings] == ["reacquire.S.direct"]
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lock_order_reacquire_through_callee():
+    findings = check_lock_order([_ctx("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """, path="svc.py")], _ipa_cfg())
+    assert [f.symbol for f in findings] == ["reacquire.S.outer"]
+    assert "svc.py:S.outer:" in findings[0].message
+
+
+def test_lock_order_rpc_under_lock_direct():
+    findings = check_lock_order([_ctx("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def flush(self, conn):
+                with self._lock:
+                    conn.call("kv_put", {})
+    """, path="svc.py")], _ipa_cfg())
+    assert [f.symbol for f in findings] == ["rpc-under-lock.S.flush.kv_put"]
+    assert "witness:" in findings[0].message
+
+
+def test_lock_order_rpc_under_lock_transitive_client_call():
+    """Holding a lock across a helper that (synchronously) reaches
+    ray_tpu.get stalls every thread behind the round trip — flagged
+    with the call chain as witness."""
+    findings = check_lock_order([_ctx("""
+        import threading
+        import ray_tpu
+
+        _lock = threading.Lock()
+
+        def fetch(ref):
+            return ray_tpu.get(ref)
+
+        def locked_fetch(ref):
+            with _lock:
+                return fetch(ref)
+    """, path="ray_tpu/gamma.py")], _ipa_cfg())
+    assert [f.symbol for f in findings] == [
+        "rpc-under-lock.locked_fetch.ray_tpu.get"]
+    assert "ray_tpu/gamma.py:locked_fetch:12 -> ray_tpu/gamma.py:fetch:8" \
+        in findings[0].message
+
+
+def test_lock_order_clean_fixtures():
+    # consistent order, RLock re-entry, lock dropped before the RPC,
+    # and an async RPC-under-lock (owned by the per-file rule, not this
+    # one): no findings
+    findings = check_lock_order([_ctx("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._re = threading.RLock()
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def reenter(self):
+                with self._re:
+                    self.helper()
+
+            def helper(self):
+                with self._re:
+                    pass
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rpc_after(self, conn):
+                with self._a:
+                    payload = {}
+                conn.call("kv_put", payload)
+
+            async def aflush(self, conn):
+                with self._a:
+                    await conn.call("kv_put", {})
+    """, path="svc.py")], _ipa_cfg())
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# resource-lifecycle
+# ---------------------------------------------------------------------------
+
+def test_resource_lifecycle_spill_fd_exit_leak():
+    findings = check_resource_lifecycle([_ctx("""
+        import os
+
+        def read_one(path):
+            fd = os.open(path, os.O_RDONLY)
+            data = os.pread(fd, 16, 0)
+            return data
+    """, path="spill.py")], _ipa_cfg())
+    assert [f.symbol for f in findings] == ["spill-fd.read_one.fd"]
+    assert "not released on every exit path" in findings[0].message
+
+
+def test_resource_lifecycle_spill_fd_exception_edge():
+    findings = check_resource_lifecycle([_ctx("""
+        import os
+
+        def read_two(path, blob):
+            fd = os.open(path, os.O_RDONLY)
+            meta = decode(blob)
+            os.close(fd)
+            return meta
+    """, path="spill.py")], _ipa_cfg())
+    assert [f.symbol for f in findings] == ["spill-fd.read_two.fd"]
+    assert "leaks if this raises" in findings[0].message
+
+
+def test_resource_lifecycle_spill_fd_try_finally_clean():
+    findings = check_resource_lifecycle([_ctx("""
+        import os
+
+        def read_ok(path):
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                return os.pread(fd, 16, 0)
+            finally:
+                os.close(fd)
+    """, path="spill.py")], _ipa_cfg())
+    assert findings == []
+
+
+def test_resource_lifecycle_arena_pin_checked_guard():
+    """A checked lease is only held under its truthiness guard — the
+    failure branch is clean, the success branch must release."""
+    findings = check_resource_lifecycle([_ctx("""
+        class Reader:
+            def pin_bad(self, oid):
+                buf = self.store.lease(oid)
+                if buf is None:
+                    return None
+                n = len(buf)
+                return n
+
+            def pin_ok(self, oid):
+                buf = self.store.lease(oid)
+                if buf is None:
+                    return None
+                try:
+                    return bytes(buf)
+                finally:
+                    self.store.release(oid)
+    """, path="reader.py")], _ipa_cfg())
+    assert [f.symbol for f in findings] == ["arena-pin.Reader.pin_bad.oid"]
+    assert "spill sweep" in findings[0].message
+
+
+def test_resource_lifecycle_failpoint_paired_only():
+    """Arm-and-disarm functions must disarm on the exception edge;
+    arm-only helpers (tests disarm later) are exempt by design."""
+    findings = check_resource_lifecycle([_ctx("""
+        from ray_tpu.util.failpoint import arm, disarm
+
+        def paired(site):
+            arm(site, "boom")
+            risky()
+            disarm(site)
+
+        def paired_ok(site):
+            arm(site, "boom")
+            try:
+                risky()
+            finally:
+                disarm(site)
+
+        def arm_only(site):
+            arm(site, "boom")
+    """, path="fp.py")], _ipa_cfg())
+    assert [f.symbol for f in findings] == ["failpoint.paired.site"]
+
+
+# ---------------------------------------------------------------------------
+# retry-safety
+# ---------------------------------------------------------------------------
+
+SERVICE_KV_PUT = """
+    class Gcs:
+        async def handle_kv_put(self, conn, data):
+            self.kv[data["key"]] = data["value"]
+            return True
+"""
+
+
+def test_retry_safety_outbound_retried_non_idempotent(fixture_project):
+    """call_with_retry / idempotent=True of a method whose handler
+    mutates a persisted table, without an IDEMPOTENT_METHODS entry."""
+    cfg = dataclasses.replace(fixture_project,
+                              persist_service_file="service.py")
+    contexts = [
+        _ctx(SERVICE_KV_PUT, path="service.py"),
+        _ctx("""
+            async def push(pool, addr):
+                await pool.call_with_retry(addr, "kv_put", {"key": "a"})
+
+            async def push2(conn):
+                await conn.call("kv_put", {"key": "b"}, idempotent=True)
+        """, path="client.py"),
+    ]
+    findings = check_retry_safety(contexts, cfg)
+    assert sorted(f.symbol for f in findings) == [
+        "retry.push.kv_put", "retry.push2.kv_put"]
+    assert "double-applies" in findings[0].message
+
+
+def test_retry_safety_outbound_through_retry_wrapper(fixture_project):
+    """A wrapper forwarding its method param into call_with_retry makes
+    every literal call site of the wrapper a retrying path."""
+    cfg = dataclasses.replace(fixture_project,
+                              persist_service_file="service.py")
+    contexts = [
+        _ctx(SERVICE_KV_PUT, path="service.py"),
+        _ctx("""
+            class W:
+                async def _retry(self, method, data):
+                    return await self.conn.call_with_retry(
+                        self.addr, method, data)
+
+                async def push(self):
+                    await self._retry("kv_put", {"key": "a"})
+        """, path="wrap.py"),
+    ]
+    findings = check_retry_safety(contexts, cfg)
+    assert [f.symbol for f in findings] == ["retry.W.push.kv_put"]
+
+
+def test_retry_safety_inbound_non_convergent_handler(fixture_project):
+    """IDEMPOTENT_METHODS licenses re-sends, so a blind increment or
+    append in the handler double-counts on replay — flagged with the
+    rpc.py line and a witness chain."""
+    findings = check_retry_safety([_ctx("""
+        class Gcs:
+            async def handle_ping(self, conn, data):
+                self._pings += 1
+                self._log.append(data)
+                return True
+    """, path="service.py")], fixture_project)
+    assert sorted(f.symbol for f in findings) == [
+        "converge.ping._log", "converge.ping._pings"]
+    assert "IDEMPOTENT_METHODS" in findings[0].message
+    assert "service.py:Gcs.handle_ping:" in findings[0].message
+
+
+def test_retry_safety_inbound_replay_guard_clean(fixture_project):
+    """A keyed early exit before the mutation is the convergent shape:
+    replayed deliveries drop out at the guard."""
+    findings = check_retry_safety([_ctx("""
+        class Gcs:
+            async def handle_ping(self, conn, data):
+                seq = data.get("seq", 0)
+                if self._seen.get(data["source"], -1) >= seq:
+                    return True
+                self._seen[data["source"]] = seq
+                self._pings += 1
+                return True
+    """, path="service.py")], fixture_project)
+    assert findings == []
+
+
+def test_retry_safety_clean_idempotent_upsert(fixture_project):
+    """Retrying an IDEMPOTENT method whose handler is a keyed upsert is
+    the sanctioned pattern — no findings in either direction."""
+    contexts = [
+        _ctx("""
+            class Gcs:
+                async def handle_ping(self, conn, data):
+                    self.seen[data["source"]] = data["seq"]
+                    return True
+        """, path="service.py"),
+        _ctx("""
+            async def client(pool, addr):
+                await pool.call_with_retry(addr, "ping", {})
+        """, path="client.py"),
+    ]
+    assert check_retry_safety(contexts, fixture_project) == []
+
+
+# ---------------------------------------------------------------------------
+# project index: call graph, aliases, witness chains, summary cache
+# ---------------------------------------------------------------------------
+
+def test_call_graph_self_and_attr_type_dispatch():
+    """self._method resolves within the class; a constructor-typed
+    attribute (self._kv = KVPageTable()) routes its method calls to the
+    bound class."""
+    cfg = _ipa_cfg()
+    idx = index_for([_ctx("""
+        class KVPageTable:
+            def release(self, rid):
+                pass
+
+        class Batcher:
+            def __init__(self):
+                self._kv = KVPageTable()
+
+            def _finish(self, rid):
+                self._kv.release(rid)
+                self._local()
+
+            def _local(self):
+                pass
+    """, path="ray_tpu/bat.py")], cfg)
+    callees = [c for c, _line in idx.callees("ray_tpu/bat.py::Batcher._finish")]
+    assert callees == ["ray_tpu/bat.py::KVPageTable.release",
+                       "ray_tpu/bat.py::Batcher._local"]
+
+
+def test_call_graph_module_alias_resolution():
+    """`from x import f as g` call sites resolve to x.f across
+    modules."""
+    cfg = _ipa_cfg()
+    idx = index_for([
+        _ctx("""
+            from ray_tpu.beta import grab_b as gb
+
+            def call_it():
+                gb()
+        """, path="ray_tpu/alpha.py"),
+        _ctx("""
+            def grab_b():
+                pass
+        """, path="ray_tpu/beta.py"),
+    ], cfg)
+    callees = [c for c, _line in idx.callees("ray_tpu/alpha.py::call_it")]
+    assert callees == ["ray_tpu/beta.py::grab_b"]
+
+
+def test_find_chain_and_witness_rendering():
+    cfg = _ipa_cfg()
+    idx = index_for([
+        _ctx("""
+            from ray_tpu.stem import mid
+
+            def root():
+                mid()
+        """, path="ray_tpu/root.py"),
+        _ctx("""
+            from ray_tpu.leaf import target
+
+            def mid():
+                target()
+        """, path="ray_tpu/stem.py"),
+        _ctx("""
+            def target():
+                x = 1
+        """, path="ray_tpu/leaf.py"),
+    ], cfg)
+    chain = idx.find_chain(
+        "ray_tpu/root.py::root",
+        lambda fid: 2 if fid.endswith("::target") else None)
+    assert chain == [("ray_tpu/root.py::root", 5),
+                     ("ray_tpu/stem.py::mid", 5),
+                     ("ray_tpu/leaf.py::target", 2)]
+    assert idx.render_chain(chain) == (
+        "ray_tpu/root.py:root:5 -> ray_tpu/stem.py:mid:5 "
+        "-> ray_tpu/leaf.py:target:2")
+
+
+def test_summary_cache_hit_and_invalidation_on_edit(tmp_path):
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir()
+    mod = pkg / "m.py"
+    mod.write_text("def f():\n    pass\n")
+    cache_path = str(tmp_path / "build" / "cache.json")
+
+    cold = SummaryCache(cache_path)
+    idx = ProjectIndex.from_tree(str(tmp_path), cache=cold)
+    assert (cold.hits, cold.misses) == (0, 1)
+    cold.save()
+
+    warm = SummaryCache(cache_path)
+    idx2 = ProjectIndex.from_tree(str(tmp_path), cache=warm)
+    assert (warm.hits, warm.misses) == (1, 0)
+    assert set(idx2.functions) == set(idx.functions)
+
+    # a fully-warm run is not dirty: save() must not rewrite the file
+    os.remove(cache_path)
+    warm.save()
+    assert not os.path.exists(cache_path)
+
+    cold.save()  # restore, then edit the source: content hash misses
+    mod.write_text("def f():\n    return 1\n")
+    edited = SummaryCache(cache_path)
+    ProjectIndex.from_tree(str(tmp_path), cache=edited)
+    assert (edited.hits, edited.misses) == (0, 1)
+
+
+def test_summary_cache_spec_fingerprint_invalidates(tmp_path):
+    from ray_tpu.tools.check.ipa import RESOURCE_SPECS
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("def f():\n    pass\n")
+    cache_path = str(tmp_path / "build" / "cache.json")
+    cold = SummaryCache(cache_path)
+    ProjectIndex.from_tree(str(tmp_path), cache=cold)
+    cold.save()
+    # a different spec table must drop the cache wholesale
+    narrowed = SummaryCache(cache_path, specs=RESOURCE_SPECS[:1])
+    ProjectIndex.from_tree(str(tmp_path), cache=narrowed,
+                           specs=RESOURCE_SPECS[:1])
+    assert (narrowed.hits, narrowed.misses) == (0, 1)

@@ -67,6 +67,7 @@ def test_huggingface_trainer_finetunes_tiny_model(tmp_path):
     assert model.config.dim == 16
 
 
+@pytest.mark.slow  # TF import + 2-worker gang; tier-1 budget headroom
 def test_tensorflow_trainer_multiworker(tmp_path):
     """The backend's contract (reference ``train/tensorflow/config.py``)
     is the TF_CONFIG rendezvous file: a consistent cluster spec plus
