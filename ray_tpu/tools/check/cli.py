@@ -15,7 +15,7 @@ as ``file:line rule message`` so CI output is click-through-able.
 
 The interprocedural rules (and the whole-tree registries the older
 cross-file rules consult) run off per-module summaries cached under
-``build/rtpu-check-summaries.json``, keyed by file content hash — a
+``build/rtpu-check-summaries.pkl``, keyed by file content hash — a
 warm run re-summarizes only edited modules.  ``--changed-only``
 narrows the *scan scope* to git-changed files plus their direct
 importers; the registries still see the whole tree through the cache,

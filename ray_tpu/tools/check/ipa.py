@@ -33,8 +33,8 @@ from __future__ import annotations
 
 import ast
 import hashlib
-import json
 import os
+import pickle
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, \
     Sequence, Set, Tuple
@@ -1367,13 +1367,17 @@ def summarize_module(path: str, source: str,
 # ---------------------------------------------------------------------------
 
 def default_cache_path(root: str) -> str:
-    return os.path.join(root, "build", "rtpu-check-summaries.json")
+    return os.path.join(root, "build", "rtpu-check-summaries.pkl")
 
 
 class SummaryCache:
     """Content-hash-keyed persistence of module summaries.  The cache
     file lives under ``build/`` (gitignored, wiped by ``make clean``);
-    a version or spec-fingerprint mismatch drops it wholesale."""
+    a version or spec-fingerprint mismatch drops it wholesale.  Pickle,
+    not JSON: the doc holds every per-function summary in the tree
+    (~hundreds of thousands of nodes) and is rewritten whole on any
+    edit, so codec speed is what keeps ``--changed-only`` sub-second —
+    same local-build-artifact trust model as ``.pyc``."""
 
     def __init__(self, path: Optional[str],
                  specs: Sequence[ResourceSpec] = RESOURCE_SPECS):
@@ -1385,12 +1389,13 @@ class SummaryCache:
         self.misses = 0
         if path is not None and os.path.exists(path):
             try:
-                with open(path, encoding="utf-8") as f:
-                    data = json.load(f)
+                with open(path, "rb") as f:
+                    data = pickle.load(f)
                 if data.get("version") == CACHE_VERSION \
                         and data.get("specs") == self._fp:
                     self._entries = data.get("modules", {})
-            except (OSError, ValueError):
+            except (OSError, ValueError, EOFError, AttributeError,
+                    ImportError, pickle.PickleError):
                 self._entries = {}
 
     def get(self, path: str, sha: str) -> Optional[ModuleSummary]:
@@ -1411,15 +1416,16 @@ class SummaryCache:
 
     def save(self) -> None:
         # a fully-warm run re-summarized nothing: skip the (large)
-        # JSON re-serialization entirely
+        # re-serialization entirely
         if self.path is None or not self._dirty:
             return
         try:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
             tmp = self.path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump({"version": CACHE_VERSION, "specs": self._fp,
-                           "modules": self._entries}, f)
+            with open(tmp, "wb") as f:
+                pickle.dump({"version": CACHE_VERSION, "specs": self._fp,
+                             "modules": self._entries}, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
             os.replace(tmp, self.path)
         except OSError:  # pragma: no cover - cache is best-effort
             pass
