@@ -18,9 +18,11 @@ cross-file rules consult) run off per-module summaries cached under
 ``build/rtpu-check-summaries.pkl``, keyed by file content hash — a
 warm run re-summarizes only edited modules.  ``--changed-only``
 narrows the *scan scope* to git-changed files plus their direct
-importers; the registries still see the whole tree through the cache,
-so a scoped run reports the same truths as a full one, just only for
-the files you touched.
+importers — the importers ride along for the cross-file rules only
+(per-file rule output on an unchanged file cannot change); the
+registries still see the whole tree through the cache, so a scoped
+run reports the same truths as a full one, just only for the files
+you touched.
 """
 
 from __future__ import annotations
@@ -94,9 +96,17 @@ def parse_files(files: Iterable[str], root: str) -> List[ModuleContext]:
 
 
 def run_rules(contexts: List[ModuleContext], cfg: ProjectConfig,
-              select: Optional[Iterable[str]] = None) -> List[Finding]:
+              select: Optional[Iterable[str]] = None, *,
+              per_file_scope: Optional[Set[str]] = None) -> List[Finding]:
     """Run the selected rules (default: all) and drop findings covered
-    by an inline ``# rtpu-check: disable=`` comment."""
+    by an inline ``# rtpu-check: disable=`` comment.
+
+    ``per_file_scope`` (repo-relative paths) narrows the *per-file*
+    rules to those contexts only; cross-file and interprocedural rules
+    always see every context.  A per-file rule's findings depend only
+    on that one file's source, so skipping it on an unchanged dependent
+    can never hide a finding the edit introduced — this is what keeps
+    ``--changed-only`` sub-second on a one-file edit."""
     selected = set(select) if select is not None else set(ALL_RULES)
     unknown = selected - set(ALL_RULES)
     if unknown:
@@ -105,6 +115,9 @@ def run_rules(contexts: List[ModuleContext], cfg: ProjectConfig,
     for name, rule in ASYNC_RULES.items():
         if name in selected:
             for ctx in contexts:
+                if per_file_scope is not None \
+                        and ctx.path not in per_file_scope:
+                    continue
                 findings.extend(rule(ctx))
     for name, rule in {**PROJECT_RULES, **IPA_RULES}.items():
         if name in selected:
@@ -193,10 +206,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # registries, and --changed-only dependent resolution all read
         # from it (warm modules come straight from the summary cache)
         index = index_for([], cfg, cache=cache)
+        per_file_scope = None
         if args.changed_only:
             changed = [p for p in changed_files(root)
                        if os.path.isfile(os.path.join(root, p))]
             scope = set(changed) | index.dependents(changed)
+            # dependents ride along for the cross-file rules only; the
+            # per-file rules re-run just on the files actually edited
+            per_file_scope = set(changed)
             paths = sorted(os.path.join(root, p) for p in scope
                            if os.path.isfile(os.path.join(root, p)))
             if not paths:
@@ -212,7 +229,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             paths = args.paths or [os.path.join(root, "ray_tpu")]
         files = discover_files(paths)
         contexts = parse_files(files, root)
-        findings = run_rules(contexts, cfg, select)
+        findings = run_rules(contexts, cfg, select,
+                             per_file_scope=per_file_scope)
     except (FileNotFoundError, SyntaxError, ValueError) as e:
         print(f"rtpu-check: error: {e}", file=sys.stderr)
         return 2

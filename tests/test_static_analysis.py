@@ -1360,3 +1360,25 @@ def test_summary_cache_spec_fingerprint_invalidates(tmp_path):
     ProjectIndex.from_tree(str(tmp_path), cache=narrowed,
                            specs=RESOURCE_SPECS[:1])
     assert (narrowed.hits, narrowed.misses) == (0, 1)
+
+
+def test_changed_only_scope_limits_per_file_rules_not_cross_file():
+    """--changed-only scans dependents with the cross-file rules only:
+    a per-file finding in an unchanged dependent is not re-reported,
+    but the dependent's context still feeds the whole-program rules."""
+    from ray_tpu.tools.check.cli import run_rules
+
+    bad = """
+        import time
+
+        async def f():
+            time.sleep(1)
+    """
+    ctxs = [_ctx(bad, path="ray_tpu/chg.py"),
+            _ctx(bad, path="ray_tpu/dep.py")]
+    cfg = ProjectConfig(root="/nonexistent")
+    full = run_rules(ctxs, cfg, select=["async-blocking"])
+    assert {f.path for f in full} == {"ray_tpu/chg.py", "ray_tpu/dep.py"}
+    scoped = run_rules(ctxs, cfg, select=["async-blocking"],
+                       per_file_scope={"ray_tpu/chg.py"})
+    assert {f.path for f in scoped} == {"ray_tpu/chg.py"}
