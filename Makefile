@@ -8,8 +8,8 @@
 #   make asan   — AddressSanitizer+UBSan build + run
 .PHONY: all native check check-fast test chaos bench bench-transfer bench-serve \
 	bench-serve-sharded bench-rl bench-controlplane bench-store \
-	bench-ha bench-data metrics-smoke metrics-history-smoke tsan asan \
-	sanitize clean
+	bench-ha bench-data metrics-smoke metrics-history-smoke \
+	postmortem-smoke tsan asan sanitize clean
 
 CXX ?= g++
 CXXFLAGS = -std=c++17 -O1 -g -fno-omit-frame-pointer -Wall -Wextra
@@ -68,7 +68,7 @@ chaos: native
 	  tests/test_tracing.py tests/test_rllib_pipeline.py \
 	  tests/test_controlplane_scale.py tests/test_store_scale.py \
 	  tests/test_gcs_ha.py tests/test_data_streaming.py \
-	  tests/test_metrics_history.py \
+	  tests/test_metrics_history.py tests/test_incidents.py \
 	  tests/test_node_drain.py tests/test_autoscaler_monitor.py \
 	  tests/test_fair_queue.py tests/test_autoscaler_chaos.py \
 	  -q -m "slow or not slow" \
@@ -144,6 +144,13 @@ metrics-smoke: native
 # and /healthz verdicts ok (docs/observability.md).
 metrics-history-smoke: native
 	JAX_PLATFORMS=cpu python scripts/metrics_history_smoke.py
+
+# Boot a mini-cluster, SIGKILL a worker mid-workload, assert the
+# incident journal opened with the dead worker's flight tail, that
+# `ray-tpu postmortem --last` renders, and that the debug bundle
+# tar-extracts with a manifest (docs/observability.md).
+postmortem-smoke: native
+	JAX_PLATFORMS=cpu python scripts/postmortem_smoke.py
 
 build/store_stress_tsan: $(SAN_SRCS)
 	@mkdir -p build

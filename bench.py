@@ -962,6 +962,58 @@ def bench_trace_overhead() -> dict:
     return out
 
 
+def bench_flight_overhead() -> dict:
+    """Flight-recorder tax on the sync-task microbench, measured
+    exactly like bench_trace_overhead: 12 alternating off/on block
+    pairs of sync nop tasks in ONE cluster, reported as the median
+    paired on/off ratio minus 1, in percent.  Acceptance bar
+    (ISSUE 20): <= 1% with the recorder on; the off block is the
+    recorder-disabled hot path (one module-global load + None test),
+    which must cost nothing by construction."""
+    import statistics as stats
+
+    import ray_tpu
+    from ray_tpu.core import flight_recorder as flt
+
+    out: dict = {}
+    try:
+        ray_tpu.init(num_cpus=2,
+                     object_store_memory=256 * 1024 * 1024)
+
+        @ray_tpu.remote(num_cpus=0)
+        def nop():
+            return None
+
+        ray_tpu.get([nop.remote() for _ in range(200)], timeout=120)
+        n = 300
+
+        def block() -> float:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                ray_tpu.get(nop.remote())
+            return time.perf_counter() - t0
+
+        block()  # warm
+        ratios = []
+        for _ in range(12):
+            flt._reset_for_tests(force=False)   # recorder off
+            off = block()
+            flt._reset_for_tests(force=True)    # recorder on
+            on = block()
+            ratios.append(on / off)
+        flt._reset_for_tests()  # restore config-driven gate
+        out["flight_overhead_pct"] = round(
+            (stats.median(ratios) - 1.0) * 100.0, 3)
+    except Exception as e:  # noqa: BLE001 — probe must not kill bench
+        out["flight_overhead_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
 def put_writer_sweep(putters, gbits: float, reps: int, settle) -> dict:
     """Aggregate put bandwidth at 1/2/4/8 concurrent writers: each
     point is a median of ``reps`` timed rounds of 2 puts per writer.
@@ -1140,7 +1192,7 @@ SUMMARY_KEYS = (
     "actor_churn_per_sec_4node", "pg_churn_per_sec_4node",
     "lease_grant_p99_ms_1node", "lease_grant_p99_ms_4node",
     "lease_p99_ratio_4v1",
-    "telemetry_overhead", "trace_overhead_pct",
+    "telemetry_overhead", "trace_overhead_pct", "flight_overhead_pct",
     "ppo_env_steps_per_sec_inline", "ppo_env_steps_per_sec_fleet",
     "ppo_env_steps_per_sec_fleet_legacy",
     "ppo_scaling_curve", "ppo_scaling_curve_legacy",
@@ -1264,6 +1316,7 @@ def main() -> None:
         details["telemetry_overhead_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("RAY_TPU_BENCH_RUNTIME", "1") != "0":
         details.update(bench_trace_overhead())
+        details.update(bench_flight_overhead())
     annotate_vs_ref(details)
     annotate_vs_prev(details)
     result = {

@@ -341,6 +341,26 @@ class Config:
     #: GCS-side ring of profile records served by ``get_profile``.
     profiler_table_size: int = 50000
 
+    # ---- incident forensics (core/flight_recorder.py) --------------------
+    #: Every process keeps a crash-surviving mmap ring of its recent
+    #: state transitions (docs/observability.md "Incidents and
+    #: postmortems").  Off: ``flight_recorder.record`` is a single
+    #: None test — the hot path pays nothing.
+    flight_recorder_enabled: bool = True
+    #: Per-process ring file size in bytes (256 B/frame → 1024 frames
+    #: at the default; the whole file is the crash-loss bound).
+    flight_ring_bytes: int = 262144
+    #: GCS-side cap on retained incidents (oldest evicted; incidents
+    #: persist via the WAL so the cap also bounds snapshot growth).
+    incident_table_size: int = 200
+    #: Deaths/alert-firings within this window of an open incident's
+    #: last update merge into it instead of opening a new one (a gang
+    #: death is one incident, not N).
+    incident_window_s: float = 120.0
+    #: Per-severity capacity of the GCS cluster-event retention rings
+    #: (evictions counted in ``ray_tpu_events_evicted_total``).
+    event_ring_size: int = 5000
+
     def apply_env_overrides(self) -> "Config":
         for f in fields(self):
             env = os.environ.get(_ENV_PREFIX + f.name.upper())

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.core import flight_recorder as _flight
 from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
@@ -63,6 +64,9 @@ class NodeInfo:
     # serves in-flight work and object pulls) but take no new leases
     state: str = NODE_ACTIVE
     drain_reason: str = ""
+    # raylet process id: on a same-host node death the GCS reads the
+    # dead raylet's flight ring from the session dir by this pid
+    pid: int = 0
 
 
 #: internal-KV key (default namespace) holding the standing
@@ -147,8 +151,23 @@ class GcsServer:
         from ray_tpu.util import event as event_mod
         self._event_mod = event_mod
         event_mod.init("GCS", session_dir)
+        # crash-surviving flight ring for the head process (the
+        # co-located raylet's later init is a no-op — first init wins)
+        _flight.init("gcs", session_dir, config)
+        self._session_dir = session_dir
+        # bounded per-severity event retention rings: a flood of one
+        # severity (INFO churn) can no longer evict the sparse ERROR
+        # evidence an incident window needs.  Evictions are counted
+        # (ray_tpu_events_evicted_total + debug_state).
         from collections import deque as _deque
-        self._events: "_deque" = _deque(maxlen=10000)
+        self._event_rings: Dict[str, "_deque"] = {}
+        self._events_evicted = 0
+        # incident journal (docs/observability.md "Incidents and
+        # postmortems"): auto-opened on deaths / firing alerts,
+        # WAL-persisted like alerts so they survive a head SIGKILL
+        from collections import OrderedDict as _inc_od
+        self._incidents: "_inc_od[str, Dict[str, Any]]" = _inc_od()
+        self._incident_collect_handles: Dict[str, Any] = {}
         # versioned resource-view broadcast (ray_syncer equivalent)
         self._sync_version = 0
         self._sync_dirty: set = set()
@@ -339,6 +358,8 @@ class GcsServer:
             self.quotas = snap.get("quotas", {})
             self.lease_tables = snap.get("lease_tables", {})
             self._node_states = snap.get("node_states", {})
+            for inc in snap.get("incidents", []):
+                self._incidents[inc["id"]] = inc
             # full actor runtime state (not just detached): a
             # reconnecting driver's handles must keep resolving after a
             # head restart
@@ -415,6 +436,12 @@ class GcsServer:
         try:
             self.wal.append(rtype, data)
             _tm.gcs_wal_append()
+            if _flight.enabled():
+                # WAL position in the ring: a postmortem of a dead GCS
+                # shows exactly how far durability had advanced
+                _flight.record("wal_append",
+                               f"{rtype} n={self.wal.appends} "
+                               f"bytes={self.wal.size_bytes}")
         except Exception as e:  # noqa: BLE001 — durability degrades,
             self._wal_degrade(e)  # availability stays
         else:
@@ -525,6 +552,15 @@ class GcsServer:
                 self.lease_tables[node_hex] = usage
             else:
                 self.lease_tables.pop(node_hex, None)
+        elif rtype == "incident":
+            # full-value set: open and collect both re-WAL the whole
+            # incident dict, so replay converges on the latest state
+            self._incidents[data["id"]] = data
+            self._incidents.move_to_end(data["id"])
+            cap = max(4, int(getattr(self.config,
+                                     "incident_table_size", 200)))
+            while len(self._incidents) > cap:
+                self._incidents.popitem(last=False)
         else:
             logger.warning("unknown WAL record type %r skipped", rtype)
 
@@ -597,7 +633,8 @@ class GcsServer:
             "placement_groups": pgs,
             "quotas": self.quotas,
             "lease_tables": self.lease_tables,
-            "node_states": self._node_states})
+            "node_states": self._node_states,
+            "incidents": list(self._incidents.values())})
         self._persist_failed_ts = 0.0 if ok else time.monotonic()
         # no awaits since the table reads above: the snapshot is a
         # consistent cut covering every WAL record appended so far, so
@@ -719,6 +756,15 @@ class GcsServer:
         out["persistence"] = self._persistence_health()
         out["recovery"] = dict(self._recovery)
         out["history"] = self._history.stats()
+        out["events_evicted"] = self._events_evicted
+        out["event_rings"] = {sev: len(ring) for sev, ring
+                              in self._event_rings.items()}
+        out["incidents"] = len(self._incidents)
+        out["incidents_open"] = sum(1 for i in self._incidents.values()
+                                    if i["state"] == "open")
+        fstats = _flight.stats()
+        if fstats is not None:
+            out["flight_recorder"] = fstats
         return out
 
     # -- versioned resource broadcast (parity: ray_syncer.h:27-60 —
@@ -771,6 +817,12 @@ class GcsServer:
                         len(self.subscribers))
                     if self.wal is not None:
                         _tm.gcs_wal_size(self.wal.size_bytes)
+                    fstats = _flight.stats()
+                    if fstats is not None:
+                        _tm.flight_frames(fstats["frames_recorded"])
+                    _tm.incidents_open(
+                        sum(1 for i in self._incidents.values()
+                            if i["state"] == "open"))
                     _tm.presample()
                     self._ingest_metrics(metrics_mod.flush_all())
                     spans = _tm.drain_spans("gcs")  # offset 0 by defn
@@ -816,6 +868,9 @@ class GcsServer:
             self._health_task.cancel()
         if self._pg_retry_task:
             self._pg_retry_task.cancel()
+        for handle in self._incident_collect_handles.values():
+            handle.cancel()
+        self._incident_collect_handles.clear()
         await self.server.stop()
         self.pool.close_all()
         if self._persist_handle is not None:
@@ -830,6 +885,9 @@ class GcsServer:
                 logger.exception("final GCS snapshot failed")
         if self.wal is not None:
             self.wal.close()
+        # graceful exit unlinks the ring: a surviving ring for a dead
+        # pid then unambiguously means crash (see flight_recorder.py)
+        _flight.close(unlink=True)
 
     # ------------------------------------------------------------------
     # pubsub hub
@@ -898,6 +956,7 @@ class GcsServer:
             resources_available=dict(data["resources"]),
             topology=data.get("topology", {}),
             max_workers=int(data.get("max_workers", -1)),
+            pid=int(data.get("pid", 0)),
         )
         # a node re-registering after a GCS restart resumes the
         # lifecycle state the WAL/snapshot recorded for it — a drain
@@ -1153,21 +1212,286 @@ class GcsServer:
                 "lease_tables": {n: dict(t)
                                  for n, t in self.lease_tables.items()}}
 
+    def _event_append(self, record: Dict[str, Any]) -> None:
+        """Route one event record into its severity's retention ring,
+        counting displaced records (the old single shared ring let an
+        INFO flood silently evict the ERROR evidence incidents need)."""
+        sev = record.get("severity") or "INFO"
+        ring = self._event_rings.get(sev)
+        if ring is None:
+            from collections import deque as _deque
+            cap = max(16, int(getattr(self.config,
+                                      "event_ring_size", 5000)))
+            ring = self._event_rings[sev] = _deque(maxlen=cap)
+        if len(ring) == ring.maxlen:
+            self._events_evicted += 1
+            _tm.events_evicted(1)
+        ring.append(record)
+
     def _emit_event(self, severity: str, label: str, message: str,
                     **fields: Any) -> None:
-        self._events.append(
+        self._event_append(
             self._event_mod.emit(severity, label, message, **fields))
 
     def push_cluster_events(self, conn, record) -> None:
         """Event records pushed by raylets/workers (see util/event.py)."""
-        self._events.append(record)
+        self._event_append(record)
 
     async def handle_list_events(self, conn, data):
         severity = (data or {}).get("severity")
         limit = (data or {}).get("limit", 1000)
-        out = [e for e in self._events
-               if severity is None or e.get("severity") == severity]
+        if severity is not None:
+            out = list(self._event_rings.get(severity, ()))
+        else:
+            out = sorted(
+                (e for ring in self._event_rings.values() for e in ring),
+                key=lambda e: e.get("timestamp", 0.0))
         return out[-limit:]
+
+    # ------------------------------------------------------------------
+    # incident journal (docs/observability.md "Incidents and
+    # postmortems"): auto-opened on deaths / firing alerts, linked into
+    # the other observability planes, WAL-persisted like alerts
+    # ------------------------------------------------------------------
+    def _open_or_merge_incident(self, kind: str, title: str,
+                                severity: str = "error",
+                                node: Optional[str] = None,
+                                job: Optional[str] = None,
+                                deployment: Optional[str] = None
+                                ) -> Dict[str, Any]:
+        """One incident per failure episode: a death/alert within
+        ``incident_window_s`` of the newest incident's last update
+        folds into it (a gang death is one incident, not N), otherwise
+        a new incident opens.  Both paths WAL the full incident and
+        (re)arm the delayed link collection."""
+        now = time.time()
+        window_s = float(getattr(self.config, "incident_window_s",
+                                 120.0))
+        inc: Optional[Dict[str, Any]] = None
+        if self._incidents:
+            newest = next(reversed(self._incidents.values()))
+            if now - newest["last_update"] <= window_s:
+                inc = newest
+        if inc is None:
+            inc = {
+                "id": f"inc-{os.urandom(6).hex()}",
+                "kind": kind, "title": title, "severity": severity,
+                "opened_at": now, "last_update": now,
+                "state": "open",
+                # the window opens a beat early: the evidence that
+                # explains a death precedes it
+                "window": [now - 30.0, None],
+                "nodes": [], "jobs": [], "deployments": [],
+                "deaths": [], "alerts": [], "partial": False,
+                "links": {},
+            }
+            cap = max(4, int(getattr(self.config,
+                                     "incident_table_size", 200)))
+            while len(self._incidents) >= cap:
+                old_id, _ = self._incidents.popitem(last=False)
+                self._incident_collect_handles.pop(old_id, None)
+            self._incidents[inc["id"]] = inc
+            _tm.incident_opened(kind)
+            self._emit_event(
+                "ERROR" if severity == "error" else "WARNING",
+                "INCIDENT_OPEN", f"incident {inc['id']}: {title}",
+                incident_id=inc["id"], kind=kind)
+            logger.warning("incident %s opened: %s", inc["id"], title)
+        else:
+            inc["last_update"] = now
+            if severity == "error":
+                inc["severity"] = "error"
+        if node and node not in inc["nodes"]:
+            inc["nodes"].append(node)
+        if job and job not in inc["jobs"]:
+            inc["jobs"].append(job)
+        if deployment and deployment not in inc["deployments"]:
+            inc["deployments"].append(deployment)
+        _flight.record("mark", f"incident {inc['id']}: {title}")
+        self._incident_wal(inc)
+        self._schedule_incident_collect(inc["id"])
+        return inc
+
+    def _incident_wal(self, inc: Dict[str, Any]) -> None:
+        self._wal_append("incident", dict(inc))
+        self._schedule_persist()
+
+    def _incident_add_death(self, inc: Dict[str, Any], source: str,
+                            pid: int, node: Optional[str], reason: str,
+                            frames: List[Dict[str, Any]], torn: int,
+                            partial: bool) -> None:
+        """Attach one dead process's identity + flight tail.  The
+        ``gcs.incident.collect_fail`` failpoint models the tail being
+        lost mid-death-notification: the death entry still lands (the
+        incident opens regardless), only the frames are gone and the
+        incident is marked partial — the death path never wedges."""
+        if _fp.active() and _fp.failpoint("gcs.incident.collect_fail"):
+            frames, torn, partial = [], 0, True
+        for d in inc["deaths"]:
+            if d["pid"] == pid and d["source"] == source:
+                if frames and not d["frames"]:
+                    d["frames"], d["torn"] = frames, torn
+                    d["partial"] = partial
+                return
+        inc["deaths"].append({
+            "source": source, "pid": pid, "node": node,
+            "reason": reason, "frames": frames, "torn": torn,
+            "partial": partial, "ts": time.time()})
+        if partial:
+            inc["partial"] = True
+        if frames:
+            _tm.flight_tail_shipped(1)
+
+    def _schedule_incident_collect(self, inc_id: str) -> None:
+        """(Re)arm the delayed link-collection pass: it runs one flush
+        period after the incident last moved, so the traces/metrics the
+        episode produced have reached the GCS tables before we snapshot
+        the links."""
+        settle = float(getattr(self.config, "metrics_report_period_s",
+                               5.0)) + 2.0
+        old = self._incident_collect_handles.pop(inc_id, None)
+        if old is not None:
+            old.cancel()
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # unit tests building a GCS outside a loop
+        def _fire() -> None:
+            self._incident_collect_handles.pop(inc_id, None)
+            t = loop.create_task(self._collect_incident(inc_id))
+            t.add_done_callback(lambda t: t.exception())
+        self._incident_collect_handles[inc_id] = loop.call_later(
+            settle, _fire)
+
+    async def _collect_incident(self, inc_id: str) -> None:
+        """Fill the incident's links into the other planes: retained
+        traces in the window, the firing-alert set, metrics-history
+        slices, profiler/recovery state.  Re-runs on merge; every pass
+        re-WALs the full incident (full-value set semantics)."""
+        inc = self._incidents.get(inc_id)
+        if inc is None:
+            return
+        try:
+            now = time.time()
+            since = inc["window"][0]
+            traces = []
+            for trace_id, entry in reversed(self._traces.items()):
+                if entry.get("keep") is False:
+                    continue
+                row = self._trace_summary(trace_id, entry)
+                if (row["start"] or 0.0) >= since:
+                    traces.append(row)
+                if len(traces) >= 50:
+                    break
+            series = {}
+            for name in ("cluster:alive_nodes", "cluster:actors_alive"):
+                rows = self._history.query(series=name, since=since)
+                if rows:
+                    series[name] = rows[0].get("points", [])
+            inc["window"][1] = now
+            inc["links"] = {
+                "trace_ids": [t["trace_id"] for t in traces],
+                "traces": traces,
+                "alerts_firing": self._history.firing(),
+                "timeseries": series,
+                "profile_records": len(self._profile),
+                "recovery": dict(self._recovery),
+            }
+            inc["state"] = "collected"
+            self._incident_wal(inc)
+        except Exception:  # noqa: BLE001 — forensics never wedges
+            logger.exception("incident %s link collection failed",
+                             inc_id)
+            inc["partial"] = True
+            inc["state"] = "collected"
+            self._incident_wal(inc)
+
+    # replay-safe by construction, not by a seq guard: a retried
+    # delivery merges into the incident it just opened (same episode
+    # window) and _incident_add_death dedupes on (source, pid), so the
+    # INCIDENT_OPEN event emits at most once per episode
+    # rtpu-check: disable=retry-safety
+    async def handle_report_flight_tail(self, conn, data):
+        """Death-notification path: a surviving raylet (or the head
+        supervisor) shipped a dead process's flight-ring tail.  Opens
+        or merges an incident; the tail attach is failpoint-gated but
+        the incident itself always lands."""
+        source = data["source"]
+        pid = int(data["pid"])
+        reason = data.get("reason") or "process died"
+        node = data.get("node_id")
+        node_hex = node.hex() if isinstance(node, bytes) else node
+        inc = self._open_or_merge_incident(
+            "death", f"{source} (pid {pid}) died: {reason}",
+            node=node_hex)
+        self._incident_add_death(
+            inc, source, pid, node_hex, reason,
+            list(data.get("frames") or []), int(data.get("torn") or 0),
+            partial=not data.get("frames"))
+        self._incident_wal(inc)
+        await self._wal_flush()
+        return {"incident_id": inc["id"]}
+
+    @staticmethod
+    def _incident_summary(inc: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "id": inc["id"], "kind": inc["kind"], "title": inc["title"],
+            "severity": inc["severity"], "state": inc["state"],
+            "opened_at": inc["opened_at"],
+            "last_update": inc["last_update"],
+            "partial": inc.get("partial", False),
+            "nodes": list(inc["nodes"]), "jobs": list(inc["jobs"]),
+            "deployments": list(inc["deployments"]),
+            "n_deaths": len(inc["deaths"]),
+            "n_alerts": len(inc["alerts"]),
+            "n_traces": len((inc.get("links") or {}).get("trace_ids",
+                                                         ())),
+        }
+
+    async def handle_list_incidents(self, conn, data):
+        data = data or {}
+        kind = data.get("kind")
+        limit = int(data.get("limit") or 50)
+        out = [self._incident_summary(inc)
+               for inc in reversed(self._incidents.values())
+               if kind is None or inc["kind"] == kind]
+        return out[:limit]
+
+    async def handle_get_incident(self, conn, data):
+        inc_id = data["incident_id"]
+        inc = self._incidents.get(inc_id)
+        if inc is None:
+            # prefix match (CLI convenience, like trace ids)
+            for iid, candidate in reversed(self._incidents.items()):
+                if iid.startswith(inc_id):
+                    inc = candidate
+                    break
+        return dict(inc) if inc is not None else None
+
+    def _read_dead_raylet_ring(self, inc: Dict[str, Any],
+                               info: "NodeInfo", reason: str) -> None:
+        """Same-host node death: the GCS itself reads the dead raylet's
+        ring from the session dir (there is no surviving raylet on that
+        node to ship it)."""
+        if not info.pid or not self._session_dir:
+            return
+        try:
+            for path in _flight.rings_for_pid(self._session_dir,
+                                              info.pid):
+                tail = _flight.read_ring(path)
+                if tail is not None:
+                    self._incident_add_death(
+                        inc, tail["source"], info.pid,
+                        info.node_id.hex(), reason,
+                        tail["frames"][-200:], tail["torn"],
+                        partial=False)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        except Exception:  # noqa: BLE001 — forensics never wedges
+            logger.exception("dead raylet ring read failed")
+            inc["partial"] = True
 
     def _mark_node_dead(self, node_id: NodeID, reason: str) -> None:
         info = self.nodes.get(node_id)
@@ -1188,6 +1512,19 @@ class GcsServer:
         self._emit_event("ERROR", "NODE_DEAD",
                          f"node {node_id.hex()[:12]} dead: {reason}",
                          node_id=node_id.hex())
+        _flight.record("node_dead",
+                       f"{node_id.hex()[:12]} {reason}")
+        # incident journal: a node death always opens (or joins) an
+        # incident; the dead raylet's own flight ring is read here —
+        # no surviving process on that node will ship it
+        try:
+            inc = self._open_or_merge_incident(
+                "death", f"node {node_id.hex()[:12]} dead: {reason}",
+                node=node_id.hex())
+            self._read_dead_raylet_ring(inc, info, reason)
+            self._incident_wal(inc)
+        except Exception:  # noqa: BLE001 — never wedge the death path
+            logger.exception("incident open failed for node death")
         # failpoint: the death broadcast is lost — consumers must
         # converge via the versioned resource-view sync (gap → resync)
         # instead of trusting one pubsub delivery
@@ -1503,6 +1840,25 @@ class GcsServer:
         self._wal_append("kv_put", ("_internal", ALERTS_FIRING_KV_KEY,
                                     blob, True))
         self._schedule_persist()
+        for t in transitions:
+            _flight.record("alert",
+                           f"{t['rule']} {t['from']} -> {t['to']}")
+        # incident journal: a firing transition opens (or joins) an
+        # incident; re-WALed with the transition attached
+        firing = [t for t in transitions if t["to"] == "firing"]
+        if firing:
+            try:
+                sev = "error" if any(t["severity"] == "critical"
+                                     for t in firing) else "warning"
+                inc = self._open_or_merge_incident(
+                    "alert",
+                    "alert firing: " + ", ".join(
+                        sorted({t["rule"] for t in firing})),
+                    severity=sev)
+                inc["alerts"].extend(firing)
+                self._incident_wal(inc)
+            except Exception:  # noqa: BLE001 — alerting must survive
+                logger.exception("incident open failed for alerts")
 
     async def handle_get_timeseries(self, conn, data):
         data = data or {}
@@ -1526,6 +1882,8 @@ class GcsServer:
             or self.table_storage.persist_failures > 0
         status = "critical" if critical else (
             "degraded" if degraded else "ok")
+        open_incidents = [i for i in self._incidents.values()
+                          if i["state"] == "open"]
         return {
             "ok": not critical,
             "status": status,
@@ -1534,6 +1892,11 @@ class GcsServer:
                                if n.alive),
             "wal_degraded": self._wal_degraded,
             "persist_failures": self.table_storage.persist_failures,
+            "incidents": len(self._incidents),
+            "incidents_open": len(open_incidents),
+            "last_incident": next(
+                reversed(self._incidents.values()))["id"]
+            if self._incidents else None,
         }
 
     async def handle_report_spans(self, conn, data):
@@ -1693,6 +2056,7 @@ class GcsServer:
         deployment = data.get("deployment")
         slo_only = bool(data.get("slo_misses"))
         since = data.get("since")
+        until = data.get("until")
         limit = data.get("limit") or 100
         out = []
         for trace_id, entry in reversed(self._traces.items()):
@@ -1707,6 +2071,8 @@ class GcsServer:
                                      and row["status"] != "ok")):
                 continue
             if since is not None and (row["start"] or 0.0) < since:
+                continue
+            if until is not None and (row["start"] or 0.0) > until:
                 continue
             out.append(row)
             if len(out) >= limit:
@@ -2432,6 +2798,18 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None or info.state == ACTOR_DEAD:
             return
+        # incident journal: an actor worker lost to a crash is a death
+        # episode whether or not a restart saves it (the shipped flight
+        # tail of the dead worker merges into the same incident)
+        try:
+            self._open_or_merge_incident(
+                "death",
+                f"actor {actor_id.hex()[:12]} "
+                f"({info.class_name or 'unknown'}) worker lost: "
+                f"{reason}",
+                job=info.owner_job.hex() if info.owner_job else None)
+        except Exception:  # noqa: BLE001 — never wedge the death path
+            logger.exception("incident open failed for actor death")
         if allow_restart and info.num_restarts < info.max_restarts:
             info.num_restarts += 1
             info.state = ACTOR_RESTARTING

@@ -95,7 +95,7 @@ def validate(method: str, data: Any) -> None:
 # -- core control-plane schemas ------------------------------------------
 # registration / membership
 register_schema("register_node", node_id=bytes, raylet_address=None,
-                resources=dict)
+                resources=dict, pid=Opt(int))
 register_schema("register_worker", worker_id=bytes, pid=int,
                 task_address=None)
 register_schema("register_job", driver_address=None)
@@ -161,7 +161,7 @@ register_schema("healthz")
 register_schema("report_trace_spans", spans=list)
 register_schema("get_trace", trace_id=str)
 register_schema("list_traces", deployment=Opt(str), slo_misses=Opt(bool),
-                since=Opt(float), limit=Opt(int))
+                since=Opt(float), until=Opt(float), limit=Opt(int))
 
 # continuous profiling plane (core/profiler.py)
 register_schema("report_profile", records=list)
@@ -186,6 +186,11 @@ register_schema("list_actors")
 register_schema("list_placement_groups")
 register_schema("list_workers")
 register_schema("list_events", limit=Opt(int), severity=Opt(str))
+# incident forensics plane (core/flight_recorder.py + GCS journal)
+register_schema("report_flight_tail", source=str, pid=int, frames=list,
+                reason=Opt(str), node_id=Opt(bytes), torn=Opt(int))
+register_schema("list_incidents", limit=Opt(int), kind=Opt(str))
+register_schema("get_incident", incident_id=str)
 register_schema("list_objects", limit=Opt(int))
 register_schema("get_task_events", limit=Opt(int), job_id=Opt(str),
                 state=Opt(str))

@@ -37,6 +37,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu.core import flight_recorder as _flight
 from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
@@ -461,6 +462,9 @@ class Raylet:
             # worker capacity: a dedicated control node (0 CPUs → cap
             # 0) must never be handed an actor lease it can't serve
             "max_workers": self._max_workers,
+            # the GCS reads this raylet's flight ring by pid if the
+            # node dies (incident journal, docs/observability.md)
+            "pid": os.getpid(),
         })
         # adopt the cluster-wide config decided by the head node
         self.config = Config.from_json(reply["config"])
@@ -487,6 +491,9 @@ class Raylet:
         self._event_mod = event_mod
         event_mod.init("RAYLET", self.session_dir, gcs_conn=self.gcs_conn,
                        loop=loop)
+        # crash-surviving flight ring (head node: the GCS opened the
+        # process ring already and this is a no-op — first init wins)
+        _flight.init("raylet", self.session_dir, self.config)
         # versioned resource-view subscription (parity: ray_syncer —
         # delta broadcasts replace per-beat full-table polling)
         self._view_by_id: Dict[bytes, Dict[str, Any]] = {}
@@ -553,6 +560,7 @@ class Raylet:
             except BufferError:
                 pass  # export still referenced; process teardown
         self.store.close()
+        _flight.close(unlink=True)  # graceful stop: no crash evidence
 
     def _on_gcs_push(self, channel: str, data: Any) -> None:
         if channel == "quotas":
@@ -1606,7 +1614,54 @@ class Raylet:
             self._release_lease_resources(worker)
         logger.info("worker %s (pid %d) dead: %s",
                     worker.worker_id.hex()[:12], worker.pid, reason)
+        _flight.record("worker_dead",
+                       f"pid={worker.pid} "
+                       f"wid={worker.worker_id.hex()[:12]} {reason}")
+        # forensics: ship the dead worker's flight-ring tail to the GCS
+        # incident journal.  A gracefully-exiting worker unlinks its own
+        # ring (CoreWorker.shutdown), so a surviving ring for a dead pid
+        # means a crash; runtime-intended kills (PG bundle revoke,
+        # raylet shutdown) are excluded explicitly.
+        if not self._closing \
+                and reason != "placement group bundle returned":
+            self._ship_flight_tail(worker.pid, reason)
         self._maybe_schedule()
+
+    def _ship_flight_tail(self, pid: int, reason: str) -> None:
+        """Read the flight ring a dead process left in the session dir
+        and fire-and-forget it to the GCS death-notification path.
+        Best-effort by design: a missing/foreign ring or a dropped RPC
+        degrades the incident to partial, never blocks worker reaping."""
+        tails = []
+        for path in _flight.rings_for_pid(self.session_dir, pid):
+            tail = _flight.read_ring(path)
+            if tail is not None:
+                tails.append(tail)
+            try:
+                os.unlink(path)  # dead pid: nobody writes this again
+            except OSError:
+                pass
+        if not tails or self.gcs_conn is None or self.gcs_conn.closed:
+            return
+
+        async def _ship():
+            for tail in tails:
+                try:
+                    await self.gcs_conn.call("report_flight_tail", {
+                        "source": tail["source"], "pid": pid,
+                        "node_id": self.node_id.binary(),
+                        "reason": reason, "torn": tail["torn"],
+                        "frames": tail["frames"][-200:],
+                    }, timeout=5.0)
+                except (rpc.ConnectionLost, rpc.RpcError,
+                        asyncio.TimeoutError, OSError):
+                    pass  # incident opens partial from the death event
+
+        try:
+            t = asyncio.get_event_loop().create_task(_ship())
+            t.add_done_callback(lambda t: t.exception())
+        except RuntimeError:
+            pass
 
     # ------------------------------------------------------------------
     # resource accounting
@@ -1982,6 +2037,11 @@ class Raylet:
             if lease.env_hash is not None:
                 worker.env_hash = lease.env_hash
             self._assign_tpu_ids(worker, lease.resources.get("TPU", 0.0))
+            if _flight.enabled():
+                _flight.record("lease_grant",
+                               f"pid={worker.pid} "
+                               f"res={lease.resources} "
+                               f"job={job_key}")
             grants.append((lease, worker))
         remaining = self._fair.pending()
         # Grants resolve AFTER the pass so each reply can carry an exact
@@ -2540,6 +2600,9 @@ class Raylet:
                 spans: list = []
                 if _tm.enabled():
                     self._sample_gauges()
+                    fstats = _flight.stats()
+                    if fstats is not None:
+                        _tm.flight_frames(fstats["frames_recorded"])
                     _tm.presample()
                     records = metrics_mod.flush_all()
                     spans = _tm.drain_spans(source)

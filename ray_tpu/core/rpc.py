@@ -119,6 +119,9 @@ IDEMPOTENT_METHODS = frozenset({
     "list_objects", "stack_traces", "list_placement_groups",
     "get_object_locations", "object_pull_chunk", "clock_sync", "get_spans",
     "get_trace", "list_traces", "get_timeseries", "get_alerts", "healthz",
+    "list_incidents", "get_incident",
+    # keyed on (source, pid): a replayed tail dedups in the handler
+    "report_flight_tail",
     # keyed / convergent mutations
     "register_node", "register_worker", "subscribe", "unsubscribe",
     "kv_put", "kv_del", "health_report", "actor_started",

@@ -169,6 +169,50 @@ class HeadSupervisor:
             _tm.gcs_respawn()
         except Exception:  # noqa: BLE001 — telemetry is best-effort
             pass
+        self._ship_dead_head_tail(proc.pid)
         # surviving raylets re-register and drivers reconnect via the
         # PR-11 backoff loops; recovery replays snapshot + WAL
         return False
+
+    def _ship_dead_head_tail(self, dead_pid: int) -> None:
+        """Hand the dead head's flight ring to the respawned GCS so the
+        incident journal records what the OLD head was doing when it
+        died.  Nobody else can: the raylet ships dead workers' rings
+        and the GCS reads dead raylets' rings, but when the head itself
+        dies the supervisor is the only survivor that knows the pid."""
+        import asyncio
+        import os
+
+        from ray_tpu.core import flight_recorder as _flight
+        from ray_tpu.core import rpc
+
+        async def _ship() -> None:
+            for path in _flight.rings_for_pid(self._session_dir,
+                                              dead_pid):
+                tail = _flight.read_ring(path)
+                try:
+                    os.unlink(path)  # dead pid: nobody writes it again
+                except OSError:
+                    pass
+                if tail is None or not tail["frames"]:
+                    continue
+                conn = await rpc.connect(
+                    ("127.0.0.1", self._gcs_port), timeout=5.0)
+                try:
+                    await conn.call("report_flight_tail", {
+                        "source": tail["source"],
+                        "pid": tail["pid"],
+                        "reason": "head process died",
+                        "torn": tail["torn"],
+                        "frames": tail["frames"][-200:],
+                    }, timeout=5.0)
+                finally:
+                    conn.close()
+
+        try:
+            asyncio.run(asyncio.wait_for(_ship(), timeout=15.0))
+        except Exception:  # noqa: BLE001 — forensics never blocks
+            # the respawn path; a lost tail just means a thinner
+            # incident entry
+            logger.debug("dead-head flight tail ship failed",
+                         exc_info=True)

@@ -1114,6 +1114,57 @@ def trace_evicted(n: int = 1) -> None:
 
 
 # ---------------------------------------------------------------------------
+# incident forensics (core/flight_recorder.py + GCS incident journal)
+# ---------------------------------------------------------------------------
+
+def events_evicted(n: int = 1) -> None:
+    """GCS-side: cluster-event records displaced from a per-severity
+    retention ring (raise event_ring_size to keep more)."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_events_evicted_total",
+             "cluster-event records evicted from the per-severity "
+             "retention rings").inc_key(_EMPTY_KEY, float(n))
+
+
+def incident_opened(kind: str) -> None:
+    """GCS-side: an incident auto-opened (kind: death | alert)."""
+    if not enabled():
+        return
+    _counter("ray_tpu_incidents_total",
+             "incidents auto-opened by the GCS journal",
+             ("kind",)).inc_key((("kind", kind),), 1.0)
+
+
+def incidents_open(n: int) -> None:
+    """GCS-side gauge: incidents currently retained in the journal."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_incidents_open",
+           "incidents retained in the GCS journal"
+           ).set_key(_EMPTY_KEY, float(n))
+
+
+def flight_tail_shipped(n: int = 1) -> None:
+    """GCS-side: dead-process flight tails attached to incidents."""
+    if not enabled() or n <= 0:
+        return
+    _counter("ray_tpu_flight_tails_shipped_total",
+             "dead-process flight-recorder tails shipped to the GCS "
+             "incident journal").inc_key(_EMPTY_KEY, float(n))
+
+
+def flight_frames(n: int) -> None:
+    """Per-process gauge, set from the flush loops (never per-frame):
+    frames this process has recorded into its flight ring."""
+    if not enabled():
+        return
+    _gauge("ray_tpu_flight_frames_total",
+           "frames recorded into this process's flight-recorder ring"
+           ).set_key(_EMPTY_KEY, float(n))
+
+
+# ---------------------------------------------------------------------------
 # gauges set by the flush loops (samplers run right before a flush)
 # ---------------------------------------------------------------------------
 

@@ -186,10 +186,22 @@ _buf: "deque[Dict[str, Any]]" = deque(maxlen=8192)
 #: increment — a lock would cost more than the count is worth)
 _dropped = 0
 
+#: optional span-completion sink (the flight recorder registers one so
+#: span completions land in the crash-surviving ring as well as the
+#: flush buffer).  One global load + None test when nothing registered.
+_span_sink = None
+
 
 def dropped() -> int:
     """Spans this process dropped to the buffer bound (never flushed)."""
     return _dropped
+
+
+def set_span_sink(fn) -> None:
+    """Install (or clear, with None) the per-process span-completion
+    sink.  The sink must be cheap and must never raise."""
+    global _span_sink
+    _span_sink = fn
 
 
 def _append(rec: Dict[str, Any]) -> None:
@@ -197,6 +209,12 @@ def _append(rec: Dict[str, Any]) -> None:
     if len(_buf) == _buf.maxlen:
         _dropped += 1
     _buf.append(rec)
+    sink = _span_sink
+    if sink is not None:
+        try:
+            sink(rec)
+        except Exception:  # noqa: BLE001 — forensics never breaks tracing
+            pass
 
 
 class Span:

@@ -56,6 +56,7 @@ def _spec_dumps(obj) -> bytes:
         return cloudpickle.dumps(obj)
 
 from ray_tpu.core import device_telemetry as _dt
+from ray_tpu.core import flight_recorder as _flight
 from ray_tpu.core import profiler as _prof
 from ray_tpu.core import rpc
 from ray_tpu.core import telemetry as _tm
@@ -241,6 +242,9 @@ class CoreWorker:
         self.worker_id = WorkerID.from_random()
         self._worker_id_hex = self.worker_id.hex()
         self.config = config or get_config()
+        # crash-surviving flight ring for this process (no-op if the
+        # co-located GCS/raylet already opened one — first init wins)
+        _flight.init(mode, session_dir, self.config)
 
         self.memory_store = MemoryStore()
         _mark("pre_store")
@@ -751,6 +755,9 @@ class CoreWorker:
         self._loop.call_soon_threadsafe(_drain_and_stop)
         self._loop_thread.join(timeout=5)
         self.store_client.close()
+        # graceful exit removes the flight ring: a surviving ring for a
+        # dead pid is then an unambiguous crash signal to the raylet
+        _flight.close(unlink=True)
         if global_worker_or_none() is self:
             set_global_worker(None)
 
@@ -1486,6 +1493,12 @@ class CoreWorker:
             max_calls=max_calls,
         )
         self._trace_begin(spec)
+        if _flight.enabled():
+            # owner-side breadcrumb: a dead driver's ring shows what it
+            # was submitting, and the paired bench (flight_overhead_pct)
+            # toggles THIS process's recorder — per-task cost is real
+            _flight.record("task_submit",
+                           f"{descriptor} task={task_id.hex()[:16]}")
         if stream_returns:
             # register BEFORE submission: the first dynamic_items push
             # can arrive while .remote() is still unwinding
@@ -3463,6 +3476,9 @@ class CoreWorker:
                                   "tasks queued owner-side awaiting "
                                   "lease/dispatch",
                                   self._queued_task_depth(), wid_tags)
+                    fstats = _flight.stats()
+                    if fstats is not None:
+                        _tm.flight_frames(fstats["frames_recorded"])
                     _tm.presample()
                     records = metrics_mod.flush_all()
                     spans = _tm.drain_spans(source)
@@ -3961,6 +3977,16 @@ class CoreWorker:
                 spec.function_descriptor, spec.task_id.hex(),
                 spec.actor_id.hex() if spec.actor_id else None,
                 spec.job_id.hex() if spec.job_id else None)
+        if _flight.enabled():
+            # last-executing identity: the frame a postmortem reads
+            # first when this worker dies mid-task
+            _flight.record(
+                "task_start",
+                f"{spec.function_descriptor} task={spec.task_id.hex()[:16]}"
+                f" actor={spec.actor_id.hex()[:16] if spec.actor_id else '-'}"
+                f" job={spec.job_id.hex() if spec.job_id else '-'}"
+                f" attempt={spec.attempt_number}")
+        _fl_status = "error"  # overwritten on every non-raising path
         exec_t0 = None  # stamped AFTER arg resolution (fetch != exec)
         espan = None  # executor-side trace span (traced tasks only)
         trace_token = None  # ambient-context reset token (outer finally)
@@ -4028,10 +4054,12 @@ class CoreWorker:
                 # (calling fn only created the generator object), so the
                 # cancel-interrupt window must stay open through the
                 # iteration — it closes in there before results commit
+                _fl_status = "ok"
                 return self._post_dynamic_returns(spec, value)
             # body done: results are being committed from here on — a
             # cancel interrupt landing now must not drop them
             INTERRUPT_WINDOW.open = False
+            _fl_status = "ok"
             if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                 results = [(rid.binary(), "inline", serialize(None).to_bytes())
                            for rid in spec.return_ids()]
@@ -4058,8 +4086,10 @@ class CoreWorker:
                 # cancel-driven interrupt (handle_cancel_task raised it
                 # into this thread), not a user Ctrl-C
                 self._interrupted_tasks.discard(tid_bin)
+                _fl_status = "cancelled"
                 return self._cancelled_reply(spec)
             if isinstance(e, ActorExitRequest):
+                _fl_status = "exit"
                 return self._actor_exit_reply(spec)
             logger.debug("task %s raised", spec.debug_name(), exc_info=True)
             blob = serialize_exception(
@@ -4095,6 +4125,11 @@ class CoreWorker:
                 # (parent = the owner's task span); a failed body
                 # already ended it with status=error (end is idempotent)
                 espan.end()
+            if _flight.enabled():
+                _flight.record(
+                    "task_finish",
+                    f"{spec.function_descriptor} "
+                    f"task={spec.task_id.hex()[:16]} {_fl_status}")
             (self._ctx.task_id, self._ctx.put_counter,
              self._ctx.attempt_number, self._ctx.current_resources) = prev
             with self._exec_track_lock:

@@ -354,11 +354,14 @@ class Dashboard:
         """Distributed traces from the GCS ring.  ``?trace_id=`` emits
         ONE trace's spans as Perfetto-compatible chrome-trace JSON;
         without it, a JSON list of retained trace summaries
-        (``?deployment=``, ``?slo_misses=1``, ``?limit=``)."""
+        (``?deployment=``, ``?slo_misses=1``, ``?since=``/``?until=``
+        epoch-seconds window, ``?limit=``)."""
         from ray_tpu.core import worker as worker_mod
         from ray_tpu.experimental.state import traces as traces_mod
 
         trace_id = request.query.get("trace_id")
+        since = request.query.get("since")
+        until = request.query.get("until")
 
         def fetch():
             core = worker_mod.global_worker()
@@ -368,6 +371,8 @@ class Dashboard:
                 "deployment": request.query.get("deployment"),
                 "slo_misses": request.query.get("slo_misses")
                 in ("1", "true"),
+                "since": float(since) if since else None,
+                "until": float(until) if until else None,
                 "limit": int(request.query.get("limit", "100"))})
         result = await self._state(fetch)
         if trace_id:
@@ -380,6 +385,27 @@ class Dashboard:
                 "traceEvents": traces_mod.perfetto_events(
                     result.get("spans") or []),
             })
+        return self._json(result)
+
+    async def handle_incidents(self, request):
+        """The incident journal.  ``?incident_id=`` returns one full
+        record (flight tails included); without it, newest-first
+        summaries (``?kind=death|alert``, ``?limit=``)."""
+        from ray_tpu.core import worker as worker_mod
+
+        incident_id = request.query.get("incident_id")
+
+        def fetch():
+            core = worker_mod.global_worker()
+            if incident_id:
+                return core.gcs_call("get_incident",
+                                     {"incident_id": incident_id})
+            return core.gcs_call("list_incidents", {
+                "kind": request.query.get("kind"),
+                "limit": int(request.query.get("limit", "50"))})
+        result = await self._state(fetch)
+        if incident_id and result is None:
+            return self._json({"error": "incident not found"})
         return self._json(result)
 
     # -- lifecycle ------------------------------------------------------
@@ -397,6 +423,7 @@ class Dashboard:
         app.router.add_get("/profile", self.handle_profile)
         app.router.add_get("/api/analyze", self.handle_analyze)
         app.router.add_get("/api/traces", self.handle_traces)
+        app.router.add_get("/api/incidents", self.handle_incidents)
         app.router.add_get("/api/timeseries", self.handle_timeseries)
         app.router.add_get("/api/alerts", self.handle_alerts)
         app.router.add_get("/healthz", self.handle_healthz)

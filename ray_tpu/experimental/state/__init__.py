@@ -18,3 +18,7 @@ from ray_tpu.experimental.state.traces import (  # noqa: F401
     get_trace,
     list_traces,
 )
+from ray_tpu.experimental.state.incidents import (  # noqa: F401
+    get_incident,
+    list_incidents,
+)

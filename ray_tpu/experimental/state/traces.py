@@ -54,10 +54,11 @@ def get_trace(trace_id: str) -> Optional[Dict[str, Any]]:
 def list_traces(deployment: Optional[str] = None,
                 slo_misses: bool = False,
                 since: Optional[float] = None,
+                until: Optional[float] = None,
                 limit: int = 100) -> List[Dict[str, Any]]:
     return _core().gcs_call("list_traces", {
         "deployment": deployment, "slo_misses": slo_misses,
-        "since": since, "limit": limit})
+        "since": since, "until": until, "limit": limit})
 
 
 # ---------------------------------------------------------------------------

@@ -208,6 +208,10 @@ def cmd_status(args) -> None:
     print(f"rpc: {retries:g} retries, {deadlines:g} deadline-exceeded, "
           f"{misses:g} heartbeat misses")
     print(f"transfers: {pulls:g} pulls, {tbytes/2**20:.1f} MiB moved")
+    if gcs_dbg.get("incidents"):
+        line = (f"incidents: {gcs_dbg['incidents']} recorded"
+                f" ({gcs_dbg.get('incidents_open', 0)} open)")
+        print(line + "  — `ray-tpu postmortem` for the newest")
     _print_persistence_section(gcs_dbg)
     if drops:
         print(f"WARNING: {drops} task events dropped by the GCS ring "
@@ -849,6 +853,75 @@ def cmd_trace(args) -> None:
         print(traces_mod.format_trace_list(rows))
 
 
+def cmd_incidents(args) -> None:
+    """The cluster incident journal: auto-opened on process/node
+    deaths and firing alerts (``ray-tpu incidents`` lists,
+    ``ray-tpu incidents <id>`` shows one; full report via
+    ``ray-tpu postmortem``)."""
+    _connect(args)
+    from ray_tpu.experimental.state import incidents as inc_mod
+
+    if args.incident_id:
+        inc = inc_mod.get_incident(args.incident_id)
+        if args.json:
+            print(json.dumps(inc, indent=2, default=str))
+        else:
+            print(inc_mod.format_incident(inc))
+        return
+    rows = inc_mod.list_incidents(kind=args.kind, limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+    else:
+        print(inc_mod.format_incident_list(rows))
+
+
+def cmd_postmortem(args) -> None:
+    """One-command postmortem: death cause, each dead process's
+    flight-recorder tail, the linked trace trees, the alert timeline,
+    and cluster-series sparklines across the incident window."""
+    _connect(args)
+    from ray_tpu.experimental.state import incidents as inc_mod
+    from ray_tpu.experimental.state import traces as traces_mod
+
+    if args.incident_id:
+        inc = inc_mod.get_incident(args.incident_id)
+    else:
+        inc = inc_mod.last_incident()
+        if inc is None:
+            sys.exit("no incidents recorded — nothing to postmortem")
+    if args.json:
+        print(json.dumps(inc, indent=2, default=str))
+        return
+    print(inc_mod.format_incident(inc,
+                                  fetch_trace=traces_mod.get_trace))
+
+
+def cmd_debug_bundle(args) -> None:
+    """Portable forensics tar: the incident (default: newest) plus
+    snapshots of every linked plane, indexed by a manifest — built to
+    be attached to a ticket and read offline."""
+    _connect(args)
+    from ray_tpu.experimental.state import incidents as inc_mod
+
+    inc = None
+    if args.incident_id:
+        inc = inc_mod.get_incident(args.incident_id)
+        if inc is None:
+            sys.exit(f"incident {args.incident_id!r} not found")
+    elif not args.window:
+        inc = inc_mod.last_incident()
+    out = args.output
+    if not out:
+        tag = inc["id"] if inc else time.strftime("%Y%m%d-%H%M%S")
+        out = f"debug-bundle-{tag}.tar.gz"
+    manifest = inc_mod.build_bundle(out, incident=inc,
+                                    window_s=args.window)
+    print(f"wrote {out}")
+    print("  incident: " + (manifest["incident_id"]
+                            or "(none — windowed snapshot only)"))
+    print(f"  files: {', '.join(manifest['files'])}")
+
+
 def cmd_logs(args) -> None:
     """Tail worker stdout/stderr cluster-wide off the ``worker_logs``
     GCS channel (the raylet log monitors already publish; this is the
@@ -973,6 +1046,45 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser(
+        "incidents",
+        help="the cluster incident journal (deaths, firing alerts)")
+    sp.add_argument("incident_id", nargs="?", default=None,
+                    help="incident id (prefix ok); omit to list")
+    sp.add_argument("--kind", choices=["death", "alert"], default=None)
+    sp.add_argument("--limit", type=int, default=50)
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_incidents)
+
+    sp = sub.add_parser(
+        "postmortem",
+        help="full report on one incident: flight tails, trace trees, "
+             "alert timeline, series sparklines")
+    sp.add_argument("incident_id", nargs="?", default=None,
+                    help="incident id (prefix ok; default: newest)")
+    sp.add_argument("--last", action="store_true",
+                    help="the newest incident (explicit spelling of "
+                         "the default)")
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_postmortem)
+
+    sp = sub.add_parser(
+        "debug-bundle",
+        help="portable postmortem tar (incident + linked-plane "
+             "snapshots + manifest)")
+    sp.add_argument("incident_id", nargs="?", default=None,
+                    help="incident to bundle (default: newest)")
+    sp.add_argument("--window", type=float, default=None, metavar="S",
+                    help="bundle the last S seconds instead of an "
+                         "incident")
+    sp.add_argument("--output", "-o", default=None,
+                    help="output path (default "
+                         "./debug-bundle-<id>.tar.gz)")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_debug_bundle)
 
     sp = sub.add_parser(
         "logs", help="tail worker logs cluster-wide")

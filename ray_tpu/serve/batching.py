@@ -57,6 +57,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core import device_telemetry as _dt
+from ray_tpu.core import flight_recorder as _flight
 from ray_tpu.core import telemetry as _tm
 from ray_tpu.core import tracing as _trace
 
@@ -716,6 +717,13 @@ class ContinuousBatcher:
             span.device_done(next_tokens)
             step_t1 = time.time()
             _tm.serve_decode_step(self._deployment, step_t1 - step_t0)
+            if _flight.enabled():
+                # a replica SIGKILLed mid-decode leaves its last steps
+                # in the crash-surviving ring (incident forensics)
+                _flight.record(
+                    "batch_step",
+                    f"{self._deployment} n={len(batch)} "
+                    f"{(step_t1 - step_t0) * 1e3:.1f}ms")
             # local ring too: replica metrics expose step p50/p99 so a
             # bench/operator can see decode-step latency directly (the
             # gang fan-out's whole cost lives here)

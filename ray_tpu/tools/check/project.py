@@ -30,6 +30,10 @@ These rules check agreement between *places that must not drift apart*:
   in the worker's submit-path functions must forward the distributed
   trace context (a ``trace`` payload key or a spec blob); a site that
   drops it silently truncates every assembled trace at that hop.
+* ``flight-vocab`` — every literal event type passed to the flight
+  recorder's ``record`` must be declared in its ``EVENT_TYPES``
+  catalogue; an undeclared type silently degrades to ``mark`` at
+  runtime and vanishes from the postmortem legend.
 * ``step-instrumentation`` — engine classes exposing a compiled step
   entry point (``step`` / ``shard_step`` / ``decode_step`` /
   ``train_step`` / ``compute_actions``) must wrap every ``jax.jit``
@@ -56,7 +60,7 @@ from ray_tpu.tools.check.findings import Finding, parse_catalogue
 __all__ = ["ProjectConfig", "check_rpc_conformance",
            "check_failpoint_registry", "check_metric_drift",
            "check_trace_propagation", "check_persist_conformance",
-           "check_step_instrumentation",
+           "check_step_instrumentation", "check_flight_vocab",
            "collect_metric_names", "parse_catalogue", "PROJECT_RULES"]
 
 
@@ -92,7 +96,10 @@ class ProjectConfig:
     persist_tables: Tuple[str, ...] = (
         "kv", "jobs", "job_counter", "functions", "actors",
         "named_actors", "placement_groups", "nodes",
-        "quotas", "lease_tables", "_node_states")
+        "quotas", "lease_tables", "_node_states", "_incidents")
+    #: flight-vocab scope: the module declaring the EVENT_TYPES
+    #: catalogue every ``_flight.record(...)`` literal must appear in
+    flight_module: str = "ray_tpu/core/flight_recorder.py"
     persist_calls: Tuple[str, ...] = (
         "_schedule_persist", "_persist_now", "_wal_append", "_wal_flush",
         "_wal_actor", "_wal_pg", "_wal_job")
@@ -326,6 +333,73 @@ def check_failpoint_registry(contexts: List[ModuleContext],
                 message=f"failpoint site {name!r} not documented in "
                         f"{cfg.failpoint_doc} (add it to the woven-sites "
                         f"table)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# flight-vocab
+# ---------------------------------------------------------------------------
+
+def _collect_flight_vocab(cfg: ProjectConfig) -> Set[str]:
+    """Keys of the ``EVENT_TYPES`` catalogue in the flight-recorder
+    module — parsed statically, same discipline as the schema and
+    idempotent-method registries."""
+    src = cfg.read(cfg.flight_module)
+    if src is None:
+        return set()
+    for node in ast.walk(ast.parse(src)):
+        target = None
+        if isinstance(node, ast.Assign) and node.targets:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        if isinstance(target, ast.Name) and target.id == "EVENT_TYPES" \
+                and isinstance(getattr(node, "value", None), ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return set()
+
+
+def check_flight_vocab(contexts: List[ModuleContext],
+                       cfg: ProjectConfig) -> List[Finding]:
+    """Every literal event type passed to a flight-recorder ``record``
+    call must be declared in the ``EVENT_TYPES`` catalogue (the same
+    contract the failpoint registry enforces for site names).  At
+    runtime an undeclared type silently degrades to ``mark``; this
+    rule turns that degradation into a CI failure so the postmortem
+    renderer's legend stays the single complete vocabulary."""
+    rule = "flight-vocab"
+    findings: List[Finding] = []
+    vocab = _collect_flight_vocab(cfg)
+    if not vocab:
+        return findings  # recorder module outside this tree
+    for ctx in contexts:
+        in_module = ctx.path == cfg.flight_module
+        for node in _walked(ctx):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None or not d.endswith(".record"):
+                continue
+            recv = d.rsplit(".", 1)[0]
+            # `_flight.record(...)` everywhere; inside the recorder
+            # module the instance calls (`r.record`, `rec.record`,
+            # `self.record`) are in scope too
+            if "flight" not in recv \
+                    and not (in_module and recv in ("r", "rec", "self")):
+                continue
+            etype = _str_arg(node, 0)
+            if etype is not None and etype not in vocab:
+                findings.append(Finding(
+                    path=ctx.path, line=node.lineno, rule=rule,
+                    symbol=etype,
+                    message=f"flight event type {etype!r} is not "
+                            f"declared in EVENT_TYPES "
+                            f"({cfg.flight_module}): at runtime it "
+                            f"degrades to 'mark' and the postmortem "
+                            f"legend loses it — declare it in the "
+                            f"catalogue"))
     return findings
 
 
@@ -776,4 +850,5 @@ PROJECT_RULES = {
     "trace-propagation": check_trace_propagation,
     "persist-conformance": check_persist_conformance,
     "step-instrumentation": check_step_instrumentation,
+    "flight-vocab": check_flight_vocab,
 }

@@ -146,6 +146,12 @@ TRAFFIC_DEPENDENT = {
     "ray_tpu_train_step_data_wait_frac",
     "ray_tpu_serve_decode_device_frac",
     "ray_tpu_gang_rank_skew_seconds",
+    # incident forensics: incidents need a death or firing alert, tail
+    # ships need a crashed process, event-ring evictions need a ring to
+    # actually wrap (5000 events of one severity)
+    "ray_tpu_incidents_total",
+    "ray_tpu_flight_tails_shipped_total",
+    "ray_tpu_events_evicted_total",
 }
 
 
